@@ -1,0 +1,120 @@
+"""Benchmark: SSB Q1.1-style filtered aggregation on one segment, real chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+metric: scanned rows/sec/chip on the full query path (plan + kernel +
+reduce). vs_baseline: speedup over a single-threaded vectorized numpy CPU
+implementation of the same query on the same data — the stand-in for the
+reference's single-threaded pinot-perf JMH baseline (BASELINE.md: the
+reference publishes no absolute numbers; the CPU baseline must be measured,
+and a numpy scan is a *stronger* baseline than Pinot's per-block Java loop).
+
+Query (SSB Q1.1 shape, pinot-integration-tests ssb_query_set.yaml):
+    SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder
+    WHERE lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25
+      AND lo_orderdate BETWEEN 19930101 AND 19940101
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = 1 << 27  # 134M rows — the north-star config is a 100M-row segment
+CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_cache")
+SQL = ("SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder "
+       "WHERE lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25 "
+       "AND lo_orderdate BETWEEN 19930101 AND 19940101")
+
+
+def build_or_load_segment():
+    from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    seg_dir = os.path.join(CACHE, f"lineorder_{N_ROWS}", "seg_0")
+    if os.path.exists(os.path.join(seg_dir, "metadata.json")):
+        return ImmutableSegment.load(seg_dir)
+
+    rng = np.random.default_rng(1992)
+    n = N_ROWS
+    cols = {
+        "lo_orderdate": (19920000 + rng.integers(0, 70000, n))
+        .astype(np.int32),
+        "lo_discount": rng.integers(0, 11, n).astype(np.int32),
+        "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
+        "lo_extendedprice": rng.integers(900, 55000, n).astype(np.int32),
+    }
+    schema = Schema("lineorder", [
+        FieldSpec("lo_orderdate", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("lo_discount", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("lo_quantity", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("lo_extendedprice", DataType.INT, FieldType.METRIC),
+    ])
+    builder = SegmentBuilder(schema, TableConfig("lineorder"))
+    builder.build(cols, os.path.join(CACHE, f"lineorder_{N_ROWS}"), "seg_0")
+    return ImmutableSegment.load(seg_dir)
+
+
+def numpy_baseline(seg, iters: int = 3):
+    """Single-threaded vectorized CPU execution of the same query."""
+    date = np.asarray(seg.raw_values("lo_orderdate"))
+    disc = np.asarray(seg.raw_values("lo_discount"))
+    qty = np.asarray(seg.raw_values("lo_quantity"))
+    price = np.asarray(seg.raw_values("lo_extendedprice"))
+    best = float("inf")
+    result = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        mask = ((disc >= 1) & (disc <= 3) & (qty < 25)
+                & (date >= 19930101) & (date <= 19940101))
+        result = int((price[mask] * disc[mask].astype(np.int64)).sum())
+        best = min(best, time.perf_counter() - t0)
+    return result, best
+
+
+def engine_run(seg, iters: int = 5):
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.server import TableDataManager
+
+    dm = TableDataManager("lineorder")
+    dm.add_segment(seg)
+    broker = Broker()
+    broker.register_table(dm)
+
+    broker.query(SQL)  # warmup: device upload + XLA compile
+    best = float("inf")
+    result = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        res = broker.query(SQL)
+        best = min(best, time.perf_counter() - t0)
+        result = res.rows[0][0]
+    return int(result), best
+
+
+def main() -> None:
+    seg = build_or_load_segment()
+    expected, cpu_t = numpy_baseline(seg)
+    got, tpu_t = engine_run(seg)
+    if got != expected:
+        print(json.dumps({"metric": "ssb_q1.1_rows_per_sec_per_chip",
+                          "value": 0, "unit": "rows/s", "vs_baseline": 0,
+                          "error": f"result mismatch {got} != {expected}"}))
+        sys.exit(1)
+    rows_per_sec = N_ROWS / tpu_t
+    print(json.dumps({
+        "metric": "ssb_q1.1_rows_per_sec_per_chip",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(cpu_t / tpu_t, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

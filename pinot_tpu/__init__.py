@@ -1,0 +1,28 @@
+"""pinot_tpu — a TPU-native real-time distributed OLAP framework.
+
+Brand-new design with the capabilities of Apache Pinot (reference:
+/root/reference, pure JVM), rebuilt TPU-first on JAX/XLA/Pallas/pjit:
+
+- columnar immutable/mutable segments with sorted dictionary encoding
+  (reference: pinot-segment-local SegmentIndexCreationDriverImpl)
+- per-segment query kernels: predicate masks -> projection gathers ->
+  masked aggregations / segment_sum group-by (reference: pinot-core
+  DocIdSetOperator / ProjectionOperator / AggregationOperator /
+  DefaultGroupByExecutor)
+- SQL subset compiler + physical planner with fast paths & pruning
+  (reference: CalciteSqlParser + InstancePlanMakerImplV2)
+- in-process broker scatter-gather + reduce (reference:
+  BrokerReduceService), scaling out via jax.sharding Mesh + shard_map
+  with psum combine over ICI instead of Netty scatter-gather.
+
+OLAP needs exact 64-bit arithmetic (long counts, double sums — Pinot
+returns double for SUM over any numeric column). We therefore enable
+jax x64 at import; accumulator dtypes degrade gracefully on backends
+where f64 is emulated (see pinot_tpu.ops.aggregations.acc_dtypes).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from .broker import Broker, Connection, connect  # noqa: F401

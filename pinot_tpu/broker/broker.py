@@ -1,0 +1,94 @@
+"""Broker: SQL in, ResultTable out — compile, route, scatter, reduce.
+
+Reference parity: pinot-broker/.../requesthandler/
+BaseSingleStageBrokerRequestHandler.java (compile :256, optimize :492-521,
+route :560-577) + SingleConnectionBrokerRequestHandler.java:141-151
+(scatter-gather + reduce). Round-1 scope: in-process execution over local
+TableDataManagers (the Netty data plane of the reference is replaced by
+direct calls here and by ICI collectives in parallel/distributed.py; a
+multi-host gRPC/DCN dispatch layer arrives with the cluster roles).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..engine.executor import execute_plan
+from ..engine.reduce import ResultTable, reduce_partials
+from ..query.context import build_query_context
+from ..query.planner import SegmentPlanner
+from ..query.sql import SqlError, parse_sql
+from ..server.data_manager import TableDataManager
+
+
+class Broker:
+    def __init__(self):
+        self._tables: Dict[str, TableDataManager] = {}
+
+    # -- table registry (ideal-state analog) -------------------------------
+    def register_table(self, dm: TableDataManager) -> None:
+        self._tables[dm.table_name] = dm
+
+    def table(self, name: str) -> TableDataManager:
+        if name not in self._tables:
+            raise SqlError(f"table {name!r} not found; "
+                           f"have {list(self._tables)}")
+        return self._tables[name]
+
+    @property
+    def table_names(self) -> List[str]:
+        return list(self._tables)
+
+    # -- query path --------------------------------------------------------
+    def query(self, sql: str) -> ResultTable:
+        t0 = time.perf_counter()
+        stmt = parse_sql(sql)
+        ctx = build_query_context(stmt)
+        dm = self.table(ctx.table)
+        segments = dm.acquire_segments()
+
+        # mesh-resident table: one shard_map program + ICI combine replaces
+        # the per-segment scatter-gather entirely
+        if dm.distributed is not None and ctx.is_aggregation:
+            partial = dm.distributed.try_execute(ctx)
+            if partial is not None:
+                result = reduce_partials(ctx, [partial])
+                result.num_segments = len(dm.distributed.segments)
+                result.num_docs_scanned = sum(
+                    s.n_docs for s in dm.distributed.segments)
+                result.time_ms = (time.perf_counter() - t0) * 1e3
+                return result
+
+        partials = []
+        pruned = 0
+        docs_scanned = 0
+        for seg in segments:
+            plan = SegmentPlanner(ctx, seg).plan()
+            if plan.kind == "pruned":
+                pruned += 1
+            partials.append(execute_plan(plan))
+            if plan.kind in ("kernel", "host"):
+                docs_scanned += seg.n_docs
+
+        result = reduce_partials(ctx, partials)
+        result.num_segments = len(segments)
+        result.num_segments_pruned = pruned
+        result.num_docs_scanned = docs_scanned
+        result.time_ms = (time.perf_counter() - t0) * 1e3
+        return result
+
+
+class Connection:
+    """Client-facing handle (pinot-clients java-client analog)."""
+
+    def __init__(self, broker: Broker):
+        self.broker = broker
+
+    def execute(self, sql: str) -> ResultTable:
+        return self.broker.query(sql)
+
+    __call__ = execute
+
+
+def connect(broker: Broker) -> Connection:
+    return Connection(broker)

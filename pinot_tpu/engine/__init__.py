@@ -1,0 +1,2 @@
+from .executor import SegmentExecutor, execute_segment  # noqa: F401
+from .reduce import ResultTable, reduce_partials  # noqa: F401

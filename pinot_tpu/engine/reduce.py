@@ -1,0 +1,264 @@
+"""Broker reduce: merge per-segment partials into the final result table.
+
+Reference parity: pinot-core/.../query/reduce/BrokerReduceService.java:61
+(merges server DataTables; aggregation/groupby/selection reducers, HAVING,
+ORDER BY, LIMIT trimming via IndexedTable). States arriving here are
+value-space and mergeable (dict ids were resolved per segment at extract
+time), so merging is pure arithmetic/set union regardless of which path
+(device kernel, fast metadata, host numpy) produced each partial.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..query.context import AggExpr, QueryContext, _expr_label
+from ..query.sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr,
+                         Comparison, FuncCall, Identifier, InList, Literal,
+                         SqlError, Star)
+from .executor import AggPartial, GroupByPartial, SelectionPartial
+
+DEFAULT_LIMIT = 10  # Pinot's default LIMIT for selection/group-by results
+
+
+@dataclass
+class ResultTable:
+    columns: List[str]
+    rows: List[tuple]
+    num_docs_scanned: int = 0
+    num_segments: int = 0
+    num_segments_pruned: int = 0
+    time_ms: float = 0.0
+    trace: Optional[dict] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "resultTable": {
+                "dataSchema": {"columnNames": self.columns},
+                "rows": [list(r) for r in self.rows],
+            },
+            "numSegmentsQueried": self.num_segments,
+            "numSegmentsPruned": self.num_segments_pruned,
+            "numDocsScanned": self.num_docs_scanned,
+            "timeUsedMs": self.time_ms,
+        }
+
+    def __repr__(self) -> str:
+        return f"ResultTable({self.columns}, {len(self.rows)} rows)"
+
+
+# ---------------------------------------------------------------------------
+# state algebra
+# ---------------------------------------------------------------------------
+
+def merge_state(kind: str, a: Any, b: Any) -> Any:
+    if kind in ("count", "sum"):
+        return a + b
+    if kind == "min":
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return min(a, b)
+    if kind == "max":
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return max(a, b)
+    if kind == "avg":
+        return (a[0] + b[0], a[1] + b[1])
+    if kind == "distinct_count":
+        return a | b
+    raise SqlError(f"unknown aggregation kind {kind}")
+
+
+def finalize_state(kind: str, s: Any) -> Any:
+    if kind == "avg":
+        return None if s[1] == 0 else s[0] / s[1]
+    if kind == "distinct_count":
+        return len(s)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# reduce
+# ---------------------------------------------------------------------------
+
+def reduce_partials(ctx: QueryContext, partials: List[Any]) -> ResultTable:
+    if ctx.is_group_by:
+        return _reduce_group_by(ctx, [p for p in partials
+                                      if isinstance(p, GroupByPartial)])
+    if ctx.is_aggregation:
+        return _reduce_aggregation(ctx, [p for p in partials
+                                         if isinstance(p, AggPartial)])
+    return _reduce_selection(ctx, [p for p in partials
+                                   if isinstance(p, SelectionPartial)])
+
+
+def _reduce_aggregation(ctx: QueryContext, partials: List[AggPartial]
+                        ) -> ResultTable:
+    kinds = [a.kind for a in ctx.aggregations]
+    merged = [_empty(k) for k in kinds]
+    for p in partials:
+        for i, k in enumerate(kinds):
+            merged[i] = merge_state(k, merged[i], p.states[i])
+    finalized = {ctx.aggregations[i].label: finalize_state(k, merged[i])
+                 for i, k in enumerate(kinds)}
+    row = tuple(finalized[item.label] for item in ctx.select_items)
+    labels = [l for item, l in zip(ctx.select_items, ctx.labels)]
+    return ResultTable(labels, [row])
+
+
+def _empty(kind: str) -> Any:
+    return {"count": 0, "sum": 0, "min": None, "max": None,
+            "avg": (0, 0), "distinct_count": set()}[kind]
+
+
+def _reduce_group_by(ctx: QueryContext, partials: List[GroupByPartial]
+                     ) -> ResultTable:
+    kinds = [a.kind for a in ctx.aggregations]
+    merged: Dict[Tuple, List[Any]] = {}
+    for p in partials:
+        for key, states in p.groups.items():
+            cur = merged.get(key)
+            if cur is None:
+                merged[key] = list(states)
+            else:
+                for i, k in enumerate(kinds):
+                    cur[i] = merge_state(k, cur[i], states[i])
+
+    group_labels = [_expr_label(g) for g in ctx.group_by]
+    rows: List[tuple] = []
+    for key, states in merged.items():
+        env: Dict[str, Any] = dict(zip(group_labels, key))
+        for i, agg in enumerate(ctx.aggregations):
+            env[agg.label] = finalize_state(agg.kind, states[i])
+        if ctx.having is not None and not _eval_scalar_bool(ctx.having, env):
+            continue
+        row = tuple(env[item.label] if isinstance(item, AggExpr)
+                    else env[_expr_label(item)]
+                    for item in ctx.select_items)
+        rows.append((row, env))  # env kept for ORDER BY evaluation
+
+    if ctx.order_by:
+        def sort_key(entry):
+            _, env = entry
+            parts = []
+            for o in ctx.order_by:
+                v = _eval_scalar(o.expr, env)
+                parts.append(_OrderKey(v, o.ascending))
+            return tuple(parts)
+        rows.sort(key=sort_key)
+    else:
+        rows.sort(key=lambda e: _key_sortable(e[0]))
+
+    limit = ctx.limit if ctx.limit is not None else DEFAULT_LIMIT
+    rows = rows[ctx.offset: ctx.offset + limit]
+    labels = list(ctx.labels)
+    return ResultTable(labels, [r for r, _ in rows])
+
+
+def _key_sortable(row: tuple) -> tuple:
+    return tuple((v is None, v) for v in row)
+
+
+class _OrderKey:
+    """Total-order wrapper handling DESC and None (nulls last)."""
+    __slots__ = ("v", "asc")
+
+    def __init__(self, v, asc: bool):
+        self.v = v
+        self.asc = asc
+
+    def __lt__(self, other: "_OrderKey") -> bool:
+        a, b = self.v, other.v
+        if a is None:
+            return False
+        if b is None:
+            return True
+        return a < b if self.asc else b < a
+
+    def __eq__(self, other) -> bool:
+        return self.v == other.v
+
+
+def _reduce_selection(ctx: QueryContext, partials: List[SelectionPartial]
+                      ) -> ResultTable:
+    labels: List[str] = []
+    rows: List[tuple] = []
+    okeys: List[tuple] = []
+    for p in partials:
+        if p.labels:
+            labels = p.labels
+        rows.extend(p.rows)
+        okeys.extend(p.order_keys)
+    if ctx.order_by and okeys:
+        order = sorted(
+            range(len(rows)),
+            key=lambda i: tuple(
+                _OrderKey(okeys[i][j], o.ascending)
+                for j, o in enumerate(ctx.order_by)))
+        rows = [rows[i] for i in order]
+    limit = ctx.limit if ctx.limit is not None else DEFAULT_LIMIT
+    rows = rows[ctx.offset: ctx.offset + limit]
+    if not labels:
+        labels = list(ctx.labels)
+    return ResultTable(labels, rows)
+
+
+# ---------------------------------------------------------------------------
+# scalar (post-aggregation) expression evaluation for HAVING / ORDER BY
+# ---------------------------------------------------------------------------
+
+def _eval_scalar(e: Any, env: Dict[str, Any]) -> Any:
+    if isinstance(e, AggExpr):
+        return env[e.label]
+    if isinstance(e, FuncCall):
+        label = _expr_label(e)
+        if label in env:
+            return env[label]
+        raise SqlError(f"unknown function result {label!r}")
+    if isinstance(e, Identifier):
+        if e.name in env:
+            return env[e.name]
+        raise SqlError(f"unknown output column {e.name!r}")
+    if isinstance(e, Literal):
+        return e.value
+    if isinstance(e, BinaryOp):
+        l = _eval_scalar(e.lhs, env)
+        r = _eval_scalar(e.rhs, env)
+        if e.op == "+":
+            return l + r
+        if e.op == "-":
+            return l - r
+        if e.op == "*":
+            return l * r
+        if e.op == "/":
+            return l / r
+        if e.op == "%":
+            return l % r
+    raise SqlError(f"unsupported post-aggregation expression {e!r}")
+
+
+def _eval_scalar_bool(e: Any, env: Dict[str, Any]) -> bool:
+    if isinstance(e, BoolAnd):
+        return all(_eval_scalar_bool(c, env) for c in e.children)
+    if isinstance(e, BoolOr):
+        return any(_eval_scalar_bool(c, env) for c in e.children)
+    if isinstance(e, BoolNot):
+        return not _eval_scalar_bool(e.child, env)
+    if isinstance(e, Comparison):
+        l = _eval_scalar(e.lhs, env)
+        r = _eval_scalar(e.rhs, env)
+        return {"==": l == r, "!=": l != r, "<": l < r,
+                "<=": l <= r, ">": l > r, ">=": l >= r}[e.op]
+    if isinstance(e, Between):
+        v = _eval_scalar(e.expr, env)
+        ok = _eval_scalar(e.lo, env) <= v <= _eval_scalar(e.hi, env)
+        return not ok if e.negated else ok
+    if isinstance(e, InList):
+        v = _eval_scalar(e.expr, env)
+        ok = v in {x.value for x in e.values}
+        return not ok if e.negated else ok
+    raise SqlError(f"unsupported HAVING expression {e!r}")

@@ -1,0 +1,3 @@
+from .ir import (And, AggSpec, Bin, Cmp, Col, EqId, FalseP, InSet, KernelPlan,
+                 Lit, Not, Or, Pred, IdRange, TrueP, ValueExpr)  # noqa: F401
+from .kernels import build_kernel, float_acc_dtype, int_acc_dtype  # noqa: F401

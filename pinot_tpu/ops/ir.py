@@ -1,0 +1,176 @@
+"""Kernel plan IR: the hashable structure a per-segment query compiles to.
+
+Reference parity: this is the TPU-native analog of pinot-core's physical
+operator tree (FilterPlanNode.java:195 constructPhysicalOperator +
+AggregationPlanNode / GroupByPlanNode). Key design difference from the
+reference: literal values (dict ids, range bounds, IN sets) are NOT part of
+the plan structure — they are runtime parameters fed to the jitted kernel,
+so XLA compiles once per plan SHAPE and the same binary serves every query
+with that shape (Pinot re-plans per query; we re-parameterize).
+
+Columns are referenced by integer index into the kernel's `cols` tuple;
+params by index into the `params` tuple. Both bindings are produced by the
+planner (query/planner.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# Value expressions (projection / transform; operator/transform/ in reference)
+# ---------------------------------------------------------------------------
+
+class ValueExpr:
+    pass
+
+
+@dataclass(frozen=True)
+class Col(ValueExpr):
+    """A projected column. If dict_param is set, the stored array holds dict
+    ids and params[dict_param] is the device-resident sorted dictionary
+    values array: value = dict_values[ids] (one gather, mirrors Pinot's
+    dictionary.get on the read path)."""
+    col: int
+    dict_param: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class Lit(ValueExpr):
+    param: int
+
+
+@dataclass(frozen=True)
+class Bin(ValueExpr):
+    """Arithmetic transform: + - * / % (ArithmeticFunctions in reference)."""
+    op: str
+    lhs: ValueExpr
+    rhs: ValueExpr
+
+
+# ---------------------------------------------------------------------------
+# Predicates (operator/filter/ + predicate evaluators in reference)
+# ---------------------------------------------------------------------------
+
+class Pred:
+    pass
+
+
+@dataclass(frozen=True)
+class TrueP(Pred):
+    pass
+
+
+@dataclass(frozen=True)
+class FalseP(Pred):
+    pass
+
+
+@dataclass(frozen=True)
+class EqId(Pred):
+    """stored[col] == params[param] — dict-id equality (the planner resolved
+    the literal through the sorted dictionary; absent values fold to FalseP)."""
+    col: int
+    param: int
+
+
+@dataclass(frozen=True)
+class IdRange(Pred):
+    """lo <= stored[col] <= hi over dict ids or raw sorted-comparable values.
+    Bounds are params (inclusive). The planner turns >,>=,<,<=,BETWEEN on
+    dict columns into inclusive id ranges via Dictionary.id_range —
+    the sorted-dictionary trick that replaces Pinot's RangeIndexBasedFilterOperator."""
+    col: int
+    lo_param: Optional[int]
+    hi_param: Optional[int]
+
+
+@dataclass(frozen=True)
+class InSet(Pred):
+    """stored[col] IN params[param] (padded to static length n with a
+    sentinel that matches nothing). InPredicateEvaluator analog."""
+    col: int
+    param: int
+    n: int
+
+
+@dataclass(frozen=True)
+class Cmp(Pred):
+    """Generic comparison on a value expression (raw-column / expression
+    filters — ScanBasedFilterOperator + ExpressionFilterOperator analog).
+    op in {'==','!=','<','<=','>','>='}; rhs is params[param]."""
+    lhs: ValueExpr
+    op: str
+    param: int
+
+
+@dataclass(frozen=True)
+class IsNull(Pred):
+    """Null check; null_param indexes a device bool mask param (unpacked
+    null bitmap). NullPredicateEvaluator analog."""
+    null_param: int
+
+
+@dataclass(frozen=True)
+class And(Pred):
+    children: Tuple[Pred, ...]
+
+
+@dataclass(frozen=True)
+class Or(Pred):
+    children: Tuple[Pred, ...]
+
+
+@dataclass(frozen=True)
+class Not(Pred):
+    child: Pred
+
+
+# ---------------------------------------------------------------------------
+# Aggregations (query/aggregation/function/ — 91 classes in reference; the
+# core numeric family here, sketches later)
+# ---------------------------------------------------------------------------
+
+AGG_KINDS = ("count", "sum", "min", "max", "avg", "distinct_count")
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    kind: str                      # one of AGG_KINDS
+    value: Optional[ValueExpr]     # None for COUNT(*)
+    integral: bool = False         # exact int64 accumulation when True
+    # distinct_count over a dict column: cardinality for the presence bitmap
+    card: Optional[int] = None
+    # magnitude bound (bits) of the integral value expression; sizes the
+    # int8-limb decomposition of the MXU group-sum (kernels._limb_rows).
+    # The planner tightens it via interval arithmetic over column min/max.
+    bits: int = 63
+    # False when the planner proved the value non-negative (halves the limbs)
+    signed: bool = True
+
+
+# ---------------------------------------------------------------------------
+# The kernel plan
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class KernelPlan:
+    """Everything the kernel builder needs, hashable. group_keys is a tuple
+    of (col_index, cardinality): group-by keys must be dict-encoded stored
+    columns; the dense group key is cartesian dict-id arithmetic exactly
+    like DictionaryBasedGroupKeyGenerator.java:63."""
+    pred: Pred
+    aggs: Tuple[AggSpec, ...]
+    group_keys: Tuple[Tuple[int, int], ...] = ()
+
+    @property
+    def group_space(self) -> int:
+        s = 1
+        for _, card in self.group_keys:
+            s *= max(card, 1)
+        return s
+
+    @property
+    def is_group_by(self) -> bool:
+        return len(self.group_keys) > 0

@@ -1,0 +1,2 @@
+from .mesh import segment_mesh  # noqa: F401
+from .distributed import DistributedTable  # noqa: F401

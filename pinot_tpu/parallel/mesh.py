@@ -1,0 +1,21 @@
+"""Mesh helpers for segment-parallel execution."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+SEG_AXIS = "seg"
+
+
+def segment_mesh(n_devices: Optional[int] = None,
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over devices; the axis is segment-parallelism (the analog of
+    Pinot's scatter-gather across servers, SURVEY.md section 2.9 table)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    import numpy as np
+    return Mesh(np.asarray(devices), (SEG_AXIS,))

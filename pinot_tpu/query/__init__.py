@@ -1,0 +1,2 @@
+from .sql import parse_sql  # noqa: F401
+from .context import QueryContext  # noqa: F401

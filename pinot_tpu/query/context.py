@@ -1,0 +1,179 @@
+"""Logical query context: classified, validated query — table-level,
+segment-independent.
+
+Reference parity: pinot-core/.../query/request/context/QueryContext (built
+by BrokerRequestToQueryContextConverter): holds select expressions,
+aggregations, group-by expressions, filter, having, order-by, limit. The
+planner (planner.py) lowers this to per-segment kernel plans.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, Comparison,
+                  FuncCall, Identifier, InList, IsNull, Like, Literal,
+                  OrderItem, SelectStmt, SqlError, Star)
+
+AGG_FUNCS = {
+    "count": "count",
+    "sum": "sum",
+    "min": "min",
+    "max": "max",
+    "avg": "avg",
+    "distinctcount": "distinct_count",
+    "count_distinct": "distinct_count",
+}
+
+
+@dataclass(frozen=True)
+class AggExpr:
+    kind: str          # count | sum | min | max | avg | distinct_count
+    arg: Any           # value expression AST (None for COUNT(*))
+    label: str
+
+    def key(self) -> str:
+        return self.label
+
+
+@dataclass
+class QueryContext:
+    table: str
+    select_items: List[Any]            # AggExpr | expr AST (group key / selection)
+    labels: List[str]                  # output column names in select order
+    aggregations: List[AggExpr]
+    group_by: List[Any]
+    filter: Optional[Any]
+    having: Optional[Any]
+    order_by: List[OrderItem]
+    limit: Optional[int]
+    offset: int
+    options: dict = field(default_factory=dict)
+
+    @property
+    def is_aggregation(self) -> bool:
+        return len(self.aggregations) > 0
+
+    @property
+    def is_group_by(self) -> bool:
+        return len(self.group_by) > 0
+
+
+def _expr_label(e: Any) -> str:
+    if isinstance(e, Identifier):
+        return e.name
+    if isinstance(e, Literal):
+        return repr(e.value)
+    if isinstance(e, Star):
+        return "*"
+    if isinstance(e, FuncCall):
+        inner = ",".join(_expr_label(a) for a in e.args)
+        d = "distinct " if e.distinct else ""
+        return f"{e.name}({d}{inner})"
+    if isinstance(e, BinaryOp):
+        return f"({_expr_label(e.lhs)}{e.op}{_expr_label(e.rhs)})"
+    return str(e)
+
+
+def _find_aggs(e: Any, out: List[FuncCall]) -> None:
+    if isinstance(e, FuncCall):
+        if e.name in AGG_FUNCS or (e.name == "count" and e.distinct):
+            out.append(e)
+            return
+        for a in e.args:
+            _find_aggs(a, out)
+    elif isinstance(e, BinaryOp):
+        _find_aggs(e.lhs, out)
+        _find_aggs(e.rhs, out)
+
+
+def build_query_context(stmt: SelectStmt) -> QueryContext:
+    aggregations: List[AggExpr] = []
+    select_items: List[Any] = []
+    labels: List[str] = []
+
+    def register_agg(fc: FuncCall) -> AggExpr:
+        kind = AGG_FUNCS[fc.name]
+        if fc.name == "count" and fc.distinct:
+            kind = "distinct_count"
+        if kind == "count" and (not fc.args or isinstance(fc.args[0], Star)):
+            arg = None
+        else:
+            if len(fc.args) != 1:
+                raise SqlError(f"{fc.name} takes one argument")
+            arg = fc.args[0]
+        label = _expr_label(fc)
+        agg = AggExpr(kind, arg, label)
+        for existing in aggregations:
+            if existing == agg:
+                return existing
+        aggregations.append(agg)
+        return agg
+
+    group_by = list(stmt.group_by)
+    group_labels = {_expr_label(g) for g in group_by}
+
+    for item in stmt.select:
+        e = item.expr
+        if isinstance(e, Star):
+            select_items.append(Star())
+            labels.append("*")
+            continue
+        found: List[FuncCall] = []
+        _find_aggs(e, found)
+        if found:
+            if not (isinstance(e, FuncCall) and e in found):
+                raise SqlError("post-aggregation expressions not yet "
+                               f"supported: {_expr_label(e)}")
+            agg = register_agg(e)
+            select_items.append(agg)
+            labels.append(item.alias or agg.label)
+        else:
+            select_items.append(e)
+            labels.append(item.alias or _expr_label(e))
+            if group_by and _expr_label(e) not in group_labels:
+                raise SqlError(f"non-aggregate select column "
+                               f"{_expr_label(e)!r} must appear in GROUP BY")
+
+    # register aggs appearing only in HAVING / ORDER BY so partials exist
+    for extra in ([stmt.having] if stmt.having else []) + \
+                 [o.expr for o in stmt.order_by]:
+        found = []
+        _find_aggs(extra, found)
+        for fc in found:
+            register_agg(fc)
+
+    if group_by and not aggregations:
+        raise SqlError("GROUP BY without aggregations not supported yet "
+                       "(use DISTINCT semantics in a later round)")
+    if aggregations:
+        bad = [i for i in select_items
+               if not isinstance(i, AggExpr) and not _is_group_key(i, group_by)]
+        if bad:
+            raise SqlError(f"selection columns mixed with aggregations: {bad}")
+
+    # Pinot applies the default LIMIT 10 at compile time
+    # (CalciteSqlParser DEFAULT_SELECTION_LIMIT analog); doing the same here
+    # bounds per-segment selection materialization, not just the reduce.
+    limit = stmt.limit
+    if limit is None and not (aggregations and not group_by):
+        limit = 10
+
+    return QueryContext(
+        table=stmt.table,
+        select_items=select_items,
+        labels=labels,
+        aggregations=aggregations,
+        group_by=group_by,
+        filter=stmt.where,
+        having=stmt.having,
+        order_by=stmt.order_by,
+        limit=limit,
+        offset=stmt.offset,
+        options=stmt.options,
+    )
+
+
+def _is_group_key(e: Any, group_by: List[Any]) -> bool:
+    lbl = _expr_label(e)
+    return any(_expr_label(g) == lbl for g in group_by)

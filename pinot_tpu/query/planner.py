@@ -1,0 +1,639 @@
+"""Physical planner: QueryContext + segment -> executable plan.
+
+Reference parity: pinot-core/.../plan/maker/InstancePlanMakerImplV2.java:137
+(makeInstancePlan) / :234 (makeSegmentPlanNode) chooses Aggregation /
+GroupBy / Selection plans per segment; AggregationPlanNode.java:98-112
+installs non-scan fast paths (metadata COUNT, dictionary MIN/MAX);
+ColumnValueSegmentPruner drops segments whose min/max can't match.
+
+TPU-native differences:
+- literals resolve to dict ids / typed scalars that become runtime kernel
+  params (plan structure is literal-free -> one XLA compile per shape);
+- dictionary-resolved predicates constant-fold (absent value -> FalseP),
+  and folding a segment's root predicate to FalseP IS the pruner;
+- range predicates on sorted dictionaries become id-range masks — the
+  sorted-dictionary trick replaces the RangeIndex;
+- LIKE/REGEXP evaluate host-side over the (small) dictionary and ship the
+  matching-id set to the device — the TPU analog of Pinot's
+  dictionary-based predicate evaluators.
+"""
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange,
+                      InSet, IsNull as IsNullIR, KernelPlan, Lit, Not, Or,
+                      Pred, TrueP, ValueExpr)
+from ..segment.immutable import ImmutableSegment
+from ..spi.schema import DataType
+from .context import AggExpr, QueryContext
+from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, Comparison,
+                  Identifier, InList, IsNull, Like, Literal, SqlError, Star)
+
+MAX_DENSE_GROUPS = 1 << 21          # beyond this, host hash group-by
+MAX_DISTINCT_MATRIX = 1 << 24       # group_space * card gate for on-device
+
+
+class PlanError(SqlError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# plan kinds
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CompiledPlan:
+    kind: str  # 'pruned' | 'fast' | 'kernel' | 'host'
+    segment: ImmutableSegment
+    ctx: QueryContext
+    # kernel path
+    col_names: List[str] = field(default_factory=list)
+    kernel_plan: Optional[KernelPlan] = None
+    params: List[Any] = field(default_factory=list)
+    agg_bindings: List["AggBinding"] = field(default_factory=list)
+    group_cols: List[str] = field(default_factory=list)   # group key columns
+    # fast path: precomputed states per agg
+    fast_states: Optional[List[Any]] = None
+
+
+@dataclass
+class AggBinding:
+    """Maps a logical AggExpr to kernel output names + finalize metadata."""
+    agg: AggExpr
+    index: int            # position in kernel plan aggs
+    integral: bool
+    dict_col: Optional[str] = None   # distinct_count id-space column
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+class _Binder:
+    def __init__(self, segment: ImmutableSegment):
+        self.segment = segment
+        self.cols: List[str] = []
+        self.params: List[Any] = []
+
+    def bind_col(self, name: str) -> int:
+        if name not in self.segment.columns:
+            raise PlanError(f"unknown column {name!r} in segment "
+                            f"{self.segment.name!r}")
+        if name in self.cols:
+            return self.cols.index(name)
+        self.cols.append(name)
+        return len(self.cols) - 1
+
+    def add_param(self, value: Any) -> int:
+        self.params.append(value)
+        return len(self.params) - 1
+
+
+def _pad_dup(vals: np.ndarray) -> np.ndarray:
+    """Pad a small set to pow2 with copies of the first element (duplicates
+    don't change `any(==)` semantics) to bound recompiles on IN-list size."""
+    n = len(vals)
+    p = 1
+    while p < n:
+        p <<= 1
+    if p == n:
+        return vals
+    return np.concatenate([vals, np.repeat(vals[:1], p - n)])
+
+
+def _simplify(p: Pred) -> Pred:
+    if isinstance(p, And):
+        kids = []
+        for c in (_simplify(c) for c in p.children):
+            if isinstance(c, FalseP):
+                return FalseP()
+            if isinstance(c, TrueP):
+                continue
+            if isinstance(c, And):
+                kids.extend(c.children)
+            else:
+                kids.append(c)
+        if not kids:
+            return TrueP()
+        return kids[0] if len(kids) == 1 else And(tuple(kids))
+    if isinstance(p, Or):
+        kids = []
+        for c in (_simplify(c) for c in p.children):
+            if isinstance(c, TrueP):
+                return TrueP()
+            if isinstance(c, FalseP):
+                continue
+            if isinstance(c, Or):
+                kids.extend(c.children)
+            else:
+                kids.append(c)
+        if not kids:
+            return FalseP()
+        return kids[0] if len(kids) == 1 else Or(tuple(kids))
+    if isinstance(p, Not):
+        c = _simplify(p.child)
+        if isinstance(c, TrueP):
+            return FalseP()
+        if isinstance(c, FalseP):
+            return TrueP()
+        if isinstance(c, Not):
+            return c.child
+        return Not(c)
+    return p
+
+
+def _like_to_regex(pattern: str) -> "re.Pattern":
+    # SQL LIKE: % = any run, _ = any one char (LikePredicate semantics)
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+# ---------------------------------------------------------------------------
+# the planner
+# ---------------------------------------------------------------------------
+
+class SegmentPlanner:
+    def __init__(self, ctx: QueryContext, segment: ImmutableSegment):
+        self.ctx = ctx
+        self.seg = segment
+        self.b = _Binder(segment)
+
+    # -- value expressions -------------------------------------------------
+    def resolve_value(self, e: Any) -> Tuple[ValueExpr, bool]:
+        """-> (ir, integral)."""
+        if isinstance(e, Identifier):
+            m = self.seg.columns.get(e.name)
+            if m is None:
+                raise PlanError(f"unknown column {e.name!r}")
+            if not m.data_type.is_numeric:
+                raise PlanError(f"column {e.name!r} ({m.data_type.value}) "
+                                "is not numeric in a value context")
+            idx = self.b.bind_col(e.name)
+            if m.has_dict:
+                # marker resolved by the executor against the segment's
+                # device cache (dictionaries upload once, not per query)
+                dp = self.b.add_param(("dictvals", e.name))
+                return Col(idx, dp), m.data_type.is_integral
+            return Col(idx), m.data_type.is_integral
+        if isinstance(e, Literal):
+            v = e.value
+            integral = isinstance(v, (int, np.integer)) and not isinstance(v, bool)
+            p = self.b.add_param(
+                np.int64(v) if integral else np.float64(float(v)))
+            return Lit(p), integral
+        if isinstance(e, BinaryOp):
+            l, li = self.resolve_value(e.lhs)
+            r, ri = self.resolve_value(e.rhs)
+            integral = li and ri and e.op != "/"
+            return Bin(e.op, l, r), integral
+        raise PlanError(f"unsupported value expression {e!r}")
+
+    # -- predicates --------------------------------------------------------
+    def resolve_filter(self, e: Any) -> Pred:
+        if e is None:
+            return TrueP()
+        return _simplify(self._pred(e))
+
+    def _pred(self, e: Any) -> Pred:
+        if isinstance(e, BoolAnd):
+            return And(tuple(self._pred(c) for c in e.children))
+        if isinstance(e, BoolOr):
+            return Or(tuple(self._pred(c) for c in e.children))
+        if isinstance(e, BoolNot):
+            return Not(self._pred(e.child))
+        if isinstance(e, Comparison):
+            return self._comparison(e)
+        if isinstance(e, Between):
+            p = self._range(e.expr, e.lo, e.hi, True, True)
+            return Not(p) if e.negated else p
+        if isinstance(e, InList):
+            return self._in_list(e)
+        if isinstance(e, Like):
+            return self._like(e)
+        if isinstance(e, IsNull):
+            return self._is_null(e)
+        if isinstance(e, Literal) and isinstance(e.value, bool):
+            return TrueP() if e.value else FalseP()
+        raise PlanError(f"unsupported filter expression {e!r}")
+
+    def _comparison(self, e: Comparison) -> Pred:
+        lhs, rhs, op = e.lhs, e.rhs, e.op
+        # normalize literal to the right
+        if isinstance(lhs, Literal) and not isinstance(rhs, Literal):
+            lhs, rhs = rhs, lhs
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if isinstance(lhs, Identifier) and isinstance(rhs, Literal):
+            name, v = lhs.name, rhs.value
+            m = self.seg.columns.get(name)
+            if m is None:
+                raise PlanError(f"unknown column {name!r}")
+            if m.has_dict:
+                d = self.seg.dictionary(name)
+                if op == "==":
+                    i = d.index_of(self._cast_for(m, v))
+                    if i < 0:
+                        return FalseP()
+                    return EqId(self.b.bind_col(name),
+                                self.b.add_param(np.int32(i)))
+                if op == "!=":
+                    i = d.index_of(self._cast_for(m, v))
+                    if i < 0:
+                        return TrueP()
+                    return Not(EqId(self.b.bind_col(name),
+                                    self.b.add_param(np.int32(i))))
+                lo, hi, il, ih = {
+                    "<": (None, v, True, False),
+                    "<=": (None, v, True, True),
+                    ">": (v, None, False, True),
+                    ">=": (v, None, True, True),
+                }[op]
+                return self._dict_range(name, lo, hi, il, ih)
+            # raw column
+            return self._raw_cmp(name, m, op, v)
+        # generic: expr vs expr -> compare difference against zero
+        l, li = self.resolve_value(lhs)
+        r, ri = self.resolve_value(rhs)
+        zero = self.b.add_param(np.int64(0) if (li and ri) else np.float64(0))
+        return Cmp(Bin("-", l, r), op, zero)
+
+    def _cast_for(self, m, v: Any) -> Any:
+        if m.data_type == DataType.STRING or not m.data_type.is_numeric:
+            return str(v)
+        if isinstance(v, str):
+            # BadQueryRequestException analog: literal must coerce to the
+            # column's numeric type
+            try:
+                return float(v) if "." in v or "e" in v.lower() else int(v)
+            except ValueError:
+                raise PlanError(
+                    f"cannot compare numeric column with {v!r}") from None
+        return v
+
+    def _raw_cmp(self, name: str, m, op: str, v: Any) -> Pred:
+        v = self._cast_for(m, v)  # coerce string literals; PlanError if not
+        # min/max constant folding = ColumnValueSegmentPruner for raw columns
+        mn, mx = m.min, m.max
+        if mn is not None and mx is not None and isinstance(v, (int, float)):
+            if op == "==" and (v < mn or v > mx):
+                return FalseP()
+            if op in ("<", "<=") and v < mn:
+                return FalseP()
+            if op in (">", ">=") and v > mx:
+                return FalseP()
+            if op == "<=" and v >= mx:
+                return TrueP()
+            if op == ">=" and v <= mn:
+                return TrueP()
+            if op == "<" and v > mx:
+                return TrueP()
+            if op == ">" and v < mn:
+                return TrueP()
+        idx = self.b.bind_col(name)
+        dt = m.data_type.np_dtype
+        if np.issubdtype(dt, np.integer) and isinstance(v, float) \
+                and v != int(v):
+            # fractional literal vs int column: rewrite to exact int bound
+            if op == "==":
+                return FalseP()
+            if op == "!=":
+                return TrueP()
+            import math
+            if op in ("<", "<="):
+                v2 = math.floor(v)
+                return Cmp(Col(idx), "<=", self.b.add_param(np.asarray(v2, dt)))
+            v2 = math.ceil(v)
+            return Cmp(Col(idx), ">=", self.b.add_param(np.asarray(v2, dt)))
+        p = self.b.add_param(np.asarray(v, dt) if m.data_type.is_numeric
+                             else np.float64(v))
+        return Cmp(Col(idx), op, p)
+
+    def _generic_cmp(self, lhs_ast: Any, op: str, rhs_ast: Any) -> Pred:
+        """expr-vs-expr comparison: compare the difference against zero."""
+        l, li = self.resolve_value(lhs_ast)
+        r, ri = self.resolve_value(rhs_ast)
+        zero = self.b.add_param(np.int64(0) if (li and ri) else np.float64(0))
+        return Cmp(Bin("-", l, r), op, zero)
+
+    def _range(self, expr: Any, lo: Any, hi: Any, il: bool, ih: bool) -> Pred:
+        # non-literal bounds (column/expression BETWEEN bounds) or a
+        # non-column subject: generic expression comparisons
+        lo_lit = lo is None or isinstance(lo, Literal)
+        hi_lit = hi is None or isinstance(hi, Literal)
+        if not isinstance(expr, Identifier) or not (lo_lit and hi_lit):
+            kids: List[Pred] = []
+            if lo is not None:
+                kids.append(self._generic_cmp(expr, ">=" if il else ">", lo))
+            if hi is not None:
+                kids.append(self._generic_cmp(expr, "<=" if ih else "<", hi))
+            return And(tuple(kids)) if kids else TrueP()
+        name = expr.name
+        m = self.seg.columns.get(name)
+        if m is None:
+            raise PlanError(f"unknown column {name!r}")
+        lo_v = lo.value if isinstance(lo, Literal) else None
+        hi_v = hi.value if isinstance(hi, Literal) else None
+        if m.has_dict:
+            return self._dict_range(name, lo_v, hi_v, il, ih)
+        kids = []
+        if lo_v is not None:
+            kids.append(self._raw_cmp(name, m, ">=" if il else ">", lo_v))
+        if hi_v is not None:
+            kids.append(self._raw_cmp(name, m, "<=" if ih else "<", hi_v))
+        return _simplify(And(tuple(kids))) if kids else TrueP()
+
+    def _dict_range(self, name: str, lo: Any, hi: Any, il: bool, ih: bool
+                    ) -> Pred:
+        m = self.seg.columns[name]
+        d = self.seg.dictionary(name)
+        if lo is not None:
+            lo = self._cast_for(m, lo)
+        if hi is not None:
+            hi = self._cast_for(m, hi)
+        lo_id, hi_id = d.id_range(lo, hi, il, ih)
+        if lo_id > hi_id:
+            return FalseP()
+        if lo_id == 0 and hi_id == d.cardinality - 1:
+            return TrueP()
+        idx = self.b.bind_col(name)
+        lo_p = self.b.add_param(np.int32(lo_id)) if lo_id > 0 else None
+        hi_p = (self.b.add_param(np.int32(hi_id))
+                if hi_id < d.cardinality - 1 else None)
+        return IdRange(idx, lo_p, hi_p)
+
+    def _in_list(self, e: InList) -> Pred:
+        if not isinstance(e.expr, Identifier):
+            raise PlanError("IN over expressions not supported yet")
+        name = e.expr.name
+        m = self.seg.columns.get(name)
+        if m is None:
+            raise PlanError(f"unknown column {name!r}")
+        vals = [v.value for v in e.values]
+        if m.has_dict:
+            d = self.seg.dictionary(name)
+            ids = [d.index_of(self._cast_for(m, v)) for v in vals]
+            ids = sorted({i for i in ids if i >= 0})
+            if not ids:
+                return TrueP() if e.negated else FalseP()
+            arr = _pad_dup(np.asarray(ids, dtype=np.int32))
+            p = InSet(self.b.bind_col(name), self.b.add_param(arr), len(arr))
+        else:
+            vals = [self._cast_for(m, v) for v in vals]
+            arr = _pad_dup(np.asarray(vals, dtype=m.data_type.np_dtype))
+            p = InSet(self.b.bind_col(name), self.b.add_param(arr), len(arr))
+        return Not(p) if e.negated else p
+
+    def _like(self, e: Like) -> Pred:
+        if not isinstance(e.expr, Identifier):
+            raise PlanError("LIKE over expressions not supported")
+        name = e.expr.name
+        m = self.seg.columns.get(name)
+        if m is None or not m.has_dict:
+            raise PlanError(f"LIKE needs a dictionary column, got {name!r}")
+        d = self.seg.dictionary(name)
+        rx = _like_to_regex(e.pattern)
+        ids = [i for i, v in enumerate(d.values) if rx.match(str(v))]
+        if not ids:
+            return TrueP() if e.negated else FalseP()
+        if len(ids) == d.cardinality:
+            return FalseP() if e.negated else TrueP()
+        arr = _pad_dup(np.asarray(ids, dtype=np.int32))
+        p = InSet(self.b.bind_col(name), self.b.add_param(arr), len(arr))
+        return Not(p) if e.negated else p
+
+    def _is_null(self, e: IsNull) -> Pred:
+        if not isinstance(e.expr, Identifier):
+            raise PlanError("IS NULL over expressions not supported")
+        name = e.expr.name
+        m = self.seg.columns.get(name)
+        if m is None:
+            raise PlanError(f"unknown column {name!r}")
+        if not m.has_nulls:
+            return TrueP() if e.negated else FalseP()
+        p = IsNullIR(self.b.add_param(("nullmask", name)))
+        return Not(p) if e.negated else p
+
+    # -- value range analysis (sizes the exact int8-limb MXU group sums) ---
+    def _range_of(self, e: Any) -> Optional[Tuple[float, float]]:
+        if isinstance(e, Identifier):
+            m = self.seg.columns.get(e.name)
+            if m is None or not m.data_type.is_numeric:
+                return None
+            if m.min is None or m.max is None:
+                return None
+            return (float(m.min), float(m.max))
+        if isinstance(e, Literal) and isinstance(e.value, (int, float)) \
+                and not isinstance(e.value, bool):
+            return (float(e.value), float(e.value))
+        if isinstance(e, BinaryOp):
+            lr = self._range_of(e.lhs)
+            rr = self._range_of(e.rhs)
+            if lr is None or rr is None:
+                return None
+            (a, b), (c, d) = lr, rr
+            if e.op == "+":
+                return (a + c, b + d)
+            if e.op == "-":
+                return (a - d, b - c)
+            if e.op == "*":
+                corners = (a * c, a * d, b * c, b * d)
+                return (min(corners), max(corners))
+            return None
+        return None
+
+    @staticmethod
+    def _bits_for(rng: Optional[Tuple[float, float]]) -> Tuple[int, bool]:
+        if rng is None:
+            return 63, True
+        lo, hi = rng
+        mag = max(abs(lo), abs(hi))
+        bits = max(1, int(mag).bit_length()) if mag < 2 ** 62 else 63
+        return min(bits, 63), lo < 0
+
+    # -- aggregations ------------------------------------------------------
+    def resolve_agg(self, i: int, agg: AggExpr) -> Tuple[AggSpec, AggBinding]:
+        if agg.kind == "count" and agg.arg is None:
+            return (AggSpec("count", None, True),
+                    AggBinding(agg, i, True))
+        if agg.kind == "distinct_count":
+            if isinstance(agg.arg, Identifier):
+                m = self.seg.columns.get(agg.arg.name)
+                if m is not None and m.has_dict:
+                    idx = self.b.bind_col(agg.arg.name)
+                    spec = AggSpec("distinct_count", Col(idx), True,
+                                   card=m.cardinality)
+                    return spec, AggBinding(agg, i, True,
+                                            dict_col=agg.arg.name)
+            raise PlanError("DISTINCTCOUNT needs a dictionary column "
+                            "(host fallback handles the rest)")
+        if agg.kind == "count":  # COUNT(col): Pinot counts all rows when
+            # null handling is disabled (NullableSingleInputAggregationFunction)
+            return AggSpec("count", None, True), AggBinding(agg, i, True)
+        ve, integral = self.resolve_value(agg.arg)
+        bits, signed = self._bits_for(self._range_of(agg.arg))
+        return (AggSpec(agg.kind, ve, integral, bits=bits, signed=signed),
+                AggBinding(agg, i, integral))
+
+    # -- validation --------------------------------------------------------
+    def _validate_columns(self) -> None:
+        """Unknown columns are user errors everywhere (including host-path
+        queries), not host-fallback surprises."""
+        ctx = self.ctx
+        names: List[str] = []
+
+        def walk(e: Any) -> None:
+            if isinstance(e, Identifier):
+                names.append(e.name)
+            elif isinstance(e, (BoolAnd, BoolOr)):
+                for c in e.children:
+                    walk(c)
+            elif isinstance(e, BoolNot):
+                walk(e.child)
+            elif isinstance(e, Comparison):
+                walk(e.lhs)
+                walk(e.rhs)
+            elif isinstance(e, Between):
+                walk(e.expr)
+            elif isinstance(e, (InList, Like, IsNull)):
+                walk(e.expr)
+            elif isinstance(e, BinaryOp):
+                walk(e.lhs)
+                walk(e.rhs)
+
+        walk(ctx.filter)
+        for g in ctx.group_by:
+            walk(g)
+        for agg in ctx.aggregations:
+            if agg.arg is not None:
+                walk(agg.arg)
+        for item in ctx.select_items:
+            if not isinstance(item, (Star,)) and not hasattr(item, "kind"):
+                walk(item)
+        for n in names:
+            if n not in self.seg.columns:
+                raise PlanError(f"unknown column {n!r}; segment has "
+                                f"{list(self.seg.columns)}")
+
+    # -- top-level ---------------------------------------------------------
+    def plan(self) -> CompiledPlan:
+        ctx, seg = self.ctx, self.seg
+        self._validate_columns()
+        if not ctx.is_aggregation:
+            return CompiledPlan("host", seg, ctx)  # selection: host path
+
+        pred = self.resolve_filter(ctx.filter)
+        if isinstance(pred, FalseP) :
+            return CompiledPlan("pruned", seg, ctx)
+
+        # group-by feasibility
+        group_cols: List[str] = []
+        group_keys: List[Tuple[int, int]] = []
+        if ctx.is_group_by:
+            dense_ok = True
+            space = 1
+            for g in ctx.group_by:
+                if not isinstance(g, Identifier):
+                    dense_ok = False
+                    break
+                m = seg.columns.get(g.name)
+                if m is None:
+                    raise PlanError(f"unknown column {g.name!r}")
+                if not m.has_dict or m.cardinality == 0:
+                    dense_ok = False
+                    break
+                space *= max(m.cardinality, 1)
+            if not dense_ok or space > MAX_DENSE_GROUPS:
+                return CompiledPlan("host", seg, ctx)
+
+        # fast path: no filter, metadata/dictionary-answerable aggs, no group
+        if isinstance(pred, TrueP) and not ctx.is_group_by:
+            fast = self._try_fast_path()
+            if fast is not None:
+                return fast
+
+        try:
+            specs: List[AggSpec] = []
+            bindings: List[AggBinding] = []
+            for i, agg in enumerate(ctx.aggregations):
+                spec, binding = self.resolve_agg(i, agg)
+                specs.append(spec)
+                bindings.append(binding)
+        except PlanError:
+            return CompiledPlan("host", seg, ctx)
+
+        if not ctx.is_group_by:
+            # scalar DISTINCTCOUNT presence matrix gate (group path gated
+            # below; backends that materialize one_hot would OOM otherwise)
+            for s in specs:
+                if s.kind == "distinct_count" and s.card is not None \
+                        and s.card > 1 << 16:
+                    return CompiledPlan("host", seg, ctx)
+
+        if ctx.is_group_by:
+            for g in ctx.group_by:
+                m = seg.columns[g.name]
+                idx = self.b.bind_col(g.name)
+                group_keys.append((idx, m.cardinality))
+                group_cols.append(g.name)
+            # gate on-device distinct matrices and large-space min/max
+            space = 1
+            for _, c in group_keys:
+                space *= max(c, 1)
+            import jax as _jax
+            slow_scatter = _jax.default_backend() != "cpu"
+            for s in specs:
+                if s.kind == "distinct_count" and s.card is not None \
+                        and space * s.card > MAX_DISTINCT_MATRIX:
+                    return CompiledPlan("host", seg, ctx)
+                if s.kind in ("min", "max") and slow_scatter and space > 64:
+                    # no matmul form for min/max; TPU scatter is pathological
+                    # (kernels.MINMAX_UNROLL_GROUPS) -> host numpy
+                    return CompiledPlan("host", seg, ctx)
+
+        plan = KernelPlan(pred=pred, aggs=tuple(specs),
+                          group_keys=tuple(group_keys))
+        return CompiledPlan("kernel", seg, ctx,
+                            col_names=list(self.b.cols),
+                            kernel_plan=plan,
+                            params=list(self.b.params),
+                            agg_bindings=bindings,
+                            group_cols=group_cols)
+
+    def _try_fast_path(self) -> Optional[CompiledPlan]:
+        """Metadata/dictionary-only answers (AggregationPlanNode.java:98-112
+        NonScanBasedAggregationOperator analog)."""
+        seg, ctx = self.seg, self.ctx
+        states: List[Any] = []
+        for agg in ctx.aggregations:
+            if agg.kind == "count" :
+                states.append(seg.n_docs)
+                continue
+            if agg.kind in ("min", "max") and isinstance(agg.arg, Identifier):
+                m = seg.columns.get(agg.arg.name)
+                if m is None or m.min is None or m.has_nulls:
+                    return None
+                if not m.data_type.is_numeric:
+                    return None
+                states.append(float(m.min if agg.kind == "min" else m.max))
+                continue
+            if agg.kind == "distinct_count" and isinstance(agg.arg, Identifier):
+                m = seg.columns.get(agg.arg.name)
+                if m is None or not m.has_dict or m.has_nulls:
+                    return None
+                # mergeable across segments: the value set, not its size
+                states.append(set(seg.dictionary(agg.arg.name).values))
+                continue
+            return None
+        return CompiledPlan("fast", seg, ctx, fast_states=states)

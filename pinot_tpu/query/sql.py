@@ -1,0 +1,482 @@
+"""SQL subset compiler: text -> AST.
+
+Reference parity: pinot-common/.../sql/parsers/CalciteSqlParser
+.compileToPinotQuery (used at BaseSingleStageBrokerRequestHandler.java:256)
+compiles SQL to the PinotQuery thrift IR. We hand-roll a tokenizer +
+recursive-descent parser for the OLAP subset (no Calcite in a TPU-native
+stack): SELECT projections/aggregations, WHERE with AND/OR/NOT,
+comparisons, BETWEEN, IN, LIKE, IS [NOT] NULL, GROUP BY, HAVING,
+ORDER BY ... ASC|DESC, LIMIT/OFFSET, arithmetic expressions, aliases.
+
+Grammar (precedence climbing for booleans and arithmetic):
+    query      := SELECT selectList FROM ident [WHERE orExpr]
+                  [GROUP BY exprList] [HAVING orExpr]
+                  [ORDER BY orderList] [LIMIT n [OFFSET n] | LIMIT o, n]
+    orExpr     := andExpr (OR andExpr)*
+    andExpr    := notExpr (AND notExpr)*
+    notExpr    := NOT notExpr | predicate
+    predicate  := addExpr ((=|!=|<>|<|<=|>|>=) addExpr
+                 | [NOT] BETWEEN addExpr AND addExpr
+                 | [NOT] IN '(' literalList ')'
+                 | [NOT] LIKE string
+                 | IS [NOT] NULL)?
+                 | '(' orExpr ')'
+    addExpr    := mulExpr ((+|-) mulExpr)*
+    mulExpr    := unary ((*|/|%) unary)*
+    unary      := [-] atom
+    atom       := literal | ident | ident '(' [DISTINCT] args ')' | '(' addExpr ')' | '*'
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple, Union
+
+
+class SqlError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Identifier:
+    name: str
+
+
+@dataclass(frozen=True)
+class Literal:
+    value: Any  # int | float | str | bool | None
+
+
+@dataclass(frozen=True)
+class Star:
+    pass
+
+
+@dataclass(frozen=True)
+class FuncCall:
+    name: str  # lowercased
+    args: Tuple[Any, ...]
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class BinaryOp:
+    op: str
+    lhs: Any
+    rhs: Any
+
+
+@dataclass(frozen=True)
+class Comparison:
+    op: str  # == != < <= > >=
+    lhs: Any
+    rhs: Any
+
+
+@dataclass(frozen=True)
+class Between:
+    expr: Any
+    lo: Any
+    hi: Any
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList:
+    expr: Any
+    values: Tuple[Any, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Like:
+    expr: Any
+    pattern: str
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull:
+    expr: Any
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class BoolAnd:
+    children: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class BoolOr:
+    children: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class BoolNot:
+    child: Any
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Any
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class OrderItem:
+    expr: Any
+    ascending: bool = True
+
+
+@dataclass
+class SelectStmt:
+    select: List[SelectItem]
+    table: str
+    where: Optional[Any] = None
+    group_by: List[Any] = field(default_factory=list)
+    having: Optional[Any] = None
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    options: dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+    | (?P<string>'(?:[^']|'')*')
+    | (?P<dqident>"(?:[^"]|"")*")
+    | (?P<ident>[A-Za-z_][A-Za-z_0-9$.]*)
+    | (?P<op><=|>=|!=|<>|=|<|>|\(|\)|,|\*|\+|-|/|%|;)
+    )""", re.VERBOSE)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "and", "or", "not", "between", "in", "like", "is", "null",
+    "as", "asc", "desc", "distinct", "true", "false", "option",
+}
+
+
+@dataclass
+class Token:
+    kind: str  # number|string|ident|op|kw|eof
+    value: Any
+    pos: int
+
+
+def tokenize(sql: str) -> List[Token]:
+    tokens: List[Token] = []
+    pos = 0
+    while pos < len(sql):
+        if sql[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN_RE.match(sql, pos)
+        if not m or m.end() == pos:
+            raise SqlError(f"cannot tokenize at {pos}: {sql[pos:pos+20]!r}")
+        if m.group("number") is not None:
+            txt = m.group("number")
+            val = float(txt) if ("." in txt or "e" in txt or "E" in txt) \
+                else int(txt)
+            tokens.append(Token("number", val, pos))
+        elif m.group("string") is not None:
+            s = m.group("string")[1:-1].replace("''", "'")
+            tokens.append(Token("string", s, pos))
+        elif m.group("dqident") is not None:
+            s = m.group("dqident")[1:-1].replace('""', '"')
+            tokens.append(Token("ident", s, pos))
+        elif m.group("ident") is not None:
+            txt = m.group("ident")
+            if txt.lower() in KEYWORDS:
+                tokens.append(Token("kw", txt.lower(), pos))
+            else:
+                tokens.append(Token("ident", txt, pos))
+        else:
+            tokens.append(Token("op", m.group("op"), pos))
+        pos = m.end()
+    tokens.append(Token("eof", None, pos))
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+class _Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    def peek(self) -> Token:
+        return self.tokens[self.i]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "kw" and t.value in kws:
+            self.i += 1
+            return t.value
+        return None
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise SqlError(f"expected {kw.upper()} at {self.peek().pos} "
+                           f"in {self.sql!r}")
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "op" and t.value in ops:
+            self.i += 1
+            return t.value
+        return None
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise SqlError(f"expected {op!r} at {self.peek().pos} "
+                           f"in {self.sql!r}")
+
+    # -- grammar -----------------------------------------------------------
+    def parse(self) -> SelectStmt:
+        self.expect_kw("select")
+        select = self.select_list()
+        self.expect_kw("from")
+        t = self.next()
+        if t.kind != "ident":
+            raise SqlError(f"expected table name at {t.pos}")
+        stmt = SelectStmt(select=select, table=t.value)
+        if self.accept_kw("where"):
+            stmt.where = self.or_expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            stmt.group_by = self.expr_list()
+        if self.accept_kw("having"):
+            stmt.having = self.or_expr()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            stmt.order_by = self.order_list()
+        if self.accept_kw("limit"):
+            n = self.next()
+            if n.kind != "number":
+                raise SqlError(f"expected LIMIT count at {n.pos}")
+            if self.accept_op(","):
+                n2 = self.next()  # LIMIT offset, count (MySQL style)
+                stmt.offset, stmt.limit = int(n.value), int(n2.value)
+            else:
+                stmt.limit = int(n.value)
+                if self.accept_kw("offset"):
+                    n2 = self.next()
+                    stmt.offset = int(n2.value)
+        if self.accept_kw("option"):
+            # OPTION(k=v, ...) — query options (QueryOptionsUtils analog)
+            self.expect_op("(")
+            while True:
+                k = self.next()
+                self.expect_op("=")
+                v = self.next()
+                stmt.options[str(k.value)] = v.value
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        self.accept_op(";")
+        if self.peek().kind != "eof":
+            t = self.peek()
+            raise SqlError(f"unexpected trailing token {t.value!r} at {t.pos}")
+        return stmt
+
+    def select_list(self) -> List[SelectItem]:
+        items = [self.select_item()]
+        while self.accept_op(","):
+            items.append(self.select_item())
+        return items
+
+    def select_item(self) -> SelectItem:
+        if self.peek().kind == "op" and self.peek().value == "*":
+            self.next()
+            return SelectItem(Star())
+        expr = self.add_expr()
+        alias = None
+        if self.accept_kw("as"):
+            t = self.next()
+            alias = str(t.value)
+        elif self.peek().kind == "ident":
+            alias = str(self.next().value)
+        return SelectItem(expr, alias)
+
+    def expr_list(self) -> List[Any]:
+        out = [self.add_expr()]
+        while self.accept_op(","):
+            out.append(self.add_expr())
+        return out
+
+    def order_list(self) -> List[OrderItem]:
+        out = []
+        while True:
+            e = self.add_expr()
+            asc = True
+            if self.accept_kw("desc"):
+                asc = False
+            else:
+                self.accept_kw("asc")
+            out.append(OrderItem(e, asc))
+            if not self.accept_op(","):
+                return out
+
+    # boolean layer
+    def or_expr(self) -> Any:
+        children = [self.and_expr()]
+        while self.accept_kw("or"):
+            children.append(self.and_expr())
+        return children[0] if len(children) == 1 else BoolOr(tuple(children))
+
+    def and_expr(self) -> Any:
+        children = [self.not_expr()]
+        while self.accept_kw("and"):
+            children.append(self.not_expr())
+        return children[0] if len(children) == 1 else BoolAnd(tuple(children))
+
+    def not_expr(self) -> Any:
+        if self.accept_kw("not"):
+            return BoolNot(self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> Any:
+        # parenthesized boolean vs parenthesized arithmetic: try boolean
+        if self.peek().kind == "op" and self.peek().value == "(":
+            save = self.i
+            self.next()
+            try:
+                inner = self.or_expr()
+                self.expect_op(")")
+                if isinstance(inner, (BoolAnd, BoolOr, BoolNot, Comparison,
+                                      Between, InList, Like, IsNull)):
+                    return inner
+                # plain value in parens: fall through to comparison tail
+                return self.predicate_tail(inner)
+            except SqlError:
+                self.i = save
+        lhs = self.add_expr()
+        return self.predicate_tail(lhs)
+
+    def predicate_tail(self, lhs: Any) -> Any:
+        op = self.accept_op("=", "!=", "<>", "<", "<=", ">", ">=")
+        if op:
+            rhs = self.add_expr()
+            norm = {"=": "==", "<>": "!="}.get(op, op)
+            return Comparison(norm, lhs, rhs)
+        negated = bool(self.accept_kw("not"))
+        if self.accept_kw("between"):
+            lo = self.add_expr()
+            self.expect_kw("and")
+            hi = self.add_expr()
+            return Between(lhs, lo, hi, negated)
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            vals = [self.literal()]
+            while self.accept_op(","):
+                vals.append(self.literal())
+            self.expect_op(")")
+            return InList(lhs, tuple(vals), negated)
+        if self.accept_kw("like"):
+            t = self.next()
+            if t.kind != "string":
+                raise SqlError(f"LIKE needs a string pattern at {t.pos}")
+            return Like(lhs, t.value, negated)
+        if self.accept_kw("is"):
+            neg = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            return IsNull(lhs, neg)
+        if negated:
+            raise SqlError(f"dangling NOT at {self.peek().pos}")
+        return lhs  # bare expression used as boolean (planner rejects later)
+
+    def literal(self) -> Literal:
+        t = self.next()
+        if t.kind == "number":
+            return Literal(t.value)
+        if t.kind == "string":
+            return Literal(t.value)
+        if t.kind == "kw" and t.value in ("true", "false"):
+            return Literal(t.value == "true")
+        if t.kind == "kw" and t.value == "null":
+            return Literal(None)
+        if t.kind == "op" and t.value == "-":
+            inner = self.literal()
+            return Literal(-inner.value)
+        raise SqlError(f"expected literal at {t.pos}")
+
+    # arithmetic layer
+    def add_expr(self) -> Any:
+        lhs = self.mul_expr()
+        while True:
+            op = self.accept_op("+", "-")
+            if not op:
+                return lhs
+            lhs = BinaryOp(op, lhs, self.mul_expr())
+
+    def mul_expr(self) -> Any:
+        lhs = self.unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return lhs
+            lhs = BinaryOp(op, lhs, self.unary())
+
+    def unary(self) -> Any:
+        if self.accept_op("-"):
+            inner = self.unary()
+            if isinstance(inner, Literal):
+                return Literal(-inner.value)
+            return BinaryOp("-", Literal(0), inner)
+        return self.atom()
+
+    def atom(self) -> Any:
+        t = self.next()
+        if t.kind == "number":
+            return Literal(t.value)
+        if t.kind == "string":
+            return Literal(t.value)
+        if t.kind == "kw" and t.value in ("true", "false"):
+            return Literal(t.value == "true")
+        if t.kind == "kw" and t.value == "null":
+            return Literal(None)
+        if t.kind == "op" and t.value == "(":
+            e = self.add_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "op" and t.value == "*":
+            return Star()
+        if t.kind == "ident":
+            if self.peek().kind == "op" and self.peek().value == "(":
+                self.next()
+                distinct = bool(self.accept_kw("distinct"))
+                args: List[Any] = []
+                if not (self.peek().kind == "op" and self.peek().value == ")"):
+                    if self.peek().kind == "op" and self.peek().value == "*":
+                        self.next()
+                        args.append(Star())
+                    else:
+                        args.append(self.add_expr())
+                    while self.accept_op(","):
+                        args.append(self.add_expr())
+                self.expect_op(")")
+                return FuncCall(t.value.lower(), tuple(args), distinct)
+            return Identifier(t.value)
+        raise SqlError(f"unexpected token {t.value!r} at {t.pos}")
+
+
+def parse_sql(sql: str) -> SelectStmt:
+    return _Parser(sql).parse()

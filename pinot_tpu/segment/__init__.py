@@ -1,0 +1,3 @@
+from .builder import SegmentBuilder  # noqa: F401
+from .immutable import ImmutableSegment  # noqa: F401
+from .dictionary import Dictionary  # noqa: F401

@@ -1,0 +1,276 @@
+"""Segment builder: two-pass stats -> encode -> write.
+
+Reference parity: pinot-segment-local/.../segment/creator/impl/
+SegmentIndexCreationDriverImpl.java:117 (init: stats pass) and :246 (build:
+dictionary + per-column index creation, seal, v3 single-dir layout). The
+TPU-native format drops bit-packing in favor of byte-aligned minimal int
+widths (uint8/uint16/int32 dict ids) that memmap zero-copy and upcast on
+device; raw numeric columns store their native fixed width.
+
+On-disk layout (segment dir):
+    metadata.json             — docs, per-column stats/encoding
+    <col>.fwd.bin             — forward index, little-endian fixed width
+    <col>.dict.bin            — numeric dictionary (sorted values)
+    <col>.dict.json           — string dictionary (sorted values)
+    <col>.null.bin            — packed null bitmap (np.packbits), if any nulls
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from ..spi.config import TableConfig
+from ..spi.schema import DataType, FieldType, Schema
+from .dictionary import Dictionary, min_id_dtype
+
+FORMAT_VERSION = 1
+METADATA_FILE = "metadata.json"
+
+
+def _fwd_path(d: str, col: str) -> str:
+    return os.path.join(d, f"{col}.fwd.bin")
+
+
+def _dict_bin_path(d: str, col: str) -> str:
+    return os.path.join(d, f"{col}.dict.bin")
+
+
+def _dict_json_path(d: str, col: str) -> str:
+    return os.path.join(d, f"{col}.dict.json")
+
+
+def _null_path(d: str, col: str) -> str:
+    return os.path.join(d, f"{col}.null.bin")
+
+
+class SegmentBuilder:
+    """Builds one immutable segment directory from rows or columns."""
+
+    def __init__(self, schema: Schema, table_config: Optional[TableConfig] = None):
+        self.schema = schema
+        self.table_config = table_config or TableConfig(schema.name)
+
+    # -- input normalization ----------------------------------------------
+    def _to_columns(self, data: Union[Sequence[Mapping[str, Any]],
+                                      Mapping[str, Any]]
+                    ) -> Dict[str, np.ndarray]:
+        """Accept list-of-row-dicts or dict-of-columns; apply null defaults;
+        return typed numpy columns plus null masks (attached as attr)."""
+        cols: Dict[str, np.ndarray] = {}
+        nulls: Dict[str, np.ndarray] = {}
+        if isinstance(data, Mapping):
+            n = None
+            for f in self.schema.fields:
+                if f.name not in data:
+                    raise ValueError(f"missing column {f.name!r}")
+                raw = data[f.name]
+                arr = np.asarray(raw)
+                if n is None:
+                    n = len(arr)
+                elif len(arr) != n:
+                    raise ValueError(f"column {f.name!r} length {len(arr)} != {n}")
+                cols[f.name], nulls[f.name] = self._coerce(f, arr)
+        else:
+            rows = list(data)
+            n = len(rows)
+            for f in self.schema.fields:
+                raw_list = [r.get(f.name) for r in rows]
+                cols[f.name], nulls[f.name] = self._coerce(
+                    f, np.asarray(raw_list, dtype=object))
+        self._nulls = nulls
+        return cols
+
+    def _coerce(self, f, arr: np.ndarray):
+        null_mask = np.zeros(len(arr), dtype=bool)
+        if arr.dtype == object:
+            null_mask = np.array([v is None for v in arr], dtype=bool)
+            if null_mask.any():
+                arr = arr.copy()
+                arr[null_mask] = f.null_value()
+        if f.data_type == DataType.STRING or not f.data_type.is_numeric:
+            out = np.asarray([str(v) for v in arr], dtype=object)
+        else:
+            if f.data_type == DataType.BOOLEAN and arr.dtype == object:
+                arr = np.asarray(
+                    [1 if v in (True, 1, "true", "True") else 0 for v in arr])
+            out = arr.astype(f.data_type.np_dtype)
+        return out, null_mask
+
+    # -- encoding decision -------------------------------------------------
+    def _use_dictionary(self, f, cardinality: int) -> bool:
+        idx = self.table_config.indexing
+        if f.name in idx.no_dictionary_columns:
+            return False
+        if f.name in idx.dictionary_columns:
+            return True
+        if not f.data_type.is_numeric:
+            return True  # strings always dict-encoded
+        if f.field_type == FieldType.METRIC:
+            return False  # raw metrics aggregate without an id->value gather
+        return cardinality <= idx.dict_cardinality_threshold
+
+    # -- build -------------------------------------------------------------
+    def build(self, data: Union[Sequence[Mapping[str, Any]], Mapping[str, Any]],
+              out_dir: str, segment_name: Optional[str] = None,
+              shared_dicts: Optional[Dict[str, Dictionary]] = None
+              ) -> str:
+        """Build a segment; returns the segment directory path.
+
+        shared_dicts: table-level dictionaries (TPU-native extension: when a
+        whole table is built at once, all its segments share one dictionary
+        per column so group-by partials combine on-device via psum without
+        per-segment id remapping — see parallel/distributed.py).
+        """
+        cols = self._to_columns(data)
+        n_docs = len(next(iter(cols.values()))) if cols else 0
+        segment_name = segment_name or f"{self.schema.name}_{int(time.time()*1e3)}"
+        seg_dir = os.path.join(out_dir, segment_name)
+        os.makedirs(seg_dir, exist_ok=True)
+
+        meta: Dict[str, Any] = {
+            "formatVersion": FORMAT_VERSION,
+            "segmentName": segment_name,
+            "tableName": self.schema.name,
+            "totalDocs": n_docs,
+            "creationTimeMs": int(time.time() * 1e3),
+            "columns": {},
+            "schema": self.schema.to_dict(),
+        }
+        if self.table_config.partition_column:
+            meta["partitionColumn"] = self.table_config.partition_column
+
+        for f in self.schema.fields:
+            arr = cols[f.name]
+            cmeta = self._build_column(
+                f, arr, seg_dir,
+                shared_dict=(shared_dicts or {}).get(f.name))
+            null_mask = self._nulls.get(f.name)
+            if null_mask is not None and null_mask.any():
+                np.packbits(null_mask).tofile(_null_path(seg_dir, f.name))
+                cmeta["hasNulls"] = True
+                cmeta["nullCount"] = int(null_mask.sum())
+            meta["columns"][f.name] = cmeta
+
+        if self.table_config.partition_column:
+            pc = self.table_config.partition_column
+            pmeta = meta["columns"][pc]
+            # modulo partition function over raw values (PartitionFunction SPI)
+            vals = cols[pc]
+            if not np.issubdtype(np.asarray(vals[:1]).dtype, np.number):
+                pids = np.asarray([hash(v) for v in vals])
+            else:
+                pids = vals.astype(np.int64)
+            parts = np.unique(pids % max(self.table_config.num_partitions, 1))
+            pmeta["partitions"] = [int(p) for p in parts]
+
+        with open(os.path.join(seg_dir, METADATA_FILE), "w") as fh:
+            json.dump(meta, fh, indent=1, default=_json_default)
+        return seg_dir
+
+    def _build_column(self, f, arr: np.ndarray, seg_dir: str,
+                      shared_dict: Optional[Dictionary] = None) -> Dict[str, Any]:
+        n = len(arr)
+        cmeta: Dict[str, Any] = {
+            "dataType": f.data_type.value,
+            "fieldType": f.field_type.value,
+        }
+        if shared_dict is not None:
+            dictionary: Optional[Dictionary] = shared_dict
+            ids = self._encode_with(shared_dict, arr, f.data_type)
+            cardinality = shared_dict.cardinality
+            use_dict = True
+        else:
+            if f.data_type == DataType.STRING or not f.data_type.is_numeric:
+                cardinality = len(set(str(v) for v in arr)) if n else 0
+            else:
+                cardinality = int(len(np.unique(arr))) if n else 0
+            use_dict = self._use_dictionary(f, cardinality)
+            dictionary, ids = (Dictionary.build(arr, f.data_type)
+                               if use_dict else (None, None))
+            if dictionary is not None:
+                cardinality = dictionary.cardinality
+
+        cmeta["cardinality"] = cardinality
+        is_sorted = bool(n == 0 or (
+            use_dict and bool(np.all(ids[1:] >= ids[:-1]))) or (
+            not use_dict and f.data_type.is_numeric
+            and bool(np.all(arr[1:] >= arr[:-1]))))
+        cmeta["isSorted"] = is_sorted
+
+        if use_dict:
+            assert dictionary is not None and ids is not None
+            id_dtype = min_id_dtype(cardinality)
+            ids.astype(id_dtype).tofile(_fwd_path(seg_dir, f.name))
+            cmeta["encoding"] = "DICT"
+            cmeta["fwdDtype"] = id_dtype.name
+            if f.data_type == DataType.STRING or not f.data_type.is_numeric:
+                with open(_dict_json_path(seg_dir, f.name), "w") as fh:
+                    json.dump(list(dictionary.values), fh)
+                cmeta["dictFormat"] = "json"
+            else:
+                vals = np.asarray(dictionary.values, dtype=f.data_type.np_dtype)
+                vals.tofile(_dict_bin_path(seg_dir, f.name))
+                cmeta["dictFormat"] = "bin"
+                cmeta["dictDtype"] = f.data_type.np_dtype.name
+            cmeta["min"] = _json_scalar(dictionary.min_value)
+            cmeta["max"] = _json_scalar(dictionary.max_value)
+        else:
+            arr.tofile(_fwd_path(seg_dir, f.name))
+            cmeta["encoding"] = "RAW"
+            cmeta["fwdDtype"] = arr.dtype.name
+            if n:
+                cmeta["min"] = _json_scalar(arr.min())
+                cmeta["max"] = _json_scalar(arr.max())
+        return cmeta
+
+    @staticmethod
+    def _encode_with(dictionary: Dictionary, arr: np.ndarray,
+                     data_type: DataType) -> np.ndarray:
+        if data_type == DataType.STRING or not data_type.is_numeric:
+            lookup = {v: i for i, v in enumerate(dictionary.values)}
+            return np.asarray([lookup[str(v)] for v in arr], dtype=np.int32)
+        vals = np.asarray(dictionary.values)
+        ids = np.searchsorted(vals, arr)
+        if not np.all(vals[ids] == arr):
+            raise ValueError("value missing from shared dictionary")
+        return ids.astype(np.int32)
+
+
+def build_table_dictionaries(schema: Schema, table_config: TableConfig,
+                             column_chunks: Iterable[Mapping[str, np.ndarray]]
+                             ) -> Dict[str, Dictionary]:
+    """Union per-column values across all chunks into table-level sorted
+    dictionaries (for the shared-dict multi-segment build path)."""
+    builder = SegmentBuilder(schema, table_config)
+    accum: Dict[str, List[np.ndarray]] = {f.name: [] for f in schema.fields}
+    chunks = list(column_chunks)
+    for chunk in chunks:
+        cols = builder._to_columns(chunk)
+        for name, arr in cols.items():
+            accum[name].append(arr)
+    dicts: Dict[str, Dictionary] = {}
+    for f in schema.fields:
+        allv = np.concatenate([np.asarray(a, dtype=object)
+                               if f.data_type == DataType.STRING else a
+                               for a in accum[f.name]])
+        card_est = len(np.unique(allv.astype(str))) if allv.dtype == object \
+            else len(np.unique(allv))
+        if builder._use_dictionary(f, card_est):
+            dicts[f.name], _ = Dictionary.build(allv, f.data_type)
+    return dicts
+
+
+def _json_scalar(v: Any) -> Any:
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def _json_default(v: Any) -> Any:
+    if isinstance(v, np.generic):
+        return v.item()
+    raise TypeError(f"not JSON serializable: {type(v)}")

@@ -1,0 +1,1 @@
+from .data_manager import TableDataManager  # noqa: F401

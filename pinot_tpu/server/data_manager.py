@@ -1,0 +1,67 @@
+"""Table/segment data manager: segment lifecycle on a server.
+
+Reference parity: pinot-core/.../data/manager/BaseTableDataManager.java
+(segment add/replace/remove with acquire/release refcounting) and
+ServerQueryExecutorV1Impl.java:203-217 (acquire-all for a query). Python's
+GIL + immutable segment objects let us replace Java's refcounting with
+atomic dict swaps; a query captures a consistent snapshot list.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ..segment.immutable import ImmutableSegment
+
+
+class TableDataManager:
+    def __init__(self, table_name: str):
+        self.table_name = table_name
+        self._segments: Dict[str, ImmutableSegment] = {}
+        self._lock = threading.Lock()
+        # optional mesh-resident DistributedTable (parallel/distributed.py);
+        # the broker prefers it for kernel-plan aggregations
+        self.distributed = None
+
+    def set_distributed(self, distributed) -> None:
+        self.distributed = distributed
+
+    def add_segment(self, segment: ImmutableSegment) -> None:
+        with self._lock:
+            self._segments = {**self._segments, segment.name: segment}
+
+    def add_segment_dir(self, seg_dir: str) -> ImmutableSegment:
+        seg = ImmutableSegment.load(seg_dir)
+        self.add_segment(seg)
+        return seg
+
+    def add_table_dir(self, table_dir: str) -> List[ImmutableSegment]:
+        """Load every segment directory under a table directory."""
+        out = []
+        for name in sorted(os.listdir(table_dir)):
+            d = os.path.join(table_dir, name)
+            if os.path.isdir(d) and os.path.exists(
+                    os.path.join(d, "metadata.json")):
+                out.append(self.add_segment_dir(d))
+        return out
+
+    def remove_segment(self, name: str) -> None:
+        with self._lock:
+            segs = dict(self._segments)
+            segs.pop(name, None)
+            self._segments = segs
+
+    def replace_segment(self, segment: ImmutableSegment) -> None:
+        self.add_segment(segment)  # atomic swap by name
+
+    def acquire_segments(self) -> List[ImmutableSegment]:
+        return list(self._segments.values())
+
+    @property
+    def num_segments(self) -> int:
+        return len(self._segments)
+
+    @property
+    def total_docs(self) -> int:
+        return sum(s.n_docs for s in self._segments.values())

@@ -1,0 +1,138 @@
+"""Table + instance configuration.
+
+Reference parity: pinot-spi/.../spi/config/table/TableConfig and
+pinot-spi/.../spi/env/PinotConfiguration.java:90 (layered config with
+relaxed key matching). We keep a small typed TableConfig plus a layered
+InstanceConfig merging dict -> env -> defaults.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class TableType(enum.Enum):
+    OFFLINE = "OFFLINE"
+    REALTIME = "REALTIME"
+
+
+@dataclass
+class IndexingConfig:
+    """Which columns are dictionary-encoded vs raw (TableConfig indexing
+    section: noDictionaryColumns, sortedColumn, ...).
+
+    TPU-native defaults: strings always dict; numeric dimensions dict when
+    cardinality <= dict_cardinality_threshold; metrics raw (raw numerics
+    aggregate directly on device without an id->value gather).
+    """
+    dictionary_columns: List[str] = field(default_factory=list)
+    no_dictionary_columns: List[str] = field(default_factory=list)
+    sorted_column: Optional[str] = None
+    dict_cardinality_threshold: int = 1 << 17
+
+
+@dataclass
+class SegmentsConfig:
+    replication: int = 1
+    # pad segments to pow2 buckets >= this floor to bound XLA recompiles
+    min_bucket: int = 1 << 10
+
+
+@dataclass
+class TableConfig:
+    table_name: str
+    table_type: TableType = TableType.OFFLINE
+    indexing: IndexingConfig = field(default_factory=IndexingConfig)
+    segments: SegmentsConfig = field(default_factory=SegmentsConfig)
+    # partition column for partition-aware routing/pruning (segmentpartition/)
+    partition_column: Optional[str] = None
+    num_partitions: int = 1
+
+    @property
+    def name_with_type(self) -> str:
+        return f"{self.table_name}_{self.table_type.value}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "tableName": self.table_name,
+            "tableType": self.table_type.value,
+            "indexing": {
+                "dictionaryColumns": self.indexing.dictionary_columns,
+                "noDictionaryColumns": self.indexing.no_dictionary_columns,
+                "sortedColumn": self.indexing.sorted_column,
+                "dictCardinalityThreshold": self.indexing.dict_cardinality_threshold,
+            },
+            "segments": {
+                "replication": self.segments.replication,
+                "minBucket": self.segments.min_bucket,
+            },
+            "partitionColumn": self.partition_column,
+            "numPartitions": self.num_partitions,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TableConfig":
+        idx = d.get("indexing", {})
+        seg = d.get("segments", {})
+        return cls(
+            table_name=d["tableName"],
+            table_type=TableType(d.get("tableType", "OFFLINE")),
+            indexing=IndexingConfig(
+                dictionary_columns=idx.get("dictionaryColumns", []),
+                no_dictionary_columns=idx.get("noDictionaryColumns", []),
+                sorted_column=idx.get("sortedColumn"),
+                dict_cardinality_threshold=idx.get("dictCardinalityThreshold",
+                                                   1 << 17),
+            ),
+            segments=SegmentsConfig(
+                replication=seg.get("replication", 1),
+                min_bucket=seg.get("minBucket", 1 << 10),
+            ),
+            partition_column=d.get("partitionColumn"),
+            num_partitions=d.get("numPartitions", 1),
+        )
+
+
+class InstanceConfig:
+    """Layered key/value config: explicit dict > env (PINOT_TPU_ prefixed,
+    relaxed matching: dots become underscores, case-insensitive) > defaults.
+    Mirrors PinotConfiguration.java:90 semantics at small scale.
+    """
+
+    ENV_PREFIX = "PINOT_TPU_"
+
+    def __init__(self, values: Optional[Dict[str, Any]] = None):
+        self._values = dict(values or {})
+
+    @staticmethod
+    def _relax(key: str) -> str:
+        return key.lower().replace(".", "_").replace("-", "_")
+
+    def get(self, key: str, default: Any = None) -> Any:
+        relaxed = self._relax(key)
+        for k, v in self._values.items():
+            if self._relax(k) == relaxed:
+                return v
+        env_key = self.ENV_PREFIX + relaxed.upper()
+        if env_key in os.environ:
+            return os.environ[env_key]
+        return default
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self.get(key, default)
+        return int(v)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key, default)
+        if isinstance(v, str):
+            return v.strip().lower() in ("1", "true", "yes", "on")
+        return bool(v)
+
+    def set(self, key: str, value: Any) -> None:
+        self._values[key] = value

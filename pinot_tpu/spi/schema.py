@@ -1,0 +1,212 @@
+"""Data model: schema, field specs, data types.
+
+Reference parity: pinot-spi/src/main/java/org/apache/pinot/spi/data/
+{Schema.java, FieldSpec.java, DateTimeFieldSpec.java}. Pinot models a table
+as dimensions + metrics + dateTime fields over types
+INT/LONG/FLOAT/DOUBLE/BOOLEAN/TIMESTAMP/STRING/JSON/BYTES/BIG_DECIMAL,
+single- or multi-value. TPU-native design keeps the same logical model but
+maps every stored column to a fixed-width numpy/JAX dtype (strings are
+always dictionary-encoded to int ids — matching Pinot's dict-id execution).
+"""
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class DataType(enum.Enum):
+    INT = "INT"
+    LONG = "LONG"
+    FLOAT = "FLOAT"
+    DOUBLE = "DOUBLE"
+    BOOLEAN = "BOOLEAN"
+    TIMESTAMP = "TIMESTAMP"  # millis since epoch, stored as int64
+    STRING = "STRING"
+    JSON = "JSON"    # stored as STRING for now
+    BYTES = "BYTES"  # stored as hex STRING for now
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in (DataType.INT, DataType.LONG, DataType.FLOAT,
+                        DataType.DOUBLE, DataType.BOOLEAN, DataType.TIMESTAMP)
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return _NP_DTYPES[self]
+
+    @property
+    def is_integral(self) -> bool:
+        return self in (DataType.INT, DataType.LONG, DataType.BOOLEAN,
+                        DataType.TIMESTAMP)
+
+
+_NP_DTYPES = {
+    DataType.INT: np.dtype(np.int32),
+    DataType.LONG: np.dtype(np.int64),
+    DataType.FLOAT: np.dtype(np.float32),
+    DataType.DOUBLE: np.dtype(np.float64),
+    DataType.BOOLEAN: np.dtype(np.int8),
+    DataType.TIMESTAMP: np.dtype(np.int64),
+    DataType.STRING: np.dtype(object),
+    DataType.JSON: np.dtype(object),
+    DataType.BYTES: np.dtype(object),
+}
+
+# Pinot default null placeholder values (FieldSpec.java DEFAULT_*): dimensions
+# use MIN_VALUE-ish sentinels, metrics use 0.
+_DEFAULT_NULL_DIM = {
+    DataType.INT: np.int32(np.iinfo(np.int32).min),
+    DataType.LONG: np.int64(np.iinfo(np.int64).min),
+    DataType.FLOAT: np.float32(np.finfo(np.float32).min),
+    DataType.DOUBLE: np.float64(np.finfo(np.float64).min),
+    DataType.BOOLEAN: np.int8(0),
+    DataType.TIMESTAMP: np.int64(0),
+    DataType.STRING: "null",
+    DataType.JSON: "null",
+    DataType.BYTES: "",
+}
+_DEFAULT_NULL_METRIC = {
+    DataType.INT: np.int32(0),
+    DataType.LONG: np.int64(0),
+    DataType.FLOAT: np.float32(0),
+    DataType.DOUBLE: np.float64(0),
+    DataType.BOOLEAN: np.int8(0),
+    DataType.TIMESTAMP: np.int64(0),
+    DataType.STRING: "null",
+    DataType.JSON: "null",
+    DataType.BYTES: "",
+}
+
+
+class FieldType(enum.Enum):
+    DIMENSION = "DIMENSION"
+    METRIC = "METRIC"
+    DATE_TIME = "DATE_TIME"
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    name: str
+    data_type: DataType
+    field_type: FieldType = FieldType.DIMENSION
+    single_value: bool = True
+    default_null_value: Any = None
+    # DATE_TIME extras (DateTimeFieldSpec.java): e.g. "1:MILLISECONDS:EPOCH"
+    format: Optional[str] = None
+    granularity: Optional[str] = None
+
+    def null_value(self) -> Any:
+        if self.default_null_value is not None:
+            return self.default_null_value
+        table = (_DEFAULT_NULL_METRIC if self.field_type == FieldType.METRIC
+                 else _DEFAULT_NULL_DIM)
+        return table[self.data_type]
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "dataType": self.data_type.value,
+            "fieldType": self.field_type.value,
+            "singleValue": self.single_value,
+        }
+        if self.default_null_value is not None:
+            v = self.default_null_value
+            d["defaultNullValue"] = v.item() if isinstance(v, np.generic) else v
+        if self.format:
+            d["format"] = self.format
+        if self.granularity:
+            d["granularity"] = self.granularity
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FieldSpec":
+        return cls(
+            name=d["name"],
+            data_type=DataType(d["dataType"]),
+            field_type=FieldType(d.get("fieldType", "DIMENSION")),
+            single_value=d.get("singleValue", True),
+            default_null_value=d.get("defaultNullValue"),
+            format=d.get("format"),
+            granularity=d.get("granularity"),
+        )
+
+
+class Schema:
+    """Ordered collection of FieldSpecs (Schema.java)."""
+
+    def __init__(self, name: str, fields: Iterable[FieldSpec]):
+        self.name = name
+        self._fields: Dict[str, FieldSpec] = {}
+        for f in fields:
+            if f.name in self._fields:
+                raise ValueError(f"duplicate field {f.name!r}")
+            self._fields[f.name] = f
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def fields(self) -> List[FieldSpec]:
+        return list(self._fields.values())
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._fields.keys())
+
+    def field(self, name: str) -> FieldSpec:
+        try:
+            return self._fields[name]
+        except KeyError:
+            raise KeyError(f"column {name!r} not in schema {self.name!r}; "
+                           f"have {self.column_names}") from None
+
+    def has_column(self, name: str) -> bool:
+        return name in self._fields
+
+    def dimension_names(self) -> List[str]:
+        return [f.name for f in self.fields if f.field_type == FieldType.DIMENSION]
+
+    def metric_names(self) -> List[str]:
+        return [f.name for f in self.fields if f.field_type == FieldType.METRIC]
+
+    # -- serde -------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "schemaName": self.name,
+            "fields": [f.to_dict() for f in self.fields],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Schema":
+        # Accept both our format and Pinot's dimensionFieldSpecs/metricFieldSpecs
+        if "fields" in d:
+            return cls(d.get("schemaName", "unknown"),
+                       [FieldSpec.from_dict(f) for f in d["fields"]])
+        fields: List[FieldSpec] = []
+        for f in d.get("dimensionFieldSpecs", []):
+            fields.append(FieldSpec(f["name"], DataType(f["dataType"]),
+                                    FieldType.DIMENSION,
+                                    f.get("singleValueField", True),
+                                    f.get("defaultNullValue")))
+        for f in d.get("metricFieldSpecs", []):
+            fields.append(FieldSpec(f["name"], DataType(f["dataType"]),
+                                    FieldType.METRIC, True,
+                                    f.get("defaultNullValue")))
+        for f in d.get("dateTimeFieldSpecs", []):
+            fields.append(FieldSpec(f["name"], DataType(f["dataType"]),
+                                    FieldType.DATE_TIME, True,
+                                    f.get("defaultNullValue"),
+                                    f.get("format"), f.get("granularity")))
+        return cls(d.get("schemaName", "unknown"), fields)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Schema":
+        return cls.from_dict(json.loads(s))
+
+    def __repr__(self) -> str:
+        return f"Schema({self.name!r}, {self.column_names})"

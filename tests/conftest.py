@@ -1,0 +1,29 @@
+"""Test env: force CPU backend with 8 virtual devices BEFORE jax imports.
+
+Mirrors the driver's multi-chip dry-run environment: sharding/collective
+tests exercise a jax.sharding.Mesh over 8 virtual CPU devices
+(xla_force_host_platform_device_count), per SURVEY.md build notes.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize registers an 'axon' TPU backend and forces
+# jax_platforms='axon,cpu' regardless of JAX_PLATFORMS. Tests run on the
+# virtual 8-device CPU mesh, so override the config before any backend
+# initializes (bench.py keeps the real chip).
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,112 @@
+"""Segment build/load round-trip tests.
+
+Reference test strategy analog: index creator/reader round-trip unit tests
+in pinot-segment-local/src/test (SURVEY.md section 4.1).
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.segment import Dictionary, ImmutableSegment, SegmentBuilder
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema, TableConfig
+
+
+@pytest.fixture
+def schema():
+    return Schema("t", [
+        FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("year", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("revenue", DataType.LONG, FieldType.METRIC),
+        FieldSpec("score", DataType.DOUBLE, FieldType.METRIC),
+    ])
+
+
+def _build(schema, tmp_path, rows):
+    builder = SegmentBuilder(schema, TableConfig("t"))
+    seg_dir = builder.build(rows, str(tmp_path), "seg_0")
+    return ImmutableSegment.load(seg_dir)
+
+
+def test_round_trip_values(schema, tmp_path):
+    rows = [
+        {"city": "nyc", "year": 2020, "revenue": 100, "score": 1.5},
+        {"city": "sf", "year": 2021, "revenue": 200, "score": 2.5},
+        {"city": "nyc", "year": 2020, "revenue": 300, "score": -3.25},
+    ]
+    seg = _build(schema, tmp_path, rows)
+    assert seg.n_docs == 3
+    assert list(seg.raw_values("city")) == ["nyc", "sf", "nyc"]
+    np.testing.assert_array_equal(seg.raw_values("year"), [2020, 2021, 2020])
+    np.testing.assert_array_equal(seg.raw_values("revenue"), [100, 200, 300])
+    np.testing.assert_array_equal(seg.raw_values("score"), [1.5, 2.5, -3.25])
+
+
+def test_dict_encoding_and_metadata(schema, tmp_path):
+    rows = [{"city": c, "year": y, "revenue": r, "score": 0.0}
+            for c, y, r in [("b", 2000, 5), ("a", 2001, 7), ("b", 2000, 9)]]
+    seg = _build(schema, tmp_path, rows)
+    city = seg.columns["city"]
+    assert city.has_dict
+    assert city.cardinality == 2
+    d = seg.dictionary("city")
+    assert list(d.values) == ["a", "b"]  # sorted
+    assert d.index_of("b") == 1
+    assert d.index_of("zz") == -1
+    # metrics stay raw
+    assert seg.columns["revenue"].encoding == "RAW"
+    assert seg.columns["revenue"].min == 5
+    assert seg.columns["revenue"].max == 9
+    # dims dict-encoded with minimal width
+    assert seg.columns["year"].fwd_dtype == np.dtype(np.uint8)
+
+
+def test_nulls_round_trip(schema, tmp_path):
+    rows = [
+        {"city": "x", "year": 1, "revenue": None, "score": 1.0},
+        {"city": None, "year": 2, "revenue": 5, "score": 2.0},
+        {"city": "y", "year": 3, "revenue": 6, "score": None},
+    ]
+    seg = _build(schema, tmp_path, rows)
+    assert seg.columns["revenue"].has_nulls
+    np.testing.assert_array_equal(seg.null_mask("revenue"),
+                                  [True, False, False])
+    # null metric defaults to 0 (FieldSpec default null values)
+    assert seg.raw_values("revenue")[0] == 0
+    assert seg.raw_values("city")[1] == "null"
+
+
+def test_device_padding_and_bucket(schema, tmp_path):
+    rows = [{"city": "c", "year": i, "revenue": i, "score": float(i)}
+            for i in range(5)]
+    seg = _build(schema, tmp_path, rows)
+    assert seg.bucket == 1024
+    col = seg.device_col("revenue")
+    assert col.shape == (1024,)
+    np.testing.assert_array_equal(np.asarray(col)[:5], np.arange(5))
+    np.testing.assert_array_equal(np.asarray(col)[5:], 0)
+
+
+def test_dictionary_id_range():
+    d = Dictionary(np.array([10, 20, 30, 40], dtype=np.int64), DataType.LONG)
+    assert d.id_range(20, 30, True, True) == (1, 2)
+    assert d.id_range(15, 35, True, True) == (1, 2)
+    assert d.id_range(20, 30, False, False) == (1, 0)  # empty sentinel
+    assert d.id_range(None, 25, True, True) == (0, 1)
+    assert d.id_range(25, None, True, True) == (2, 3)
+    assert d.id_range(41, None, True, True) == (1, 0)  # empty sentinel
+    assert d.id_range(None, None, True, True) == (0, 3)
+
+
+def test_sorted_flag(schema, tmp_path):
+    rows = [{"city": "c", "year": i // 2, "revenue": 9 - i, "score": 0.0}
+            for i in range(6)]
+    seg = _build(schema, tmp_path, rows)
+    assert seg.columns["year"].is_sorted
+    assert not seg.columns["revenue"].is_sorted
+
+
+def test_mmap_zero_copy(schema, tmp_path):
+    rows = [{"city": "c", "year": 1, "revenue": i, "score": 0.0}
+            for i in range(100)]
+    seg = _build(schema, tmp_path, rows)
+    fwd = seg.fwd("revenue")
+    assert isinstance(fwd, np.memmap)

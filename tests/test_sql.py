@@ -1,0 +1,98 @@
+"""SQL parser tests (CalciteSqlParser compile tests analog)."""
+import pytest
+
+from pinot_tpu.query.sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr,
+                                 Comparison, FuncCall, Identifier, InList,
+                                 IsNull, Like, Literal, SqlError, Star,
+                                 parse_sql)
+
+
+def test_basic_select():
+    s = parse_sql("SELECT a, b FROM t")
+    assert s.table == "t"
+    assert [i.expr for i in s.select] == [Identifier("a"), Identifier("b")]
+
+
+def test_star():
+    s = parse_sql("select * from t limit 5")
+    assert isinstance(s.select[0].expr, Star)
+    assert s.limit == 5
+
+
+def test_aggregation_group_by():
+    s = parse_sql("SELECT yearID, SUM(runs) AS total FROM baseballStats "
+                  "WHERE league = 'NL' GROUP BY yearID ORDER BY total DESC "
+                  "LIMIT 20")
+    assert s.select[1].alias == "total"
+    fc = s.select[1].expr
+    assert fc == FuncCall("sum", (Identifier("runs"),))
+    assert s.group_by == [Identifier("yearID")]
+    assert not s.order_by[0].ascending
+    assert s.limit == 20
+
+
+def test_where_precedence():
+    s = parse_sql("SELECT COUNT(*) FROM t WHERE a = 1 AND b > 2 OR c < 3")
+    assert isinstance(s.where, BoolOr)
+    assert isinstance(s.where.children[0], BoolAnd)
+
+
+def test_between_in_like_null():
+    s = parse_sql("SELECT COUNT(*) FROM t WHERE a BETWEEN 1 AND 10 "
+                  "AND b IN ('x','y') AND c NOT LIKE 'ab%' AND d IS NOT NULL")
+    kids = s.where.children
+    assert isinstance(kids[0], Between)
+    assert isinstance(kids[1], InList)
+    assert kids[2] == Like(Identifier("c"), "ab%", negated=True)
+    assert kids[3] == IsNull(Identifier("d"), negated=True)
+
+
+def test_arithmetic_in_agg():
+    s = parse_sql("SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder")
+    fc = s.select[0].expr
+    assert fc.name == "sum"
+    assert isinstance(fc.args[0], BinaryOp)
+    assert fc.args[0].op == "*"
+
+
+def test_string_escapes_and_negative():
+    s = parse_sql("SELECT COUNT(*) FROM t WHERE s = 'it''s' AND x > -5.5")
+    assert s.where.children[0].rhs == Literal("it's")
+    assert s.where.children[1].rhs == Literal(-5.5)
+
+
+def test_not_and_parens():
+    s = parse_sql("SELECT COUNT(*) FROM t WHERE NOT (a = 1 OR b = 2)")
+    assert isinstance(s.where, BoolNot)
+    assert isinstance(s.where.child, BoolOr)
+
+
+def test_limit_offset_forms():
+    assert parse_sql("SELECT a FROM t LIMIT 5 OFFSET 3").offset == 3
+    s = parse_sql("SELECT a FROM t LIMIT 3, 5")
+    assert (s.offset, s.limit) == (3, 5)
+
+
+def test_count_distinct():
+    s = parse_sql("SELECT COUNT(DISTINCT a), DISTINCTCOUNT(b) FROM t")
+    assert s.select[0].expr.distinct
+    assert s.select[1].expr.name == "distinctcount"
+
+
+def test_having():
+    s = parse_sql("SELECT a, SUM(b) FROM t GROUP BY a HAVING SUM(b) > 10")
+    assert isinstance(s.having, Comparison)
+
+
+def test_errors():
+    with pytest.raises(SqlError):
+        parse_sql("SELECT FROM t")
+    with pytest.raises(SqlError):
+        parse_sql("SELECT a FROM t WHERE")
+    with pytest.raises(SqlError):
+        parse_sql("SELECT a FROM t trailing garbage ,")
+
+
+def test_options():
+    s = parse_sql("SELECT a FROM t LIMIT 1 OPTION(timeoutMs=100)")
+    assert s.options["timeoutMs"] == 100
