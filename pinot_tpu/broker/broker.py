@@ -43,6 +43,11 @@ class Broker:
     def query(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
+        if stmt.joins:
+            # v2 engine (BrokerRequestHandlerDelegate picks the multi-stage
+            # handler when the query needs it)
+            from ..multistage import execute_multistage
+            return execute_multistage(self, stmt)
         ctx = build_query_context(stmt)
         dm = self.table(ctx.table)
         segments = dm.acquire_segments()

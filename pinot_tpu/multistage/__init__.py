@@ -1,0 +1,2 @@
+from .relation import Relation  # noqa: F401
+from .executor import execute_multistage, is_multistage  # noqa: F401

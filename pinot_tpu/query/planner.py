@@ -530,6 +530,10 @@ class SegmentPlanner:
     def plan(self) -> CompiledPlan:
         ctx, seg = self.ctx, self.seg
         self._validate_columns()
+        if getattr(seg, "is_mutable", False):
+            # consuming snapshot: vectorized host path (MutableSegmentImpl's
+            # realtime read path analog; rows become device-resident on seal)
+            return CompiledPlan("host", seg, ctx)
         if not ctx.is_aggregation:
             return CompiledPlan("host", seg, ctx)  # selection: host path
 

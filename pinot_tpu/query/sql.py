@@ -132,10 +132,29 @@ class OrderItem:
     ascending: bool = True
 
 
+@dataclass(frozen=True)
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+    @property
+    def label(self) -> str:
+        return self.alias or self.name
+
+
+@dataclass(frozen=True)
+class JoinClause:
+    table: TableRef
+    on: Any                 # boolean expression over qualified identifiers
+    join_type: str = "inner"  # inner | left
+
+
 @dataclass
 class SelectStmt:
     select: List[SelectItem]
     table: str
+    table_alias: Optional[str] = None
+    joins: List[JoinClause] = field(default_factory=list)
     where: Optional[Any] = None
     group_by: List[Any] = field(default_factory=list)
     having: Optional[Any] = None
@@ -162,6 +181,7 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "and", "or", "not", "between", "in", "like", "is", "null",
     "as", "asc", "desc", "distinct", "true", "false", "option",
+    "join", "on", "left", "right", "inner", "outer", "cross", "full",
 }
 
 
@@ -254,10 +274,30 @@ class _Parser:
         self.expect_kw("select")
         select = self.select_list()
         self.expect_kw("from")
-        t = self.next()
-        if t.kind != "ident":
-            raise SqlError(f"expected table name at {t.pos}")
-        stmt = SelectStmt(select=select, table=t.value)
+        base = self.table_ref()
+        stmt = SelectStmt(select=select, table=base.name,
+                          table_alias=base.alias)
+        while True:
+            jt = None
+            if self.accept_kw("join"):
+                jt = "inner"
+            elif self.accept_kw("inner"):
+                self.expect_kw("join")
+                jt = "inner"
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                jt = "left"
+            elif self.peek().kind == "kw" and self.peek().value in (
+                    "right", "full", "cross"):
+                raise SqlError(f"{self.peek().value.upper()} JOIN "
+                               "not supported yet")
+            if jt is None:
+                break
+            tref = self.table_ref()
+            self.expect_kw("on")
+            cond = self.or_expr()
+            stmt.joins.append(JoinClause(tref, cond, jt))
         if self.accept_kw("where"):
             stmt.where = self.or_expr()
         if self.accept_kw("group"):
@@ -296,6 +336,17 @@ class _Parser:
             t = self.peek()
             raise SqlError(f"unexpected trailing token {t.value!r} at {t.pos}")
         return stmt
+
+    def table_ref(self) -> TableRef:
+        t = self.next()
+        if t.kind != "ident":
+            raise SqlError(f"expected table name at {t.pos}")
+        alias = None
+        if self.accept_kw("as"):
+            alias = str(self.next().value)
+        elif self.peek().kind == "ident":
+            alias = str(self.next().value)
+        return TableRef(t.value, alias)
 
     def select_list(self) -> List[SelectItem]:
         items = [self.select_item()]

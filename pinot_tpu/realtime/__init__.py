@@ -1,0 +1,3 @@
+from .stream import (InMemoryStream, MessageBatch, PartitionGroupConsumer,
+                     StreamConfig, StreamConsumerFactory)  # noqa: F401
+from .manager import RealtimeTableDataManager  # noqa: F401

@@ -1,0 +1,118 @@
+"""Stream ingestion SPI: partitioned consumption with integer offsets.
+
+Reference parity: pinot-spi/.../spi/stream/{StreamConsumerFactory.java,
+PartitionGroupConsumer.java, MessageBatch.java, StreamPartitionMsgOffset
+.java, StreamConfig.java} (33 files). The TPU-native SPI keeps the same
+shape at Python scale: a factory creates per-partition consumers; a
+consumer fetches MessageBatch(rows, next_offset) from a start offset;
+offsets are opaque-but-ordered ints persisted in the segment checkpoint
+state (the ZK segment-metadata analog, manager.py).
+
+InMemoryStream is the FakeStreamConsumerFactory analog (pinot-core test
+fixture pattern, SURVEY.md section 4.6) and doubles as the bridge for any
+in-process producer. Kafka/Kinesis-shaped plugins implement the same two
+classes against their client libraries.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+
+@dataclass
+class StreamConfig:
+    topic: str
+    num_partitions: int = 1
+    # segment sealing thresholds (realtime.segment.flush.threshold.* analog)
+    flush_threshold_rows: int = 100_000
+    flush_threshold_seconds: float = 3600.0
+    consumer_factory: Optional["StreamConsumerFactory"] = None
+
+
+@dataclass
+class MessageBatch:
+    rows: List[Mapping[str, Any]]
+    next_offset: int
+
+    @property
+    def message_count(self) -> int:
+        return len(self.rows)
+
+
+class PartitionGroupConsumer:
+    """One partition's consumer (PartitionGroupConsumer.java)."""
+
+    def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
+        raise NotImplementedError
+
+    def latest_offset(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StreamConsumerFactory:
+    """Creates per-partition consumers (StreamConsumerFactory.java)."""
+
+    def num_partitions(self) -> int:
+        raise NotImplementedError
+
+    def create_consumer(self, partition: int) -> PartitionGroupConsumer:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# in-memory stream (FakeStream analog + in-process producer bridge)
+# ---------------------------------------------------------------------------
+
+class _Partition:
+    def __init__(self):
+        self.rows: List[Mapping[str, Any]] = []
+        self.lock = threading.Lock()
+
+
+class InMemoryStream(StreamConsumerFactory):
+    def __init__(self, num_partitions: int = 1,
+                 partitioner: Optional[Callable[[Mapping[str, Any]], int]]
+                 = None):
+        self._partitions = [_Partition() for _ in range(num_partitions)]
+        self._partitioner = partitioner
+
+    def num_partitions(self) -> int:
+        return len(self._partitions)
+
+    def produce(self, row: Mapping[str, Any],
+                partition: Optional[int] = None) -> int:
+        if partition is None:
+            if self._partitioner is not None:
+                partition = self._partitioner(row) % len(self._partitions)
+            else:
+                partition = 0
+        p = self._partitions[partition]
+        with p.lock:
+            p.rows.append(dict(row))
+            return len(p.rows) - 1
+
+    def produce_many(self, rows: Sequence[Mapping[str, Any]],
+                     partition: Optional[int] = None) -> None:
+        for r in rows:
+            self.produce(r, partition)
+
+    def create_consumer(self, partition: int) -> "_InMemoryConsumer":
+        return _InMemoryConsumer(self._partitions[partition])
+
+
+class _InMemoryConsumer(PartitionGroupConsumer):
+    def __init__(self, partition: _Partition):
+        self._p = partition
+
+    def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
+        with self._p.lock:
+            rows = self._p.rows[start_offset: start_offset + max_messages]
+            return MessageBatch(list(rows), start_offset + len(rows))
+
+    def latest_offset(self) -> int:
+        with self._p.lock:
+            return len(self._p.rows)

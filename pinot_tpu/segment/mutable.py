@@ -1,0 +1,187 @@
+"""Mutable (consuming) segment: row-append buffers, queryable snapshots.
+
+Reference parity: pinot-segment-local/.../indexsegment/mutable/
+MutableSegmentImpl.java:119 (1364 lines — concurrently-readable in-memory
+segment built row-by-row; index(GenericRow) at :488). TPU-native stance:
+the consuming segment is a HOST structure (growing numpy buffers with
+capacity doubling) queried through the vectorized host path — fresh rows
+are few relative to sealed data, so chasing device residency for them
+buys nothing; on seal the rows flow through SegmentBuilder into the same
+immutable format every other segment uses (sorted dictionaries, minimal
+widths) and become device-resident like any offline segment. That mirrors
+Pinot's CONSUMING -> ONLINE conversion exactly.
+
+Readers never lock writers: index() appends under a lock; snapshot()
+captures (buffers, count) pairs — numpy buffers only grow, so rows
+[0, count) are immutable once visible.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from ..spi.config import TableConfig
+from ..spi.schema import DataType, FieldSpec, Schema
+from .builder import SegmentBuilder
+from .dictionary import Dictionary
+
+_INITIAL_CAPACITY = 4096
+
+
+class _MutableColumn:
+    def __init__(self, spec: FieldSpec):
+        self.spec = spec
+        self.is_string = (spec.data_type == DataType.STRING
+                          or not spec.data_type.is_numeric)
+        if self.is_string:
+            self.values: Any = np.empty(_INITIAL_CAPACITY, dtype=object)
+        else:
+            self.values = np.zeros(_INITIAL_CAPACITY,
+                                   dtype=spec.data_type.np_dtype)
+        self.nulls = np.zeros(_INITIAL_CAPACITY, dtype=bool)
+        self.any_nulls = False
+
+    def ensure(self, capacity: int) -> None:
+        if capacity <= len(self.values):
+            return
+        new_cap = len(self.values)
+        while new_cap < capacity:
+            new_cap *= 2
+        nv = (np.empty(new_cap, dtype=object) if self.is_string
+              else np.zeros(new_cap, dtype=self.values.dtype))
+        nv[: len(self.values)] = self.values
+        nn = np.zeros(new_cap, dtype=bool)
+        nn[: len(self.nulls)] = self.nulls
+        self.values, self.nulls = nv, nn
+
+    def append(self, i: int, v: Any) -> None:
+        if v is None:
+            self.nulls[i] = True
+            self.any_nulls = True
+            v = self.spec.null_value()
+        if self.is_string:
+            self.values[i] = str(v)
+        else:
+            if self.spec.data_type == DataType.BOOLEAN and isinstance(
+                    v, (bool, str)):
+                v = 1 if v in (True, "true", "True", 1) else 0
+            self.values[i] = v
+
+
+class MutableSegment:
+    def __init__(self, schema: Schema, name: str,
+                 table_config: Optional[TableConfig] = None):
+        self.schema = schema
+        self.name = name
+        self.table_config = table_config or TableConfig(schema.name)
+        self._cols: Dict[str, _MutableColumn] = {
+            f.name: _MutableColumn(f) for f in schema.fields}
+        self._count = 0
+        self._lock = threading.Lock()
+        self.start_offset: Optional[int] = None
+        self.created_at = None
+        self.sealed_docs = 0  # set by seal(); authoritative for offsets
+
+    @property
+    def n_docs(self) -> int:
+        return self._count
+
+    # -- write path --------------------------------------------------------
+    def index(self, row: Mapping[str, Any]) -> None:
+        """Append one row (MutableSegmentImpl.index analog)."""
+        with self._lock:
+            i = self._count
+            for name, col in self._cols.items():
+                col.ensure(i + 1)
+                col.append(i, row.get(name))
+            self._count = i + 1  # publish after the row is fully written
+
+    def index_batch(self, rows) -> int:
+        for r in rows:
+            self.index(r)
+        return self._count
+
+    # -- read path ---------------------------------------------------------
+    def snapshot(self) -> "MutableSegmentView":
+        with self._lock:
+            n = self._count
+            cols = {name: (c.values, c.nulls, c.any_nulls)
+                    for name, c in self._cols.items()}
+        return MutableSegmentView(self, n, cols)
+
+    # -- seal --------------------------------------------------------------
+    def seal(self, out_dir: str, segment_name: Optional[str] = None) -> str:
+        """Build the immutable segment directory from the current rows
+        (CONSUMING -> ONLINE conversion; RealtimeSegmentConverter analog).
+        The row count actually sealed is published as self.sealed_docs —
+        offset accounting MUST use it, not a later read of n_docs (rows
+        indexed concurrently with the build are not in the artifact)."""
+        with self._lock:
+            n = self._count
+        self.sealed_docs = n
+        columns: Dict[str, Any] = {}
+        for name, c in self._cols.items():
+            if c.any_nulls and c.nulls[:n].any():
+                arr = np.empty(n, dtype=object)
+                arr[:] = c.values[:n]
+                arr[c.nulls[:n]] = None
+                columns[name] = arr
+            else:
+                columns[name] = c.values[:n].copy()
+        builder = SegmentBuilder(self.schema, self.table_config)
+        return builder.build(columns, out_dir, segment_name or self.name)
+
+
+class _ViewColumnMeta:
+    """Planner/host-path column metadata for a consuming snapshot: no
+    dictionary, no min/max (no constant folding against moving data)."""
+
+    def __init__(self, spec: FieldSpec, any_nulls: bool):
+        self.name = spec.name
+        self.data_type = spec.data_type
+        self.field_type = spec.field_type.value
+        self.encoding = "RAW"
+        self.cardinality = 0
+        self.is_sorted = False
+        self.min = None
+        self.max = None
+        self.has_nulls = any_nulls
+        self.partitions = None
+
+    @property
+    def has_dict(self) -> bool:
+        return False
+
+
+class MutableSegmentView:
+    """Immutable row-range view over a consuming segment; implements the
+    host-path segment protocol (raw_values/null_mask/columns/schema).
+    is_mutable routes the planner straight to the host path."""
+
+    is_mutable = True
+
+    def __init__(self, parent: MutableSegment, n: int,
+                 cols: Dict[str, Tuple[np.ndarray, np.ndarray, bool]]):
+        self.parent = parent
+        self.name = parent.name
+        self.schema = parent.schema
+        self.n_docs = n
+        self._cols = cols
+        self.columns: Dict[str, _ViewColumnMeta] = {
+            f.name: _ViewColumnMeta(f, cols[f.name][2])
+            for f in parent.schema.fields}
+
+    def raw_values(self, col: str) -> np.ndarray:
+        vals, _, _ = self._cols[col]
+        return vals[: self.n_docs]
+
+    def null_mask(self, col: str) -> Optional[np.ndarray]:
+        vals, nulls, any_nulls = self._cols[col]
+        if not any_nulls:
+            return None
+        return nulls[: self.n_docs]
+
+    def dictionary(self, col: str) -> Optional[Dictionary]:
+        return None

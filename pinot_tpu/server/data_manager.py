@@ -20,6 +20,7 @@ class TableDataManager:
         self.table_name = table_name
         self._segments: Dict[str, ImmutableSegment] = {}
         self._lock = threading.Lock()
+        self._schema = None
         # optional mesh-resident DistributedTable (parallel/distributed.py);
         # the broker prefers it for kernel-plan aggregations
         self.distributed = None
@@ -57,6 +58,20 @@ class TableDataManager:
 
     def acquire_segments(self) -> List[ImmutableSegment]:
         return list(self._segments.values())
+
+    @property
+    def schema(self):
+        """Table schema: the declared one if set (realtime managers set it
+        at construction), else derived from any loaded segment."""
+        if self._schema is not None:
+            return self._schema
+        for s in self._segments.values():
+            return s.schema
+        return None
+
+    @schema.setter
+    def schema(self, value) -> None:
+        self._schema = value
 
     @property
     def num_segments(self) -> int:

@@ -1,0 +1,282 @@
+"""Multi-stage engine tests: joins over a mini star schema.
+
+Reference analog: pinot-query-runtime ResourceBasedQueriesTest (JSON query
+suites against in-process servers) — here a fact table + two dimension
+tables, queries through the full broker path, oracle = hand-joined numpy.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.query.sql import SqlError, parse_sql
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+N_ORDERS = 3000
+
+
+@pytest.fixture(scope="module")
+def star(tmp_path_factory):
+    rng = np.random.default_rng(5)
+    out = tmp_path_factory.mktemp("star")
+
+    cust_ids = np.arange(100)
+    cust = {
+        "c_id": cust_ids.astype(np.int32),
+        "c_nation": rng.choice(["us", "de", "jp", "br"], 100),
+        "c_active": rng.integers(0, 2, 100).astype(np.int32),
+    }
+    part_ids = np.arange(40)
+    part = {
+        "p_id": part_ids.astype(np.int32),
+        "p_brand": rng.choice(["acme", "blitz", "corex"], 40),
+    }
+    orders = {
+        "o_cust": rng.choice(cust_ids, N_ORDERS).astype(np.int32),
+        "o_part": rng.choice(part_ids, N_ORDERS).astype(np.int32),
+        "o_qty": rng.integers(1, 20, N_ORDERS).astype(np.int32),
+        "o_price": rng.integers(10, 5000, N_ORDERS).astype(np.int64),
+    }
+
+    def build(name, cols, fields, n_segments=1):
+        schema = Schema(name, fields)
+        b = SegmentBuilder(schema, TableConfig(name))
+        dm = TableDataManager(name)
+        n = len(next(iter(cols.values())))
+        bounds = np.linspace(0, n, n_segments + 1).astype(int)
+        for i in range(n_segments):
+            chunk = {k: v[bounds[i]:bounds[i + 1]] for k, v in cols.items()}
+            dm.add_segment_dir(b.build(chunk, str(out / name), f"s{i}"))
+        return dm
+
+    broker = Broker()
+    broker.register_table(build("customers", cust, [
+        FieldSpec("c_id", DataType.INT),
+        FieldSpec("c_nation", DataType.STRING),
+        FieldSpec("c_active", DataType.INT),
+    ]))
+    broker.register_table(build("parts", part, [
+        FieldSpec("p_id", DataType.INT),
+        FieldSpec("p_brand", DataType.STRING),
+    ]))
+    broker.register_table(build("orders", orders, [
+        FieldSpec("o_cust", DataType.INT),
+        FieldSpec("o_part", DataType.INT),
+        FieldSpec("o_qty", DataType.INT, FieldType.METRIC),
+        FieldSpec("o_price", DataType.LONG, FieldType.METRIC),
+    ], n_segments=3))
+    return broker, cust, part, orders
+
+
+def _join_oracle(orders, cust, part):
+    """Row-expanded join arrays keyed by order row."""
+    c_idx = orders["o_cust"]          # c_id == index
+    p_idx = orders["o_part"]
+    return {
+        "c_nation": cust["c_nation"][c_idx],
+        "c_active": cust["c_active"][c_idx],
+        "p_brand": part["p_brand"][p_idx],
+        **orders,
+    }
+
+
+def test_parse_join():
+    s = parse_sql("SELECT a.x FROM t1 a JOIN t2 b ON a.k = b.k "
+                  "LEFT JOIN t3 c ON b.j = c.j WHERE a.x > 1")
+    assert s.table == "t1" and s.table_alias == "a"
+    assert [j.join_type for j in s.joins] == ["inner", "left"]
+
+
+def test_inner_join_group_by(star):
+    broker, cust, part, orders = star
+    res = broker.query(
+        "SELECT c.c_nation, SUM(o.o_price), COUNT(*) FROM orders o "
+        "JOIN customers c ON o.o_cust = c.c_id "
+        "GROUP BY c.c_nation ORDER BY c.c_nation LIMIT 10")
+    j = _join_oracle(orders, cust, part)
+    expected = sorted(
+        (n, int(j["o_price"][j["c_nation"] == n].sum()),
+         int((j["c_nation"] == n).sum()))
+        for n in np.unique(cust["c_nation"]))
+    assert [tuple(r) for r in res.rows] == expected
+
+
+def test_join_filter_pushdown_and_post_filter(star):
+    broker, cust, part, orders = star
+    res = broker.query(
+        "SELECT SUM(o.o_qty) FROM orders o "
+        "JOIN customers c ON o.o_cust = c.c_id "
+        "WHERE c.c_active = 1 AND o.o_price > 1000 AND c.c_nation = 'us'")
+    j = _join_oracle(orders, cust, part)
+    m = (j["c_active"] == 1) & (j["o_price"] > 1000) & (j["c_nation"] == "us")
+    assert [tuple(r) for r in res.rows] == [(int(j["o_qty"][m].sum()),)]
+
+
+def test_three_way_join(star):
+    broker, cust, part, orders = star
+    res = broker.query(
+        "SELECT c.c_nation, p.p_brand, SUM(o.o_price) FROM orders o "
+        "JOIN customers c ON o.o_cust = c.c_id "
+        "JOIN parts p ON o.o_part = p.p_id "
+        "WHERE p.p_brand != 'corex' "
+        "GROUP BY c.c_nation, p.p_brand ORDER BY c.c_nation, p.p_brand "
+        "LIMIT 100")
+    j = _join_oracle(orders, cust, part)
+    keys = sorted({(n, b) for n, b in zip(j["c_nation"], j["p_brand"])
+                   if b != "corex"})
+    expected = []
+    for n, b in keys:
+        m = (j["c_nation"] == n) & (j["p_brand"] == b)
+        expected.append((n, b, int(j["o_price"][m].sum())))
+    assert [tuple(r) for r in res.rows] == expected
+
+
+def test_join_selection_order_by(star):
+    broker, cust, part, orders = star
+    res = broker.query(
+        "SELECT o.o_price, c.c_nation FROM orders o "
+        "JOIN customers c ON o.o_cust = c.c_id "
+        "ORDER BY o.o_price DESC LIMIT 3")
+    j = _join_oracle(orders, cust, part)
+    order = np.argsort(-j["o_price"], kind="stable")[:3]
+    expected = [(int(j["o_price"][i]), j["c_nation"][i]) for i in order]
+    assert [tuple(r) for r in res.rows] == expected
+
+
+def test_left_join_preserves_unmatched(tmp_path):
+    lschema = Schema("lt", [FieldSpec("k", DataType.INT),
+                            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+    rschema = Schema("rt", [FieldSpec("k", DataType.INT),
+                            FieldSpec("tag", DataType.STRING)])
+    lb = SegmentBuilder(lschema, TableConfig("lt"))
+    rb = SegmentBuilder(rschema, TableConfig("rt"))
+    ldm = TableDataManager("lt")
+    ldm.add_segment_dir(lb.build(
+        {"k": np.array([1, 2, 3], np.int32),
+         "v": np.array([10, 20, 30], np.int32)}, str(tmp_path / "lt"), "s0"))
+    rdm = TableDataManager("rt")
+    rdm.add_segment_dir(rb.build(
+        {"k": np.array([2], np.int32),
+         "tag": np.array(["two"], object)}, str(tmp_path / "rt"), "s0"))
+    b = Broker()
+    b.register_table(ldm)
+    b.register_table(rdm)
+    res = b.query("SELECT l.k, l.v, r.tag FROM lt l "
+                  "LEFT JOIN rt r ON l.k = r.k ORDER BY l.k")
+    assert [tuple(r) for r in res.rows] == [
+        (1, 10, "null"), (2, 20, "two"), (3, 30, "null")]
+    # COUNT preserves all left rows
+    res = b.query("SELECT COUNT(*) FROM lt l LEFT JOIN rt r ON l.k = r.k")
+    assert [tuple(r) for r in res.rows] == [(3,)]
+    # IS NULL sees the join-null mask
+    res = b.query("SELECT COUNT(*) FROM lt l LEFT JOIN rt r ON l.k = r.k "
+                  "WHERE r.tag IS NULL")
+    assert [tuple(r) for r in res.rows] == [(2,)]
+
+
+def test_duplicate_join_keys_expand(tmp_path):
+    lschema = Schema("dl", [FieldSpec("k", DataType.INT)])
+    rschema = Schema("dr", [FieldSpec("k", DataType.INT),
+                            FieldSpec("x", DataType.INT, FieldType.METRIC)])
+    ldm = TableDataManager("dl")
+    ldm.add_segment_dir(SegmentBuilder(lschema, TableConfig("dl")).build(
+        {"k": np.array([1, 1, 2], np.int32)}, str(tmp_path / "dl"), "s0"))
+    rdm = TableDataManager("dr")
+    rdm.add_segment_dir(SegmentBuilder(rschema, TableConfig("dr")).build(
+        {"k": np.array([1, 1, 3], np.int32),
+         "x": np.array([5, 7, 9], np.int32)}, str(tmp_path / "dr"), "s0"))
+    b = Broker()
+    b.register_table(ldm)
+    b.register_table(rdm)
+    # 2 left rows with k=1 x 2 right rows with k=1 = 4 result rows
+    res = b.query("SELECT COUNT(*), SUM(r.x) FROM dl l "
+                  "JOIN dr r ON l.k = r.k")
+    assert [tuple(r) for r in res.rows] == [(4, 24)]
+
+
+def test_ambiguous_and_unknown_columns(star):
+    broker, *_ = star
+    with pytest.raises(SqlError):
+        broker.query("SELECT nope FROM orders o "
+                     "JOIN customers c ON o.o_cust = c.c_id LIMIT 1")
+    with pytest.raises(SqlError):
+        broker.query("SELECT COUNT(*) FROM orders o JOIN customers c "
+                     "ON o.o_cust = c.c_id JOIN parts p ON o.o_part = p.p_id "
+                     "WHERE x.bad = 1")
+
+
+def test_cross_join_rejected(star):
+    broker, *_ = star
+    with pytest.raises(SqlError):
+        broker.query("SELECT COUNT(*) FROM orders o "
+                     "JOIN customers c ON o.o_qty > c.c_active")
+
+
+def test_hash_shuffle_join_path(star, monkeypatch):
+    """Force the HashExchange partitioned join (right side above the
+    broadcast threshold) and check identical results."""
+    import pinot_tpu.multistage.executor as ex
+    broker, cust, part, orders = star
+    sql = ("SELECT c.c_nation, SUM(o.o_price) FROM orders o "
+           "JOIN customers c ON o.o_cust = c.c_id "
+           "GROUP BY c.c_nation ORDER BY c.c_nation LIMIT 10")
+    baseline = broker.query(sql).rows
+    monkeypatch.setattr(ex, "BROADCAST_THRESHOLD", 0)
+    shuffled = broker.query(sql).rows
+    assert shuffled == baseline
+
+
+def test_inner_requires_join_keyword():
+    with pytest.raises(SqlError):
+        parse_sql("SELECT a.x FROM t1 a INNER t2 b ON a.k = b.k")
+
+
+def test_null_join_keys_never_match(tmp_path):
+    """SQL semantics: NULL = NULL is not a match."""
+    ls = Schema("na", [FieldSpec("k", DataType.INT),
+                       FieldSpec("v", DataType.INT, FieldType.METRIC)])
+    rs = Schema("nb", [FieldSpec("k", DataType.INT),
+                       FieldSpec("x", DataType.INT, FieldType.METRIC)])
+    ldm = TableDataManager("na")
+    ldm.add_segment_dir(SegmentBuilder(ls, TableConfig("na")).build(
+        [{"k": 1, "v": 10}, {"k": None, "v": 20}], str(tmp_path / "na"),
+        "s0"))
+    rdm = TableDataManager("nb")
+    rdm.add_segment_dir(SegmentBuilder(rs, TableConfig("nb")).build(
+        [{"k": 1, "x": 100}, {"k": None, "x": 200}], str(tmp_path / "nb"),
+        "s0"))
+    b = Broker()
+    b.register_table(ldm)
+    b.register_table(rdm)
+    res = b.query("SELECT COUNT(*) FROM na a JOIN nb b2 ON a.k = b2.k")
+    assert [tuple(r) for r in res.rows] == [(1,)]  # only k=1 matches
+    # LEFT: the NULL-key left row survives, null-extended
+    res = b.query("SELECT a.v, b2.x FROM na a LEFT JOIN nb b2 "
+                  "ON a.k = b2.k ORDER BY a.v")
+    assert [tuple(r) for r in res.rows] == [(10, 100), (20, 0)]
+
+
+def test_left_join_non_equi_on_null_extends(tmp_path):
+    """LEFT JOIN rows failing a non-equi ON conjunct are null-extended,
+    not dropped."""
+    ls = Schema("ne1", [FieldSpec("k", DataType.INT)])
+    rs = Schema("ne2", [FieldSpec("k", DataType.INT),
+                        FieldSpec("w", DataType.INT, FieldType.METRIC),
+                        FieldSpec("tag", DataType.STRING)])
+    ldm = TableDataManager("ne1")
+    ldm.add_segment_dir(SegmentBuilder(ls, TableConfig("ne1")).build(
+        {"k": np.array([1, 2, 3], np.int32)}, str(tmp_path / "ne1"), "s0"))
+    rdm = TableDataManager("ne2")
+    rdm.add_segment_dir(SegmentBuilder(rs, TableConfig("ne2")).build(
+        {"k": np.array([1, 2], np.int32), "w": np.array([3, 9], np.int32),
+         "tag": np.array(["a", "b"], object)}, str(tmp_path / "ne2"), "s0"))
+    b = Broker()
+    b.register_table(ldm)
+    b.register_table(rdm)
+    res = b.query("SELECT l.k, r.tag FROM ne1 l LEFT JOIN ne2 r "
+                  "ON l.k = r.k AND r.w > 5 ORDER BY l.k")
+    # k=1 matched the key but failed w>5 -> null-extended, NOT dropped
+    assert [tuple(r) for r in res.rows] == [
+        (1, "null"), (2, "b"), (3, "null")]
