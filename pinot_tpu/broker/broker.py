@@ -64,16 +64,28 @@ class Broker:
                 result.time_ms = (time.perf_counter() - t0) * 1e3
                 return result
 
-        partials = []
-        pruned = 0
-        docs_scanned = 0
-        for seg in segments:
-            plan = SegmentPlanner(ctx, seg).plan()
-            if plan.kind == "pruned":
-                pruned += 1
-            partials.append(execute_plan(plan))
-            if plan.kind in ("kernel", "host"):
-                docs_scanned += seg.n_docs
+        # star-tree analog: segments with a matching rollup answer from the
+        # pre-aggregation (StarTreeUtils swap-in)
+        from ..startree.query import try_rollup_execute
+        plans = []
+        precomputed = {}
+        for i, seg in enumerate(segments):
+            partial = (try_rollup_execute(ctx, seg)
+                       if hasattr(seg, "metadata") else None)
+            if partial is not None:
+                precomputed[i] = partial
+                plans.append(None)
+            else:
+                plans.append(SegmentPlanner(ctx, seg).plan())
+        real_plans = [p for p in plans if p is not None]
+        pruned = sum(1 for p in real_plans if p.kind == "pruned")
+        docs_scanned = sum(p.segment.n_docs for p in real_plans
+                           if p.kind in ("kernel", "host"))
+        # one vmapped device dispatch per plan shape (combine-operator analog)
+        from ..engine.batch import execute_plans_batched
+        executed = iter(execute_plans_batched(real_plans))
+        partials = [precomputed[i] if p is None else next(executed)
+                    for i, p in enumerate(plans)]
 
         result = reduce_partials(ctx, partials)
         result.num_segments = len(segments)

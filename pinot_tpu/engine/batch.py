@@ -1,0 +1,100 @@
+"""Batched segment execution: one device dispatch for many segments.
+
+Reference parity: pinot-core/.../operator/combine/BaseCombineOperator
+.java:83,99-117 — Pinot runs one task per segment on a thread pool and
+merges. TPU-native: segments sharing a plan structure, bucket, and param
+signature jit ONE vmapped kernel and launch ONCE — jax.vmap over the
+stacked (n_segments, bucket) columns replaces the thread pool, and the
+fixed per-execution dispatch cost (~65ms RPC floor on tunneled TPUs) is
+paid once per query instead of once per segment. Per-segment partials are
+sliced out of the stacked outputs host-side, so per-segment dictionaries
+stay correct (unlike parallel/distributed.py, which requires shared
+dictionaries in exchange for on-device psum combine).
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.kernels import build_kernel
+from ..query.planner import CompiledPlan
+from .executor import execute_plan, extract_partial, resolve_params
+
+# stacked-column cache: (segment names, cols, bucket) -> tuple of stacked
+# device arrays; bounded LRU since segment sets change under realtime
+_STACK_CACHE: "OrderedDict[Tuple, Tuple[jax.Array, ...]]" = OrderedDict()
+_STACK_CACHE_MAX = 32
+
+
+@functools.lru_cache(maxsize=512)
+def _vmapped_kernel(plan_struct, bucket: int):
+    return jax.jit(jax.vmap(build_kernel(plan_struct, bucket)))
+
+
+def _param_sig(params: Tuple[jax.Array, ...]) -> Tuple:
+    return tuple((tuple(p.shape), str(p.dtype)) for p in params)
+
+
+def _stacked_cols(plans: List[CompiledPlan], bucket: int
+                  ) -> Tuple[jax.Array, ...]:
+    key = (tuple(p.segment.name for p in plans),
+           tuple(plans[0].col_names), bucket)
+    hit = _STACK_CACHE.get(key)
+    if hit is not None:
+        _STACK_CACHE.move_to_end(key)
+        return hit
+    cols = tuple(
+        jnp.stack([p.segment.device_col(c, bucket) for p in plans])
+        for c in plans[0].col_names)
+    _STACK_CACHE[key] = cols
+    if len(_STACK_CACHE) > _STACK_CACHE_MAX:
+        _STACK_CACHE.popitem(last=False)
+    return cols
+
+
+def evict_stacks_containing(segment_name: str) -> None:
+    """Drop stacked copies that include a segment (called from
+    ImmutableSegment.evict_device so eviction actually frees HBM)."""
+    for key in [k for k in _STACK_CACHE if segment_name in k[0]]:
+        del _STACK_CACHE[key]
+
+
+def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
+    """Execute all plans; kernel plans with matching structure run in one
+    vmapped dispatch. Returns partials in input order."""
+    results: List[Any] = [None] * len(plans)
+    groups: Dict[Tuple, List[int]] = {}
+    resolved: Dict[int, Tuple[jax.Array, ...]] = {}
+
+    for i, plan in enumerate(plans):
+        if plan.kind != "kernel":
+            results[i] = execute_plan(plan)
+            continue
+        params = resolve_params(plan)
+        resolved[i] = params
+        key = (plan.kernel_plan, plan.segment.bucket, _param_sig(params))
+        groups.setdefault(key, []).append(i)
+
+    for (plan_struct, bucket, _sig), idxs in groups.items():
+        if len(idxs) == 1:
+            i = idxs[0]
+            results[i] = execute_plan(plans[i])
+            continue
+        group_plans = [plans[i] for i in idxs]
+        cols = _stacked_cols(group_plans, bucket)
+        n_docs = jnp.asarray([p.segment.n_docs for p in group_plans],
+                             dtype=jnp.int32)
+        params = tuple(
+            jnp.stack([resolved[i][j] for i in idxs])
+            for j in range(len(resolved[idxs[0]])))
+        fn = _vmapped_kernel(plan_struct, bucket)
+        out = jax.device_get(fn(cols, n_docs, params))
+        for k, i in enumerate(idxs):
+            per_seg = {name: v[k] for name, v in out.items()}
+            results[i] = extract_partial(plans[i], per_seg)
+    return results

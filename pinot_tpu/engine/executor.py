@@ -76,6 +76,11 @@ def execute_plan(plan: CompiledPlan):
         return AggPartial(list(plan.fast_states))
     if plan.kind == "host":
         mask = host_eval.eval_filter(ctx.filter, seg)
+        vd = getattr(seg, "valid_docs", None)
+        if vd is not None:
+            from ..query.planner import _truthy
+            if not _truthy(ctx.options.get("skipUpsert")):
+                mask = mask & vd[: seg.n_docs]
         if ctx.is_group_by:
             return GroupByPartial(host_eval.host_group_by(ctx, seg, mask))
         if ctx.is_aggregation:
@@ -97,6 +102,8 @@ def resolve_params(plan: CompiledPlan) -> Tuple[jax.Array, ...]:
             out.append(seg.device_dict_values(p[1]))
         elif isinstance(p, tuple) and len(p) == 2 and p[0] == "nullmask":
             out.append(seg.device_null_mask(p[1]))
+        elif isinstance(p, tuple) and len(p) == 2 and p[0] == "validdocs":
+            out.append(seg.device_valid_mask())
         else:
             out.append(jax.device_put(p))
     return tuple(out)

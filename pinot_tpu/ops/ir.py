@@ -106,10 +106,15 @@ class Cmp(Pred):
 
 
 @dataclass(frozen=True)
-class IsNull(Pred):
-    """Null check; null_param indexes a device bool mask param (unpacked
-    null bitmap). NullPredicateEvaluator analog."""
-    null_param: int
+class MaskParam(Pred):
+    """A precomputed per-doc bool mask passed as a kernel param. Serves
+    null checks (NullPredicateEvaluator analog: params hold the unpacked
+    null bitmap) and upsert validDocIds (queryableDocIds in the reference's
+    upsert path — pinot-segment-local/.../upsert/)."""
+    param: int
+
+
+IsNull = MaskParam  # historical alias
 
 
 @dataclass(frozen=True)

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from .ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange, InSet,
-                 IsNull, KernelPlan, Lit, Not, Or, Pred, TrueP, ValueExpr)
+                 KernelPlan, Lit, MaskParam, Not, Or, Pred, TrueP, ValueExpr)
 
 # unrolled masked-reduce limit for group MIN/MAX (no matmul form exists;
 # above this the planner routes to segment ops on CPU or the host path)
@@ -145,8 +145,8 @@ def _eval_pred(p: Pred, cols, params, bucket: int) -> jax.Array:
         if p.op == ">=":
             return l >= r
         raise ValueError(f"unknown cmp op {p.op!r}")
-    if isinstance(p, IsNull):
-        return params[p.null_param]
+    if isinstance(p, MaskParam):
+        return params[p.param]
     if isinstance(p, And):
         m = _eval_pred(p.children[0], cols, params, bucket)
         for c in p.children[1:]:

@@ -106,6 +106,12 @@ class DistributedTable:
                 m.has_nulls = m.has_nulls or m2.has_nulls
                 m.is_sorted = m.is_sorted and m2.is_sorted
             view.columns[name] = m
+        # ANY segment with upsert-invalidated docs forces the validdocs
+        # param into the plan (-> try_execute falls back to the per-segment
+        # path), not just segment 0
+        view.valid_docs = next(
+            (s.valid_docs for s in self.segments
+             if getattr(s, "valid_docs", None) is not None), None)
         return view
 
     # -- sharded residency -------------------------------------------------
@@ -142,9 +148,9 @@ class DistributedTable:
         plan = self.plan(ctx)
         if plan.kind != "kernel":
             return None
-        if any(isinstance(p, tuple) and p[0] == "nullmask"
+        if any(isinstance(p, tuple) and p[0] in ("nullmask", "validdocs")
                for p in plan.params):
-            return None
+            return None  # per-segment data params need the per-segment path
         out = self._run(plan)
         return extract_partial(plan, out)
 

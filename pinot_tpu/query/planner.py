@@ -43,6 +43,12 @@ class PlanError(SqlError):
     pass
 
 
+def _truthy(v: Any) -> bool:
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes")
+    return bool(v)
+
+
 # ---------------------------------------------------------------------------
 # plan kinds
 # ---------------------------------------------------------------------------
@@ -540,6 +546,14 @@ class SegmentPlanner:
         pred = self.resolve_filter(ctx.filter)
         if isinstance(pred, FalseP) :
             return CompiledPlan("pruned", seg, ctx)
+
+        # upsert validDocIds: fold the segment's valid mask into the filter
+        # (queryableDocIds in the reference; OPTION(skipUpsert=true) bypasses)
+        if getattr(seg, "valid_docs", None) is not None and \
+                not _truthy(ctx.options.get("skipUpsert")):
+            from ..ops.ir import MaskParam
+            pred = _simplify(And((pred, MaskParam(
+                self.b.add_param(("validdocs", None))))))
 
         # group-by feasibility
         group_cols: List[str] = []

@@ -35,6 +35,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from ..segment.immutable import ImmutableSegment
 from ..segment.mutable import MutableSegment
 from ..server.data_manager import TableDataManager
@@ -50,7 +52,8 @@ class RealtimeTableDataManager(TableDataManager):
     def __init__(self, table_name: str, schema: Schema,
                  stream_config: StreamConfig, data_dir: str,
                  table_config: Optional[TableConfig] = None,
-                 poll_interval: float = 0.02):
+                 poll_interval: float = 0.02,
+                 upsert_config=None, dedup_config=None):
         super().__init__(table_name)
         self.schema = schema
         self.stream_config = stream_config
@@ -66,19 +69,66 @@ class RealtimeTableDataManager(TableDataManager):
         self._stop = threading.Event()
         self._seal_lock = threading.Lock()
 
-        # restart path: re-register committed segments from the checkpoint
-        for pstate in self._state.values():
-            for seg_name in pstate["segments"]:
-                seg_dir = os.path.join(self.data_dir, seg_name)
-                if os.path.isdir(seg_dir):
-                    self.add_segment(ImmutableSegment.load(seg_dir))
+        # upsert/dedup metadata, per partition (PKs are partition-local,
+        # same contract as the reference's partition managers)
+        self._upsert: Dict[int, Any] = {}
+        self._dedup: Dict[int, Any] = {}
+        if upsert_config is not None and dedup_config is not None:
+            raise ValueError("a table is upsert or dedup, not both")
+        self.upsert_config = upsert_config
+        self.dedup_config = dedup_config
 
         factory = stream_config.consumer_factory
         if factory is None:
             raise ValueError("StreamConfig.consumer_factory is required")
-        for p in range(factory.num_partitions()):
+        n_parts = factory.num_partitions()
+        if upsert_config is not None:
+            from ..upsert import PartitionUpsertMetadataManager
+            for p in range(n_parts):
+                self._upsert[p] = PartitionUpsertMetadataManager(
+                    upsert_config)
+        if dedup_config is not None:
+            from ..upsert import PartitionDedupMetadataManager
+            for p in range(n_parts):
+                self._dedup[p] = PartitionDedupMetadataManager(dedup_config)
+
+        # restart path: re-register committed segments from the checkpoint,
+        # replaying PK metadata in commit order for upsert/dedup tables
+        for pkey, pstate in self._state.items():
+            p = int(pkey)
+            for seg_name in pstate["segments"]:
+                seg_dir = os.path.join(self.data_dir, seg_name)
+                if not os.path.isdir(seg_dir):
+                    continue
+                seg = ImmutableSegment.load(seg_dir)
+                self.add_segment(seg)
+                self._replay_metadata(p, seg)
+
+        for p in range(n_parts):
             self._partition_state(p)
             self._new_mutable(p)
+
+    def _replay_metadata(self, p: int, seg: ImmutableSegment) -> None:
+        if p in self._upsert:
+            cfg = self.upsert_config
+            pks = self._segment_pks(seg, cfg.pk_columns)
+            if cfg.comparison_column is not None:
+                cmps = list(np.asarray(
+                    seg.raw_values(cfg.comparison_column)))
+            else:
+                start = seg.metadata.get("startOffset", 0)
+                cmps = list(range(start, start + seg.n_docs))
+            seg.set_valid_docs(None)  # replay recomputes from scratch
+            self._upsert[p].replay_segment(seg, pks, cmps)
+            seg.persist_valid_docs()
+        elif p in self._dedup:
+            pks = self._segment_pks(seg, self.dedup_config.pk_columns)
+            self._dedup[p].replay_segment(seg, pks)
+
+    @staticmethod
+    def _segment_pks(seg: ImmutableSegment, pk_cols) -> List[tuple]:
+        arrays = [np.asarray(seg.raw_values(c)) for c in pk_cols]
+        return list(zip(*[a.tolist() for a in arrays]))
 
     # -- durable state (segment ZK metadata analog) ------------------------
     def _state_path(self) -> str:
@@ -137,13 +187,33 @@ class RealtimeTableDataManager(TableDataManager):
                     offset, min(FETCH_BATCH, room))
                 if not batch.rows:
                     break
-                m.index_batch(batch.rows)
+                self._index_rows(p, m, batch.rows, offset)
                 total += len(batch.rows)
                 self._maybe_seal(p)
             return total
         finally:
             if own:
                 consumer.close()
+
+    def _index_rows(self, p: int, m: MutableSegment, rows, offset: int
+                    ) -> None:
+        """Index a batch, maintaining upsert/dedup metadata per row.
+
+        Dedup'd rows are still indexed but immediately invalidated — the
+        stream offset accounting stays row = doc (the reference instead
+        skips indexing; masks make skipping unnecessary here and keep
+        offsets trivially exact)."""
+        upsert = self._upsert.get(p)
+        dedup = self._dedup.get(p)
+        if upsert is None and dedup is None:
+            m.index_batch(rows)
+            return
+        for i, row in enumerate(rows):
+            doc = m.index(row)
+            if dedup is not None and dedup.should_drop(row):
+                m.invalidate_doc(doc)
+            elif upsert is not None:
+                upsert.add_row(m, doc, row, offset + i)
 
     def _maybe_seal(self, p: int) -> None:
         m = self._mutables[p]
@@ -174,6 +244,14 @@ class RealtimeTableDataManager(TableDataManager):
                 json.dump(meta, fh, indent=1)
 
             seg = ImmutableSegment.load(seg_dir)
+            # upsert/dedup: carry the consuming segment's validDocIds into
+            # the committed artifact and repoint PK locations at it
+            valid = m.valid_mask(sealed)
+            if not valid.all():
+                seg.set_valid_docs(valid.copy())
+                seg.persist_valid_docs()
+            if p in self._upsert:
+                self._upsert[p].remap_segment(m, seg, sealed)
             self.add_segment(seg)  # atomic swap: queries see it immediately
             st["next_offset"] += sealed
             st["seq"] += 1

@@ -73,6 +73,14 @@ class ImmutableSegment:
         self._dicts: Dict[str, Dictionary] = {}
         self._nulls: Dict[str, Optional[np.ndarray]] = {}
         self._device: Dict[Tuple[str, int], jax.Array] = {}
+        # upsert validDocIds (None = all docs valid); versioned so the
+        # device-resident copy invalidates on update
+        self.valid_docs: Optional[np.ndarray] = None
+        self.valid_docs_version = 0
+        valid_path = os.path.join(seg_dir, "valid.bin")
+        if os.path.exists(valid_path):
+            bits = np.fromfile(valid_path, dtype=np.uint8)
+            self.valid_docs = np.unpackbits(bits)[: self.n_docs].astype(bool)
 
     @classmethod
     def load(cls, seg_dir: str, read_mode: str = "mmap") -> "ImmutableSegment":
@@ -177,8 +185,39 @@ class ImmutableSegment:
             self._device[key] = jax.device_put(padded)
         return self._device[key]
 
+    def set_valid_docs(self, mask: Optional[np.ndarray]) -> None:
+        self.valid_docs = mask
+        self.valid_docs_version += 1
+        # drop stale device copies
+        for key in [k for k in self._device if k[0].startswith("__valid__")]:
+            del self._device[key]
+
+    def persist_valid_docs(self) -> None:
+        """Snapshot validDocIds next to the segment (upsert snapshot analog,
+        pinot-segment-local/.../upsert/ validDocIds persistence)."""
+        path = os.path.join(self.dir, "valid.bin")
+        if self.valid_docs is None:
+            if os.path.exists(path):
+                os.remove(path)
+            return
+        np.packbits(self.valid_docs).tofile(path)
+
+    def device_valid_mask(self, bucket: Optional[int] = None) -> jax.Array:
+        bucket = bucket or self.bucket
+        key = (f"__valid__v{self.valid_docs_version}", bucket)
+        if key not in self._device:
+            padded = np.zeros(bucket, dtype=bool)
+            if self.valid_docs is not None:
+                padded[: self.n_docs] = self.valid_docs
+            else:
+                padded[: self.n_docs] = True
+            self._device[key] = jax.device_put(padded)
+        return self._device[key]
+
     def evict_device(self) -> None:
         self._device.clear()
+        from ..engine.batch import evict_stacks_containing
+        evict_stacks_containing(self.name)
 
     def __repr__(self) -> str:
         return (f"ImmutableSegment({self.name!r}, docs={self.n_docs}, "

@@ -83,20 +83,35 @@ class MutableSegment:
         self.start_offset: Optional[int] = None
         self.created_at = None
         self.sealed_docs = 0  # set by seal(); authoritative for offsets
+        # upsert validDocIds over consuming rows (all-true when not upsert)
+        self._valid = np.ones(_INITIAL_CAPACITY, dtype=bool)
 
     @property
     def n_docs(self) -> int:
         return self._count
 
     # -- write path --------------------------------------------------------
-    def index(self, row: Mapping[str, Any]) -> None:
-        """Append one row (MutableSegmentImpl.index analog)."""
+    def index(self, row: Mapping[str, Any]) -> int:
+        """Append one row; returns its doc id (MutableSegmentImpl.index)."""
         with self._lock:
             i = self._count
             for name, col in self._cols.items():
                 col.ensure(i + 1)
                 col.append(i, row.get(name))
+            if i >= len(self._valid):
+                nv = np.ones(len(self._valid) * 2, dtype=bool)
+                nv[: len(self._valid)] = self._valid
+                self._valid = nv
+            self._valid[i] = True
             self._count = i + 1  # publish after the row is fully written
+            return i
+
+    def invalidate_doc(self, doc_id: int) -> None:
+        """Upsert: an earlier row for this PK was superseded."""
+        self._valid[doc_id] = False
+
+    def valid_mask(self, n: int) -> np.ndarray:
+        return self._valid[:n]
 
     def index_batch(self, rows) -> int:
         for r in rows:
@@ -109,7 +124,8 @@ class MutableSegment:
             n = self._count
             cols = {name: (c.values, c.nulls, c.any_nulls)
                     for name, c in self._cols.items()}
-        return MutableSegmentView(self, n, cols)
+            valid = self._valid
+        return MutableSegmentView(self, n, cols, valid)
 
     # -- seal --------------------------------------------------------------
     def seal(self, out_dir: str, segment_name: Optional[str] = None) -> str:
@@ -163,12 +179,18 @@ class MutableSegmentView:
     is_mutable = True
 
     def __init__(self, parent: MutableSegment, n: int,
-                 cols: Dict[str, Tuple[np.ndarray, np.ndarray, bool]]):
+                 cols: Dict[str, Tuple[np.ndarray, np.ndarray, bool]],
+                 valid: Optional[np.ndarray] = None):
         self.parent = parent
         self.name = parent.name
         self.schema = parent.schema
         self.n_docs = n
         self._cols = cols
+        # expose upsert validDocIds only when some doc is invalidated (the
+        # all-true case keeps the common path mask-free)
+        self.valid_docs = None
+        if valid is not None and not valid[:n].all():
+            self.valid_docs = valid[:n]
         self.columns: Dict[str, _ViewColumnMeta] = {
             f.name: _ViewColumnMeta(f, cols[f.name][2])
             for f in parent.schema.fields}

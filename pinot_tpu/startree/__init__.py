@@ -1,0 +1,2 @@
+from .builder import RollupConfig, build_rollup  # noqa: F401
+from .query import try_rollup_execute  # noqa: F401

@@ -1,0 +1,3 @@
+from .metadata import (DedupConfig, PartitionDedupMetadataManager,
+                       PartitionUpsertMetadataManager,
+                       UpsertConfig)  # noqa: F401

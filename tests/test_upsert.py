@@ -1,0 +1,155 @@
+"""Upsert + dedup tests.
+
+Reference analog: UpsertTableIntegrationTest / dedup tests — latest row
+per PK wins across consuming and committed segments, validDocIds survive
+restart, skipUpsert exposes raw rows.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.realtime import (InMemoryStream, RealtimeTableDataManager,
+                                StreamConfig)
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+from pinot_tpu.upsert import DedupConfig, UpsertConfig
+
+
+@pytest.fixture
+def schema():
+    return Schema("users", [
+        FieldSpec("uid", DataType.INT),
+        FieldSpec("score", DataType.INT, FieldType.METRIC),
+        FieldSpec("ts", DataType.LONG, FieldType.METRIC),
+    ])
+
+
+def _mgr(schema, tmp_path, stream, threshold=100, upsert=None, dedup=None):
+    cfg = StreamConfig("users", num_partitions=stream.num_partitions(),
+                       flush_threshold_rows=threshold,
+                       consumer_factory=stream)
+    return RealtimeTableDataManager("users", schema, cfg, str(tmp_path),
+                                    upsert_config=upsert, dedup_config=dedup)
+
+
+def test_upsert_latest_wins_consuming(schema, tmp_path):
+    stream = InMemoryStream(1)
+    for uid, score, ts in [(1, 10, 100), (2, 20, 100), (1, 11, 200),
+                           (1, 12, 300), (2, 21, 50)]:  # last 2@ts=50 loses
+        stream.produce({"uid": uid, "score": score, "ts": ts})
+    dm = _mgr(schema, tmp_path, stream, threshold=1000,
+              upsert=UpsertConfig(["uid"], "ts"))
+    dm.consume_once(0)
+    b = Broker()
+    b.register_table(dm)
+    res = b.query("SELECT uid, score FROM users ORDER BY uid")
+    assert [tuple(r) for r in res.rows] == [(1, 12), (2, 20)]
+    res = b.query("SELECT COUNT(*), SUM(score) FROM users")
+    assert [tuple(r) for r in res.rows] == [(2, 32)]
+    # skipUpsert sees all raw rows
+    res = b.query("SELECT COUNT(*) FROM users OPTION(skipUpsert=true)")
+    assert [tuple(r) for r in res.rows] == [(5,)]
+
+
+def test_upsert_across_sealed_segments(schema, tmp_path):
+    stream = InMemoryStream(1)
+    for i in range(6):  # uids 0,1,2,0,1,2 — second batch supersedes
+        stream.produce({"uid": i % 3, "score": 100 + i, "ts": i})
+    dm = _mgr(schema, tmp_path, stream, threshold=3,
+              upsert=UpsertConfig(["uid"], "ts"))
+    dm.consume_once(0)
+    assert dm.num_segments == 2  # two sealed segments of 3
+    b = Broker()
+    b.register_table(dm)
+    res = b.query("SELECT uid, score FROM users ORDER BY uid")
+    assert [tuple(r) for r in res.rows] == [(0, 103), (1, 104), (2, 105)]
+    # the first segment is fully superseded; kernel path honors masks
+    res = b.query("SELECT SUM(score), COUNT(*) FROM users")
+    assert [tuple(r) for r in res.rows] == [(103 + 104 + 105, 3)]
+
+
+def test_upsert_restart_rehydrates(schema, tmp_path):
+    stream = InMemoryStream(1)
+    for i in range(6):
+        stream.produce({"uid": i % 3, "score": 100 + i, "ts": i})
+    dm = _mgr(schema, tmp_path, stream, threshold=3,
+              upsert=UpsertConfig(["uid"], "ts"))
+    dm.consume_once(0)
+
+    dm2 = _mgr(schema, tmp_path, stream, threshold=3,
+               upsert=UpsertConfig(["uid"], "ts"))
+    b = Broker()
+    b.register_table(dm2)
+    res = b.query("SELECT SUM(score), COUNT(*) FROM users")
+    assert [tuple(r) for r in res.rows] == [(103 + 104 + 105, 3)]
+    # new rows after restart keep superseding
+    stream.produce({"uid": 1, "score": 999, "ts": 100})
+    dm2.consume_once(0)
+    res = b.query("SELECT SUM(score), COUNT(*) FROM users")
+    assert [tuple(r) for r in res.rows] == [(103 + 999 + 105, 3)]
+
+
+def test_upsert_stream_order_wins_without_comparison_col(schema, tmp_path):
+    stream = InMemoryStream(1)
+    stream.produce({"uid": 7, "score": 1, "ts": 0})
+    stream.produce({"uid": 7, "score": 2, "ts": 0})
+    dm = _mgr(schema, tmp_path, stream, threshold=1000,
+              upsert=UpsertConfig(["uid"]))
+    dm.consume_once(0)
+    b = Broker()
+    b.register_table(dm)
+    res = b.query("SELECT score FROM users")
+    assert [tuple(r) for r in res.rows] == [(2,)]
+
+
+def test_dedup_drops_duplicates(schema, tmp_path):
+    stream = InMemoryStream(1)
+    for uid in [1, 2, 1, 3, 2, 1]:
+        stream.produce({"uid": uid, "score": uid * 10, "ts": 0})
+    dm = _mgr(schema, tmp_path, stream, threshold=4,
+              dedup=DedupConfig(["uid"]))
+    dm.consume_once(0)
+    b = Broker()
+    b.register_table(dm)
+    res = b.query("SELECT COUNT(*), SUM(score) FROM users")
+    assert [tuple(r) for r in res.rows] == [(3, 60)]
+    # restart: dedup set rehydrates, later duplicates still dropped
+    dm2 = _mgr(schema, tmp_path, stream, threshold=4,
+               dedup=DedupConfig(["uid"]))
+    stream.produce({"uid": 3, "score": 30, "ts": 0})   # dup
+    stream.produce({"uid": 4, "score": 40, "ts": 0})   # new
+    dm2.consume_once(0)
+    b2 = Broker()
+    b2.register_table(dm2)
+    res = b2.query("SELECT COUNT(*), SUM(score) FROM users")
+    assert [tuple(r) for r in res.rows] == [(4, 100)]
+
+
+def test_rollup_disabled_on_upsert_invalidated_segment(schema, tmp_path):
+    """Regression: a rollup must not answer for a segment with
+    upsert-invalidated docs (pre-aggregates include superseded rows)."""
+    import numpy as np
+    from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.startree import (RollupConfig, build_rollup,
+                                    try_rollup_execute)
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.sql import parse_sql
+    b = SegmentBuilder(schema, TableConfig("users"))
+    d = b.build({"uid": np.array([1, 2, 1], np.int32),
+                 "score": np.array([10, 20, 30], np.int32),
+                 "ts": np.array([1, 1, 2], np.int64)}, str(tmp_path), "s0")
+    seg = ImmutableSegment.load(d)
+    build_rollup(seg, RollupConfig(dims=["uid"],
+                                   metrics=[("sum", "score")]))
+    seg = ImmutableSegment.load(d)
+    ctx = build_query_context(parse_sql("SELECT COUNT(*) FROM users"))
+    assert try_rollup_execute(ctx, seg) is not None
+    seg.set_valid_docs(np.array([False, True, True]))
+    assert try_rollup_execute(ctx, seg) is None
+    dm = TableDataManager("users")
+    dm.add_segment(seg)
+    b2 = Broker()
+    b2.register_table(dm)
+    assert [tuple(r) for r in b2.query(
+        "SELECT COUNT(*), SUM(score) FROM users").rows] == [(2, 50)]
