@@ -1,1 +1,2 @@
-from .broker import Broker, Connection, connect  # noqa: F401
+from .broker import (Broker, Connection, QueryTimeoutError,  # noqa: F401
+                     connect)

@@ -3,22 +3,33 @@
 Reference parity: pinot-broker/.../requesthandler/
 BaseSingleStageBrokerRequestHandler.java (compile :256, optimize :492-521,
 route :560-577) + SingleConnectionBrokerRequestHandler.java:141-151
-(scatter-gather + reduce). Round-1 scope: in-process execution over local
-TableDataManagers (the Netty data plane of the reference is replaced by
-direct calls here and by ICI collectives in parallel/distributed.py; a
-multi-host gRPC/DCN dispatch layer arrives with the cluster roles).
+(scatter-gather + reduce) + BrokerRequestHandlerDelegate (engine pick) +
+query options (QueryOptionsUtils: timeoutMs, trace, skipUpsert) + EXPLAIN.
+In-process execution over local TableDataManagers; the HTTP cluster roles
+(cluster/broker_node.py) reuse the same reduce over remote partials, and
+ICI collectives (parallel/distributed.py) replace the Netty data plane for
+mesh-resident tables.
 """
 from __future__ import annotations
 
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 from ..engine.executor import execute_plan
 from ..engine.reduce import ResultTable, reduce_partials
 from ..query.context import build_query_context
-from ..query.planner import SegmentPlanner
+from ..query.planner import SegmentPlanner, _truthy
 from ..query.sql import SqlError, parse_sql
 from ..server.data_manager import TableDataManager
+from ..utils.metrics import global_metrics
+from ..utils.trace import Tracing
+
+DEFAULT_TIMEOUT_MS = 10_000
+
+
+class QueryTimeoutError(SqlError):
+    pass
 
 
 class Broker:
@@ -41,21 +52,49 @@ class Broker:
 
     # -- query path --------------------------------------------------------
     def query(self, sql: str) -> ResultTable:
+        global_metrics.count("broker_queries")
+        with global_metrics.timer("broker_query"):
+            try:
+                return self._query(sql)
+            except SqlError:
+                global_metrics.count("broker_query_exceptions")
+                raise
+
+    def _query(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
         if stmt.joins:
             # v2 engine (BrokerRequestHandlerDelegate picks the multi-stage
             # handler when the query needs it)
             from ..multistage import execute_multistage
+            from ..multistage.executor import explain_multistage
+            if stmt.explain:
+                return explain_multistage(self, stmt)
             return execute_multistage(self, stmt)
         ctx = build_query_context(stmt)
+        trace_on = _truthy(ctx.options.get("trace"))
+        scope = Tracing.register(uuid.uuid4().hex[:12], trace_on)
+        timeout_ms = int(ctx.options.get("timeoutMs", DEFAULT_TIMEOUT_MS))
+        deadline = t0 + timeout_ms / 1e3
+        try:
+            result = self._execute_ctx(ctx, stmt, t0, deadline)
+        finally:
+            Tracing.unregister()
+        if trace_on:
+            result.trace = scope.to_dict()
+        return result
+
+    def _execute_ctx(self, ctx, stmt, t0: float, deadline: float
+                     ) -> ResultTable:
         dm = self.table(ctx.table)
         segments = dm.acquire_segments()
 
         # mesh-resident table: one shard_map program + ICI combine replaces
         # the per-segment scatter-gather entirely
-        if dm.distributed is not None and ctx.is_aggregation:
-            partial = dm.distributed.try_execute(ctx)
+        if dm.distributed is not None and ctx.is_aggregation \
+                and not stmt.explain:
+            with Tracing.phase("distributed_execute"):
+                partial = dm.distributed.try_execute(ctx)
             if partial is not None:
                 result = reduce_partials(ctx, [partial])
                 result.num_segments = len(dm.distributed.segments)
@@ -64,33 +103,37 @@ class Broker:
                 result.time_ms = (time.perf_counter() - t0) * 1e3
                 return result
 
-        # star-tree analog: segments with a matching rollup answer from the
-        # pre-aggregation (StarTreeUtils swap-in)
-        from ..startree.query import try_rollup_execute
-        plans = []
-        precomputed = {}
-        for i, seg in enumerate(segments):
-            partial = (try_rollup_execute(ctx, seg)
-                       if hasattr(seg, "metadata") else None)
-            if partial is not None:
-                precomputed[i] = partial
-                plans.append(None)
-            else:
-                plans.append(SegmentPlanner(ctx, seg).plan())
-        real_plans = [p for p in plans if p is not None]
-        pruned = sum(1 for p in real_plans if p.kind == "pruned")
-        docs_scanned = sum(p.segment.n_docs for p in real_plans
-                           if p.kind in ("kernel", "host"))
-        # one vmapped device dispatch per plan shape (combine-operator analog)
-        from ..engine.batch import execute_plans_batched
-        executed = iter(execute_plans_batched(real_plans))
-        partials = [precomputed[i] if p is None else next(executed)
-                    for i, p in enumerate(plans)]
+        # shared plan + rollup + batched-dispatch loop (engine/serving.py)
+        from ..engine.serving import execute_planned, plan_segments
+        ex = plan_segments(ctx, segments, use_rollups=not stmt.explain)
 
-        result = reduce_partials(ctx, partials)
+        if stmt.explain:
+            from ..query.explain import explain_rows
+            cols, rows = explain_rows(ctx, ex.real_plans, ex.rollup_segments)
+            return ResultTable(cols, rows, num_segments=len(segments))
+
+        if time.perf_counter() > deadline:
+            global_metrics.count("broker_query_timeouts")
+            raise QueryTimeoutError(
+                f"query timed out during planning "
+                f"(>{int((deadline - t0) * 1e3)}ms)")
+
+        Tracing.count("numSegmentsQueried", len(segments))
+        Tracing.count("numSegmentsPruned", ex.pruned)
+        Tracing.count("numDocsScanned", ex.docs_scanned)
+
+        partials = execute_planned(ex)
+
+        if time.perf_counter() > deadline:
+            global_metrics.count("broker_query_timeouts")
+            raise QueryTimeoutError(
+                f"query timed out (>{int((deadline - t0) * 1e3)}ms)")
+
+        with Tracing.phase("reduce"):
+            result = reduce_partials(ctx, partials)
         result.num_segments = len(segments)
-        result.num_segments_pruned = pruned
-        result.num_docs_scanned = docs_scanned
+        result.num_segments_pruned = ex.pruned
+        result.num_docs_scanned = ex.docs_scanned
         result.time_ms = (time.perf_counter() - t0) * 1e3
         return result
 

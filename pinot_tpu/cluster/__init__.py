@@ -1,0 +1,3 @@
+from .controller import Controller  # noqa: F401
+from .server_node import ServerNode  # noqa: F401
+from .broker_node import BrokerNode  # noqa: F401

@@ -1,0 +1,239 @@
+"""Broker node: REST query entry, routing, scatter-gather, failure handling.
+
+Reference parity: pinot-broker/ — PinotClientRequest.java:110 (/query/sql),
+BrokerRoutingManager (routing table from the ideal state), instance
+selectors (BalancedInstanceSelector round-robin across replicas),
+ConnectionFailureDetector (unhealthy on failure, exponential-backoff
+retry), and SingleConnectionBrokerRequestHandler.java:141-151
+(scatter over servers, gather DataTables, reduce). Scatter here is
+threaded HTTP to server nodes; partials come back in the serde wire
+format and reduce through the same BrokerReduceService analog the
+in-process broker uses.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..engine.reduce import ResultTable, reduce_partials
+from ..engine.serde import partial_from_wire
+from ..query.context import build_query_context
+from ..query.sql import SqlError, parse_sql
+from .http_util import JsonHandler, http_json, start_http
+
+
+class FailureDetector:
+    """Consecutive-failure marking with exponential backoff retry
+    (BaseExponentialBackoffRetryFailureDetector analog)."""
+
+    def __init__(self, base_backoff: float = 0.5, max_backoff: float = 30.0):
+        self._fails: Dict[str, int] = {}
+        self._until: Dict[str, float] = {}
+        self._base = base_backoff
+        self._max = max_backoff
+        self._lock = threading.Lock()
+
+    def healthy(self, server: str) -> bool:
+        with self._lock:
+            return time.monotonic() >= self._until.get(server, 0.0)
+
+    def record_failure(self, server: str) -> None:
+        with self._lock:
+            n = self._fails.get(server, 0) + 1
+            self._fails[server] = n
+            backoff = min(self._base * (2 ** (n - 1)), self._max)
+            self._until[server] = time.monotonic() + backoff
+
+    def record_success(self, server: str) -> None:
+        with self._lock:
+            self._fails.pop(server, None)
+            self._until.pop(server, None)
+
+
+class BrokerNode:
+    def __init__(self, controller_url: str, port: int = 0,
+                 routing_refresh: float = 0.3):
+        self.controller_url = controller_url
+        self.routing_refresh = routing_refresh
+        self._routing: Dict[str, Any] = {"version": -1}
+        self._rr = 0  # round-robin cursor (BalancedInstanceSelector)
+        self._failures = FailureDetector()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=16)
+        self._httpd, self.port, _ = start_http(self._make_handler(), port)
+        self._refresh_routing()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- routing -----------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.routing_refresh):
+            try:
+                self._refresh_routing()
+            except Exception:
+                pass
+
+    def _refresh_routing(self) -> None:
+        snap = http_json("GET", f"{self.controller_url}/routing")
+        with self._lock:
+            if snap["version"] != self._routing.get("version"):
+                self._routing = snap
+
+    def wait_for_version(self, version: int, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._routing.get("version", -1) >= version:
+                return True
+            try:
+                self._refresh_routing()
+            except Exception:
+                pass
+            time.sleep(0.05)
+        return False
+
+    def _server_url(self, server_id: str) -> Optional[str]:
+        inst = self._routing.get("instances", {}).get(server_id)
+        if inst is None:
+            return None
+        return f"http://{inst['host']}:{inst['port']}"
+
+    def _route(self, table: str) -> Dict[str, List[str]]:
+        """segment -> replica server ids, from the cached ideal state."""
+        with self._lock:
+            assignment = self._routing.get("assignment", {}).get(table)
+        if assignment is None:
+            raise SqlError(f"table {table!r} not found in routing")
+        return assignment
+
+    def _pick_replica(self, holders: List[str]) -> Optional[str]:
+        candidates = [h for h in holders if self._failures.healthy(h)
+                      and self._server_url(h)]
+        if not candidates:
+            # all backed off: try anyway rather than failing outright
+            candidates = [h for h in holders if self._server_url(h)]
+        if not candidates:
+            return None
+        self._rr += 1
+        return candidates[self._rr % len(candidates)]
+
+    # -- query path --------------------------------------------------------
+    def query(self, sql: str) -> ResultTable:
+        t0 = time.perf_counter()
+        stmt = parse_sql(sql)
+        if stmt.joins:
+            raise SqlError("multi-stage joins over the remote data plane "
+                           "arrive with the dispatch stage; use the "
+                           "in-process broker for joins")
+        ctx = build_query_context(stmt)
+        assignment = self._route(ctx.table)
+
+        if stmt.explain:
+            # plan shape is identical across servers: ask any holder
+            for seg, holders in assignment.items():
+                pick = self._pick_replica(holders)
+                if pick is None:
+                    continue
+                resp = http_json("POST", f"{self._server_url(pick)}/query",
+                                 {"sql": sql})
+                exp = resp.get("explain", {})
+                return ResultTable(exp.get("columns", []),
+                                   [tuple(r) for r in exp.get("rows", [])])
+            raise SqlError("no live replica to explain against")
+
+        # scatter: group segments by chosen replica
+        by_server: Dict[str, List[str]] = {}
+        unserved: List[str] = []
+        for seg, holders in assignment.items():
+            pick = self._pick_replica(holders)
+            if pick is None:
+                unserved.append(seg)
+            else:
+                by_server.setdefault(pick, []).append(seg)
+        if unserved:
+            raise SqlError(f"no live replica for segments {unserved[:3]}"
+                           f"{'...' if len(unserved) > 3 else ''}")
+
+        def call(server: str, segs: List[str], retry: bool = True):
+            url = self._server_url(server)
+            try:
+                resp = http_json("POST", f"{url}/query",
+                                 {"sql": sql, "segments": segs})
+                self._failures.record_success(server)
+                return resp
+            except urllib.error.HTTPError as e:
+                # the server answered: an application error, not a health
+                # signal — surface it, don't poison the failure detector
+                self._failures.record_success(server)
+                try:
+                    detail = e.read().decode()[:200]
+                except Exception:
+                    detail = str(e)
+                raise SqlError(f"server {server} rejected query: "
+                               f"{detail}") from None
+            except Exception:
+                self._failures.record_failure(server)
+                if not retry:
+                    raise
+                # failover: re-pick replicas per segment, one retry
+                regrouped: Dict[str, List[str]] = {}
+                for seg in segs:
+                    holders = [h for h in assignment.get(seg, [])
+                               if h != server]
+                    pick = self._pick_replica(holders)
+                    if pick is None:
+                        raise SqlError(f"no replica left for {seg!r}")
+                    regrouped.setdefault(pick, []).append(seg)
+                out = {"partials": [], "segmentsQueried": 0}
+                for srv, ss in regrouped.items():
+                    r = call(srv, ss, retry=False)
+                    out["partials"].extend(r["partials"])
+                    out["segmentsQueried"] += r["segmentsQueried"]
+                return out
+
+        futures = [self._pool.submit(call, srv, segs)
+                   for srv, segs in by_server.items()]
+        partials = []
+        queried = 0
+        for f in futures:
+            resp = f.result()
+            partials.extend(partial_from_wire(p) for p in resp["partials"])
+            queried += resp["segmentsQueried"]
+
+        result = reduce_partials(ctx, partials)
+        result.num_segments = queried
+        result.time_ms = (time.perf_counter() - t0) * 1e3
+        return result
+
+    # -- REST --------------------------------------------------------------
+    def _make_handler(self):
+        node = self
+
+        def q(h, b):
+            sql = (b or {}).get("sql")
+            if not sql:
+                return 400, {"error": "missing sql"}
+            try:
+                return 200, node.query(sql).to_dict()
+            except SqlError as e:
+                return 400, {"error": str(e)}
+
+        class Handler(JsonHandler):
+            routes = {
+                ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
+                ("POST", "/query/sql"): q,
+            }
+        return Handler
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pool.shutdown(wait=False)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"

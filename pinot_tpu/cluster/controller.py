@@ -1,0 +1,224 @@
+"""Controller: cluster metadata + declarative reconciliation.
+
+Reference parity: pinot-controller/.../BaseControllerStarter.java:351 +
+PinotHelixResourceManager (table/segment/instance CRUD) + segment
+assignment strategies (helix/core/assignment/segment/) + periodic tasks
+(RetentionManager, SegmentStatusChecker — BaseControllerStarter.java:
+174-191). TPU-native stance (SURVEY.md section 5, distributed backend):
+Helix/ZK is replaceable infrastructure, not product surface — a single
+controller process owns a file-backed property store (atomic tmp+rename
+JSON, the ZK property-store analog), instances announce themselves with
+heartbeats (ephemeral-node analog), and a reconciliation loop converges
+ideal state: every segment assigned to `replication` live servers with
+minimal movement (keep surviving replicas, top up from least-loaded).
+Brokers/servers poll a monotonically versioned ideal state instead of
+watching ZK events.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .http_util import JsonHandler, start_http
+
+HEARTBEAT_TIMEOUT_S = 10.0
+RECONCILE_INTERVAL_S = 1.0
+
+
+class Controller:
+    def __init__(self, data_dir: str, port: int = 0,
+                 heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S,
+                 reconcile_interval: float = RECONCILE_INTERVAL_S):
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self._lock = threading.RLock()
+        self.heartbeat_timeout = heartbeat_timeout
+        self.reconcile_interval = reconcile_interval
+        self._state: Dict[str, Any] = self._load() or {
+            "version": 0,
+            "tables": {},      # name -> {schema, config, replication}
+            "segments": {},    # table -> {segment -> {location}}
+            "assignment": {},  # table -> {segment -> [instance ids]}
+        }
+        self._instances: Dict[str, Dict[str, Any]] = {}  # ephemeral
+        self._stop = threading.Event()
+        self._httpd, self.port, _ = start_http(self._make_handler(), port)
+        self._recon = threading.Thread(target=self._reconcile_loop,
+                                       daemon=True)
+        self._recon.start()
+
+    # -- property store ----------------------------------------------------
+    def _path(self) -> str:
+        return os.path.join(self.data_dir, "cluster_state.json")
+
+    def _load(self) -> Optional[Dict[str, Any]]:
+        if os.path.exists(self._path()):
+            with open(self._path()) as fh:
+                return json.load(fh)
+        return None
+
+    def _persist(self) -> None:
+        tmp = self._path() + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._state, fh, indent=1)
+        os.replace(tmp, self._path())
+
+    def _bump(self) -> None:
+        self._state["version"] += 1
+        self._persist()
+
+    # -- instance registry (Helix liveness analog) -------------------------
+    def register_instance(self, inst: Dict[str, Any]) -> None:
+        with self._lock:
+            inst = dict(inst)
+            inst["lastHeartbeat"] = time.monotonic()
+            self._instances[inst["id"]] = inst
+            self._reconcile_locked()
+
+    def heartbeat(self, instance_id: str) -> bool:
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst is None:
+                return False
+            inst["lastHeartbeat"] = time.monotonic()
+            return True
+
+    def live_servers(self) -> List[str]:
+        now = time.monotonic()
+        return sorted(
+            i["id"] for i in self._instances.values()
+            if i.get("role") == "server"
+            and now - i["lastHeartbeat"] <= self.heartbeat_timeout)
+
+    # -- tables / segments -------------------------------------------------
+    def add_table(self, name: str, schema: Dict[str, Any],
+                  config: Optional[Dict[str, Any]] = None,
+                  replication: int = 1) -> None:
+        with self._lock:
+            self._state["tables"][name] = {
+                "schema": schema, "config": config or {},
+                "replication": replication}
+            self._state["segments"].setdefault(name, {})
+            self._state["assignment"].setdefault(name, {})
+            self._bump()
+
+    def drop_table(self, name: str) -> None:
+        with self._lock:
+            for key in ("tables", "segments", "assignment"):
+                self._state[key].pop(name, None)
+            self._bump()
+
+    def add_segment(self, table: str, segment: str, location: str) -> None:
+        with self._lock:
+            if table not in self._state["tables"]:
+                raise KeyError(f"table {table!r} not registered")
+            self._state["segments"][table][segment] = {"location": location}
+            self._reconcile_locked()
+
+    # -- assignment / reconciliation ---------------------------------------
+    def _reconcile_loop(self) -> None:
+        while not self._stop.wait(self.reconcile_interval):
+            with self._lock:
+                self._reconcile_locked()
+
+    def _reconcile_locked(self) -> None:
+        """Converge assignment: each segment on `replication` live servers,
+        minimal movement (TableRebalancer analog at small scale)."""
+        live = self.live_servers()
+        changed = False
+        load: Dict[str, int] = {s: 0 for s in live}
+        for table, segs in self._state["assignment"].items():
+            for seg, holders in segs.items():
+                for h in holders:
+                    if h in load:
+                        load[h] += 1
+        for table, tmeta in self._state["tables"].items():
+            repl = min(tmeta.get("replication", 1), max(len(live), 1))
+            assign = self._state["assignment"].setdefault(table, {})
+            for seg in self._state["segments"].get(table, {}):
+                holders = [h for h in assign.get(seg, []) if h in live]
+                while len(holders) < repl and live:
+                    candidates = [s for s in live if s not in holders]
+                    if not candidates:
+                        break
+                    pick = min(candidates, key=lambda s: load[s])
+                    holders.append(pick)
+                    load[pick] += 1
+                if assign.get(seg) != holders:
+                    assign[seg] = holders
+                    changed = True
+        if changed:
+            self._bump()
+
+    # -- views -------------------------------------------------------------
+    def routing_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "version": self._state["version"],
+                "tables": {
+                    t: {"schema": m["schema"], "config": m["config"]}
+                    for t, m in self._state["tables"].items()},
+                "assignment": json.loads(json.dumps(
+                    self._state["assignment"])),
+                "segments": json.loads(json.dumps(self._state["segments"])),
+                "instances": {
+                    i["id"]: {"host": i["host"], "port": i["port"],
+                              "role": i.get("role")}
+                    for i in self._instances.values()},
+                "liveServers": self.live_servers(),
+            }
+
+    def server_assignment(self, instance_id: str) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Dict[str, str]] = {}
+            for table, segs in self._state["assignment"].items():
+                for seg, holders in segs.items():
+                    if instance_id in holders:
+                        loc = self._state["segments"][table][seg]["location"]
+                        out.setdefault(table, {})[seg] = loc
+            return {"version": self._state["version"], "tables": out,
+                    "schemas": {t: m["schema"] for t, m in
+                                self._state["tables"].items()}}
+
+    # -- REST --------------------------------------------------------------
+    def _make_handler(self):
+        ctrl = self
+
+        class Handler(JsonHandler):
+            routes = {
+                ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
+                ("POST", "/instances"): lambda h, b: (
+                    ctrl.register_instance(b) or (200, {"status": "OK"})),
+                ("POST", "/heartbeat/"): lambda h, b: (
+                    (200, {"status": "OK"})
+                    if ctrl.heartbeat(h.path.rsplit("/", 1)[1])
+                    else (404, {"error": "unknown instance"})),
+                ("POST", "/tables"): lambda h, b: (
+                    ctrl.add_table(b["name"], b["schema"],
+                                   b.get("config"),
+                                   b.get("replication", 1))
+                    or (200, {"status": "OK"})),
+                ("DELETE", "/tables/"): lambda h, b: (
+                    ctrl.drop_table(h.path.rsplit("/", 1)[1])
+                    or (200, {"status": "OK"})),
+                ("POST", "/segments"): lambda h, b: (
+                    ctrl.add_segment(b["table"], b["segment"],
+                                     b["location"]) or (200, {"status": "OK"})),
+                ("GET", "/routing"): lambda h, b: (
+                    200, ctrl.routing_snapshot()),
+                ("GET", "/assignments/"): lambda h, b: (
+                    200, ctrl.server_assignment(h.path.rsplit("/", 1)[1])),
+            }
+        return Handler
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"

@@ -1,0 +1,73 @@
+"""Tiny stdlib HTTP plumbing shared by the cluster roles (the Netty/gRPC/
+Jersey stack of the reference collapses to ThreadingHTTPServer + urllib for
+the host-side control/data planes; intra-query device combines ride ICI via
+parallel/distributed.py, which is where the bandwidth actually matters)."""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Dispatches (method, path-prefix) to registered handlers returning
+    (status, json-able)."""
+
+    routes: Dict[Tuple[str, str], Callable] = {}
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _dispatch(self, method: str) -> None:
+        body = None
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            body = json.loads(self.rfile.read(length))
+        for (m, prefix), fn in sorted(self.routes.items(),
+                                      key=lambda kv: -len(kv[0][1])):
+            if m == method and self.path.split("?")[0].startswith(prefix):
+                try:
+                    status, payload = fn(self, body)
+                except Exception as e:  # surface handler errors as 500 JSON
+                    status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+        self.send_response(404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+
+def start_http(handler_cls, port: int = 0) -> Tuple[ThreadingHTTPServer,
+                                                    int, threading.Thread]:
+    srv = ThreadingHTTPServer(("127.0.0.1", port), handler_cls)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1], t
+
+
+def http_json(method: str, url: str, body: Any = None,
+              timeout: float = 10.0) -> Any:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        payload = resp.read()
+    return json.loads(payload) if payload else None

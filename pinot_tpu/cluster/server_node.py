@@ -1,0 +1,135 @@
+"""Server node: segment hosting + query execution over HTTP.
+
+Reference parity: pinot-server/.../BaseServerStarter.java:557 + the Helix
+state model (SegmentOnlineOfflineStateModelFactory.java:78,128 — servers
+receive ONLINE transitions and download/load segments) + the server half of
+the single-stage data plane (InstanceRequestHandler.channelRead0). Here the
+server polls its versioned assignment from the controller (ideal-state
+pull, not ZK push), loads/unloads immutable segments to match, and serves
+POST /query {sql, table, segments?} by running the per-segment planner +
+batched kernel executor and returning wire-encoded partials — the
+DataTable response analog.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..engine.serde import partial_to_wire
+from ..query.context import build_query_context
+from ..query.sql import parse_sql
+from ..segment.immutable import ImmutableSegment
+from ..server.data_manager import TableDataManager
+from .http_util import JsonHandler, http_json, start_http
+
+
+class ServerNode:
+    def __init__(self, instance_id: str, controller_url: str, port: int = 0,
+                 poll_interval: float = 0.3):
+        self.instance_id = instance_id
+        self.controller_url = controller_url
+        self.poll_interval = poll_interval
+        self._tables: Dict[str, TableDataManager] = {}
+        self._assignment_version = -1
+        self._stop = threading.Event()
+        self._httpd, self.port, _ = start_http(self._make_handler(), port)
+        self._register()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- control plane -----------------------------------------------------
+    def _register(self) -> None:
+        http_json("POST", f"{self.controller_url}/instances", {
+            "id": self.instance_id, "host": "127.0.0.1",
+            "port": self.port, "role": "server"})
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                http_json("POST",
+                          f"{self.controller_url}/heartbeat/"
+                          f"{self.instance_id}")
+                self._sync_assignment()
+            except Exception:
+                pass  # controller briefly unreachable; keep serving
+
+    def _sync_assignment(self) -> None:
+        a = http_json("GET", f"{self.controller_url}/assignments/"
+                             f"{self.instance_id}")
+        if a["version"] == self._assignment_version:
+            return
+        ok = True  # advance the version only after a fully-applied sync;
+        # a failed segment load retries on every poll instead of being
+        # silently skipped until an unrelated version bump
+        for table, segs in a["tables"].items():
+            dm = self._tables.setdefault(table, TableDataManager(table))
+            have = {s.name for s in dm.acquire_segments()}
+            for seg_name, location in segs.items():
+                if seg_name not in have:
+                    try:
+                        dm.add_segment(ImmutableSegment.load(location))
+                    except Exception:
+                        ok = False
+            for seg_name in have - set(segs):
+                dm.remove_segment(seg_name)
+        for table in list(self._tables):
+            if table not in a["tables"]:
+                del self._tables[table]
+        if ok:
+            self._assignment_version = a["version"]
+
+    def wait_for_version(self, version: int, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._assignment_version >= version:
+                return True
+            time.sleep(0.05)
+        return False
+
+    # -- data plane --------------------------------------------------------
+    def execute(self, sql: str, segment_names: Optional[List[str]] = None
+                ) -> Dict[str, Any]:
+        stmt = parse_sql(sql)
+        if stmt.joins:
+            raise ValueError("leaf servers execute single-table stages")
+        ctx = build_query_context(stmt)
+        dm = self._tables.get(ctx.table)
+        if dm is None:
+            return {"partials": [], "segmentsQueried": 0}
+        segments = dm.acquire_segments()
+        if segment_names is not None:
+            wanted = set(segment_names)
+            segments = [s for s in segments if s.name in wanted]
+        # shared loop with the in-process broker (engine/serving.py)
+        from ..engine.serving import execute_segments, plan_segments
+        if stmt.explain:
+            ex = plan_segments(ctx, segments, use_rollups=False)
+            from ..query.explain import explain_rows
+            cols, rows = explain_rows(ctx, ex.real_plans, 0)
+            return {"explain": {"columns": cols,
+                                "rows": [list(r) for r in rows]},
+                    "segmentsQueried": len(segments)}
+        ex = execute_segments(ctx, segments)
+        return {"partials": [partial_to_wire(p) for p in ex.partials],
+                "segmentsQueried": len(segments)}
+
+    def _make_handler(self):
+        node = self
+
+        class Handler(JsonHandler):
+            routes = {
+                ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
+                ("POST", "/query"): lambda h, b: (
+                    200, node.execute(b["sql"], b.get("segments"))),
+            }
+        return Handler
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"

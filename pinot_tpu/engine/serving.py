@@ -1,0 +1,71 @@
+"""Shared per-table execution: the one rollup-or-plan-then-batch loop.
+
+Both the in-process broker (broker/broker.py) and the HTTP server node
+(cluster/server_node.py) serve a query over a list of segments; this is
+that loop in one place so fixes (rollup gating, tracing, upsert handling)
+cannot drift between the two paths.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..query.context import QueryContext
+from ..query.planner import CompiledPlan, SegmentPlanner
+from ..startree.query import try_rollup_execute
+from ..utils.trace import Tracing
+from .batch import execute_plans_batched
+
+
+@dataclass
+class TableExecution:
+    plans: List[Optional[CompiledPlan]]         # None where rollup answered
+    real_plans: List[CompiledPlan]
+    partials: List[Any] = field(default_factory=list)
+    rollup_segments: int = 0
+
+    @property
+    def pruned(self) -> int:
+        return sum(1 for p in self.real_plans if p.kind == "pruned")
+
+    @property
+    def docs_scanned(self) -> int:
+        return sum(p.segment.n_docs for p in self.real_plans
+                   if p.kind in ("kernel", "host"))
+
+
+def plan_segments(ctx: QueryContext, segments: List[Any],
+                  use_rollups: bool = True) -> TableExecution:
+    plans: List[Optional[CompiledPlan]] = []
+    precomputed: Dict[int, Any] = {}
+    with Tracing.phase("planning"):
+        for i, seg in enumerate(segments):
+            partial = (try_rollup_execute(ctx, seg)
+                       if use_rollups and hasattr(seg, "metadata") else None)
+            if partial is not None:
+                precomputed[i] = partial
+                plans.append(None)
+            else:
+                plans.append(SegmentPlanner(ctx, seg).plan())
+    ex = TableExecution(plans, [p for p in plans if p is not None],
+                        rollup_segments=len(precomputed))
+    ex._precomputed = precomputed  # type: ignore[attr-defined]
+    return ex
+
+
+def execute_planned(ex: TableExecution) -> List[Any]:
+    """Run the batched device dispatch and interleave rollup partials back
+    into input order."""
+    with Tracing.phase("execution"):
+        executed = iter(execute_plans_batched(ex.real_plans))
+    precomputed = getattr(ex, "_precomputed", {})
+    ex.partials = [precomputed[i] if p is None else next(executed)
+                   for i, p in enumerate(ex.plans)]
+    return ex.partials
+
+
+def execute_segments(ctx: QueryContext, segments: List[Any],
+                     use_rollups: bool = True) -> TableExecution:
+    ex = plan_segments(ctx, segments, use_rollups)
+    execute_planned(ex)
+    return ex

@@ -336,3 +336,45 @@ class MultiStageExecutor:
 
 def execute_multistage(broker, stmt: SelectStmt) -> ResultTable:
     return MultiStageExecutor(broker, stmt).execute()
+
+
+def explain_multistage(broker, stmt: SelectStmt) -> ResultTable:
+    """EXPLAIN for join queries: describe the stage topology without
+    executing any scan (QueryEnvironment.explainQuery analog)."""
+    ex = MultiStageExecutor(broker, stmt)
+    needed = ex._collect_needed()
+    pushed, post = ex._split_where()
+    rows: List[tuple] = []
+    rid = 0
+
+    def emit(op: str, parent: int) -> int:
+        nonlocal rid
+        rows.append((op, rid, parent))
+        rid += 1
+        return rid - 1
+
+    ctx = build_query_context(stmt)
+    root = emit("BROKER_REDUCE", -1)
+    if ctx.is_group_by:
+        final = emit(f"AGGREGATE_GROUP_BY(keys:{len(ctx.group_by)},"
+                     f"aggs:{len(ctx.aggregations)})", root)
+    elif ctx.is_aggregation:
+        final = emit(f"AGGREGATE(aggs:{len(ctx.aggregations)})", root)
+    else:
+        final = emit("SELECT", root)
+    if post:
+        final = emit(f"FILTER(post_join_conjuncts:{len(post)})", final)
+    parent = final
+    for j in reversed(stmt.joins):
+        label = j.table.label
+        equi, rest = ex._split_on(
+            j.on, {t.label for t in ex.tables if t.label != label}, label)
+        parent = emit(
+            f"HASH_JOIN({j.join_type.upper()},keys:{len(equi)},"
+            f"non_equi:{len(rest)})", parent)
+        emit(f"LEAF_SCAN({label},cols:{len(needed[label])},"
+             f"pushed_filters:{len(pushed[label])})", parent)
+    base = ex.tables[0].label
+    emit(f"LEAF_SCAN({base},cols:{len(needed[base])},"
+         f"pushed_filters:{len(pushed[base])})", parent)
+    return ResultTable(["Operator", "Operator_Id", "Parent_Id"], rows)

@@ -1,0 +1,163 @@
+"""Native host runtime: ctypes bindings over the C++ library, with numpy
+fallbacks so the engine runs without the compiled artifact.
+
+Reference parity: SURVEY.md section 2.9 — the reference's native surface
+is off-heap mmap buffers + JNI codec jars + bit-unpack hot loops; the
+build-on-first-use .so here plays that role for the host side of the TPU
+pipeline (the device side is XLA). See src/pinot_native.cpp.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "pinot_native.cpp")
+_SO = os.path.join(_HERE, "libpinot_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+           "-o", _SO, "-lz", "-lzstd"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        src_exists = os.path.exists(_SRC)
+        stale = (src_exists and os.path.exists(_SO)
+                 and os.path.getmtime(_SRC) > os.path.getmtime(_SO))
+        if not os.path.exists(_SO) or stale:
+            # a prebuilt .so without src/ in the deployment loads as-is
+            if not src_exists or not _build():
+                if not os.path.exists(_SO):
+                    return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        c_i64, c_i32, c_u8 = (ctypes.c_int64, ctypes.c_int32, ctypes.c_uint8)
+        p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.fixedbit_pack.restype = c_i64
+        lib.fixedbit_pack.argtypes = [p_i32, c_i64, ctypes.c_int, p_u8]
+        lib.fixedbit_unpack.restype = None
+        lib.fixedbit_unpack.argtypes = [p_u8, c_i64, ctypes.c_int, p_i32]
+        for name in ("zlib_compress_chunk", "zstd_compress_chunk"):
+            fn = getattr(lib, name)
+            fn.restype = c_i64
+            fn.argtypes = [p_u8, c_i64, p_u8, c_i64, ctypes.c_int]
+        for name in ("zlib_decompress_chunk", "zstd_decompress_chunk"):
+            fn = getattr(lib, name)
+            fn.restype = c_i64
+            fn.argtypes = [p_u8, c_i64, p_u8, c_i64]
+        lib.compress_bound.restype = c_i64
+        lib.compress_bound.argtypes = [c_i64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------------
+# fixed-bit pack/unpack (numpy fallback mirrors the C++ exactly)
+# ---------------------------------------------------------------------------
+
+def bits_for(cardinality: int) -> int:
+    return max(1, int(cardinality - 1).bit_length()) if cardinality > 1 else 1
+
+
+def fixedbit_pack(ids: np.ndarray, bits: int) -> np.ndarray:
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    n = len(ids)
+    nbytes = (n * bits + 7) // 8
+    lib = load()
+    if lib is not None:
+        out = np.zeros(nbytes + 8, dtype=np.uint8)  # +8: unpack window pad
+        lib.fixedbit_pack(ids, n, bits, out)
+        return out
+    # numpy fallback: expand to a bit matrix then packbits (little-endian)
+    shifts = np.arange(bits, dtype=np.uint32)
+    bitmat = ((ids.astype(np.uint32)[:, None] >> shifts) & 1).astype(np.uint8)
+    flat = bitmat.reshape(-1)
+    out = np.packbits(flat, bitorder="little")
+    padded = np.zeros(nbytes + 8, dtype=np.uint8)
+    padded[: len(out)] = out
+    return padded
+
+
+def fixedbit_unpack(buf: np.ndarray, n: int, bits: int) -> np.ndarray:
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    lib = load()
+    if lib is not None:
+        out = np.empty(n, dtype=np.int32)
+        lib.fixedbit_unpack(buf, n, bits, out)
+        return out
+    flat = np.unpackbits(buf, bitorder="little")[: n * bits]
+    bitmat = flat.reshape(n, bits).astype(np.uint32)
+    weights = (np.uint32(1) << np.arange(bits, dtype=np.uint32))
+    return (bitmat * weights).sum(axis=1).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# chunk codecs
+# ---------------------------------------------------------------------------
+
+def compress(data: np.ndarray, codec: str = "ZSTD", level: int = 3
+             ) -> np.ndarray:
+    raw = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+    lib = load()
+    if lib is not None:
+        cap = int(lib.compress_bound(len(raw)))
+        out = np.empty(cap, dtype=np.uint8)
+        fn = (lib.zstd_compress_chunk if codec == "ZSTD"
+              else lib.zlib_compress_chunk)
+        sz = fn(raw, len(raw), out, cap, level)
+        if sz < 0:
+            raise RuntimeError(f"{codec} compression failed")
+        return out[:sz].copy()
+    if codec != "ZLIB":
+        # never write a codec the metadata can't honor elsewhere: a silent
+        # zlib stream labeled ZSTD is unreadable wherever the lib exists
+        raise RuntimeError(f"native library unavailable; codec {codec!r} "
+                           "needs it (use ZLIB for the pure-python path)")
+    import zlib
+    return np.frombuffer(zlib.compress(raw.tobytes(), level), dtype=np.uint8)
+
+
+def decompress(data: np.ndarray, raw_size: int, codec: str = "ZSTD"
+               ) -> np.ndarray:
+    buf = np.ascontiguousarray(data, dtype=np.uint8)
+    lib = load()
+    if lib is not None:
+        out = np.empty(raw_size, dtype=np.uint8)
+        fn = (lib.zstd_decompress_chunk if codec == "ZSTD"
+              else lib.zlib_decompress_chunk)
+        sz = fn(buf, len(buf), out, raw_size)
+        if sz != raw_size:
+            raise RuntimeError(f"{codec} decompression failed ({sz})")
+        return out
+    if codec != "ZLIB":
+        raise RuntimeError(f"native library unavailable; cannot decode "
+                           f"{codec!r} column (rebuild the native lib)")
+    import zlib
+    return np.frombuffer(zlib.decompress(buf.tobytes()), dtype=np.uint8)

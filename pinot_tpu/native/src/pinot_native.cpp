@@ -1,0 +1,125 @@
+// pinot_tpu native host runtime.
+//
+// Reference parity: the "native" surface of the reference (SURVEY.md
+// section 2.9) — off-heap buffers (pinot-segment-spi/.../memory/
+// PinotDataBuffer.java:60, LArray JNI mmap / Unsafe), JNI-backed
+// compression jars (zstd-jni, lz4-java wired in pinot-segment-local/
+// .../io/compression/), and pure-Java bit-unpacking
+// (FixedBitSVForwardIndexReaderV2). Here those become one C++ shared
+// library bound via ctypes:
+//   - fixed-bit pack/unpack for dictionary-id forward indexes
+//     (ceil(log2(card)) bits per value, byte stream), feeding int32
+//     device uploads;
+//   - chunked ZLIB/ZSTD codecs for raw column files;
+//   - mmap open/close helpers for explicit off-heap column mapping
+//     (np.memmap equivalents, exposed for the loader's zero-copy path).
+// All functions are plain C ABI; numpy fallbacks exist python-side so the
+// engine works without the compiled artifact.
+
+#include <cstdint>
+#include <cstring>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <zlib.h>
+#include <zstd.h>
+
+extern "C" {
+
+// --------------------------------------------------------------------------
+// fixed-bit packing (FixedBitSVForwardIndexReaderV2 analog)
+// --------------------------------------------------------------------------
+
+// pack n int32 values of `bits` bits each into dst (little-endian bit
+// order within the stream); returns bytes written
+int64_t fixedbit_pack(const int32_t* src, int64_t n, int bits,
+                      uint8_t* dst) {
+    int64_t bitpos = 0;
+    int64_t total_bits = n * (int64_t)bits;
+    memset(dst, 0, (total_bits + 7) / 8);
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t v = (uint32_t)src[i];
+        int64_t bp = bitpos;
+        for (int b = 0; b < bits; ++b, ++bp) {
+            if (v & (1u << b)) dst[bp >> 3] |= (uint8_t)(1u << (bp & 7));
+        }
+        bitpos += bits;
+    }
+    return (total_bits + 7) / 8;
+}
+
+// unpack n values of `bits` bits from src into int32 dst
+void fixedbit_unpack(const uint8_t* src, int64_t n, int bits,
+                     int32_t* dst) {
+    const uint32_t mask = (bits >= 32) ? 0xffffffffu
+                                       : ((1u << bits) - 1u);
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t bitpos = i * (int64_t)bits;
+        int64_t byte = bitpos >> 3;
+        int shift = (int)(bitpos & 7);
+        // read up to 8 bytes covering the value
+        uint64_t window = 0;
+        memcpy(&window, src + byte, 8);  // caller pads the buffer tail
+        dst[i] = (int32_t)((window >> shift) & mask);
+    }
+}
+
+// --------------------------------------------------------------------------
+// chunk codecs (io/compression analog; ZLIB ~ GZIP, ZSTD ~ ZSTANDARD)
+// --------------------------------------------------------------------------
+
+int64_t zlib_compress_chunk(const uint8_t* src, int64_t n, uint8_t* dst,
+                            int64_t cap, int level) {
+    uLongf out = (uLongf)cap;
+    int rc = compress2(dst, &out, src, (uLong)n, level);
+    return rc == Z_OK ? (int64_t)out : -1;
+}
+
+int64_t zlib_decompress_chunk(const uint8_t* src, int64_t n, uint8_t* dst,
+                              int64_t cap) {
+    uLongf out = (uLongf)cap;
+    int rc = uncompress(dst, &out, src, (uLong)n);
+    return rc == Z_OK ? (int64_t)out : -1;
+}
+
+int64_t zstd_compress_chunk(const uint8_t* src, int64_t n, uint8_t* dst,
+                            int64_t cap, int level) {
+    size_t out = ZSTD_compress(dst, (size_t)cap, src, (size_t)n, level);
+    return ZSTD_isError(out) ? -1 : (int64_t)out;
+}
+
+int64_t zstd_decompress_chunk(const uint8_t* src, int64_t n, uint8_t* dst,
+                              int64_t cap) {
+    size_t out = ZSTD_decompress(dst, (size_t)cap, src, (size_t)n);
+    return ZSTD_isError(out) ? -1 : (int64_t)out;
+}
+
+int64_t compress_bound(int64_t n) {
+    uLong zb = compressBound((uLong)n);
+    size_t sb = ZSTD_compressBound((size_t)n);
+    return (int64_t)(zb > sb ? zb : sb);
+}
+
+// --------------------------------------------------------------------------
+// mmap helpers (PinotDataBuffer mmap mode)
+// --------------------------------------------------------------------------
+
+void* mmap_open(const char* path, int64_t* size_out) {
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+    void* p = mmap(nullptr, (size_t)st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+    close(fd);
+    if (p == MAP_FAILED) return nullptr;
+    *size_out = (int64_t)st.st_size;
+    return p;
+}
+
+int mmap_close(void* p, int64_t size) {
+    return munmap(p, (size_t)size);
+}
+
+}  // extern "C"

@@ -162,6 +162,7 @@ class SelectStmt:
     limit: Optional[int] = None
     offset: int = 0
     options: dict = field(default_factory=dict)
+    explain: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +183,7 @@ KEYWORDS = {
     "offset", "and", "or", "not", "between", "in", "like", "is", "null",
     "as", "asc", "desc", "distinct", "true", "false", "option",
     "join", "on", "left", "right", "inner", "outer", "cross", "full",
+    "explain",  # 'plan'/'for' stay contextual: valid column names elsewhere
 }
 
 
@@ -271,6 +273,16 @@ class _Parser:
 
     # -- grammar -----------------------------------------------------------
     def parse(self) -> SelectStmt:
+        explain = False
+        if self.accept_kw("explain"):
+            t = self.peek()  # contextual: EXPLAIN [PLAN FOR] SELECT ...
+            if t.kind == "ident" and t.value.lower() == "plan":
+                self.next()
+                t2 = self.next()
+                if not (t2.kind == "ident" and t2.value.lower() == "for"):
+                    raise SqlError(f"expected FOR after EXPLAIN PLAN "
+                                   f"at {t2.pos}")
+            explain = True
         self.expect_kw("select")
         select = self.select_list()
         self.expect_kw("from")
@@ -335,6 +347,7 @@ class _Parser:
         if self.peek().kind != "eof":
             t = self.peek()
             raise SqlError(f"unexpected trailing token {t.value!r} at {t.pos}")
+        stmt.explain = explain
         return stmt
 
     def table_ref(self) -> TableRef:

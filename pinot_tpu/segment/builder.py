@@ -201,12 +201,22 @@ class SegmentBuilder:
             and bool(np.all(arr[1:] >= arr[:-1]))))
         cmeta["isSorted"] = is_sorted
 
+        idx_cfg = self.table_config.indexing
         if use_dict:
             assert dictionary is not None and ids is not None
-            id_dtype = min_id_dtype(cardinality)
-            ids.astype(id_dtype).tofile(_fwd_path(seg_dir, f.name))
             cmeta["encoding"] = "DICT"
-            cmeta["fwdDtype"] = id_dtype.name
+            if idx_cfg.bit_packed_ids and cardinality > 1:
+                from .. import native
+                bits = native.bits_for(cardinality)
+                buf = native.fixedbit_pack(ids.astype(np.int32), bits)
+                buf.tofile(_fwd_path(seg_dir, f.name))
+                cmeta["fwdFormat"] = "BITPACK"
+                cmeta["bits"] = bits
+                cmeta["fwdDtype"] = "int32"
+            else:
+                id_dtype = min_id_dtype(cardinality)
+                ids.astype(id_dtype).tofile(_fwd_path(seg_dir, f.name))
+                cmeta["fwdDtype"] = id_dtype.name
             if f.data_type == DataType.STRING or not f.data_type.is_numeric:
                 with open(_dict_json_path(seg_dir, f.name), "w") as fh:
                     json.dump(list(dictionary.values), fh)
@@ -219,9 +229,21 @@ class SegmentBuilder:
             cmeta["min"] = _json_scalar(dictionary.min_value)
             cmeta["max"] = _json_scalar(dictionary.max_value)
         else:
-            arr.tofile(_fwd_path(seg_dir, f.name))
             cmeta["encoding"] = "RAW"
             cmeta["fwdDtype"] = arr.dtype.name
+            if idx_cfg.compression:
+                from .. import native
+                codec = idx_cfg.compression
+                if codec == "ZSTD" and not native.available():
+                    codec = "ZLIB"  # degrade to the pure-python codec; the
+                    # metadata must always name the stream actually written
+                comp = native.compress(arr, codec)
+                comp.tofile(_fwd_path(seg_dir, f.name))
+                cmeta["fwdFormat"] = "COMPRESSED"
+                cmeta["codec"] = codec
+                cmeta["rawSize"] = int(arr.nbytes)
+            else:
+                arr.tofile(_fwd_path(seg_dir, f.name))
             if n:
                 cmeta["min"] = _json_scalar(arr.min())
                 cmeta["max"] = _json_scalar(arr.max())

@@ -41,6 +41,10 @@ class ColumnMeta:
         self.field_type = d["fieldType"]
         self.encoding = d["encoding"]  # DICT | RAW
         self.fwd_dtype = np.dtype(d["fwdDtype"])
+        self.fwd_format = d.get("fwdFormat", "PLAIN")  # |BITPACK|COMPRESSED
+        self.bits = d.get("bits")
+        self.codec = d.get("codec")
+        self.raw_size = d.get("rawSize")
         self.cardinality = d.get("cardinality", 0)
         self.is_sorted = d.get("isSorted", False)
         self.min = d.get("min")
@@ -88,11 +92,23 @@ class ImmutableSegment:
 
     # -- host access -------------------------------------------------------
     def fwd(self, col: str) -> np.ndarray:
-        """Stored forward index (dict ids or raw values), host-side."""
+        """Stored forward index (dict ids or raw values), host-side.
+
+        PLAIN columns memmap zero-copy; BITPACK/COMPRESSED decode once
+        through the native runtime (pinot_tpu.native) and cache."""
         if col not in self._fwd:
             m = self.columns[col]
             path = _fwd_path(self.dir, col)
-            if self._read_mode == "mmap":
+            if m.fwd_format == "BITPACK":
+                from .. import native
+                buf = np.fromfile(path, dtype=np.uint8)
+                arr = native.fixedbit_unpack(buf, self.n_docs, m.bits)
+            elif m.fwd_format == "COMPRESSED":
+                from .. import native
+                comp = np.fromfile(path, dtype=np.uint8)
+                raw = native.decompress(comp, m.raw_size, m.codec)
+                arr = raw.view(m.fwd_dtype)[: self.n_docs]
+            elif self._read_mode == "mmap":
                 arr = np.memmap(path, dtype=m.fwd_dtype, mode="r",
                                 shape=(self.n_docs,))
             else:

@@ -32,6 +32,11 @@ class IndexingConfig:
     no_dictionary_columns: List[str] = field(default_factory=list)
     sorted_column: Optional[str] = None
     dict_cardinality_threshold: int = 1 << 17
+    # storage codecs (native C++ pack/compress; pinot io/compression analog):
+    # bit-pack dict ids at ceil(log2(card)) bits instead of byte-aligned
+    bit_packed_ids: bool = False
+    # compress raw columns: None | "ZSTD" | "ZLIB"
+    compression: Optional[str] = None
 
 
 @dataclass

@@ -1,0 +1,2 @@
+from .trace import Tracing, RequestScope  # noqa: F401
+from .metrics import MetricsRegistry, global_metrics  # noqa: F401

@@ -1,0 +1,73 @@
+"""Metrics: counters, gauges, timers with a global registry.
+
+Reference parity: pinot-common/.../metrics/AbstractMetrics.java +
+pinot-spi metrics SPI (pluggable yammer/dropwizard backends). The registry
+snapshot serves the /metrics endpoints of the cluster roles; a Prometheus
+text formatter is a render method away.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._timers: Dict[str, List[float]] = {}
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                self._timers.setdefault(name, []).append(dt)
+                if len(self._timers[name]) > 1024:  # bound memory
+                    self._timers[name] = self._timers[name][-512:]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            timers = {}
+            for name, vals in self._timers.items():
+                if not vals:
+                    continue
+                s = sorted(vals)
+                timers[name] = {
+                    "count": len(s),
+                    "p50": s[len(s) // 2],
+                    "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+                    "max": s[-1],
+                }
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges),
+                    "timers": timers}
+
+    def prometheus(self) -> str:
+        snap = self.snapshot()
+        lines = []
+        for k, v in snap["counters"].items():
+            lines.append(f"pinot_tpu_{k}_total {v}")
+        for k, v in snap["gauges"].items():
+            lines.append(f"pinot_tpu_{k} {v}")
+        for k, t in snap["timers"].items():
+            lines.append(f"pinot_tpu_{k}_ms_p50 {t['p50']:.3f}")
+            lines.append(f"pinot_tpu_{k}_ms_p99 {t['p99']:.3f}")
+        return "\n".join(lines) + "\n"
+
+
+global_metrics = MetricsRegistry()
