@@ -132,16 +132,26 @@ class BrokerNode:
         assignment = self._route(ctx.table)
 
         if stmt.explain:
-            # plan shape is identical across servers: ask any holder
+            # plan shape is identical across servers: ask any holder, with
+            # the same failover + failure-detector recording as the data path
             for seg, holders in assignment.items():
-                pick = self._pick_replica(holders)
-                if pick is None:
-                    continue
-                resp = http_json("POST", f"{self._server_url(pick)}/query",
-                                 {"sql": sql})
-                exp = resp.get("explain", {})
-                return ResultTable(exp.get("columns", []),
-                                   [tuple(r) for r in exp.get("rows", [])])
+                tried = set()
+                while True:
+                    pick = self._pick_replica(
+                        [h for h in holders if h not in tried])
+                    if pick is None:
+                        break
+                    try:
+                        resp = http_json(
+                            "POST", f"{self._server_url(pick)}/query",
+                            {"sql": sql})
+                    except Exception:
+                        tried.add(pick)
+                        self._failures.record_failure(pick)
+                        continue
+                    exp = resp.get("explain", {})
+                    return ResultTable(exp.get("columns", []),
+                                       [tuple(r) for r in exp.get("rows", [])])
             raise SqlError("no live replica to explain against")
 
         # scatter: group segments by chosen replica

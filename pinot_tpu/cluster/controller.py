@@ -35,6 +35,7 @@ class Controller:
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self._lock = threading.RLock()
+        self._routing_cache: Optional[Dict[str, Any]] = None
         self.heartbeat_timeout = heartbeat_timeout
         self.reconcile_interval = reconcile_interval
         self._state: Dict[str, Any] = self._load() or {
@@ -115,7 +116,13 @@ class Controller:
         with self._lock:
             if table not in self._state["tables"]:
                 raise KeyError(f"table {table!r} not registered")
+            prev = self._state["segments"][table].get(segment)
             self._state["segments"][table][segment] = {"location": location}
+            if prev is not None and prev.get("location") != location:
+                # segment refresh/replace: assignment may be unchanged but
+                # servers must re-download — force a version bump so their
+                # assignment sync sees it (segment refresh message analog)
+                self._bump()
             self._reconcile_locked()
 
     # -- assignment / reconciliation ---------------------------------------
@@ -156,20 +163,32 @@ class Controller:
     # -- views -------------------------------------------------------------
     def routing_snapshot(self) -> Dict[str, Any]:
         with self._lock:
-            return {
-                "version": self._state["version"],
-                "tables": {
-                    t: {"schema": m["schema"], "config": m["config"]}
-                    for t, m in self._state["tables"].items()},
-                "assignment": json.loads(json.dumps(
-                    self._state["assignment"])),
-                "segments": json.loads(json.dumps(self._state["segments"])),
-                "instances": {
-                    i["id"]: {"host": i["host"], "port": i["port"],
-                              "role": i.get("role")}
-                    for i in self._instances.values()},
-                "liveServers": self.live_servers(),
-            }
+            # cache the expensive deep copy per version: brokers poll this
+            # endpoint continuously and the state only changes on _bump()
+            cached = self._routing_cache
+            if cached is not None and cached["version"] == \
+                    self._state["version"]:
+                snap = dict(cached)
+            else:
+                snap = {
+                    "version": self._state["version"],
+                    "tables": {
+                        t: {"schema": m["schema"], "config": m["config"]}
+                        for t, m in self._state["tables"].items()},
+                    "assignment": json.loads(json.dumps(
+                        self._state["assignment"])),
+                    "segments": json.loads(json.dumps(
+                        self._state["segments"])),
+                }
+                self._routing_cache = snap
+                snap = dict(snap)
+            # liveness is heartbeat-driven, not version-driven: always fresh
+            snap["instances"] = {
+                i["id"]: {"host": i["host"], "port": i["port"],
+                          "role": i.get("role")}
+                for i in self._instances.values()}
+            snap["liveServers"] = self.live_servers()
+            return snap
 
     def server_assignment(self, instance_id: str) -> Dict[str, Any]:
         with self._lock:

@@ -26,7 +26,17 @@ class JsonHandler(BaseHTTPRequestHandler):
         body = None
         length = int(self.headers.get("Content-Length") or 0)
         if length:
-            body = json.loads(self.rfile.read(length))
+            try:
+                body = json.loads(self.rfile.read(length))
+            except ValueError as e:
+                data = json.dumps(
+                    {"error": f"malformed JSON body: {e}"}).encode()
+                self.send_response(400)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
         for (m, prefix), fn in sorted(self.routes.items(),
                                       key=lambda kv: -len(kv[0][1])):
             if m == method and self.path.split("?")[0].startswith(prefix):

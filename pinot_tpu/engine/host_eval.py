@@ -16,8 +16,9 @@ import numpy as np
 
 from ..query.context import AggExpr, QueryContext
 from ..query.sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr,
-                         Comparison, FuncCall, Identifier, InList, IsNull,
-                         Like, Literal, SqlError, Star)
+                         CaseWhen, Cast, Comparison, FuncCall, Identifier,
+                         InList, IsNull, Like, Literal, SqlError, Star)
+from ..query import functions as F
 from ..segment.immutable import ImmutableSegment
 
 
@@ -43,7 +44,62 @@ def eval_value(e: Any, seg: ImmutableSegment,
         if e.op == "%":
             return l % r
         raise SqlError(f"unknown op {e.op}")
+    if isinstance(e, FuncCall):
+        return _eval_func(e, seg, sel)
+    if isinstance(e, CaseWhen):
+        return _eval_case(e, seg, sel)
+    if isinstance(e, Cast):
+        return F.cast_value(eval_value(e.expr, seg, sel), e.type_name)
     raise SqlError(f"unsupported value expression {e!r}")
+
+
+def _eval_func(e: FuncCall, seg: ImmutableSegment,
+               sel: Optional[np.ndarray]) -> np.ndarray:
+    fd = F.lookup(e.name)
+    if fd is None:
+        raise SqlError(f"unknown function {e.name!r}")
+    # dictionary fast path (TransformFunction-over-dictionary analog):
+    # an elementwise function of one dict-encoded column evaluates once per
+    # dictionary value, then gathers by dict id — O(cardinality) not O(rows)
+    col_args = [a for a in e.args if not isinstance(a, Literal)]
+    if (fd.elementwise and len(col_args) == 1
+            and isinstance(col_args[0], Identifier)):
+        name = col_args[0].name
+        m = seg.columns.get(name)
+        if m is not None and m.has_dict and \
+                not getattr(m, "is_multi_value", False):
+            d = seg.dictionary(name)
+            dvals = np.asarray(d.values)
+            args = [dvals if a is col_args[0] else a.value for a in e.args]
+            per_value = np.asarray(F.call(e.name, *args))
+            if per_value.ndim == 1 and len(per_value) == len(dvals):
+                ids = np.asarray(seg.fwd(name)).astype(np.int64)
+                if sel is not None:
+                    ids = ids[sel]
+                return per_value[ids]
+    args = [a.value if isinstance(a, Literal) else eval_value(a, seg, sel)
+            for a in e.args]
+    return np.asarray(F.call(e.name, *args))
+
+
+def _eval_case(e: CaseWhen, seg: ImmutableSegment,
+               sel: Optional[np.ndarray]) -> np.ndarray:
+    conds = []
+    vals = []
+    for cond, res in e.whens:
+        m = eval_filter(cond, seg)
+        conds.append(m[sel] if sel is not None else m)
+        vals.append(np.asarray(eval_value(res, seg, sel)))
+    if e.else_ is not None:
+        default = np.asarray(eval_value(e.else_, seg, sel))
+    else:
+        stringy = any(v.dtype == object or v.dtype.kind in "US"
+                      for v in vals)
+        default = np.asarray(None if stringy else np.nan)
+    n = len(conds[0])
+    vals = [np.broadcast_to(v, (n,)) for v in vals]
+    default = np.broadcast_to(default, (n,))
+    return np.select(conds, vals, default=default)
 
 
 def _like_regex(pattern: str) -> "re.Pattern":
@@ -114,6 +170,13 @@ def eval_filter(e: Any, seg: ImmutableSegment) -> np.ndarray:
         return ~m if e.negated else m
     if isinstance(e, Literal) and isinstance(e.value, bool):
         return np.full(n, e.value, dtype=bool)
+    if isinstance(e, (FuncCall, Identifier, Cast, CaseWhen)):
+        # boolean-valued expression used as a predicate
+        # (startsWith(col, 'x'), boolean column, ...)
+        v = np.asarray(eval_value(e, seg))
+        if v.dtype != bool:
+            v = v.astype(bool)
+        return np.broadcast_to(v, (n,)).copy()
     raise SqlError(f"unsupported filter {e!r}")
 
 

@@ -12,10 +12,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..query.context import AggExpr, QueryContext, _expr_label
+from ..query import functions as F
 from ..query.sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr,
-                         Comparison, FuncCall, Identifier, InList, Literal,
-                         SqlError, Star)
+                         CaseWhen, Cast, Comparison, FuncCall, Identifier,
+                         InList, IsNull, Literal, SqlError, Star)
 from .executor import AggPartial, GroupByPartial, SelectionPartial
 
 DEFAULT_LIMIT = 10  # Pinot's default LIMIT for selection/group-by results
@@ -103,9 +106,13 @@ def _reduce_aggregation(ctx: QueryContext, partials: List[AggPartial]
     for p in partials:
         for i, k in enumerate(kinds):
             merged[i] = merge_state(k, merged[i], p.states[i])
-    finalized = {ctx.aggregations[i].label: finalize_state(k, merged[i])
-                 for i, k in enumerate(kinds)}
-    row = tuple(finalized[item.label] for item in ctx.select_items)
+    env = {ctx.aggregations[i].label: finalize_state(k, merged[i])
+           for i, k in enumerate(kinds)}
+    if ctx.having is not None and not _eval_scalar_bool(ctx.having, env):
+        return ResultTable(list(ctx.labels), [])
+    row = tuple(env[item.label] if isinstance(item, AggExpr)
+                else _eval_scalar(item, env)
+                for item in ctx.select_items)
     labels = [l for item, l in zip(ctx.select_items, ctx.labels)]
     return ResultTable(labels, [row])
 
@@ -138,6 +145,8 @@ def _reduce_group_by(ctx: QueryContext, partials: List[GroupByPartial]
             continue
         row = tuple(env[item.label] if isinstance(item, AggExpr)
                     else env[_expr_label(item)]
+                    if _expr_label(item) in env
+                    else _eval_scalar(item, env)
                     for item in ctx.select_items)
         rows.append((row, env))  # env kept for ORDER BY evaluation
 
@@ -218,6 +227,11 @@ def _eval_scalar(e: Any, env: Dict[str, Any]) -> Any:
         label = _expr_label(e)
         if label in env:
             return env[label]
+        if F.lookup(e.name) is not None:
+            args = [_eval_scalar(a, env) for a in e.args]
+            out = F.call(e.name, *args)
+            return out.item() if hasattr(out, "item") and \
+                np.asarray(out).ndim == 0 else out
         raise SqlError(f"unknown function result {label!r}")
     if isinstance(e, Identifier):
         if e.name in env:
@@ -225,9 +239,19 @@ def _eval_scalar(e: Any, env: Dict[str, Any]) -> Any:
         raise SqlError(f"unknown output column {e.name!r}")
     if isinstance(e, Literal):
         return e.value
+    if isinstance(e, CaseWhen):
+        for cond, res in e.whens:
+            if _eval_scalar_bool(cond, env):
+                return _eval_scalar(res, env)
+        return None if e.else_ is None else _eval_scalar(e.else_, env)
+    if isinstance(e, Cast):
+        v = F.cast_value(_eval_scalar(e.expr, env), e.type_name)
+        return v.item() if np.asarray(v).ndim == 0 else v
     if isinstance(e, BinaryOp):
         l = _eval_scalar(e.lhs, env)
         r = _eval_scalar(e.rhs, env)
+        if l is None or r is None:
+            return None
         if e.op == "+":
             return l + r
         if e.op == "-":
@@ -261,4 +285,10 @@ def _eval_scalar_bool(e: Any, env: Dict[str, Any]) -> bool:
         v = _eval_scalar(e.expr, env)
         ok = v in {x.value for x in e.values}
         return not ok if e.negated else ok
+    if isinstance(e, IsNull):
+        v = _eval_scalar(e.expr, env)
+        isnull = v is None or (isinstance(v, float) and v != v)
+        return not isnull if e.negated else isnull
+    if isinstance(e, (FuncCall, Literal, CaseWhen, Cast)):
+        return bool(_eval_scalar(e, env))
     raise SqlError(f"unsupported HAVING expression {e!r}")

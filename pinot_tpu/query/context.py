@@ -11,9 +11,10 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, List, Optional, Tuple
 
-from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, Comparison,
-                  FuncCall, Identifier, InList, IsNull, Like, Literal,
-                  OrderItem, SelectStmt, SqlError, Star)
+from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, CaseWhen,
+                  Cast, Comparison, FuncCall, Identifier, InList, IsNull,
+                  Like, Literal, OrderItem, SelectStmt, SqlError, Star,
+                  ast_children, collect_identifiers)
 
 AGG_FUNCS = {
     "count": "count",
@@ -72,6 +73,15 @@ def _expr_label(e: Any) -> str:
         return f"{e.name}({d}{inner})"
     if isinstance(e, BinaryOp):
         return f"({_expr_label(e.lhs)}{e.op}{_expr_label(e.rhs)})"
+    if isinstance(e, Comparison):
+        return f"({_expr_label(e.lhs)}{e.op}{_expr_label(e.rhs)})"
+    if isinstance(e, CaseWhen):
+        parts = " ".join(f"when {_expr_label(c)} then {_expr_label(v)}"
+                         for c, v in e.whens)
+        tail = f" else {_expr_label(e.else_)}" if e.else_ is not None else ""
+        return f"case {parts}{tail} end"
+    if isinstance(e, Cast):
+        return f"cast({_expr_label(e.expr)} as {e.type_name})"
     return str(e)
 
 
@@ -80,11 +90,8 @@ def _find_aggs(e: Any, out: List[FuncCall]) -> None:
         if e.name in AGG_FUNCS or (e.name == "count" and e.distinct):
             out.append(e)
             return
-        for a in e.args:
-            _find_aggs(a, out)
-    elif isinstance(e, BinaryOp):
-        _find_aggs(e.lhs, out)
-        _find_aggs(e.rhs, out)
+    for a in ast_children(e):
+        _find_aggs(a, out)
 
 
 def build_query_context(stmt: SelectStmt) -> QueryContext:
@@ -116,24 +123,36 @@ def build_query_context(stmt: SelectStmt) -> QueryContext:
     for item in stmt.select:
         e = item.expr
         if isinstance(e, Star):
+            if stmt.distinct:
+                raise SqlError("SELECT DISTINCT * not supported")
             select_items.append(Star())
             labels.append("*")
             continue
         found: List[FuncCall] = []
         _find_aggs(e, found)
         if found:
-            if not (isinstance(e, FuncCall) and e in found):
-                raise SqlError("post-aggregation expressions not yet "
-                               f"supported: {_expr_label(e)}")
-            agg = register_agg(e)
-            select_items.append(agg)
-            labels.append(item.alias or agg.label)
+            if isinstance(e, FuncCall) and e in found:
+                agg = register_agg(e)
+                select_items.append(agg)
+                labels.append(item.alias or agg.label)
+            else:
+                # post-aggregation expression (PostAggregationHandler
+                # analog): register inner aggs, evaluate the expression
+                # over finalized values at reduce
+                for fc in found:
+                    register_agg(fc)
+                select_items.append(e)
+                labels.append(item.alias or _expr_label(e))
         else:
             select_items.append(e)
             labels.append(item.alias or _expr_label(e))
-            if group_by and _expr_label(e) not in group_labels:
-                raise SqlError(f"non-aggregate select column "
-                               f"{_expr_label(e)!r} must appear in GROUP BY")
+            if group_by and _expr_label(e) not in group_labels \
+                    and not _keys_only(e, group_by):
+                # expressions over group keys compute at reduce; anything
+                # referencing non-grouped columns is a user error
+                raise SqlError(
+                    f"non-aggregate select column "
+                    f"{_expr_label(e)!r} must appear in GROUP BY")
 
     # register aggs appearing only in HAVING / ORDER BY so partials exist
     for extra in ([stmt.having] if stmt.having else []) + \
@@ -143,14 +162,26 @@ def build_query_context(stmt: SelectStmt) -> QueryContext:
         for fc in found:
             register_agg(fc)
 
-    if group_by and not aggregations:
-        raise SqlError("GROUP BY without aggregations not supported yet "
-                       "(use DISTINCT semantics in a later round)")
     if aggregations:
         bad = [i for i in select_items
-               if not isinstance(i, AggExpr) and not _is_group_key(i, group_by)]
+               if not isinstance(i, AggExpr) and not _is_group_key(i, group_by)
+               and not _find_aggs_present(i)
+               and not _keys_only(i, group_by)]
         if bad:
             raise SqlError(f"selection columns mixed with aggregations: {bad}")
+
+    if stmt.distinct:
+        # SELECT DISTINCT a, b == group-by on the select expressions with a
+        # hidden aggregation (DistinctOperator analog: the group-by engine
+        # IS the distinct engine; dictionary path stays device-resident)
+        if aggregations:
+            raise SqlError("SELECT DISTINCT with aggregations not supported")
+        group_by = list(select_items)
+    if group_by and not aggregations:
+        # plain GROUP BY / DISTINCT: synthesize a hidden COUNT(*) so every
+        # execution path (kernel, host, fast) has a mergeable state; reduce
+        # projects only select_items so it never surfaces
+        aggregations.append(AggExpr("count", None, "count(*)"))
 
     # Pinot applies the default LIMIT 10 at compile time
     # (CalciteSqlParser DEFAULT_SELECTION_LIMIT analog); doing the same here
@@ -177,3 +208,19 @@ def build_query_context(stmt: SelectStmt) -> QueryContext:
 def _is_group_key(e: Any, group_by: List[Any]) -> bool:
     lbl = _expr_label(e)
     return any(_expr_label(g) == lbl for g in group_by)
+
+
+def _find_aggs_present(e: Any) -> bool:
+    found: List[FuncCall] = []
+    _find_aggs(e, found)
+    return bool(found)
+
+
+def _keys_only(e: Any, group_by: List[Any]) -> bool:
+    """Expression over group keys only (computable at reduce)."""
+    if not group_by:
+        return False
+    group_cols: set = set()
+    for g in group_by:
+        collect_identifiers(g, group_cols)
+    return collect_identifiers(e) <= group_cols

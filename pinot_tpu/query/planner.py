@@ -499,24 +499,13 @@ class SegmentPlanner:
         ctx = self.ctx
         names: List[str] = []
 
+        from .sql import ast_children
+
         def walk(e: Any) -> None:
             if isinstance(e, Identifier):
                 names.append(e.name)
-            elif isinstance(e, (BoolAnd, BoolOr)):
-                for c in e.children:
-                    walk(c)
-            elif isinstance(e, BoolNot):
-                walk(e.child)
-            elif isinstance(e, Comparison):
-                walk(e.lhs)
-                walk(e.rhs)
-            elif isinstance(e, Between):
-                walk(e.expr)
-            elif isinstance(e, (InList, Like, IsNull)):
-                walk(e.expr)
-            elif isinstance(e, BinaryOp):
-                walk(e.lhs)
-                walk(e.rhs)
+            for c in ast_children(e):
+                walk(c)
 
         walk(ctx.filter)
         for g in ctx.group_by:
@@ -543,7 +532,12 @@ class SegmentPlanner:
         if not ctx.is_aggregation:
             return CompiledPlan("host", seg, ctx)  # selection: host path
 
-        pred = self.resolve_filter(ctx.filter)
+        try:
+            pred = self.resolve_filter(ctx.filter)
+        except PlanError:
+            # filter uses expressions without a device lowering (scalar
+            # functions, CASE, ...) -> vectorized host path
+            return CompiledPlan("host", seg, ctx)
         if isinstance(pred, FalseP) :
             return CompiledPlan("pruned", seg, ctx)
 

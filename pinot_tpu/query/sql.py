@@ -106,6 +106,18 @@ class IsNull:
 
 
 @dataclass(frozen=True)
+class CaseWhen:
+    whens: Tuple[Tuple[Any, Any], ...]  # (condition, result) pairs
+    else_: Any = None
+
+
+@dataclass(frozen=True)
+class Cast:
+    expr: Any
+    type_name: str  # lowercased target type
+
+
+@dataclass(frozen=True)
 class BoolAnd:
     children: Tuple[Any, ...]
 
@@ -153,6 +165,7 @@ class JoinClause:
 class SelectStmt:
     select: List[SelectItem]
     table: str
+    distinct: bool = False
     table_alias: Optional[str] = None
     joins: List[JoinClause] = field(default_factory=list)
     where: Optional[Any] = None
@@ -184,6 +197,7 @@ KEYWORDS = {
     "as", "asc", "desc", "distinct", "true", "false", "option",
     "join", "on", "left", "right", "inner", "outer", "cross", "full",
     "explain",  # 'plan'/'for' stay contextual: valid column names elsewhere
+    "case", "when", "then", "else", "end", "cast",
 }
 
 
@@ -284,10 +298,11 @@ class _Parser:
                                    f"at {t2.pos}")
             explain = True
         self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
         select = self.select_list()
         self.expect_kw("from")
         base = self.table_ref()
-        stmt = SelectStmt(select=select, table=base.name,
+        stmt = SelectStmt(select=select, table=base.name, distinct=distinct,
                           table_alias=base.alias)
         while True:
             jt = None
@@ -517,6 +532,18 @@ class _Parser:
             return Literal(t.value == "true")
         if t.kind == "kw" and t.value == "null":
             return Literal(None)
+        if t.kind == "kw" and t.value == "case":
+            return self.case_expr()
+        if t.kind == "kw" and t.value == "cast":
+            # CAST(expr AS type)
+            self.expect_op("(")
+            inner = self.add_expr()
+            self.expect_kw("as")
+            tt = self.next()
+            if tt.kind not in ("ident", "kw"):
+                raise SqlError(f"expected type name at {tt.pos}")
+            self.expect_op(")")
+            return Cast(inner, str(tt.value).lower())
         if t.kind == "op" and t.value == "(":
             e = self.add_expr()
             self.expect_op(")")
@@ -540,6 +567,63 @@ class _Parser:
                 return FuncCall(t.value.lower(), tuple(args), distinct)
             return Identifier(t.value)
         raise SqlError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def case_expr(self) -> CaseWhen:
+        """CASE [operand] WHEN cond THEN val ... [ELSE val] END.
+
+        The simple form (CASE x WHEN v THEN ...) desugars into the searched
+        form with equality conditions, which is how Calcite normalizes it."""
+        operand = None
+        if not (self.peek().kind == "kw" and self.peek().value == "when"):
+            operand = self.add_expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.or_expr() if operand is None else \
+                Comparison("==", operand, self.add_expr())
+            self.expect_kw("then")
+            whens.append((cond, self.add_expr()))
+        if not whens:
+            raise SqlError(f"CASE needs at least one WHEN at "
+                           f"{self.peek().pos}")
+        else_ = None
+        if self.accept_kw("else"):
+            else_ = self.add_expr()
+        self.expect_kw("end")
+        return CaseWhen(tuple(whens), else_)
+
+
+def ast_children(e: Any) -> Tuple[Any, ...]:
+    """Immediate sub-expressions of any AST node (generic walker support)."""
+    if isinstance(e, FuncCall):
+        return e.args
+    if isinstance(e, (BinaryOp, Comparison)):
+        return (e.lhs, e.rhs)
+    if isinstance(e, (BoolAnd, BoolOr)):
+        return e.children
+    if isinstance(e, BoolNot):
+        return (e.child,)
+    if isinstance(e, Between):
+        return (e.expr, e.lo, e.hi)
+    if isinstance(e, (InList, Like, IsNull)):
+        return (e.expr,)
+    if isinstance(e, CaseWhen):
+        out = [x for w in e.whens for x in w]
+        if e.else_ is not None:
+            out.append(e.else_)
+        return tuple(out)
+    if isinstance(e, Cast):
+        return (e.expr,)
+    return ()
+
+
+def collect_identifiers(e: Any, out: Optional[set] = None) -> set:
+    if out is None:
+        out = set()
+    if isinstance(e, Identifier):
+        out.add(e.name)
+    for c in ast_children(e):
+        collect_identifiers(c, out)
+    return out
 
 
 def parse_sql(sql: str) -> SelectStmt:

@@ -1,0 +1,287 @@
+"""Scalar/transform function + expression-surface suite vs numpy oracle.
+
+Reference test strategy analog: pinot-core transform-function tests
+(operator/transform/function/*Test) and post-aggregation tests, run
+through the full broker path like BaseQueriesTest.
+"""
+import datetime
+import math
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.query.functions import call as fcall
+from pinot_tpu.query.sql import SqlError
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+N = 3000
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    base = int(datetime.datetime(2024, 1, 1,
+                                 tzinfo=datetime.timezone.utc).timestamp()
+               * 1000)
+    return {
+        "name": rng.choice(["Alpha", "beta", "Gamma_X", "delta",
+                            "Epsilon"], N),
+        "grp": rng.choice(["g1", "g2", "g3"], N),
+        "val": rng.integers(-50, 200, N).astype(np.int64),
+        "price": np.round(rng.uniform(0.5, 99.5, N), 4),
+        "ts": (base + rng.integers(0, 90 * 86_400_000, N)).astype(np.int64),
+    }
+
+
+@pytest.fixture(scope="module")
+def broker(data, tmp_path_factory):
+    schema = Schema("fx", [
+        FieldSpec("name", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("grp", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("val", DataType.LONG, FieldType.METRIC),
+        FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("ts", DataType.TIMESTAMP, FieldType.DIMENSION),
+    ])
+    out = tmp_path_factory.mktemp("fx_table")
+    builder = SegmentBuilder(schema, TableConfig("fx"))
+    dm = TableDataManager("fx")
+    for i, (lo, hi) in enumerate(((0, 1000), (1000, 2000), (2000, N))):
+        chunk = {k: v[lo:hi] for k, v in data.items()}
+        dm.add_segment_dir(builder.build(chunk, str(out), f"seg_{i}"))
+    b = Broker()
+    b.register_table(dm)
+    return b
+
+
+def one(res):
+    assert len(res.rows) == 1, res.rows
+    return tuple(res.rows[0])
+
+
+# ---------------------------------------------------------------------------
+# registry unit behavior
+# ---------------------------------------------------------------------------
+
+def test_math_functions_vectorized():
+    v = np.array([-2.5, 0.0, 3.7])
+    assert np.allclose(fcall("abs", v), np.abs(v))
+    assert np.allclose(fcall("ceil", v), np.ceil(v))
+    assert np.allclose(fcall("floor", v), np.floor(v))
+    assert np.allclose(fcall("sqrt", np.abs(v)), np.sqrt(np.abs(v)))
+    assert np.allclose(fcall("power", v, 2), v ** 2)
+    assert np.allclose(fcall("least", v, 0.0), np.minimum(v, 0))
+    assert np.allclose(fcall("greatest", v, 0.0), np.maximum(v, 0))
+    assert np.allclose(fcall("round", np.array([1.234, 5.678]), 1),
+                       [1.2, 5.7])
+    assert np.allclose(fcall("truncate", np.array([1.239, -5.678]), 2),
+                       [1.23, -5.67])
+
+
+def test_string_functions_vectorized():
+    v = np.array(["Hello", "World Cup", ""], dtype=object)
+    assert list(fcall("upper", v)) == ["HELLO", "WORLD CUP", ""]
+    assert list(fcall("lower", v)) == ["hello", "world cup", ""]
+    assert list(fcall("length", v)) == [5, 9, 0]
+    assert list(fcall("reverse", v)) == ["olleH", "puC dlroW", ""]
+    assert list(fcall("substr", v, 1, 3)) == ["el", "or", ""]
+    assert list(fcall("replace", v, "o", "0")) == ["Hell0", "W0rld Cup", ""]
+    assert list(fcall("startswith", v, "He")) == [True, False, False]
+    assert list(fcall("contains", v, "l")) == [True, True, False]
+    assert list(fcall("strpos", v, "l")) == [2, 3, -1]
+    assert list(fcall("lpad", v, 7, "*")) == ["**Hello", "World C", "*******"]
+    assert list(fcall("splitpart", np.array(["a,b,c"], dtype=object),
+                      ",", 1)) == ["b"]
+
+
+def test_datetime_functions():
+    # 2024-03-15T10:30:45.123Z
+    ms = int(datetime.datetime(2024, 3, 15, 10, 30, 45, 123000,
+                               tzinfo=datetime.timezone.utc).timestamp()
+             * 1000)
+    v = np.array([ms], dtype=np.int64)
+    assert list(fcall("year", v)) == [2024]
+    assert list(fcall("month", v)) == [3]
+    assert list(fcall("day", v)) == [15]
+    assert list(fcall("hour", v)) == [10]
+    assert list(fcall("minute", v)) == [30]
+    assert list(fcall("second", v)) == [45]
+    assert list(fcall("millisecond", v)) == [123]
+    assert list(fcall("dayofweek", v)) == [5]   # friday, ISO 1=mon
+    assert list(fcall("quarter", v)) == [1]
+    assert list(fcall("toepochdays", v)) == [ms // 86_400_000]
+    assert list(fcall("fromepochdays", fcall("toepochdays", v))) == \
+        [ms // 86_400_000 * 86_400_000]
+    trunc_day = fcall("datetrunc", "day", v)
+    assert list(fcall("hour", trunc_day)) == [0]
+    assert list(fcall("todatetime", v, "yyyy-MM-dd")) == ["2024-03-15"]
+    assert list(fcall("fromdatetime", np.array(["2024-03-15"], dtype=object),
+                      "yyyy-MM-dd")) == [ms - ms % 86_400_000]
+    plus = fcall("timestampadd", "month", np.int64(1), v)
+    assert list(fcall("month", plus)) == [4]
+    assert fcall("timestampdiff", "day",
+                 v - 86_400_000 * 3, v).tolist() == [3]
+
+
+def test_json_extract_scalar():
+    docs = np.array(['{"a": {"b": 7}, "l": [1, 2, 3]}',
+                     '{"a": {"b": 9}}', 'not json'], dtype=object)
+    assert list(fcall("jsonextractscalar", docs, "$.a.b", "LONG", 0)) == \
+        [7, 9, 0]
+    assert list(fcall("jsonextractscalar", docs, "$.l[1]", "LONG", -1)) == \
+        [2, -1, -1]
+
+
+# ---------------------------------------------------------------------------
+# full-path: functions in WHERE / SELECT / GROUP BY
+# ---------------------------------------------------------------------------
+
+def test_function_in_where(broker, data):
+    res = broker.query(
+        "SELECT COUNT(*) FROM fx WHERE LOWER(name) = 'alpha'")
+    expect = int(np.sum(np.char.lower(data["name"].astype(str)) == "alpha"))
+    assert one(res) == (expect,)
+
+
+def test_startswith_predicate(broker, data):
+    res = broker.query(
+        "SELECT COUNT(*) FROM fx WHERE STARTSWITH(name, 'G')")
+    expect = int(np.sum(np.char.startswith(data["name"].astype(str), "G")))
+    assert one(res) == (expect,)
+
+
+def test_function_group_by(broker, data):
+    res = broker.query(
+        "SELECT UPPER(grp), COUNT(*) FROM fx GROUP BY UPPER(grp) "
+        "ORDER BY UPPER(grp)")
+    names = np.char.upper(data["grp"].astype(str))
+    expect = [(g, int(np.sum(names == g))) for g in sorted(set(names))]
+    assert [tuple(r) for r in res.rows] == expect
+
+
+def test_abs_in_aggregation(broker, data):
+    res = broker.query("SELECT SUM(ABS(val)) FROM fx")
+    assert one(res)[0] == pytest.approx(float(np.abs(data["val"]).sum()))
+
+
+def test_datetime_group_by(broker, data):
+    res = broker.query(
+        "SELECT MONTH(ts), COUNT(*) FROM fx GROUP BY MONTH(ts) "
+        "ORDER BY MONTH(ts)")
+    months = fcall("month", data["ts"])
+    expect = [(int(m), int(np.sum(months == m)))
+              for m in sorted(set(months.tolist()))]
+    assert [tuple(r) for r in res.rows] == expect
+
+
+# ---------------------------------------------------------------------------
+# CASE / CAST
+# ---------------------------------------------------------------------------
+
+def test_case_when_in_select_aggregation(broker, data):
+    res = broker.query(
+        "SELECT SUM(CASE WHEN val > 0 THEN val ELSE 0 END) FROM fx")
+    expect = float(np.where(data["val"] > 0, data["val"], 0).sum())
+    assert one(res)[0] == pytest.approx(expect)
+
+
+def test_simple_case_form(broker, data):
+    res = broker.query(
+        "SELECT SUM(CASE grp WHEN 'g1' THEN 1 ELSE 0 END) FROM fx")
+    assert one(res)[0] == pytest.approx(
+        float(np.sum(data["grp"] == "g1")))
+
+
+def test_cast(broker, data):
+    res = broker.query("SELECT SUM(CAST(price AS LONG)) FROM fx")
+    expect = float(data["price"].astype(np.int64).sum())
+    assert one(res)[0] == pytest.approx(expect)
+
+
+# ---------------------------------------------------------------------------
+# post-aggregation expressions
+# ---------------------------------------------------------------------------
+
+def test_post_aggregation_arith(broker, data):
+    res = broker.query(
+        "SELECT SUM(val) / COUNT(*) AS m, MAX(price) - MIN(price) FROM fx")
+    r = one(res)
+    assert r[0] == pytest.approx(data["val"].sum() / N)
+    assert r[1] == pytest.approx(float(data["price"].max()
+                                       - data["price"].min()))
+
+
+def test_post_aggregation_group_by(broker, data):
+    res = broker.query(
+        "SELECT grp, SUM(val) / COUNT(*) AS avg_val FROM fx "
+        "GROUP BY grp ORDER BY grp")
+    expect = []
+    for g in sorted(set(data["grp"].tolist())):
+        m = data["grp"] == g
+        expect.append((g, data["val"][m].sum() / m.sum()))
+    assert [r[0] for r in res.rows] == [e[0] for e in expect]
+    for r, e in zip(res.rows, expect):
+        assert r[1] == pytest.approx(e[1])
+
+
+def test_post_aggregation_having(broker, data):
+    res = broker.query(
+        "SELECT grp, COUNT(*) FROM fx GROUP BY grp "
+        "HAVING COUNT(*) * 2 > 100 ORDER BY grp")
+    expect = [(g, int(np.sum(data["grp"] == g)))
+              for g in sorted(set(data["grp"].tolist()))
+              if np.sum(data["grp"] == g) * 2 > 100]
+    assert [tuple(r) for r in res.rows] == expect
+
+
+def test_post_aggregation_function(broker, data):
+    res = broker.query("SELECT SQRT(SUM(ABS(val))) FROM fx")
+    assert one(res)[0] == pytest.approx(
+        math.sqrt(float(np.abs(data["val"]).sum())))
+
+
+# ---------------------------------------------------------------------------
+# SELECT DISTINCT / GROUP BY without aggregation
+# ---------------------------------------------------------------------------
+
+def test_select_distinct(broker, data):
+    res = broker.query("SELECT DISTINCT grp FROM fx ORDER BY grp")
+    assert [r[0] for r in res.rows] == sorted(set(data["grp"].tolist()))
+
+
+def test_select_distinct_two_cols(broker, data):
+    res = broker.query(
+        "SELECT DISTINCT grp, name FROM fx ORDER BY grp, name LIMIT 100")
+    expect = sorted({(g, n) for g, n in zip(data["grp"].tolist(),
+                                            data["name"].tolist())})
+    assert [tuple(r) for r in res.rows] == expect
+
+
+def test_group_by_no_agg(broker, data):
+    res = broker.query(
+        "SELECT grp FROM fx GROUP BY grp ORDER BY grp")
+    assert [r[0] for r in res.rows] == sorted(set(data["grp"].tolist()))
+
+
+def test_distinct_with_filter(broker, data):
+    res = broker.query(
+        "SELECT DISTINCT name FROM fx WHERE val > 100 ORDER BY name")
+    expect = sorted(set(data["name"][data["val"] > 100].tolist()))
+    assert [r[0] for r in res.rows] == expect
+
+
+# ---------------------------------------------------------------------------
+# errors
+# ---------------------------------------------------------------------------
+
+def test_unknown_function_rejected(broker):
+    with pytest.raises(SqlError):
+        broker.query("SELECT NOSUCHFN(val) FROM fx")
+
+
+def test_nongrouped_select_rejected(broker):
+    with pytest.raises(SqlError):
+        broker.query("SELECT name, COUNT(*) FROM fx GROUP BY grp")
