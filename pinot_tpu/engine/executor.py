@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..ops import aggregations
 from ..ops.kernels import jitted_kernel
 from ..query.context import QueryContext
 from ..query.planner import AggBinding, CompiledPlan, SegmentPlanner
@@ -44,13 +45,9 @@ def empty_partial(ctx: QueryContext):
     if ctx.is_group_by:
         return GroupByPartial({})
     if ctx.is_aggregation:
-        return AggPartial([_empty_state(a.kind) for a in ctx.aggregations])
+        return AggPartial([aggregations.empty_state(a)
+                           for a in ctx.aggregations])
     return SelectionPartial([], [])
-
-
-def _empty_state(kind: str) -> Any:
-    return {"count": 0, "sum": 0, "min": None, "max": None,
-            "avg": (0, 0), "distinct_count": set()}[kind]
 
 
 class SegmentExecutor:

@@ -19,6 +19,7 @@ from ..query.sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr,
                          CaseWhen, Cast, Comparison, FuncCall, Identifier,
                          InList, IsNull, Like, Literal, SqlError, Star)
 from ..query import functions as F
+from ..ops import aggregations
 from ..segment.immutable import ImmutableSegment
 
 
@@ -218,6 +219,11 @@ def host_aggregate(ctx: QueryContext, seg: ImmutableSegment,
 def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
     if agg.kind == "count":
         return int(len(sel))
+    impl = aggregations.make(agg)  # extended registry kinds
+    if impl is not None:
+        h = aggregations.HostSel(lambda ast: eval_value(ast, seg, sel),
+                                 len(sel))
+        return impl.state(h)
     vals = eval_value(agg.arg, seg, sel)
     if agg.kind == "sum":
         if len(sel) == 0:
@@ -286,6 +292,11 @@ def _group_states(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
     if agg.kind == "count":
         c = np.bincount(inv, minlength=n_groups)
         return [int(x) for x in c]
+    impl = aggregations.make(agg)  # extended registry kinds
+    if impl is not None:
+        h = aggregations.HostSel(lambda ast: eval_value(ast, seg, sel),
+                                 len(sel), inv, n_groups)
+        return impl.group_states(h)
     vals = eval_value(agg.arg, seg, sel)
     if agg.kind == "sum":
         if np.issubdtype(vals.dtype, np.integer):

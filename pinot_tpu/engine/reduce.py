@@ -16,6 +16,7 @@ import numpy as np
 
 from ..query.context import AggExpr, QueryContext, _expr_label
 from ..query import functions as F
+from ..ops import aggregations
 from ..query.sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr,
                          CaseWhen, Cast, Comparison, FuncCall, Identifier,
                          InList, IsNull, Literal, SqlError, Star)
@@ -54,34 +55,12 @@ class ResultTable:
 # state algebra
 # ---------------------------------------------------------------------------
 
-def merge_state(kind: str, a: Any, b: Any) -> Any:
-    if kind in ("count", "sum"):
-        return a + b
-    if kind == "min":
-        if a is None:
-            return b
-        if b is None:
-            return a
-        return min(a, b)
-    if kind == "max":
-        if a is None:
-            return b
-        if b is None:
-            return a
-        return max(a, b)
-    if kind == "avg":
-        return (a[0] + b[0], a[1] + b[1])
-    if kind == "distinct_count":
-        return a | b
-    raise SqlError(f"unknown aggregation kind {kind}")
+def merge_state(agg: AggExpr, a: Any, b: Any) -> Any:
+    return aggregations.merge_states(agg, a, b)
 
 
-def finalize_state(kind: str, s: Any) -> Any:
-    if kind == "avg":
-        return None if s[1] == 0 else s[0] / s[1]
-    if kind == "distinct_count":
-        return len(s)
-    return s
+def finalize_state(agg: AggExpr, s: Any) -> Any:
+    return aggregations.finalize_state(agg, s)
 
 
 # ---------------------------------------------------------------------------
@@ -101,13 +80,13 @@ def reduce_partials(ctx: QueryContext, partials: List[Any]) -> ResultTable:
 
 def _reduce_aggregation(ctx: QueryContext, partials: List[AggPartial]
                         ) -> ResultTable:
-    kinds = [a.kind for a in ctx.aggregations]
-    merged = [_empty(k) for k in kinds]
+    aggs = ctx.aggregations
+    merged = [aggregations.empty_state(a) for a in aggs]
     for p in partials:
-        for i, k in enumerate(kinds):
-            merged[i] = merge_state(k, merged[i], p.states[i])
-    env = {ctx.aggregations[i].label: finalize_state(k, merged[i])
-           for i, k in enumerate(kinds)}
+        for i, a in enumerate(aggs):
+            merged[i] = merge_state(a, merged[i], p.states[i])
+    env = {a.label: finalize_state(a, merged[i])
+           for i, a in enumerate(aggs)}
     if ctx.having is not None and not _eval_scalar_bool(ctx.having, env):
         return ResultTable(list(ctx.labels), [])
     row = tuple(env[item.label] if isinstance(item, AggExpr)
@@ -117,14 +96,9 @@ def _reduce_aggregation(ctx: QueryContext, partials: List[AggPartial]
     return ResultTable(labels, [row])
 
 
-def _empty(kind: str) -> Any:
-    return {"count": 0, "sum": 0, "min": None, "max": None,
-            "avg": (0, 0), "distinct_count": set()}[kind]
-
-
 def _reduce_group_by(ctx: QueryContext, partials: List[GroupByPartial]
                      ) -> ResultTable:
-    kinds = [a.kind for a in ctx.aggregations]
+    aggs = ctx.aggregations
     merged: Dict[Tuple, List[Any]] = {}
     for p in partials:
         for key, states in p.groups.items():
@@ -132,15 +106,15 @@ def _reduce_group_by(ctx: QueryContext, partials: List[GroupByPartial]
             if cur is None:
                 merged[key] = list(states)
             else:
-                for i, k in enumerate(kinds):
-                    cur[i] = merge_state(k, cur[i], states[i])
+                for i, a in enumerate(aggs):
+                    cur[i] = merge_state(a, cur[i], states[i])
 
     group_labels = [_expr_label(g) for g in ctx.group_by]
     rows: List[tuple] = []
     for key, states in merged.items():
         env: Dict[str, Any] = dict(zip(group_labels, key))
         for i, agg in enumerate(ctx.aggregations):
-            env[agg.label] = finalize_state(agg.kind, states[i])
+            env[agg.label] = finalize_state(agg, states[i])
         if ctx.having is not None and not _eval_scalar_bool(ctx.having, env):
             continue
         row = tuple(env[item.label] if isinstance(item, AggExpr)

@@ -20,6 +20,11 @@ def _enc_state(s: Any) -> Any:
         return {"__set__": sorted(s, key=lambda v: (str(type(v)), str(v)))}
     if isinstance(s, tuple):
         return {"__tuple__": [_enc_state(x) for x in s]}
+    if isinstance(s, dict):
+        # MODE value->count maps: JSON stringifies object keys, so ship as
+        # pairs to keep numeric keys numeric
+        return {"__dict__": [[_enc_state(k), _enc_state(v)]
+                             for k, v in s.items()]}
     return s
 
 
@@ -28,6 +33,8 @@ def _dec_state(s: Any) -> Any:
         return set(s["__set__"])
     if isinstance(s, dict) and "__tuple__" in s:
         return tuple(_dec_state(x) for x in s["__tuple__"])
+    if isinstance(s, dict) and "__dict__" in s:
+        return {_dec_state(k): _dec_state(v) for k, v in s["__dict__"]}
     return s
 
 

@@ -1,0 +1,793 @@
+"""Aggregation function registry: name resolution, mergeable states.
+
+Reference parity: pinot-core/.../query/aggregation/function/ (91 classes,
+AggregationFunctionFactory) — SUM/MIN/MAX/COUNT/AVG plus the long tail:
+variance family (VarianceAggregationFunction), skew/kurtosis, COVAR,
+MODE, MINMAXRANGE, PERCENTILE{,EST,TDIGEST,KLL} (+digit-suffixed forms),
+DISTINCTCOUNT{,HLL,BITMAP}, SUMPRECISION, BOOL_AND/OR, FIRST/LASTWITHTIME.
+
+TPU-native design: every aggregation is (vectorized per-segment state
+extraction) + (commutative merge) + (finalize at broker reduce). States are
+JSON-encodable (serde tags sets/tuples/dicts), and moment-family states are
+*raw power sums* so merge is elementwise addition — the same contract the
+device kernels use, which keeps partials interchangeable across the kernel,
+host, and rollup execution paths.
+
+The classic six (count/sum/min/max/avg/distinct_count) keep their original
+state formats (ints, scalars, (sum,count), sets) because the XLA kernel
+extract path (engine/executor.py) and star-tree rollups emit those directly.
+"""
+from __future__ import annotations
+
+import math
+import re
+from decimal import Decimal
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _sql_mod():
+    # lazy: query.context imports this module for the name registry, and
+    # query/__init__ imports context — a module-level import of query.sql
+    # here would close that cycle during package init
+    from ..query import sql
+    return sql
+
+# name (lowercased) -> kind; percentile forms handled by _PERC_RE
+AGG_NAME_TO_KIND: Dict[str, str] = {
+    "count": "count",
+    "sum": "sum",
+    "min": "min",
+    "max": "max",
+    "avg": "avg",
+    "distinctcount": "distinct_count",
+    "count_distinct": "distinct_count",
+    "distinctcountbitmap": "distinct_count",
+    "segmentpartitioneddistinctcount": "distinct_count",
+    "distinctcounthll": "distinct_count_hll",
+    "distinctcounthllplus": "distinct_count_hll",
+    "variance": "var_samp",
+    "var_samp": "var_samp",
+    "varsamp": "var_samp",
+    "var_pop": "var_pop",
+    "varpop": "var_pop",
+    "stddev": "stddev_samp",
+    "stddev_samp": "stddev_samp",
+    "stddevsamp": "stddev_samp",
+    "stddev_pop": "stddev_pop",
+    "stddevpop": "stddev_pop",
+    "skewness": "skewness",
+    "kurtosis": "kurtosis",
+    "covar_pop": "covar_pop",
+    "covar_samp": "covar_samp",
+    "mode": "mode",
+    "minmaxrange": "minmaxrange",
+    "sumprecision": "sum_precision",
+    "bool_and": "bool_and",
+    "booland": "bool_and",
+    "bool_or": "bool_or",
+    "boolor": "bool_or",
+    "firstwithtime": "first_with_time",
+    "lastwithtime": "last_with_time",
+}
+
+_PERC_RE = re.compile(r"^(percentile(?:est|tdigest|kll)?)(\d{1,2}|100)?$")
+
+_SKETCH_KINDS = {"percentileest": "percentile_sketch",
+                 "percentiletdigest": "percentile_sketch",
+                 "percentilekll": "percentile_sketch",
+                 "percentile": "percentile"}
+
+
+def is_agg_name(name: str) -> bool:
+    return name in AGG_NAME_TO_KIND or _PERC_RE.match(name) is not None
+
+
+def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
+                 ) -> Optional[Tuple[str, Any, Any, Tuple[Any, ...]]]:
+    """-> (kind, arg, arg2, params) for an aggregation call, else None.
+
+    `arg`/`arg2` are value-expression ASTs; `params` are plain literals
+    (percentile p, mode reducer, ...) baked into the AggExpr identity.
+    """
+    if name == "count" and distinct:
+        _need(name, args, 1)
+        return ("distinct_count", args[0], None, ())
+    if distinct and is_agg_name(name):
+        # the reference's single-stage engine likewise rejects DISTINCT
+        # qualifiers outside COUNT — silently dropping it would return
+        # wrong answers
+        raise _sql_mod().SqlError(
+            f"{name}(DISTINCT ...) is not supported; only "
+            "COUNT(DISTINCT ...)")
+    m = _PERC_RE.match(name)
+    if m is not None:
+        base, suffix = m.group(1), m.group(2)
+        kind = _SKETCH_KINDS[base]
+        if suffix is not None:
+            _need(name, args, 1)
+            return (kind, args[0], None, (float(suffix),))
+        if len(args) != 2:
+            raise _sql_mod().SqlError(f"{name} needs (column, percentile)")
+        p = args[1]
+        if not isinstance(p, _sql_mod().Literal) or isinstance(p.value, str):
+            raise _sql_mod().SqlError(f"{name}: percentile must be a numeric literal")
+        pv = float(p.value)
+        if not 0.0 <= pv <= 100.0:
+            raise _sql_mod().SqlError(
+                f"{name}: percentile must be in [0, 100], got {pv}")
+        return (kind, args[0], None, (pv,))
+    kind = AGG_NAME_TO_KIND.get(name)
+    if kind is None:
+        return None
+    if kind == "count":
+        return ("count", None, None, ())
+    if kind in ("covar_pop", "covar_samp"):
+        _need(name, args, 2)
+        return (kind, args[0], args[1], ())
+    if kind in ("first_with_time", "last_with_time"):
+        if len(args) not in (2, 3):  # (data, time[, 'dataType'])
+            raise _sql_mod().SqlError(f"{name} needs (dataColumn, timeColumn[, type])")
+        return (kind, args[0], args[1], ())
+    if kind == "mode":
+        if len(args) == 2:
+            r = args[1]
+            if not isinstance(r, _sql_mod().Literal):
+                raise _sql_mod().SqlError("mode: reducer must be a literal")
+            return (kind, args[0], None, (str(r.value).lower(),))
+        _need(name, args, 1)
+        return (kind, args[0], None, ("min",))
+    if kind == "distinct_count_hll":
+        if len(args) == 2:
+            r = args[1]
+            if not isinstance(r, _sql_mod().Literal):
+                raise _sql_mod().SqlError("distinctcounthll: log2m must be a literal")
+            log2m = int(r.value)
+            if not 4 <= log2m <= 20:
+                raise _sql_mod().SqlError(
+                    f"distinctcounthll: log2m must be in [4, 20], "
+                    f"got {log2m}")
+            return (kind, args[0], None, (log2m,))
+        _need(name, args, 1)
+        return (kind, args[0], None, (HLL_DEFAULT_LOG2M,))
+    _need(name, args, 1)
+    return (kind, args[0], None, ())
+
+
+def _need(name: str, args: Tuple[Any, ...], n: int) -> None:
+    if len(args) != n:
+        raise _sql_mod().SqlError(f"{name} takes {n} argument(s), got {len(args)}")
+
+
+# ---------------------------------------------------------------------------
+# host-side evaluation context
+# ---------------------------------------------------------------------------
+
+class HostSel:
+    """Selected-docs view handed to aggregation state extractors.
+
+    ev(ast) -> numpy array over the selected docs; inv/n_groups present in
+    group-by context (inv = group index per selected doc).
+    """
+    __slots__ = ("ev", "n", "inv", "n_groups")
+
+    def __init__(self, ev: Callable[[Any], np.ndarray], n: int,
+                 inv: Optional[np.ndarray] = None, n_groups: int = 0):
+        self.ev = ev
+        self.n = n
+        self.inv = inv
+        self.n_groups = n_groups
+
+
+def _per_group_apply(vals: np.ndarray, inv: np.ndarray, n_groups: int,
+                     fn: Callable[[np.ndarray], Any]) -> List[Any]:
+    """Sort-split pattern: apply fn to each group's values (vectorized
+    partition, python loop only over groups)."""
+    order = np.argsort(inv, kind="stable")
+    sv = vals[order]
+    si = inv[order]
+    bounds = np.searchsorted(si, np.arange(n_groups + 1))
+    return [fn(sv[bounds[g]:bounds[g + 1]]) for g in range(n_groups)]
+
+
+def _f64(v: np.ndarray) -> np.ndarray:
+    return np.asarray(v, dtype=np.float64)
+
+
+def _py(v: Any) -> Any:
+    return v.item() if isinstance(v, np.generic) else v
+
+
+# ---------------------------------------------------------------------------
+# aggregation implementations
+# ---------------------------------------------------------------------------
+
+class AggImpl:
+    """One aggregation bound to its AggExpr (params in self.agg.params)."""
+
+    def __init__(self, agg: Any):
+        self.agg = agg
+
+    # subclasses: empty / state / group_states / merge / finalize
+    def empty(self) -> Any:
+        raise NotImplementedError
+
+    def state(self, h: HostSel) -> Any:
+        raise NotImplementedError
+
+    def group_states(self, h: HostSel) -> List[Any]:
+        raise NotImplementedError
+
+    def merge(self, a: Any, b: Any) -> Any:
+        raise NotImplementedError
+
+    def finalize(self, s: Any) -> Any:
+        raise NotImplementedError
+
+
+class _PowerSums(AggImpl):
+    """Shared base: state = (n, S1, .., Sk) raw power sums; merge = add."""
+    K = 2
+
+    def empty(self):
+        return tuple([0] + [0.0] * self.K)
+
+    def _sums(self, v: np.ndarray) -> tuple:
+        v = _f64(v)
+        return tuple([int(v.size)]
+                     + [float(np.sum(v ** i)) for i in range(1, self.K + 1)])
+
+    def state(self, h: HostSel):
+        return self._sums(h.ev(self.agg.arg))
+
+    def group_states(self, h: HostSel):
+        v = _f64(h.ev(self.agg.arg))
+        out = []
+        n = np.bincount(h.inv, minlength=h.n_groups)
+        sums = [np.bincount(h.inv, weights=v ** i, minlength=h.n_groups)
+                for i in range(1, self.K + 1)]
+        for g in range(h.n_groups):
+            out.append(tuple([int(n[g])] + [float(s[g]) for s in sums]))
+        return out
+
+    def merge(self, a, b):
+        return tuple(x + y for x, y in zip(a, b))
+
+
+class VarianceAgg(_PowerSums):
+    K = 2
+
+    def __init__(self, agg, sample: bool, stddev: bool):
+        super().__init__(agg)
+        self.sample = sample
+        self.stddev = stddev
+
+    def finalize(self, s):
+        n, s1, s2 = s
+        if n == 0 or (self.sample and n < 2):
+            return None
+        mean = s1 / n
+        m2 = max(s2 - n * mean * mean, 0.0)
+        var = m2 / (n - 1 if self.sample else n)
+        return math.sqrt(var) if self.stddev else var
+
+
+class SkewnessAgg(_PowerSums):
+    K = 3
+
+    def finalize(self, s):
+        n, s1, s2, s3 = s
+        if n < 3:
+            return None
+        mean = s1 / n
+        m2 = max(s2 - n * mean ** 2, 0.0)
+        m3 = s3 - 3 * mean * s2 + 2 * n * mean ** 3
+        if m2 == 0:
+            return 0.0
+        sd = math.sqrt(m2 / (n - 1))  # sample sd (commons-math Skewness)
+        return (n / ((n - 1) * (n - 2))) * m3 / sd ** 3
+
+
+class KurtosisAgg(_PowerSums):
+    K = 4
+
+    def finalize(self, s):
+        n, s1, s2, s3, s4 = s
+        if n < 4:
+            return None
+        mean = s1 / n
+        m2 = max(s2 - n * mean ** 2, 0.0)
+        m4 = (s4 - 4 * mean * s3 + 6 * mean ** 2 * s2 - 3 * n * mean ** 4)
+        if m2 == 0:
+            return 0.0
+        var = m2 / (n - 1)  # commons-math Kurtosis (sample, excess)
+        term = (n * (n + 1.0)) / ((n - 1.0) * (n - 2.0) * (n - 3.0))
+        return term * m4 / var ** 2 - 3.0 * (n - 1.0) ** 2 \
+            / ((n - 2.0) * (n - 3.0))
+
+
+class CovarAgg(AggImpl):
+    """state = (n, Sx, Sy, Sxy); merge = elementwise add."""
+
+    def __init__(self, agg, sample: bool):
+        super().__init__(agg)
+        self.sample = sample
+
+    def empty(self):
+        return (0, 0.0, 0.0, 0.0)
+
+    def state(self, h: HostSel):
+        x = _f64(h.ev(self.agg.arg))
+        y = _f64(h.ev(self.agg.arg2))
+        return (int(x.size), float(x.sum()), float(y.sum()),
+                float((x * y).sum()))
+
+    def group_states(self, h: HostSel):
+        x = _f64(h.ev(self.agg.arg))
+        y = _f64(h.ev(self.agg.arg2))
+        n = np.bincount(h.inv, minlength=h.n_groups)
+        sx = np.bincount(h.inv, weights=x, minlength=h.n_groups)
+        sy = np.bincount(h.inv, weights=y, minlength=h.n_groups)
+        sxy = np.bincount(h.inv, weights=x * y, minlength=h.n_groups)
+        return [(int(n[g]), float(sx[g]), float(sy[g]), float(sxy[g]))
+                for g in range(h.n_groups)]
+
+    def merge(self, a, b):
+        return tuple(x + y for x, y in zip(a, b))
+
+    def finalize(self, s):
+        n, sx, sy, sxy = s
+        if n == 0 or (self.sample and n < 2):
+            return None
+        c = sxy - sx * sy / n
+        return c / (n - 1 if self.sample else n)
+
+
+class ModeAgg(AggImpl):
+    """state = {value: count}; finalize picks per reducer (min|max|avg)."""
+
+    def empty(self):
+        return {}
+
+    def _counts(self, v: np.ndarray) -> dict:
+        if v.dtype == object or v.dtype.kind in "US":
+            v = v.astype(str)
+        u, c = np.unique(v, return_counts=True)
+        return {_py(k): int(n) for k, n in zip(u, c)}
+
+    def state(self, h: HostSel):
+        return self._counts(h.ev(self.agg.arg))
+
+    def group_states(self, h: HostSel):
+        v = h.ev(self.agg.arg)
+        return _per_group_apply(v, h.inv, h.n_groups, self._counts)
+
+    def merge(self, a, b):
+        out = dict(a)
+        for k, n in b.items():
+            out[k] = out.get(k, 0) + n
+        return out
+
+    def finalize(self, s):
+        if not s:
+            return None
+        best = max(s.values())
+        winners = [k for k, n in s.items() if n == best]
+        reducer = self.agg.params[0] if self.agg.params else "min"
+        if reducer == "max":
+            return max(winners)
+        if reducer == "avg":
+            try:
+                return sum(float(w) for w in winners) / len(winners)
+            except (TypeError, ValueError):
+                raise _sql_mod().SqlError(
+                    "mode: 'avg' reducer requires a numeric column") \
+                    from None
+        return min(winners)
+
+
+class MinMaxRangeAgg(AggImpl):
+    """state = (min, max) or None."""
+
+    def empty(self):
+        return None
+
+    def _mm(self, v: np.ndarray):
+        if v.size == 0:
+            return None
+        v = _f64(v)
+        return (float(v.min()), float(v.max()))
+
+    def state(self, h: HostSel):
+        return self._mm(h.ev(self.agg.arg))
+
+    def group_states(self, h: HostSel):
+        v = _f64(h.ev(self.agg.arg))
+        lo = np.full(h.n_groups, np.inf)
+        hi = np.full(h.n_groups, -np.inf)
+        np.minimum.at(lo, h.inv, v)
+        np.maximum.at(hi, h.inv, v)
+        return [(float(lo[g]), float(hi[g])) for g in range(h.n_groups)]
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return (min(a[0], b[0]), max(a[1], b[1]))
+
+    def finalize(self, s):
+        return None if s is None else s[1] - s[0]
+
+
+class PercentileAgg(AggImpl):
+    """Exact percentile: state = sorted list of all values (the reference's
+    PercentileAggregationFunction keeps every value too); finalize indexes
+    at floor((n-1) * p / 100), identical to its sorted-array lookup."""
+
+    def empty(self):
+        return []
+
+    def state(self, h: HostSel):
+        return _f64(h.ev(self.agg.arg)).tolist()
+
+    def group_states(self, h: HostSel):
+        v = _f64(h.ev(self.agg.arg))
+        return _per_group_apply(v, h.inv, h.n_groups,
+                                lambda g: g.tolist())
+
+    def merge(self, a, b):
+        return a + b
+
+    def finalize(self, s):
+        if not s:
+            return None
+        p = self.agg.params[0]
+        arr = np.sort(np.asarray(s, dtype=np.float64))
+        idx = int((len(arr) - 1) * p / 100.0)
+        return float(arr[idx])
+
+
+TDIGEST_MAX_CENTROIDS = 128
+
+
+class PercentileSketchAgg(AggImpl):
+    """Mergeable quantile sketch (t-digest-style size-capped centroids):
+    state = [[mean, weight], ...] sorted by mean. Plays the role of the
+    reference's PERCENTILEEST (QDigest), PERCENTILETDIGEST and
+    PERCENTILEKLL sketches — approximate, bounded-size, mergeable."""
+
+    def empty(self):
+        return []
+
+    def _compress(self, cents: List[List[float]]) -> List[List[float]]:
+        if len(cents) <= TDIGEST_MAX_CENTROIDS:
+            return cents
+        cents.sort(key=lambda c: c[0])
+        total = sum(c[1] for c in cents)
+        out: List[List[float]] = []
+        # scale function: uniform weight cap keeps tails reasonably sharp
+        cap = max(total / TDIGEST_MAX_CENTROIDS, 1.0)
+        cur_m, cur_w = cents[0]
+        for m, w in cents[1:]:
+            if cur_w + w <= cap * 2:
+                cur_m = (cur_m * cur_w + m * w) / (cur_w + w)
+                cur_w += w
+            else:
+                out.append([cur_m, cur_w])
+                cur_m, cur_w = m, w
+        out.append([cur_m, cur_w])
+        return out
+
+    def _from_values(self, v: np.ndarray) -> List[List[float]]:
+        if v.size == 0:
+            return []
+        v = np.sort(_f64(v))
+        if v.size <= TDIGEST_MAX_CENTROIDS:
+            return [[float(x), 1.0] for x in v]
+        # bucket into equal-count chunks
+        chunks = np.array_split(v, TDIGEST_MAX_CENTROIDS)
+        return [[float(c.mean()), float(c.size)] for c in chunks if c.size]
+
+    def state(self, h: HostSel):
+        return self._from_values(h.ev(self.agg.arg))
+
+    def group_states(self, h: HostSel):
+        v = _f64(h.ev(self.agg.arg))
+        return _per_group_apply(v, h.inv, h.n_groups, self._from_values)
+
+    def merge(self, a, b):
+        return self._compress([list(c) for c in a] + [list(c) for c in b])
+
+    def finalize(self, s):
+        if not s:
+            return None
+        cents = sorted(s, key=lambda c: c[0])
+        p = self.agg.params[0]
+        total = sum(c[1] for c in cents)
+        target = p / 100.0 * total
+        acc = 0.0
+        for m, w in cents:
+            if acc + w >= target:
+                return float(m)
+            acc += w
+        return float(cents[-1][0])
+
+
+HLL_DEFAULT_LOG2M = 12
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    x = x.astype(np.uint64)
+    x = (x + np.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def _hash64(v: np.ndarray) -> np.ndarray:
+    if v.dtype == object or v.dtype.kind in "US":
+        import hashlib
+        sv = v.astype(str)
+        u, inv = np.unique(sv, return_inverse=True)
+        hu = np.asarray(
+            [int.from_bytes(hashlib.md5(x.encode()).digest()[:8], "little")
+             for x in u], dtype=np.uint64)
+        return hu[inv]
+    if v.dtype.kind == "f":
+        v = v.astype(np.float64).view(np.int64)
+    return _splitmix64(np.asarray(v).astype(np.int64))
+
+
+class HllAgg(AggImpl):
+    """HyperLogLog: state = list[int] of 2^log2m registers; merge = max."""
+
+    @property
+    def log2m(self) -> int:
+        return int(self.agg.params[0]) if self.agg.params \
+            else HLL_DEFAULT_LOG2M
+
+    def empty(self):
+        return [0] * (1 << self.log2m)
+
+    def _regs(self, v: np.ndarray) -> List[int]:
+        p = self.log2m
+        m = 1 << p
+        if v.size == 0:
+            return [0] * m
+        h = _hash64(v)
+        idx = (h >> np.uint64(64 - p)).astype(np.int64)
+        rest = (h << np.uint64(p)) | np.uint64(1 << (p - 1))
+        # rank = leading zeros in the remaining 64-p bits + 1
+        lz = np.zeros(v.size, dtype=np.int64)
+        mask = np.uint64(1) << np.uint64(63)
+        cur = rest.copy()
+        done = np.zeros(v.size, dtype=bool)
+        for _ in range(64 - p + 1):
+            top = (cur & mask) != 0
+            done |= top
+            lz += ~done
+            cur = cur << np.uint64(1)
+        rank = lz + 1
+        regs = np.zeros(m, dtype=np.int64)
+        np.maximum.at(regs, idx, rank)
+        return regs.tolist()
+
+    def state(self, h: HostSel):
+        return self._regs(h.ev(self.agg.arg))
+
+    def group_states(self, h: HostSel):
+        v = h.ev(self.agg.arg)
+        return _per_group_apply(v, h.inv, h.n_groups, self._regs)
+
+    def merge(self, a, b):
+        return np.maximum(np.asarray(a), np.asarray(b)).tolist()
+
+    def finalize(self, s):
+        regs = np.asarray(s, dtype=np.float64)
+        m = regs.size
+        alpha = 0.7213 / (1 + 1.079 / m)
+        est = alpha * m * m / np.sum(2.0 ** -regs)
+        zeros = int(np.sum(regs == 0))
+        if est <= 2.5 * m and zeros > 0:
+            est = m * math.log(m / zeros)  # linear counting range
+        return int(round(est))
+
+
+class SumPrecisionAgg(AggImpl):
+    """Exact big-decimal sum: state = decimal string; merge = Decimal add."""
+
+    def empty(self):
+        return "0"
+
+    def _sum(self, v: np.ndarray) -> str:
+        if v.size == 0:
+            return "0"
+        if np.issubdtype(v.dtype, np.integer):
+            return str(int(v.astype(object).sum()))  # python-int exact
+        return str(sum((Decimal(repr(float(x))) for x in v), Decimal(0)))
+
+    def state(self, h: HostSel):
+        return self._sum(h.ev(self.agg.arg))
+
+    def group_states(self, h: HostSel):
+        v = h.ev(self.agg.arg)
+        return _per_group_apply(v, h.inv, h.n_groups, self._sum)
+
+    def merge(self, a, b):
+        return str(Decimal(a) + Decimal(b))
+
+    def finalize(self, s):
+        d = Decimal(s)
+        return int(d) if d == d.to_integral_value() else float(d)
+
+
+class BoolAgg(AggImpl):
+    """BOOL_AND / BOOL_OR: state = None | bool."""
+
+    def __init__(self, agg, is_and: bool):
+        super().__init__(agg)
+        self.is_and = is_and
+
+    def empty(self):
+        return None
+
+    def _red(self, v: np.ndarray):
+        if v.size == 0:
+            return None
+        b = v.astype(bool)
+        return bool(b.all()) if self.is_and else bool(b.any())
+
+    def state(self, h: HostSel):
+        return self._red(h.ev(self.agg.arg))
+
+    def group_states(self, h: HostSel):
+        v = h.ev(self.agg.arg)
+        return _per_group_apply(v, h.inv, h.n_groups, self._red)
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return (a and b) if self.is_and else (a or b)
+
+    def finalize(self, s):
+        return s
+
+
+class WithTimeAgg(AggImpl):
+    """FIRSTWITHTIME / LASTWITHTIME: state = (time, value) | None."""
+
+    def __init__(self, agg, last: bool):
+        super().__init__(agg)
+        self.last = last
+
+    def empty(self):
+        return None
+
+    def _pick(self, vals: np.ndarray, times: np.ndarray):
+        if vals.size == 0:
+            return None
+        i = int(np.argmax(times) if self.last else np.argmin(times))
+        return (_py(times[i]), _py(vals[i]))
+
+    def state(self, h: HostSel):
+        return self._pick(h.ev(self.agg.arg), h.ev(self.agg.arg2))
+
+    def group_states(self, h: HostSel):
+        vals = h.ev(self.agg.arg)
+        times = h.ev(self.agg.arg2)
+        order = np.argsort(h.inv, kind="stable")
+        sv, st, si = vals[order], times[order], h.inv[order]
+        bounds = np.searchsorted(si, np.arange(h.n_groups + 1))
+        return [self._pick(sv[bounds[g]:bounds[g + 1]],
+                           st[bounds[g]:bounds[g + 1]])
+                for g in range(h.n_groups)]
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if self.last:
+            return a if a[0] >= b[0] else b
+        return a if a[0] <= b[0] else b
+
+    def finalize(self, s):
+        return None if s is None else s[1]
+
+
+# ---------------------------------------------------------------------------
+# factory + dispatch used by host_eval / reduce / executor
+# ---------------------------------------------------------------------------
+
+def make(agg: Any) -> Optional[AggImpl]:
+    """AggImpl for extended kinds; None for the classic six (inlined in
+    host_eval/kernels with matched state formats)."""
+    k = agg.kind
+    if k == "var_pop":
+        return VarianceAgg(agg, sample=False, stddev=False)
+    if k == "var_samp":
+        return VarianceAgg(agg, sample=True, stddev=False)
+    if k == "stddev_pop":
+        return VarianceAgg(agg, sample=False, stddev=True)
+    if k == "stddev_samp":
+        return VarianceAgg(agg, sample=True, stddev=True)
+    if k == "skewness":
+        return SkewnessAgg(agg)
+    if k == "kurtosis":
+        return KurtosisAgg(agg)
+    if k == "covar_pop":
+        return CovarAgg(agg, sample=False)
+    if k == "covar_samp":
+        return CovarAgg(agg, sample=True)
+    if k == "mode":
+        return ModeAgg(agg)
+    if k == "minmaxrange":
+        return MinMaxRangeAgg(agg)
+    if k == "percentile":
+        return PercentileAgg(agg)
+    if k == "percentile_sketch":
+        return PercentileSketchAgg(agg)
+    if k == "distinct_count_hll":
+        return HllAgg(agg)
+    if k == "sum_precision":
+        return SumPrecisionAgg(agg)
+    if k == "bool_and":
+        return BoolAgg(agg, is_and=True)
+    if k == "bool_or":
+        return BoolAgg(agg, is_and=False)
+    if k == "first_with_time":
+        return WithTimeAgg(agg, last=False)
+    if k == "last_with_time":
+        return WithTimeAgg(agg, last=True)
+    return None
+
+
+_CLASSIC_EMPTY = {"count": 0, "sum": 0, "min": None, "max": None,
+                  "avg": (0, 0), "distinct_count": set}
+
+
+def empty_state(agg: Any) -> Any:
+    k = agg.kind
+    if k in _CLASSIC_EMPTY:
+        e = _CLASSIC_EMPTY[k]
+        return e() if callable(e) else e
+    impl = make(agg)
+    if impl is None:
+        raise _sql_mod().SqlError(f"unknown aggregation kind {k!r}")
+    return impl.empty()
+
+
+def merge_states(agg: Any, a: Any, b: Any) -> Any:
+    k = agg.kind
+    if k in ("count", "sum"):
+        return a + b
+    if k == "min":
+        return b if a is None else a if b is None else min(a, b)
+    if k == "max":
+        return b if a is None else a if b is None else max(a, b)
+    if k == "avg":
+        return (a[0] + b[0], a[1] + b[1])
+    if k == "distinct_count":
+        return a | b
+    impl = make(agg)
+    if impl is None:
+        raise _sql_mod().SqlError(f"unknown aggregation kind {k!r}")
+    return impl.merge(a, b)
+
+
+def finalize_state(agg: Any, s: Any) -> Any:
+    k = agg.kind
+    if k == "avg":
+        return None if s[1] == 0 else s[0] / s[1]
+    if k == "distinct_count":
+        return len(s)
+    if k in ("count", "sum", "min", "max"):
+        return s
+    impl = make(agg)
+    if impl is None:
+        raise _sql_mod().SqlError(f"unknown aggregation kind {k!r}")
+    return impl.finalize(s)

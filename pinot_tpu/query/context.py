@@ -16,22 +16,17 @@ from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, CaseWhen,
                   Like, Literal, OrderItem, SelectStmt, SqlError, Star,
                   ast_children, collect_identifiers)
 
-AGG_FUNCS = {
-    "count": "count",
-    "sum": "sum",
-    "min": "min",
-    "max": "max",
-    "avg": "avg",
-    "distinctcount": "distinct_count",
-    "count_distinct": "distinct_count",
-}
+from ..ops.aggregations import AGG_NAME_TO_KIND as AGG_FUNCS  # noqa: F401
+from ..ops.aggregations import is_agg_name, resolve_call
 
 
 @dataclass(frozen=True)
 class AggExpr:
-    kind: str          # count | sum | min | max | avg | distinct_count
+    kind: str          # count | sum | ... (ops/aggregations.py registry)
     arg: Any           # value expression AST (None for COUNT(*))
     label: str
+    arg2: Any = None   # second value expression (covar, *withtime)
+    params: Tuple[Any, ...] = ()  # literal params (percentile p, ...)
 
     def key(self) -> str:
         return self.label
@@ -87,7 +82,7 @@ def _expr_label(e: Any) -> str:
 
 def _find_aggs(e: Any, out: List[FuncCall]) -> None:
     if isinstance(e, FuncCall):
-        if e.name in AGG_FUNCS or (e.name == "count" and e.distinct):
+        if is_agg_name(e.name) or (e.name == "count" and e.distinct):
             out.append(e)
             return
     for a in ast_children(e):
@@ -100,17 +95,16 @@ def build_query_context(stmt: SelectStmt) -> QueryContext:
     labels: List[str] = []
 
     def register_agg(fc: FuncCall) -> AggExpr:
-        kind = AGG_FUNCS[fc.name]
-        if fc.name == "count" and fc.distinct:
-            kind = "distinct_count"
-        if kind == "count" and (not fc.args or isinstance(fc.args[0], Star)):
-            arg = None
+        args = fc.args
+        if fc.name == "count" and not fc.distinct and \
+                (not args or isinstance(args[0], Star)):
+            resolved = ("count", None, None, ())
         else:
-            if len(fc.args) != 1:
-                raise SqlError(f"{fc.name} takes one argument")
-            arg = fc.args[0]
-        label = _expr_label(fc)
-        agg = AggExpr(kind, arg, label)
+            resolved = resolve_call(fc.name, args, fc.distinct)
+            if resolved is None:
+                raise SqlError(f"unknown aggregation {fc.name!r}")
+        kind, arg, arg2, params = resolved
+        agg = AggExpr(kind, arg, _expr_label(fc), arg2, params)
         for existing in aggregations:
             if existing == agg:
                 return existing
@@ -217,10 +211,25 @@ def _find_aggs_present(e: Any) -> bool:
 
 
 def _keys_only(e: Any, group_by: List[Any]) -> bool:
-    """Expression over group keys only (computable at reduce)."""
+    """Expression derivable from the group keys (computable at reduce).
+
+    An expression is covered when it IS a group key (label match), is a
+    literal, or every sub-expression is covered. A bare column that is not
+    itself a key is NOT covered even if some key mentions it — reduce only
+    has key values in scope (SELECT val ... GROUP BY ABS(val) must fail
+    here with a clear error, not at reduce)."""
     if not group_by:
         return False
-    group_cols: set = set()
-    for g in group_by:
-        collect_identifiers(g, group_cols)
-    return collect_identifiers(e) <= group_cols
+    group_labels = {_expr_label(g) for g in group_by}
+
+    def covered(x: Any) -> bool:
+        if _expr_label(x) in group_labels:
+            return True
+        if isinstance(x, Literal):
+            return True
+        if isinstance(x, Identifier):
+            return False
+        kids = ast_children(x)
+        return bool(kids) and all(covered(c) for c in kids)
+
+    return covered(e)

@@ -497,11 +497,14 @@ def _joda_to_strftime(fmt: str) -> str:
 def _todatetime(millis, fmt):
     import datetime as _dt
     f = _joda_to_strftime(str(np.asarray(fmt)))
+    # millisecond precision: format %f out-of-band so trailing literals
+    # (e.g. a 'Z' after SSS) survive
+    f_ms = f.replace("%f", "\x00")
 
     def conv(ms: int) -> str:
         t = _dt.datetime.fromtimestamp(ms / 1000.0, tz=_dt.timezone.utc)
-        s = t.strftime(f)
-        return s[:-3] if "%f" in f else s  # micro -> milli
+        s = t.strftime(f_ms)
+        return s.replace("\x00", f"{t.microsecond // 1000:03d}")
     m = _i(millis)
     if m.ndim == 0:
         return np.asarray(conv(int(m)))

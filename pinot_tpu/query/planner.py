@@ -487,6 +487,9 @@ class SegmentPlanner:
         if agg.kind == "count":  # COUNT(col): Pinot counts all rows when
             # null handling is disabled (NullableSingleInputAggregationFunction)
             return AggSpec("count", None, True), AggBinding(agg, i, True)
+        if agg.kind not in ("sum", "min", "max", "avg"):
+            raise PlanError(f"no device lowering for {agg.kind} "
+                            "(host fallback)")
         ve, integral = self.resolve_value(agg.arg)
         bits, signed = self._bits_for(self._range_of(agg.arg))
         return (AggSpec(agg.kind, ve, integral, bits=bits, signed=signed),

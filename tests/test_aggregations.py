@@ -1,0 +1,192 @@
+"""Extended aggregation function suite vs numpy oracle.
+
+Reference analog: pinot-core query/aggregation/function tests. Data is
+split over 3 segments so every assertion also exercises the mergeable
+partial-state path (state extraction per segment -> merge -> finalize).
+"""
+import math
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+N = 6000
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(23)
+    return {
+        "grp": rng.choice(["a", "b", "c", "d"], N),
+        "x": rng.normal(50, 20, N).round(4),
+        "y": rng.normal(-5, 8, N).round(4),
+        "iv": rng.integers(0, 1000, N).astype(np.int64),
+        "flag": rng.integers(0, 2, N).astype(np.int32),
+        "t": rng.permutation(N).astype(np.int64),
+    }
+
+
+@pytest.fixture(scope="module")
+def broker(data, tmp_path_factory):
+    schema = Schema("agg", [
+        FieldSpec("grp", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("x", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("y", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("iv", DataType.LONG, FieldType.METRIC),
+        FieldSpec("flag", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("t", DataType.LONG, FieldType.DIMENSION),
+    ])
+    out = tmp_path_factory.mktemp("agg_table")
+    builder = SegmentBuilder(schema, TableConfig("agg"))
+    dm = TableDataManager("agg")
+    for i, (lo, hi) in enumerate(((0, 2000), (2000, 4000), (4000, N))):
+        chunk = {k: v[lo:hi] for k, v in data.items()}
+        dm.add_segment_dir(builder.build(chunk, str(out), f"seg_{i}"))
+    b = Broker()
+    b.register_table(dm)
+    return b
+
+
+def one(res):
+    assert len(res.rows) == 1, res.rows
+    return tuple(res.rows[0])
+
+
+def test_variance_family(broker, data):
+    x = data["x"]
+    r = one(broker.query(
+        "SELECT VAR_POP(x), VAR_SAMP(x), STDDEV_POP(x), STDDEV_SAMP(x) "
+        "FROM agg"))
+    assert r[0] == pytest.approx(np.var(x), rel=1e-9)
+    assert r[1] == pytest.approx(np.var(x, ddof=1), rel=1e-9)
+    assert r[2] == pytest.approx(np.std(x), rel=1e-9)
+    assert r[3] == pytest.approx(np.std(x, ddof=1), rel=1e-9)
+
+
+def test_variance_aliases(broker, data):
+    x = data["x"]
+    r = one(broker.query("SELECT VARIANCE(x), STDDEV(x) FROM agg"))
+    assert r[0] == pytest.approx(np.var(x, ddof=1), rel=1e-9)
+    assert r[1] == pytest.approx(np.std(x, ddof=1), rel=1e-9)
+
+
+def test_variance_group_by(broker, data):
+    res = broker.query(
+        "SELECT grp, VAR_POP(x) FROM agg GROUP BY grp ORDER BY grp")
+    for g, v in [tuple(r) for r in res.rows]:
+        m = data["grp"] == g
+        assert v == pytest.approx(np.var(data["x"][m]), rel=1e-9)
+
+
+def test_covariance(broker, data):
+    x, y = data["x"], data["y"]
+    r = one(broker.query("SELECT COVAR_POP(x, y), COVAR_SAMP(x, y) "
+                         "FROM agg"))
+    assert r[0] == pytest.approx(np.cov(x, y, bias=True)[0, 1], rel=1e-6)
+    assert r[1] == pytest.approx(np.cov(x, y)[0, 1], rel=1e-6)
+
+
+def test_skewness_kurtosis(broker, data):
+    x = data["x"]
+    n = len(x)
+    mean = x.mean()
+    m2 = ((x - mean) ** 2).sum()
+    m3 = ((x - mean) ** 3).sum()
+    m4 = ((x - mean) ** 4).sum()
+    sd = math.sqrt(m2 / (n - 1))
+    skew = (n / ((n - 1) * (n - 2))) * m3 / sd ** 3
+    var = m2 / (n - 1)
+    kurt = ((n * (n + 1.0)) / ((n - 1.0) * (n - 2.0) * (n - 3.0))) \
+        * m4 / var ** 2 - 3.0 * (n - 1.0) ** 2 / ((n - 2.0) * (n - 3.0))
+    r = one(broker.query("SELECT SKEWNESS(x), KURTOSIS(x) FROM agg"))
+    assert r[0] == pytest.approx(skew, rel=1e-6)
+    assert r[1] == pytest.approx(kurt, rel=1e-6)
+
+
+def test_minmaxrange(broker, data):
+    r = one(broker.query("SELECT MINMAXRANGE(iv) FROM agg"))
+    assert r[0] == pytest.approx(
+        float(data["iv"].max() - data["iv"].min()))
+
+
+def test_mode(broker, data):
+    vals, counts = np.unique(data["iv"], return_counts=True)
+    best = counts.max()
+    expect = vals[counts == best].min()
+    r = one(broker.query("SELECT MODE(iv) FROM agg"))
+    assert r[0] == expect
+
+
+def test_percentile_exact(broker, data):
+    x = np.sort(data["x"])
+    for p in (50, 90, 99):
+        r = one(broker.query(f"SELECT PERCENTILE(x, {p}) FROM agg"))
+        expect = float(x[int((len(x) - 1) * p / 100.0)])
+        assert r[0] == pytest.approx(expect)
+
+
+def test_percentile_suffix_form(broker, data):
+    x = np.sort(data["x"])
+    r = one(broker.query("SELECT PERCENTILE95(x) FROM agg"))
+    assert r[0] == pytest.approx(float(x[int((len(x) - 1) * 0.95)]))
+
+
+def test_percentile_sketch_close(broker, data):
+    x = data["x"]
+    for fn in ("PERCENTILEEST", "PERCENTILETDIGEST", "PERCENTILEKLL"):
+        r = one(broker.query(f"SELECT {fn}(x, 50) FROM agg"))
+        # approximate: within 2 of the true median on N(50,20) data
+        assert abs(r[0] - float(np.median(x))) < 2.0, (fn, r)
+
+
+def test_distinctcount_hll_close(broker, data):
+    true = len(np.unique(data["iv"]))
+    r = one(broker.query("SELECT DISTINCTCOUNTHLL(iv) FROM agg"))
+    assert abs(r[0] - true) / true < 0.05  # ~1.04/sqrt(4096) ≈ 1.6% stderr
+    exact = one(broker.query("SELECT DISTINCTCOUNTBITMAP(iv) FROM agg"))
+    assert exact[0] == true
+
+
+def test_sumprecision_exact(broker, data):
+    r = one(broker.query("SELECT SUMPRECISION(iv) FROM agg"))
+    assert r[0] == int(data["iv"].sum())
+
+
+def test_bool_and_or(broker, data):
+    r = one(broker.query("SELECT BOOL_AND(flag), BOOL_OR(flag) FROM agg"))
+    assert r == (bool(data["flag"].all()), bool(data["flag"].any()))
+
+
+def test_first_last_with_time(broker, data):
+    first_i = int(np.argmin(data["t"]))
+    last_i = int(np.argmax(data["t"]))
+    r = one(broker.query(
+        "SELECT FIRSTWITHTIME(iv, t, 'LONG'), LASTWITHTIME(iv, t, 'LONG') "
+        "FROM agg"))
+    assert r == (data["iv"][first_i], data["iv"][last_i])
+
+
+def test_extended_agg_group_by_with_filter(broker, data):
+    res = broker.query(
+        "SELECT grp, PERCENTILE(x, 50), MODE(flag) FROM agg "
+        "WHERE iv < 500 GROUP BY grp ORDER BY grp")
+    for g, med, mo in [tuple(r) for r in res.rows]:
+        m = (data["grp"] == g) & (data["iv"] < 500)
+        xs = np.sort(data["x"][m])
+        assert med == pytest.approx(float(xs[int((len(xs) - 1) * 0.5)]))
+        vals, counts = np.unique(data["flag"][m], return_counts=True)
+        assert mo == vals[counts == counts.max()].min()
+
+
+def test_extended_in_having_order(broker, data):
+    res = broker.query(
+        "SELECT grp, STDDEV(x) FROM agg GROUP BY grp "
+        "HAVING STDDEV(x) > 0 ORDER BY STDDEV(x) DESC")
+    assert len(res.rows) == 4
+    vals = [r[1] for r in res.rows]
+    assert vals == sorted(vals, reverse=True)
