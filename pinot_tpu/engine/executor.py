@@ -101,6 +101,13 @@ def resolve_params(plan: CompiledPlan) -> Tuple[jax.Array, ...]:
             out.append(seg.device_null_mask(p[1]))
         elif isinstance(p, tuple) and len(p) == 2 and p[0] == "validdocs":
             out.append(seg.device_valid_mask())
+        elif isinstance(p, tuple) and len(p) == 2 and p[0] == "docmask":
+            # index-predicate doc mask (TEXT_MATCH/JSON_MATCH/
+            # VECTOR_SIMILARITY): pad to the segment bucket
+            mask = np.asarray(p[1], dtype=bool)
+            padded = np.zeros(seg.bucket, dtype=bool)
+            padded[: len(mask)] = mask
+            out.append(jax.device_put(padded))
         else:
             out.append(jax.device_put(p))
     return tuple(out)

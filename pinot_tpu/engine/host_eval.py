@@ -127,6 +127,20 @@ def eval_filter(e: Any, seg: ImmutableSegment) -> np.ndarray:
     if isinstance(e, BoolNot):
         return ~eval_filter(e.child, seg)
     if isinstance(e, Comparison):
+        # InvertedIndexFilterOperator analog: EQ/NEQ on a dict column with
+        # an inverted index answers in O(selectivity) from posting lists
+        if e.op in ("==", "!=") and isinstance(e.lhs, Identifier) \
+                and isinstance(e.rhs, Literal):
+            m = seg.columns.get(e.lhs.name)
+            if m is not None and getattr(m, "has_dict", False) \
+                    and "inverted" in getattr(m, "indexes", {}):
+                d = seg.dictionary(e.lhs.name)
+                val = e.rhs.value
+                did = d.index_of(str(val) if not m.data_type.is_numeric
+                                 else val)
+                mask = seg.index_reader(e.lhs.name, "inverted") \
+                    .mask_for_ids([did] if did >= 0 else [], n)
+                return ~mask if e.op == "!=" else mask
         l = eval_value(e.lhs, seg)
         r = eval_value(e.rhs, seg)
         l, r = _align_str(l, r)
@@ -171,6 +185,11 @@ def eval_filter(e: Any, seg: ImmutableSegment) -> np.ndarray:
         return ~m if e.negated else m
     if isinstance(e, Literal) and isinstance(e.value, bool):
         return np.full(n, e.value, dtype=bool)
+    if isinstance(e, FuncCall):
+        from ..index.predicates import try_index_filter_mask
+        idx_mask = try_index_filter_mask(seg, e)
+        if idx_mask is not None:
+            return idx_mask
     if isinstance(e, (FuncCall, Identifier, Cast, CaseWhen)):
         # boolean-valued expression used as a predicate
         # (startsWith(col, 'x'), boolean column, ...)

@@ -1,0 +1,23 @@
+"""Pluggable per-column index subsystem.
+
+Reference parity: pinot-segment-spi/.../index/StandardIndexes.java:85-136
+(the IndexType registry: forward, dictionary, nullValueVector, bloomFilter,
+inverted, json, range, text, vector) and the per-index creator/reader pairs
+in pinot-segment-local/.../segment/index/. Forward, dictionary and
+null-vector indexes are built into the segment core (segment/builder.py);
+this package holds the optional per-column secondary indexes.
+
+TPU-native stance: secondary indexes evaluate HOST-side into boolean doc
+masks that ship to the device kernel as a MaskParam (ops/ir.py) — the TPU
+analog of Pinot handing a RoaringBitmap docIdSet to downstream operators
+(operator/filter/InvertedIndexFilterOperator et al). The vector index is
+the exception: similarity is a dense matmul, so it runs ON device (MXU).
+"""
+from .registry import (INDEX_KINDS, build_indexes_for_column,
+                       index_predicate_names, load_index)
+from .predicates import index_filter_mask
+
+__all__ = [
+    "INDEX_KINDS", "build_indexes_for_column", "load_index",
+    "index_predicate_names", "index_filter_mask",
+]

@@ -1,0 +1,69 @@
+"""CSR posting-list storage shared by inverted/text/json indexes.
+
+Reference parity: pinot-segment-local/.../segment/index/inverted/ stores a
+RoaringBitmap per dict id; the TPU-native layout is a flat CSR (offsets +
+concatenated sorted doc ids) which memmaps zero-copy and turns a posting
+read into one slice.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Sequence
+
+import numpy as np
+
+
+def write_csr(path_prefix: str, postings: Sequence[np.ndarray]) -> None:
+    """postings[i] = sorted int32 doc ids for key i."""
+    offsets = np.zeros(len(postings) + 1, dtype=np.int64)
+    for i, p in enumerate(postings):
+        offsets[i + 1] = offsets[i] + len(p)
+    docs = (np.concatenate(postings).astype(np.int32)
+            if len(postings) else np.zeros(0, dtype=np.int32))
+    docs.tofile(path_prefix + ".docs.bin")
+    offsets.tofile(path_prefix + ".off.bin")
+
+
+class CsrPostings:
+    """Memmapped CSR posting lists."""
+
+    def __init__(self, path_prefix: str):
+        self.docs = np.memmap(path_prefix + ".docs.bin", dtype=np.int32,
+                              mode="r") if os.path.getsize(
+            path_prefix + ".docs.bin") else np.zeros(0, dtype=np.int32)
+        self.offsets = np.fromfile(path_prefix + ".off.bin", dtype=np.int64)
+
+    @property
+    def n_keys(self) -> int:
+        return len(self.offsets) - 1
+
+    def docs_for(self, key: int) -> np.ndarray:
+        if key < 0 or key >= self.n_keys:
+            return np.zeros(0, dtype=np.int32)
+        return np.asarray(self.docs[self.offsets[key]: self.offsets[key + 1]])
+
+    def mask_for(self, keys: Iterable[int], n_docs: int) -> np.ndarray:
+        mask = np.zeros(n_docs, dtype=bool)
+        for k in keys:
+            mask[self.docs_for(k)] = True
+        return mask
+
+
+def postings_from_ids(ids: np.ndarray, cardinality: int) -> List[np.ndarray]:
+    """Group doc positions by dict id (counting sort; ids in [0, card))."""
+    order = np.argsort(ids, kind="stable")
+    sorted_ids = ids[order]
+    bounds = np.searchsorted(sorted_ids, np.arange(cardinality + 1))
+    return [order[bounds[i]: bounds[i + 1]].astype(np.int32)
+            for i in range(cardinality)]
+
+
+def postings_from_doc_keys(doc_keys: Sequence[Iterable[int]],
+                           n_keys: int) -> List[np.ndarray]:
+    """doc_keys[doc] = iterable of key ids present in that doc."""
+    buckets: Dict[int, List[int]] = {}
+    for doc, keys in enumerate(doc_keys):
+        for k in keys:
+            buckets.setdefault(k, []).append(doc)
+    return [np.asarray(sorted(set(buckets.get(k, []))), dtype=np.int32)
+            for k in range(n_keys)]

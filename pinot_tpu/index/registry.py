@@ -1,0 +1,62 @@
+"""Index type registry: kind -> (builder fn, reader class).
+
+Reference parity: pinot-segment-spi/.../index/StandardIndexes.java:85-136 +
+IndexService (plugin-style registry of IndexType<Config, Reader, Creator>).
+Forward/dictionary/null-vector are segment-core (segment/builder.py);
+star-tree lives in startree/ (it is a segment-level structure, not
+per-column). Registered here: inverted, range, bloom, text, json, vector.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from . import bloom, inverted, json_index, range_index, text, vector
+
+_BUILDERS = {
+    "inverted": inverted.build,
+    "range": range_index.build,
+    "bloom": bloom.build,
+    "text": text.build,
+    "json": json_index.build,
+    "vector": vector.build,
+}
+
+_READERS = {
+    "inverted": inverted.InvertedIndexReader,
+    "range": range_index.RangeIndexReader,
+    "bloom": bloom.BloomFilterReader,
+    "text": text.TextIndexReader,
+    "json": json_index.JsonIndexReader,
+    "vector": vector.VectorIndexReader,
+}
+
+INDEX_KINDS = tuple(_BUILDERS)
+
+# filter functions answered by an index (TextMatchFilterOperator,
+# JsonMatchFilterOperator, VectorSimilarityFilterOperator analogs)
+_PREDICATE_FUNCS = ("text_match", "json_match", "vector_similarity")
+
+
+def index_predicate_names() -> tuple:
+    return _PREDICATE_FUNCS
+
+
+def build_indexes_for_column(col: str, kinds, seg_dir: str, *,
+                             values: np.ndarray, ids, cardinality: int
+                             ) -> Dict[str, Dict[str, Any]]:
+    """Build each configured index; returns {kind: extra_metadata} to embed
+    in the column's metadata under "indexes"."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for kind in kinds:
+        if kind not in _BUILDERS:
+            raise ValueError(f"unknown index kind {kind!r}; have "
+                             f"{INDEX_KINDS}")
+        out[kind] = _BUILDERS[kind](col, seg_dir, values=values, ids=ids,
+                                    cardinality=cardinality)
+    return out
+
+
+def load_index(seg_dir: str, col: str, kind: str, meta: Dict[str, Any]):
+    return _READERS[kind](seg_dir, col, meta)

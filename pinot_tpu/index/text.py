@@ -1,0 +1,146 @@
+"""Text index: tokenized inverted index serving TEXT_MATCH.
+
+Reference parity: pinot-segment-local/.../segment/creator/impl/text/
+LuceneTextIndexCreator.java:28-30 (Lucene StandardAnalyzer index) and
+operator/filter/TextMatchFilterOperator. Lucene stays host-side in the
+reference; here the analyzer is a lowercase alphanumeric tokenizer and the
+index is CSR postings (token -> sorted doc ids). Query syntax is a Lucene
+subset: terms, "quoted phrases" (conjunctive, positions not stored),
+AND / OR / NOT, parentheses; bare terms combine with OR like Lucene's
+default operator.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .csr import CsrPostings, postings_from_doc_keys, write_csr
+
+SUFFIX = ".text"
+_TOKEN_RX = re.compile(r"[a-z0-9]+")
+
+
+def tokenize(text: Any) -> List[str]:
+    return _TOKEN_RX.findall(str(text).lower())
+
+
+def build(col: str, seg_dir: str, *, values: np.ndarray,
+          **_: Any) -> Dict[str, Any]:
+    doc_tokens = [tokenize(v) for v in values]
+    vocab: Dict[str, int] = {}
+    for toks in doc_tokens:
+        for t in toks:
+            if t not in vocab:
+                vocab[t] = len(vocab)
+    tokens_sorted = sorted(vocab)
+    remap = {t: i for i, t in enumerate(tokens_sorted)}
+    doc_keys = [[remap[t] for t in toks] for toks in doc_tokens]
+    write_csr(os.path.join(seg_dir, col + SUFFIX),
+              postings_from_doc_keys(doc_keys, len(tokens_sorted)))
+    with open(os.path.join(seg_dir, col + SUFFIX + ".vocab.json"), "w") as fh:
+        json.dump(tokens_sorted, fh)
+    return {"vocabSize": len(tokens_sorted)}
+
+
+class _QueryParser:
+    """query := or ; or := and (OR and)* ; and := unary ((AND)? unary)* ;
+    unary := NOT unary | '(' or ')' | phrase | term.
+    Adjacent units with no operator combine with OR (Lucene default)."""
+
+    def __init__(self, q: str):
+        self.toks = re.findall(r"\(|\)|\"[^\"]*\"|[^\s()]+", q)
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def parse(self):
+        node = self._or()
+        if self.peek() is not None:
+            raise ValueError(f"bad TEXT_MATCH query near {self.peek()!r}")
+        return node
+
+    def _or(self):
+        parts = [self._and()]
+        while self.peek() is not None and self.peek().upper() != "AND" \
+                and self.peek() != ")":
+            if self.peek().upper() == "OR":
+                self.i += 1
+            parts.append(self._and())
+        return ("or", parts) if len(parts) > 1 else parts[0]
+
+    def _and(self):
+        parts = [self._unary()]
+        while self.peek() is not None and self.peek().upper() == "AND":
+            self.i += 1
+            parts.append(self._unary())
+        return ("and", parts) if len(parts) > 1 else parts[0]
+
+    def _unary(self):
+        t = self.peek()
+        if t is None:
+            raise ValueError("empty TEXT_MATCH query")
+        if t.upper() == "NOT":
+            self.i += 1
+            return ("not", self._unary())
+        if t == "(":
+            self.i += 1
+            node = self._or()
+            if self.peek() != ")":
+                raise ValueError("unbalanced parens in TEXT_MATCH query")
+            self.i += 1
+            return node
+        self.i += 1
+        if t.startswith('"'):
+            return ("phrase", tokenize(t.strip('"')))
+        return ("term", t.lower())
+
+
+class TextIndexReader:
+    def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
+        self.postings = CsrPostings(os.path.join(seg_dir, col + SUFFIX))
+        with open(os.path.join(seg_dir, col + SUFFIX + ".vocab.json")) as fh:
+            vocab = json.load(fh)
+        self.vocab = {t: i for i, t in enumerate(vocab)}
+
+    def _term_mask(self, term: str, n_docs: int) -> np.ndarray:
+        if "*" in term or "?" in term:  # wildcard: scan the vocab
+            rx = re.compile("^" + term.replace("*", ".*").replace("?", ".")
+                            + "$")
+            keys = [i for t, i in self.vocab.items() if rx.match(t)]
+            return self.postings.mask_for(keys, n_docs)
+        key = self.vocab.get(term)
+        mask = np.zeros(n_docs, dtype=bool)
+        if key is not None:
+            mask[self.postings.docs_for(key)] = True
+        return mask
+
+    def _eval(self, node, n_docs: int) -> np.ndarray:
+        kind = node[0]
+        if kind == "term":
+            return self._term_mask(node[1], n_docs)
+        if kind == "phrase":
+            mask = np.ones(n_docs, dtype=bool)
+            for t in node[1]:
+                mask &= self._term_mask(t, n_docs)
+            return mask
+        if kind == "and":
+            mask = np.ones(n_docs, dtype=bool)
+            for c in node[1]:
+                mask &= self._eval(c, n_docs)
+            return mask
+        if kind == "or":
+            mask = np.zeros(n_docs, dtype=bool)
+            for c in node[1]:
+                mask |= self._eval(c, n_docs)
+            return mask
+        if kind == "not":
+            return ~self._eval(node[1], n_docs)
+        raise ValueError(kind)
+
+    def match(self, query: str, n_docs: int) -> np.ndarray:
+        return self._eval(_QueryParser(query).parse(), n_docs)

@@ -135,7 +135,11 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
             r = args[1]
             if not isinstance(r, _sql_mod().Literal):
                 raise _sql_mod().SqlError("mode: reducer must be a literal")
-            return (kind, args[0], None, (str(r.value).lower(),))
+            reducer = str(r.value).lower()
+            if reducer not in ("min", "max", "avg"):
+                raise _sql_mod().SqlError(
+                    f"mode: reducer must be MIN|MAX|AVG, got {r.value!r}")
+            return (kind, args[0], None, (reducer,))
         _need(name, args, 1)
         return (kind, args[0], None, ("min",))
     if kind == "distinct_count_hll":
@@ -143,7 +147,12 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
             r = args[1]
             if not isinstance(r, _sql_mod().Literal):
                 raise _sql_mod().SqlError("distinctcounthll: log2m must be a literal")
-            log2m = int(r.value)
+            try:
+                log2m = int(r.value)
+            except (TypeError, ValueError):
+                raise _sql_mod().SqlError(
+                    f"distinctcounthll: log2m must be an integer, "
+                    f"got {r.value!r}") from None
             if not 4 <= log2m <= 20:
                 raise _sql_mod().SqlError(
                     f"distinctcounthll: log2m must be in [4, 20], "
@@ -226,36 +235,67 @@ class AggImpl:
         raise NotImplementedError
 
 
-class _PowerSums(AggImpl):
-    """Shared base: state = (n, S1, .., Sk) raw power sums; merge = add."""
-    K = 2
+class _CentralMoments(AggImpl):
+    """Shared base: state = (n, mean, M2[, M3[, M4]]) CENTRAL moments;
+    merge = Chan's pairwise combine. Raw power sums (sum(x^k)) cancel
+    catastrophically when |mean| >> stddev; central moments are the
+    numerically-stable mergeable form — same design as the reference's
+    PinotFourthMoment (pinot-segment-local/.../customobject/)."""
+    K = 2  # highest central moment tracked
 
     def empty(self):
-        return tuple([0] + [0.0] * self.K)
+        return tuple([0, 0.0] + [0.0] * (self.K - 1))
 
-    def _sums(self, v: np.ndarray) -> tuple:
+    def _moments(self, v: np.ndarray) -> tuple:
         v = _f64(v)
-        return tuple([int(v.size)]
-                     + [float(np.sum(v ** i)) for i in range(1, self.K + 1)])
+        n = int(v.size)
+        if n == 0:
+            return self.empty()
+        mean = float(v.mean())
+        d = v - mean
+        return tuple([n, mean] + [float(np.sum(d ** i))
+                                  for i in range(2, self.K + 1)])
 
     def state(self, h: HostSel):
-        return self._sums(h.ev(self.agg.arg))
+        return self._moments(h.ev(self.agg.arg))
 
     def group_states(self, h: HostSel):
         v = _f64(h.ev(self.agg.arg))
-        out = []
         n = np.bincount(h.inv, minlength=h.n_groups)
-        sums = [np.bincount(h.inv, weights=v ** i, minlength=h.n_groups)
-                for i in range(1, self.K + 1)]
-        for g in range(h.n_groups):
-            out.append(tuple([int(n[g])] + [float(s[g]) for s in sums]))
-        return out
+        safe = np.maximum(n, 1)
+        mean = np.bincount(h.inv, weights=v, minlength=h.n_groups) / safe
+        d = v - mean[h.inv]
+        ms = [np.bincount(h.inv, weights=d ** i, minlength=h.n_groups)
+              for i in range(2, self.K + 1)]
+        return [tuple([int(n[g]), float(mean[g])]
+                      + [float(m[g]) for m in ms])
+                for g in range(h.n_groups)]
 
     def merge(self, a, b):
-        return tuple(x + y for x, y in zip(a, b))
+        na, nb = a[0], b[0]
+        if na == 0:
+            return b
+        if nb == 0:
+            return a
+        n = na + nb
+        d = b[1] - a[1]
+        out = [n, a[1] + d * nb / n,
+               a[2] + b[2] + d * d * na * nb / n]
+        if self.K >= 3:
+            out.append(a[3] + b[3]
+                       + d ** 3 * na * nb * (na - nb) / n ** 2
+                       + 3.0 * d * (na * b[2] - nb * a[2]) / n)
+        if self.K >= 4:
+            out.append(a[4] + b[4]
+                       + d ** 4 * na * nb * (na * na - na * nb + nb * nb)
+                       / n ** 3
+                       + 6.0 * d * d * (na * na * b[2] + nb * nb * a[2])
+                       / n ** 2
+                       + 4.0 * d * (na * b[3] - nb * a[3]) / n)
+        return tuple(out)
 
 
-class VarianceAgg(_PowerSums):
+class VarianceAgg(_CentralMoments):
     K = 2
 
     def __init__(self, agg, sample: bool, stddev: bool):
@@ -264,42 +304,34 @@ class VarianceAgg(_PowerSums):
         self.stddev = stddev
 
     def finalize(self, s):
-        n, s1, s2 = s
+        n, _mean, m2 = s
         if n == 0 or (self.sample and n < 2):
             return None
-        mean = s1 / n
-        m2 = max(s2 - n * mean * mean, 0.0)
-        var = m2 / (n - 1 if self.sample else n)
+        var = max(m2, 0.0) / (n - 1 if self.sample else n)
         return math.sqrt(var) if self.stddev else var
 
 
-class SkewnessAgg(_PowerSums):
+class SkewnessAgg(_CentralMoments):
     K = 3
 
     def finalize(self, s):
-        n, s1, s2, s3 = s
+        n, _mean, m2, m3 = s
         if n < 3:
             return None
-        mean = s1 / n
-        m2 = max(s2 - n * mean ** 2, 0.0)
-        m3 = s3 - 3 * mean * s2 + 2 * n * mean ** 3
-        if m2 == 0:
+        if m2 <= 0:
             return 0.0
         sd = math.sqrt(m2 / (n - 1))  # sample sd (commons-math Skewness)
         return (n / ((n - 1) * (n - 2))) * m3 / sd ** 3
 
 
-class KurtosisAgg(_PowerSums):
+class KurtosisAgg(_CentralMoments):
     K = 4
 
     def finalize(self, s):
-        n, s1, s2, s3, s4 = s
+        n, _mean, m2, m3, m4 = s
         if n < 4:
             return None
-        mean = s1 / n
-        m2 = max(s2 - n * mean ** 2, 0.0)
-        m4 = (s4 - 4 * mean * s3 + 6 * mean ** 2 * s2 - 3 * n * mean ** 4)
-        if m2 == 0:
+        if m2 <= 0:
             return 0.0
         var = m2 / (n - 1)  # commons-math Kurtosis (sample, excess)
         term = (n * (n + 1.0)) / ((n - 1.0) * (n - 2.0) * (n - 3.0))
@@ -750,15 +782,26 @@ _CLASSIC_EMPTY = {"count": 0, "sum": 0, "min": None, "max": None,
                   "avg": (0, 0), "distinct_count": set}
 
 
+def _impl(agg: Any) -> AggImpl:
+    """Resolve (once per AggExpr) and cache the extended-agg impl on the
+    expression itself — merge/finalize run per (group x partial) in the
+    reduce hot loop and must not re-dispatch every call."""
+    impl = getattr(agg, "_impl_cache", None)
+    if impl is None:
+        impl = make(agg)
+        if impl is None:
+            raise _sql_mod().SqlError(
+                f"unknown aggregation kind {agg.kind!r}")
+        object.__setattr__(agg, "_impl_cache", impl)  # frozen dataclass
+    return impl
+
+
 def empty_state(agg: Any) -> Any:
     k = agg.kind
     if k in _CLASSIC_EMPTY:
         e = _CLASSIC_EMPTY[k]
         return e() if callable(e) else e
-    impl = make(agg)
-    if impl is None:
-        raise _sql_mod().SqlError(f"unknown aggregation kind {k!r}")
-    return impl.empty()
+    return _impl(agg).empty()
 
 
 def merge_states(agg: Any, a: Any, b: Any) -> Any:
@@ -773,10 +816,7 @@ def merge_states(agg: Any, a: Any, b: Any) -> Any:
         return (a[0] + b[0], a[1] + b[1])
     if k == "distinct_count":
         return a | b
-    impl = make(agg)
-    if impl is None:
-        raise _sql_mod().SqlError(f"unknown aggregation kind {k!r}")
-    return impl.merge(a, b)
+    return _impl(agg).merge(a, b)
 
 
 def finalize_state(agg: Any, s: Any) -> Any:
@@ -787,7 +827,4 @@ def finalize_state(agg: Any, s: Any) -> Any:
         return len(s)
     if k in ("count", "sum", "min", "max"):
         return s
-    impl = make(agg)
-    if impl is None:
-        raise _sql_mod().SqlError(f"unknown aggregation kind {k!r}")
-    return impl.finalize(s)
+    return _impl(agg).finalize(s)

@@ -27,8 +27,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..ops.ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange,
-                      InSet, IsNull as IsNullIR, KernelPlan, Lit, Not, Or,
-                      Pred, TrueP, ValueExpr)
+                      InSet, IsNull as IsNullIR, KernelPlan, Lit,
+                      MaskParam as MaskParamP, Not, Or, Pred, TrueP,
+                      ValueExpr)
 from ..segment.immutable import ImmutableSegment
 from ..spi.schema import DataType
 from .context import AggExpr, QueryContext
@@ -233,6 +234,18 @@ class SegmentPlanner:
             return self._is_null(e)
         if isinstance(e, Literal) and isinstance(e.value, bool):
             return TrueP() if e.value else FalseP()
+        from ..index.predicates import is_index_predicate, index_filter_mask
+        if is_index_predicate(e):
+            # TEXT_MATCH / JSON_MATCH / VECTOR_SIMILARITY: the index
+            # evaluates host-side into a doc mask shipped as a kernel param
+            # (SqlError propagates when the index is missing — user error,
+            # not host fallback)
+            mask = index_filter_mask(self.seg, e)
+            if not mask.any():
+                return FalseP()
+            if mask.all():
+                return TrueP()
+            return MaskParamP(self.b.add_param(("docmask", mask)))
         raise PlanError(f"unsupported filter expression {e!r}")
 
     def _comparison(self, e: Comparison) -> Pred:
@@ -290,6 +303,17 @@ class SegmentPlanner:
 
     def _raw_cmp(self, name: str, m, op: str, v: Any) -> Pred:
         v = self._cast_for(m, v)  # coerce string literals; PlanError if not
+        if op == "==" and "bloom" in getattr(m, "indexes", {}):
+            # BloomFilterSegmentPruner analog: a definite miss folds the
+            # predicate (and possibly the whole segment plan) to FalseP.
+            # Coerce the literal to the column dtype first so its string
+            # hash matches how the build stringified the typed array
+            # (int literal 5 vs stored float "5.0" must not false-prune).
+            reader = self.seg.index_reader(name, "bloom")
+            probe = (np.asarray(v, dtype=m.data_type.np_dtype)
+                     if m.data_type.is_numeric else v)
+            if reader is not None and not reader.might_contain(probe):
+                return FalseP()
         # min/max constant folding = ColumnValueSegmentPruner for raw columns
         mn, mx = m.min, m.max
         if mn is not None and mx is not None and isinstance(v, (int, float)):

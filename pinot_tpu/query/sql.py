@@ -188,7 +188,7 @@ _TOKEN_RE = re.compile(r"""
     | (?P<string>'(?:[^']|'')*')
     | (?P<dqident>"(?:[^"]|"")*")
     | (?P<ident>[A-Za-z_][A-Za-z_0-9$.]*)
-    | (?P<op><=|>=|!=|<>|=|<|>|\(|\)|,|\*|\+|-|/|%|;)
+    | (?P<op><=|>=|!=|<>|=|<|>|\(|\)|\[|\]|,|\*|\+|-|/|%|;)
     )""", re.VERBOSE)
 
 KEYWORDS = {
@@ -550,6 +550,18 @@ class _Parser:
             return e
         if t.kind == "op" and t.value == "*":
             return Star()
+        if t.kind == "ident" and t.value.lower() == "array" \
+                and self.peek().kind == "op" and self.peek().value == "[":
+            # ARRAY[1.0, 2.0, ...] literal (vector queries); elements must
+            # be numeric literals
+            self.next()
+            vals: List[Any] = []
+            if not (self.peek().kind == "op" and self.peek().value == "]"):
+                vals.append(self.literal().value)
+                while self.accept_op(","):
+                    vals.append(self.literal().value)
+            self.expect_op("]")
+            return Literal(tuple(vals))
         if t.kind == "ident":
             if self.peek().kind == "op" and self.peek().value == "(":
                 self.next()

@@ -86,6 +86,13 @@ class SegmentBuilder:
 
     def _coerce(self, f, arr: np.ndarray):
         null_mask = np.zeros(len(arr), dtype=bool)
+        if f.name in self.table_config.indexing.vector_index_columns:
+            # vector column: rows are fixed-dim float sequences; stored only
+            # through the vector index (index/vector.py), queried only via
+            # VECTOR_SIMILARITY
+            out = np.empty(len(arr), dtype=object)
+            out[:] = [np.asarray(v, dtype=np.float32) for v in arr]
+            return out, null_mask
         if arr.dtype == object:
             null_mask = np.array([v is None for v in arr], dtype=bool)
             if null_mask.any():
@@ -143,8 +150,27 @@ class SegmentBuilder:
         if self.table_config.partition_column:
             meta["partitionColumn"] = self.table_config.partition_column
 
+        idx_cfg = self.table_config.indexing
         for f in self.schema.fields:
             arr = cols[f.name]
+            if f.name in idx_cfg.vector_index_columns:
+                from .. import index as index_pkg
+                vcfg = idx_cfg.vector_index_columns[f.name]
+                cmeta = {
+                    "dataType": f.data_type.value,
+                    "fieldType": f.field_type.value,
+                    "encoding": "VECTOR",
+                    "fwdDtype": "float32",
+                    "cardinality": 0,
+                }
+                extra = index_pkg.build_indexes_for_column(
+                    f.name, ["vector"], seg_dir, values=arr, ids=None,
+                    cardinality=0)
+                extra["vector"].update({k: v for k, v in vcfg.items()
+                                        if k == "metric"})
+                cmeta["indexes"] = extra
+                meta["columns"][f.name] = cmeta
+                continue
             cmeta = self._build_column(
                 f, arr, seg_dir,
                 shared_dict=(shared_dicts or {}).get(f.name))
@@ -247,6 +273,17 @@ class SegmentBuilder:
             if n:
                 cmeta["min"] = _json_scalar(arr.min())
                 cmeta["max"] = _json_scalar(arr.max())
+
+        kinds = self.table_config.indexing.indexes_for(f.name)
+        if kinds:
+            from .. import index as index_pkg
+            if "inverted" in kinds and not use_dict:
+                raise ValueError(f"inverted index needs a dictionary "
+                                 f"column: {f.name!r}")
+            cmeta["indexes"] = index_pkg.build_indexes_for_column(
+                f.name, kinds, seg_dir, values=arr,
+                ids=ids if use_dict else None,
+                cardinality=cardinality)
         return cmeta
 
     @staticmethod

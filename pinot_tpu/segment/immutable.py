@@ -53,6 +53,8 @@ class ColumnMeta:
         self.dict_format = d.get("dictFormat")
         self.dict_dtype = d.get("dictDtype")
         self.partitions = d.get("partitions")
+        # secondary indexes: kind -> extra metadata (index/registry.py)
+        self.indexes: Dict[str, Any] = d.get("indexes", {})
 
     @property
     def has_dict(self) -> bool:
@@ -73,6 +75,7 @@ class ImmutableSegment:
             name: ColumnMeta(name, d)
             for name, d in self.metadata["columns"].items()}
         self._read_mode = read_mode
+        self._index_readers: Dict[Tuple[str, str], Any] = {}
         self._fwd: Dict[str, np.ndarray] = {}
         self._dicts: Dict[str, Dictionary] = {}
         self._nulls: Dict[str, Optional[np.ndarray]] = {}
@@ -140,9 +143,24 @@ class ImmutableSegment:
             self._nulls[col] = np.unpackbits(bits)[: self.n_docs].astype(bool)
         return self._nulls[col]
 
+    def index_reader(self, col: str, kind: str):
+        """Lazy secondary-index reader (StandardIndexes registry analog);
+        None when the column has no such index."""
+        m = self.columns.get(col)
+        if m is None or kind not in m.indexes:
+            return None
+        key = (col, kind)
+        if key not in self._index_readers:
+            from .. import index as index_pkg
+            self._index_readers[key] = index_pkg.load_index(
+                self.dir, col, kind, m.indexes[kind])
+        return self._index_readers[key]
+
     def raw_values(self, col: str) -> np.ndarray:
         """Decoded values (host-side; for selection results / oracles)."""
         m = self.columns[col]
+        if m.encoding == "VECTOR":
+            return np.asarray(self.index_reader(col, "vector").matrix)
         stored = self.fwd(col)
         if m.has_dict:
             return self.dictionary(col).values_for(np.asarray(stored))

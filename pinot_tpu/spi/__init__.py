@@ -1,2 +1,2 @@
 from .schema import DataType, FieldType, FieldSpec, Schema  # noqa: F401
-from .config import TableConfig, TableType  # noqa: F401
+from .config import IndexingConfig, InstanceConfig, SegmentsConfig, TableConfig, TableType  # noqa: F401

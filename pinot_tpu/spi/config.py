@@ -37,6 +37,29 @@ class IndexingConfig:
     bit_packed_ids: bool = False
     # compress raw columns: None | "ZSTD" | "ZLIB"
     compression: Optional[str] = None
+    # secondary per-column indexes (StandardIndexes analog; built by
+    # pinot_tpu.index registry at segment-build time)
+    inverted_index_columns: List[str] = field(default_factory=list)
+    range_index_columns: List[str] = field(default_factory=list)
+    bloom_filter_columns: List[str] = field(default_factory=list)
+    text_index_columns: List[str] = field(default_factory=list)
+    json_index_columns: List[str] = field(default_factory=list)
+    # col -> {"dim": int, "metric": "cosine"|"l2"}
+    vector_index_columns: Dict[str, Dict[str, Any]] = field(
+        default_factory=dict)
+
+    def indexes_for(self, col: str) -> List[str]:
+        kinds = []
+        for kind, cols in (("inverted", self.inverted_index_columns),
+                           ("range", self.range_index_columns),
+                           ("bloom", self.bloom_filter_columns),
+                           ("text", self.text_index_columns),
+                           ("json", self.json_index_columns)):
+            if col in cols:
+                kinds.append(kind)
+        if col in self.vector_index_columns:
+            kinds.append("vector")
+        return kinds
 
 
 @dataclass
@@ -69,6 +92,12 @@ class TableConfig:
                 "noDictionaryColumns": self.indexing.no_dictionary_columns,
                 "sortedColumn": self.indexing.sorted_column,
                 "dictCardinalityThreshold": self.indexing.dict_cardinality_threshold,
+                "invertedIndexColumns": self.indexing.inverted_index_columns,
+                "rangeIndexColumns": self.indexing.range_index_columns,
+                "bloomFilterColumns": self.indexing.bloom_filter_columns,
+                "textIndexColumns": self.indexing.text_index_columns,
+                "jsonIndexColumns": self.indexing.json_index_columns,
+                "vectorIndexColumns": self.indexing.vector_index_columns,
             },
             "segments": {
                 "replication": self.segments.replication,
@@ -94,6 +123,12 @@ class TableConfig:
                 sorted_column=idx.get("sortedColumn"),
                 dict_cardinality_threshold=idx.get("dictCardinalityThreshold",
                                                    1 << 17),
+                inverted_index_columns=idx.get("invertedIndexColumns", []),
+                range_index_columns=idx.get("rangeIndexColumns", []),
+                bloom_filter_columns=idx.get("bloomFilterColumns", []),
+                text_index_columns=idx.get("textIndexColumns", []),
+                json_index_columns=idx.get("jsonIndexColumns", []),
+                vector_index_columns=idx.get("vectorIndexColumns", {}),
             ),
             segments=SegmentsConfig(
                 replication=seg.get("replication", 1),

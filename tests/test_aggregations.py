@@ -190,3 +190,39 @@ def test_extended_in_having_order(broker, data):
     assert len(res.rows) == 4
     vals = [r[1] for r in res.rows]
     assert vals == sorted(vals, reverse=True)
+
+
+def test_moments_large_mean_stability(tmp_path):
+    """Raw power sums cancel catastrophically at |mean| >> stddev; the
+    central-moment states must not (PinotFourthMoment-style merge)."""
+    rng = np.random.default_rng(3)
+    x = (1e6 + rng.normal(0, 1, 4000)).round(6)
+    schema = Schema("mm", [FieldSpec("x", DataType.DOUBLE, FieldType.METRIC)])
+    builder = SegmentBuilder(schema, TableConfig("mm"))
+    dm = TableDataManager("mm")
+    for i, (lo, hi) in enumerate(((0, 1500), (1500, 4000))):
+        dm.add_segment_dir(builder.build({"x": x[lo:hi]}, str(tmp_path),
+                                         f"seg_{i}"))
+    b = Broker()
+    b.register_table(dm)
+    sk, ku, sd = one(b.query(
+        "SELECT SKEWNESS(x), KURTOSIS(x), STDDEV(x) FROM mm"))
+    n = len(x)
+    d = x - x.mean()
+    m2, m3, m4 = (d ** 2).sum(), (d ** 3).sum(), (d ** 4).sum()
+    ssd = math.sqrt(m2 / (n - 1))
+    exp_sk = (n / ((n - 1) * (n - 2))) * m3 / ssd ** 3
+    term = (n * (n + 1.0)) / ((n - 1.0) * (n - 2.0) * (n - 3.0))
+    exp_ku = term * m4 / (m2 / (n - 1)) ** 2 \
+        - 3.0 * (n - 1.0) ** 2 / ((n - 2.0) * (n - 3.0))
+    assert sd == pytest.approx(x.std(ddof=1), rel=1e-6)
+    assert sk == pytest.approx(exp_sk, abs=1e-3)
+    assert ku == pytest.approx(exp_ku, abs=1e-3)
+
+
+def test_mode_bad_reducer_and_hll_bad_log2m(broker):
+    from pinot_tpu.query.sql import SqlError
+    with pytest.raises(SqlError, match="reducer"):
+        broker.query("SELECT MODE(iv, 'bogus') FROM agg")
+    with pytest.raises(SqlError, match="log2m"):
+        broker.query("SELECT DISTINCTCOUNTHLL(iv, 'abc') FROM agg")
