@@ -73,12 +73,16 @@ class Broker:
             return execute_multistage(self, stmt)
         ctx = build_query_context(stmt)
         trace_on = _truthy(ctx.options.get("trace"))
-        scope = Tracing.register(uuid.uuid4().hex[:12], trace_on)
+        query_id = uuid.uuid4().hex[:12]
+        scope = Tracing.register(query_id, trace_on)
         timeout_ms = int(ctx.options.get("timeoutMs", DEFAULT_TIMEOUT_MS))
         deadline = t0 + timeout_ms / 1e3
+        from ..engine.accounting import global_accountant
+        global_accountant.register(query_id, deadline=deadline)
         try:
             result = self._execute_ctx(ctx, stmt, t0, deadline)
         finally:
+            global_accountant.unregister(query_id)
             Tracing.unregister()
         if trace_on:
             result.trace = scope.to_dict()

@@ -14,8 +14,11 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
+from ..engine.accounting import global_accountant
+from ..engine.scheduler import make_scheduler
 from ..engine.serde import partial_to_wire
 from ..query.context import build_query_context
 from ..query.sql import parse_sql
@@ -26,10 +29,14 @@ from .http_util import JsonHandler, http_json, start_http
 
 class ServerNode:
     def __init__(self, instance_id: str, controller_url: str, port: int = 0,
-                 poll_interval: float = 0.3):
+                 poll_interval: float = 0.3,
+                 scheduler_config: Optional[Dict[str, Any]] = None):
         self.instance_id = instance_id
         self.controller_url = controller_url
         self.poll_interval = poll_interval
+        # admission + ordering for concurrent HTTP queries
+        # (QuerySchedulerFactory analog; fcfs by default)
+        self.scheduler = make_scheduler(scheduler_config)
         self._tables: Dict[str, TableDataManager] = {}
         self._assignment_version = -1
         self._stop = threading.Event()
@@ -88,8 +95,21 @@ class ServerNode:
         return False
 
     # -- data plane --------------------------------------------------------
-    def execute(self, sql: str, segment_names: Optional[List[str]] = None
-                ) -> Dict[str, Any]:
+    def execute(self, sql: str, segment_names: Optional[List[str]] = None,
+                priority: int = 0) -> Dict[str, Any]:
+        """Admit through the scheduler (QueryScheduler.submit analog) and
+        account the query so the watcher can kill it under pressure."""
+        query_id = uuid.uuid4().hex[:12]
+        global_accountant.register(query_id)
+        try:
+            return self.scheduler.execute(
+                lambda: self._execute(sql, segment_names),
+                query_id, priority=priority)
+        finally:
+            global_accountant.unregister(query_id)
+
+    def _execute(self, sql: str, segment_names: Optional[List[str]] = None
+                 ) -> Dict[str, Any]:
         stmt = parse_sql(sql)
         if stmt.joins:
             raise ValueError("leaf servers execute single-table stages")
@@ -127,6 +147,7 @@ class ServerNode:
 
     def stop(self) -> None:
         self._stop.set()
+        self.scheduler.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 

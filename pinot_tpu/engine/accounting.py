@@ -1,0 +1,201 @@
+"""Per-query resource accounting + query killing.
+
+Reference parity: pinot-spi/.../accounting/ThreadResourceUsageAccountant
+(SPI) + pinot-core/.../accounting/PerQueryCPUMemAccountantFactory.java:66 —
+per-thread CPU/memory sampled into per-query aggregates (:125-126,263), a
+WatcherTask that kills the most expensive query under heap pressure
+(:471-494), and the hot-loop interrupt check
+Tracing.ThreadAccountantOps.sample() (DocIdSetOperator.java:70).
+
+TPU-native shape: queries are a handful of XLA launches, not thousands of
+block iterations — sample() sits between per-segment launches (the
+engine's natural preemption points), CPU comes from time.thread_time
+deltas of the executing thread, memory is the tracked bytes of
+materialized partials plus process RSS for the watcher's pressure signal.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..query.sql import SqlError
+
+
+class QueryKilledError(SqlError):
+    """Raised inside the query's own execution path after a kill flag."""
+
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def process_rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * _PAGE
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+@dataclass
+class QueryUsage:
+    query_id: str
+    start: float = field(default_factory=time.perf_counter)
+    deadline: Optional[float] = None
+    cpu_s: float = 0.0
+    mem_bytes: int = 0
+    killed_reason: Optional[str] = None
+    _thread_cpu0: Dict[int, float] = field(default_factory=dict)
+
+    @property
+    def wall_s(self) -> float:
+        return time.perf_counter() - self.start
+
+    def cost(self) -> float:
+        """Kill ordering: tracked memory dominates, wall time breaks ties
+        (the reference ranks by allocated bytes)."""
+        return self.mem_bytes + self.wall_s * 1e6
+
+
+class ResourceAccountant:
+    """Global registry: thread -> running query, with kill/timeout checks
+    at sample points."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_query: Dict[str, QueryUsage] = {}
+        self._by_thread: Dict[int, str] = {}
+
+    # -- registration ------------------------------------------------------
+    def register(self, query_id: str, deadline: Optional[float] = None
+                 ) -> QueryUsage:
+        u = QueryUsage(query_id, deadline=deadline)
+        tid = threading.get_ident()
+        with self._lock:
+            self._by_query[query_id] = u
+            self._by_thread[tid] = query_id
+        u._thread_cpu0[tid] = time.thread_time()
+        return u
+
+    def attach_thread(self, query_id: str) -> None:
+        """Worker threads executing on behalf of a query (combine-pool
+        TraceRunnable analog) call this so their samples account to it."""
+        tid = threading.get_ident()
+        with self._lock:
+            if query_id in self._by_query:
+                self._by_thread[tid] = query_id
+                self._by_query[query_id]._thread_cpu0[tid] = \
+                    time.thread_time()
+
+    def unregister(self, query_id: str) -> Optional[QueryUsage]:
+        with self._lock:
+            u = self._by_query.pop(query_id, None)
+            for tid in [t for t, q in self._by_thread.items()
+                        if q == query_id]:
+                del self._by_thread[tid]
+        return u
+
+    def usage(self, query_id: str) -> Optional[QueryUsage]:
+        with self._lock:
+            return self._by_query.get(query_id)
+
+    def running(self) -> List[QueryUsage]:
+        with self._lock:
+            return list(self._by_query.values())
+
+    # -- hot-loop hooks ----------------------------------------------------
+    def sample(self) -> None:
+        """Call between per-segment launches: accumulates this thread's CPU
+        into the owning query and raises if the query was killed or timed
+        out (ThreadAccountantOps.sample + interrupt-check analog)."""
+        tid = threading.get_ident()
+        with self._lock:
+            qid = self._by_thread.get(tid)
+            u = self._by_query.get(qid) if qid else None
+        if u is None:
+            return
+        t = time.thread_time()
+        t0 = u._thread_cpu0.get(tid, t)
+        u.cpu_s += max(t - t0, 0.0)
+        u._thread_cpu0[tid] = t
+        if u.killed_reason is not None:
+            raise QueryKilledError(
+                f"query {u.query_id} killed: {u.killed_reason}")
+        if u.deadline is not None and time.perf_counter() > u.deadline:
+            raise QueryKilledError(
+                f"query {u.query_id} killed: deadline exceeded")
+
+    def track_memory(self, nbytes: int) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            qid = self._by_thread.get(tid)
+            u = self._by_query.get(qid) if qid else None
+        if u is not None:
+            u.mem_bytes += max(int(nbytes), 0)
+
+    # -- killing -----------------------------------------------------------
+    def kill(self, query_id: str, reason: str) -> bool:
+        with self._lock:
+            u = self._by_query.get(query_id)
+        if u is None:
+            return False
+        u.killed_reason = reason
+        return True
+
+    def kill_most_expensive(self, reason: str) -> Optional[str]:
+        """PerQueryCPUMemResourceUsageAccountant.java:471-494 analog."""
+        candidates = [u for u in self.running() if u.killed_reason is None]
+        if not candidates:
+            return None
+        victim = max(candidates, key=QueryUsage.cost)
+        victim.killed_reason = reason
+        return victim.query_id
+
+
+class HeapWatcher:
+    """Background memory-pressure watcher: when process RSS crosses the
+    panic threshold, kill the most expensive running query (WatcherTask
+    analog, PerQueryCPUMemAccountantFactory.java:263)."""
+
+    def __init__(self, accountant: ResourceAccountant,
+                 rss_limit_bytes: int, panic_fraction: float = 0.9,
+                 interval_s: float = 0.2):
+        self.accountant = accountant
+        self.rss_limit = int(rss_limit_bytes)
+        self.panic = panic_fraction
+        self.interval = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills = 0
+
+    def start(self) -> "HeapWatcher":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.check_once()
+
+    def check_once(self) -> Optional[str]:
+        rss = process_rss_bytes()
+        if self.rss_limit and rss > self.rss_limit * self.panic:
+            victim = self.accountant.kill_most_expensive(
+                f"heap pressure: rss {rss >> 20}MiB > "
+                f"{int(self.rss_limit * self.panic) >> 20}MiB")
+            if victim is not None:
+                self.kills += 1
+                from ..utils.metrics import global_metrics
+                global_metrics.count("queries_killed_oom")
+            return victim
+        return None
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+global_accountant = ResourceAccountant()

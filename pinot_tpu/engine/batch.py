@@ -71,7 +71,11 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
     groups: Dict[Tuple, List[int]] = {}
     resolved: Dict[int, Tuple[jax.Array, ...]] = {}
 
+    from .accounting import global_accountant
     for i, plan in enumerate(plans):
+        # preemption point between per-segment launches (the hot-loop
+        # ThreadAccountantOps.sample analog): raises on kill/timeout
+        global_accountant.sample()
         if plan.kind != "kernel":
             results[i] = execute_plan(plan)
             continue
@@ -81,6 +85,7 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
         groups.setdefault(key, []).append(i)
 
     for (plan_struct, bucket, _sig), idxs in groups.items():
+        global_accountant.sample()
         if len(idxs) == 1:
             i = idxs[0]
             results[i] = execute_plan(plans[i])
@@ -94,6 +99,8 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
             for j in range(len(resolved[idxs[0]])))
         fn = _vmapped_kernel(plan_struct, bucket)
         out = jax.device_get(fn(cols, n_docs, params))
+        global_accountant.track_memory(
+            sum(np.asarray(v).nbytes for v in out.values()))
         for k, i in enumerate(idxs):
             per_seg = {name: v[k] for name, v in out.items()}
             results[i] = extract_partial(plans[i], per_seg)

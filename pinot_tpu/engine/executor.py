@@ -119,7 +119,11 @@ def run_kernel(plan: CompiledPlan) -> Dict[str, np.ndarray]:
     params = resolve_params(plan)
     fn = jitted_kernel(plan.kernel_plan, seg.bucket)
     out = fn(cols, np.int32(seg.n_docs), params)
-    return jax.device_get(out)
+    host = jax.device_get(out)
+    from .accounting import global_accountant
+    global_accountant.track_memory(
+        sum(np.asarray(v).nbytes for v in host.values()))
+    return host
 
 
 def extract_partial(plan: CompiledPlan, out: Dict[str, np.ndarray]):

@@ -134,13 +134,51 @@ def eval_filter(e: Any, seg: ImmutableSegment) -> np.ndarray:
             m = seg.columns.get(e.lhs.name)
             if m is not None and getattr(m, "has_dict", False) \
                     and "inverted" in getattr(m, "indexes", {}):
-                d = seg.dictionary(e.lhs.name)
+                # coerce the literal like the scan path (_align_str) does;
+                # on a non-coercible literal fall through so the scan path
+                # raises the same SqlError as without the index
                 val = e.rhs.value
-                did = d.index_of(str(val) if not m.data_type.is_numeric
-                                 else val)
-                mask = seg.index_reader(e.lhs.name, "inverted") \
-                    .mask_for_ids([did] if did >= 0 else [], n)
-                return ~mask if e.op == "!=" else mask
+                if m.data_type.is_numeric and isinstance(val, str):
+                    try:
+                        val = float(val) if ("." in val or "e" in val.lower()
+                                            ) else int(val)
+                    except ValueError:
+                        val = None
+                elif not m.data_type.is_numeric:
+                    val = str(val)
+                if val is not None:
+                    d = seg.dictionary(e.lhs.name)
+                    did = d.index_of(val)
+                    mask = seg.index_reader(e.lhs.name, "inverted") \
+                        .mask_for_ids([did] if did >= 0 else [], n)
+                    return ~mask if e.op == "!=" else mask
+        # RangeIndexBasedFilterOperator analog: chunk zone maps on raw
+        # numeric columns let the scan skip non-candidate chunks entirely
+        if e.op in ("<", "<=", ">", ">=", "==") \
+                and isinstance(e.lhs, Identifier) \
+                and isinstance(e.rhs, Literal) \
+                and isinstance(e.rhs.value, (int, float)) \
+                and not isinstance(e.rhs.value, bool):
+            m = seg.columns.get(e.lhs.name)
+            if m is not None and not getattr(m, "has_dict", False) \
+                    and "range" in getattr(m, "indexes", {}):
+                rd = seg.index_reader(e.lhs.name, "range")
+                v = e.rhs.value
+                lo, hi = {"<": (None, v), "<=": (None, v), ">": (v, None),
+                          ">=": (v, None), "==": (v, v)}[e.op]
+                cand = rd.candidate_chunks(lo, hi)
+                np_op = {"==": np.equal, "<": np.less, "<=": np.less_equal,
+                         ">": np.greater, ">=": np.greater_equal}[e.op]
+                vals = np.asarray(seg.fwd(e.lhs.name))
+                mask = np.zeros(n, dtype=bool)
+                if cand.all():
+                    mask[:] = np_op(vals[:n], v)
+                else:
+                    chunk = rd.chunk
+                    for ci in np.nonzero(cand)[0]:
+                        s = slice(ci * chunk, min((ci + 1) * chunk, n))
+                        mask[s] = np_op(vals[s], v)
+                return mask
         l = eval_value(e.lhs, seg)
         r = eval_value(e.rhs, seg)
         l, r = _align_str(l, r)

@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Any, Dict, Iterable, List, Tuple
+from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
@@ -142,7 +142,6 @@ class JsonIndexReader:
         with open(os.path.join(seg_dir, col + SUFFIX + ".keys.json")) as fh:
             keys = json.load(fh)
         self.keys = {k: i for i, k in enumerate(keys)}
-        self._sorted_keys = keys
 
     def _mask_for_key(self, key: str, n_docs: int) -> np.ndarray:
         mask = np.zeros(n_docs, dtype=bool)
@@ -150,16 +149,6 @@ class JsonIndexReader:
         if k is not None:
             mask[self.postings.docs_for(k)] = True
         return mask
-
-    def _keys_for_path(self, path: str) -> Iterable[int]:
-        # all value keys under a path (for wildcard-ish semantics)
-        prefix = path + SEP
-        import bisect
-        lo = bisect.bisect_left(self._sorted_keys, prefix)
-        for i in range(lo, len(self._sorted_keys)):
-            if not self._sorted_keys[i].startswith(prefix):
-                break
-            yield i
 
     def _eval(self, node, n_docs: int) -> np.ndarray:
         kind = node[0]

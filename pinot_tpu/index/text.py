@@ -108,9 +108,12 @@ class TextIndexReader:
         self.vocab = {t: i for i, t in enumerate(vocab)}
 
     def _term_mask(self, term: str, n_docs: int) -> np.ndarray:
-        if "*" in term or "?" in term:  # wildcard: scan the vocab
-            rx = re.compile("^" + term.replace("*", ".*").replace("?", ".")
-                            + "$")
+        if "*" in term or "?" in term:  # wildcard: scan the vocab;
+            # escape every other char so regex metachars in user input
+            # match literally instead of raising re.error
+            pattern = "".join(".*" if c == "*" else "." if c == "?"
+                              else re.escape(c) for c in term)
+            rx = re.compile("^" + pattern + "$")
             keys = [i for t, i in self.vocab.items() if rx.match(t)]
             return self.postings.mask_for(keys, n_docs)
         key = self.vocab.get(term)

@@ -254,3 +254,46 @@ def test_bloom_int_literal_on_float_column(tmp_path):
     b.register_table(dm)
     assert rows(b.query("SELECT COUNT(*) FROM fb WHERE d = 5")) == [(1,)]
     assert rows(b.query("SELECT COUNT(*) FROM fb WHERE d = 5.0")) == [(1,)]
+
+
+def test_inverted_numeric_literal_coercion(tmp_path):
+    """EQ fast path must coerce string literals like the scan path."""
+    from pinot_tpu.spi import IndexingConfig
+    schema = Schema("nv", [FieldSpec("v", DataType.LONG,
+                                     FieldType.DIMENSION),
+                           FieldSpec("s", DataType.STRING,
+                                     FieldType.DIMENSION)])
+    cfg = TableConfig("nv", indexing=IndexingConfig(
+        inverted_index_columns=["v"], dictionary_columns=["v"]))
+    dm = TableDataManager("nv")
+    dm.add_segment_dir(SegmentBuilder(schema, cfg).build(
+        {"v": np.asarray([3, 5, 5, 9]), "s": np.asarray(list("abcd"))},
+        str(tmp_path), "s0"))
+    b = Broker()
+    b.register_table(dm)
+    assert rows(b.query("SELECT s FROM nv WHERE v = '5' ORDER BY s")) == \
+        [("b",), ("c",)]
+    assert rows(b.query("SELECT s FROM nv WHERE v != '5' ORDER BY s")) == \
+        [("a",), ("d",)]
+
+
+def test_text_match_wildcard_metachars(seg_and_broker):
+    # regex metacharacters in wildcard terms match literally / zero docs,
+    # never raise re.error
+    _, b = seg_and_broker
+    res = b.query("SELECT COUNT(*) FROM events "
+                  "WHERE TEXT_MATCH(doc, 'fa[*')")
+    assert rows(res) == [(0,)]
+
+
+def test_range_index_host_scan(seg_and_broker, data):
+    # selection queries evaluate filters via host_eval: a range filter on
+    # the range-indexed raw column exercises the chunk-skipping path
+    _, b = seg_and_broker
+    res = b.query("SELECT views FROM events WHERE views >= 9990 "
+                  "ORDER BY views LIMIT 100")
+    expect = sorted(int(v) for v in data["views"][data["views"] >= 9990])
+    assert [r[0] for r in res.rows] == expect[:100]
+    res = b.query("SELECT views FROM events WHERE views = 9999 LIMIT 100")
+    expect_n = int((data["views"] == 9999).sum())
+    assert len(res.rows) == min(expect_n, 100)

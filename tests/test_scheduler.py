@@ -1,0 +1,178 @@
+"""Query scheduler + resource accounting + query-killing suite.
+
+Reference analog: pinot-core query/scheduler tests (FCFS vs priority
+ordering, admission rejection) and the accounting query-killing tests
+(OfflineClusterMemBasedServerQueryKillingTest pattern, in-process).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.engine.accounting import (HeapWatcher, QueryKilledError,
+                                         ResourceAccountant)
+from pinot_tpu.engine.scheduler import (FcfsScheduler, PriorityScheduler,
+                                        SchedulerRejectedError,
+                                        make_scheduler)
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+
+def test_fcfs_runs_in_arrival_order():
+    sched = FcfsScheduler(num_workers=1, max_pending=16)
+    order, gate = [], threading.Event()
+    futures = [sched.submit(lambda: gate.wait(5), "q0")]
+    for i in range(1, 5):
+        futures.append(sched.submit(
+            lambda i=i: order.append(i), f"q{i}", priority=5 - i))
+    gate.set()
+    for f in futures:
+        f.result(timeout=5)
+    assert order == [1, 2, 3, 4]  # arrival order; priorities ignored
+    sched.stop()
+
+
+def test_priority_scheduler_orders_by_priority():
+    sched = PriorityScheduler(num_workers=1, max_pending=16)
+    order, gate = [], threading.Event()
+    first = sched.submit(lambda: gate.wait(5), "q0")
+    futures = [sched.submit(lambda i=i: order.append(i), f"q{i}",
+                            priority=10 - i) for i in range(1, 5)]
+    gate.set()
+    first.result(timeout=5)
+    for f in futures:
+        f.result(timeout=5)
+    assert order == [4, 3, 2, 1]  # lowest priority value first
+    sched.stop()
+
+
+def test_scheduler_rejects_when_queue_full():
+    sched = FcfsScheduler(num_workers=1, max_pending=2)
+    gate = threading.Event()
+    sched.submit(lambda: gate.wait(5), "q0")
+    time.sleep(0.05)  # let the worker take q0 off the queue
+    sched.submit(lambda: None, "q1")
+    sched.submit(lambda: None, "q2")
+    with pytest.raises(SchedulerRejectedError):
+        sched.submit(lambda: None, "q3")
+    gate.set()
+    sched.stop()
+
+
+def test_make_scheduler_factory():
+    assert isinstance(make_scheduler({}), FcfsScheduler)
+    assert isinstance(
+        make_scheduler({"query.scheduler.name": "priority"}),
+        PriorityScheduler)
+    with pytest.raises(ValueError):
+        make_scheduler({"query.scheduler.name": "bogus"})
+
+
+def test_accountant_kill_raises_at_sample():
+    acct = ResourceAccountant()
+    acct.register("qk")
+    acct.sample()  # fine while alive
+    assert acct.kill("qk", "test kill")
+    with pytest.raises(QueryKilledError, match="test kill"):
+        acct.sample()
+    acct.unregister("qk")
+    acct.sample()  # unregistered thread: no-op
+
+
+def test_accountant_deadline_raises_at_sample():
+    acct = ResourceAccountant()
+    acct.register("qd", deadline=time.perf_counter() - 1)
+    with pytest.raises(QueryKilledError, match="deadline"):
+        acct.sample()
+    acct.unregister("qd")
+
+
+def test_accountant_tracks_cpu_and_memory():
+    acct = ResourceAccountant()
+    u = acct.register("qc")
+    x = 0
+    for i in range(200_000):
+        x += i
+    acct.track_memory(1 << 20)
+    acct.sample()
+    assert u.cpu_s > 0
+    assert u.mem_bytes == 1 << 20
+    acct.unregister("qc")
+
+
+def test_watcher_kills_most_expensive():
+    acct = ResourceAccountant()
+    a = acct.register("cheap")
+    b = acct.register("costly")
+    a.mem_bytes = 1 << 10
+    b.mem_bytes = 1 << 30
+    w = HeapWatcher(acct, rss_limit_bytes=1, panic_fraction=0.0)
+    victim = w.check_once()
+    assert victim == "costly"
+    assert b.killed_reason is not None and "heap pressure" in b.killed_reason
+    assert a.killed_reason is None
+    acct.unregister("cheap")
+    acct.unregister("costly")
+
+
+def test_killed_query_aborts_engine_loop(tmp_path):
+    """The per-segment sample() preemption point must surface the kill as
+    a query error (PerQueryCPUMemAccountant kill-path analog)."""
+    from pinot_tpu.engine.accounting import global_accountant
+    schema = Schema("kt", [FieldSpec("v", DataType.INT, FieldType.METRIC)])
+    builder = SegmentBuilder(schema, TableConfig("kt"))
+    dm = TableDataManager("kt")
+    for i in range(3):
+        dm.add_segment_dir(builder.build(
+            {"v": np.arange(100, dtype=np.int32)}, str(tmp_path), f"s{i}"))
+    b = Broker()
+    b.register_table(dm)
+
+    import pinot_tpu.broker.broker as broker_mod
+    orig_register = global_accountant.register
+
+    def register_and_kill(query_id, deadline=None):
+        u = orig_register(query_id, deadline=deadline)
+        global_accountant.kill(query_id, "watcher says no")
+        return u
+
+    broker_mod_acct = global_accountant
+    try:
+        broker_mod_acct.register = register_and_kill
+        with pytest.raises(QueryKilledError, match="watcher says no"):
+            b.query("SELECT SUM(v) FROM kt")
+    finally:
+        broker_mod_acct.register = orig_register
+    # a normal query still works afterwards
+    assert b.query("SELECT COUNT(*) FROM kt").rows[0][0] == 300
+
+
+def test_server_node_scheduler_integration(tmp_path):
+    """ServerNode admits queries through its scheduler."""
+    from pinot_tpu.cluster.controller import Controller
+    from pinot_tpu.cluster.server_node import ServerNode
+    ctl = Controller(str(tmp_path / "ctrl"), reconcile_interval=0.1)
+    try:
+        node = ServerNode("server_0", ctl.url, poll_interval=0.1,
+                          scheduler_config={
+                              "query.scheduler.name": "priority"})
+        try:
+            schema = Schema("st", [FieldSpec("v", DataType.INT,
+                                             FieldType.METRIC)])
+            seg = SegmentBuilder(schema, TableConfig("st")).build(
+                {"v": np.arange(50, dtype=np.int32)}, str(tmp_path), "s0")
+            ctl.add_table("st", schema.to_dict())
+            ctl.add_segment("st", "s0", seg)
+            assert node.wait_for_version(
+                ctl.routing_snapshot()["version"])
+            out = node.execute("SELECT SUM(v) FROM st")
+            assert out["segmentsQueried"] == 1
+            assert isinstance(node.scheduler, PriorityScheduler)
+        finally:
+            node.stop()
+    finally:
+        ctl.stop()
