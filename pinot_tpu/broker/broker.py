@@ -63,21 +63,27 @@ class Broker:
     def _query(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
+        from ..engine.accounting import global_accountant
+        query_id = uuid.uuid4().hex[:12]
+        timeout_ms = int(stmt.options.get("timeoutMs", DEFAULT_TIMEOUT_MS))
+        deadline = t0 + timeout_ms / 1e3
         if stmt.joins:
             # v2 engine (BrokerRequestHandlerDelegate picks the multi-stage
-            # handler when the query needs it)
+            # handler when the query needs it); registered with the
+            # accountant like any query so kills/deadlines reach its leaf
+            # scans' sample points
             from ..multistage import execute_multistage
             from ..multistage.executor import explain_multistage
             if stmt.explain:
                 return explain_multistage(self, stmt)
-            return execute_multistage(self, stmt)
+            global_accountant.register(query_id, deadline=deadline)
+            try:
+                return execute_multistage(self, stmt)
+            finally:
+                global_accountant.unregister(query_id)
         ctx = build_query_context(stmt)
         trace_on = _truthy(ctx.options.get("trace"))
-        query_id = uuid.uuid4().hex[:12]
         scope = Tracing.register(query_id, trace_on)
-        timeout_ms = int(ctx.options.get("timeoutMs", DEFAULT_TIMEOUT_MS))
-        deadline = t0 + timeout_ms / 1e3
-        from ..engine.accounting import global_accountant
         global_accountant.register(query_id, deadline=deadline)
         try:
             result = self._execute_ctx(ctx, stmt, t0, deadline)

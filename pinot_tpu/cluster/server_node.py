@@ -37,6 +37,16 @@ class ServerNode:
         # admission + ordering for concurrent HTTP queries
         # (QuerySchedulerFactory analog; fcfs by default)
         self.scheduler = make_scheduler(scheduler_config)
+        # OOM protection: kill the most expensive query near the RSS limit
+        # (PerQueryCPUMemAccountant WatcherTask analog); limit defaults to
+        # 90% of system memory, override/disable via
+        # scheduler_config["query.killer.rss_limit_bytes"] (0 disables)
+        from ..engine.accounting import HeapWatcher, system_memory_bytes
+        cfg = scheduler_config or {}
+        rss_limit = int(cfg.get("query.killer.rss_limit_bytes",
+                                int(system_memory_bytes() * 0.9)))
+        self.heap_watcher = (HeapWatcher(global_accountant, rss_limit).start()
+                             if rss_limit > 0 else None)
         self._tables: Dict[str, TableDataManager] = {}
         self._assignment_version = -1
         self._stop = threading.Event()
@@ -103,14 +113,21 @@ class ServerNode:
         global_accountant.register(query_id)
         try:
             return self.scheduler.execute(
-                lambda: self._execute(sql, segment_names),
+                lambda: self._execute(sql, segment_names, query_id),
                 query_id, priority=priority)
         finally:
             global_accountant.unregister(query_id)
 
-    def _execute(self, sql: str, segment_names: Optional[List[str]] = None
-                 ) -> Dict[str, Any]:
+    def _execute(self, sql: str, segment_names: Optional[List[str]] = None,
+                 query_id: Optional[str] = None) -> Dict[str, Any]:
+        t0 = time.perf_counter()
         stmt = parse_sql(sql)
+        if query_id is not None:
+            # enforce the query's timeoutMs where the work actually runs
+            # (the broker-side deadline lives in a different process in
+            # cluster mode)
+            timeout_ms = int(stmt.options.get("timeoutMs", 10_000))
+            global_accountant.set_deadline(query_id, t0 + timeout_ms / 1e3)
         if stmt.joins:
             raise ValueError("leaf servers execute single-table stages")
         ctx = build_query_context(stmt)
@@ -148,6 +165,8 @@ class ServerNode:
     def stop(self) -> None:
         self._stop.set()
         self.scheduler.stop()
+        if self.heap_watcher is not None:
+            self.heap_watcher.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 

@@ -39,6 +39,17 @@ def process_rss_bytes() -> int:
         return 0
 
 
+def system_memory_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, IndexError, ValueError):
+        pass
+    return 0
+
+
 @dataclass
 class QueryUsage:
     query_id: str
@@ -111,15 +122,19 @@ class ResourceAccountant:
         into the owning query and raises if the query was killed or timed
         out (ThreadAccountantOps.sample + interrupt-check analog)."""
         tid = threading.get_ident()
+        t = time.thread_time()
         with self._lock:
             qid = self._by_thread.get(tid)
             u = self._by_query.get(qid) if qid else None
+            if u is not None:
+                # counters mutate under the lock: multiple worker threads
+                # can be attached to one query (attach_thread) and unlocked
+                # read-modify-write would lose updates
+                t0 = u._thread_cpu0.get(tid, t)
+                u.cpu_s += max(t - t0, 0.0)
+                u._thread_cpu0[tid] = t
         if u is None:
             return
-        t = time.thread_time()
-        t0 = u._thread_cpu0.get(tid, t)
-        u.cpu_s += max(t - t0, 0.0)
-        u._thread_cpu0[tid] = t
         if u.killed_reason is not None:
             raise QueryKilledError(
                 f"query {u.query_id} killed: {u.killed_reason}")
@@ -132,8 +147,14 @@ class ResourceAccountant:
         with self._lock:
             qid = self._by_thread.get(tid)
             u = self._by_query.get(qid) if qid else None
-        if u is not None:
-            u.mem_bytes += max(int(nbytes), 0)
+            if u is not None:
+                u.mem_bytes += max(int(nbytes), 0)
+
+    def set_deadline(self, query_id: str, deadline: Optional[float]) -> None:
+        with self._lock:
+            u = self._by_query.get(query_id)
+            if u is not None:
+                u.deadline = deadline
 
     # -- killing -----------------------------------------------------------
     def kill(self, query_id: str, reason: str) -> bool:

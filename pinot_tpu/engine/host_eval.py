@@ -166,18 +166,12 @@ def eval_filter(e: Any, seg: ImmutableSegment) -> np.ndarray:
                 v = e.rhs.value
                 lo, hi = {"<": (None, v), "<=": (None, v), ">": (v, None),
                           ">=": (v, None), "==": (v, v)}[e.op]
-                cand = rd.candidate_chunks(lo, hi)
                 np_op = {"==": np.equal, "<": np.less, "<=": np.less_equal,
                          ">": np.greater, ">=": np.greater_equal}[e.op]
                 vals = np.asarray(seg.fwd(e.lhs.name))
+                cand = rd.candidate_mask(lo, hi, n)
                 mask = np.zeros(n, dtype=bool)
-                if cand.all():
-                    mask[:] = np_op(vals[:n], v)
-                else:
-                    chunk = rd.chunk
-                    for ci in np.nonzero(cand)[0]:
-                        s = slice(ci * chunk, min((ci + 1) * chunk, n))
-                        mask[s] = np_op(vals[s], v)
+                mask[cand] = np_op(vals[:n][cand], v)
                 return mask
         l = eval_value(e.lhs, seg)
         r = eval_value(e.rhs, seg)

@@ -1,0 +1,83 @@
+"""Input-format record readers (batch ingestion sources).
+
+Reference parity: pinot-plugins/pinot-input-format/ — RecordReader SPI
+implementations for csv, json, avro, parquet, orc, protobuf, thrift.
+Python-native: csv/json(l) read with the stdlib; avro and parquet load
+through fastavro/pyarrow when present and raise a clear gating error when
+not (the environment does not allow installing them).
+"""
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, List
+
+FORMATS = ("csv", "json", "avro", "parquet")
+
+
+def _infer(v: str) -> Any:
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v
+
+
+def read_csv(path: str) -> List[Dict[str, Any]]:
+    with open(path, newline="") as fh:
+        return [{k: _infer(v) if v != "" else None for k, v in row.items()}
+                for row in csv.DictReader(fh)]
+
+
+def read_json(path: str) -> List[Dict[str, Any]]:
+    """A JSON array file, or JSON-lines (one object per line)."""
+    with open(path) as fh:
+        text = fh.read().strip()
+    if text.startswith("["):
+        rows = json.loads(text)
+    else:
+        rows = [json.loads(line) for line in text.splitlines() if line]
+    if not isinstance(rows, list):
+        raise ValueError(f"{path}: expected a JSON array or JSON lines")
+    return rows
+
+
+def read_avro(path: str) -> List[Dict[str, Any]]:
+    try:
+        import fastavro  # type: ignore[import-not-found]
+    except ImportError:
+        raise RuntimeError(
+            "avro input needs the 'fastavro' package, which is not "
+            "installed in this environment") from None
+    with open(path, "rb") as fh:
+        return list(fastavro.reader(fh))
+
+
+def read_parquet(path: str) -> List[Dict[str, Any]]:
+    try:
+        import pyarrow.parquet as pq  # type: ignore[import-not-found]
+    except ImportError:
+        raise RuntimeError(
+            "parquet input needs the 'pyarrow' package, which is not "
+            "installed in this environment") from None
+    return pq.read_table(path).to_pylist()
+
+
+_READERS = {"csv": read_csv, "json": read_json, "avro": read_avro,
+            "parquet": read_parquet}
+
+
+def read_records(path: str, fmt: str = "") -> List[Dict[str, Any]]:
+    """Read a file into row dicts; format inferred from the extension when
+    not given."""
+    fmt = (fmt or os.path.splitext(path)[1].lstrip(".")).lower()
+    if fmt == "jsonl":
+        fmt = "json"
+    reader = _READERS.get(fmt)
+    if reader is None:
+        raise ValueError(f"unknown input format {fmt!r}; have {FORMATS}")
+    return reader(path)

@@ -1,0 +1,21 @@
+"""Minion: background task execution framework + built-in tasks.
+
+Reference parity: pinot-minion/ (TaskExecutorFactoryRegistry, task
+executors, event observers), controller-side PinotTaskManager + generators
+(pinot-controller/.../helix/core/minion/), and the built-in tasks in
+pinot-plugins/pinot-minion-tasks/pinot-minion-builtin-tasks: MergeRollup,
+Purge, RealtimeToOfflineSegments, SegmentGenerationAndPush,
+UpsertCompaction. The segment processing framework
+(pinot-core/.../segment/processing/framework/) is minion's map/partition/
+reduce engine over segments.
+"""
+from .framework import (MinionContext, MinionWorker, TaskManager, TaskSpec,
+                        TaskState, register_task_executor, task_executor_types)
+from .processing import ProcessorConfig, RollupConfig, process_segments
+from . import tasks as _builtin_tasks  # noqa: F401 — registers executors
+
+__all__ = [
+    "MinionContext", "MinionWorker", "TaskManager", "TaskSpec", "TaskState",
+    "register_task_executor", "task_executor_types",
+    "ProcessorConfig", "RollupConfig", "process_segments",
+]

@@ -1,0 +1,211 @@
+"""Minion task framework + built-in task suite.
+
+Reference analog: pinot-minion task executor tests and
+pinot-core segment/processing/framework tests.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.minion import (MinionContext, MinionWorker, TaskManager,
+                              TaskSpec, TaskState)
+from pinot_tpu.minion.framework import (merge_rollup_generator,
+                                        upsert_compaction_generator)
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+SCHEMA = Schema("m", [
+    FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+    FieldSpec("day", DataType.LONG, FieldType.DIMENSION),
+    FieldSpec("clicks", DataType.INT, FieldType.METRIC),
+])
+
+
+def build_dm(tmp_path, n_segments=4, rows=500, seed=5):
+    rng = np.random.default_rng(seed)
+    builder = SegmentBuilder(SCHEMA, TableConfig("m"))
+    dm = TableDataManager("m")
+    data = {"city": [], "day": [], "clicks": []}
+    for i in range(n_segments):
+        cols = {
+            "city": rng.choice(["nyc", "sf"], rows),
+            "day": rng.integers(0, 3, rows).astype(np.int64) * 86_400_000,
+            "clicks": rng.integers(0, 50, rows).astype(np.int32),
+        }
+        dm.add_segment_dir(builder.build(cols, str(tmp_path / "segs"),
+                                         f"seg_{i}"))
+        for k in data:
+            data[k].append(cols[k])
+    return dm, {k: np.concatenate(v) for k, v in data.items()}
+
+
+def make_worker(tmp_path, dm):
+    return MinionWorker(MinionContext({"m": dm}, str(tmp_path / "out")))
+
+
+def total(dm, broker=None):
+    b = Broker()
+    b.register_table(dm)
+    return b
+
+
+def test_merge_rollup_merges_segments(tmp_path):
+    dm, data = build_dm(tmp_path)
+    w = make_worker(tmp_path, dm)
+    spec = w.submit(TaskSpec("MergeRollupTask", "m",
+                             {"targetRows": 10_000}))
+    w.run_once()
+    assert spec.state == TaskState.COMPLETED, spec.error
+    assert dm.num_segments == 1
+    assert dm.total_docs == len(data["city"])
+    b = total(dm)
+    res = b.query("SELECT SUM(clicks) FROM m")
+    assert res.rows[0][0] == int(data["clicks"].sum())
+
+
+def test_merge_rollup_with_rollup_collapses_dims(tmp_path):
+    dm, data = build_dm(tmp_path)
+    w = make_worker(tmp_path, dm)
+    spec = w.submit(TaskSpec("MergeRollupTask", "m",
+                             {"rollup": {"clicks": "sum"}}))
+    w.run_once()
+    assert spec.state == TaskState.COMPLETED, spec.error
+    # 2 cities x 3 days = at most 6 rows after rollup
+    assert dm.total_docs <= 6
+    b = total(dm)
+    res = b.query("SELECT city, SUM(clicks) FROM m GROUP BY city "
+                  "ORDER BY city")
+    exp = [(c, int(data["clicks"][data["city"] == c].sum()))
+           for c in ["nyc", "sf"]]
+    assert [tuple(r) for r in res.rows] == exp
+
+
+def test_purge_task_drops_matching_rows(tmp_path):
+    dm, data = build_dm(tmp_path)
+    w = make_worker(tmp_path, dm)
+    spec = w.submit(TaskSpec("PurgeTask", "m", {"where": "city = 'nyc'"}))
+    w.run_once()
+    assert spec.state == TaskState.COMPLETED, spec.error
+    assert spec.result["rowsPurged"] == int((data["city"] == "nyc").sum())
+    b = total(dm)
+    assert b.query("SELECT COUNT(*) FROM m").rows[0][0] == \
+        int((data["city"] == "sf").sum())
+    assert b.query("SELECT COUNT(*) FROM m WHERE city = 'nyc'") \
+        .rows[0][0] == 0
+
+
+def test_upsert_compaction_rewrites_invalid_docs(tmp_path):
+    dm, data = build_dm(tmp_path, n_segments=1, rows=400)
+    seg = dm.acquire_segments()[0]
+    valid = np.ones(seg.n_docs, dtype=bool)
+    valid[:150] = False
+    seg.set_valid_docs(valid)
+    w = make_worker(tmp_path, dm)
+    spec = w.submit(TaskSpec("UpsertCompactionTask", "m",
+                             {"segments": [seg.name]}))
+    w.run_once()
+    assert spec.state == TaskState.COMPLETED, spec.error
+    assert spec.result["invalidDocsRemoved"] == 150
+    new_seg = dm.acquire_segments()[0]
+    assert new_seg.n_docs == 250
+    assert new_seg.valid_docs is None
+    b = total(dm)
+    assert b.query("SELECT COUNT(*) FROM m").rows[0][0] == 250
+
+
+def test_realtime_to_offline_moves_and_buckets(tmp_path):
+    dm, data = build_dm(tmp_path)
+    off = TableDataManager("m")
+    ctx = MinionContext({"m": dm}, str(tmp_path / "out"),
+                        offline_tables={"m": off})
+    w = MinionWorker(ctx)
+    spec = w.submit(TaskSpec("RealtimeToOfflineSegmentsTask", "m",
+                             {"timeColumn": "day",
+                              "bucketMs": 86_400_000}))
+    w.run_once()
+    assert spec.state == TaskState.COMPLETED, spec.error
+    assert dm.num_segments == 0
+    assert off.num_segments == 3  # one per day bucket
+    assert off.total_docs == len(data["city"])
+    for s in off.acquire_segments():
+        days = np.unique(s.raw_values("day") // 86_400_000)
+        assert len(days) == 1
+
+
+def test_segment_generation_and_push_csv_json(tmp_path):
+    dm = TableDataManager("m")
+    dm.schema = SCHEMA
+    csv_path = tmp_path / "in.csv"
+    csv_path.write_text("city,day,clicks\nnyc,0,3\nsf,86400000,7\n")
+    jsonl_path = tmp_path / "in.json"
+    jsonl_path.write_text(json.dumps(
+        [{"city": "nyc", "day": 0, "clicks": 10}]))
+    w = make_worker(tmp_path, dm)
+    s1 = w.submit(TaskSpec("SegmentGenerationAndPushTask", "m",
+                           {"inputPath": str(csv_path), "format": "csv"}))
+    s2 = w.submit(TaskSpec("SegmentGenerationAndPushTask", "m",
+                           {"inputPath": str(jsonl_path), "format": "json"}))
+    w.drain()
+    assert s1.state == TaskState.COMPLETED, s1.error
+    assert s2.state == TaskState.COMPLETED, s2.error
+    b = total(dm)
+    assert b.query("SELECT SUM(clicks) FROM m").rows[0][0] == 20
+
+
+def test_failed_task_records_error(tmp_path):
+    dm, _ = build_dm(tmp_path, n_segments=1)
+    w = make_worker(tmp_path, dm)
+    spec = w.submit(TaskSpec("PurgeTask", "m", {}))  # missing 'where'
+    w.run_once()
+    assert spec.state == TaskState.FAILED
+    assert "where" in spec.error
+
+
+def test_generators_emit_tasks(tmp_path):
+    dm, data = build_dm(tmp_path)  # 4 small segments
+    w = make_worker(tmp_path, dm)
+    mgr = TaskManager(w)
+    mgr.register_generator(merge_rollup_generator(min_small_segments=3))
+    mgr.register_generator(upsert_compaction_generator(invalid_fraction=0.2))
+    # invalidate 40% of one segment so the compaction generator fires
+    seg = dm.acquire_segments()[0]
+    valid = np.ones(seg.n_docs, dtype=bool)
+    valid[: int(seg.n_docs * 0.4)] = False
+    seg.set_valid_docs(valid)
+    specs = mgr.generate_and_submit()
+    types = sorted(s.task_type for s in specs)
+    assert types == ["MergeRollupTask", "UpsertCompactionTask"]
+    done = w.drain()
+    assert all(s.state == TaskState.COMPLETED for s in done), \
+        [s.error for s in done]
+    b = total(dm)
+    # merged output must reflect only valid docs
+    expect = len(data["city"]) - int((~valid).sum())
+    assert b.query("SELECT COUNT(*) FROM m").rows[0][0] == expect
+
+
+def test_input_format_gating():
+    from pinot_tpu.inputformat import read_records
+    with pytest.raises(ValueError, match="unknown input format"):
+        read_records("x.foo")
+
+
+def test_worker_background_loop(tmp_path):
+    dm, _ = build_dm(tmp_path, n_segments=2)
+    w = make_worker(tmp_path, dm)
+    w.start(poll_interval=0.05)
+    try:
+        spec = w.submit(TaskSpec("MergeRollupTask", "m", {}))
+        import time
+        deadline = time.time() + 5
+        while spec.state in (TaskState.PENDING, TaskState.RUNNING) \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert spec.state == TaskState.COMPLETED, spec.error
+    finally:
+        w.stop()
