@@ -18,9 +18,12 @@ from typing import Any, Dict, List, Optional
 
 from ..engine.executor import execute_plan
 from ..engine.reduce import ResultTable, reduce_partials
+from ..engine.setops import combine_setop, order_limit_rows
 from ..query.context import build_query_context
 from ..query.planner import SegmentPlanner, _truthy
-from ..query.sql import SqlError, parse_sql
+from ..query.sql import (Comparison, InList, InSubquery, Literal,
+                         ScalarSubquery, SelectStmt, SetOpStmt, SqlError,
+                         map_expr, parse_sql)
 from ..server.data_manager import TableDataManager
 from ..utils.metrics import global_metrics
 from ..utils.trace import Tracing
@@ -63,11 +66,18 @@ class Broker:
     def _query(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
+        return self._execute_stmt(stmt, t0)
+
+    def _execute_stmt(self, stmt, t0: float) -> ResultTable:
+        if isinstance(stmt, SetOpStmt):
+            return self._execute_setop(stmt, t0)
+        stmt = self._resolve_subqueries(stmt)
         from ..engine.accounting import global_accountant
+        from ..multistage.window import has_window
         query_id = uuid.uuid4().hex[:12]
         timeout_ms = int(stmt.options.get("timeoutMs", DEFAULT_TIMEOUT_MS))
         deadline = t0 + timeout_ms / 1e3
-        if stmt.joins:
+        if stmt.joins or has_window(stmt):
             # v2 engine (BrokerRequestHandlerDelegate picks the multi-stage
             # handler when the query needs it); registered with the
             # accountant like any query so kills/deadlines reach its leaf
@@ -86,7 +96,8 @@ class Broker:
         scope = Tracing.register(query_id, trace_on)
         global_accountant.register(query_id, deadline=deadline)
         try:
-            result = self._execute_ctx(ctx, stmt, t0, deadline)
+            result = self._execute_ctx(ctx, stmt, t0, deadline,
+                                       query_id=query_id)
         finally:
             global_accountant.unregister(query_id)
             Tracing.unregister()
@@ -94,8 +105,100 @@ class Broker:
             result.trace = scope.to_dict()
         return result
 
-    def _execute_ctx(self, ctx, stmt, t0: float, deadline: float
-                     ) -> ResultTable:
+    # -- set operations (v2 set operators; combine at the broker) ----------
+    _BRANCH_LIMIT = 1 << 31  # branches run unlimited; compound LIMIT caps
+
+    def _execute_setop(self, stmt: SetOpStmt, t0: float) -> ResultTable:
+        if stmt.explain:
+            return self._explain_setop(stmt)
+        left = self._run_branch(stmt.left, stmt.options)
+        right = self._run_branch(stmt.right, stmt.options)
+        result = combine_setop(stmt.op, stmt.all, left, right)
+        from ..engine.reduce import DEFAULT_LIMIT
+        limit = stmt.limit if stmt.limit is not None else DEFAULT_LIMIT
+        result = order_limit_rows(result, stmt.order_by, limit, stmt.offset)
+        result.time_ms = (time.perf_counter() - t0) * 1e3
+        return result
+
+    def _run_branch(self, stmt, options: Optional[dict] = None
+                    ) -> ResultTable:
+        if isinstance(stmt, SetOpStmt):
+            left = self._run_branch(stmt.left, options)
+            right = self._run_branch(stmt.right, options)
+            return combine_setop(stmt.op, stmt.all, left, right)
+        if options:
+            # compound-level OPTION(...) applies to every branch
+            # (branch-specific keys win)
+            stmt.options = {**options, **stmt.options}
+        if stmt.limit is None:
+            stmt.limit = self._BRANCH_LIMIT
+        return self._execute_stmt(stmt, time.perf_counter())
+
+    def _explain_setop(self, stmt: SetOpStmt) -> ResultTable:
+        rows: List[tuple] = []
+
+        def emit(node, parent: int) -> None:
+            rid = len(rows)
+            if isinstance(node, SetOpStmt):
+                tag = node.op.upper() + ("_ALL" if node.all else "")
+                rows.append((f"SET_OP({tag})", rid, parent))
+                emit(node.left, rid)
+                emit(node.right, rid)
+            else:
+                rows.append((f"SELECT({node.table})", rid, parent))
+
+        rows.append(("BROKER_REDUCE", 0, -1))
+        emit(stmt, 0)
+        return ResultTable(["Operator", "Operator_Id", "Parent_Id"], rows)
+
+    # -- subqueries (IN_SUBQUERY / scalar rewrite at the broker) -----------
+    def _resolve_subqueries(self, stmt: SelectStmt) -> SelectStmt:
+        if stmt.explain:
+            # EXPLAIN must not execute the subquery scan; substitute
+            # placeholder shapes so the plan still builds
+            def placeholder(e):
+                if isinstance(e, InSubquery):
+                    return InList(e.expr, (Literal(0),), e.negated)
+                if isinstance(e, ScalarSubquery):
+                    return Literal(0)
+                return e
+            if stmt.where is not None:
+                stmt.where = map_expr(stmt.where, placeholder)
+            if stmt.having is not None:
+                stmt.having = map_expr(stmt.having, placeholder)
+            return stmt
+
+        def rw(e):
+            if isinstance(e, InSubquery):
+                sub = e.stmt
+                if sub.limit is None:
+                    sub.limit = self._BRANCH_LIMIT
+                res = self._execute_stmt(sub, time.perf_counter())
+                if len(res.columns) != 1:
+                    raise SqlError(
+                        f"IN subquery must select exactly 1 column, "
+                        f"got {len(res.columns)}")
+                vals = tuple(Literal(r[0].item() if hasattr(r[0], "item")
+                                     else r[0]) for r in res.rows)
+                return InList(e.expr, vals, e.negated)
+            if isinstance(e, ScalarSubquery):
+                res = self._execute_stmt(e.stmt, time.perf_counter())
+                if len(res.rows) != 1 or len(res.rows[0]) != 1:
+                    raise SqlError(
+                        f"scalar subquery must return 1 row x 1 column, "
+                        f"got {len(res.rows)} rows")
+                v = res.rows[0][0]
+                return Literal(v.item() if hasattr(v, "item") else v)
+            return e
+
+        if stmt.where is not None:
+            stmt.where = map_expr(stmt.where, rw)
+        if stmt.having is not None:
+            stmt.having = map_expr(stmt.having, rw)
+        return stmt
+
+    def _execute_ctx(self, ctx, stmt, t0: float, deadline: float,
+                     query_id: str = "") -> ResultTable:
         dm = self.table(ctx.table)
         segments = dm.acquire_segments()
 
@@ -122,17 +225,28 @@ class Broker:
             cols, rows = explain_rows(ctx, ex.real_plans, ex.rollup_segments)
             return ResultTable(cols, rows, num_segments=len(segments))
 
-        if time.perf_counter() > deadline:
-            global_metrics.count("broker_query_timeouts")
-            raise QueryTimeoutError(
-                f"query timed out during planning "
-                f"(>{int((deadline - t0) * 1e3)}ms)")
+        # Planning includes XLA compilation on a cold chip (20-40s once,
+        # cached thereafter) — exclude it from the query budget, which
+        # covers execution + reduce, or every cold-start query would blow
+        # the default 10s timeout (ServerQueryExecutorV1Impl's timeout
+        # covers execution; Java has no compile phase to exclude).
+        plan_elapsed = time.perf_counter() - t0
+        deadline += plan_elapsed
+        from ..engine.accounting import global_accountant
+        global_accountant.set_deadline(query_id, deadline)
 
         Tracing.count("numSegmentsQueried", len(segments))
         Tracing.count("numSegmentsPruned", ex.pruned)
         Tracing.count("numDocsScanned", ex.docs_scanned)
 
-        partials = execute_planned(ex)
+        from ..engine.accounting import QueryKilledError
+        try:
+            partials = execute_planned(ex)
+        except QueryKilledError as e:
+            if "deadline" in str(e):
+                global_metrics.count("broker_query_timeouts")
+                raise QueryTimeoutError(str(e)) from None
+            raise
 
         if time.perf_counter() > deadline:
             global_metrics.count("broker_query_timeouts")

@@ -126,7 +126,9 @@ class ServerNode:
             # enforce the query's timeoutMs where the work actually runs
             # (the broker-side deadline lives in a different process in
             # cluster mode)
-            timeout_ms = int(stmt.options.get("timeoutMs", 10_000))
+            from ..broker.broker import DEFAULT_TIMEOUT_MS
+            timeout_ms = int(stmt.options.get("timeoutMs",
+                                              DEFAULT_TIMEOUT_MS))
             global_accountant.set_deadline(query_id, t0 + timeout_ms / 1e3)
         if stmt.joins:
             raise ValueError("leaf servers execute single-table stages")

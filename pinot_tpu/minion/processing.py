@@ -53,8 +53,7 @@ def _segment_columns(seg: ImmutableSegment,
         keep &= seg.valid_docs[: seg.n_docs]
     if drop_mask is not None:
         keep &= ~drop_mask
-    return {name: seg.raw_values(name)[keep] for name in seg.columns
-            if seg.columns[name].encoding != "VECTOR"}
+    return {name: seg.raw_values(name)[keep] for name in seg.columns}
 
 
 def _concat(chunks: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
@@ -99,15 +98,16 @@ def _rollup(cols: Dict[str, np.ndarray], schema: Schema,
     out: Dict[str, np.ndarray] = {}
     for d in dim_cols:
         out[d] = np.asarray(cols[d])[firsts]
+    starts = np.nonzero(new_group)[0]
     for m in metric_cols:
         v = np.asarray(cols[m])[order]
         agg = cfg.aggregations.get(m, "sum")
         if agg == "sum":
-            out[m] = np.add.reduceat(v, np.nonzero(new_group)[0])
+            out[m] = np.add.reduceat(v, starts)
         elif agg == "min":
-            out[m] = np.minimum.reduceat(v, np.nonzero(new_group)[0])
+            out[m] = np.minimum.reduceat(v, starts)
         elif agg == "max":
-            out[m] = np.maximum.reduceat(v, np.nonzero(new_group)[0])
+            out[m] = np.maximum.reduceat(v, starts)
         else:
             raise ValueError(f"unknown rollup aggregation {agg!r} "
                              f"for metric {m!r}")

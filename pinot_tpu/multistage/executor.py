@@ -38,7 +38,8 @@ SHUFFLE_PARTITIONS = 4         # hash-exchange fan-out for large joins
 
 
 def is_multistage(stmt: SelectStmt) -> bool:
-    return bool(stmt.joins)
+    from .window import has_window
+    return bool(stmt.joins) or has_window(stmt)
 
 
 # ---------------------------------------------------------------------------
@@ -46,34 +47,8 @@ def is_multistage(stmt: SelectStmt) -> bool:
 # ---------------------------------------------------------------------------
 
 def _map_identifiers(e: Any, fn) -> Any:
-    if isinstance(e, Identifier):
-        return fn(e)
-    if isinstance(e, BoolAnd):
-        return BoolAnd(tuple(_map_identifiers(c, fn) for c in e.children))
-    if isinstance(e, BoolOr):
-        return BoolOr(tuple(_map_identifiers(c, fn) for c in e.children))
-    if isinstance(e, BoolNot):
-        return BoolNot(_map_identifiers(e.child, fn))
-    if isinstance(e, Comparison):
-        return Comparison(e.op, _map_identifiers(e.lhs, fn),
-                          _map_identifiers(e.rhs, fn))
-    if isinstance(e, Between):
-        return Between(_map_identifiers(e.expr, fn),
-                       _map_identifiers(e.lo, fn),
-                       _map_identifiers(e.hi, fn), e.negated)
-    if isinstance(e, InList):
-        return InList(_map_identifiers(e.expr, fn), e.values, e.negated)
-    if isinstance(e, Like):
-        return Like(_map_identifiers(e.expr, fn), e.pattern, e.negated)
-    if isinstance(e, IsNull):
-        return IsNull(_map_identifiers(e.expr, fn), e.negated)
-    if isinstance(e, BinaryOp):
-        return BinaryOp(e.op, _map_identifiers(e.lhs, fn),
-                        _map_identifiers(e.rhs, fn))
-    if isinstance(e, FuncCall):
-        return FuncCall(e.name, tuple(_map_identifiers(a, fn)
-                                      for a in e.args), e.distinct)
-    return e
+    from ..query.sql import map_expr
+    return map_expr(e, lambda n: fn(n) if isinstance(n, Identifier) else n)
 
 
 def _refs(e: Any) -> Set[str]:
@@ -161,9 +136,15 @@ class MultiStageExecutor:
         if star:
             for t in self.tables:
                 needed[t.label].update(self.schemas[t.label].column_names)
+        aliases = {i.alias for i in self.stmt.select if i.alias}
         for e in exprs:
             for r in _refs(e):
-                label, col = self.owner_of(r)
+                try:
+                    label, col = self.owner_of(r)
+                except SqlError:
+                    if r in aliases:  # ORDER BY / HAVING select-alias ref
+                        continue
+                    raise
                 needed[label].add(col)
         return needed
 
@@ -317,6 +298,19 @@ class MultiStageExecutor:
 
         self.mailboxes.release(query_id)
 
+        # window stage (WindowAggregateOperator analog): compute each
+        # window call as a column, then the final stage sees plain refs
+        from .window import compute_window, find_windows, rewrite_windows
+        wfs = find_windows(stmt)
+        if wfs:
+            if stmt.group_by:
+                raise SqlError("window functions cannot be combined with "
+                               "GROUP BY in one stage yet")
+            names = {wf: f"__w{i}" for i, wf in enumerate(wfs)}
+            current = current.with_columns(
+                {names[wf]: compute_window(current, wf) for wf in wfs})
+            stmt = rewrite_windows(stmt, names)
+
         # final stage: aggregation / selection over the joined relation
         ctx = build_query_context(stmt)
         mask = np.ones(current.n_rows, dtype=bool)
@@ -353,8 +347,15 @@ def explain_multistage(broker, stmt: SelectStmt) -> ResultTable:
         rid += 1
         return rid - 1
 
+    from .window import find_windows, rewrite_windows
+    wfs = find_windows(stmt)
+    if wfs:
+        stmt = rewrite_windows(stmt, {w: f"__w{i}"
+                                      for i, w in enumerate(wfs)})
     ctx = build_query_context(stmt)
     root = emit("BROKER_REDUCE", -1)
+    if wfs:
+        root = emit(f"WINDOW(funcs:{len(wfs)})", root)
     if ctx.is_group_by:
         final = emit(f"AGGREGATE_GROUP_BY(keys:{len(ctx.group_by)},"
                      f"aggs:{len(ctx.aggregations)})", root)

@@ -184,6 +184,15 @@ def build_query_context(stmt: SelectStmt) -> QueryContext:
     if limit is None and not (aggregations and not group_by):
         limit = 10
 
+    # ORDER BY may reference a select alias (Calcite scope resolution);
+    # substitute the aliased expression so evaluators see real columns
+    alias_exprs = {item.alias: item.expr for item in stmt.select
+                   if item.alias and not isinstance(item.expr, Star)}
+    order_by = [
+        OrderItem(alias_exprs[o.expr.name], o.ascending)
+        if isinstance(o.expr, Identifier) and o.expr.name in alias_exprs
+        else o for o in stmt.order_by]
+
     return QueryContext(
         table=stmt.table,
         select_items=select_items,
@@ -192,7 +201,7 @@ def build_query_context(stmt: SelectStmt) -> QueryContext:
         group_by=group_by,
         filter=stmt.where,
         having=stmt.having,
-        order_by=stmt.order_by,
+        order_by=order_by,
         limit=limit,
         offset=stmt.offset,
         options=stmt.options,

@@ -411,6 +411,8 @@ class SegmentPlanner:
         if m is None:
             raise PlanError(f"unknown column {name!r}")
         vals = [v.value for v in e.values]
+        if not vals:  # empty IN list (e.g. an empty IN-subquery result)
+            return TrueP() if e.negated else FalseP()
         if m.has_dict:
             d = self.seg.dictionary(name)
             ids = [d.index_of(self._cast_for(m, v)) for v in vals]

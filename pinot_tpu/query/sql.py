@@ -6,7 +6,10 @@ compiles SQL to the PinotQuery thrift IR. We hand-roll a tokenizer +
 recursive-descent parser for the OLAP subset (no Calcite in a TPU-native
 stack): SELECT projections/aggregations, WHERE with AND/OR/NOT,
 comparisons, BETWEEN, IN, LIKE, IS [NOT] NULL, GROUP BY, HAVING,
-ORDER BY ... ASC|DESC, LIMIT/OFFSET, arithmetic expressions, aliases.
+ORDER BY ... ASC|DESC, LIMIT/OFFSET, arithmetic expressions, aliases,
+window functions (fn(...) OVER (PARTITION BY ... ORDER BY ... [frame])),
+set operations (UNION/INTERSECT/EXCEPT [ALL], INTERSECT binds tighter),
+and subqueries (expr [NOT] IN (SELECT ...), scalar (SELECT ...)).
 
 Grammar (precedence climbing for booleans and arithmetic):
     query      := SELECT selectList FROM ident [WHERE orExpr]
@@ -133,6 +136,41 @@ class BoolNot:
 
 
 @dataclass(frozen=True)
+class WindowSpec:
+    """OVER (...) clause: partitioning, intra-partition order, frame.
+
+    frame is None (default: whole partition without ORDER BY, RANGE
+    UNBOUNDED PRECEDING..CURRENT ROW with) or ("rows", lo, hi) where
+    lo/hi are int offsets relative to the current row and None means
+    unbounded on that side — the subset Pinot's WindowNode supports
+    (pinot-query-planner WindowNode / runtime/operator/window/)."""
+    partition_by: Tuple[Any, ...] = ()
+    order_by: Tuple["OrderItem", ...] = ()
+    frame: Optional[Tuple[str, Optional[int], Optional[int]]] = None
+
+
+@dataclass(frozen=True)
+class WindowFunc:
+    func: "FuncCall"
+    spec: WindowSpec
+
+
+@dataclass(frozen=True)
+class InSubquery:
+    """expr [NOT] IN (SELECT ...) — broker evaluates the subquery first and
+    rewrites to InList (IN_SUBQUERY / IdSet rewrite analog)."""
+    expr: Any
+    stmt: Any  # SelectStmt
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery:
+    """(SELECT ...) used as a value; must reduce to one row, one column."""
+    stmt: Any
+
+
+@dataclass(frozen=True)
 class SelectItem:
     expr: Any
     alias: Optional[str] = None
@@ -178,6 +216,22 @@ class SelectStmt:
     explain: bool = False
 
 
+@dataclass
+class SetOpStmt:
+    """Compound query: left (UNION|INTERSECT|EXCEPT) [ALL] right, with
+    compound-level ORDER BY / LIMIT. Mirrors the v2 engine's set
+    operators (pinot-query-runtime/.../runtime/operator/set/)."""
+    op: str               # union | intersect | except
+    all: bool
+    left: Any             # SelectStmt | SetOpStmt
+    right: Any
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    options: dict = field(default_factory=dict)
+    explain: bool = False
+
+
 # ---------------------------------------------------------------------------
 # Tokenizer
 # ---------------------------------------------------------------------------
@@ -198,6 +252,9 @@ KEYWORDS = {
     "join", "on", "left", "right", "inner", "outer", "cross", "full",
     "explain",  # 'plan'/'for' stay contextual: valid column names elsewhere
     "case", "when", "then", "else", "end", "cast",
+    "over", "partition", "union", "intersect", "except", "all",
+    # frame keywords (rows/range/unbounded/preceding/following/current)
+    # stay contextual: they are common column names
 }
 
 
@@ -286,7 +343,7 @@ class _Parser:
                            f"in {self.sql!r}")
 
     # -- grammar -----------------------------------------------------------
-    def parse(self) -> SelectStmt:
+    def parse(self) -> Union[SelectStmt, "SetOpStmt"]:
         explain = False
         if self.accept_kw("explain"):
             t = self.peek()  # contextual: EXPLAIN [PLAN FOR] SELECT ...
@@ -297,6 +354,66 @@ class _Parser:
                     raise SqlError(f"expected FOR after EXPLAIN PLAN "
                                    f"at {t2.pos}")
             explain = True
+        stmt = self.compound()
+        self.accept_op(";")
+        if self.peek().kind != "eof":
+            t = self.peek()
+            raise SqlError(f"unexpected trailing token {t.value!r} at {t.pos}")
+        stmt.explain = explain
+        return stmt
+
+    def compound(self) -> Union[SelectStmt, "SetOpStmt"]:
+        """select_core ((UNION|EXCEPT) [ALL] select_core)* with INTERSECT
+        binding tighter, then compound-level ORDER BY/LIMIT/OPTION; a lone
+        select keeps its trailing clauses on the SelectStmt itself."""
+        left = self.intersect_term()
+        while True:
+            op = self.accept_kw("union", "except")
+            if op is None:
+                break
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            left = SetOpStmt(op, all_, left, self.intersect_term())
+        self.trailing_clauses(left)
+        return left
+
+    def intersect_term(self) -> Union[SelectStmt, "SetOpStmt"]:
+        left = self.select_core()
+        while self.accept_kw("intersect"):
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            left = SetOpStmt("intersect", all_, left, self.select_core())
+        return left
+
+    def trailing_clauses(self, stmt) -> None:
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            stmt.order_by = self.order_list()
+        if self.accept_kw("limit"):
+            n = self.next()
+            if n.kind != "number":
+                raise SqlError(f"expected LIMIT count at {n.pos}")
+            if self.accept_op(","):
+                n2 = self.next()  # LIMIT offset, count (MySQL style)
+                stmt.offset, stmt.limit = int(n.value), int(n2.value)
+            else:
+                stmt.limit = int(n.value)
+                if self.accept_kw("offset"):
+                    n2 = self.next()
+                    stmt.offset = int(n2.value)
+        if self.accept_kw("option"):
+            # OPTION(k=v, ...) — query options (QueryOptionsUtils analog)
+            self.expect_op("(")
+            while True:
+                k = self.next()
+                self.expect_op("=")
+                v = self.next()
+                stmt.options[str(k.value)] = v.value
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+
+    def select_core(self) -> SelectStmt:
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
         select = self.select_list()
@@ -332,37 +449,6 @@ class _Parser:
             stmt.group_by = self.expr_list()
         if self.accept_kw("having"):
             stmt.having = self.or_expr()
-        if self.accept_kw("order"):
-            self.expect_kw("by")
-            stmt.order_by = self.order_list()
-        if self.accept_kw("limit"):
-            n = self.next()
-            if n.kind != "number":
-                raise SqlError(f"expected LIMIT count at {n.pos}")
-            if self.accept_op(","):
-                n2 = self.next()  # LIMIT offset, count (MySQL style)
-                stmt.offset, stmt.limit = int(n.value), int(n2.value)
-            else:
-                stmt.limit = int(n.value)
-                if self.accept_kw("offset"):
-                    n2 = self.next()
-                    stmt.offset = int(n2.value)
-        if self.accept_kw("option"):
-            # OPTION(k=v, ...) — query options (QueryOptionsUtils analog)
-            self.expect_op("(")
-            while True:
-                k = self.next()
-                self.expect_op("=")
-                v = self.next()
-                stmt.options[str(k.value)] = v.value
-                if not self.accept_op(","):
-                    break
-            self.expect_op(")")
-        self.accept_op(";")
-        if self.peek().kind != "eof":
-            t = self.peek()
-            raise SqlError(f"unexpected trailing token {t.value!r} at {t.pos}")
-        stmt.explain = explain
         return stmt
 
     def table_ref(self) -> TableRef:
@@ -464,6 +550,11 @@ class _Parser:
             return Between(lhs, lo, hi, negated)
         if self.accept_kw("in"):
             self.expect_op("(")
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                sub = self.select_core()
+                self.trailing_clauses(sub)
+                self.expect_op(")")
+                return InSubquery(lhs, sub, negated)
             vals = [self.literal()]
             while self.accept_op(","):
                 vals.append(self.literal())
@@ -545,6 +636,11 @@ class _Parser:
             self.expect_op(")")
             return Cast(inner, str(tt.value).lower())
         if t.kind == "op" and t.value == "(":
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                sub = self.select_core()
+                self.trailing_clauses(sub)
+                self.expect_op(")")
+                return ScalarSubquery(sub)
             e = self.add_expr()
             self.expect_op(")")
             return e
@@ -576,9 +672,72 @@ class _Parser:
                     while self.accept_op(","):
                         args.append(self.add_expr())
                 self.expect_op(")")
-                return FuncCall(t.value.lower(), tuple(args), distinct)
+                fc = FuncCall(t.value.lower(), tuple(args), distinct)
+                if self.accept_kw("over"):
+                    return WindowFunc(fc, self.window_spec())
+                return fc
             return Identifier(t.value)
         raise SqlError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def window_spec(self) -> WindowSpec:
+        """OVER ( [PARTITION BY exprs] [ORDER BY order] [frame] ). Frame
+        keywords (ROWS/RANGE/UNBOUNDED/PRECEDING/FOLLOWING/CURRENT/ROW)
+        are contextual identifiers — they stay valid column names."""
+        self.expect_op("(")
+        partition: List[Any] = []
+        order: List[OrderItem] = []
+        frame = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition = self.expr_list()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order = self.order_list()
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() in ("rows", "range"):
+            mode = self.next().value.lower()
+            frame = self._frame(mode)
+        self.expect_op(")")
+        return WindowSpec(tuple(partition), tuple(order), frame)
+
+    def _frame(self, mode: str) -> Tuple[str, Optional[int], Optional[int]]:
+        def ctx_ident(*words: str) -> str:
+            t = self.next()
+            w = str(t.value).lower() if t.kind in ("ident", "kw") else ""
+            if w not in words:
+                raise SqlError(f"expected {'|'.join(words).upper()} "
+                               f"at {t.pos}")
+            return w
+
+        def bound(is_lo: bool) -> Optional[int]:
+            t = self.peek()
+            if t.kind == "ident" and t.value.lower() == "unbounded":
+                self.next()
+                side = ctx_ident("preceding", "following")
+                if side == ("preceding" if is_lo else "following"):
+                    return None
+                raise SqlError(f"UNBOUNDED {side.upper()} on the "
+                               f"{'lower' if is_lo else 'upper'} bound")
+            if t.kind == "ident" and t.value.lower() == "current":
+                self.next()
+                ctx_ident("row")
+                return 0
+            if t.kind == "number":
+                n = int(self.next().value)
+                side = ctx_ident("preceding", "following")
+                return -n if side == "preceding" else n
+            raise SqlError(f"expected frame bound at {t.pos}")
+
+        if self.accept_kw("between"):
+            lo = bound(True)
+            self.expect_kw("and")
+            hi = bound(False)
+        else:
+            lo, hi = bound(True), 0
+        if mode == "range" and not (lo is None and hi == 0):
+            raise SqlError("RANGE frames support only "
+                           "UNBOUNDED PRECEDING AND CURRENT ROW")
+        return (mode, lo, hi)
 
     def case_expr(self) -> CaseWhen:
         """CASE [operand] WHEN cond THEN val ... [ELSE val] END.
@@ -625,7 +784,55 @@ def ast_children(e: Any) -> Tuple[Any, ...]:
         return tuple(out)
     if isinstance(e, Cast):
         return (e.expr,)
+    if isinstance(e, WindowFunc):
+        return (e.func.args + e.spec.partition_by
+                + tuple(o.expr for o in e.spec.order_by))
+    if isinstance(e, InSubquery):
+        return (e.expr,)
     return ()
+
+
+def map_expr(e: Any, fn) -> Any:
+    """Bottom-up AST rewrite: rebuild each node from transformed children,
+    then apply fn to the rebuilt node. fn returns the (possibly replaced)
+    node."""
+    if isinstance(e, FuncCall):
+        e = FuncCall(e.name, tuple(map_expr(a, fn) for a in e.args),
+                     e.distinct)
+    elif isinstance(e, BinaryOp):
+        e = BinaryOp(e.op, map_expr(e.lhs, fn), map_expr(e.rhs, fn))
+    elif isinstance(e, Comparison):
+        e = Comparison(e.op, map_expr(e.lhs, fn), map_expr(e.rhs, fn))
+    elif isinstance(e, BoolAnd):
+        e = BoolAnd(tuple(map_expr(c, fn) for c in e.children))
+    elif isinstance(e, BoolOr):
+        e = BoolOr(tuple(map_expr(c, fn) for c in e.children))
+    elif isinstance(e, BoolNot):
+        e = BoolNot(map_expr(e.child, fn))
+    elif isinstance(e, Between):
+        e = Between(map_expr(e.expr, fn), map_expr(e.lo, fn),
+                    map_expr(e.hi, fn), e.negated)
+    elif isinstance(e, InList):
+        e = InList(map_expr(e.expr, fn), e.values, e.negated)
+    elif isinstance(e, Like):
+        e = Like(map_expr(e.expr, fn), e.pattern, e.negated)
+    elif isinstance(e, IsNull):
+        e = IsNull(map_expr(e.expr, fn), e.negated)
+    elif isinstance(e, CaseWhen):
+        e = CaseWhen(tuple((map_expr(c, fn), map_expr(v, fn))
+                           for c, v in e.whens),
+                     map_expr(e.else_, fn) if e.else_ is not None else None)
+    elif isinstance(e, Cast):
+        e = Cast(map_expr(e.expr, fn), e.type_name)
+    elif isinstance(e, WindowFunc):
+        e = WindowFunc(
+            map_expr(e.func, fn),
+            WindowSpec(tuple(map_expr(p, fn) for p in e.spec.partition_by),
+                       tuple(OrderItem(map_expr(o.expr, fn), o.ascending)
+                             for o in e.spec.order_by), e.spec.frame))
+    elif isinstance(e, InSubquery):
+        e = InSubquery(map_expr(e.expr, fn), e.stmt, e.negated)
+    return fn(e)
 
 
 def collect_identifiers(e: Any, out: Optional[set] = None) -> set:
@@ -638,5 +845,5 @@ def collect_identifiers(e: Any, out: Optional[set] = None) -> set:
     return out
 
 
-def parse_sql(sql: str) -> SelectStmt:
+def parse_sql(sql: str) -> Union[SelectStmt, SetOpStmt]:
     return _Parser(sql).parse()

@@ -1,0 +1,240 @@
+"""Window functions, set operations, and subqueries.
+
+Reference test model: pinot-query-runtime/src/test/resources/queries/
+WindowFunctions.json and SetOp suites (ResourceBasedQueriesTest) — SQL in,
+expected rows out, verified against a hand-computed/pandas-style oracle.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.query.sql import SqlError, parse_sql, SetOpStmt, WindowFunc
+from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+from pinot_tpu.server.data_manager import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+
+@pytest.fixture(scope="module")
+def broker(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("winseg"))
+    schema = Schema("emp", [
+        FieldSpec("dept", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("name", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("salary", DataType.INT, FieldType.METRIC),
+    ])
+    cfg = TableConfig("emp")
+    cols = {
+        "dept": np.array(["eng", "eng", "eng", "sales", "sales", "hr"]),
+        "name": np.array(["a", "b", "c", "d", "e", "f"]),
+        "salary": np.array([300, 100, 200, 50, 150, 75], dtype=np.int32),
+    }
+    d = SegmentBuilder(schema, cfg).build(cols, out, "s0")
+    dm = TableDataManager("emp")
+    dm.add_segment(ImmutableSegment.load(d))
+    b = Broker()
+    b.register_table(dm)
+    return b
+
+
+class TestParser:
+    def test_window_ast(self):
+        s = parse_sql("SELECT SUM(x) OVER (PARTITION BY g ORDER BY y DESC "
+                      "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) FROM t")
+        wf = s.select[0].expr
+        assert isinstance(wf, WindowFunc)
+        assert wf.spec.frame == ("rows", -2, 0)
+
+    def test_setop_precedence(self):
+        s = parse_sql("SELECT a FROM t UNION SELECT a FROM u "
+                      "INTERSECT SELECT a FROM v")
+        assert isinstance(s, SetOpStmt) and s.op == "union"
+        assert isinstance(s.right, SetOpStmt) and s.right.op == "intersect"
+
+    def test_range_frame_restricted(self):
+        with pytest.raises(SqlError):
+            parse_sql("SELECT SUM(x) OVER (ORDER BY y RANGE BETWEEN "
+                      "2 PRECEDING AND CURRENT ROW) FROM t")
+
+    def test_rank_requires_order(self, broker):
+        with pytest.raises(SqlError):
+            broker.query("SELECT RANK() OVER (PARTITION BY dept) FROM emp")
+
+
+class TestWindow:
+    def test_row_number_and_running_sum(self, broker):
+        r = broker.query(
+            "SELECT dept, salary, "
+            "ROW_NUMBER() OVER (PARTITION BY dept ORDER BY salary) AS rn, "
+            "SUM(salary) OVER (PARTITION BY dept ORDER BY salary) AS rs "
+            "FROM emp ORDER BY dept, salary")
+        assert r.rows == [
+            ("eng", 100, 1, 100), ("eng", 200, 2, 300),
+            ("eng", 300, 3, 600), ("hr", 75, 1, 75),
+            ("sales", 50, 1, 50), ("sales", 150, 2, 200)]
+
+    def test_rank_dense_rank_global(self, broker):
+        r = broker.query(
+            "SELECT name, RANK() OVER (ORDER BY salary DESC) AS rk "
+            "FROM emp ORDER BY rk LIMIT 3")
+        assert r.rows == [("a", 1), ("c", 2), ("e", 3)]
+
+    def test_rank_with_ties(self, broker):
+        r = broker.query(
+            "SELECT name, RANK() OVER (ORDER BY dept) AS rk, "
+            "DENSE_RANK() OVER (ORDER BY dept) AS dr "
+            "FROM emp ORDER BY dept, name")
+        # eng×3 (rank 1), hr (rank 4), sales×2 (rank 5)
+        assert [row[1] for row in r.rows] == [1, 1, 1, 4, 5, 5]
+        assert [row[2] for row in r.rows] == [1, 1, 1, 2, 3, 3]
+
+    def test_partition_agg_whole(self, broker):
+        r = broker.query(
+            "SELECT dept, salary, MAX(salary) OVER (PARTITION BY dept) AS m,"
+            " COUNT(*) OVER (PARTITION BY dept) AS c "
+            "FROM emp ORDER BY dept, salary")
+        assert r.rows == [
+            ("eng", 100, 300, 3), ("eng", 200, 300, 3), ("eng", 300, 300, 3),
+            ("hr", 75, 75, 1), ("sales", 50, 150, 2),
+            ("sales", 150, 150, 2)]
+
+    def test_lag_lead(self, broker):
+        r = broker.query(
+            "SELECT salary, LAG(salary) OVER (ORDER BY salary) AS p, "
+            "LEAD(salary, 1, -1) OVER (ORDER BY salary) AS nx "
+            "FROM emp ORDER BY salary")
+        sal = [50, 75, 100, 150, 200, 300]
+        for i, row in enumerate(r.rows):
+            assert row[0] == sal[i]
+            if i == 0:
+                assert np.isnan(row[1])
+            else:
+                assert row[1] == sal[i - 1]
+            assert row[2] == (sal[i + 1] if i + 1 < len(sal) else -1)
+
+    def test_first_last_value(self, broker):
+        r = broker.query(
+            "SELECT dept, salary, "
+            "FIRST_VALUE(salary) OVER (PARTITION BY dept ORDER BY salary) f,"
+            " LAST_VALUE(salary) OVER (PARTITION BY dept) l "
+            "FROM emp ORDER BY dept, salary")
+        # LAST_VALUE without ORDER BY: last row in stored order (eng stores
+        # a=300,b=100,c=200 -> 200), matching unordered-window semantics
+        assert [(row[2], row[3]) for row in r.rows] == [
+            (100, 200), (100, 200), (100, 200), (75, 75), (50, 150),
+            (50, 150)]
+
+    def test_rows_frame_sliding(self, broker):
+        r = broker.query(
+            "SELECT salary, SUM(salary) OVER (ORDER BY salary "
+            "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s "
+            "FROM emp ORDER BY salary")
+        assert [row[1] for row in r.rows] == [50, 125, 175, 250, 350, 500]
+
+    def test_rows_frame_min_both_bounds(self, broker):
+        r = broker.query(
+            "SELECT salary, MIN(salary) OVER (ORDER BY salary "
+            "ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS m "
+            "FROM emp ORDER BY salary")
+        assert [row[1] for row in r.rows] == [50, 50, 75, 100, 150, 200]
+
+    def test_ntile(self, broker):
+        r = broker.query(
+            "SELECT salary, NTILE(3) OVER (ORDER BY salary) AS t "
+            "FROM emp ORDER BY salary")
+        assert [row[1] for row in r.rows] == [1, 1, 2, 2, 3, 3]
+
+    def test_window_avg_cumulative(self, broker):
+        r = broker.query(
+            "SELECT salary, AVG(salary) OVER (ORDER BY salary) AS a "
+            "FROM emp ORDER BY salary")
+        sal = [50, 75, 100, 150, 200, 300]
+        for i, row in enumerate(r.rows):
+            assert row[1] == pytest.approx(sum(sal[:i + 1]) / (i + 1))
+
+
+class TestSetOps:
+    def test_union_dedupe(self, broker):
+        r = broker.query("SELECT dept FROM emp UNION SELECT dept FROM emp "
+                         "ORDER BY dept")
+        assert r.rows == [("eng",), ("hr",), ("sales",)]
+
+    def test_union_all(self, broker):
+        r = broker.query(
+            "SELECT dept FROM emp WHERE dept = 'hr' UNION ALL "
+            "SELECT dept FROM emp WHERE dept = 'hr'")
+        assert r.rows == [("hr",), ("hr",)]
+
+    def test_intersect(self, broker):
+        r = broker.query(
+            "SELECT dept FROM emp INTERSECT "
+            "SELECT dept FROM emp WHERE salary > 100 ORDER BY dept")
+        assert r.rows == [("eng",), ("sales",)]
+
+    def test_except(self, broker):
+        r = broker.query(
+            "SELECT dept FROM emp EXCEPT "
+            "SELECT dept FROM emp WHERE salary > 100 ORDER BY dept")
+        assert r.rows == [("hr",)]
+
+    def test_except_all_multiplicity(self, broker):
+        r = broker.query(
+            "SELECT dept FROM emp WHERE dept = 'eng' EXCEPT ALL "
+            "SELECT dept FROM emp WHERE dept = 'eng' AND salary = 300")
+        assert r.rows == [("eng",), ("eng",)]
+
+    def test_compound_order_by_position(self, broker):
+        r = broker.query(
+            "SELECT dept, salary FROM emp WHERE salary >= 150 UNION "
+            "SELECT dept, salary FROM emp WHERE salary <= 75 "
+            "ORDER BY 2 DESC LIMIT 2")
+        assert r.rows == [("eng", 300), ("eng", 200)]
+
+    def test_column_count_mismatch(self, broker):
+        with pytest.raises(SqlError):
+            broker.query("SELECT dept FROM emp UNION "
+                         "SELECT dept, salary FROM emp")
+
+    def test_aggregate_branches(self, broker):
+        r = broker.query(
+            "SELECT COUNT(*) FROM emp WHERE dept = 'eng' UNION ALL "
+            "SELECT COUNT(*) FROM emp WHERE dept = 'sales'")
+        assert sorted(r.rows) == [(2,), (3,)]
+
+
+class TestSubqueries:
+    def test_in_subquery(self, broker):
+        r = broker.query(
+            "SELECT name FROM emp WHERE salary IN "
+            "(SELECT MAX(salary) FROM emp)")
+        assert r.rows == [("a",)]
+
+    def test_not_in_subquery(self, broker):
+        r = broker.query(
+            "SELECT name FROM emp WHERE dept NOT IN "
+            "(SELECT dept FROM emp WHERE salary > 200) ORDER BY name")
+        assert r.rows == [("d",), ("e",), ("f",)]
+
+    def test_empty_in_subquery(self, broker):
+        r = broker.query(
+            "SELECT name FROM emp WHERE salary IN "
+            "(SELECT salary FROM emp WHERE salary > 10000)")
+        assert r.rows == []
+
+    def test_scalar_subquery_comparison(self, broker):
+        r = broker.query(
+            "SELECT name FROM emp WHERE salary > "
+            "(SELECT AVG(salary) FROM emp) ORDER BY name")
+        assert r.rows == [("a",), ("c",), ("e",)]  # avg = 145.83
+
+    def test_scalar_subquery_must_be_scalar(self, broker):
+        with pytest.raises(SqlError):
+            broker.query("SELECT name FROM emp WHERE salary > "
+                         "(SELECT salary FROM emp)")
+
+    def test_in_subquery_no_default_limit_truncation(self, broker):
+        # the inner select must not be truncated by the default LIMIT 10
+        r = broker.query(
+            "SELECT COUNT(*) FROM emp WHERE salary IN "
+            "(SELECT salary FROM emp)")
+        assert r.rows == [(6,)]
