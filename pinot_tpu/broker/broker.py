@@ -21,9 +21,9 @@ from ..engine.reduce import ResultTable, reduce_partials
 from ..engine.setops import combine_setop, order_limit_rows
 from ..query.context import build_query_context
 from ..query.planner import SegmentPlanner, _truthy
-from ..query.sql import (Comparison, InList, InSubquery, Literal,
-                         ScalarSubquery, SelectStmt, SetOpStmt, SqlError,
-                         map_expr, parse_sql)
+from ..query.sql import (InList, InSubquery, Literal, ScalarSubquery,
+                         SelectStmt, SetOpStmt, SqlError, map_expr,
+                         parse_sql)
 from ..server.data_manager import TableDataManager
 from ..utils.metrics import global_metrics
 from ..utils.trace import Tracing
@@ -243,7 +243,7 @@ class Broker:
         try:
             partials = execute_planned(ex)
         except QueryKilledError as e:
-            if "deadline" in str(e):
+            if e.is_deadline:
                 global_metrics.count("broker_query_timeouts")
                 raise QueryTimeoutError(str(e)) from None
             raise

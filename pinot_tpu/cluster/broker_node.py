@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..engine.reduce import ResultTable, reduce_partials
 from ..engine.serde import partial_from_wire
 from ..query.context import build_query_context
-from ..query.sql import SqlError, parse_sql
+from ..query.sql import SetOpStmt, SqlError, parse_sql, to_sql
 from .http_util import JsonHandler, http_json, start_http
 
 
@@ -124,10 +124,13 @@ class BrokerNode:
     def query(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
-        if stmt.joins:
-            raise SqlError("multi-stage joins over the remote data plane "
-                           "arrive with the dispatch stage; use the "
-                           "in-process broker for joins")
+        if isinstance(stmt, SetOpStmt):
+            return self._query_setop(stmt, t0)
+        from ..multistage.window import has_window
+        if stmt.joins or has_window(stmt):
+            raise SqlError("multi-stage joins/windows over the remote data "
+                           "plane arrive with the dispatch stage; use the "
+                           "in-process broker for them")
         ctx = build_query_context(stmt)
         assignment = self._route(ctx.table)
 
@@ -215,6 +218,30 @@ class BrokerNode:
 
         result = reduce_partials(ctx, partials)
         result.num_segments = queried
+        result.time_ms = (time.perf_counter() - t0) * 1e3
+        return result
+
+    def _query_setop(self, stmt: SetOpStmt, t0: float) -> ResultTable:
+        """Set operations over the remote data plane: run each branch as
+        its own scatter-gather (rendered back to SQL), combine at this
+        broker — the same multiset merge the in-process broker uses."""
+        from ..engine.reduce import DEFAULT_LIMIT
+        from ..engine.setops import combine_setop, order_limit_rows
+
+        def run(node) -> ResultTable:
+            if isinstance(node, SetOpStmt):
+                return combine_setop(node.op, node.all,
+                                     run(node.left), run(node.right))
+            if stmt.options:
+                node.options = {**stmt.options, **node.options}
+            if node.limit is None:
+                node.limit = 1 << 31
+            return self.query(to_sql(node))
+
+        result = combine_setop(stmt.op, stmt.all,
+                               run(stmt.left), run(stmt.right))
+        limit = stmt.limit if stmt.limit is not None else DEFAULT_LIMIT
+        result = order_limit_rows(result, stmt.order_by, limit, stmt.offset)
         result.time_ms = (time.perf_counter() - t0) * 1e3
         return result
 

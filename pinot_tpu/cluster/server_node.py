@@ -122,6 +122,14 @@ class ServerNode:
                  query_id: Optional[str] = None) -> Dict[str, Any]:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
+        from ..query.sql import SetOpStmt
+        if isinstance(stmt, SetOpStmt):
+            raise ValueError("leaf servers execute single-table stages; "
+                             "set operations combine at the broker")
+        from ..multistage.window import has_window
+        if has_window(stmt):
+            raise ValueError("leaf servers execute single-table stages; "
+                             "window functions run in the dispatch stage")
         if query_id is not None:
             # enforce the query's timeoutMs where the work actually runs
             # (the broker-side deadline lives in a different process in

@@ -25,6 +25,12 @@ from ..query.sql import SqlError
 
 
 class QueryKilledError(SqlError):
+    """A query terminated by the accountant. is_deadline distinguishes a
+    timeout (deadline exceeded) from an operator/watcher kill."""
+
+    def __init__(self, msg: str, is_deadline: bool = False):
+        super().__init__(msg)
+        self.is_deadline = is_deadline
     """Raised inside the query's own execution path after a kill flag."""
 
 
@@ -140,7 +146,8 @@ class ResourceAccountant:
                 f"query {u.query_id} killed: {u.killed_reason}")
         if u.deadline is not None and time.perf_counter() > u.deadline:
             raise QueryKilledError(
-                f"query {u.query_id} killed: deadline exceeded")
+                f"query {u.query_id} killed: deadline exceeded",
+                is_deadline=True)
 
     def track_memory(self, nbytes: int) -> None:
         tid = threading.get_ident()

@@ -45,7 +45,11 @@ def empty_partial(ctx: QueryContext):
     if ctx.is_group_by:
         return GroupByPartial({})
     if ctx.is_aggregation:
-        return AggPartial([aggregations.empty_state(a)
+        na = host_eval.null_aware(ctx)
+        # with null handling, SUM over zero rows is null, not 0 (the merge
+        # is null-absorbing, so any segment with rows still wins)
+        return AggPartial([None if na and a.kind == "sum"
+                           else aggregations.empty_state(a)
                            for a in ctx.aggregations])
     return SelectionPartial([], [])
 
@@ -72,7 +76,10 @@ def execute_plan(plan: CompiledPlan):
     if plan.kind == "fast":
         return AggPartial(list(plan.fast_states))
     if plan.kind == "host":
-        mask = host_eval.eval_filter(ctx.filter, seg)
+        if host_eval.null_aware(ctx):
+            mask, _ = host_eval.eval_filter_3vl(ctx.filter, seg)
+        else:
+            mask = host_eval.eval_filter(ctx.filter, seg)
         vd = getattr(seg, "valid_docs", None)
         if vd is not None:
             from ..query.planner import _truthy

@@ -103,6 +103,74 @@ def _eval_case(e: CaseWhen, seg: ImmutableSegment,
     return np.select(conds, vals, default=default)
 
 
+def null_aware(ctx) -> bool:
+    """The enableNullHandling query option (QueryOptionsUtils analog).
+    Accepts anything with .options (QueryContext or SelectStmt); shares
+    the option-truthiness parser with the planner."""
+    from ..query.planner import _truthy
+    return _truthy(ctx.options.get("enableNullHandling"))
+
+
+def expr_null_mask(e: Any, seg) -> Optional[np.ndarray]:
+    """Union of null masks of every column referenced by e (a row is null
+    for the expression if any input is null — SQL null propagation)."""
+    from ..query.sql import collect_identifiers
+    m: Optional[np.ndarray] = None
+    for name in collect_identifiers(e):
+        nm = seg.null_mask(name)
+        if nm is not None:
+            m = nm.copy() if m is None else (m | nm)
+    return m
+
+
+def eval_filter_3vl(e: Any, seg) -> Tuple[np.ndarray, np.ndarray]:
+    """Three-valued-logic filter evaluation for enableNullHandling.
+
+    Returns (T, F): rows where the predicate is definitely TRUE and
+    definitely FALSE; the rest are UNKNOWN (some input was null). Mirrors
+    Pinot's null-handling predicate semantics: a row passes the filter
+    only when the predicate is TRUE. NOT maps UNKNOWN to UNKNOWN
+    (T/F swap), AND/OR follow Kleene logic.
+    """
+    n = seg.n_docs
+    if e is None:
+        return np.ones(n, dtype=bool), np.zeros(n, dtype=bool)
+    if isinstance(e, BoolAnd):
+        T = np.ones(n, dtype=bool)
+        F = np.zeros(n, dtype=bool)
+        for c in e.children:
+            t, f = eval_filter_3vl(c, seg)
+            T &= t
+            F |= f
+        return T, F
+    if isinstance(e, BoolOr):
+        T = np.zeros(n, dtype=bool)
+        F = np.ones(n, dtype=bool)
+        for c in e.children:
+            t, f = eval_filter_3vl(c, seg)
+            T |= t
+            F &= f
+        return T, F
+    if isinstance(e, BoolNot):
+        t, f = eval_filter_3vl(e.child, seg)
+        return f, t
+    if isinstance(e, IsNull):
+        nm = expr_null_mask(e.expr, seg)
+        if nm is None:
+            nm = np.zeros(n, dtype=bool)
+        t = ~nm if e.negated else nm
+        return t, ~t  # IS [NOT] NULL never yields UNKNOWN
+    # leaf predicate: evaluate two-valued, then mark null inputs UNKNOWN.
+    # negated leaves (NOT BETWEEN / NOT IN / NOT LIKE) stay UNKNOWN on null
+    # inputs because both T and F are masked by `valid`.
+    m = eval_filter(e, seg)
+    nm = expr_null_mask(e, seg)
+    if nm is None:
+        return m, ~m
+    valid = ~nm
+    return m & valid, ~m & valid
+
+
 def _like_regex(pattern: str) -> "re.Pattern":
     out = []
     for ch in pattern:
@@ -261,10 +329,35 @@ def host_aggregate(ctx: QueryContext, seg: ImmutableSegment,
                    mask: np.ndarray) -> List[Any]:
     """Per-segment states for ctx.aggregations (mergeable, value-space)."""
     sel = np.nonzero(mask)[0]
+    na = null_aware(ctx)
     states: List[Any] = []
     for agg in ctx.aggregations:
-        states.append(_agg_state(agg, seg, sel))
+        sel2 = _agg_sel(agg, seg, sel, na)
+        s = _agg_state(agg, seg, sel2)
+        if na and agg.kind == "sum" and len(sel2) == 0:
+            s = None  # SUM over all-null input is null, not 0
+        states.append(s)
     return states
+
+
+def _agg_keep(agg: AggExpr, seg, sel: np.ndarray) -> Optional[np.ndarray]:
+    """Boolean keep-mask over sel dropping rows whose aggregation input is
+    null (NullableSingleInputAggregationFunction semantics); None when the
+    inputs have no nulls. COUNT(*) (arg None) keeps every filtered row."""
+    nm = None
+    for arg in (agg.arg, agg.arg2):
+        if arg is not None:
+            m = expr_null_mask(arg, seg)
+            if m is not None:
+                nm = m if nm is None else (nm | m)
+    return None if nm is None else ~nm[sel]
+
+
+def _agg_sel(agg: AggExpr, seg, sel: np.ndarray, na: bool) -> np.ndarray:
+    if not na:
+        return sel
+    keep = _agg_keep(agg, seg, sel)
+    return sel if keep is None else sel[keep]
 
 
 def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
@@ -331,8 +424,20 @@ def host_group_by(ctx: QueryContext, seg: ImmutableSegment,
     keys = list(zip(*[[_scalar(x) for x in kc] for kc in key_cols]))
 
     out: Dict[Tuple, List[Any]] = {tuple(k): [] for k in keys}
+    na = null_aware(ctx)
     for agg in ctx.aggregations:
-        per_group = _group_states(agg, seg, sel, inv, n_groups)
+        keep = _agg_keep(agg, seg, sel) if na else None
+        if keep is None or keep.all():
+            per_group = _group_states(agg, seg, sel, inv, n_groups)
+        else:
+            per_group = _group_states(agg, seg, sel[keep], inv[keep],
+                                      n_groups)
+            if agg.kind in ("sum", "min", "max", "avg"):
+                # groups whose inputs were all null -> null result, not a
+                # sentinel from the empty reduction
+                cnt = np.bincount(inv[keep], minlength=n_groups)
+                per_group = [None if cnt[gi] == 0 else per_group[gi]
+                             for gi in range(n_groups)]
         for gi, k in enumerate(keys):
             out[tuple(k)].append(per_group[gi])
     return out
@@ -439,6 +544,16 @@ def host_selection(ctx: QueryContext, seg: ImmutableSegment,
 
     cols = [np.broadcast_to(eval_value(e, seg, sel), (len(sel),))
             for e in exprs]
+    if null_aware(ctx):
+        # surface stored default values as real nulls in the result rows
+        out_cols: List[np.ndarray] = []
+        for e, c in zip(exprs, cols):
+            nm = expr_null_mask(e, seg)
+            if nm is not None and nm[sel].any():
+                c = c.astype(object)
+                c[nm[sel]] = None
+            out_cols.append(c)
+        cols = out_cols
     rows = [tuple(_scalar(c[i]) for c in cols) for i in range(len(sel))]
     okeys = [tuple(_scalar(ov[i]) for ov in order_vals)
              for i in range(len(sel))] if ctx.order_by else []

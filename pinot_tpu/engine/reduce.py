@@ -81,8 +81,13 @@ def reduce_partials(ctx: QueryContext, partials: List[Any]) -> ResultTable:
 def _reduce_aggregation(ctx: QueryContext, partials: List[AggPartial]
                         ) -> ResultTable:
     aggs = ctx.aggregations
-    merged = [aggregations.empty_state(a) for a in aggs]
-    for p in partials:
+    # seed from the first partial (not empty_state) so a null partial —
+    # SUM over all-null input under enableNullHandling — stays null
+    if partials:
+        merged = list(partials[0].states)
+    else:
+        merged = [aggregations.empty_state(a) for a in aggs]
+    for p in partials[1:]:
         for i, a in enumerate(aggs):
             merged[i] = merge_state(a, merged[i], p.states[i])
     env = {a.label: finalize_state(a, merged[i])

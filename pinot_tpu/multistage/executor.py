@@ -177,8 +177,12 @@ class MultiStageExecutor:
         dm = self.broker.table(tref.name)
         blocks: List[Relation] = []
         cols = sorted(cols)
+        na = host_eval.null_aware(self.stmt)
         for seg in dm.acquire_segments():
-            mask = host_eval.eval_filter(bare, seg)
+            if na:
+                mask, _ = host_eval.eval_filter_3vl(bare, seg)
+            else:
+                mask = host_eval.eval_filter(bare, seg)
             idx = np.nonzero(mask)[0]
             data: Dict[str, np.ndarray] = {}
             nulls: Dict[str, np.ndarray] = {}
@@ -293,7 +297,10 @@ class MultiStageExecutor:
             joined_labels.add(label)
 
         for conj in post_where:
-            m = host_eval.eval_filter(conj, current)
+            if host_eval.null_aware(stmt):
+                m, _ = host_eval.eval_filter_3vl(conj, current)
+            else:
+                m = host_eval.eval_filter(conj, current)
             current = current.take(np.nonzero(m)[0])
 
         self.mailboxes.release(query_id)

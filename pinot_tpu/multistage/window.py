@@ -55,13 +55,28 @@ def rewrite_windows(stmt: SelectStmt, names: Dict[WindowFunc, str]
                     ) -> SelectStmt:
     """Replace each WindowFunc with an Identifier over its computed
     column, leaving a plain selection statement."""
-    from ..query.sql import map_expr
+    from ..query.sql import expr_to_sql, map_expr
 
     def rw(e: Any) -> Any:
         return Identifier(names[e]) if isinstance(e, WindowFunc) else e
 
+    def item_alias(i: SelectItem) -> Any:
+        if i.alias is not None:
+            return i.alias
+        has_wf = False
+
+        def probe(e):
+            nonlocal has_wf
+            if isinstance(e, WindowFunc):
+                has_wf = True
+            return e
+        map_expr(i.expr, probe)
+        # label the output column with the original expression text, not
+        # the internal __wN rewrite name
+        return expr_to_sql(i.expr) if has_wf else None
+
     return SelectStmt(
-        select=[SelectItem(map_expr(i.expr, rw), i.alias)
+        select=[SelectItem(map_expr(i.expr, rw), item_alias(i))
                 for i in stmt.select],
         table=stmt.table, distinct=stmt.distinct,
         table_alias=stmt.table_alias, joins=stmt.joins,
@@ -183,9 +198,13 @@ def _arg_value(rel, wf: WindowFunc, sidx: np.ndarray, i: int = 0
 
 def _lit(wf: WindowFunc, i: int, default: Any) -> Any:
     from ..query.sql import Literal
-    if len(wf.func.args) > i and isinstance(wf.func.args[i], Literal):
-        return wf.func.args[i].value
-    return default
+    if len(wf.func.args) <= i:
+        return default
+    arg = wf.func.args[i]
+    if not isinstance(arg, Literal):
+        raise SqlError(f"{wf.func.name.upper()} argument {i + 1} must be "
+                       f"a literal, got {type(arg).__name__}")
+    return arg.value
 
 
 def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
@@ -231,6 +250,22 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
         return v[_ends_from_starts(new_part)]
 
     # ---- aggregate window functions -------------------------------------
+    if wf.func.distinct:
+        if name != "count" or wf.spec.order_by or wf.spec.frame is not None:
+            raise SqlError(
+                "DISTINCT in window aggregates is supported only for "
+                "COUNT(DISTINCT x) OVER (PARTITION BY ...) without "
+                "ORDER BY or frames")
+        # distinct count per partition, broadcast to every row
+        v = _arg_value(rel, wf, sidx)
+        _, vc_codes = np.unique(v, return_inverse=True)
+        pair = part * (int(vc_codes.max()) + 1) + vc_codes
+        order2 = np.argsort(pair, kind="stable")
+        sp = pair[order2]
+        first = np.r_[True, sp[1:] != sp[:-1]]  # one row per (part, value)
+        uniq_per_part = np.bincount(part[order2][first],
+                                    minlength=int(part.max()) + 1)
+        return uniq_per_part[part]
     v = _arg_value(rel, wf, sidx)
     if name == "count":
         v = np.ones(n, dtype=np.int64)

@@ -806,13 +806,20 @@ def empty_state(agg: Any) -> Any:
 
 def merge_states(agg: Any, a: Any, b: Any) -> Any:
     k = agg.kind
-    if k in ("count", "sum"):
+    if k == "count":
         return a + b
+    if k == "sum":
+        # None = all inputs null (enableNullHandling); null-absorbing merge
+        return b if a is None else a if b is None else a + b
     if k == "min":
         return b if a is None else a if b is None else min(a, b)
     if k == "max":
         return b if a is None else a if b is None else max(a, b)
     if k == "avg":
+        if a is None:
+            return b
+        if b is None:
+            return a
         return (a[0] + b[0], a[1] + b[1])
     if k == "distinct_count":
         return a | b
@@ -822,7 +829,7 @@ def merge_states(agg: Any, a: Any, b: Any) -> Any:
 def finalize_state(agg: Any, s: Any) -> Any:
     k = agg.kind
     if k == "avg":
-        return None if s[1] == 0 else s[0] / s[1]
+        return None if s is None or s[1] == 0 else s[0] / s[1]
     if k == "distinct_count":
         return len(s)
     if k in ("count", "sum", "min", "max"):

@@ -34,6 +34,7 @@ from ..segment.immutable import ImmutableSegment
 from ..spi.schema import DataType
 from .context import AggExpr, QueryContext
 from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, Comparison,
+                  collect_identifiers,
                   Identifier, InList, IsNull, Like, Literal, SqlError, Star)
 
 MAX_DENSE_GROUPS = 1 << 21          # beyond this, host hash group-by
@@ -554,6 +555,23 @@ class SegmentPlanner:
     def plan(self) -> CompiledPlan:
         ctx, seg = self.ctx, self.seg
         self._validate_columns()
+        if _truthy(ctx.options.get("enableNullHandling")):
+            # null-aware execution: segments whose referenced columns hold
+            # nulls run the host path (3VL predicates, per-agg null skip);
+            # null-free segments keep the device kernels — the common case
+            # since null bitmaps are per-segment-per-column
+            refs: set = set()
+            if ctx.filter is not None:
+                collect_identifiers(ctx.filter, refs)
+            for a in ctx.aggregations:
+                for arg in (a.arg, a.arg2):
+                    if arg is not None:
+                        collect_identifiers(arg, refs)
+            for g in ctx.group_by:
+                collect_identifiers(g, refs)
+            if any(getattr(seg.columns.get(r), "has_nulls", False)
+                   for r in refs):
+                return CompiledPlan("host", seg, ctx)
         if getattr(seg, "is_mutable", False):
             # consuming snapshot: vectorized host path (MutableSegmentImpl's
             # realtime read path analog; rows become device-resident on seal)

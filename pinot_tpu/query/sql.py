@@ -845,5 +845,137 @@ def collect_identifiers(e: Any, out: Optional[set] = None) -> set:
     return out
 
 
+def _sql_literal(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    if isinstance(v, tuple):
+        return "ARRAY[" + ", ".join(_sql_literal(x) for x in v) + "]"
+    return repr(v)
+
+
+def expr_to_sql(e: Any) -> str:
+    """Render an expression AST back to SQL text (round-trips through
+    parse_sql). Used by the cluster broker to dispatch sub-statements
+    (set-op branches, subqueries) over the wire as SQL."""
+    if isinstance(e, Identifier):
+        return e.name
+    if isinstance(e, Literal):
+        return _sql_literal(e.value)
+    if isinstance(e, Star):
+        return "*"
+    if isinstance(e, FuncCall):
+        d = "DISTINCT " if e.distinct else ""
+        return f"{e.name}({d}{', '.join(expr_to_sql(a) for a in e.args)})"
+    if isinstance(e, BinaryOp):
+        return f"({expr_to_sql(e.lhs)} {e.op} {expr_to_sql(e.rhs)})"
+    if isinstance(e, Comparison):
+        op = {"==": "="}.get(e.op, e.op)
+        return f"{expr_to_sql(e.lhs)} {op} {expr_to_sql(e.rhs)}"
+    if isinstance(e, Between):
+        n = "NOT " if e.negated else ""
+        return (f"{expr_to_sql(e.expr)} {n}BETWEEN {expr_to_sql(e.lo)} "
+                f"AND {expr_to_sql(e.hi)}")
+    if isinstance(e, InList):
+        n = "NOT " if e.negated else ""
+        vals = ", ".join(_sql_literal(v.value) for v in e.values)
+        return f"{expr_to_sql(e.expr)} {n}IN ({vals})"
+    if isinstance(e, Like):
+        n = "NOT " if e.negated else ""
+        return f"{expr_to_sql(e.expr)} {n}LIKE {_sql_literal(e.pattern)}"
+    if isinstance(e, IsNull):
+        n = "NOT " if e.negated else ""
+        return f"{expr_to_sql(e.expr)} IS {n}NULL"
+    if isinstance(e, BoolAnd):
+        return "(" + " AND ".join(expr_to_sql(c) for c in e.children) + ")"
+    if isinstance(e, BoolOr):
+        return "(" + " OR ".join(expr_to_sql(c) for c in e.children) + ")"
+    if isinstance(e, BoolNot):
+        return f"NOT ({expr_to_sql(e.child)})"
+    if isinstance(e, CaseWhen):
+        parts = ["CASE"]
+        for c, v in e.whens:
+            parts.append(f"WHEN {expr_to_sql(c)} THEN {expr_to_sql(v)}")
+        if e.else_ is not None:
+            parts.append(f"ELSE {expr_to_sql(e.else_)}")
+        parts.append("END")
+        return " ".join(parts)
+    if isinstance(e, Cast):
+        return f"CAST({expr_to_sql(e.expr)} AS {e.type_name})"
+    if isinstance(e, WindowFunc):
+        spec = []
+        if e.spec.partition_by:
+            spec.append("PARTITION BY " + ", ".join(
+                expr_to_sql(p) for p in e.spec.partition_by))
+        if e.spec.order_by:
+            spec.append("ORDER BY " + ", ".join(
+                expr_to_sql(o.expr) + ("" if o.ascending else " DESC")
+                for o in e.spec.order_by))
+        if e.spec.frame is not None:
+            mode, lo, hi = e.spec.frame
+
+            def bound(b, is_lo):
+                if b is None:
+                    return ("UNBOUNDED PRECEDING" if is_lo
+                            else "UNBOUNDED FOLLOWING")
+                if b == 0:
+                    return "CURRENT ROW"
+                return (f"{-b} PRECEDING" if b < 0 else f"{b} FOLLOWING")
+            spec.append(f"{mode.upper()} BETWEEN {bound(lo, True)} "
+                        f"AND {bound(hi, False)}")
+        return f"{expr_to_sql(e.func)} OVER ({' '.join(spec)})"
+    if isinstance(e, InSubquery):
+        n = "NOT " if e.negated else ""
+        return f"{expr_to_sql(e.expr)} {n}IN ({to_sql(e.stmt)})"
+    if isinstance(e, ScalarSubquery):
+        return f"({to_sql(e.stmt)})"
+    raise SqlError(f"cannot render {type(e).__name__} to SQL")
+
+
+def to_sql(stmt: Union[SelectStmt, SetOpStmt]) -> str:
+    """Render a statement AST back to SQL text."""
+    if isinstance(stmt, SetOpStmt):
+        op = stmt.op.upper() + (" ALL" if stmt.all else "")
+        parts = [f"{to_sql(stmt.left)} {op} {to_sql(stmt.right)}"]
+    else:
+        sel = []
+        for item in stmt.select:
+            s = expr_to_sql(item.expr)
+            if item.alias:
+                s += f' AS "{item.alias}"'
+            sel.append(s)
+        d = "DISTINCT " if stmt.distinct else ""
+        base = stmt.table + (f" AS {stmt.table_alias}"
+                             if stmt.table_alias else "")
+        parts = [f"SELECT {d}{', '.join(sel)} FROM {base}"]
+        for j in stmt.joins:
+            jt = "LEFT JOIN" if j.join_type == "left" else "JOIN"
+            t = j.table.name + (f" AS {j.table.alias}"
+                                if j.table.alias else "")
+            parts.append(f"{jt} {t} ON {expr_to_sql(j.on)}")
+        if stmt.where is not None:
+            parts.append(f"WHERE {expr_to_sql(stmt.where)}")
+        if stmt.group_by:
+            parts.append("GROUP BY " + ", ".join(
+                expr_to_sql(g) for g in stmt.group_by))
+        if stmt.having is not None:
+            parts.append(f"HAVING {expr_to_sql(stmt.having)}")
+    if stmt.order_by:
+        parts.append("ORDER BY " + ", ".join(
+            expr_to_sql(o.expr) + ("" if o.ascending else " DESC")
+            for o in stmt.order_by))
+    if stmt.limit is not None:
+        parts.append(f"LIMIT {stmt.limit}")
+        if stmt.offset:
+            parts.append(f"OFFSET {stmt.offset}")
+    if stmt.options:
+        parts.append("OPTION(" + ", ".join(
+            f"{k}={v}" for k, v in stmt.options.items()) + ")")
+    return " ".join(parts)
+
+
 def parse_sql(sql: str) -> Union[SelectStmt, SetOpStmt]:
     return _Parser(sql).parse()

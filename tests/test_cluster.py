@@ -165,3 +165,24 @@ def test_cluster_explain_and_app_errors_dont_poison_failover(cluster):
     resp = http_json("POST", f"{broker.url}/query/sql",
                      {"sql": "SELECT COUNT(*) FROM sales"})
     assert resp["resultTable"]["rows"] == [[N_SEGMENTS * ROWS]]
+
+
+def test_cluster_set_operation(cluster):
+    """Set ops over the remote data plane: branches scatter-gather
+    independently (rendered back to SQL), combine at the broker."""
+    ctrl, servers, broker, tmp_path = cluster
+    data = _build_table(tmp_path, ctrl)
+    _sync(ctrl, servers, broker)
+
+    resp = http_json("POST", f"{broker.url}/query/sql", {
+        "sql": "SELECT region FROM sales WHERE amount > 500 UNION "
+               "SELECT region FROM sales WHERE amount <= 500 "
+               "ORDER BY region"})
+    rows = [tuple(r) for r in resp["resultTable"]["rows"]]
+    assert rows == [("east",), ("west",)]
+
+    resp = http_json("POST", f"{broker.url}/query/sql", {
+        "sql": "SELECT region FROM sales EXCEPT SELECT region FROM sales "
+               "WHERE region = 'east'"})
+    rows = [tuple(r) for r in resp["resultTable"]["rows"]]
+    assert rows == [("west",)]

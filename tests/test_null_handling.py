@@ -1,0 +1,131 @@
+"""enableNullHandling: 3VL predicates, null-skipping aggregations, null
+selection output.
+
+Reference parity: Pinot's null handling — null value vectors per column
+(NullValueVectorReader), NullableSingleInputAggregationFunction (aggs skip
+null inputs), 3-valued predicate logic, and nulls surfacing in selection
+results — activated per query by the enableNullHandling option
+(QueryOptionsUtils). Without the option, stored default values are used
+(the reference's pre-null-handling behavior).
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+from pinot_tpu.server.data_manager import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+NH = " OPTION(enableNullHandling=true)"
+
+
+@pytest.fixture(scope="module")
+def broker(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("nullseg"))
+    schema = Schema("nt", [
+        FieldSpec("k", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+        FieldSpec("w", DataType.DOUBLE, FieldType.METRIC),
+    ])
+    cfg = TableConfig("nt")
+    rows = [
+        {"k": "a", "v": 10, "w": 1.5},
+        {"k": "a", "v": None, "w": 2.5},
+        {"k": "b", "v": 30, "w": None},
+        {"k": None, "v": 40, "w": 4.5},
+        {"k": "b", "v": None, "w": None},
+    ]
+    d = SegmentBuilder(schema, cfg).build(rows, out, "s0")
+    dm = TableDataManager("nt")
+    dm.add_segment(ImmutableSegment.load(d))
+    b = Broker()
+    b.register_table(dm)
+    return b
+
+
+class TestAggregations:
+    def test_sum_skips_nulls(self, broker):
+        r = broker.query("SELECT SUM(v) FROM nt" + NH)
+        assert r.rows == [(80,)]  # 10 + 30 + 40
+
+    def test_sum_without_option_uses_defaults(self, broker):
+        r = broker.query("SELECT SUM(v) FROM nt")
+        assert r.rows == [(80,)]  # metric default null value is 0
+
+    def test_count_star_keeps_null_rows(self, broker):
+        r = broker.query("SELECT COUNT(*) FROM nt" + NH)
+        assert r.rows == [(5,)]
+
+    def test_avg_skips_nulls(self, broker):
+        r = broker.query("SELECT AVG(w) FROM nt" + NH)
+        assert r.rows[0][0] == pytest.approx((1.5 + 2.5 + 4.5) / 3)
+
+    def test_min_skips_null_default(self, broker):
+        # without the option the stored default (0.0) wins MIN; with it,
+        # the real minimum of the non-null values
+        assert broker.query("SELECT MIN(w) FROM nt").rows[0][0] == 0.0
+        assert broker.query("SELECT MIN(w) FROM nt" + NH).rows[0][0] == 1.5
+
+    def test_sum_all_null_is_null(self, broker):
+        r = broker.query("SELECT SUM(v) FROM nt WHERE k = 'zzz'" + NH)
+        assert r.rows[0][0] is None
+
+
+class TestGroupBy:
+    def test_group_agg_skips_nulls(self, broker):
+        r = broker.query(
+            "SELECT k, SUM(v), COUNT(*) FROM nt GROUP BY k ORDER BY k"
+            + NH)
+        by_key = {row[0]: (row[1], row[2]) for row in r.rows}
+        assert by_key["a"] == (10, 2)
+        assert by_key["b"] == (30, 2)
+
+    def test_group_all_null_input_yields_null(self, broker):
+        r = broker.query(
+            "SELECT k, MIN(w) FROM nt WHERE k = 'b' GROUP BY k" + NH)
+        assert r.rows == [("b", None)]
+
+
+class TestPredicates:
+    def test_comparison_excludes_nulls(self, broker):
+        # v > 0 is UNKNOWN for null v; without the option the default (0)
+        # fails v > 0 too, but v >= 0 separates them
+        r = broker.query("SELECT COUNT(*) FROM nt WHERE v >= 0" + NH)
+        assert r.rows == [(3,)]
+        r2 = broker.query("SELECT COUNT(*) FROM nt WHERE v >= 0")
+        assert r2.rows == [(5,)]
+
+    def test_not_pushes_unknown(self, broker):
+        # NOT (v > 1000): null v stays UNKNOWN, excluded
+        r = broker.query("SELECT COUNT(*) FROM nt WHERE NOT v > 1000" + NH)
+        assert r.rows == [(3,)]
+
+    def test_is_null(self, broker):
+        r = broker.query("SELECT COUNT(*) FROM nt WHERE v IS NULL" + NH)
+        assert r.rows == [(2,)]
+        r2 = broker.query("SELECT COUNT(*) FROM nt WHERE v IS NOT NULL"
+                          + NH)
+        assert r2.rows == [(3,)]
+
+    def test_or_with_null(self, broker):
+        # v >= 0 OR w >= 0: row 5 (both null) is UNKNOWN, excluded
+        r = broker.query(
+            "SELECT COUNT(*) FROM nt WHERE v >= 0 OR w >= 0" + NH)
+        assert r.rows == [(4,)]
+
+    def test_string_null_dimension(self, broker):
+        r = broker.query("SELECT COUNT(*) FROM nt WHERE k IS NULL" + NH)
+        assert r.rows == [(1,)]
+
+
+class TestSelection:
+    def test_nulls_surface_in_rows(self, broker):
+        r = broker.query("SELECT k, v FROM nt" + NH)
+        vals = {tuple(row) for row in r.rows}
+        assert ("a", None) in vals
+        assert (None, 40) in vals
+
+    def test_defaults_without_option(self, broker):
+        r = broker.query("SELECT v FROM nt")
+        assert None not in {row[0] for row in r.rows}
