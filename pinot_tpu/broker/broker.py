@@ -37,11 +37,16 @@ class QueryTimeoutError(SqlError):
 
 class Broker:
     def __init__(self):
+        from .quota import QueryQuotaManager
         self._tables: Dict[str, TableDataManager] = {}
+        self.quota = QueryQuotaManager()
 
     # -- table registry (ideal-state analog) -------------------------------
     def register_table(self, dm: TableDataManager) -> None:
         self._tables[dm.table_name] = dm
+        cfg = getattr(dm, "table_config", None)
+        if cfg is not None and getattr(cfg, "quota_qps", None):
+            self.quota.set_quota(dm.table_name, cfg.quota_qps)
 
     def table(self, name: str) -> TableDataManager:
         if name not in self._tables:
@@ -68,6 +73,11 @@ class Broker:
         stmt = parse_sql(sql)
         return self._execute_stmt(stmt, t0)
 
+    def _is_hybrid(self, table: str) -> bool:
+        return table not in self._tables and \
+            f"{table}_OFFLINE" in self._tables and \
+            f"{table}_REALTIME" in self._tables
+
     def _execute_stmt(self, stmt, t0: float) -> ResultTable:
         if isinstance(stmt, SetOpStmt):
             return self._execute_setop(stmt, t0)
@@ -77,6 +87,17 @@ class Broker:
         query_id = uuid.uuid4().hex[:12]
         timeout_ms = int(stmt.options.get("timeoutMs", DEFAULT_TIMEOUT_MS))
         deadline = t0 + timeout_ms / 1e3
+        if self._is_hybrid(stmt.table):
+            if stmt.joins or has_window(stmt):
+                raise SqlError("joins/window functions over hybrid "
+                               "tables are not supported yet; query the "
+                               "_OFFLINE/_REALTIME tables directly")
+            global_accountant.register(query_id, deadline=deadline)
+            try:
+                return self._execute_hybrid(stmt, t0, query_id)
+            finally:
+                global_accountant.unregister(query_id)
+        self.quota.check(stmt.table)
         if stmt.joins or has_window(stmt):
             # v2 engine (BrokerRequestHandlerDelegate picks the multi-stage
             # handler when the query needs it); registered with the
@@ -103,6 +124,72 @@ class Broker:
             Tracing.unregister()
         if trace_on:
             result.trace = scope.to_dict()
+        return result
+
+    # -- hybrid offline+realtime tables (TimeBoundaryManager analog) -------
+    def _execute_hybrid(self, stmt: SelectStmt, t0: float,
+                        query_id: str = "") -> ResultTable:
+        """Logical table = T_OFFLINE + T_REALTIME: the offline side answers
+        time <= boundary, the realtime side time > boundary, partials merge
+        in one reduce (BaseBrokerRequestHandler hybrid scatter)."""
+        from ..engine.accounting import QueryKilledError
+        from ..engine.serving import execute_planned, plan_segments
+        from .routing import split_hybrid, time_boundary
+        logical = stmt.table
+        off_dm = self.table(f"{logical}_OFFLINE")
+        self.quota.check(f"{logical}_OFFLINE")
+
+        time_col = None
+        cfg = getattr(off_dm, "table_config", None)
+        if cfg is not None and getattr(cfg, "time_column", None):
+            time_col = cfg.time_column
+        if time_col is None:
+            from ..spi.schema import FieldType
+            schema = off_dm.schema
+            for f in getattr(schema, "fields", []):
+                if f.field_type == FieldType.DATE_TIME:
+                    time_col = f.name
+                    break
+        if time_col is None:
+            raise SqlError(
+                f"hybrid table {logical!r} needs a timeColumn in its "
+                f"config or a DATE_TIME schema field")
+
+        boundary = time_boundary(
+            {seg.name: {"columns": {time_col: {
+                "max": getattr(seg.columns.get(time_col), "max", None)}}}
+             for seg in off_dm.acquire_segments()}, time_col)
+        if boundary is None:
+            raise SqlError(
+                f"hybrid table {logical!r}: no offline segments, or "
+                f"offline segments lack {time_col!r} metadata for the "
+                f"time boundary")
+
+        off_stmt, rt_stmt = split_hybrid(stmt, time_col, boundary)
+        if stmt.explain:
+            return self._execute_stmt(off_stmt, t0)
+        partials: List[Any] = []
+        n_segments = pruned = docs = 0
+        try:
+            for part_stmt in (off_stmt, rt_stmt):
+                ctx_p = build_query_context(part_stmt)
+                dm = self.table(ctx_p.table)
+                segments = dm.acquire_segments()
+                ex = plan_segments(ctx_p, segments, use_rollups=True)
+                partials.extend(execute_planned(ex))
+                n_segments += len(segments)
+                pruned += ex.pruned
+                docs += ex.docs_scanned
+        except QueryKilledError as e:
+            if e.is_deadline:
+                global_metrics.count("broker_query_timeouts")
+                raise QueryTimeoutError(str(e)) from None
+            raise
+        result = reduce_partials(build_query_context(off_stmt), partials)
+        result.num_segments = n_segments
+        result.num_segments_pruned = pruned
+        result.num_docs_scanned = docs
+        result.time_ms = (time.perf_counter() - t0) * 1e3
         return result
 
     # -- set operations (v2 set operators; combine at the broker) ----------

@@ -55,12 +55,17 @@ class FailureDetector:
 
 class BrokerNode:
     def __init__(self, controller_url: str, port: int = 0,
-                 routing_refresh: float = 0.3):
+                 routing_refresh: float = 0.3,
+                 instance_selector: str = "balanced"):
+        from ..broker.quota import QueryQuotaManager
+        from ..broker.routing import make_selector
         self.controller_url = controller_url
         self.routing_refresh = routing_refresh
         self._routing: Dict[str, Any] = {"version": -1}
-        self._rr = 0  # round-robin cursor (BalancedInstanceSelector)
+        self._rr = 0  # round-robin cursor for explain/failover re-picks
         self._failures = FailureDetector()
+        self._selector = make_selector(instance_selector)
+        self._quota = QueryQuotaManager()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(max_workers=16)
@@ -121,6 +126,25 @@ class BrokerNode:
         return candidates[self._rr % len(candidates)]
 
     # -- query path --------------------------------------------------------
+    def _snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._routing
+
+    def _table_config(self, table: str) -> Dict[str, Any]:
+        snap = self._snapshot()
+        return (snap.get("tables", {}).get(table) or {}).get("config") or {}
+
+    def _segment_meta(self, table: str) -> Dict[str, Any]:
+        snap = self._snapshot()
+        return {s: (e or {}).get("meta")
+                for s, e in (snap.get("segments", {}).get(table)
+                             or {}).items()}
+
+    def _check_quota(self, table: str) -> None:
+        qps = self._table_config(table).get("quotaQps")
+        self._quota.set_quota(table, qps)
+        self._quota.check(table)
+
     def query(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
@@ -131,47 +155,136 @@ class BrokerNode:
             raise SqlError("multi-stage joins/windows over the remote data "
                            "plane arrive with the dispatch stage; use the "
                            "in-process broker for them")
+
+        # hybrid table: logical name fans out to _OFFLINE + _REALTIME with
+        # the time boundary applied (TimeBoundaryManager analog)
+        snap_tables = self._snapshot().get("tables", {})
+        if stmt.table not in snap_tables and \
+                f"{stmt.table}_OFFLINE" in snap_tables and \
+                f"{stmt.table}_REALTIME" in snap_tables:
+            return self._query_hybrid(stmt, t0)
+
+        self._check_quota(stmt.table)
         ctx = build_query_context(stmt)
-        assignment = self._route(ctx.table)
-
         if stmt.explain:
-            # plan shape is identical across servers: ask any holder, with
-            # the same failover + failure-detector recording as the data path
-            for seg, holders in assignment.items():
-                tried = set()
-                while True:
-                    pick = self._pick_replica(
-                        [h for h in holders if h not in tried])
-                    if pick is None:
-                        break
-                    try:
-                        resp = http_json(
-                            "POST", f"{self._server_url(pick)}/query",
-                            {"sql": sql})
-                    except Exception:
-                        tried.add(pick)
-                        self._failures.record_failure(pick)
-                        continue
-                    exp = resp.get("explain", {})
-                    return ResultTable(exp.get("columns", []),
-                                       [tuple(r) for r in exp.get("rows", [])])
-            raise SqlError("no live replica to explain against")
+            return self._explain_remote(sql, ctx.table)
+        partials, queried, pruned = self._scatter(sql, ctx)
+        result = reduce_partials(ctx, partials)
+        result.num_segments = queried
+        result.num_segments_pruned = pruned
+        result.time_ms = (time.perf_counter() - t0) * 1e3
+        return result
 
-        # scatter: group segments by chosen replica
-        by_server: Dict[str, List[str]] = {}
-        unserved: List[str] = []
+    def _query_hybrid(self, stmt, t0: float) -> ResultTable:
+        from ..broker.routing import split_hybrid, time_boundary
+        logical = stmt.table
+        self._check_quota(f"{logical}_OFFLINE")
+        tc = self._table_config(f"{logical}_OFFLINE")
+        time_col = tc.get("timeColumn")
+        if not time_col:
+            schema = (self._snapshot().get("tables", {})
+                      .get(f"{logical}_OFFLINE") or {}).get("schema") or {}
+            for f in schema.get("fields", []):
+                if f.get("fieldType") == "DATE_TIME":
+                    time_col = f.get("name")
+                    break
+        if not time_col:
+            raise SqlError(
+                f"hybrid table {logical!r} needs a timeColumn in its "
+                f"config or a DATE_TIME schema field")
+        boundary = time_boundary(
+            self._segment_meta(f"{logical}_OFFLINE"), time_col)
+        if boundary is None:
+            raise SqlError(f"hybrid table {logical!r}: offline segments "
+                           f"lack {time_col!r} metadata for the boundary")
+        off, rt = split_hybrid(stmt, time_col, boundary)
+        if stmt.explain:
+            return self._explain_remote("EXPLAIN " + to_sql(off), off.table)
+        partials: List[Any] = []
+        queried = pruned = 0
+        for part_stmt in (off, rt):
+            ctx_p = build_query_context(part_stmt)
+            p, q, pr = self._scatter(to_sql(part_stmt), ctx_p)
+            partials.extend(p)
+            queried += q
+            pruned += pr
+        result = reduce_partials(build_query_context(off), partials)
+        result.num_segments = queried
+        result.num_segments_pruned = pruned
+        result.time_ms = (time.perf_counter() - t0) * 1e3
+        return result
+
+    def _explain_remote(self, sql: str, table: str) -> ResultTable:
+        # plan shape is identical across servers: ask any holder, with the
+        # same failover + failure-detector recording as the data path
+        assignment = self._route(table)
         for seg, holders in assignment.items():
-            pick = self._pick_replica(holders)
-            if pick is None:
-                unserved.append(seg)
-            else:
-                by_server.setdefault(pick, []).append(seg)
+            tried: set = set()
+            while True:
+                pick = self._pick_replica(
+                    [h for h in holders if h not in tried])
+                if pick is None:
+                    break
+                try:
+                    resp = http_json(
+                        "POST", f"{self._server_url(pick)}/query",
+                        {"sql": sql})
+                except Exception:
+                    tried.add(pick)
+                    self._failures.record_failure(pick)
+                    continue
+                exp = resp.get("explain", {})
+                return ResultTable(exp.get("columns", []),
+                                   [tuple(r) for r in exp.get("rows", [])])
+        raise SqlError("no live replica to explain against")
+
+    def _scatter(self, sql: str, ctx) -> Tuple[List[Any], int, int]:
+        # one snapshot for assignment + segment metadata: the refresh
+        # thread swaps self._routing, and mixing two snapshots could
+        # silently drop segments assigned in one but absent in the other
+        snap = self._snapshot()
+        assignment = snap.get("assignment", {}).get(ctx.table)
+        if assignment is None:
+            raise SqlError(f"table {ctx.table!r} not found in routing")
+        seg_entries = snap.get("segments", {}).get(ctx.table) or {}
+
+        # broker-side pruning over controller-held segment metadata; an
+        # assigned segment with no metadata entry is never pruned
+        from ..broker.routing import prune_segments
+        meta = {s: (seg_entries.get(s) or {}).get("meta")
+                for s in assignment}
+        keep, pruned = prune_segments(
+            meta, ctx.filter,
+            (snap.get("tables", {}).get(ctx.table) or {}).get("config"))
+        keep_set = set(keep)
+        assignment = {s: h for s, h in assignment.items() if s in keep_set}
+
+        # drop holders with no known URL up front so selector fallbacks
+        # can only pick reachable servers
+        assignment = {s: [h for h in holders if self._server_url(h)]
+                      for s, holders in assignment.items()}
+
+        # instance selection (pluggable: balanced / replicaGroup /
+        # strictReplicaGroup / adaptive)
+        def healthy(h: str) -> bool:
+            return self._failures.healthy(h)
+
+        picks = self._selector.select(assignment, healthy)
+        unserved = [s for s, p in picks.items() if p is None]
         if unserved:
             raise SqlError(f"no live replica for segments {unserved[:3]}"
                            f"{'...' if len(unserved) > 3 else ''}")
+        by_server: Dict[str, List[str]] = {}
+        for seg, pick in picks.items():
+            by_server.setdefault(pick, []).append(seg)
+
+        adaptive = getattr(self._selector, "record_start", None)
 
         def call(server: str, segs: List[str], retry: bool = True):
             url = self._server_url(server)
+            if adaptive:
+                self._selector.record_start(server)
+            tcall = time.perf_counter()
             try:
                 resp = http_json("POST", f"{url}/query",
                                  {"sql": sql, "segments": segs})
@@ -206,20 +319,20 @@ class BrokerNode:
                     out["partials"].extend(r["partials"])
                     out["segmentsQueried"] += r["segmentsQueried"]
                 return out
+            finally:
+                if adaptive:
+                    self._selector.record_end(
+                        server, (time.perf_counter() - tcall) * 1e3)
 
         futures = [self._pool.submit(call, srv, segs)
                    for srv, segs in by_server.items()]
-        partials = []
+        partials: List[Any] = []
         queried = 0
         for f in futures:
             resp = f.result()
             partials.extend(partial_from_wire(p) for p in resp["partials"])
             queried += resp["segmentsQueried"]
-
-        result = reduce_partials(ctx, partials)
-        result.num_segments = queried
-        result.time_ms = (time.perf_counter() - t0) * 1e3
-        return result
+        return partials, queried, pruned
 
     def _query_setop(self, stmt: SetOpStmt, t0: float) -> ResultTable:
         """Set operations over the remote data plane: run each branch as

@@ -112,12 +112,34 @@ class Controller:
                 self._state[key].pop(name, None)
             self._bump()
 
-    def add_segment(self, table: str, segment: str, location: str) -> None:
+    @staticmethod
+    def _read_segment_meta(location: str) -> Optional[Dict[str, Any]]:
+        """Pruning metadata from the segment dir (per-column min/max +
+        partitions, ZK segment-metadata analog); None when unreadable."""
+        try:
+            with open(os.path.join(location, "metadata.json")) as fh:
+                m = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        cols = {}
+        for name, cm in (m.get("columns") or {}).items():
+            entry = {k: cm[k] for k in ("min", "max", "partitions")
+                     if k in cm}
+            if entry:
+                cols[name] = entry
+        return {"columns": cols, "totalDocs": m.get("totalDocs"),
+                "numPartitions": m.get("numPartitions")}
+
+    def add_segment(self, table: str, segment: str, location: str,
+                    metadata: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:
             if table not in self._state["tables"]:
                 raise KeyError(f"table {table!r} not registered")
             prev = self._state["segments"][table].get(segment)
-            self._state["segments"][table][segment] = {"location": location}
+            if metadata is None:
+                metadata = self._read_segment_meta(location)
+            self._state["segments"][table][segment] = {
+                "location": location, "meta": metadata}
             if prev is not None and prev.get("location") != location:
                 # segment refresh/replace: assignment may be unchanged but
                 # servers must re-download — force a version bump so their
@@ -225,7 +247,8 @@ class Controller:
                     or (200, {"status": "OK"})),
                 ("POST", "/segments"): lambda h, b: (
                     ctrl.add_segment(b["table"], b["segment"],
-                                     b["location"]) or (200, {"status": "OK"})),
+                                     b["location"], b.get("metadata"))
+                    or (200, {"status": "OK"})),
                 ("GET", "/routing"): lambda h, b: (
                     200, ctrl.routing_snapshot()),
                 ("GET", "/assignments/"): lambda h, b: (

@@ -25,13 +25,13 @@ from ..query.sql import SqlError
 
 
 class QueryKilledError(SqlError):
-    """A query terminated by the accountant. is_deadline distinguishes a
-    timeout (deadline exceeded) from an operator/watcher kill."""
+    """Raised inside the query's own execution path after a kill flag.
+    is_deadline distinguishes a timeout (deadline exceeded) from an
+    operator/watcher kill."""
 
     def __init__(self, msg: str, is_deadline: bool = False):
         super().__init__(msg)
         self.is_deadline = is_deadline
-    """Raised inside the query's own execution path after a kill flag."""
 
 
 _PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096

@@ -400,28 +400,45 @@ def host_group_by(ctx: QueryContext, seg: ImmutableSegment,
     nsel = len(sel)
     if nsel == 0:
         return {}
-    key_vals: List[np.ndarray] = []
+    na = null_aware(ctx)
     codes = np.zeros(nsel, dtype=np.int64)
-    uniques: List[np.ndarray] = []
+    uniques: List[Tuple[np.ndarray, bool]] = []
     for g in ctx.group_by:
         v = eval_value(g, seg, sel)
         if v.dtype == object:
             v = v.astype(str)
-        u, inv = np.unique(v, return_inverse=True)
-        codes = codes * len(u) + inv
-        uniques.append(u)
-        key_vals.append(v)
+        nm = expr_null_mask(g, seg) if na else None
+        f = nm[sel] if nm is not None else None
+        if f is not None and f.any():
+            # null keys form their own group: encode the null flag as an
+            # extra factor so the stored default value never collides
+            vv = v.copy()
+            vv[f] = vv[~f][0] if (~f).any() else vv[0]
+            u, inv = np.unique(vv, return_inverse=True)
+            codes = (codes * len(u) + inv) * 2 + f
+            uniques.append((u, True))
+        else:
+            u, inv = np.unique(v, return_inverse=True)
+            codes = codes * len(u) + inv
+            uniques.append((u, False))
     ucodes, inv = np.unique(codes, return_inverse=True)
     n_groups = len(ucodes)
 
     # decode group keys: recover per-key value by walking codes backwards
-    key_cols: List[np.ndarray] = []
+    key_cols: List[List[Any]] = []
     rem = ucodes.copy()
-    for u in reversed(uniques):
-        key_cols.append(u[rem % len(u)])
-        rem //= len(u)
+    for u, has_null_flag in reversed(uniques):
+        if has_null_flag:
+            flag = rem % 2
+            rem = rem // 2
+            vals = u[rem % len(u)]
+            key_cols.append([None if flag[i] else _scalar(vals[i])
+                             for i in range(n_groups)])
+        else:
+            key_cols.append([_scalar(x) for x in (u[rem % len(u)])])
+        rem = rem // len(u)
     key_cols.reverse()
-    keys = list(zip(*[[_scalar(x) for x in kc] for kc in key_cols]))
+    keys = list(zip(*key_cols))
 
     out: Dict[Tuple, List[Any]] = {tuple(k): [] for k in keys}
     na = null_aware(ctx)

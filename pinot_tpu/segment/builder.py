@@ -184,14 +184,15 @@ class SegmentBuilder:
         if self.table_config.partition_column:
             pc = self.table_config.partition_column
             pmeta = meta["columns"][pc]
-            # modulo partition function over raw values (PartitionFunction SPI)
-            vals = cols[pc]
-            if not np.issubdtype(np.asarray(vals[:1]).dtype, np.number):
-                pids = np.asarray([hash(v) for v in vals])
-            else:
-                pids = vals.astype(np.int64)
-            parts = np.unique(pids % max(self.table_config.num_partitions, 1))
-            pmeta["partitions"] = [int(p) for p in parts]
+            # stable partition function (PartitionFunction SPI): modulo for
+            # ints, murmur2 for strings — the broker pruner recomputes
+            # partitions of query literals, so builtin hash() (per-process
+            # salted) can never be used here
+            from ..spi.partition import partition_ids
+            pids = partition_ids(cols[pc],
+                                 self.table_config.num_partitions)
+            pmeta["partitions"] = sorted(set(pids))
+            meta["numPartitions"] = self.table_config.num_partitions
 
         with open(os.path.join(seg_dir, METADATA_FILE), "w") as fh:
             json.dump(meta, fh, indent=1, default=_json_default)

@@ -16,8 +16,9 @@ from ..segment.immutable import ImmutableSegment
 
 
 class TableDataManager:
-    def __init__(self, table_name: str):
+    def __init__(self, table_name: str, table_config=None):
         self.table_name = table_name
+        self.table_config = table_config  # TableConfig | None
         self._segments: Dict[str, ImmutableSegment] = {}
         self._lock = threading.Lock()
         self._schema = None

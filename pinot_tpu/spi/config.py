@@ -78,6 +78,11 @@ class TableConfig:
     # partition column for partition-aware routing/pruning (segmentpartition/)
     partition_column: Optional[str] = None
     num_partitions: int = 1
+    # time column for time pruning + the hybrid-table time boundary
+    # (TimeBoundaryManager); defaults to the schema's DATE_TIME field
+    time_column: Optional[str] = None
+    # max queries/sec for this table (query quota; None = unlimited)
+    quota_qps: Optional[float] = None
 
     @property
     def name_with_type(self) -> str:
@@ -105,6 +110,8 @@ class TableConfig:
             },
             "partitionColumn": self.partition_column,
             "numPartitions": self.num_partitions,
+            "timeColumn": self.time_column,
+            "quotaQps": self.quota_qps,
         }
 
     def to_json(self) -> str:
@@ -136,6 +143,8 @@ class TableConfig:
             ),
             partition_column=d.get("partitionColumn"),
             num_partitions=d.get("numPartitions", 1),
+            time_column=d.get("timeColumn"),
+            quota_qps=d.get("quotaQps"),
         )
 
 

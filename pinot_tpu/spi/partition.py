@@ -1,0 +1,68 @@
+"""Partition functions for partition-aware segment assignment/pruning.
+
+Reference parity: pinot-segment-spi/.../partition/PartitionFunction.java
+implementations — Modulo for integral values, Murmur (murmur2, seed
+0x9747b28c, over UTF-8 bytes) for strings. Stability across processes is
+the point: the broker prunes segments by recomputing the partition of a
+query literal, so the function must match what the segment builder used
+(Python's builtin hash() is salted per process and can never be used).
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, List
+
+import numpy as np
+
+_MURMUR2_SEED = 0x9747B28C
+_M = 0x5BD1E995
+_MASK = 0xFFFFFFFF
+
+
+def murmur2(data: bytes) -> int:
+    """32-bit murmur2, matching kafka.common.utils.Utils.murmur2 (the
+    implementation Pinot's MurmurPartitionFunction delegates to)."""
+    length = len(data)
+    h = (_MURMUR2_SEED ^ length) & _MASK
+    n4 = length & ~0x3
+    for i in range(0, n4, 4):
+        k = (data[i] & 0xFF) | ((data[i + 1] & 0xFF) << 8) \
+            | ((data[i + 2] & 0xFF) << 16) | ((data[i + 3] & 0xFF) << 24)
+        k = (k * _M) & _MASK
+        k ^= k >> 24
+        k = (k * _M) & _MASK
+        h = (h * _M) & _MASK
+        h ^= k
+    rem = length & 0x3
+    if rem == 3:
+        h ^= (data[n4 + 2] & 0xFF) << 16
+    if rem >= 2:
+        h ^= (data[n4 + 1] & 0xFF) << 8
+    if rem >= 1:
+        h ^= data[n4] & 0xFF
+        h = (h * _M) & _MASK
+    h ^= h >> 13
+    h = (h * _M) & _MASK
+    h ^= h >> 15
+    return h
+
+
+def partition_of(value: Any, num_partitions: int) -> int:
+    """Partition id of one value: Modulo for integral values, Murmur for
+    everything else (rendered as str, UTF-8) — the builder and the broker
+    pruner must agree, so both call this."""
+    n = max(num_partitions, 1)
+    if isinstance(value, (bool, np.bool_)):
+        return int(value) % n
+    if isinstance(value, (int, np.integer)):
+        return int(value) % n
+    if isinstance(value, (float, np.floating)) and float(value).is_integer():
+        return int(value) % n
+    return (murmur2(str(value).encode("utf-8")) & 0x7FFFFFFF) % n
+
+
+def partition_ids(values: Iterable[Any], num_partitions: int) -> List[int]:
+    n = max(num_partitions, 1)
+    arr = np.asarray(values)
+    if np.issubdtype(arr.dtype, np.integer):
+        return (arr.astype(np.int64) % n).tolist()
+    return [partition_of(v, n) for v in arr.tolist()]

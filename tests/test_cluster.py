@@ -186,3 +186,96 @@ def test_cluster_set_operation(cluster):
                "WHERE region = 'east'"})
     rows = [tuple(r) for r in resp["resultTable"]["rows"]]
     assert rows == [("west",)]
+
+
+def test_broker_side_segment_pruning(cluster):
+    """The broker prunes segments via controller-held metadata (min/max)
+    before scattering (TimeSegmentPruner analog)."""
+    ctrl, servers, broker, tmp_path = cluster
+    schema = Schema("ts", [
+        FieldSpec("day", DataType.INT),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+    builder = SegmentBuilder(schema, TableConfig("ts"))
+    ctrl.add_table("ts", schema.to_dict(), replication=1)
+    for i in range(4):  # segments cover days [100i, 100i+99]
+        cols = {
+            "day": (100 * i + np.arange(100)).astype(np.int32),
+            "v": np.full(100, i + 1, dtype=np.int32),
+        }
+        d = builder.build(cols, str(tmp_path / "segments"), f"ts_{i}")
+        ctrl.add_segment("ts", f"ts_{i}", d)
+    _sync(ctrl, servers, broker)
+
+    resp = http_json("POST", f"{broker.url}/query/sql", {
+        "sql": "SELECT SUM(v) FROM ts WHERE day >= 350"})
+    assert [tuple(r) for r in resp["resultTable"]["rows"]] == [(200,)]
+    assert resp["numSegmentsPruned"] == 3
+    assert resp["numSegmentsQueried"] == 1
+
+
+def test_cluster_hybrid_table(cluster):
+    """Logical hybrid table over HTTP: offline + realtime parts split at
+    the time boundary computed from controller-held metadata."""
+    ctrl, servers, broker, tmp_path = cluster
+    schema = Schema("ev", [
+        FieldSpec("day", DataType.INT, FieldType.DATE_TIME),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+    off_cfg = {"timeColumn": "day"}
+    ctrl.add_table("ev_OFFLINE", schema.to_dict(), config=off_cfg,
+                   replication=1)
+    ctrl.add_table("ev_REALTIME", schema.to_dict(), config=off_cfg,
+                   replication=1)
+    builder = SegmentBuilder(schema, TableConfig("ev"))
+    d = builder.build({"day": np.arange(1, 11, dtype=np.int32),
+                       "v": np.full(10, 1, dtype=np.int32)},
+                      str(tmp_path / "segments"), "ev_off_0")
+    ctrl.add_segment("ev_OFFLINE", "ev_off_0", d)
+    d = builder.build({"day": np.arange(8, 16, dtype=np.int32),
+                       "v": np.full(8, 100, dtype=np.int32)},
+                      str(tmp_path / "segments"), "ev_rt_0")
+    ctrl.add_segment("ev_REALTIME", "ev_rt_0", d)
+    _sync(ctrl, servers, broker)
+
+    resp = http_json("POST", f"{broker.url}/query/sql", {
+        "sql": "SELECT SUM(v), COUNT(*) FROM ev"})
+    # offline days 1-10 (v=1), realtime days 11-15 only (v=100)
+    assert [tuple(r) for r in resp["resultTable"]["rows"]] == [(510, 15)]
+
+
+def test_replica_group_selector_cluster(cluster):
+    ctrl, servers, _broker, tmp_path = cluster
+    data = _build_table(tmp_path, ctrl, replication=2)
+    rg_broker = BrokerNode(ctrl.url, routing_refresh=0.1,
+                           instance_selector="replicaGroup")
+    try:
+        _sync(ctrl, servers, rg_broker)
+        resp = http_json("POST", f"{rg_broker.url}/query/sql", {
+            "sql": "SELECT SUM(amount) FROM sales"})
+        rows = [tuple(r) for r in resp["resultTable"]["rows"]]
+        assert rows == [(int(data["amount"].sum()),)]
+    finally:
+        rg_broker.stop()
+
+
+def test_query_quota_cluster(cluster):
+    ctrl, servers, broker, tmp_path = cluster
+    schema = Schema("q", [FieldSpec("v", DataType.INT, FieldType.METRIC)])
+    ctrl.add_table("q", schema.to_dict(), config={"quotaQps": 2.0},
+                   replication=1)
+    d = SegmentBuilder(schema, TableConfig("q")).build(
+        {"v": np.arange(10, dtype=np.int32)},
+        str(tmp_path / "segments"), "q_0")
+    ctrl.add_segment("q", "q_0", d)
+    _sync(ctrl, servers, broker)
+
+    ok = errors = 0
+    for _ in range(6):
+        try:
+            http_json("POST", f"{broker.url}/query/sql",
+                      {"sql": "SELECT SUM(v) FROM q"})
+            ok += 1
+        except Exception:
+            errors += 1
+    assert ok >= 1 and errors >= 1  # burst of 2 allowed, rest rejected

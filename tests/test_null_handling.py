@@ -129,3 +129,16 @@ class TestSelection:
     def test_defaults_without_option(self, broker):
         r = broker.query("SELECT v FROM nt")
         assert None not in {row[0] for row in r.rows}
+
+
+class TestGroupByNullKeys:
+    def test_null_key_is_its_own_group(self, broker):
+        r = broker.query(
+            "SELECT k, COUNT(*) FROM nt GROUP BY k ORDER BY k" + NH)
+        by_key = {row[0]: row[1] for row in r.rows}
+        assert by_key[None] == 1       # the k=None row groups under null
+        assert by_key["a"] == 2 and by_key["b"] == 2
+
+    def test_default_mode_groups_under_default(self, broker):
+        r = broker.query("SELECT k, COUNT(*) FROM nt GROUP BY k")
+        assert None not in {row[0] for row in r.rows}

@@ -1,0 +1,207 @@
+"""Broker routing: segment pruning, instance selectors, time boundary
+(hybrid tables), partition functions, query quotas.
+
+Reference test model: pinot-broker routing tests (instanceselector/,
+segmentpruner/, timeboundary/) + HelixExternalViewBasedQueryQuotaManager
+tests.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.broker.quota import QueryQuotaManager, QuotaExceededError
+from pinot_tpu.broker.routing import (AdaptiveServerSelector,
+                                      BalancedInstanceSelector,
+                                      ReplicaGroupInstanceSelector,
+                                      StrictReplicaGroupInstanceSelector,
+                                      filter_bounds, prune_segments,
+                                      time_boundary)
+from pinot_tpu.query.sql import parse_sql
+from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+from pinot_tpu.server.data_manager import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+from pinot_tpu.spi.partition import murmur2, partition_of
+
+
+def _where(sql_where: str):
+    return parse_sql(f"SELECT a FROM t WHERE {sql_where}").where
+
+
+class TestPartitionFunction:
+    def test_murmur2_deterministic_and_spread(self):
+        # what matters operationally: the builder and the broker pruner
+        # compute identical partitions across processes and restarts, and
+        # the hash spreads keys
+        vals = [f"key_{i}" for i in range(200)]
+        h1 = [murmur2(v.encode()) for v in vals]
+        h2 = [murmur2(v.encode()) for v in vals]
+        assert h1 == h2
+        assert all(0 <= h <= 0xFFFFFFFF for h in h1)
+        assert len({h % 8 for h in h1}) == 8  # hits every bucket
+
+    def test_int_modulo(self):
+        assert partition_of(17, 4) == 1
+        assert partition_of(np.int32(17), 4) == 1
+
+    def test_string_stable(self):
+        a = partition_of("east", 8)
+        assert a == partition_of("east", 8)
+        assert 0 <= a < 8
+
+
+class TestFilterBounds:
+    def test_range_and_eq(self):
+        b = filter_bounds(_where("x > 5 AND x <= 20 AND y = 3"))
+        assert b["x"].lo == 5 and b["x"].hi == 20
+        assert b["y"].values == {3}
+
+    def test_between_and_in(self):
+        b = filter_bounds(_where("x BETWEEN 2 AND 9 AND r IN ('a', 'b')"))
+        assert (b["x"].lo, b["x"].hi) == (2, 9)
+        assert b["r"].values == {"a", "b"}
+
+    def test_or_not_analyzed(self):
+        assert filter_bounds(_where("x > 5 OR y = 3")) == {}
+
+
+class TestSegmentPruning:
+    META = {
+        "seg_low": {"columns": {"t": {"min": 0, "max": 99}}},
+        "seg_high": {"columns": {"t": {"min": 100, "max": 199}}},
+        "seg_nometa": None,
+    }
+
+    def test_time_range_prunes(self):
+        keep, pruned = prune_segments(self.META, _where("t >= 150"))
+        assert set(keep) == {"seg_high", "seg_nometa"}
+        assert pruned == 1
+
+    def test_no_filter_keeps_all(self):
+        keep, pruned = prune_segments(self.META, None)
+        assert len(keep) == 3 and pruned == 0
+
+    def test_partition_pruning(self):
+        meta = {
+            f"seg_{p}": {"columns": {"pid": {"min": 0, "max": 10 ** 9,
+                                             "partitions": [p]}},
+                         "numPartitions": 4}
+            for p in range(4)
+        }
+        keep, pruned = prune_segments(
+            meta, _where("pid = 6"), {"partitionColumn": "pid",
+                                      "numPartitions": 4})
+        assert keep == ["seg_2"] and pruned == 3  # 6 % 4 == 2
+
+
+class TestInstanceSelectors:
+    ASSIGN = {"s1": ["a", "b"], "s2": ["a", "b"], "s3": ["b", "c"]}
+
+    def test_balanced_spreads(self):
+        sel = BalancedInstanceSelector()
+        picks = [sel.select(self.ASSIGN, lambda h: True) for _ in range(4)]
+        used = {p for d in picks for p in d.values()}
+        assert used == {"a", "b", "c"}
+
+    def test_replica_group_single_position(self):
+        sel = ReplicaGroupInstanceSelector()
+        picks = sel.select({"s1": ["a", "b"], "s2": ["c", "d"]},
+                           lambda h: True)
+        # same replica index for every segment: {a,c} or {b,d}
+        assert set(picks.values()) in ({"a", "c"}, {"b", "d"})
+
+    def test_strict_replica_group_fails_unhealthy(self):
+        sel = StrictReplicaGroupInstanceSelector()
+        picks = sel.select({"s1": ["a"], "s2": ["a"]}, lambda h: h != "a")
+        assert picks == {"s1": None, "s2": None}
+
+    def test_adaptive_prefers_fast_server(self):
+        sel = AdaptiveServerSelector()
+        for _ in range(5):
+            sel.record_start("slow")
+            sel.record_end("slow", 500.0)
+            sel.record_start("fast")
+            sel.record_end("fast", 5.0)
+        picks = sel.select({"s1": ["slow", "fast"]}, lambda h: True)
+        assert picks["s1"] == "fast"
+
+
+class TestQuota:
+    def test_quota_rejects_over_rate(self):
+        qm = QueryQuotaManager()
+        qm.set_quota("t", 2.0)  # burst capacity 2
+        qm.check("t")
+        qm.check("t")
+        with pytest.raises(QuotaExceededError):
+            qm.check("t")
+
+    def test_quota_refills(self):
+        qm = QueryQuotaManager()
+        qm.set_quota("t", 50.0)
+        for _ in range(50):
+            qm.check("t")
+        with pytest.raises(QuotaExceededError):
+            qm.check("t")
+        time.sleep(0.1)  # ~5 tokens back
+        qm.check("t")
+
+    def test_no_quota_unlimited(self):
+        qm = QueryQuotaManager()
+        for _ in range(100):
+            qm.check("unbounded")
+
+
+class TestTimeBoundary:
+    def test_boundary_is_max(self):
+        meta = {"s0": {"columns": {"d": {"min": 0, "max": 10}}},
+                "s1": {"columns": {"d": {"min": 11, "max": 20}}}}
+        assert time_boundary(meta, "d") == 20
+
+    def test_missing_meta_no_boundary(self):
+        assert time_boundary({"s0": {}}, "d") is None
+
+
+@pytest.fixture(scope="module")
+def hybrid_broker(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("hybrid"))
+    schema = Schema("ev", [
+        FieldSpec("day", DataType.INT, FieldType.DATE_TIME),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+    b = Broker()
+    # offline: days 1..10
+    off_cfg = TableConfig("ev_OFFLINE", time_column="day")
+    builder = SegmentBuilder(schema, off_cfg)
+    off_dm = TableDataManager("ev_OFFLINE", table_config=off_cfg)
+    d = builder.build({"day": np.arange(1, 11, dtype=np.int32),
+                       "v": np.full(10, 1, dtype=np.int32)}, out, "off_0")
+    off_dm.add_segment(ImmutableSegment.load(d))
+    b.register_table(off_dm)
+    # realtime: days 8..15 — 8..10 overlap the offline side and must be
+    # served by OFFLINE only (boundary = 10)
+    rt_cfg = TableConfig("ev_REALTIME", time_column="day")
+    rt_dm = TableDataManager("ev_REALTIME", table_config=rt_cfg)
+    d = SegmentBuilder(schema, rt_cfg).build(
+        {"day": np.arange(8, 16, dtype=np.int32),
+         "v": np.full(8, 100, dtype=np.int32)}, out, "rt_0")
+    rt_dm.add_segment(ImmutableSegment.load(d))
+    b.register_table(rt_dm)
+    return b
+
+
+class TestHybridTable:
+    def test_boundary_split(self, hybrid_broker):
+        # offline days 1-10 each v=1 (sum 10); realtime days 11-15 v=100
+        # (sum 500); realtime rows with day<=10 are excluded
+        r = hybrid_broker.query("SELECT SUM(v), COUNT(*) FROM ev")
+        assert r.rows == [(510, 15)]
+
+    def test_user_filter_composes(self, hybrid_broker):
+        r = hybrid_broker.query("SELECT COUNT(*) FROM ev WHERE day >= 9")
+        assert r.rows == [(7,)]  # days 9,10 offline + 11..15 realtime
+
+    def test_physical_tables_still_queryable(self, hybrid_broker):
+        r = hybrid_broker.query("SELECT COUNT(*) FROM ev_REALTIME")
+        assert r.rows == [(8,)]
