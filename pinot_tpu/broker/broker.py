@@ -134,22 +134,15 @@ class Broker:
         in one reduce (BaseBrokerRequestHandler hybrid scatter)."""
         from ..engine.accounting import QueryKilledError
         from ..engine.serving import execute_planned, plan_segments
-        from .routing import split_hybrid, time_boundary
+        from .routing import (resolve_time_column, split_hybrid,
+                              time_boundary)
         logical = stmt.table
         off_dm = self.table(f"{logical}_OFFLINE")
-        self.quota.check(f"{logical}_OFFLINE")
 
-        time_col = None
         cfg = getattr(off_dm, "table_config", None)
-        if cfg is not None and getattr(cfg, "time_column", None):
-            time_col = cfg.time_column
-        if time_col is None:
-            from ..spi.schema import FieldType
-            schema = off_dm.schema
-            for f in getattr(schema, "fields", []):
-                if f.field_type == FieldType.DATE_TIME:
-                    time_col = f.name
-                    break
+        time_col = resolve_time_column(
+            {"timeColumn": getattr(cfg, "time_column", None)}
+            if cfg is not None else None, off_dm.schema)
         if time_col is None:
             raise SqlError(
                 f"hybrid table {logical!r} needs a timeColumn in its "
@@ -167,7 +160,9 @@ class Broker:
 
         off_stmt, rt_stmt = split_hybrid(stmt, time_col, boundary)
         if stmt.explain:
+            # _execute_stmt charges the quota for the explain itself
             return self._execute_stmt(off_stmt, t0)
+        self.quota.check(f"{logical}_OFFLINE")
         partials: List[Any] = []
         n_segments = pruned = docs = 0
         try:

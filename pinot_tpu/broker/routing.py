@@ -271,6 +271,25 @@ def make_selector(kind: str):
 # hybrid-table time boundary
 # ---------------------------------------------------------------------------
 
+def resolve_time_column(config: Optional[Dict[str, Any]], schema: Any
+                        ) -> Optional[str]:
+    """Table time column: explicit timeColumn config, else the schema's
+    first DATE_TIME field. Accepts a schema dict ({"fields": [...]}) or a
+    Schema object — shared by the in-process and HTTP brokers."""
+    if config and config.get("timeColumn"):
+        return config["timeColumn"]
+    fields = (schema or {}).get("fields", []) if isinstance(schema, dict) \
+        else getattr(schema, "fields", [])
+    for f in fields:
+        if isinstance(f, dict):
+            if f.get("fieldType") == "DATE_TIME":
+                return f.get("name")
+        elif getattr(getattr(f, "field_type", None), "value", None) \
+                == "DATE_TIME":
+            return f.name
+    return None
+
+
 def time_boundary(offline_segment_meta: Dict[str, Dict[str, Any]],
                   time_col: str) -> Optional[Any]:
     """Max end time across offline segments (TimeBoundaryManager: the

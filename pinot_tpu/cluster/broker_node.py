@@ -130,18 +130,23 @@ class BrokerNode:
         with self._lock:
             return self._routing
 
-    def _table_config(self, table: str) -> Dict[str, Any]:
-        snap = self._snapshot()
+    def _table_config(self, table: str,
+                      snap: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+        snap = snap if snap is not None else self._snapshot()
         return (snap.get("tables", {}).get(table) or {}).get("config") or {}
 
-    def _segment_meta(self, table: str) -> Dict[str, Any]:
-        snap = self._snapshot()
+    def _segment_meta(self, table: str,
+                      snap: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+        snap = snap if snap is not None else self._snapshot()
         return {s: (e or {}).get("meta")
                 for s, e in (snap.get("segments", {}).get(table)
                              or {}).items()}
 
-    def _check_quota(self, table: str) -> None:
-        qps = self._table_config(table).get("quotaQps")
+    def _check_quota(self, table: str,
+                     snap: Optional[Dict[str, Any]] = None) -> None:
+        qps = self._table_config(table, snap).get("quotaQps")
         self._quota.set_quota(table, qps)
         self._quota.check(table)
 
@@ -156,55 +161,54 @@ class BrokerNode:
                            "plane arrive with the dispatch stage; use the "
                            "in-process broker for them")
 
-        # hybrid table: logical name fans out to _OFFLINE + _REALTIME with
-        # the time boundary applied (TimeBoundaryManager analog)
-        snap_tables = self._snapshot().get("tables", {})
+        # one snapshot for the whole query: hybrid detection, quota, time
+        # boundary, pruning, and scatter must agree on routing state (the
+        # refresh thread swaps self._routing underneath)
+        snap = self._snapshot()
+        snap_tables = snap.get("tables", {})
         if stmt.table not in snap_tables and \
                 f"{stmt.table}_OFFLINE" in snap_tables and \
                 f"{stmt.table}_REALTIME" in snap_tables:
-            return self._query_hybrid(stmt, t0)
+            return self._query_hybrid(stmt, t0, snap)
 
-        self._check_quota(stmt.table)
+        self._check_quota(stmt.table, snap)
         ctx = build_query_context(stmt)
         if stmt.explain:
             return self._explain_remote(sql, ctx.table)
-        partials, queried, pruned = self._scatter(sql, ctx)
+        partials, queried, pruned = self._scatter(sql, ctx, snap)
         result = reduce_partials(ctx, partials)
         result.num_segments = queried
         result.num_segments_pruned = pruned
         result.time_ms = (time.perf_counter() - t0) * 1e3
         return result
 
-    def _query_hybrid(self, stmt, t0: float) -> ResultTable:
-        from ..broker.routing import split_hybrid, time_boundary
+    def _query_hybrid(self, stmt, t0: float,
+                      snap: Dict[str, Any]) -> ResultTable:
+        from ..broker.routing import (resolve_time_column, split_hybrid,
+                                      time_boundary)
         logical = stmt.table
-        self._check_quota(f"{logical}_OFFLINE")
-        tc = self._table_config(f"{logical}_OFFLINE")
-        time_col = tc.get("timeColumn")
-        if not time_col:
-            schema = (self._snapshot().get("tables", {})
-                      .get(f"{logical}_OFFLINE") or {}).get("schema") or {}
-            for f in schema.get("fields", []):
-                if f.get("fieldType") == "DATE_TIME":
-                    time_col = f.get("name")
-                    break
+        off_table = f"{logical}_OFFLINE"
+        time_col = resolve_time_column(
+            self._table_config(off_table, snap),
+            (snap.get("tables", {}).get(off_table) or {}).get("schema"))
         if not time_col:
             raise SqlError(
                 f"hybrid table {logical!r} needs a timeColumn in its "
                 f"config or a DATE_TIME schema field")
         boundary = time_boundary(
-            self._segment_meta(f"{logical}_OFFLINE"), time_col)
+            self._segment_meta(off_table, snap), time_col)
         if boundary is None:
             raise SqlError(f"hybrid table {logical!r}: offline segments "
                            f"lack {time_col!r} metadata for the boundary")
         off, rt = split_hybrid(stmt, time_col, boundary)
         if stmt.explain:
             return self._explain_remote("EXPLAIN " + to_sql(off), off.table)
+        self._check_quota(off_table, snap)
         partials: List[Any] = []
         queried = pruned = 0
         for part_stmt in (off, rt):
             ctx_p = build_query_context(part_stmt)
-            p, q, pr = self._scatter(to_sql(part_stmt), ctx_p)
+            p, q, pr = self._scatter(to_sql(part_stmt), ctx_p, snap)
             partials.extend(p)
             queried += q
             pruned += pr
@@ -238,11 +242,14 @@ class BrokerNode:
                                    [tuple(r) for r in exp.get("rows", [])])
         raise SqlError("no live replica to explain against")
 
-    def _scatter(self, sql: str, ctx) -> Tuple[List[Any], int, int]:
+    def _scatter(self, sql: str, ctx,
+                 snap: Optional[Dict[str, Any]] = None
+                 ) -> Tuple[List[Any], int, int]:
         # one snapshot for assignment + segment metadata: the refresh
         # thread swaps self._routing, and mixing two snapshots could
         # silently drop segments assigned in one but absent in the other
-        snap = self._snapshot()
+        if snap is None:
+            snap = self._snapshot()
         assignment = snap.get("assignment", {}).get(ctx.table)
         if assignment is None:
             raise SqlError(f"table {ctx.table!r} not found in routing")

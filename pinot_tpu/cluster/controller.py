@@ -41,15 +41,29 @@ class Controller:
         self._state: Dict[str, Any] = self._load() or {
             "version": 0,
             "tables": {},      # name -> {schema, config, replication}
-            "segments": {},    # table -> {segment -> {location}}
+            "segments": {},    # table -> {segment -> {location, meta}}
             "assignment": {},  # table -> {segment -> [instance ids]}
+            "lineage": {},     # table -> [{id, from, to, state}]
         }
+        self._state.setdefault("lineage", {})
         self._instances: Dict[str, Dict[str, Any]] = {}  # ephemeral
+        self._status: Dict[str, Any] = {}
         self._stop = threading.Event()
+        # periodic controller tasks (BaseControllerStarter.java:174-191);
+        # built before the HTTP server binds so /periodictask/* never sees
+        # a half-constructed controller
+        from .periodic import BasePeriodicTask, PeriodicTaskScheduler
+        self.scheduler = PeriodicTaskScheduler()
+        self.scheduler.register(BasePeriodicTask(
+            "RetentionManager", interval_s=60.0, fn=self.run_retention))
+        self.scheduler.register(BasePeriodicTask(
+            "SegmentStatusChecker", interval_s=30.0,
+            fn=self.run_status_check))
         self._httpd, self.port, _ = start_http(self._make_handler(), port)
         self._recon = threading.Thread(target=self._reconcile_loop,
                                        daemon=True)
         self._recon.start()
+        self.scheduler.start()
 
     # -- property store ----------------------------------------------------
     def _path(self) -> str:
@@ -87,12 +101,20 @@ class Controller:
             inst["lastHeartbeat"] = time.monotonic()
             return True
 
-    def live_servers(self) -> List[str]:
+    def live_servers(self, tenant: Optional[str] = None) -> List[str]:
+        """Live server instances; with tenant, only instances carrying
+        that tag (tag-based tenant isolation, controller tenant mgmt)."""
         now = time.monotonic()
-        return sorted(
-            i["id"] for i in self._instances.values()
-            if i.get("role") == "server"
-            and now - i["lastHeartbeat"] <= self.heartbeat_timeout)
+        out = []
+        for i in self._instances.values():
+            if i.get("role") != "server":
+                continue
+            if now - i["lastHeartbeat"] > self.heartbeat_timeout:
+                continue
+            if tenant is not None and tenant not in (i.get("tags") or []):
+                continue
+            out.append(i["id"])
+        return sorted(out)
 
     # -- tables / segments -------------------------------------------------
     def add_table(self, name: str, schema: Dict[str, Any],
@@ -108,7 +130,7 @@ class Controller:
 
     def drop_table(self, name: str) -> None:
         with self._lock:
-            for key in ("tables", "segments", "assignment"):
+            for key in ("tables", "segments", "assignment", "lineage"):
                 self._state[key].pop(name, None)
             self._bump()
 
@@ -153,18 +175,24 @@ class Controller:
             with self._lock:
                 self._reconcile_locked()
 
+    def _table_tenant(self, table: str) -> Optional[str]:
+        cfg = self._state["tables"].get(table, {}).get("config") or {}
+        return cfg.get("serverTenant")
+
     def _reconcile_locked(self) -> None:
-        """Converge assignment: each segment on `replication` live servers,
-        minimal movement (TableRebalancer analog at small scale)."""
-        live = self.live_servers()
+        """Converge assignment: each segment on `replication` live servers
+        of the table's tenant, minimal movement (TableRebalancer analog at
+        small scale)."""
         changed = False
-        load: Dict[str, int] = {s: 0 for s in live}
+        all_live = self.live_servers()
+        load: Dict[str, int] = {s: 0 for s in all_live}
         for table, segs in self._state["assignment"].items():
             for seg, holders in segs.items():
                 for h in holders:
                     if h in load:
                         load[h] += 1
         for table, tmeta in self._state["tables"].items():
+            live = self.live_servers(self._table_tenant(table))
             repl = min(tmeta.get("replication", 1), max(len(live), 1))
             assign = self._state["assignment"].setdefault(table, {})
             for seg in self._state["segments"].get(table, {}):
@@ -173,14 +201,197 @@ class Controller:
                     candidates = [s for s in live if s not in holders]
                     if not candidates:
                         break
-                    pick = min(candidates, key=lambda s: load[s])
+                    pick = min(candidates, key=lambda s: load.get(s, 0))
                     holders.append(pick)
-                    load[pick] += 1
+                    load[pick] = load.get(pick, 0) + 1
                 if assign.get(seg) != holders:
                     assign[seg] = holders
                     changed = True
         if changed:
             self._bump()
+
+    # -- rebalance (TableRebalancer analog) --------------------------------
+    def rebalance(self, table: str, dry_run: bool = False,
+                  replication: Optional[int] = None) -> Dict[str, Any]:
+        """Recompute a balanced assignment with minimal movement: keep
+        surviving replicas, move only what load-balance requires. Returns
+        the before/after diff (rebalance observer analog); applies unless
+        dry_run."""
+        with self._lock:
+            if table not in self._state["tables"]:
+                raise KeyError(f"table {table!r} not registered")
+            live = self.live_servers(self._table_tenant(table))
+            if not live:
+                return {"status": "NO_SERVERS", "table": table}
+            if replication is None:
+                replication = self._state["tables"][table].get(
+                    "replication", 1)
+            elif not dry_run:
+                # a dry run must not change cluster state
+                self._state["tables"][table]["replication"] = replication
+            repl = min(replication, len(live))
+            segs = sorted(self._state["segments"].get(table, {}))
+            current = {s: list(self._state["assignment"]
+                               .get(table, {}).get(s, []))
+                       for s in segs}
+            # target load per server for THIS table
+            total = len(segs) * repl
+            cap = -(-total // len(live))  # ceil
+            load = {s: 0 for s in live}
+            target: Dict[str, List[str]] = {}
+            moved = 0
+            # pass 1: keep current holders that are live and under cap
+            for seg in segs:
+                kept = []
+                for h in current[seg]:
+                    if h in load and load[h] < cap and len(kept) < repl:
+                        kept.append(h)
+                        load[h] += 1
+                target[seg] = kept
+            # pass 2: top up from least-loaded
+            for seg in segs:
+                while len(target[seg]) < repl:
+                    cands = [s for s in live if s not in target[seg]]
+                    if not cands:
+                        break
+                    pick = min(cands, key=lambda s: load[s])
+                    target[seg].append(pick)
+                    load[pick] += 1
+                    if pick not in current[seg]:
+                        moved += 1
+            result = {
+                "status": "DRY_RUN" if dry_run else "DONE",
+                "table": table,
+                "segmentsMoved": moved,
+                "numSegments": len(segs),
+                "replication": repl,
+                "serverLoad": load,
+            }
+            if not dry_run:
+                if self._state["assignment"].get(table) != target:
+                    self._state["assignment"][table] = target
+                    self._bump()
+            return result
+
+    # -- retention (RetentionManager analog) -------------------------------
+    _UNIT_MS = {"MILLISECONDS": 1, "SECONDS": 1_000, "MINUTES": 60_000,
+                "HOURS": 3_600_000, "DAYS": 86_400_000}
+
+    def run_retention(self) -> None:
+        """Drop segments older than the table's retention, judged by the
+        time column's max value in segment metadata."""
+        now_ms = time.time() * 1e3
+        with self._lock:
+            changed = False
+            for table, tmeta in list(self._state["tables"].items()):
+                cfg = tmeta.get("config") or {}
+                value = cfg.get("retentionValue")
+                tcol = cfg.get("timeColumn")
+                if not value or not tcol:
+                    continue
+                unit_ms = self._UNIT_MS.get(
+                    str(cfg.get("retentionUnit", "DAYS")).upper(), 86_400_000)
+                tcol_ms = self._UNIT_MS.get(
+                    str(cfg.get("timeUnit", "MILLISECONDS")).upper(), 1)
+                cutoff_ms = now_ms - float(value) * unit_ms
+                for seg, entry in list(
+                        self._state["segments"].get(table, {}).items()):
+                    cm = ((entry.get("meta") or {}).get("columns")
+                          or {}).get(tcol)
+                    if cm is None or cm.get("max") is None:
+                        continue
+                    if float(cm["max"]) * tcol_ms < cutoff_ms:
+                        self._state["segments"][table].pop(seg, None)
+                        self._state["assignment"].get(table, {}).pop(
+                            seg, None)
+                        changed = True
+            if changed:
+                self._bump()
+
+    # -- status checker (SegmentStatusChecker analog) ----------------------
+    def run_status_check(self) -> None:
+        with self._lock:
+            live = set(self.live_servers())
+            out: Dict[str, Any] = {}
+            for table, tmeta in self._state["tables"].items():
+                repl = tmeta.get("replication", 1)
+                segs = self._state["segments"].get(table, {})
+                assign = self._state["assignment"].get(table, {})
+                unassigned = sum(
+                    1 for s in segs
+                    if not [h for h in assign.get(s, []) if h in live])
+                under = sum(
+                    1 for s in segs
+                    if 0 < len([h for h in assign.get(s, []) if h in live])
+                    < repl)
+                out[table] = {
+                    "numSegments": len(segs),
+                    "numUnassigned": unassigned,
+                    "numUnderReplicated": under,
+                    "healthy": unassigned == 0,
+                }
+            self._status = out
+
+    # -- segment lineage (replace/merge atomicity) -------------------------
+    def start_replace_segments(self, table: str, from_segs: List[str],
+                               to_segs: List[str]) -> str:
+        """Begin an atomic segment swap (SegmentLineage IN_PROGRESS):
+        the new segments stay invisible to routing until the end call."""
+        import uuid as _uuid
+        with self._lock:
+            if table not in self._state["tables"]:
+                raise KeyError(f"table {table!r} not registered")
+            entry_id = _uuid.uuid4().hex[:12]
+            self._state["lineage"].setdefault(table, []).append({
+                "id": entry_id, "from": list(from_segs),
+                "to": list(to_segs), "state": "IN_PROGRESS",
+            })
+            self._bump()
+            return entry_id
+
+    def end_replace_segments(self, table: str, entry_id: str) -> None:
+        """Flip the lineage entry to COMPLETED: new segments become
+        routable, replaced ones are removed, atomically (one version
+        bump). Removal (not permanent name exclusion) keeps replaced
+        segment names reusable by later uploads."""
+        with self._lock:
+            for e in self._state["lineage"].get(table, []):
+                if e["id"] == entry_id and e["state"] == "IN_PROGRESS":
+                    e["state"] = "COMPLETED"
+                    for seg in e["from"]:
+                        self._state["segments"].get(table, {}).pop(seg,
+                                                                   None)
+                        self._state["assignment"].get(table, {}).pop(
+                            seg, None)
+                    self._reconcile_locked()
+                    self._bump()
+                    return
+            raise KeyError(f"no IN_PROGRESS lineage entry {entry_id!r}")
+
+    def revert_replace_segments(self, table: str, entry_id: str) -> None:
+        with self._lock:
+            lin = self._state["lineage"].get(table, [])
+            for e in lin:
+                if e["id"] == entry_id and e["state"] == "IN_PROGRESS":
+                    e["state"] = "REVERTED"
+                    for seg in e["to"]:
+                        self._state["segments"].get(table, {}).pop(seg, None)
+                        self._state["assignment"].get(table, {}).pop(
+                            seg, None)
+                    self._bump()
+                    return
+            raise KeyError(f"no IN_PROGRESS lineage entry {entry_id!r}")
+
+    def _excluded_segments(self, table: str) -> set:
+        """Segments hidden from routing by lineage state. Only IN_PROGRESS
+        "to" segments are hidden (resident on servers but not routable
+        until the flip); COMPLETED/REVERTED entries already removed their
+        dead segments, so finished entries never blacklist a name."""
+        out: set = set()
+        for e in self._state["lineage"].get(table, []):
+            if e["state"] == "IN_PROGRESS":
+                out.update(e["to"])
+        return out
 
     # -- views -------------------------------------------------------------
     def routing_snapshot(self) -> Dict[str, Any]:
@@ -192,15 +403,26 @@ class Controller:
                     self._state["version"]:
                 snap = dict(cached)
             else:
+                assignment = json.loads(json.dumps(
+                    self._state["assignment"]))
+                segments = json.loads(json.dumps(self._state["segments"]))
+                for table in list(assignment):
+                    hidden = self._excluded_segments(table)
+                    if hidden:
+                        assignment[table] = {
+                            s: h for s, h in assignment[table].items()
+                            if s not in hidden}
+                        segments[table] = {
+                            s: e for s, e in segments.get(table,
+                                                          {}).items()
+                            if s not in hidden}
                 snap = {
                     "version": self._state["version"],
                     "tables": {
                         t: {"schema": m["schema"], "config": m["config"]}
                         for t, m in self._state["tables"].items()},
-                    "assignment": json.loads(json.dumps(
-                        self._state["assignment"])),
-                    "segments": json.loads(json.dumps(
-                        self._state["segments"])),
+                    "assignment": assignment,
+                    "segments": segments,
                 }
                 self._routing_cache = snap
                 snap = dict(snap)
@@ -216,10 +438,16 @@ class Controller:
         with self._lock:
             out: Dict[str, Dict[str, str]] = {}
             for table, segs in self._state["assignment"].items():
+                # servers DO load IN_PROGRESS lineage "to" segments (they
+                # must be resident before the atomic flip makes them
+                # routable); replaced/reverted segments are already gone
+                # from the assignment itself
                 for seg, holders in segs.items():
-                    if instance_id in holders:
-                        loc = self._state["segments"][table][seg]["location"]
-                        out.setdefault(table, {})[seg] = loc
+                    if instance_id not in holders:
+                        continue
+                    entry = self._state["segments"][table].get(seg)
+                    if entry is not None:
+                        out.setdefault(table, {})[seg] = entry["location"]
             return {"version": self._state["version"], "tables": out,
                     "schemas": {t: m["schema"] for t, m in
                                 self._state["tables"].items()}}
@@ -253,11 +481,34 @@ class Controller:
                     200, ctrl.routing_snapshot()),
                 ("GET", "/assignments/"): lambda h, b: (
                     200, ctrl.server_assignment(h.path.rsplit("/", 1)[1])),
+                ("POST", "/rebalance/"): lambda h, b: (
+                    200, ctrl.rebalance(
+                        h.path.rsplit("/", 1)[1],
+                        dry_run=bool((b or {}).get("dryRun")),
+                        replication=(b or {}).get("replication"))),
+                ("POST", "/lineage/start"): lambda h, b: (
+                    200, {"entryId": ctrl.start_replace_segments(
+                        b["table"], b["from"], b["to"])}),
+                ("POST", "/lineage/end"): lambda h, b: (
+                    ctrl.end_replace_segments(b["table"], b["entryId"])
+                    or (200, {"status": "OK"})),
+                ("POST", "/lineage/revert"): lambda h, b: (
+                    ctrl.revert_replace_segments(b["table"], b["entryId"])
+                    or (200, {"status": "OK"})),
+                ("POST", "/periodictask/run/"): lambda h, b: (
+                    (200, {"status": "OK"})
+                    if ctrl.scheduler.trigger(h.path.rsplit("/", 1)[1])
+                    else (404, {"error": "unknown task"})),
+                ("GET", "/periodictask/status"): lambda h, b: (
+                    200, {"tasks": ctrl.scheduler.status()}),
+                ("GET", "/status"): lambda h, b: (
+                    ctrl.run_status_check() or (200, ctrl._status)),
             }
         return Handler
 
     def stop(self) -> None:
         self._stop.set()
+        self.scheduler.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 

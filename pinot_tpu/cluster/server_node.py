@@ -30,10 +30,12 @@ from .http_util import JsonHandler, http_json, start_http
 class ServerNode:
     def __init__(self, instance_id: str, controller_url: str, port: int = 0,
                  poll_interval: float = 0.3,
-                 scheduler_config: Optional[Dict[str, Any]] = None):
+                 scheduler_config: Optional[Dict[str, Any]] = None,
+                 tags: Optional[List[str]] = None):
         self.instance_id = instance_id
         self.controller_url = controller_url
         self.poll_interval = poll_interval
+        self.tags = list(tags or [])  # tenant tags (Helix instance tags)
         # admission + ordering for concurrent HTTP queries
         # (QuerySchedulerFactory analog; fcfs by default)
         self.scheduler = make_scheduler(scheduler_config)
@@ -59,7 +61,7 @@ class ServerNode:
     def _register(self) -> None:
         http_json("POST", f"{self.controller_url}/instances", {
             "id": self.instance_id, "host": "127.0.0.1",
-            "port": self.port, "role": "server"})
+            "port": self.port, "role": "server", "tags": self.tags})
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
