@@ -188,6 +188,7 @@ class BrokerNode:
                                       time_boundary)
         logical = stmt.table
         off_table = f"{logical}_OFFLINE"
+        self._check_quota(off_table, snap)  # charges EXPLAIN too
         time_col = resolve_time_column(
             self._table_config(off_table, snap),
             (snap.get("tables", {}).get(off_table) or {}).get("schema"))
@@ -203,7 +204,6 @@ class BrokerNode:
         off, rt = split_hybrid(stmt, time_col, boundary)
         if stmt.explain:
             return self._explain_remote("EXPLAIN " + to_sql(off), off.table)
-        self._check_quota(off_table, snap)
         partials: List[Any] = []
         queried = pruned = 0
         for part_stmt in (off, rt):

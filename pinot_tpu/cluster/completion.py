@@ -1,0 +1,200 @@
+"""Segment completion protocol: controller-arbitrated realtime commit.
+
+Reference parity: pinot-common/.../protocols/SegmentCompletionProtocol.java
+:77-122 (message types HOLD / CATCHUP / COMMIT / COMMIT_CONTINUE /
+COMMIT_SUCCESS / FAILED, split-commit) + pinot-controller/.../realtime/
+SegmentCompletionManager.java (the FSM electing exactly one committer per
+consuming segment among its replicas).
+
+Flow per (table, segment):
+    replicas hit their row/time threshold -> POST segmentConsumed(offset)
+    controller HOLDs until every expected replica reported or the
+    decision window elapses, then elects the largest offset:
+        winner   -> COMMIT  (commit at target offset)
+        laggards -> CATCHUP (consume to target, report again, then HOLD)
+    winner: segmentCommitStart -> build + upload to deep store ->
+            segmentCommitEnd(downloadURI) -> controller registers the
+            segment (atomic version bump) -> COMMIT_SUCCESS
+    other replicas' next segmentConsumed -> COMMITTED + downloadURI
+    (they discard their consuming state and download — peer/deep-store
+    download path).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+HOLD = "HOLD"
+CATCHUP = "CATCHUP"
+COMMIT = "COMMIT"
+COMMIT_CONTINUE = "COMMIT_CONTINUE"
+COMMIT_SUCCESS = "COMMIT_SUCCESS"
+COMMITTED = "COMMITTED"
+FAILED = "FAILED"
+
+
+class SegmentCompletionManager:
+    def __init__(self, expected_replicas: Callable[[str], int],
+                 decision_window_s: float = 0.5,
+                 commit_timeout_s: float = 30.0,
+                 committed_ttl_s: float = 300.0):
+        """expected_replicas: table -> how many replicas consume each
+        segment (the controller's replication for the table).
+        committed_ttl_s bounds FSM memory: COMMITTED entries are purged
+        after laggards have had that long to fetch the downloadURI (they
+        fall back to the controller's segment registry afterwards)."""
+        self._expected = expected_replicas
+        self.decision_window_s = decision_window_s
+        self.commit_timeout_s = commit_timeout_s
+        self.committed_ttl_s = committed_ttl_s
+        self._lock = threading.Lock()
+        self._fsm: Dict[Tuple[str, str], Dict[str, Any]] = {}
+
+    def _purge_locked(self) -> None:
+        now = time.monotonic()
+        dead = [k for k, e in self._fsm.items()
+                if e["state"] == "COMMITTED" and e.get("commit_ts")
+                and now - e["commit_ts"] > self.committed_ttl_s]
+        for k in dead:
+            del self._fsm[k]
+
+    def drop_table(self, table: str) -> None:
+        with self._lock:
+            for k in [k for k in self._fsm if k[0] == table]:
+                del self._fsm[k]
+
+    def _entry(self, table: str, segment: str) -> Dict[str, Any]:
+        key = (table, segment)
+        if key not in self._fsm:
+            self._fsm[key] = {"state": "HOLDING", "offsets": {},
+                              "first_ts": time.monotonic(),
+                              "winner": None, "target": None,
+                              "download_uri": None, "commit_ts": None}
+        return self._fsm[key]
+
+    def segment_consumed(self, table: str, segment: str, server: str,
+                         offset: int) -> Dict[str, Any]:
+        with self._lock:
+            self._purge_locked()
+            e = self._entry(table, segment)
+            if e["state"] == "COMMITTED":
+                return {"status": COMMITTED,
+                        "downloadURI": e["download_uri"],
+                        "offset": e["target"]}
+            if e["state"] == "COMMITTING":
+                if server == e["winner"]:
+                    # winner re-reporting (e.g. after restart): carry on
+                    return {"status": COMMIT, "offset": e["target"]}
+                # a committer died? allow takeover after timeout
+                if time.monotonic() - (e["commit_ts"] or 0) \
+                        > self.commit_timeout_s:
+                    e["offsets"][server] = offset
+                    return self._elect(table, e, server, takeover=True)
+                return {"status": HOLD}
+            e["offsets"][server] = max(offset,
+                                       e["offsets"].get(server, offset))
+            expected = max(self._expected(table), 1)
+            window_over = (time.monotonic() - e["first_ts"]
+                           >= self.decision_window_s)
+            if len(e["offsets"]) >= expected or window_over:
+                return self._elect(table, e, server)
+            return {"status": HOLD}
+
+    def _elect(self, table: str, e: Dict[str, Any], server: str,
+               takeover: bool = False) -> Dict[str, Any]:
+        """Pick the committer: the largest reported offset (ties: first
+        reporter). Laggards catch up to the target; the winner commits."""
+        if e["target"] is None or takeover:
+            cands = dict(e["offsets"])
+            if takeover and len(cands) > 1:
+                cands.pop(e["winner"], None)  # the stalled committer
+            winner = max(cands, key=lambda s: (cands[s],))
+            e["winner"] = winner
+            e["target"] = max(e["target"] or 0, cands[winner])
+            if takeover:
+                e["state"] = "HOLDING"
+        if server == e["winner"] and \
+                e["offsets"].get(server, -1) >= e["target"]:
+            # the winner may have consumed past the elected target while
+            # holding; commit everything it has so the artifact's end
+            # offset and the adopters' resume offset agree (no duplicate
+            # re-consumption on the laggards)
+            e["target"] = e["offsets"][server]
+            e["state"] = "COMMITTING"
+            e["commit_ts"] = time.monotonic()
+            return {"status": COMMIT, "offset": e["target"]}
+        if e["offsets"].get(server, -1) < e["target"]:
+            return {"status": CATCHUP, "offset": e["target"]}
+        return {"status": HOLD}
+
+    def segment_commit_start(self, table: str, segment: str, server: str
+                             ) -> Dict[str, Any]:
+        with self._lock:
+            e = self._fsm.get((table, segment))
+            if e is None or e["state"] != "COMMITTING" or \
+                    e["winner"] != server:
+                return {"status": FAILED}
+            e["commit_ts"] = time.monotonic()
+            return {"status": COMMIT_CONTINUE}
+
+    def segment_commit_end(self, table: str, segment: str, server: str,
+                           download_uri: str,
+                           register: Callable[[], None]) -> Dict[str, Any]:
+        """register() runs under the FSM lock — the segment-metadata write
+        and the COMMITTED flip are atomic with respect to replica polls."""
+        with self._lock:
+            e = self._fsm.get((table, segment))
+            if e is None or e["state"] != "COMMITTING" or \
+                    e["winner"] != server:
+                return {"status": FAILED}
+            register()
+            e["state"] = "COMMITTED"
+            e["download_uri"] = download_uri
+            e["commit_ts"] = time.monotonic()  # TTL purge baseline
+            return {"status": COMMIT_SUCCESS}
+
+    def status(self, table: str, segment: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            e = self._fsm.get((table, segment))
+            return dict(e) if e else None
+
+
+class CompletionClient:
+    """Server-side protocol client: reports thresholds and runs the
+    split-commit against the controller REST API (the server half of
+    SegmentCompletionProtocol — ServerSegmentCompletionProtocolHandler
+    analog)."""
+
+    def __init__(self, controller_url: str, server_id: str,
+                 deepstore_uri: str):
+        self.controller_url = controller_url
+        self.server_id = server_id
+        self.deepstore_uri = deepstore_uri
+
+    def segment_consumed(self, table: str, segment: str, offset: int
+                         ) -> Dict[str, Any]:
+        from .http_util import http_json
+        return http_json("POST", f"{self.controller_url}/segmentConsumed",
+                         {"table": table, "segment": segment,
+                          "server": self.server_id, "offset": offset})
+
+    def split_commit(self, table: str, segment: str, seg_dir: str,
+                     metadata: Optional[Dict[str, Any]] = None) -> bool:
+        """commitStart -> upload to deep store -> commitEnd. Returns True
+        on COMMIT_SUCCESS."""
+        from .deepstore import upload_segment
+        from .http_util import http_json
+        start = http_json("POST",
+                          f"{self.controller_url}/segmentCommitStart",
+                          {"table": table, "segment": segment,
+                           "server": self.server_id})
+        if start.get("status") != COMMIT_CONTINUE:
+            return False
+        uri = upload_segment(seg_dir,
+                             self.deepstore_uri.rstrip("/") + "/" + table)
+        end = http_json("POST", f"{self.controller_url}/segmentCommitEnd",
+                        {"table": table, "segment": segment,
+                         "server": self.server_id, "downloadURI": uri,
+                         "metadata": metadata})
+        return end.get("status") == COMMIT_SUCCESS

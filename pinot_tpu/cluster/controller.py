@@ -59,6 +59,11 @@ class Controller:
         self.scheduler.register(BasePeriodicTask(
             "SegmentStatusChecker", interval_s=30.0,
             fn=self.run_status_check))
+        # realtime commit arbitration (SegmentCompletionManager FSM)
+        from .completion import SegmentCompletionManager
+        self.completion = SegmentCompletionManager(
+            expected_replicas=lambda t: self._state["tables"]
+            .get(t, {}).get("replication", 1))
         self._httpd, self.port, _ = start_http(self._make_handler(), port)
         self._recon = threading.Thread(target=self._reconcile_loop,
                                        daemon=True)
@@ -128,29 +133,34 @@ class Controller:
             self._state["assignment"].setdefault(name, {})
             self._bump()
 
+    @staticmethod
+    def _delete_artifact(location: Optional[str]) -> None:
+        """Best-effort deletion of a retired segment's bytes (local dir or
+        deep-store archive via PinotFS) — dropping only the metadata would
+        grow deep-store/disk unboundedly (RetentionManager deletes the
+        artifacts too)."""
+        if not location:
+            return
+        try:
+            from ..spi.filesystem import fs_for_uri
+            fs, path = fs_for_uri(location)
+            fs.delete(path, force=True)
+        except Exception:
+            pass  # unreachable store: metadata removal still wins
+
     def drop_table(self, name: str) -> None:
         with self._lock:
             for key in ("tables", "segments", "assignment", "lineage"):
                 self._state[key].pop(name, None)
+            self.completion.drop_table(name)
             self._bump()
 
     @staticmethod
     def _read_segment_meta(location: str) -> Optional[Dict[str, Any]]:
         """Pruning metadata from the segment dir (per-column min/max +
         partitions, ZK segment-metadata analog); None when unreadable."""
-        try:
-            with open(os.path.join(location, "metadata.json")) as fh:
-                m = json.load(fh)
-        except (OSError, ValueError):
-            return None
-        cols = {}
-        for name, cm in (m.get("columns") or {}).items():
-            entry = {k: cm[k] for k in ("min", "max", "partitions")
-                     if k in cm}
-            if entry:
-                cols[name] = entry
-        return {"columns": cols, "totalDocs": m.get("totalDocs"),
-                "numPartitions": m.get("numPartitions")}
+        from .deepstore import pruning_metadata
+        return pruning_metadata(location)
 
     def add_segment(self, table: str, segment: str, location: str,
                     metadata: Optional[Dict[str, Any]] = None) -> None:
@@ -301,9 +311,12 @@ class Controller:
                     if cm is None or cm.get("max") is None:
                         continue
                     if float(cm["max"]) * tcol_ms < cutoff_ms:
-                        self._state["segments"][table].pop(seg, None)
+                        entry = self._state["segments"][table].pop(
+                            seg, None)
                         self._state["assignment"].get(table, {}).pop(
                             seg, None)
+                        self._delete_artifact(
+                            (entry or {}).get("location"))
                         changed = True
             if changed:
                 self._bump()
@@ -359,10 +372,12 @@ class Controller:
                 if e["id"] == entry_id and e["state"] == "IN_PROGRESS":
                     e["state"] = "COMPLETED"
                     for seg in e["from"]:
-                        self._state["segments"].get(table, {}).pop(seg,
-                                                                   None)
+                        entry = self._state["segments"].get(
+                            table, {}).pop(seg, None)
                         self._state["assignment"].get(table, {}).pop(
                             seg, None)
+                        self._delete_artifact(
+                            (entry or {}).get("location"))
                     self._reconcile_locked()
                     self._bump()
                     return
@@ -375,9 +390,12 @@ class Controller:
                 if e["id"] == entry_id and e["state"] == "IN_PROGRESS":
                     e["state"] = "REVERTED"
                     for seg in e["to"]:
-                        self._state["segments"].get(table, {}).pop(seg, None)
+                        entry = self._state["segments"].get(
+                            table, {}).pop(seg, None)
                         self._state["assignment"].get(table, {}).pop(
                             seg, None)
+                        self._delete_artifact(
+                            (entry or {}).get("location"))
                     self._bump()
                     return
             raise KeyError(f"no IN_PROGRESS lineage entry {entry_id!r}")
@@ -501,6 +519,20 @@ class Controller:
                     else (404, {"error": "unknown task"})),
                 ("GET", "/periodictask/status"): lambda h, b: (
                     200, {"tasks": ctrl.scheduler.status()}),
+                ("POST", "/segmentConsumed"): lambda h, b: (
+                    200, ctrl.completion.segment_consumed(
+                        b["table"], b["segment"], b["server"],
+                        int(b["offset"]))),
+                ("POST", "/segmentCommitStart"): lambda h, b: (
+                    200, ctrl.completion.segment_commit_start(
+                        b["table"], b["segment"], b["server"])),
+                ("POST", "/segmentCommitEnd"): lambda h, b: (
+                    200, ctrl.completion.segment_commit_end(
+                        b["table"], b["segment"], b["server"],
+                        b["downloadURI"],
+                        register=lambda: ctrl.add_segment(
+                            b["table"], b["segment"], b["downloadURI"],
+                            b.get("metadata")))),
                 ("GET", "/status"): lambda h, b: (
                     ctrl.run_status_check() or (200, ctrl._status)),
             }

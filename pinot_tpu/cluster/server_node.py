@@ -12,6 +12,7 @@ DataTable response analog.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -36,6 +37,9 @@ class ServerNode:
         self.controller_url = controller_url
         self.poll_interval = poll_interval
         self.tags = list(tags or [])  # tenant tags (Helix instance tags)
+        import tempfile
+        # local segment store for deep-store downloads (tar.gz locations)
+        self.data_dir = tempfile.mkdtemp(prefix=f"ptpu_{instance_id}_")
         # admission + ordering for concurrent HTTP queries
         # (QuerySchedulerFactory analog; fcfs by default)
         self.scheduler = make_scheduler(scheduler_config)
@@ -87,11 +91,25 @@ class ServerNode:
             for seg_name, location in segs.items():
                 if seg_name not in have:
                     try:
+                        # deep-store location: download + untar, then load
+                        # (onBecomeOnlineFromOffline download path)
+                        from .deepstore import (download_segment,
+                                                is_deepstore_uri)
+                        if is_deepstore_uri(location):
+                            location = download_segment(
+                                location,
+                                os.path.join(self.data_dir, table))
                         dm.add_segment(ImmutableSegment.load(location))
                     except Exception:
                         ok = False
             for seg_name in have - set(segs):
                 dm.remove_segment(seg_name)
+                # reclaim the local deep-store download, if any (mmaps of
+                # in-flight queries survive the unlink)
+                local = os.path.join(self.data_dir, table, seg_name)
+                if os.path.isdir(local):
+                    import shutil
+                    shutil.rmtree(local, ignore_errors=True)
         for table in list(self._tables):
             if table not in a["tables"]:
                 del self._tables[table]

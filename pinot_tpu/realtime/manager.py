@@ -53,13 +53,19 @@ class RealtimeTableDataManager(TableDataManager):
                  stream_config: StreamConfig, data_dir: str,
                  table_config: Optional[TableConfig] = None,
                  poll_interval: float = 0.02,
-                 upsert_config=None, dedup_config=None):
+                 upsert_config=None, dedup_config=None,
+                 completion_client=None):
         super().__init__(table_name)
         self.schema = schema
         self.stream_config = stream_config
         self.table_config = table_config or TableConfig(table_name)
         self.data_dir = data_dir
         self.poll_interval = poll_interval
+        # controller-arbitrated commit (cluster.completion.CompletionClient);
+        # None = standalone mode, seal locally without arbitration
+        self.completion_client = completion_client
+        self._last_report: Dict[int, float] = {}
+        self.report_interval_s = 0.05
         os.makedirs(data_dir, exist_ok=True)
 
         self._mutables: Dict[int, MutableSegment] = {}
@@ -219,45 +225,138 @@ class RealtimeTableDataManager(TableDataManager):
         m = self._mutables[p]
         cfg = self.stream_config
         age = time.monotonic() - self._mutable_age[p]
-        if m.n_docs >= cfg.flush_threshold_rows or (
-                m.n_docs > 0 and age >= cfg.flush_threshold_seconds):
+        if not (m.n_docs >= cfg.flush_threshold_rows or (
+                m.n_docs > 0 and age >= cfg.flush_threshold_seconds)):
+            return
+        if self.completion_client is None:
             self.seal_partition(p)
+        else:
+            self._protocol_seal(p)
 
-    def seal_partition(self, p: int) -> Optional[ImmutableSegment]:
-        """CONSUMING -> ONLINE: build, swap, checkpoint."""
+    def _protocol_seal(self, p: int) -> None:
+        """Controller-arbitrated commit (SegmentCompletionProtocol client
+        side): report the threshold, then act on the controller's verdict
+        — COMMIT: build + split-commit; CATCHUP: keep consuming; HOLD:
+        wait; COMMITTED: another replica won, download its artifact and
+        resume from its end offset."""
+        now = time.monotonic()
+        if now - self._last_report.get(p, 0.0) < self.report_interval_s:
+            return
+        self._last_report[p] = now
+        cc = self.completion_client
+        st = self._partition_state(p)
+        m = self._mutables[p]
+        name = m.name
+        offset = st["next_offset"] + m.n_docs
+        try:
+            resp = cc.segment_consumed(self.table_name, name, offset)
+        except Exception:
+            return  # controller unreachable: report again next poll;
+            # a network blip must never kill the consumer thread
+        status = resp.get("status")
+        if status == "COMMIT":
+            # build-then-commit-then-adopt: local durable state advances
+            # ONLY after the controller acknowledged the split commit —
+            # a failed commit leaves the mutable live for retry/takeover
+            with self._seal_lock:
+                built = self._build_artifact(p)
+                if built is None:
+                    return
+                mm, seg, sealed = built
+                ok = False
+                try:
+                    from ..cluster.deepstore import pruning_metadata
+                    ok = cc.split_commit(self.table_name, name, seg.dir,
+                                         pruning_metadata(seg.dir))
+                except Exception:
+                    ok = False
+                if ok:
+                    self._commit_local(p, mm, seg, sealed)
+                else:
+                    import shutil
+                    shutil.rmtree(seg.dir, ignore_errors=True)
+        elif status == "COMMITTED":
+            try:
+                self._adopt_committed(p, name, resp["downloadURI"],
+                                      int(resp["offset"]))
+            except Exception:
+                pass  # deep store unreachable: retry on the next poll
+        # CATCHUP / HOLD: keep consuming / report again next poll
+
+    def _adopt_committed(self, p: int, name: str, download_uri: str,
+                         end_offset: int) -> None:
+        """A peer replica committed this segment: drop the local consuming
+        state, download the canonical artifact, resume after it (the
+        non-winner CONSUMING->ONLINE transition with deep-store
+        download)."""
+        from ..cluster.deepstore import download_segment
         with self._seal_lock:
-            m = self._mutables[p]
-            if m.n_docs == 0:
-                return None
             st = self._partition_state(p)
-            seg_dir = m.seal(self.data_dir)
-            sealed = m.sealed_docs  # NOT m.n_docs: rows indexed during the
-            # build are absent from the artifact and must be re-consumed
-            # record offsets in segment metadata for lineage/debug
-            meta_path = os.path.join(seg_dir, "metadata.json")
-            with open(meta_path) as fh:
-                meta = json.load(fh)
-            meta["startOffset"] = st["next_offset"]
-            meta["endOffset"] = st["next_offset"] + sealed
-            meta["partition"] = p
-            with open(meta_path, "w") as fh:
-                json.dump(meta, fh, indent=1)
-
+            if name in st["segments"]:
+                return
+            seg_dir = download_segment(download_uri, self.data_dir)
             seg = ImmutableSegment.load(seg_dir)
-            # upsert/dedup: carry the consuming segment's validDocIds into
-            # the committed artifact and repoint PK locations at it
-            valid = m.valid_mask(sealed)
-            if not valid.all():
-                seg.set_valid_docs(valid.copy())
-                seg.persist_valid_docs()
-            if p in self._upsert:
-                self._upsert[p].remap_segment(m, seg, sealed)
-            self.add_segment(seg)  # atomic swap: queries see it immediately
-            st["next_offset"] += sealed
+            self.add_segment(seg)
+            self._replay_metadata(p, seg)
+            st["next_offset"] = end_offset
             st["seq"] += 1
-            st["segments"].append(m.name)
+            st["segments"].append(name)
             self._write_state()
             self._new_mutable(p)
+
+    def _build_artifact(self, p: int):
+        """Build the immutable artifact from the consuming segment WITHOUT
+        touching durable state — the commit decision may still fail (split
+        commit), and the mutable must stay live until it succeeds.
+        Returns (mutable, segment, sealed_docs) or None when empty."""
+        m = self._mutables[p]
+        if m.n_docs == 0:
+            return None
+        st = self._partition_state(p)
+        seg_dir = m.seal(self.data_dir)
+        sealed = m.sealed_docs  # NOT m.n_docs: rows indexed during the
+        # build are absent from the artifact and must be re-consumed
+        # record offsets in segment metadata for lineage/debug
+        meta_path = os.path.join(seg_dir, "metadata.json")
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        meta["startOffset"] = st["next_offset"]
+        meta["endOffset"] = st["next_offset"] + sealed
+        meta["partition"] = p
+        with open(meta_path, "w") as fh:
+            json.dump(meta, fh, indent=1)
+
+        seg = ImmutableSegment.load(seg_dir)
+        # upsert/dedup: carry the consuming segment's validDocIds into
+        # the committed artifact and repoint PK locations at it
+        valid = m.valid_mask(sealed)
+        if not valid.all():
+            seg.set_valid_docs(valid.copy())
+            seg.persist_valid_docs()
+        return m, seg, sealed
+
+    def _commit_local(self, p: int, m, seg: ImmutableSegment,
+                      sealed: int) -> None:
+        """Second half of the seal: swap + checkpoint + fresh mutable."""
+        st = self._partition_state(p)
+        if p in self._upsert:
+            self._upsert[p].remap_segment(m, seg, sealed)
+        self.add_segment(seg)  # atomic swap: queries see it immediately
+        st["next_offset"] += sealed
+        st["seq"] += 1
+        st["segments"].append(m.name)
+        self._write_state()
+        self._new_mutable(p)
+
+    def seal_partition(self, p: int) -> Optional[ImmutableSegment]:
+        """CONSUMING -> ONLINE: build, swap, checkpoint (standalone
+        mode — no controller arbitration)."""
+        with self._seal_lock:
+            built = self._build_artifact(p)
+            if built is None:
+                return None
+            m, seg, sealed = built
+            self._commit_local(p, m, seg, sealed)
             return seg
 
     # -- background consumption (PartitionConsumer.run analog) -------------

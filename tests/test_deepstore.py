@@ -1,0 +1,287 @@
+"""Deep store (PinotFS + tar.gz segment packaging) and the segment
+completion protocol (controller-arbitrated realtime commit).
+
+Reference test model: LocalPinotFS tests, TarGzCompressionUtils tests,
+SegmentCompletionManager FSM tests (HOLD/CATCHUP/COMMIT election), and
+the split-commit integration flow.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
+from pinot_tpu.cluster.completion import SegmentCompletionManager
+from pinot_tpu.cluster.deepstore import (download_segment, pack_segment,
+                                         unpack_segment, upload_segment)
+from pinot_tpu.cluster.http_util import http_json
+from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+from pinot_tpu.spi.filesystem import LocalPinotFS, fs_for_uri
+
+
+def _build_segment(tmp_path, name="s0", n=100):
+    schema = Schema("t", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+    cols = {"k": np.array(["a", "b"] * (n // 2)),
+            "v": np.arange(n, dtype=np.int32)}
+    return SegmentBuilder(schema, TableConfig("t")).build(
+        cols, str(tmp_path / "build"), name), schema
+
+
+class TestPinotFS:
+    def test_local_roundtrip(self, tmp_path):
+        fs = LocalPinotFS()
+        src = tmp_path / "a.txt"
+        src.write_text("hello")
+        fs.copy(str(src), str(tmp_path / "b" / "a.txt"))
+        assert (tmp_path / "b" / "a.txt").read_text() == "hello"
+        assert fs.exists(str(tmp_path / "b"))
+        assert fs.listdir(str(tmp_path / "b")) == ["a.txt"]
+        assert fs.length(str(src)) == 5
+        fs.move(str(src), str(tmp_path / "c.txt"))
+        assert not src.exists() and (tmp_path / "c.txt").exists()
+        assert fs.delete(str(tmp_path / "c.txt"))
+
+    def test_uri_resolution(self, tmp_path):
+        fs, path = fs_for_uri(f"file://{tmp_path}/x")
+        assert isinstance(fs, LocalPinotFS) and path == f"{tmp_path}/x"
+        fs2, path2 = fs_for_uri("/plain/path")
+        assert isinstance(fs2, LocalPinotFS) and path2 == "/plain/path"
+
+    def test_cloud_schemes_gated(self):
+        fs, _ = fs_for_uri("s3://bucket/key")
+        with pytest.raises(RuntimeError, match="boto3"):
+            fs.exists("bucket/key")
+
+
+class TestPackaging:
+    def test_pack_unpack_roundtrip(self, tmp_path):
+        seg_dir, _ = _build_segment(tmp_path)
+        archive = pack_segment(seg_dir)
+        assert archive.endswith(".tar.gz")
+        out = unpack_segment(archive, str(tmp_path / "restored"))
+        seg = ImmutableSegment.load(out)
+        assert seg.n_docs == 100
+
+    def test_upload_download(self, tmp_path):
+        seg_dir, _ = _build_segment(tmp_path)
+        store = f"file://{tmp_path}/deepstore/t"
+        uri = upload_segment(seg_dir, store)
+        assert uri.endswith("s0.tar.gz")
+        local = download_segment(uri, str(tmp_path / "dl"))
+        seg = ImmutableSegment.load(local)
+        assert int(np.asarray(seg.raw_values("v")).sum()) == sum(range(100))
+
+
+class TestCompletionFSM:
+    def _mgr(self, replicas=2, window=0.2):
+        return SegmentCompletionManager(lambda t: replicas,
+                                        decision_window_s=window)
+
+    def test_election_largest_offset_wins(self):
+        m = self._mgr()
+        r1 = m.segment_consumed("t", "seg", "s1", 100)
+        assert r1["status"] == "HOLD"  # waiting for the second replica
+        r2 = m.segment_consumed("t", "seg", "s2", 120)
+        assert r2["status"] == "COMMIT" and r2["offset"] == 120
+        r1b = m.segment_consumed("t", "seg", "s1", 100)
+        assert r1b["status"] == "HOLD"  # committing in progress elsewhere
+
+    def test_catchup_then_commit_visibility(self):
+        m = self._mgr()
+        m.segment_consumed("t", "seg", "s1", 50)
+        win = m.segment_consumed("t", "seg", "s2", 90)
+        assert win["status"] == "COMMIT"
+        assert m.segment_commit_start("t", "seg", "s2")["status"] == \
+            "COMMIT_CONTINUE"
+        registered = []
+        end = m.segment_commit_end("t", "seg", "s2", "file:///x.tar.gz",
+                                   register=lambda: registered.append(1))
+        assert end["status"] == "COMMIT_SUCCESS" and registered == [1]
+        r1 = m.segment_consumed("t", "seg", "s1", 50)
+        assert r1["status"] == "COMMITTED"
+        assert r1["downloadURI"] == "file:///x.tar.gz"
+
+    def test_laggard_gets_catchup(self):
+        m = self._mgr(replicas=2, window=0.05)
+        m.segment_consumed("t", "seg", "s1", 10)
+        time.sleep(0.1)
+        # window elapsed: s1's solo report elects s1; a late s2 behind the
+        # target is told to catch up
+        r1 = m.segment_consumed("t", "seg", "s1", 10)
+        assert r1["status"] == "COMMIT"
+        r2 = m.segment_consumed("t", "seg", "s2", 5)
+        assert r2["status"] in ("CATCHUP", "HOLD")
+
+    def test_commit_start_rejects_non_winner(self):
+        m = self._mgr(replicas=1)
+        m.segment_consumed("t", "seg", "s1", 10)
+        assert m.segment_commit_start("t", "seg", "s2")["status"] == \
+            "FAILED"
+
+    def test_takeover_after_commit_timeout(self):
+        m = SegmentCompletionManager(lambda t: 2, decision_window_s=0.01,
+                                     commit_timeout_s=0.05)
+        m.segment_consumed("t", "seg", "s1", 10)
+        time.sleep(0.02)
+        assert m.segment_consumed("t", "seg", "s1", 10)["status"] == \
+            "COMMIT"
+        time.sleep(0.1)  # winner dies mid-commit
+        r2 = m.segment_consumed("t", "seg", "s2", 10)
+        assert r2["status"] == "COMMIT"  # s2 takes over
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    ctrl = Controller(str(tmp_path / "ctrl"), heartbeat_timeout=2.0,
+                      reconcile_interval=0.2)
+    servers = [ServerNode(f"server_{i}", ctrl.url, poll_interval=0.1)
+               for i in range(2)]
+    broker = BrokerNode(ctrl.url, routing_refresh=0.1)
+    yield ctrl, servers, broker, tmp_path
+    broker.stop()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    ctrl.stop()
+
+
+def test_deepstore_segment_serving(cluster):
+    """Segment registered by deep-store URI: servers download + untar +
+    load, broker queries it (metadata-push flow)."""
+    ctrl, servers, broker, tmp_path = cluster
+    seg_dir, schema = _build_segment(tmp_path)
+    uri = upload_segment(seg_dir, f"file://{tmp_path}/deepstore/t")
+    import json
+    with open(os.path.join(seg_dir, "metadata.json")) as fh:
+        meta = json.load(fh)
+    ctrl.add_table("t", schema.to_dict(), replication=2)
+    ctrl.add_segment("t", "s0", uri, metadata={
+        "columns": {c: {k: m[k] for k in ("min", "max") if k in m}
+                    for c, m in meta["columns"].items()},
+        "totalDocs": meta["totalDocs"]})
+    v = ctrl.routing_snapshot()["version"]
+    for s in servers:
+        assert s.wait_for_version(v)
+    assert broker.wait_for_version(v)
+
+    resp = http_json("POST", f"{broker.url}/query/sql", {
+        "sql": "SELECT SUM(v), COUNT(*) FROM t"})
+    assert [tuple(r) for r in resp["resultTable"]["rows"]] == \
+        [(sum(range(100)), 100)]
+
+
+def test_split_commit_over_http(cluster):
+    """Two replicas run the completion protocol over REST; the winner
+    split-commits into the deep store; the segment becomes queryable."""
+    ctrl, servers, broker, tmp_path = cluster
+    seg_dir, schema = _build_segment(tmp_path, name="rt_seg_0")
+    ctrl.add_table("rt", schema.to_dict(), replication=2)
+
+    # both replicas reach their threshold; s2 is ahead
+    r1 = http_json("POST", f"{ctrl.url}/segmentConsumed", {
+        "table": "rt", "segment": "rt_seg_0", "server": "server_0",
+        "offset": 100})
+    assert r1["status"] == "HOLD"
+    r2 = http_json("POST", f"{ctrl.url}/segmentConsumed", {
+        "table": "rt", "segment": "rt_seg_0", "server": "server_1",
+        "offset": 120})
+    assert r2["status"] == "COMMIT"
+
+    # winner split-commits
+    assert http_json("POST", f"{ctrl.url}/segmentCommitStart", {
+        "table": "rt", "segment": "rt_seg_0",
+        "server": "server_1"})["status"] == "COMMIT_CONTINUE"
+    uri = upload_segment(seg_dir, f"file://{tmp_path}/deepstore/rt")
+    end = http_json("POST", f"{ctrl.url}/segmentCommitEnd", {
+        "table": "rt", "segment": "rt_seg_0", "server": "server_1",
+        "downloadURI": uri})
+    assert end["status"] == "COMMIT_SUCCESS"
+
+    # the laggard replica learns the segment is committed
+    r1b = http_json("POST", f"{ctrl.url}/segmentConsumed", {
+        "table": "rt", "segment": "rt_seg_0", "server": "server_0",
+        "offset": 100})
+    assert r1b["status"] == "COMMITTED" and r1b["downloadURI"] == uri
+
+    # committed segment serves queries (servers downloaded from deepstore)
+    v = ctrl.routing_snapshot()["version"]
+    for s in servers:
+        assert s.wait_for_version(v)
+    assert broker.wait_for_version(v)
+    resp = http_json("POST", f"{broker.url}/query/sql", {
+        "sql": "SELECT COUNT(*) FROM rt"})
+    assert [tuple(r) for r in resp["resultTable"]["rows"]] == [(100,)]
+
+
+def test_two_replica_realtime_commit(tmp_path):
+    """Two consuming replicas of one partition arbitrate through the
+    controller: one wins and split-commits, the other adopts the
+    committed artifact and resumes from its end offset."""
+    from pinot_tpu.cluster.completion import CompletionClient
+    from pinot_tpu.realtime.manager import RealtimeTableDataManager
+    from pinot_tpu.realtime.stream import InMemoryStream, StreamConfig
+
+    ctrl = Controller(str(tmp_path / "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=5.0)
+    try:
+        schema = Schema("rtt", [
+            FieldSpec("k", DataType.STRING),
+            FieldSpec("v", DataType.INT, FieldType.METRIC),
+        ])
+        ctrl.add_table("rtt", schema.to_dict(), replication=2)
+        ctrl.completion.decision_window_s = 0.1
+
+        stream = InMemoryStream(num_partitions=1)
+        for i in range(40):
+            stream.produce({"k": "a", "v": i})
+
+        deep = f"file://{tmp_path}/deepstore"
+        managers = []
+        for sid in ("rt_server_0", "rt_server_1"):
+            cfg = StreamConfig("events", consumer_factory=stream,
+                               flush_threshold_rows=40,
+                               flush_threshold_seconds=3600)
+            cc = CompletionClient(ctrl.url, sid, deep)
+            m = RealtimeTableDataManager(
+                "rtt", schema, cfg, str(tmp_path / sid),
+                completion_client=cc)
+            m.report_interval_s = 0.0
+            managers.append(m)
+
+        for m in managers:
+            m.consume_once(0)  # both hit the 40-row threshold
+
+        # drive the protocol until both sides hold the committed segment
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            for m in managers:
+                m._maybe_seal(0)
+            states = [m._partition_state(0) for m in managers]
+            if all(s["segments"] == ["rtt__0__0"] for s in states):
+                break
+            time.sleep(0.05)
+        states = [m._partition_state(0) for m in managers]
+        assert all(s["segments"] == ["rtt__0__0"] for s in states)
+        assert all(s["next_offset"] == 40 for s in states)
+
+        # exactly one commit happened; both replicas serve identical data
+        entry = ctrl.completion.status("rtt", "rtt__0__0")
+        assert entry["state"] == "COMMITTED"
+        for m in managers:
+            segs = [s for s in m.acquire_segments()]
+            assert sum(s.n_docs for s in segs) == 40
+        # controller registered the committed segment with its deep-store
+        # URI and pruning metadata
+        seg_entry = ctrl.routing_snapshot()["segments"]["rtt"]["rtt__0__0"]
+        assert seg_entry["location"].endswith(".tar.gz")
+        assert seg_entry["meta"]["totalDocs"] == 40
+    finally:
+        ctrl.stop()
