@@ -38,7 +38,9 @@ class SegmentCompletionManager:
     def __init__(self, expected_replicas: Callable[[str], int],
                  decision_window_s: float = 0.5,
                  commit_timeout_s: float = 30.0,
-                 committed_ttl_s: float = 300.0):
+                 committed_ttl_s: float = 300.0,
+                 registered_segment: Optional[
+                     Callable[[str, str], Optional[Dict[str, Any]]]] = None):
         """expected_replicas: table -> how many replicas consume each
         segment (the controller's replication for the table).
         committed_ttl_s bounds FSM memory: COMMITTED entries are purged
@@ -48,6 +50,12 @@ class SegmentCompletionManager:
         self.decision_window_s = decision_window_s
         self.commit_timeout_s = commit_timeout_s
         self.committed_ttl_s = committed_ttl_s
+        # fallback registry lookup: (table, segment) -> {"downloadURI",
+        # "offset"} | None. The FSM is memory-only; after a controller
+        # restart or a TTL purge a laggard's report must NOT re-elect a
+        # committer for a segment the cluster already registered — that
+        # would overwrite the canonical artifact with a divergent one.
+        self._registered = registered_segment
         self._lock = threading.Lock()
         self._fsm: Dict[Tuple[str, str], Dict[str, Any]] = {}
 
@@ -77,6 +85,13 @@ class SegmentCompletionManager:
                          offset: int) -> Dict[str, Any]:
         with self._lock:
             self._purge_locked()
+            if (table, segment) not in self._fsm and \
+                    self._registered is not None:
+                reg = self._registered(table, segment)
+                if reg is not None:
+                    return {"status": COMMITTED,
+                            "downloadURI": reg.get("downloadURI"),
+                            "offset": reg.get("offset")}
             e = self._entry(table, segment)
             if e["state"] == "COMMITTED":
                 return {"status": COMMITTED,

@@ -59,11 +59,24 @@ class Controller:
         self.scheduler.register(BasePeriodicTask(
             "SegmentStatusChecker", interval_s=30.0,
             fn=self.run_status_check))
-        # realtime commit arbitration (SegmentCompletionManager FSM)
+        # realtime commit arbitration (SegmentCompletionManager FSM); the
+        # registry fallback keeps restarts/purges from re-electing a
+        # committer for an already-registered segment
         from .completion import SegmentCompletionManager
+
+        def _registered(table: str, segment: str):
+            with self._lock:
+                entry = self._state["segments"].get(table, {}).get(segment)
+                if entry is None:
+                    return None
+                meta = entry.get("meta") or {}
+                return {"downloadURI": entry.get("location"),
+                        "offset": meta.get("endOffset")}
+
         self.completion = SegmentCompletionManager(
             expected_replicas=lambda t: self._state["tables"]
-            .get(t, {}).get("replication", 1))
+            .get(t, {}).get("replication", 1),
+            registered_segment=_registered)
         self._httpd, self.port, _ = start_http(self._make_handler(), port)
         self._recon = threading.Thread(target=self._reconcile_loop,
                                        daemon=True)
@@ -152,8 +165,11 @@ class Controller:
         with self._lock:
             for key in ("tables", "segments", "assignment", "lineage"):
                 self._state[key].pop(name, None)
-            self.completion.drop_table(name)
             self._bump()
+        # outside self._lock: segmentCommitEnd nests completion._lock ->
+        # self._lock (register), so nesting the other way here would be
+        # an ABBA deadlock
+        self.completion.drop_table(name)
 
     @staticmethod
     def _read_segment_meta(location: str) -> Optional[Dict[str, Any]]:
@@ -289,8 +305,11 @@ class Controller:
 
     def run_retention(self) -> None:
         """Drop segments older than the table's retention, judged by the
-        time column's max value in segment metadata."""
+        time column's max value in segment metadata. Artifact deletion
+        (deep-store I/O) happens after the lock is released — a hung
+        store must not stall the control plane."""
         now_ms = time.time() * 1e3
+        retired: List[Optional[str]] = []
         with self._lock:
             changed = False
             for table, tmeta in list(self._state["tables"].items()):
@@ -315,11 +334,12 @@ class Controller:
                             seg, None)
                         self._state["assignment"].get(table, {}).pop(
                             seg, None)
-                        self._delete_artifact(
-                            (entry or {}).get("location"))
+                        retired.append((entry or {}).get("location"))
                         changed = True
             if changed:
                 self._bump()
+        for loc in retired:
+            self._delete_artifact(loc)
 
     # -- status checker (SegmentStatusChecker analog) ----------------------
     def run_status_check(self) -> None:
@@ -362,43 +382,41 @@ class Controller:
             self._bump()
             return entry_id
 
+    def _retire_lineage_segments(self, table: str, entry_id: str,
+                                 from_state: str, to_state: str,
+                                 seg_key: str, reconcile: bool) -> None:
+        retired: List[Optional[str]] = []
+        with self._lock:
+            for e in self._state["lineage"].get(table, []):
+                if e["id"] == entry_id and e["state"] == from_state:
+                    e["state"] = to_state
+                    for seg in e[seg_key]:
+                        entry = self._state["segments"].get(
+                            table, {}).pop(seg, None)
+                        self._state["assignment"].get(table, {}).pop(
+                            seg, None)
+                        retired.append((entry or {}).get("location"))
+                    if reconcile:
+                        self._reconcile_locked()
+                    self._bump()
+                    break
+            else:
+                raise KeyError(
+                    f"no {from_state} lineage entry {entry_id!r}")
+        for loc in retired:  # deep-store I/O outside the lock
+            self._delete_artifact(loc)
+
     def end_replace_segments(self, table: str, entry_id: str) -> None:
         """Flip the lineage entry to COMPLETED: new segments become
         routable, replaced ones are removed, atomically (one version
         bump). Removal (not permanent name exclusion) keeps replaced
         segment names reusable by later uploads."""
-        with self._lock:
-            for e in self._state["lineage"].get(table, []):
-                if e["id"] == entry_id and e["state"] == "IN_PROGRESS":
-                    e["state"] = "COMPLETED"
-                    for seg in e["from"]:
-                        entry = self._state["segments"].get(
-                            table, {}).pop(seg, None)
-                        self._state["assignment"].get(table, {}).pop(
-                            seg, None)
-                        self._delete_artifact(
-                            (entry or {}).get("location"))
-                    self._reconcile_locked()
-                    self._bump()
-                    return
-            raise KeyError(f"no IN_PROGRESS lineage entry {entry_id!r}")
+        self._retire_lineage_segments(table, entry_id, "IN_PROGRESS",
+                                      "COMPLETED", "from", reconcile=True)
 
     def revert_replace_segments(self, table: str, entry_id: str) -> None:
-        with self._lock:
-            lin = self._state["lineage"].get(table, [])
-            for e in lin:
-                if e["id"] == entry_id and e["state"] == "IN_PROGRESS":
-                    e["state"] = "REVERTED"
-                    for seg in e["to"]:
-                        entry = self._state["segments"].get(
-                            table, {}).pop(seg, None)
-                        self._state["assignment"].get(table, {}).pop(
-                            seg, None)
-                        self._delete_artifact(
-                            (entry or {}).get("location"))
-                    self._bump()
-                    return
-            raise KeyError(f"no IN_PROGRESS lineage entry {entry_id!r}")
+        self._retire_lineage_segments(table, entry_id, "IN_PROGRESS",
+                                      "REVERTED", "to", reconcile=False)
 
     def _excluded_segments(self, table: str) -> set:
         """Segments hidden from routing by lineage state. Only IN_PROGRESS

@@ -82,5 +82,9 @@ def pruning_metadata(seg_dir: str):
         entry = {k: cm[k] for k in ("min", "max", "partitions") if k in cm}
         if entry:
             cols[name] = entry
-    return {"columns": cols, "totalDocs": m.get("totalDocs"),
-            "numPartitions": m.get("numPartitions")}
+    out = {"columns": cols, "totalDocs": m.get("totalDocs"),
+           "numPartitions": m.get("numPartitions")}
+    for k in ("startOffset", "endOffset", "partition"):
+        if k in m:
+            out[k] = m[k]
+    return out

@@ -1,0 +1,116 @@
+"""Batch ingestion job: read input files -> transform -> build segments
+-> push.
+
+Reference parity: pinot-spi/.../ingestion/batch/spec/
+SegmentGenerationJobSpec + pinot-plugins/pinot-batch-ingestion/
+pinot-batch-ingestion-standalone (the standalone runner) with the two
+push modes: tar/metadata push to a controller (deep store) or plain
+local segment output. Spark/Hadoop runners in the reference parallelize
+the same per-file work; here files chunk into segments serially (a
+process pool can slot in behind run() without changing the spec).
+
+Job spec (dict; JSON/YAML-friendly, SegmentGenerationJobSpec analog):
+    {
+      "inputDirURI": "/data/in",            # or "inputFiles": [...]
+      "includeFileNamePattern": "*.csv",    # fnmatch, default all
+      "format": "csv",                      # csv|json|jsonl|avro|parquet
+      "outputDirURI": "/data/segments",
+      "tableName": "mytable",
+      "schema": {...},                      # Schema.to_dict()
+      "tableConfig": {...},                 # TableConfig.to_dict()
+      "segmentNamePrefix": "mytable",       # default tableName
+      "rowsPerSegment": 1000000,
+      "push": {                             # optional
+        "controllerUrl": "http://...",
+        "deepstoreURI": "file:///deepstore" # tar push when set,
+      }                                     # location push otherwise
+    }
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+from typing import Any, Dict, List, Optional
+
+from ..inputformat import read_records
+from ..segment.builder import SegmentBuilder
+from ..spi.config import TableConfig
+from ..spi.schema import Schema
+from .transformers import CompositeTransformer
+
+
+class BatchIngestionJob:
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec = spec
+        self.schema = Schema.from_dict(spec["schema"])
+        self.table_config = TableConfig.from_dict(
+            spec.get("tableConfig")
+            or {"tableName": spec["tableName"]})
+        self.table = spec.get("tableName") or self.table_config.table_name
+
+    # -- input discovery ---------------------------------------------------
+    def input_files(self) -> List[str]:
+        if self.spec.get("inputFiles"):
+            return list(self.spec["inputFiles"])
+        root = self.spec["inputDirURI"]
+        pattern = self.spec.get("includeFileNamePattern", "*")
+        out: List[str] = []
+        for dirpath, _dirs, files in os.walk(root):
+            for f in sorted(files):
+                if fnmatch.fnmatch(f, pattern):
+                    out.append(os.path.join(dirpath, f))
+        if not out:
+            raise FileNotFoundError(
+                f"no input files under {root!r} matching {pattern!r}")
+        return out
+
+    # -- run ---------------------------------------------------------------
+    def run(self) -> List[str]:
+        """Execute the job; returns the registered segment locations
+        (deep-store URIs in tar-push mode, local dirs otherwise)."""
+        fmt = self.spec.get("format", "")
+        rows: List[Dict[str, Any]] = []
+        for path in self.input_files():
+            rows.extend(read_records(path, fmt))
+        pipeline = CompositeTransformer.from_table_config(
+            self.table_config, self.schema)
+        rows = pipeline.transform(rows)
+        if not rows:
+            return []
+
+        out_dir = self.spec["outputDirURI"]
+        prefix = self.spec.get("segmentNamePrefix", self.table)
+        per_seg = int(self.spec.get("rowsPerSegment", 1_000_000))
+        builder = SegmentBuilder(self.schema, self.table_config)
+        seg_dirs: List[str] = []
+        for i in range(0, len(rows), per_seg):
+            name = f"{prefix}_{i // per_seg}"
+            seg_dirs.append(builder.build(rows[i:i + per_seg], out_dir,
+                                          name))
+
+        push = self.spec.get("push") or {}
+        if not push.get("controllerUrl"):
+            return seg_dirs
+        return [self._push(d, push) for d in seg_dirs]
+
+    def _push(self, seg_dir: str, push: Dict[str, Any]) -> str:
+        """Metadata push: optional deep-store upload, then register the
+        segment + pruning metadata with the controller."""
+        from ..cluster.deepstore import pruning_metadata, upload_segment
+        from ..cluster.http_util import http_json
+        location = seg_dir
+        if push.get("deepstoreURI"):
+            location = upload_segment(
+                seg_dir, push["deepstoreURI"].rstrip("/") + "/"
+                + self.table)
+        http_json("POST", f"{push['controllerUrl']}/segments", {
+            "table": self.table,
+            "segment": os.path.basename(seg_dir.rstrip("/")),
+            "location": location,
+            "metadata": pruning_metadata(seg_dir),
+        })
+        return location
+
+
+def run_batch_ingestion(spec: Dict[str, Any]) -> List[str]:
+    return BatchIngestionJob(spec).run()

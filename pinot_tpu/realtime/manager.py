@@ -84,6 +84,30 @@ class RealtimeTableDataManager(TableDataManager):
         self.upsert_config = upsert_config
         self.dedup_config = dedup_config
 
+        # pre-indexing row pipeline (CompositeTransformer before
+        # MutableSegmentImpl.index, as in RealtimeSegmentDataManager).
+        # Split at the filter stage so the filter sees the same rows it
+        # would in batch ingestion (raw source columns, pre-coercion);
+        # filtered rows are indexed then invalidated so stream-offset ==
+        # doc-id accounting stays exact.
+        from ..ingestion.transformers import (CompositeTransformer,
+                                              FilterTransformer)
+        self._pre_transformer = None
+        self._row_filter: Optional[FilterTransformer] = None
+        self._post_transformer = None
+        if getattr(self.table_config, "ingestion", None):
+            chain = CompositeTransformer.from_table_config(
+                self.table_config, schema).transformers
+            fidx = next((i for i, t in enumerate(chain)
+                         if isinstance(t, FilterTransformer)), None)
+            if fidx is None:
+                self._pre_transformer = CompositeTransformer(chain)
+            else:
+                self._pre_transformer = CompositeTransformer(chain[:fidx])
+                self._row_filter = chain[fidx]
+                self._post_transformer = CompositeTransformer(
+                    chain[fidx + 1:])
+
         factory = stream_config.consumer_factory
         if factory is None:
             raise ValueError("StreamConfig.consumer_factory is required")
@@ -209,14 +233,24 @@ class RealtimeTableDataManager(TableDataManager):
         stream offset accounting stays row = doc (the reference instead
         skips indexing; masks make skipping unnecessary here and keep
         offsets trivially exact)."""
+        drop = None
+        if self._pre_transformer is not None:
+            rows = self._pre_transformer.transform(
+                [dict(r) for r in rows])
+            if self._row_filter is not None:
+                drop = self._row_filter.drop_mask(rows)
+            if self._post_transformer is not None:
+                rows = self._post_transformer.transform(rows)
         upsert = self._upsert.get(p)
         dedup = self._dedup.get(p)
-        if upsert is None and dedup is None:
+        if upsert is None and dedup is None and drop is None:
             m.index_batch(rows)
             return
         for i, row in enumerate(rows):
             doc = m.index(row)
-            if dedup is not None and dedup.should_drop(row):
+            if drop is not None and drop[i]:
+                m.invalidate_doc(doc)  # ingestion-filtered row
+            elif dedup is not None and dedup.should_drop(row):
                 m.invalidate_doc(doc)
             elif upsert is not None:
                 upsert.add_row(m, doc, row, offset + i)
@@ -276,9 +310,12 @@ class RealtimeTableDataManager(TableDataManager):
                     import shutil
                     shutil.rmtree(seg.dir, ignore_errors=True)
         elif status == "COMMITTED":
+            uri = resp.get("downloadURI")
+            off = resp.get("offset")
+            if uri is None or off is None:
+                return  # registry fallback without offsets: cannot adopt
             try:
-                self._adopt_committed(p, name, resp["downloadURI"],
-                                      int(resp["offset"]))
+                self._adopt_committed(p, name, uri, int(off))
             except Exception:
                 pass  # deep store unreachable: retry on the next poll
         # CATCHUP / HOLD: keep consuming / report again next poll
@@ -297,12 +334,34 @@ class RealtimeTableDataManager(TableDataManager):
             seg_dir = download_segment(download_uri, self.data_dir)
             seg = ImmutableSegment.load(seg_dir)
             self.add_segment(seg)
-            self._replay_metadata(p, seg)
             st["next_offset"] = end_offset
             st["seq"] += 1
             st["segments"].append(name)
             self._write_state()
             self._new_mutable(p)
+            # the discarded mutable polluted the upsert/dedup metadata
+            # with rows past end_offset that will be re-consumed; rebuild
+            # the partition's PK state from committed segments only, or
+            # re-consumed rows would be dropped as phantom duplicates
+            self._rebuild_partition_metadata(p)
+
+    def _rebuild_partition_metadata(self, p: int) -> None:
+        if p in self._upsert:
+            from ..upsert import PartitionUpsertMetadataManager
+            self._upsert[p] = PartitionUpsertMetadataManager(
+                self.upsert_config)
+        elif p in self._dedup:
+            from ..upsert import PartitionDedupMetadataManager
+            self._dedup[p] = PartitionDedupMetadataManager(
+                self.dedup_config)
+        else:
+            return
+        st = self._partition_state(p)
+        by_name = {s.name: s for s in super().acquire_segments()}
+        for seg_name in st["segments"]:
+            seg = by_name.get(seg_name)
+            if seg is not None:
+                self._replay_metadata(p, seg)
 
     def _build_artifact(self, p: int):
         """Build the immutable artifact from the consuming segment WITHOUT

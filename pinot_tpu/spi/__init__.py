@@ -1,2 +1,4 @@
 from .schema import DataType, FieldType, FieldSpec, Schema  # noqa: F401
-from .config import IndexingConfig, InstanceConfig, SegmentsConfig, TableConfig, TableType  # noqa: F401
+from .config import (IndexingConfig, IngestionConfig,  # noqa: F401
+                     InstanceConfig, SegmentsConfig, TableConfig,
+                     TableType)

@@ -63,6 +63,15 @@ class IndexingConfig:
 
 
 @dataclass
+class IngestionConfig:
+    """Row pipeline config (TableConfig ingestionConfig analog):
+    filterFunction drops matching rows; transforms derive columns."""
+    filter_function: Optional[str] = None
+    # [{"columnName": ..., "transformFunction": "<expression>"}]
+    transforms: List[Dict[str, str]] = field(default_factory=list)
+
+
+@dataclass
 class SegmentsConfig:
     replication: int = 1
     # pad segments to pow2 buckets >= this floor to bound XLA recompiles
@@ -81,6 +90,8 @@ class TableConfig:
     # time column for time pruning + the hybrid-table time boundary
     # (TimeBoundaryManager); defaults to the schema's DATE_TIME field
     time_column: Optional[str] = None
+    # pre-indexing row pipeline (recordtransformer/ analog)
+    ingestion: Optional[IngestionConfig] = None
     # max queries/sec for this table (query quota; None = unlimited)
     quota_qps: Optional[float] = None
 
@@ -112,6 +123,10 @@ class TableConfig:
             "numPartitions": self.num_partitions,
             "timeColumn": self.time_column,
             "quotaQps": self.quota_qps,
+            "ingestion": None if self.ingestion is None else {
+                "filterFunction": self.ingestion.filter_function,
+                "transforms": self.ingestion.transforms,
+            },
         }
 
     def to_json(self) -> str:
@@ -145,6 +160,10 @@ class TableConfig:
             num_partitions=d.get("numPartitions", 1),
             time_column=d.get("timeColumn"),
             quota_qps=d.get("quotaQps"),
+            ingestion=None if not d.get("ingestion") else IngestionConfig(
+                filter_function=d["ingestion"].get("filterFunction"),
+                transforms=d["ingestion"].get("transforms", []),
+            ),
         )
 
 

@@ -1,0 +1,176 @@
+"""Record transformer pipeline + batch ingestion job.
+
+Reference test model: recordtransformer tests (CompositeTransformer
+order, flatten/expression/filter/type-coercion) and the standalone
+batch-ingestion runner tests (files -> segments -> push).
+"""
+import csv
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
+from pinot_tpu.cluster.http_util import http_json
+from pinot_tpu.ingestion import (ComplexTypeTransformer,
+                                 CompositeTransformer,
+                                 DataTypeTransformer,
+                                 ExpressionTransformer, FilterTransformer,
+                                 run_batch_ingestion)
+from pinot_tpu.segment import ImmutableSegment
+from pinot_tpu.server.data_manager import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, IngestionConfig,
+                           Schema, TableConfig)
+
+
+SCHEMA = Schema("orders", [
+    FieldSpec("region", DataType.STRING),
+    FieldSpec("amount", DataType.INT, FieldType.METRIC),
+    FieldSpec("amount_usd", DataType.DOUBLE, FieldType.METRIC),
+])
+
+
+class TestTransformers:
+    def test_flatten(self):
+        t = ComplexTypeTransformer()
+        rows = t.transform([{"a": {"b": 1, "c": {"d": 2}}, "e": 3}])
+        assert rows == [{"a.b": 1, "a.c.d": 2, "e": 3}]
+
+    def test_expression_transform(self):
+        t = ExpressionTransformer([
+            {"columnName": "amount_usd",
+             "transformFunction": "amount * 2"}])
+        rows = t.transform([{"amount": 5}, {"amount": 7}])
+        assert [r["amount_usd"] for r in rows] == [10, 14]
+
+    def test_filter_transform(self):
+        t = FilterTransformer("amount < 10")
+        rows = t.transform([{"amount": 5}, {"amount": 50}])
+        assert rows == [{"amount": 50}]
+
+    def test_type_coercion_and_unknown_drop(self):
+        t = DataTypeTransformer(SCHEMA)
+        rows = t.transform([{"region": 7, "amount": "42",
+                             "amount_usd": "1.5", "junk": "x"}])
+        assert rows == [{"region": "7", "amount": 42, "amount_usd": 1.5}]
+
+    def test_composite_order(self):
+        cfg = TableConfig("orders", ingestion=IngestionConfig(
+            filter_function="amount < 0",
+            transforms=[{"columnName": "amount_usd",
+                         "transformFunction": "amount * 1.5"}]))
+        pipe = CompositeTransformer.from_table_config(cfg, SCHEMA)
+        rows = pipe.transform([
+            {"nested": {"ignored": 1}, "region": "eu", "amount": 10},
+            {"region": "us", "amount": -5},
+        ])
+        assert len(rows) == 1
+        assert rows[0]["amount_usd"] == 15.0 and rows[0]["region"] == "eu"
+
+
+class TestBatchJob:
+    def _write_inputs(self, tmp_path):
+        csv_path = tmp_path / "in" / "part1.csv"
+        csv_path.parent.mkdir()
+        with open(csv_path, "w", newline="") as fh:
+            w = csv.DictWriter(fh, ["region", "amount"])
+            w.writeheader()
+            for i in range(10):
+                w.writerow({"region": "east" if i % 2 else "west",
+                            "amount": i})
+        json_path = tmp_path / "in" / "part2.json"
+        with open(json_path, "w") as fh:
+            for i in range(10, 20):
+                fh.write(json.dumps({"region": "north", "amount": i})
+                         + "\n")
+        return str(tmp_path / "in")
+
+    def _spec(self, tmp_path, **push):
+        cfg = TableConfig("orders", ingestion=IngestionConfig(
+            transforms=[{"columnName": "amount_usd",
+                         "transformFunction": "amount * 1.1"}]))
+        return {
+            "inputDirURI": self._write_inputs(tmp_path),
+            "outputDirURI": str(tmp_path / "segments"),
+            "tableName": "orders",
+            "schema": SCHEMA.to_dict(),
+            "tableConfig": cfg.to_dict(),
+            "rowsPerSegment": 8,
+            **push,
+        }
+
+    def test_local_build(self, tmp_path):
+        seg_dirs = run_batch_ingestion(self._spec(tmp_path))
+        assert len(seg_dirs) == 3  # 20 rows / 8 per segment
+        dm = TableDataManager("orders")
+        for d in seg_dirs:
+            dm.add_segment(ImmutableSegment.load(d))
+        b = Broker()
+        b.register_table(dm)
+        r = b.query("SELECT COUNT(*), SUM(amount) FROM orders")
+        assert r.rows == [(20, sum(range(20)))]
+        r2 = b.query("SELECT SUM(amount_usd) FROM orders")
+        assert r2.rows[0][0] == pytest.approx(sum(range(20)) * 1.1)
+
+    def test_push_to_cluster(self, tmp_path):
+        ctrl = Controller(str(tmp_path / "ctrl"), heartbeat_timeout=2.0,
+                          reconcile_interval=0.1)
+        srv = ServerNode("s0", ctrl.url, poll_interval=0.1)
+        brk = BrokerNode(ctrl.url, routing_refresh=0.1)
+        try:
+            ctrl.add_table("orders", SCHEMA.to_dict(), replication=1)
+            uris = run_batch_ingestion(self._spec(
+                tmp_path,
+                push={"controllerUrl": ctrl.url,
+                      "deepstoreURI": f"file://{tmp_path}/deepstore"}))
+            assert all(u.endswith(".tar.gz") for u in uris)
+            v = ctrl.routing_snapshot()["version"]
+            assert srv.wait_for_version(v)
+            assert brk.wait_for_version(v)
+            resp = http_json("POST", f"{brk.url}/query/sql", {
+                "sql": "SELECT COUNT(*), SUM(amount) FROM orders"})
+            assert [tuple(r) for r in resp["resultTable"]["rows"]] == \
+                [(20, sum(range(20)))]
+        finally:
+            brk.stop()
+            srv.stop()
+            ctrl.stop()
+
+    def test_empty_after_filter(self, tmp_path):
+        spec = self._spec(tmp_path)
+        spec["tableConfig"]["ingestion"]["filterFunction"] = "amount >= 0"
+        assert run_batch_ingestion(spec) == []
+
+    def test_missing_inputs_raise(self, tmp_path):
+        spec = self._spec(tmp_path)
+        spec["includeFileNamePattern"] = "*.nope"
+        with pytest.raises(FileNotFoundError):
+            run_batch_ingestion(spec)
+
+
+class TestRealtimeTransforms:
+    def test_filter_and_derive_in_stream(self, tmp_path):
+        from pinot_tpu.realtime.manager import RealtimeTableDataManager
+        from pinot_tpu.realtime.stream import InMemoryStream, StreamConfig
+        stream = InMemoryStream(num_partitions=1)
+        for i in range(10):
+            stream.produce({"region": "r", "amount": i})
+        cfg = TableConfig("orders", ingestion=IngestionConfig(
+            filter_function="amount < 3",
+            transforms=[{"columnName": "amount_usd",
+                         "transformFunction": "amount * 2.0"}]))
+        m = RealtimeTableDataManager(
+            "orders", SCHEMA,
+            StreamConfig("t", consumer_factory=stream,
+                         flush_threshold_rows=1000),
+            str(tmp_path / "rt"), table_config=cfg)
+        m.consume_once(0)
+        b = Broker()
+        b.register_table(m)
+        r = b.query("SELECT COUNT(*), SUM(amount_usd) FROM orders")
+        # amounts 0,1,2 filtered; remaining 3..9 doubled
+        assert r.rows == [(7, float(2 * sum(range(3, 10))))]
+        # offsets still advance one per stream row
+        assert m._partition_state(0)["next_offset"] == 0  # not sealed yet
+        assert m._mutables[0].n_docs == 10
