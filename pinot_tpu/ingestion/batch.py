@@ -67,31 +67,39 @@ class BatchIngestionJob:
     # -- run ---------------------------------------------------------------
     def run(self) -> List[str]:
         """Execute the job; returns the registered segment locations
-        (deep-store URIs in tar-push mode, local dirs otherwise)."""
+        (deep-store URIs in tar-push mode, local dirs otherwise).
+
+        Streaming: each input file is read + transformed on its own and
+        segments flush as the buffer reaches rowsPerSegment, so peak
+        memory is one file plus one segment of rows — never the whole
+        dataset (the transform pipeline is row-independent, so chunking
+        preserves semantics)."""
         fmt = self.spec.get("format", "")
-        rows: List[Dict[str, Any]] = []
-        for path in self.input_files():
-            rows.extend(read_records(path, fmt))
         pipeline = CompositeTransformer.from_table_config(
             self.table_config, self.schema)
-        rows = pipeline.transform(rows)
-        if not rows:
-            return []
-
         out_dir = self.spec["outputDirURI"]
         prefix = self.spec.get("segmentNamePrefix", self.table)
         per_seg = int(self.spec.get("rowsPerSegment", 1_000_000))
         builder = SegmentBuilder(self.schema, self.table_config)
-        seg_dirs: List[str] = []
-        for i in range(0, len(rows), per_seg):
-            name = f"{prefix}_{i // per_seg}"
-            seg_dirs.append(builder.build(rows[i:i + per_seg], out_dir,
-                                          name))
-
         push = self.spec.get("push") or {}
-        if not push.get("controllerUrl"):
-            return seg_dirs
-        return [self._push(d, push) for d in seg_dirs]
+
+        locations: List[str] = []
+        buf: List[Dict[str, Any]] = []
+
+        def flush(chunk: List[Dict[str, Any]]) -> None:
+            name = f"{prefix}_{len(locations)}"
+            seg_dir = builder.build(chunk, out_dir, name)
+            locations.append(self._push(seg_dir, push)
+                             if push.get("controllerUrl") else seg_dir)
+
+        for path in self.input_files():
+            buf.extend(pipeline.transform(read_records(path, fmt)))
+            while len(buf) >= per_seg:
+                flush(buf[:per_seg])
+                buf = buf[per_seg:]
+        if buf:
+            flush(buf)
+        return locations
 
     def _push(self, seg_dir: str, push: Dict[str, Any]) -> str:
         """Metadata push: optional deep-store upload, then register the

@@ -107,13 +107,27 @@ class ExpressionTransformer(RecordTransformer):
         if not rows or not self._specs:
             return rows
         from ..engine import host_eval
+        from ..query.sql import collect_identifiers
         rel = _rows_to_relation(rows)
         for name, expr in self._specs:
-            vals = np.broadcast_to(
-                np.asarray(host_eval.eval_value(expr, rel)),
-                (len(rows),))
-            for r, v in zip(rows, vals.tolist()):
-                r[name] = v
+            try:
+                vals = np.broadcast_to(
+                    np.asarray(host_eval.eval_value(expr, rel)),
+                    (len(rows),)).tolist()
+            except (KeyError, SqlError, TypeError, ValueError):
+                # e.g. a batch where no row carries the source column:
+                # the derived column is null, not a dead consumer thread
+                vals = [None] * len(rows)
+            # null inputs yield null outputs (the placeholder 0/NaN the
+            # relation builder substitutes must never escape as data)
+            null_in = None
+            for ref in collect_identifiers(expr):
+                nm = rel.null_mask(ref)
+                if nm is not None:
+                    null_in = nm if null_in is None else (null_in | nm)
+            for i, r in enumerate(rows):
+                r[name] = None if (null_in is not None and null_in[i]) \
+                    else vals[i]
         return rows
 
 

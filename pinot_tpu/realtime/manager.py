@@ -148,7 +148,9 @@ class RealtimeTableDataManager(TableDataManager):
             else:
                 start = seg.metadata.get("startOffset", 0)
                 cmps = list(range(start, start + seg.n_docs))
-            seg.set_valid_docs(None)  # replay recomputes from scratch
+            # the old mask stays visible until replay_segment publishes the
+            # rebuilt one — clearing first would transiently expose
+            # superseded rows to concurrent queries
             self._upsert[p].replay_segment(seg, pks, cmps)
             seg.persist_valid_docs()
         elif p in self._dedup:
@@ -235,12 +237,24 @@ class RealtimeTableDataManager(TableDataManager):
         offsets trivially exact)."""
         drop = None
         if self._pre_transformer is not None:
-            rows = self._pre_transformer.transform(
-                [dict(r) for r in rows])
-            if self._row_filter is not None:
-                drop = self._row_filter.drop_mask(rows)
-            if self._post_transformer is not None:
-                rows = self._post_transformer.transform(rows)
+            try:
+                rows = self._pre_transformer.transform(
+                    [dict(r) for r in rows])
+                if self._row_filter is not None:
+                    drop = self._row_filter.drop_mask(rows)
+                if self._post_transformer is not None:
+                    rows = self._post_transformer.transform(rows)
+            except Exception:
+                # a poison batch must not kill the consumer thread
+                # (realtimeRowsWithErrors in the reference): index
+                # schema-shaped placeholders and invalidate them so
+                # offset == doc accounting still holds
+                from ..utils.metrics import global_metrics
+                global_metrics.count("realtime_rows_with_errors",
+                                    len(rows))
+                rows = [{f.name: None for f in self.schema.fields}
+                        for _ in rows]
+                drop = np.ones(len(rows), dtype=bool)
         upsert = self._upsert.get(p)
         dedup = self._dedup.get(p)
         if upsert is None and dedup is None and drop is None:
@@ -311,17 +325,21 @@ class RealtimeTableDataManager(TableDataManager):
                     shutil.rmtree(seg.dir, ignore_errors=True)
         elif status == "COMMITTED":
             uri = resp.get("downloadURI")
+            if uri is None:
+                return  # nothing to adopt from; report again next poll
             off = resp.get("offset")
-            if uri is None or off is None:
-                return  # registry fallback without offsets: cannot adopt
             try:
-                self._adopt_committed(p, name, uri, int(off))
+                # off may be None (registry fallback without offsets) —
+                # _adopt_committed then derives it from the artifact's own
+                # endOffset metadata, so the replica never stalls forever
+                self._adopt_committed(
+                    p, name, uri, None if off is None else int(off))
             except Exception:
                 pass  # deep store unreachable: retry on the next poll
         # CATCHUP / HOLD: keep consuming / report again next poll
 
     def _adopt_committed(self, p: int, name: str, download_uri: str,
-                         end_offset: int) -> None:
+                         end_offset: Optional[int]) -> None:
         """A peer replica committed this segment: drop the local consuming
         state, download the canonical artifact, resume after it (the
         non-winner CONSUMING->ONLINE transition with deep-store
@@ -333,6 +351,9 @@ class RealtimeTableDataManager(TableDataManager):
                 return
             seg_dir = download_segment(download_uri, self.data_dir)
             seg = ImmutableSegment.load(seg_dir)
+            if end_offset is None:
+                end_offset = seg.metadata.get(
+                    "endOffset", st["next_offset"] + seg.n_docs)
             self.add_segment(seg)
             st["next_offset"] = end_offset
             st["seq"] += 1

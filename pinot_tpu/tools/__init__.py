@@ -1,0 +1,6 @@
+"""Operator tools: the admin CLI and the quickstart.
+
+Reference parity: pinot-tools/ — PinotAdministrator.java:92 (the
+pinot-admin command surface) and Quickstart.java:93-128 (one-process
+cluster + example data + sample queries).
+"""

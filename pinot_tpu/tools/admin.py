@@ -1,0 +1,171 @@
+"""Admin CLI (pinot-admin analog).
+
+Reference parity: pinot-tools/.../admin/PinotAdministrator.java:92 — the
+`pinot-admin.sh` command surface. Subcommands mirror the reference's
+most-used ones:
+
+    python -m pinot_tpu.tools.admin StartController --data-dir D [--port P]
+    python -m pinot_tpu.tools.admin StartServer --controller URL --id ID
+    python -m pinot_tpu.tools.admin StartBroker --controller URL
+    python -m pinot_tpu.tools.admin AddTable --controller URL \
+        --schema-file schema.json [--config-file table.json] [--replicas N]
+    python -m pinot_tpu.tools.admin LaunchDataIngestionJob --job-spec job.json
+    python -m pinot_tpu.tools.admin PostQuery --broker URL --query SQL
+    python -m pinot_tpu.tools.admin QuickStart [--rows N] [--exit-after]
+
+Role-starting commands block until Ctrl-C (the reference's foreground
+mode); everything else exits when done.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _wait_forever(label: str, url: str) -> None:
+    print(f"{label} running at {url}; press Ctrl-C to stop")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_start_controller(args) -> int:
+    from ..cluster import Controller
+    c = Controller(args.data_dir, port=args.port)
+    try:
+        _wait_forever("controller", c.url)
+    finally:
+        c.stop()
+    return 0
+
+
+def cmd_start_server(args) -> int:
+    from ..cluster import ServerNode
+    s = ServerNode(args.id, args.controller, port=args.port,
+                   tags=args.tag or [])
+    try:
+        _wait_forever(f"server {args.id}", s.url)
+    finally:
+        s.stop()
+    return 0
+
+
+def cmd_start_broker(args) -> int:
+    from ..cluster import BrokerNode
+    b = BrokerNode(args.controller, port=args.port,
+                   instance_selector=args.selector)
+    try:
+        _wait_forever("broker", b.url)
+    finally:
+        b.stop()
+    return 0
+
+
+def cmd_add_table(args) -> int:
+    from ..cluster.http_util import http_json
+    with open(args.schema_file) as fh:
+        schema = json.load(fh)
+    config = None
+    if args.config_file:
+        with open(args.config_file) as fh:
+            config = json.load(fh)
+    name = args.name or (config or {}).get("tableName") \
+        or schema.get("schemaName") or schema.get("name")
+    if not name:
+        print("no table name: pass --name or put tableName in the config",
+              file=sys.stderr)
+        return 2
+    http_json("POST", f"{args.controller}/tables", {
+        "name": name, "schema": schema, "config": config,
+        "replication": args.replicas})
+    print(f"table {name!r} added")
+    return 0
+
+
+def cmd_launch_ingestion(args) -> int:
+    from ..ingestion import run_batch_ingestion
+    with open(args.job_spec) as fh:
+        spec = json.load(fh)
+    locations = run_batch_ingestion(spec)
+    print(f"built {len(locations)} segment(s)")
+    for loc in locations:
+        print(f"  {loc}")
+    return 0
+
+
+def cmd_post_query(args) -> int:
+    from ..clients import connect_url
+    r = connect_url(args.broker).execute(args.query)
+    print(" | ".join(r.columns))
+    for row in r.rows:
+        print(" | ".join(str(v) for v in row))
+    print(f"-- {len(r.rows)} row(s), {r.num_segments} segment(s), "
+          f"{r.time_ms:.1f}ms")
+    return 0
+
+
+def cmd_quickstart(args) -> int:
+    from .quickstart import main
+    main(keep_running=not args.exit_after, rows=args.rows)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pinot-tpu-admin",
+        description="Cluster administration commands")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sc = sub.add_parser("StartController")
+    sc.add_argument("--data-dir", required=True)
+    sc.add_argument("--port", type=int, default=0)
+    sc.set_defaults(fn=cmd_start_controller)
+
+    ss = sub.add_parser("StartServer")
+    ss.add_argument("--controller", required=True)
+    ss.add_argument("--id", required=True)
+    ss.add_argument("--port", type=int, default=0)
+    ss.add_argument("--tag", action="append")
+    ss.set_defaults(fn=cmd_start_server)
+
+    sb = sub.add_parser("StartBroker")
+    sb.add_argument("--controller", required=True)
+    sb.add_argument("--port", type=int, default=0)
+    sb.add_argument("--selector", default="balanced")
+    sb.set_defaults(fn=cmd_start_broker)
+
+    at = sub.add_parser("AddTable")
+    at.add_argument("--controller", required=True)
+    at.add_argument("--schema-file", required=True)
+    at.add_argument("--config-file")
+    at.add_argument("--name")
+    at.add_argument("--replicas", type=int, default=1)
+    at.set_defaults(fn=cmd_add_table)
+
+    li = sub.add_parser("LaunchDataIngestionJob")
+    li.add_argument("--job-spec", required=True)
+    li.set_defaults(fn=cmd_launch_ingestion)
+
+    pq = sub.add_parser("PostQuery")
+    pq.add_argument("--broker", required=True)
+    pq.add_argument("--query", required=True)
+    pq.set_defaults(fn=cmd_post_query)
+
+    qs = sub.add_parser("QuickStart")
+    qs.add_argument("--rows", type=int, default=5000)
+    qs.add_argument("--exit-after", action="store_true")
+    qs.set_defaults(fn=cmd_quickstart)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,8 +87,10 @@ class PartitionUpsertMetadataManager:
                         valid[doc_id] = False
                 else:
                     self._map[pk] = (segment, doc_id, cmp_val)
-        if not valid.all():
-            segment.set_valid_docs(valid)
+        # publish unconditionally AFTER the rebuild: the caller must never
+        # pre-clear to None (that would expose superseded rows to queries
+        # running concurrently with the replay)
+        segment.set_valid_docs(valid if not valid.all() else None)
 
     def remap_segment(self, old, new, sealed_docs: int) -> None:
         """Seal: locations recorded against the consuming segment now live

@@ -1,0 +1,98 @@
+"""Admin CLI, quickstart, and the HTTP/DB-API client.
+
+Reference test model: pinot-tools command tests + Quickstart smoke +
+java-client/jdbc-client connection tests.
+"""
+import json
+
+import pytest
+
+from pinot_tpu.clients import Cursor, connect_url
+from pinot_tpu.query.sql import SqlError
+from pinot_tpu.tools.admin import main as admin_main
+from pinot_tpu.tools.quickstart import (SAMPLE_QUERIES, Quickstart,
+                                        example_schema,
+                                        write_example_data)
+
+
+@pytest.fixture(scope="module")
+def quickstart(tmp_path_factory):
+    qs = Quickstart(str(tmp_path_factory.mktemp("quick")), rows=800)
+    qs.start()
+    yield qs
+    qs.stop()
+
+
+class TestQuickstart:
+    def test_sample_queries_run(self, quickstart):
+        results = quickstart.run_sample_queries(out=lambda *_: None)
+        assert len(results) == len(SAMPLE_QUERIES)
+        assert results[0].rows == [(800,)]  # COUNT(*)
+        top = results[2]  # top players by runs
+        assert top.columns == ["playerName", "total_runs"]
+        runs = [r[1] for r in top.rows]
+        assert runs == sorted(runs, reverse=True)
+
+    def test_served_over_http(self, quickstart):
+        conn = connect_url(quickstart.broker.url)
+        r = conn("SELECT COUNT(*) FROM baseballStats WHERE homeRuns > 10")
+        assert 0 < r.rows[0][0] <= 800
+        assert r.num_segments >= 1
+
+    def test_http_error_surfaces_as_sqlerror(self, quickstart):
+        conn = connect_url(quickstart.broker.url)
+        with pytest.raises(SqlError):
+            conn("SELECT nope FROM baseballStats")
+
+
+class TestCursor:
+    def test_dbapi_flow(self, quickstart):
+        cur = Cursor(connect_url(quickstart.broker.url))
+        cur.execute("SELECT playerName, SUM(runs) FROM baseballStats "
+                    "GROUP BY playerName ORDER BY playerName LIMIT 3")
+        assert [d[0] for d in cur.description] == \
+            ["playerName", "sum(runs)"]
+        first = cur.fetchone()
+        assert first is not None
+        rest = cur.fetchall()
+        assert len(rest) == 2
+        assert cur.fetchone() is None
+        cur.close()
+
+
+class TestAdminCli:
+    def test_add_table_and_query(self, quickstart, tmp_path, capsys):
+        schema_file = tmp_path / "schema.json"
+        schema_file.write_text(json.dumps(example_schema().to_dict()))
+        rc = admin_main([
+            "AddTable", "--controller", quickstart.controller.url,
+            "--schema-file", str(schema_file), "--name", "cli_table"])
+        assert rc == 0
+        assert "cli_table" in \
+            quickstart.controller.routing_snapshot()["tables"]
+
+        rc = admin_main([
+            "PostQuery", "--broker", quickstart.broker.url,
+            "--query", "SELECT COUNT(*) FROM baseballStats"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "800" in out
+
+    def test_ingestion_job_cmd(self, quickstart, tmp_path, capsys):
+        data_dir = write_example_data(str(tmp_path / "raw"), rows=50)
+        from pinot_tpu.spi import TableConfig
+        spec = {
+            "inputDirURI": str(tmp_path / "raw"),
+            "outputDirURI": str(tmp_path / "segments"),
+            "tableName": "cli_ingest",
+            "schema": example_schema().to_dict(),
+            "tableConfig": TableConfig("cli_ingest").to_dict(),
+            "rowsPerSegment": 25,
+        }
+        spec_file = tmp_path / "job.json"
+        spec_file.write_text(json.dumps(spec))
+        rc = admin_main(["LaunchDataIngestionJob", "--job-spec",
+                         str(spec_file)])
+        assert rc == 0
+        assert "built 2 segment(s)" in capsys.readouterr().out
+        assert data_dir.endswith(".csv")
