@@ -96,27 +96,36 @@ def execute_plan(plan: CompiledPlan):
     return extract_partial(plan, out)
 
 
-def resolve_params(plan: CompiledPlan) -> Tuple[jax.Array, ...]:
+def resolve_params(plan: CompiledPlan, sharding=None) -> Tuple[jax.Array, ...]:
     """Materialize planner params: symbolic markers hit the segment device
-    cache; literal scalars/arrays upload (tiny)."""
+    cache; literal scalars/arrays upload (tiny).
+
+    `sharding` pins placement (e.g. a mesh-replicated NamedSharding for the
+    distributed path) so params never land on the default backend — required
+    when the process default is a real TPU but the query runs on a CPU mesh.
+    """
     seg = plan.segment
+
+    def put(x):
+        return jax.device_put(x, sharding)  # sharding None = default
+
     out = []
     for p in plan.params:
         if isinstance(p, tuple) and len(p) == 2 and p[0] == "dictvals":
-            out.append(seg.device_dict_values(p[1]))
+            out.append(seg.device_dict_values(p[1], sharding=sharding))
         elif isinstance(p, tuple) and len(p) == 2 and p[0] == "nullmask":
-            out.append(seg.device_null_mask(p[1]))
+            out.append(seg.device_null_mask(p[1], sharding=sharding))
         elif isinstance(p, tuple) and len(p) == 2 and p[0] == "validdocs":
-            out.append(seg.device_valid_mask())
+            out.append(seg.device_valid_mask(sharding=sharding))
         elif isinstance(p, tuple) and len(p) == 2 and p[0] == "docmask":
             # index-predicate doc mask (TEXT_MATCH/JSON_MATCH/
             # VECTOR_SIMILARITY): pad to the segment bucket
             mask = np.asarray(p[1], dtype=bool)
             padded = np.zeros(seg.bucket, dtype=bool)
             padded[: len(mask)] = mask
-            out.append(jax.device_put(padded))
+            out.append(put(padded))
         else:
-            out.append(jax.device_put(p))
+            out.append(put(p))
     return tuple(out)
 
 

@@ -148,7 +148,8 @@ class DistributedTable:
         plan = self.plan(ctx)
         if plan.kind != "kernel":
             return None
-        if any(isinstance(p, tuple) and p[0] in ("nullmask", "validdocs")
+        if any(isinstance(p, tuple)
+               and p[0] in ("nullmask", "validdocs", "docmask")
                for p in plan.params):
             return None  # per-segment data params need the per-segment path
         out = self._run(plan)
@@ -156,7 +157,9 @@ class DistributedTable:
 
     def _run(self, plan: CompiledPlan) -> Dict[str, np.ndarray]:
         cols = tuple(self.device_col(n) for n in plan.col_names)
-        params = resolve_params(plan)
+        # replicated placement on THIS mesh's devices — never the default
+        # backend (the driver's dryrun runs a CPU mesh under a TPU default)
+        params = resolve_params(plan, sharding=self._sharding(P()))
         fn = _distributed_kernel(plan.kernel_plan, self.bucket, self.mesh,
                                  len(cols), len(params))
         out = fn(cols, self._n_docs, params)

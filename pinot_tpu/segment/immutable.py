@@ -79,7 +79,8 @@ class ImmutableSegment:
         self._fwd: Dict[str, np.ndarray] = {}
         self._dicts: Dict[str, Dictionary] = {}
         self._nulls: Dict[str, Optional[np.ndarray]] = {}
-        self._device: Dict[Tuple[str, int], jax.Array] = {}
+        # key: (name, bucket, sharding) — sharding None = default backend
+        self._device: Dict[Tuple[str, int, Any], jax.Array] = {}
         # upsert validDocIds (None = all docs valid); versioned so the
         # device-resident copy invalidates on update
         self.valid_docs: Optional[np.ndarray] = None
@@ -171,7 +172,14 @@ class ImmutableSegment:
     def bucket(self) -> int:
         return bucket_for(self.n_docs)
 
-    def device_col(self, col: str, bucket: Optional[int] = None) -> jax.Array:
+    def _put(self, host: np.ndarray, sharding) -> jax.Array:
+        """device_put honoring an explicit placement (mesh sharding or
+        device, None = process default); bare placement is wrong when a
+        query runs on a CPU mesh under a TPU default."""
+        return jax.device_put(host, sharding)
+
+    def device_col(self, col: str, bucket: Optional[int] = None,
+                   sharding=None) -> jax.Array:
         """Padded device array for a column's stored representation.
 
         Dict ids upcast to int32 (byte-width storage is a host format detail;
@@ -179,7 +187,7 @@ class ImmutableSegment:
         Pad value 0 — validity masks make padding inert.
         """
         bucket = bucket or self.bucket
-        key = (col, bucket)
+        key = (col, bucket, sharding)
         if key not in self._device:
             m = self.columns[col]
             host = np.asarray(self.fwd(col))
@@ -188,35 +196,36 @@ class ImmutableSegment:
             if bucket > self.n_docs:
                 pad = np.zeros(bucket - self.n_docs, dtype=host.dtype)
                 host = np.concatenate([host, pad])
-            self._device[key] = jax.device_put(host)
+            self._device[key] = self._put(host, sharding)
         return self._device[key]
 
-    def device_cols(self, cols: List[str], bucket: Optional[int] = None
-                    ) -> Tuple[jax.Array, ...]:
+    def device_cols(self, cols: List[str], bucket: Optional[int] = None,
+                    sharding=None) -> Tuple[jax.Array, ...]:
         bucket = bucket or self.bucket
-        return tuple(self.device_col(c, bucket) for c in cols)
+        return tuple(self.device_col(c, bucket, sharding=sharding)
+                     for c in cols)
 
-    def device_dict_values(self, col: str) -> jax.Array:
+    def device_dict_values(self, col: str, sharding=None) -> jax.Array:
         """Device-resident sorted dictionary values (cached; used for
         id->value gathers inside kernels)."""
-        key = (f"__dict__{col}", 0)
+        key = (f"__dict__{col}", 0, sharding)
         if key not in self._device:
             m = self.columns[col]
             vals = np.asarray(self.dictionary(col).values,
                               dtype=m.data_type.np_dtype)
-            self._device[key] = jax.device_put(vals)
+            self._device[key] = self._put(vals, sharding)
         return self._device[key]
 
-    def device_null_mask(self, col: str, bucket: Optional[int] = None
-                         ) -> jax.Array:
+    def device_null_mask(self, col: str, bucket: Optional[int] = None,
+                         sharding=None) -> jax.Array:
         bucket = bucket or self.bucket
-        key = (f"__null__{col}", bucket)
+        key = (f"__null__{col}", bucket, sharding)
         if key not in self._device:
             nm = self.null_mask(col)
             padded = np.zeros(bucket, dtype=bool)
             if nm is not None:
                 padded[: len(nm)] = nm
-            self._device[key] = jax.device_put(padded)
+            self._device[key] = self._put(padded, sharding)
         return self._device[key]
 
     def set_valid_docs(self, mask: Optional[np.ndarray]) -> None:
@@ -236,16 +245,17 @@ class ImmutableSegment:
             return
         np.packbits(self.valid_docs).tofile(path)
 
-    def device_valid_mask(self, bucket: Optional[int] = None) -> jax.Array:
+    def device_valid_mask(self, bucket: Optional[int] = None,
+                          sharding=None) -> jax.Array:
         bucket = bucket or self.bucket
-        key = (f"__valid__v{self.valid_docs_version}", bucket)
+        key = (f"__valid__v{self.valid_docs_version}", bucket, sharding)
         if key not in self._device:
             padded = np.zeros(bucket, dtype=bool)
             if self.valid_docs is not None:
                 padded[: self.n_docs] = self.valid_docs
             else:
                 padded[: self.n_docs] = True
-            self._device[key] = jax.device_put(padded)
+            self._device[key] = self._put(padded, sharding)
         return self._device[key]
 
     def evict_device(self) -> None:
