@@ -76,7 +76,10 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
         # preemption point between per-segment launches (the hot-loop
         # ThreadAccountantOps.sample analog): raises on kill/timeout
         global_accountant.sample()
-        if plan.kind != "kernel":
+        if plan.kind != "kernel" or plan.kernel_plan.strategy == "compact":
+            # compact-strategy plans launch per segment: the Pallas
+            # compaction kernel doesn't vmap, and big-space group-bys are
+            # single-large-segment workloads anyway
             results[i] = execute_plan(plan)
             continue
         params = resolve_params(plan)

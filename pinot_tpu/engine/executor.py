@@ -136,6 +136,14 @@ def run_kernel(plan: CompiledPlan) -> Dict[str, np.ndarray]:
     fn = jitted_kernel(plan.kernel_plan, seg.bucket)
     out = fn(cols, np.int32(seg.n_docs), params)
     host = jax.device_get(out)
+    if int(host.pop("overflow", 0)):
+        # compact-strategy capacity exceeded (high selectivity): rerun with
+        # a capacity that cannot overflow (ops/compact.full_slots_cap)
+        from ..ops.compact import full_slots_cap
+        fn = jitted_kernel(plan.kernel_plan, seg.bucket,
+                           full_slots_cap(seg.bucket))
+        host = jax.device_get(fn(cols, np.int32(seg.n_docs), params))
+        host.pop("overflow", None)
     from .accounting import global_accountant
     global_accountant.track_memory(
         sum(np.asarray(v).nbytes for v in host.values()))

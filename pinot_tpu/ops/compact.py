@@ -1,0 +1,310 @@
+"""Masked row compaction — the TPU-native DocIdSet/Projection primitive.
+
+Reference parity: pinot-core/.../operator/DocIdSetOperator.java:59-86
+materializes filtered docIds in blocks, then ProjectionOperator.java:67-78
+batch-gathers projected columns for them. The TPU analog cannot scatter
+(no efficient per-lane scatter on the VPU), so compaction works lane-wise:
+
+- the (N,) column is viewed as (N/128, 128) — 128 independent lane streams;
+- per (R,128) tile, each lane compacts its matched rows to the top via a
+  broadcast-compare scatter (dest[r,c] = exclusive in-lane count, an
+  R x R strict-lower-triangular matmul, then sum_r [dest==s] * x — all
+  VPU/MXU ops, no scatter);
+- every lane stream advances by the same amount: the tile's max per-lane
+  count. Short lanes pad with invalid slots (valid flags are compacted
+  alongside), so the output is "loosely compacted": size ~ matched rows
+  times a small inflation factor, never more than the input;
+- a running slot offset carried in SMEM across the (sequential) TPU grid
+  places each tile's rows; each DMA writes a full fixed-size staging
+  block and the next tile's DMA overwrites the garbage tail.
+
+Order is NOT preserved — group-by / aggregation consumers don't need it.
+
+Outputs are (slots_cap*128,) arrays + (n_slots, matched, overflow)
+scalars. Rows at index >= n_slots*128 are uninitialized; consumers must
+mask with `valid & (iota < n_slots*128)`. overflow != 0 means capacity
+was exceeded and the result is incomplete — retry with full capacity
+(`full_slots_cap(n)` can never overflow).
+
+On CPU (tests, host fallback) an XLA nonzero-based implementation is used.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+LANES = 128
+R = 32                 # sublane rows per subtile
+K = 8                  # subtiles per grid step
+STEP = K * R           # input rows consumed per grid step
+STAGE = K * R + R      # staging rows (worst case: K subtiles all full + pad)
+
+
+def default_slots_cap(n: int) -> int:
+    """Default output capacity (slot rows): 1/8 of the input, padded."""
+    return max(n // (8 * LANES), 2 * STAGE) + STAGE
+
+
+def full_slots_cap(n: int) -> int:
+    """Capacity that can never overflow: total slot advance is bounded by
+    one slot row per input row-of-128 plus one pad row per subtile."""
+    return n // LANES + n // (R * LANES) + STAGE
+
+
+def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int):
+    """Compact masked elements of 1-D arrays toward the front (lane-wise).
+
+    mask: (N,) bool; cols: tuple of (N,) arrays. 64-bit columns are
+    bit-split into int32 pairs around the kernel. Returns
+    (valid, out_cols, n_valid_rows, matched, overflow) with
+    valid/out_cols of length slots_cap*128.
+    """
+    n = mask.shape[0]
+    # split 64-bit columns into int32 pairs (exact for int64 and float64)
+    split_cols = []
+    recipes = []  # (dtype, n_parts)
+    for c in cols:
+        if c.dtype.itemsize == 8:
+            pair = jax.lax.bitcast_convert_type(c, jnp.int32)  # (N, 2)
+            split_cols.extend([pair[:, 0], pair[:, 1]])
+            recipes.append((c.dtype, 2))
+        elif c.dtype.itemsize == 4:
+            split_cols.append(jax.lax.bitcast_convert_type(c, jnp.int32))
+            recipes.append((c.dtype, 1))
+        else:
+            split_cols.append(c.astype(jnp.int32))
+            recipes.append((jnp.dtype(jnp.int32), 1))
+
+    if _use_pallas(n):
+        valid, outs, n_slots, matched, overflow = _compact_pallas(
+            mask, tuple(split_cols), n, slots_cap)
+    else:
+        valid, outs, n_slots, matched, overflow = _compact_xla(
+            mask, tuple(split_cols), n, slots_cap)
+
+    # recombine split columns
+    out_cols = []
+    i = 0
+    for dtype, parts in recipes:
+        if parts == 2:
+            pair = jnp.stack([outs[i], outs[i + 1]], axis=-1)
+            out_cols.append(jax.lax.bitcast_convert_type(pair, dtype))
+            i += 2
+        else:
+            out_cols.append(jax.lax.bitcast_convert_type(outs[i], dtype)
+                            if dtype != jnp.int32 else outs[i])
+            i += 1
+    n_valid = n_slots * LANES
+    return valid, tuple(out_cols), n_valid, matched, overflow
+
+
+def _use_pallas(n: int) -> bool:
+    return (jax.default_backend() == "tpu"
+            and n % (STEP * LANES) == 0 and n >= STEP * LANES)
+
+
+def _compact_xla(mask, cols, n, slots_cap):
+    """Fallback: dense compaction via nonzero (fast on CPU)."""
+    cap = slots_cap * LANES
+    size = min(cap, n)
+    idx, = jnp.nonzero(mask, size=size, fill_value=n)
+    valid_small = idx < n
+    outs = [jnp.where(valid_small, c.at[idx].get(mode="clip"), 0)
+            for c in cols]
+    if cap > size:
+        pad = cap - size
+        valid = jnp.concatenate(
+            [valid_small, jnp.zeros(pad, dtype=jnp.bool_)])
+        outs = [jnp.concatenate([o, jnp.zeros(pad, dtype=o.dtype)])
+                for o in outs]
+    else:
+        valid = valid_small
+    matched = jnp.sum(mask, dtype=jnp.int32)
+    overflow = (matched > cap).astype(jnp.int32)
+    n_slots = jnp.minimum((matched + LANES - 1) // LANES,
+                          jnp.int32(slots_cap))
+    return valid, outs, n_slots, matched, overflow
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    col_refs = rest[:n_cols]
+    valid_out = rest[n_cols]
+    col_outs = rest[n_cols + 1: 2 * n_cols + 1]
+    nslots_ref = rest[2 * n_cols + 1]
+    matched_ref = rest[2 * n_cols + 2]
+    overflow_ref = rest[2 * n_cols + 3]
+    carry = rest[2 * n_cols + 4]            # SMEM (2,): [off, matched]
+    oflow = rest[2 * n_cols + 5]            # SMEM (1,)
+    stages = rest[2 * n_cols + 6: 3 * n_cols + 7]   # VMEM staging per col
+    sems = rest[3 * n_cols + 7]             # DMA sems (n_cols + 1,)
+
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        carry[0] = 0
+        carry[1] = 0
+        oflow[0] = 0
+
+    # strict lower triangular (R x R): exclusive in-lane running count
+    row_i = jax.lax.broadcasted_iota(jnp.int32, (R, R), 0)
+    col_i = jax.lax.broadcasted_iota(jnp.int32, (R, R), 1)
+    stril = (row_i > col_i).astype(jnp.int32).astype(jnp.float32)
+    out_iota = jax.lax.broadcasted_iota(jnp.int32, (R, R, LANES), 0)
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
+    stage_iota = jax.lax.broadcasted_iota(jnp.int32, (STAGE, R), 0)
+    sub_iota = jax.lax.broadcasted_iota(jnp.int32, (STAGE, R), 1)
+
+    # staging accumulators as values; each subtile contributes via an
+    # (STAGE, R) one-hot stacking matmul (invalid slots are exact zeros,
+    # so overlapping garbage rows can't corrupt the sums). Stacking runs
+    # in single-pass bf16: columns are split into bytes (|v| <= 255 is
+    # bf16-exact) and recombined after f32 accumulation.
+    valid_acc = jnp.zeros((STAGE, LANES), jnp.float32)
+    byte_accs = [[jnp.zeros((STAGE, LANES), jnp.float32) for _ in range(4)]
+                 for _ in range(n_cols)]
+
+    local_off = jnp.int32(0)
+    total = jnp.int32(0)
+    for k in range(K):
+        sl = slice(k * R, (k + 1) * R)
+        m = mask_ref[sl, :] != 0                       # (R, 128)
+        mf = m.astype(jnp.int32).astype(jnp.float32)
+        cnt = jnp.sum(m.astype(jnp.int32), axis=0,
+                      dtype=jnp.int32)                 # (128,)
+        adv = jnp.max(cnt)
+        dest = jax.lax.dot_general(
+            stril, mf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32)
+        scat = (dest[None, :, :] == out_iota) & m[None, :, :]  # (R, R, 128)
+        stack = (stage_iota == local_off + sub_iota)\
+            .astype(jnp.int32).astype(jnp.bfloat16)
+
+        def place(tile_bf16):
+            return jax.lax.dot_general(
+                stack, tile_bf16, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        valid_acc = valid_acc + place(
+            (row_iota < cnt[None, :]).astype(jnp.int32)
+            .astype(jnp.bfloat16))
+        for ci in range(n_cols):
+            x = col_refs[ci][sl, :]
+            comp = jnp.sum(jnp.where(scat, x[None, :, :], jnp.int32(0)),
+                           axis=1, dtype=jnp.int32)    # (R, 128) int32
+            for b in range(4):
+                if b < 3:
+                    part = jax.lax.bitwise_and(
+                        jax.lax.shift_right_logical(comp, jnp.int32(8 * b)),
+                        jnp.int32(0xFF))
+                else:
+                    part = jax.lax.shift_right_arithmetic(comp, jnp.int32(24))
+                byte_accs[ci][b] = byte_accs[ci][b] + place(
+                    part.astype(jnp.float32).astype(jnp.bfloat16))
+        local_off = local_off + adv
+        # f32 scalar sum (exact: <= 4096 per step); jnp.sum-to-scalar on
+        # int32 sneaks an int64 intermediate past the Mosaic lowering
+        total = total + jnp.sum(cnt.astype(jnp.float32),
+                                dtype=jnp.float32).astype(jnp.int32)
+
+    off = carry[0]
+    fits = off + STAGE <= slots_cap
+
+    for ci in range(n_cols + 1):
+        if ci == 0:
+            val = valid_acc.astype(jnp.int32)
+        else:
+            acc = byte_accs[ci - 1]
+            val = (((acc[3].astype(jnp.int32) * jnp.int32(256)
+                     + acc[2].astype(jnp.int32)) * jnp.int32(256)
+                    + acc[1].astype(jnp.int32)) * jnp.int32(256)
+                   + acc[0].astype(jnp.int32))
+        stages[ci][:] = val
+
+    # DMA start + synchronous wait inside one conditional block: a skipped
+    # step (overflow) skips both, so no semaphore imbalance across steps
+    @pl.when(fits)
+    def _():
+        cps = []
+        for ci in range(n_cols + 1):
+            dst = valid_out if ci == 0 else col_outs[ci - 1]
+            cp = pltpu.make_async_copy(
+                stages[ci].at[:], dst.at[pl.ds(off, STAGE)], sems.at[ci])
+            cp.start()
+            cps.append(cp)
+        for cp in cps:
+            cp.wait()
+        carry[0] = off + local_off
+
+    @pl.when(jnp.logical_not(fits))
+    def _():
+        oflow[0] = 1
+
+    carry[1] = carry[1] + total
+
+    @pl.when(step == n_steps - 1)
+    def _():
+        nslots_ref[0, 0] = carry[0]
+        matched_ref[0, 0] = carry[1]
+        overflow_ref[0, 0] = oflow[0]
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _compact_pallas(mask, cols, n, slots_cap):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_cols = len(cols)
+    n_steps = n // (STEP * LANES)
+    mask2d = mask.reshape(n // LANES, LANES).astype(jnp.uint8)
+    cols2d = [c.reshape(n // LANES, LANES) for c in cols]
+
+    in_specs = [pl.BlockSpec((STEP, LANES), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)] * (n_cols + 1)
+    out_shapes = ([jax.ShapeDtypeStruct((slots_cap, LANES), jnp.int32)]
+                  * (n_cols + 1)
+                  + [jax.ShapeDtypeStruct((1, 1), jnp.int32)] * 3)
+    out_specs = ([pl.BlockSpec(memory_space=pl.ANY)] * (n_cols + 1)
+                 + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 3)
+
+    kern = functools.partial(_kernel, n_cols=n_cols, slots_cap=slots_cap,
+                             n_steps=n_steps)
+    call = pl.pallas_call(
+        kern,
+        grid=(n_steps,),
+        in_specs=in_specs,
+        out_shape=out_shapes,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.SMEM((2,), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
+        ] + [pltpu.VMEM((STAGE, LANES), jnp.int32)] * (n_cols + 1)
+          + [pltpu.SemaphoreType.DMA((n_cols + 1,))],
+    )
+    # the kernel is pure 32-bit; keep x64 promotion rules out of the trace
+    with jax.enable_x64(False):
+        outs = call(mask2d, *cols2d)
+
+    valid2d = outs[0]
+    col2d = outs[1: n_cols + 1]
+    n_slots = outs[n_cols + 1][0, 0]
+    matched = outs[n_cols + 2][0, 0]
+    overflow = outs[n_cols + 3][0, 0]
+
+    cap_rows = slots_cap * LANES
+    row_ok = (jnp.arange(cap_rows, dtype=jnp.int32)
+              < n_slots * LANES)
+    valid = (valid2d.reshape(cap_rows) != 0) & row_ok
+    out_cols = tuple(jnp.where(valid, c.reshape(cap_rows), 0)
+                     for c in col2d)
+    return valid, out_cols, n_slots, matched, overflow

@@ -164,10 +164,19 @@ class KernelPlan:
     """Everything the kernel builder needs, hashable. group_keys is a tuple
     of (col_index, cardinality): group-by keys must be dict-encoded stored
     columns; the dense group key is cartesian dict-id arithmetic exactly
-    like DictionaryBasedGroupKeyGenerator.java:63."""
+    like DictionaryBasedGroupKeyGenerator.java:63.
+
+    strategy selects the group-by execution shape (ops/kernels.py):
+    - 'dense':   one-hot dot_general over all rows — small group spaces;
+    - 'compact': Pallas masked-row compaction (ops/compact.py), then
+      factorized one-hot matmuls (small spaces) or sort + boundary diffs
+      (large spaces) over the compacted rows only. The TPU answer to
+      DocIdSetOperator + DefaultGroupByExecutor at SSB selectivities.
+    """
     pred: Pred
     aggs: Tuple[AggSpec, ...]
     group_keys: Tuple[Tuple[int, int], ...] = ()
+    strategy: str = "dense"
 
     @property
     def group_space(self) -> int:

@@ -33,7 +33,7 @@ Kernel signature (shape-stable, no data-dependent shapes):
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -370,10 +370,264 @@ def _group_minmax(i: int, spec: AggSpec, mask, keys, space: int, cols,
 
 
 # ---------------------------------------------------------------------------
+# compacted group-by (Pallas compaction -> aggregate matched rows only)
+# ---------------------------------------------------------------------------
+
+# factorized one-hot matmul above this space would still be cheap, but the
+# (M, space/128) int8 operand materialization starts to dominate; the sort
+# path takes over (cap: searchsorted probes scale with space)
+FACTORIZED_GROUP_LIMIT = 1 << 14
+COMPACT_GROUP_LIMIT = 1 << 20
+
+
+def _value_col_indices(ve) -> set:
+    if isinstance(ve, Col):
+        return {ve.col}
+    if isinstance(ve, Bin):
+        return _value_col_indices(ve.lhs) | _value_col_indices(ve.rhs)
+    return set()
+
+
+def chunked_cumsum(x: jax.Array, chunk: int = 1 << 13) -> jax.Array:
+    """Two-level cumsum: XLA's monolithic reduce-window lowering blows
+    scoped VMEM beyond ~16M elements on TPU; chunking keeps windows small
+    and is faster besides."""
+    n = x.shape[0]
+    if n <= chunk or n % chunk != 0:
+        return jnp.cumsum(x)
+    m = n // chunk
+    x2 = x.reshape(m, chunk)
+    within = jnp.cumsum(x2, axis=1)
+    carry = jnp.concatenate(
+        [jnp.zeros(1, x.dtype), jnp.cumsum(within[:, -1])[:-1]])
+    return (within + carry[:, None]).reshape(n)
+
+
+_IMIN64 = -(1 << 63)
+
+
+def _to_orderable64(v: jax.Array, integral: bool) -> jax.Array:
+    """Order-preserving map to int64 (full width, exact). Integers pass
+    through; floats map via the classic sign-flip bijection on their f64
+    bit patterns: non-negatives keep their bits, negatives reverse order
+    and land below (imin + ~bits)."""
+    if integral:
+        return v.astype(jnp.int64)
+    bits = jax.lax.bitcast_convert_type(v.astype(jnp.float64), jnp.int64)
+    return jnp.where(bits >= 0, bits,
+                     jnp.int64(_IMIN64) + jnp.bitwise_not(bits))
+
+
+def _from_orderable64(o: jax.Array, integral: bool, acc_f) -> jax.Array:
+    if integral:
+        return o
+    neg_bits = jnp.bitwise_not(o - jnp.int64(_IMIN64))
+    bits = jnp.where(o >= 0, o, neg_bits)
+    return jax.lax.bitcast_convert_type(bits, jnp.float64).astype(acc_f)
+
+
+def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
+                        slots_cap: int, out: Dict[str, jax.Array]) -> None:
+    """Group aggregation over compacted matched rows.
+
+    Reference parity: DocIdSetOperator (docId materialization) +
+    DefaultGroupByExecutor, reshaped for the TPU: the Pallas compaction
+    kernel (ops/compact.py) concentrates the matched rows, then either a
+    factorized two-sided one-hot matmul (sums/counts, space <= 2^14: cost
+    M x space MACs on the MXU with no giant operand) or one sort + chunked
+    cumsum + boundary diffs (any agg, space <= 2^20) finishes the job.
+    Outputs are the same dense (space,) arrays as the dense strategy, so
+    extraction and broker reduce are strategy-agnostic.
+    """
+    from .compact import compact
+
+    space = plan.group_space
+    needed = sorted({ci for ci, _ in plan.group_keys}
+                    | set().union(*[_value_col_indices(s.value)
+                                    for s in plan.aggs if s.value is not None]
+                                  or [set()]))
+    valid, comp, n_valid, matched, overflow = compact(
+        mask, tuple(cols[ci] for ci in needed), slots_cap)
+    out["overflow"] = overflow
+    out["matched"] = matched.astype(int_acc_dtype())
+    ccols: List[Optional[jax.Array]] = [None] * len(cols)
+    for i, ci in enumerate(needed):
+        ccols[ci] = comp[i]
+    m = valid.shape[0]
+
+    keys = jnp.zeros((m,), dtype=jnp.int32)
+    for col_idx, card in plan.group_keys:
+        keys = keys * jnp.int32(card) + ccols[col_idx].astype(jnp.int32)
+    keys = jnp.where(valid, keys, space)  # sentinel past the space
+
+    needs_sort = (space > FACTORIZED_GROUP_LIMIT
+                  or any(s.kind in ("min", "max") for s in plan.aggs))
+    if needs_sort:
+        _sorted_group(plan, keys, valid, ccols, params, space, out)
+    else:
+        _factorized_group(plan, keys, valid, ccols, params, space, m, out)
+
+
+def _factorized_group(plan, keys, valid, ccols, params, space, m, out):
+    """sums[hi, lo] = (oh_hi . limb)^T @ oh_lo — two fused one-hot operands
+    keep the contraction on the MXU without materializing (M, space)."""
+    g_pad = -(-(space + 1) // 128) * 128
+    n_hi = g_pad // 128
+    hi = keys >> jnp.int32(7)
+    lo = keys & jnp.int32(127)
+    oh_hi = jax.nn.one_hot(hi, n_hi, dtype=jnp.int8)      # (M, n_hi)
+    oh_lo = jax.nn.one_hot(lo, 128, dtype=jnp.int8)       # (M, 128)
+
+    def int_rows_matmul(rows8: List[jax.Array]) -> jax.Array:
+        lhs = jnp.stack([oh_hi * r[:, None] for r in rows8], axis=0)
+        return jax.lax.dot_general(
+            lhs, oh_lo, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)  # (n_rows, n_hi, 128)
+
+    cnt_dtype = int_acc_dtype()
+    int_rows: List[jax.Array] = [valid.astype(jnp.int8)]
+    row_meta: List[Tuple[int, List[int], int]] = []
+    float_jobs: List[Tuple[int, AggSpec]] = []
+    deferred: List[Tuple[int, AggSpec, str]] = []
+
+    for i, spec in enumerate(plan.aggs):
+        if spec.kind == "count":
+            continue
+        if spec.kind in ("sum", "avg") and spec.integral:
+            vals = _eval_value(spec.value, ccols, params, promote=True)
+            vals = jnp.where(valid, vals, 0)
+            rows, signs, b = _limb_rows(vals, valid, spec.bits, spec.signed,
+                                        m)
+            row_meta.append((len(int_rows), signs, b))
+            int_rows.extend(rows)
+            deferred.append((i, spec, "int_sum"))
+        elif spec.kind in ("sum", "avg"):
+            float_jobs.append((i, spec))
+            deferred.append((i, spec, "float_sum"))
+        else:
+            raise ValueError(
+                f"factorized group-by cannot lower {spec.kind!r}")
+
+    S = int_rows_matmul(int_rows)            # (R, n_hi, 128) int32
+    flat = S.reshape(S.shape[0], g_pad)[:, :space]
+    counts = flat[0].astype(cnt_dtype)
+    out["group_count"] = counts
+
+    if float_jobs:
+        acc_f = float_acc_dtype()
+        ohf_hi = oh_hi.astype(acc_f)
+        ohf_lo = oh_lo.astype(acc_f)
+        frows = []
+        for i, spec in float_jobs:
+            v = _eval_value(spec.value, ccols, params).astype(acc_f)
+            frows.append(jnp.where(valid, v, 0))
+        lhs = jnp.stack([ohf_hi * r[:, None] for r in frows], axis=0)
+        F = jax.lax.dot_general(
+            lhs, ohf_lo, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=acc_f)
+        Fflat = F.reshape(F.shape[0], g_pad)[:, :space]
+
+    meta_iter = iter(row_meta)
+    fi = 0
+    for i, spec, how in deferred:
+        name = _agg_name(i, spec)
+        if how == "int_sum":
+            start, signs, b = next(meta_iter)
+            total = jnp.zeros((space,), dtype=jnp.int64)
+            nl = signs.count(1)
+            for j, sign in enumerate(signs):
+                w = jnp.int64(1) << jnp.int64(b * (j % nl))
+                total = total + jnp.int64(sign) * w * \
+                    flat[start + j].astype(jnp.int64)
+            if spec.kind == "avg":
+                out[name + "_sum"] = total
+                out[name + "_cnt"] = counts
+            else:
+                out[name] = total
+        else:
+            row = Fflat[fi]
+            fi += 1
+            if spec.kind == "avg":
+                out[name + "_sum"] = row
+                out[name + "_cnt"] = counts
+            else:
+                out[name] = row
+
+
+def _sorted_group(plan, keys, valid, ccols, params, space, out):
+    """Sort-based group aggregation: one sort of the compacted rows, then
+    chunked cumsum + boundary diffs (sums/counts) and first/last-element
+    gathers on composite keys (min/max). Edge positions come from
+    searchsorted over the sorted keys (space + 1 probes)."""
+    acc_f = float_acc_dtype()
+    cnt_dtype = int_acc_dtype()
+
+    # gather all payloads that ride the main key sort
+    sum_payloads: List[Tuple[int, AggSpec, jax.Array]] = []
+    minmax: List[Tuple[int, AggSpec, jax.Array]] = []
+    for i, spec in enumerate(plan.aggs):
+        if spec.kind == "count":
+            continue
+        v = _eval_value(spec.value, ccols, params,
+                        promote=spec.integral)
+        if spec.kind in ("sum", "avg"):
+            if spec.integral:
+                v = jnp.where(valid, v, 0).astype(jnp.int64)
+            else:
+                v = jnp.where(valid, v, 0).astype(acc_f)
+            sum_payloads.append((i, spec, v))
+        else:
+            minmax.append((i, spec, v))
+
+    operands = [keys, valid.astype(jnp.int32)] + [p for _, _, p in
+                                                  sum_payloads]
+    sorted_ops = jax.lax.sort(operands, num_keys=1)
+    sk = sorted_ops[0]
+    edges = jnp.searchsorted(sk, jnp.arange(space + 1, dtype=jnp.int32))
+
+    def group_sums(sorted_vals, dtype):
+        cs = chunked_cumsum(sorted_vals.astype(dtype))
+        tot = jnp.concatenate([jnp.zeros(1, dtype), cs])
+        return tot[edges[1:]] - tot[edges[:-1]]
+
+    counts = group_sums(sorted_ops[1], jnp.int64).astype(cnt_dtype)
+    out["group_count"] = counts
+
+    for oi, (i, spec, _) in enumerate(sum_payloads):
+        name = _agg_name(i, spec)
+        sv = sorted_ops[2 + oi]
+        s = group_sums(sv, jnp.int64 if spec.integral else acc_f)
+        if spec.kind == "avg":
+            out[name + "_sum"] = s
+            out[name + "_cnt"] = counts
+        else:
+            out[name] = s
+
+    for i, spec, v in minmax:
+        # lexicographic (key, orderable-value) sort: group min = first
+        # element of the group's run, max = last. The int64 orderable is
+        # exact for both 64-bit ints and doubles.
+        name = _agg_name(i, spec)
+        integral = spec.integral and jnp.issubdtype(v.dtype, jnp.integer)
+        o = _to_orderable64(v, integral)
+        keys_sorted, o_sorted = jax.lax.sort([keys, o], num_keys=2)
+        e2 = jnp.searchsorted(keys_sorted,
+                              jnp.arange(space + 1, dtype=jnp.int32))
+        if spec.kind == "min":
+            pos = jnp.minimum(e2[:-1], keys.shape[0] - 1)
+        else:
+            pos = jnp.clip(e2[1:] - 1, 0, keys.shape[0] - 1)
+        picked = o_sorted.at[pos].get(mode="clip")
+        out[name] = _from_orderable64(picked, integral, acc_f)
+
+
+# ---------------------------------------------------------------------------
 # kernel assembly
 # ---------------------------------------------------------------------------
 
-def build_kernel(plan: KernelPlan, bucket: int):
+def build_kernel(plan: KernelPlan, bucket: int,
+                 slots_cap: Optional[int] = None):
     """Return fn(cols, n_docs, params) -> dict of partial aggregation states.
 
     Shape contract: every cols[i] has the same (bucket,) length; n_docs is a
@@ -381,6 +635,10 @@ def build_kernel(plan: KernelPlan, bucket: int):
     (scalars, or (group_space,) arrays) — never from the data. bucket is
     static (plans may bind zero columns, e.g. COUNT(*) with an IS NULL
     filter, so it can't be derived from cols).
+
+    slots_cap sizes the compaction output for the 'compact' strategy
+    (default: ops/compact.default_slots_cap(bucket)); the returned dict's
+    "overflow" entry tells the executor to retry with full capacity.
     """
 
     def kernel(cols: Tuple[jax.Array, ...], n_docs: jax.Array,
@@ -388,6 +646,11 @@ def build_kernel(plan: KernelPlan, bucket: int):
         valid = jnp.arange(bucket, dtype=jnp.int32) < n_docs
         mask = valid & _eval_pred(plan.pred, cols, params, bucket)
         out: Dict[str, jax.Array] = {}
+        if plan.is_group_by and plan.strategy == "compact":
+            from .compact import default_slots_cap
+            cap = slots_cap or default_slots_cap(bucket)
+            _compact_group_aggs(plan, mask, cols, params, bucket, cap, out)
+            return out
         out["matched"] = jnp.sum(mask, dtype=int_acc_dtype())
         if plan.is_group_by:
             _group_aggs(plan, mask, cols, params, bucket, out)
@@ -400,6 +663,7 @@ def build_kernel(plan: KernelPlan, bucket: int):
 
 
 @functools.lru_cache(maxsize=1024)
-def jitted_kernel(plan: KernelPlan, bucket: int):
-    """jit once per (plan structure, bucket)."""
-    return jax.jit(build_kernel(plan, bucket))
+def jitted_kernel(plan: KernelPlan, bucket: int,
+                  slots_cap: Optional[int] = None):
+    """jit once per (plan structure, bucket, compaction capacity)."""
+    return jax.jit(build_kernel(plan, bucket, slots_cap))

@@ -138,8 +138,11 @@ class DistributedTable:
     def plan(self, ctx: QueryContext) -> CompiledPlan:
         """Plan against the widened table view; shared dictionaries make the
         dict-id params valid table-wide, and widened min/max keep raw-column
-        constant folds and limb sizing correct for every segment."""
-        return SegmentPlanner(ctx, self._plan_view()).plan()
+        constant folds and limb sizing correct for every segment.
+        prefer_dense: the mesh path vmaps the kernel over local segments,
+        which the compact strategy's Pallas call does not support."""
+        return SegmentPlanner(ctx, self._plan_view(),
+                              prefer_dense=True).plan()
 
     def try_execute(self, ctx: QueryContext):
         """Distributed partial, or None when the plan needs the per-segment

@@ -39,6 +39,9 @@ from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, Comparison,
 
 MAX_DENSE_GROUPS = 1 << 21          # beyond this, host hash group-by
 MAX_DISTINCT_MATRIX = 1 << 24       # group_space * card gate for on-device
+# small spaces stay on the dense one-hot kernel (one fused pass, vmap- and
+# mesh-friendly); larger spaces compact matched rows first (ops/compact.py)
+DENSE_SMALL_GROUPS = 512
 
 
 class PlanError(SqlError):
@@ -174,9 +177,14 @@ def _like_to_regex(pattern: str) -> "re.Pattern":
 # ---------------------------------------------------------------------------
 
 class SegmentPlanner:
-    def __init__(self, ctx: QueryContext, segment: ImmutableSegment):
+    def __init__(self, ctx: QueryContext, segment: ImmutableSegment,
+                 prefer_dense: bool = False):
+        """prefer_dense keeps group-bys on the dense one-hot strategy (the
+        vmap/shard_map-compatible shape) — the distributed mesh path sets
+        it because the Pallas compaction kernel is per-device only."""
         self.ctx = ctx
         self.seg = segment
+        self.prefer_dense = prefer_dense
         self.b = _Binder(segment)
 
     # -- value expressions -------------------------------------------------
@@ -613,7 +621,10 @@ class SegmentPlanner:
                     dense_ok = False
                     break
                 space *= max(m.cardinality, 1)
-            if not dense_ok or space > MAX_DENSE_GROUPS:
+            from ..ops.kernels import COMPACT_GROUP_LIMIT
+            space_cap = (MAX_DENSE_GROUPS if self.prefer_dense
+                         else max(MAX_DENSE_GROUPS, COMPACT_GROUP_LIMIT))
+            if not dense_ok or space > space_cap:
                 return CompiledPlan("host", seg, ctx)
 
         # fast path: no filter, metadata/dictionary-answerable aggs, no group
@@ -640,29 +651,48 @@ class SegmentPlanner:
                         and s.card > 1 << 16:
                     return CompiledPlan("host", seg, ctx)
 
+        strategy = "dense"
         if ctx.is_group_by:
             for g in ctx.group_by:
                 m = seg.columns[g.name]
                 idx = self.b.bind_col(g.name)
                 group_keys.append((idx, m.cardinality))
                 group_cols.append(g.name)
-            # gate on-device distinct matrices and large-space min/max
             space = 1
             for _, c in group_keys:
                 space *= max(c, 1)
             import jax as _jax
+
+            from ..ops.kernels import COMPACT_GROUP_LIMIT
             slow_scatter = _jax.default_backend() != "cpu"
+            # compact strategy: Pallas row compaction + factorized/sorted
+            # aggregation (ops/kernels._compact_group_aggs); covers every
+            # core numeric agg (min/max ride an exact int64 orderable in a
+            # lexicographic sort)
+            compact_ok = (
+                not self.prefer_dense
+                and space <= COMPACT_GROUP_LIMIT
+                and all(s.kind in ("count", "sum", "avg", "min", "max")
+                        for s in specs))
+            # dense-strategy viability (one-hot over all rows)
+            dense_viable = space <= MAX_DENSE_GROUPS
             for s in specs:
                 if s.kind == "distinct_count" and s.card is not None \
                         and space * s.card > MAX_DISTINCT_MATRIX:
-                    return CompiledPlan("host", seg, ctx)
+                    dense_viable = False
                 if s.kind in ("min", "max") and slow_scatter and space > 64:
-                    # no matmul form for min/max; TPU scatter is pathological
-                    # (kernels.MINMAX_UNROLL_GROUPS) -> host numpy
-                    return CompiledPlan("host", seg, ctx)
+                    # no matmul form for min/max; TPU scatter is
+                    # pathological (kernels.MINMAX_UNROLL_GROUPS)
+                    dense_viable = False
+            if compact_ok and (space > DENSE_SMALL_GROUPS
+                               or not dense_viable):
+                strategy = "compact"
+            elif not dense_viable:
+                return CompiledPlan("host", seg, ctx)
 
         plan = KernelPlan(pred=pred, aggs=tuple(specs),
-                          group_keys=tuple(group_keys))
+                          group_keys=tuple(group_keys),
+                          strategy=strategy)
         return CompiledPlan("kernel", seg, ctx,
                             col_names=list(self.b.cols),
                             kernel_plan=plan,
