@@ -54,12 +54,27 @@ def full_slots_cap(n: int) -> int:
     return n // LANES + n // (R * LANES) + STAGE
 
 
-def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int):
+def f64_bitcast_ok(platform: str = None) -> bool:
+    """XLA:TPU's x64 rewriter cannot lower f64 bitcast-convert (it legalizes
+    s64/u64 as 32-bit pairs but has no rule for f64 bit views); emitting one
+    crashes compilation on the real chip. CPU lowers it fine.
+
+    platform: the platform the kernel will compile for — pass it whenever
+    execution targets a mesh whose devices differ from the process default
+    (e.g. a CPU dryrun mesh under a TPU default backend)."""
+    return (platform or jax.default_backend()) == "cpu"
+
+
+def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int,
+            platform: str = None):
     """Compact masked elements of 1-D arrays toward the front (lane-wise).
 
     mask: (N,) bool; cols: tuple of (N,) arrays. 64-bit columns are
-    bit-split into int32 pairs around the kernel. Returns
-    (valid, out_cols, n_valid_rows, matched, overflow) with
+    bit-split into int32 pairs around the kernel. float64 columns on
+    backends without f64 bitcast support (TPU) are carried as float32 —
+    value-identical to the dense strategy there, which accumulates
+    float_acc_dtype()=f32 anyway (kernels.py documented tolerance).
+    Returns (valid, out_cols, n_valid_rows, matched, overflow) with
     valid/out_cols of length slots_cap*128.
     """
     n = mask.shape[0]
@@ -67,6 +82,8 @@ def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int):
     split_cols = []
     recipes = []  # (dtype, n_parts)
     for c in cols:
+        if c.dtype == jnp.float64 and not f64_bitcast_ok(platform):
+            c = c.astype(jnp.float32)
         if c.dtype.itemsize == 8:
             pair = jax.lax.bitcast_convert_type(c, jnp.int32)  # (N, 2)
             split_cols.extend([pair[:, 0], pair[:, 1]])
@@ -78,7 +95,7 @@ def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int):
             split_cols.append(c.astype(jnp.int32))
             recipes.append((jnp.dtype(jnp.int32), 1))
 
-    if _use_pallas(n):
+    if _use_pallas(n, platform):
         valid, outs, n_slots, matched, overflow = _compact_pallas(
             mask, tuple(split_cols), n, slots_cap)
     else:
@@ -101,8 +118,8 @@ def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int):
     return valid, tuple(out_cols), n_valid, matched, overflow
 
 
-def _use_pallas(n: int) -> bool:
-    return (jax.default_backend() == "tpu"
+def _use_pallas(n: int, platform: str = None) -> bool:
+    return ((platform or jax.default_backend()) == "tpu"
             and n % (STEP * LANES) == 0 and n >= STEP * LANES)
 
 

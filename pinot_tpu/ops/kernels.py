@@ -377,7 +377,12 @@ def _group_minmax(i: int, spec: AggSpec, mask, keys, space: int, cols,
 # (M, space/128) int8 operand materialization starts to dominate; the sort
 # path takes over (cap: searchsorted probes scale with space)
 FACTORIZED_GROUP_LIMIT = 1 << 14
-COMPACT_GROUP_LIMIT = 1 << 20
+# sort path ceiling: cost is one sort of the *matched* rows + (space+1)
+# searchsorted probes + dense (space,) outputs — 2^22 keeps outputs and
+# probes cheap while clearing MAX_DENSE_GROUPS (so spaces in (2^21, 2^22]
+# that used to fall to host numpy now stay on device; SSB Q4.3's
+# 7 x 250 x 1000 = 1.75M space lands here)
+COMPACT_GROUP_LIMIT = 1 << 22
 
 
 def _value_col_indices(ve) -> set:
@@ -404,30 +409,49 @@ def chunked_cumsum(x: jax.Array, chunk: int = 1 << 13) -> jax.Array:
 
 
 _IMIN64 = -(1 << 63)
+_IMIN32 = -(1 << 31)
 
 
-def _to_orderable64(v: jax.Array, integral: bool) -> jax.Array:
-    """Order-preserving map to int64 (full width, exact). Integers pass
-    through; floats map via the classic sign-flip bijection on their f64
-    bit patterns: non-negatives keep their bits, negatives reverse order
-    and land below (imin + ~bits)."""
+def _to_orderable64(v: jax.Array, integral: bool, platform: str = None):
+    """Order-preserving map to int64. Integers pass through (exact); floats
+    map via the classic sign-flip bijection on their bit patterns:
+    non-negatives keep their bits, negatives reverse order and land below
+    (imin + ~bits). f64 bit views only exist on backends whose x64 rewriter
+    can lower them (CPU — compact.f64_bitcast_ok); everywhere else floats
+    take the 32-bit bijection widened to int64, so no f64 op is ever
+    emitted (TPU crashes on f64 bitcast-convert at compile time).
+    Returns (orderable, mode) with mode consumed by _from_orderable64."""
+    from .compact import f64_bitcast_ok
+
     if integral:
-        return v.astype(jnp.int64)
-    bits = jax.lax.bitcast_convert_type(v.astype(jnp.float64), jnp.int64)
-    return jnp.where(bits >= 0, bits,
-                     jnp.int64(_IMIN64) + jnp.bitwise_not(bits))
+        return v.astype(jnp.int64), "int"
+    if v.dtype == jnp.float64 and f64_bitcast_ok(platform):
+        bits = jax.lax.bitcast_convert_type(v, jnp.int64)
+        o = jnp.where(bits >= 0, bits,
+                      jnp.int64(_IMIN64) + jnp.bitwise_not(bits))
+        return o, "f64"
+    bits = jax.lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32)
+    o32 = jnp.where(bits >= 0, bits,
+                    jnp.int32(_IMIN32) + jnp.bitwise_not(bits))
+    return o32.astype(jnp.int64), "f32"
 
 
-def _from_orderable64(o: jax.Array, integral: bool, acc_f) -> jax.Array:
-    if integral:
+def _from_orderable64(o: jax.Array, mode: str, acc_f) -> jax.Array:
+    if mode == "int":
         return o
-    neg_bits = jnp.bitwise_not(o - jnp.int64(_IMIN64))
-    bits = jnp.where(o >= 0, o, neg_bits)
-    return jax.lax.bitcast_convert_type(bits, jnp.float64).astype(acc_f)
+    if mode == "f64":
+        neg_bits = jnp.bitwise_not(o - jnp.int64(_IMIN64))
+        bits = jnp.where(o >= 0, o, neg_bits)
+        return jax.lax.bitcast_convert_type(bits, jnp.float64).astype(acc_f)
+    o32 = o.astype(jnp.int32)
+    neg_bits = jnp.bitwise_not(o32 - jnp.int32(_IMIN32))
+    bits = jnp.where(o32 >= 0, o32, neg_bits)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(acc_f)
 
 
 def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
-                        slots_cap: int, out: Dict[str, jax.Array]) -> None:
+                        slots_cap: int, out: Dict[str, jax.Array],
+                        platform: str = None) -> None:
     """Group aggregation over compacted matched rows.
 
     Reference parity: DocIdSetOperator (docId materialization) +
@@ -447,7 +471,7 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
                                     for s in plan.aggs if s.value is not None]
                                   or [set()]))
     valid, comp, n_valid, matched, overflow = compact(
-        mask, tuple(cols[ci] for ci in needed), slots_cap)
+        mask, tuple(cols[ci] for ci in needed), slots_cap, platform)
     out["overflow"] = overflow
     out["matched"] = matched.astype(int_acc_dtype())
     ccols: List[Optional[jax.Array]] = [None] * len(cols)
@@ -463,7 +487,8 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
     needs_sort = (space > FACTORIZED_GROUP_LIMIT
                   or any(s.kind in ("min", "max") for s in plan.aggs))
     if needs_sort:
-        _sorted_group(plan, keys, valid, ccols, params, space, out)
+        _sorted_group(plan, keys, valid, ccols, params, space, out,
+                      platform)
     else:
         _factorized_group(plan, keys, valid, ccols, params, space, m, out)
 
@@ -555,17 +580,21 @@ def _factorized_group(plan, keys, valid, ccols, params, space, m, out):
                 out[name] = row
 
 
-def _sorted_group(plan, keys, valid, ccols, params, space, out):
-    """Sort-based group aggregation: one sort of the compacted rows, then
-    chunked cumsum + boundary diffs (sums/counts) and first/last-element
-    gathers on composite keys (min/max). Edge positions come from
-    searchsorted over the sorted keys (space + 1 probes)."""
+def _sorted_group(plan, keys, valid, ccols, params, space, out,
+                  platform: str = None):
+    """Sort-based group aggregation: one lexicographic sort of the compacted
+    rows carries every sum payload AND the first min/max orderable as the
+    secondary key (group min = first element of the run, max = last);
+    additional *distinct* min/max value expressions each need one more
+    (key, orderable) sort, but MIN(x)/MAX(x) share an orderable and every
+    sort shares the single searchsorted edges array (sorted keys are the
+    same multiset in all of them)."""
     acc_f = float_acc_dtype()
     cnt_dtype = int_acc_dtype()
 
-    # gather all payloads that ride the main key sort
     sum_payloads: List[Tuple[int, AggSpec, jax.Array]] = []
-    minmax: List[Tuple[int, AggSpec, jax.Array]] = []
+    minmax: List[Tuple[int, AggSpec]] = []
+    orderables: Dict[object, Tuple[int, jax.Array, str]] = {}  # value -> slot
     for i, spec in enumerate(plan.aggs):
         if spec.kind == "count":
             continue
@@ -578,12 +607,20 @@ def _sorted_group(plan, keys, valid, ccols, params, space, out):
                 v = jnp.where(valid, v, 0).astype(acc_f)
             sum_payloads.append((i, spec, v))
         else:
-            minmax.append((i, spec, v))
+            if spec.value not in orderables:
+                integral = (spec.integral
+                            and jnp.issubdtype(v.dtype, jnp.integer))
+                o, mode = _to_orderable64(v, integral, platform)
+                orderables[spec.value] = (len(orderables), o, mode)
+            minmax.append((i, spec))
 
-    operands = [keys, valid.astype(jnp.int32)] + [p for _, _, p in
-                                                  sum_payloads]
-    sorted_ops = jax.lax.sort(operands, num_keys=1)
+    by_slot = list(orderables.values())  # insertion order == slot order
+    first_o = [by_slot[0][1]] if by_slot else []
+    operands = [keys] + first_o + [valid.astype(jnp.int32)] \
+        + [p for _, _, p in sum_payloads]
+    sorted_ops = jax.lax.sort(operands, num_keys=1 + len(first_o))
     sk = sorted_ops[0]
+    base = 1 + len(first_o)
     edges = jnp.searchsorted(sk, jnp.arange(space + 1, dtype=jnp.int32))
 
     def group_sums(sorted_vals, dtype):
@@ -591,12 +628,12 @@ def _sorted_group(plan, keys, valid, ccols, params, space, out):
         tot = jnp.concatenate([jnp.zeros(1, dtype), cs])
         return tot[edges[1:]] - tot[edges[:-1]]
 
-    counts = group_sums(sorted_ops[1], jnp.int64).astype(cnt_dtype)
+    counts = group_sums(sorted_ops[base], jnp.int64).astype(cnt_dtype)
     out["group_count"] = counts
 
     for oi, (i, spec, _) in enumerate(sum_payloads):
         name = _agg_name(i, spec)
-        sv = sorted_ops[2 + oi]
+        sv = sorted_ops[base + 1 + oi]
         s = group_sums(sv, jnp.int64 if spec.integral else acc_f)
         if spec.kind == "avg":
             out[name + "_sum"] = s
@@ -604,22 +641,24 @@ def _sorted_group(plan, keys, valid, ccols, params, space, out):
         else:
             out[name] = s
 
-    for i, spec, v in minmax:
-        # lexicographic (key, orderable-value) sort: group min = first
-        # element of the group's run, max = last. The int64 orderable is
-        # exact for both 64-bit ints and doubles.
-        name = _agg_name(i, spec)
-        integral = spec.integral and jnp.issubdtype(v.dtype, jnp.integer)
-        o = _to_orderable64(v, integral)
-        keys_sorted, o_sorted = jax.lax.sort([keys, o], num_keys=2)
-        e2 = jnp.searchsorted(keys_sorted,
-                              jnp.arange(space + 1, dtype=jnp.int32))
-        if spec.kind == "min":
-            pos = jnp.minimum(e2[:-1], keys.shape[0] - 1)
+    # sorted orderables: slot 0 already rode the main sort
+    sorted_orderable: List[jax.Array] = []
+    for slot, o, _mode in by_slot:
+        if slot == 0:
+            sorted_orderable.append(sorted_ops[1])
         else:
-            pos = jnp.clip(e2[1:] - 1, 0, keys.shape[0] - 1)
+            sorted_orderable.append(jax.lax.sort([keys, o], num_keys=2)[1])
+
+    n_rows = keys.shape[0]
+    pos_min = jnp.minimum(edges[:-1], n_rows - 1)
+    pos_max = jnp.clip(edges[1:] - 1, 0, n_rows - 1)
+    for i, spec in minmax:
+        name = _agg_name(i, spec)
+        slot, _o, mode = orderables[spec.value]
+        o_sorted = sorted_orderable[slot]
+        pos = pos_min if spec.kind == "min" else pos_max
         picked = o_sorted.at[pos].get(mode="clip")
-        out[name] = _from_orderable64(picked, integral, acc_f)
+        out[name] = _from_orderable64(picked, mode, acc_f)
 
 
 # ---------------------------------------------------------------------------
@@ -627,7 +666,8 @@ def _sorted_group(plan, keys, valid, ccols, params, space, out):
 # ---------------------------------------------------------------------------
 
 def build_kernel(plan: KernelPlan, bucket: int,
-                 slots_cap: Optional[int] = None):
+                 slots_cap: Optional[int] = None,
+                 platform: Optional[str] = None):
     """Return fn(cols, n_docs, params) -> dict of partial aggregation states.
 
     Shape contract: every cols[i] has the same (bucket,) length; n_docs is a
@@ -649,7 +689,8 @@ def build_kernel(plan: KernelPlan, bucket: int,
         if plan.is_group_by and plan.strategy == "compact":
             from .compact import default_slots_cap
             cap = slots_cap or default_slots_cap(bucket)
-            _compact_group_aggs(plan, mask, cols, params, bucket, cap, out)
+            _compact_group_aggs(plan, mask, cols, params, bucket, cap, out,
+                                platform)
             return out
         out["matched"] = jnp.sum(mask, dtype=int_acc_dtype())
         if plan.is_group_by:
@@ -664,6 +705,10 @@ def build_kernel(plan: KernelPlan, bucket: int,
 
 @functools.lru_cache(maxsize=1024)
 def jitted_kernel(plan: KernelPlan, bucket: int,
-                  slots_cap: Optional[int] = None):
-    """jit once per (plan structure, bucket, compaction capacity)."""
-    return jax.jit(build_kernel(plan, bucket, slots_cap))
+                  slots_cap: Optional[int] = None,
+                  platform: Optional[str] = None):
+    """jit once per (plan structure, bucket, capacity, target platform) —
+    platform keys the cache because f64-bitcast support and the Pallas
+    gate differ per backend (mesh execution may target a platform other
+    than the process default)."""
+    return jax.jit(build_kernel(plan, bucket, slots_cap, platform))

@@ -42,6 +42,10 @@ MAX_DISTINCT_MATRIX = 1 << 24       # group_space * card gate for on-device
 # small spaces stay on the dense one-hot kernel (one fused pass, vmap- and
 # mesh-friendly); larger spaces compact matched rows first (ops/compact.py)
 DENSE_SMALL_GROUPS = 512
+# dense one-hot materializes an (bucket, space) int8 operand in HBM; cap its
+# size so big segments route to compact even for small spaces (a 134M-row
+# segment with 175 groups would otherwise stage a 23GB operand)
+DENSE_ONEHOT_BUDGET = 1 << 28
 
 
 class PlanError(SqlError):
@@ -676,6 +680,11 @@ class SegmentPlanner:
                         for s in specs))
             # dense-strategy viability (one-hot over all rows)
             dense_viable = space <= MAX_DENSE_GROUPS
+            if slow_scatter and seg.bucket * (space + 1) > DENSE_ONEHOT_BUDGET:
+                # the (bucket, space) int8 one-hot operand would not fit /
+                # would dominate HBM traffic; matched-row compaction first
+                # is strictly better at any real selectivity
+                dense_viable = False
             for s in specs:
                 if s.kind == "distinct_count" and s.card is not None \
                         and space * s.card > MAX_DISTINCT_MATRIX:

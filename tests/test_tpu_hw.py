@@ -1,0 +1,54 @@
+"""Real-hardware gate for chip-only lowerings (ADVICE r2: both f64-bitcast
+compile crashes shipped because the suite forces CPU). The suite process
+pins JAX_PLATFORMS=cpu before jax loads, so hardware coverage runs in a
+subprocess with a clean environment: if a TPU is attached it must compile
+and execute the Pallas compaction kernel + compact-strategy queries for
+every dtype class; with no TPU the test skips.
+
+Set PINOT_SKIP_TPU_HW=1 to skip explicitly (e.g. to keep CI fast when a
+chip is attached but the ~3 min XLA compile budget is unwanted).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = os.path.join(os.path.dirname(__file__), "tpu_hw_script.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = " ".join(
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_compact_strategy_on_hardware():
+    if os.environ.get("PINOT_SKIP_TPU_HW"):
+        pytest.skip("PINOT_SKIP_TPU_HW set")
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.default_backend())"],
+        env=_clean_env(), capture_output=True, text=True, timeout=120)
+    if "tpu" not in probe.stdout:
+        pytest.skip(f"no TPU attached (backend: {probe.stdout.strip()!r})")
+
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT], env=_clean_env(),
+        capture_output=True, text=True, timeout=880)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON verdict\nstdout:{proc.stdout}\nstderr:" \
+                  f"{proc.stderr[-2000:]}"
+    verdict = json.loads(lines[-1])
+    if verdict.get("skip"):
+        pytest.skip(f"backend {verdict['backend']}")
+    assert verdict.get("ok"), \
+        f"hardware checks failed\nstdout:{proc.stdout}\n" \
+        f"stderr:{proc.stderr[-4000:]}"

@@ -1,0 +1,132 @@
+"""Hardware smoke script: runs on the REAL TPU (no CPU forcing) in a
+subprocess spawned by tests/test_tpu_hw.py. Covers the lowering classes
+that have historically compiled on CPU but crashed on the chip (f64
+bitcast-convert through the X64 rewriter, Pallas Mosaic lowering):
+
+1. compact() Pallas kernel vs the XLA nonzero fallback — identical
+   multisets per dtype class (INT, LONG, FLOAT, DOUBLE);
+2. one compact-strategy group-by query per dtype class through the full
+   broker path, checked against a numpy oracle.
+
+Prints one JSON line: {"ok": true, "backend": "tpu", ...} or an error.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import traceback
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import pinot_tpu  # noqa: F401  (enables x64)
+    from pinot_tpu.ops import compact as C
+
+    backend = jax.default_backend()
+    out = {"backend": backend, "checks": []}
+    if backend != "tpu":
+        print(json.dumps({"ok": False, "skip": True, "backend": backend}))
+        return 0
+
+    rng = np.random.default_rng(11)
+    n = 1 << 16
+    mask_np = rng.random(n) < 0.15
+    mask = jnp.asarray(mask_np)
+    srcs = {
+        "int": rng.integers(-1000, 1000, n).astype(np.int32),
+        "long": rng.integers(-(2**40), 2**40, n),
+        "float": rng.standard_normal(n).astype(np.float32),
+        "double": rng.standard_normal(n),
+    }
+    cols = tuple(jnp.asarray(v) for v in srcs.values())
+    cap = C.default_slots_cap(n)
+    assert C._use_pallas(n), "Pallas path must engage on the chip"
+    valid, outs, _nv, matched, ovf = jax.device_get(
+        C.compact(mask, cols, cap))
+    if int(matched) != int(mask_np.sum()) or int(ovf) != 0:
+        raise AssertionError(
+            f"matched {int(matched)} != {mask_np.sum()} ovf={int(ovf)}")
+    for (name, src), got_col in zip(srcs.items(), outs):
+        got = np.sort(np.asarray(got_col)[valid])
+        exp = np.sort(src[mask_np].astype(got.dtype))
+        if not np.array_equal(got, exp):
+            raise AssertionError(f"compact multiset mismatch for {name}")
+        out["checks"].append(f"compact:{name}")
+
+    # full-path compact-strategy queries per dtype class
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.planner import SegmentPlanner
+    from pinot_tpu.query.sql import parse_sql
+    from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    k = rng.integers(0, 1000, n).astype(np.int32)
+    data = {"k": k, "i": srcs["int"], "l": srcs["long"],
+            "f": srcs["float"], "d": srcs["double"]}
+    schema = Schema("t", [
+        FieldSpec("k", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("i", DataType.INT, FieldType.METRIC),
+        FieldSpec("l", DataType.LONG, FieldType.METRIC),
+        FieldSpec("f", DataType.FLOAT, FieldType.METRIC),
+        FieldSpec("d", DataType.DOUBLE, FieldType.METRIC),
+    ])
+    tmp = tempfile.mkdtemp()
+    SegmentBuilder(schema, TableConfig("t")).build(data, tmp, "seg_0")
+    seg = ImmutableSegment.load(os.path.join(tmp, "seg_0"))
+    dm = TableDataManager("t")
+    dm.add_segment(seg)
+    broker = Broker()
+    broker.register_table(dm)
+
+    m0 = k == 0
+    cases = [
+        ("SELECT k, SUM(i), COUNT(*) FROM t GROUP BY k ORDER BY k LIMIT 1",
+         (0, int(srcs["int"][m0].sum()), int(m0.sum())), None),
+        ("SELECT k, SUM(l) FROM t GROUP BY k ORDER BY k LIMIT 1",
+         (0, int(srcs["long"][m0].sum())), None),
+        ("SELECT k, MIN(f), MAX(f) FROM t GROUP BY k ORDER BY k LIMIT 1",
+         (0, float(srcs["float"][m0].min()),
+          float(srcs["float"][m0].max())), 1e-6),
+        ("SELECT k, SUM(d), MIN(d), MAX(d) FROM t GROUP BY k "
+         "ORDER BY k LIMIT 1",
+         (0, float(srcs["double"][m0].sum()),
+          float(srcs["double"][m0].min()),
+          float(srcs["double"][m0].max())), 1e-4),
+    ]
+    for sql, expect, tol in cases:
+        ctx = build_query_context(parse_sql(sql))
+        plan = SegmentPlanner(ctx, seg).plan()
+        strat = plan.kernel_plan.strategy if plan.kernel_plan else plan.kind
+        if strat != "compact":
+            raise AssertionError(f"{sql!r} planned {strat}, want compact")
+        res = broker.query(sql + " OPTION(timeoutMs=600000)")
+        got = res.rows[0]
+        for g, e in zip(got, expect):
+            if tol is None:
+                ok = g == e
+            else:
+                ok = abs(g - e) <= tol * max(1.0, abs(e))
+            if not ok:
+                raise AssertionError(f"{sql!r}: got {got}, want {expect}")
+        out["checks"].append(f"query:{sql.split('(')[1].split(')')[0]}")
+
+    out["ok"] = True
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception:
+        traceback.print_exc()
+        print(json.dumps({"ok": False}))
+        sys.exit(1)
