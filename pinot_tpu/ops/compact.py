@@ -96,8 +96,16 @@ def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int,
             recipes.append((jnp.dtype(jnp.int32), 1))
 
     if _use_pallas(n, platform):
+        # the kernel consumes STEP*LANES rows per grid step; pad odd sizes
+        # with unmatched rows (mask False) so every segment shape qualifies
+        rem = n % (STEP * LANES)
+        if rem:
+            pad = STEP * LANES - rem
+            mask = jnp.pad(mask, (0, pad))
+            split_cols = [jnp.pad(c, (0, pad)) for c in split_cols]
         valid, outs, n_slots, matched, overflow = _compact_pallas(
-            mask, tuple(split_cols), n, slots_cap)
+            mask, tuple(split_cols), n + (STEP * LANES - rem if rem else 0),
+            slots_cap)
     else:
         valid, outs, n_slots, matched, overflow = _compact_xla(
             mask, tuple(split_cols), n, slots_cap)
@@ -120,7 +128,7 @@ def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int,
 
 def _use_pallas(n: int, platform: str = None) -> bool:
     return ((platform or jax.default_backend()) == "tpu"
-            and n % (STEP * LANES) == 0 and n >= STEP * LANES)
+            and n >= STEP * LANES)
 
 
 def _compact_xla(mask, cols, n, slots_cap):

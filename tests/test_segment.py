@@ -110,3 +110,24 @@ def test_mmap_zero_copy(schema, tmp_path):
     seg = _build(schema, tmp_path, rows)
     fwd = seg.fwd("revenue")
     assert isinstance(fwd, np.memmap)
+
+
+def test_categorical_fast_path(schema, tmp_path):
+    """Pre-encoded Categorical input builds the same segment as raw
+    strings, with codes remapped to sorted dictionary ids."""
+    from pinot_tpu.segment.builder import Categorical
+
+    codes = np.array([0, 1, 0, 2, 1], dtype=np.int8)
+    values = ["nyc", "sf", "austin"]  # deliberately unsorted
+    data = {
+        "city": Categorical(codes, values),
+        "year": np.array([2020, 2021, 2020, 2022, 2021]),
+        "revenue": np.arange(5, dtype=np.int64),
+        "score": np.zeros(5),
+    }
+    builder = SegmentBuilder(schema, TableConfig("t"))
+    seg = ImmutableSegment.load(builder.build(data, str(tmp_path), "seg_0"))
+    assert list(seg.dictionary("city").values) == ["austin", "nyc", "sf"]
+    assert list(seg.raw_values("city")) == ["nyc", "sf", "nyc", "austin", "sf"]
+    with pytest.raises(ValueError):
+        Categorical(codes, ["dup", "dup", "x"])

@@ -58,6 +58,20 @@ def main() -> int:
             raise AssertionError(f"compact multiset mismatch for {name}")
         out["checks"].append(f"compact:{name}")
 
+    # odd (non-multiple-of-STEP*LANES) sizes must still take the Pallas
+    # path via tail padding
+    n_odd = 40_000
+    assert C._use_pallas(n_odd), "odd sizes must engage Pallas via padding"
+    m_odd = rng.random(n_odd) < 0.2
+    x_odd = rng.integers(-500, 500, n_odd).astype(np.int32)
+    v2, (o2,), _nv2, m2, ov2 = jax.device_get(C.compact(
+        jnp.asarray(m_odd), (jnp.asarray(x_odd),),
+        C.full_slots_cap(n_odd)))
+    if int(m2) != int(m_odd.sum()) or int(ov2) != 0 or not np.array_equal(
+            np.sort(np.asarray(o2)[v2]), np.sort(x_odd[m_odd])):
+        raise AssertionError("odd-size padded compact mismatch")
+    out["checks"].append("compact:odd_size")
+
     # full-path compact-strategy queries per dtype class
     from pinot_tpu.broker import Broker
     from pinot_tpu.query.context import build_query_context
