@@ -19,10 +19,10 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..engine.reduce import ResultTable, reduce_partials
-from ..engine.serde import partial_from_wire
+
 from ..query.context import build_query_context
 from ..query.sql import SetOpStmt, SqlError, parse_sql, to_sql
-from .http_util import JsonHandler, http_json, start_http
+from .http_util import JsonHandler, http_json, http_raw, start_http
 
 
 class FailureDetector:
@@ -293,10 +293,13 @@ class BrokerNode:
                 self._selector.record_start(server)
             tcall = time.perf_counter()
             try:
-                resp = http_json("POST", f"{url}/query",
-                                 {"sql": sql, "segments": segs})
+                from ..engine.datablock import decode_wire_frame
+                raw = http_raw("POST", f"{url}/query/bin",
+                               {"sql": sql, "segments": segs})
+                header, decoded = decode_wire_frame(raw)
                 self._failures.record_success(server)
-                return resp
+                return {"partials": decoded,
+                        "segmentsQueried": header.get("segmentsQueried", 0)}
             except urllib.error.HTTPError as e:
                 # the server answered: an application error, not a health
                 # signal — surface it, don't poison the failure detector
@@ -337,7 +340,7 @@ class BrokerNode:
         queried = 0
         for f in futures:
             resp = f.result()
-            partials.extend(partial_from_wire(p) for p in resp["partials"])
+            partials.extend(resp["partials"])
             queried += resp["segmentsQueried"]
         return partials, queried, pruned
 

@@ -44,9 +44,15 @@ class JsonHandler(BaseHTTPRequestHandler):
                     status, payload = fn(self, body)
                 except Exception as e:  # surface handler errors as 500 JSON
                     status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
-                data = json.dumps(payload).encode()
+                if isinstance(payload, (bytes, bytearray)):
+                    # binary data plane (DataTable-over-Netty analog)
+                    data = bytes(payload)
+                    ctype = "application/octet-stream"
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -73,11 +79,17 @@ def start_http(handler_cls, port: int = 0) -> Tuple[ThreadingHTTPServer,
     return srv, srv.server_address[1], t
 
 
-def http_json(method: str, url: str, body: Any = None,
-              timeout: float = 10.0) -> Any:
+def http_raw(method: str, url: str, body: Any = None,
+             timeout: float = 10.0) -> bytes:
+    """JSON request, raw-bytes response (the binary data plane)."""
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method,
                                  headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout) as resp:
-        payload = resp.read()
+        return resp.read()
+
+
+def http_json(method: str, url: str, body: Any = None,
+              timeout: float = 10.0) -> Any:
+    payload = http_raw(method, url, body, timeout)
     return json.loads(payload) if payload else None

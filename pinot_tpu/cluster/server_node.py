@@ -163,7 +163,7 @@ class ServerNode:
         ctx = build_query_context(stmt)
         dm = self._tables.get(ctx.table)
         if dm is None:
-            return {"partials": [], "segmentsQueried": 0}
+            return {"partials_raw": [], "segmentsQueried": 0}
         segments = dm.acquire_segments()
         if segment_names is not None:
             wanted = set(segment_names)
@@ -178,8 +178,26 @@ class ServerNode:
                                 "rows": [list(r) for r in rows]},
                     "segmentsQueried": len(segments)}
         ex = execute_segments(ctx, segments)
-        return {"partials": [partial_to_wire(p) for p in ex.partials],
+        return {"partials_raw": ex.partials,
                 "segmentsQueried": len(segments)}
+
+    def execute_json(self, sql: str,
+                     segment_names: Optional[List[str]] = None
+                     ) -> Dict[str, Any]:
+        """Legacy/debuggable JSON wire (also serves EXPLAIN)."""
+        resp = self.execute(sql, segment_names)
+        raw = resp.pop("partials_raw", None)
+        if raw is not None:
+            resp["partials"] = [partial_to_wire(p) for p in raw]
+        return resp
+
+    def execute_bin(self, sql: str,
+                    segment_names: Optional[List[str]] = None) -> bytes:
+        """Binary data plane: columnar DataBlock partials in one frame."""
+        from ..engine.datablock import encode_wire_frame
+        resp = self.execute(sql, segment_names)
+        raw = resp.pop("partials_raw", [])
+        return encode_wire_frame(resp, raw)
 
     def _make_handler(self):
         node = self
@@ -187,8 +205,10 @@ class ServerNode:
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
+                ("POST", "/query/bin"): lambda h, b: (
+                    200, node.execute_bin(b["sql"], b.get("segments"))),
                 ("POST", "/query"): lambda h, b: (
-                    200, node.execute(b["sql"], b.get("segments"))),
+                    200, node.execute_json(b["sql"], b.get("segments"))),
             }
         return Handler
 

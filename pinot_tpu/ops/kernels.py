@@ -495,19 +495,17 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
 
 def _factorized_group(plan, keys, valid, ccols, params, space, m, out):
     """sums[hi, lo] = (oh_hi . limb)^T @ oh_lo — two fused one-hot operands
-    keep the contraction on the MXU without materializing (M, space)."""
+    keep the contraction on the MXU without materializing (M, space).
+
+    The contraction runs as a lax.scan over fixed-size row blocks: the
+    (block, n_hi) x (block, 128) one-hot operands are rebuilt per block and
+    accumulated into the (rows, n_hi, 128) result, so peak memory is
+    independent of M. (Unblocked, XLA materialized the (rows, M, n_hi)
+    stacked operand — 34 GB at full_slots_cap on a 134M-row segment.)"""
     g_pad = -(-(space + 1) // 128) * 128
     n_hi = g_pad // 128
     hi = keys >> jnp.int32(7)
     lo = keys & jnp.int32(127)
-    oh_hi = jax.nn.one_hot(hi, n_hi, dtype=jnp.int8)      # (M, n_hi)
-    oh_lo = jax.nn.one_hot(lo, 128, dtype=jnp.int8)       # (M, 128)
-
-    def int_rows_matmul(rows8: List[jax.Array]) -> jax.Array:
-        lhs = jnp.stack([oh_hi * r[:, None] for r in rows8], axis=0)
-        return jax.lax.dot_general(
-            lhs, oh_lo, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)  # (n_rows, n_hi, 128)
 
     cnt_dtype = int_acc_dtype()
     int_rows: List[jax.Array] = [valid.astype(jnp.int8)]
@@ -533,25 +531,63 @@ def _factorized_group(plan, keys, valid, ccols, params, space, m, out):
             raise ValueError(
                 f"factorized group-by cannot lower {spec.kind!r}")
 
-    S = int_rows_matmul(int_rows)            # (R, n_hi, 128) int32
-    flat = S.reshape(S.shape[0], g_pad)[:, :space]
+    acc_f = float_acc_dtype()
+    frows = []
+    for i, spec in float_jobs:
+        v = _eval_value(spec.value, ccols, params).astype(acc_f)
+        frows.append(jnp.where(valid, v, 0))
+
+    # block size: keep the per-block (R, MB, n_hi) int8 operand ~<=128MB
+    n_int = len(int_rows)
+    budget = max((128 << 20) // max(n_int * n_hi, 1), 1 << 15)
+    mb = max(1 << 15, min(1 << 21, 1 << (budget.bit_length() - 1)))
+    n_b = -(-m // mb)
+    pad = n_b * mb - m
+
+    def blocked(x, fill):
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.full((pad,), fill, dtype=x.dtype)])
+        return x.reshape(n_b, mb)
+
+    hi_b = blocked(hi, space >> 7)     # sentinel key -> trimmed pad region
+    lo_b = blocked(lo, space & 127)
+    ir_b = jnp.stack([blocked(r, 0) for r in int_rows], axis=1)
+    xs = (hi_b, lo_b, ir_b)
+    fr_b = None
+    if frows:
+        fr_b = jnp.stack([blocked(r, 0) for r in frows], axis=1)
+        xs = xs + (fr_b,)
+
+    S0 = jnp.zeros((n_int, n_hi, 128), jnp.int32)
+    F0 = jnp.zeros((len(frows), n_hi, 128), acc_f)
+
+    def body(carry, xb):
+        S, F = carry
+        hb, lb, irb = xb[:3]
+        oh_hi = jax.nn.one_hot(hb, n_hi, dtype=jnp.int8)   # (MB, n_hi)
+        oh_lo = jax.nn.one_hot(lb, 128, dtype=jnp.int8)    # (MB, 128)
+        lhs = oh_hi[None, :, :] * irb[:, :, None]          # (R, MB, n_hi)
+        S = S + jax.lax.dot_general(
+            lhs, oh_lo, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        if frows:
+            flhs = oh_hi.astype(acc_f)[None, :, :] * xb[3][:, :, None]
+            F = F + jax.lax.dot_general(
+                flhs, oh_lo.astype(acc_f), (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=acc_f)
+        return (S, F), None
+
+    if n_b == 1:  # small capacity: no scan, cheaper to compile (tests/CPU)
+        (S, F), _ = body((S0, F0), tuple(x[0] for x in xs))
+    else:
+        (S, F), _ = jax.lax.scan(body, (S0, F0), xs)
+    flat = S.reshape(n_int, g_pad)[:, :space]
     counts = flat[0].astype(cnt_dtype)
     out["group_count"] = counts
-
     if float_jobs:
-        acc_f = float_acc_dtype()
-        ohf_hi = oh_hi.astype(acc_f)
-        ohf_lo = oh_lo.astype(acc_f)
-        frows = []
-        for i, spec in float_jobs:
-            v = _eval_value(spec.value, ccols, params).astype(acc_f)
-            frows.append(jnp.where(valid, v, 0))
-        lhs = jnp.stack([ohf_hi * r[:, None] for r in frows], axis=0)
-        F = jax.lax.dot_general(
-            lhs, ohf_lo, (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=acc_f)
-        Fflat = F.reshape(F.shape[0], g_pad)[:, :space]
+        Fflat = F.reshape(len(frows), g_pad)[:, :space]
 
     meta_iter = iter(row_meta)
     fi = 0

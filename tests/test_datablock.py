@@ -1,0 +1,93 @@
+"""Binary columnar partial serde (DataTable/DataBlock analog) tests.
+
+Reference test analog: DataTableSerDeTest / DataBlockTest in
+pinot-common — round-trip every state shape, then check the wire-size
+win over the JSON serde on a large group-by partial (the reason the
+binary path exists: 1M-group partials shipped as JSON text cost ~90B
+per group).
+"""
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine.datablock import (decode_partial, decode_wire_frame,
+                                        encode_partial, encode_wire_frame)
+from pinot_tpu.engine.executor import (AggPartial, GroupByPartial,
+                                       SelectionPartial)
+from pinot_tpu.engine.serde import partial_to_wire
+
+
+def rt(p):
+    return decode_partial(encode_partial(p))
+
+
+def test_agg_partial_round_trip():
+    p = AggPartial([7, 3.25, None, (12.5, 4), {1, 2, "x"},
+                    {"a": 2, 3: 1}, 2**70])
+    q = rt(p)
+    assert q.states == p.states
+
+
+def test_groupby_round_trip_all_state_shapes():
+    groups = {
+        (1993, "MFGR#12"): [100, (250.5, 10), {"a", "b"}, -5],
+        (1994, "MFGR#13"): [200, (0.5, 1), {"c"}, 2**40],
+    }
+    q = rt(GroupByPartial(groups))
+    assert q.groups == groups
+
+
+def test_groupby_empty_and_none_cells():
+    assert rt(GroupByPartial({})).groups == {}
+    groups = {("k",): [None], ("j",): [None]}
+    assert rt(GroupByPartial(groups)).groups == groups
+    mixed = {("k",): [None], ("j",): [3]}  # None demotes column to OBJ
+    assert rt(GroupByPartial(mixed)).groups == mixed
+
+
+def test_selection_round_trip():
+    p = SelectionPartial(
+        ["a", "b", "c"],
+        [(1, "x", 2.5), (2, "y", -1.0), (3, None, 0.0)],
+        [(1,), (2,), (3,)])
+    q = rt(p)
+    assert q.labels == p.labels
+    assert q.rows == p.rows
+    assert q.order_keys == p.order_keys
+
+
+def test_wire_frame_round_trip():
+    parts = [AggPartial([1]), GroupByPartial({("k",): [2]})]
+    frame = encode_wire_frame({"segmentsQueried": 2}, parts)
+    header, decoded = decode_wire_frame(frame)
+    assert header == {"segmentsQueried": 2}
+    assert decoded[0].states == [1]
+    assert decoded[1].groups == {("k",): [2]}
+    with pytest.raises(ValueError):
+        decode_wire_frame(b"nope" + frame[4:])
+
+
+def test_large_groupby_wire_size_vs_json():
+    """SSB-shaped 128k-group partial: binary must be >=5x smaller than the
+    JSON wire (measured 6.8x at 1M groups with worst-case random int64
+    sums; real sums compress further)."""
+    rng = np.random.default_rng(0)
+    n = 1 << 17
+    brands = [f"MFGR#{m}{c}{b}" for m in range(1, 6) for c in range(1, 6)
+              for b in range(1, 41)]
+    idx = np.arange(n)
+    sums = rng.integers(10**9, 10**13, n)
+    cnts = rng.integers(1, 10**5, n)
+    groups = {}
+    for i in range(n):
+        groups[(int(1992 + idx[i] % 7), brands[(idx[i] // 7) % 1000],
+                int(idx[i] // 7000))] = \
+            [int(sums[i]), (float(sums[i]), int(cnts[i]))]
+    assert len(groups) == n
+    p = GroupByPartial(groups)
+    b = encode_partial(p)
+    j = json.dumps(partial_to_wire(p)).encode()
+    assert len(b) * 5 <= len(j), (len(b), len(j))
+    q = decode_partial(b)
+    assert q.groups == groups
