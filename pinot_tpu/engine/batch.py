@@ -106,5 +106,12 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
             sum(np.asarray(v).nbytes for v in out.values()))
         for k, i in enumerate(idxs):
             per_seg = {name: v[k] for name, v in out.items()}
-            results[i] = extract_partial(plans[i], per_seg)
+            if int(per_seg.pop("group_overflow", 0)):
+                # this segment alone exceeded the transfer-compaction cap;
+                # rerun it solo, straight to dense outputs
+                from .executor import run_kernel
+                dense = run_kernel(plans[i], xfer_compact=False)
+                results[i] = extract_partial(plans[i], dense)
+            else:
+                results[i] = extract_partial(plans[i], per_seg)
     return results

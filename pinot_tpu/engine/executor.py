@@ -129,20 +129,34 @@ def resolve_params(plan: CompiledPlan, sharding=None) -> Tuple[jax.Array, ...]:
     return tuple(out)
 
 
-def run_kernel(plan: CompiledPlan) -> Dict[str, np.ndarray]:
+def run_kernel(plan: CompiledPlan,
+               xfer_compact: bool = True) -> Dict[str, np.ndarray]:
+    """xfer_compact=False goes straight to dense (space,) group outputs —
+    used when the caller already knows the transfer compaction spilled
+    (engine/batch.py's vmapped path)."""
     seg = plan.segment
     cols = seg.device_cols(plan.col_names)
     params = resolve_params(plan)
-    fn = jitted_kernel(plan.kernel_plan, seg.bucket)
-    out = fn(cols, np.int32(seg.n_docs), params)
-    host = jax.device_get(out)
+    n = np.int32(seg.n_docs)
+    cap = None
+    fn = jitted_kernel(plan.kernel_plan, seg.bucket,
+                       xfer_compact=xfer_compact)
+    host = jax.device_get(fn(cols, n, params))
     if int(host.pop("overflow", 0)):
         # compact-strategy capacity exceeded (high selectivity): rerun with
         # a capacity that cannot overflow (ops/compact.full_slots_cap)
         from ..ops.compact import full_slots_cap
-        fn = jitted_kernel(plan.kernel_plan, seg.bucket,
-                           full_slots_cap(seg.bucket))
-        host = jax.device_get(fn(cols, np.int32(seg.n_docs), params))
+        cap = full_slots_cap(seg.bucket)
+        fn = jitted_kernel(plan.kernel_plan, seg.bucket, cap,
+                           xfer_compact=xfer_compact)
+        host = jax.device_get(fn(cols, n, params))
+        host.pop("overflow", None)
+    if int(host.pop("group_overflow", 0)):
+        # more live groups than the transfer-compaction cap: rerun with
+        # dense (space,) outputs
+        fn = jitted_kernel(plan.kernel_plan, seg.bucket, cap,
+                           xfer_compact=False)
+        host = jax.device_get(fn(cols, n, params))
         host.pop("overflow", None)
     from .accounting import global_accountant
     global_accountant.track_memory(
@@ -159,8 +173,16 @@ def extract_partial(plan: CompiledPlan, out: Dict[str, np.ndarray]):
             states.append(_scalar_state(b, out, matched, seg))
         return AggPartial(states)
 
+    gi = out.get("group_idx")
     gc = out["group_count"]
-    idxs = np.nonzero(gc > 0)[0]
+    if gi is not None:
+        # device-compacted outputs: arrays are gathered non-empty rows,
+        # gi holds their dense space ids (sentinel rows have count 0)
+        sel = np.nonzero(gc > 0)[0]
+        idxs = np.asarray(gi)[sel]
+    else:
+        idxs = np.nonzero(gc > 0)[0]
+        sel = idxs
     # decode dense cartesian keys -> per-column ids -> values
     key_cols: List[np.ndarray] = []
     rem = idxs.copy()
@@ -175,9 +197,9 @@ def extract_partial(plan: CompiledPlan, out: Dict[str, np.ndarray]):
 
     groups: Dict[Tuple, List[Any]] = {k: [] for k in keys}
     for b in plan.agg_bindings:
-        per_group = _group_state(b, out, idxs, seg)
-        for gi, k in enumerate(keys):
-            groups[k].append(per_group[gi])
+        per_group = _group_state(b, out, sel, seg)
+        for k_i, k in enumerate(keys):
+            groups[k].append(per_group[k_i])
     return GroupByPartial(groups)
 
 

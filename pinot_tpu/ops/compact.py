@@ -54,6 +54,18 @@ def default_slots_cap(n: int) -> int:
     return max(n // (4 * LANES), 2 * STAGE) + STAGE
 
 
+def sorted_default_slots_cap(n: int) -> int:
+    """Default capacity for the sort-based group path: 1/16 of the input.
+
+    Big-space group-bys are overwhelmingly low-selectivity (SSB Q3/Q4:
+    0.01-0.5% matched), and the sort runs over the full static capacity,
+    so a tighter cap is a direct kernel-time win. The loose-compaction
+    advance floor is ~1 slot row per 32-row subtile with any match
+    (~3.2%), so 1/16 (6.25%) keeps headroom; denser masks pay the
+    full-capacity retry like everything else."""
+    return max(n // (16 * LANES), 2 * STAGE) + STAGE
+
+
 def full_slots_cap(n: int) -> int:
     """Capacity that can never overflow: total slot advance is bounded by
     one slot row per input row-of-128 plus one pad row per subtile."""

@@ -470,6 +470,7 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
                     | set().union(*[_value_col_indices(s.value)
                                     for s in plan.aggs if s.value is not None]
                                   or [set()]))
+    needs_sort = _needs_sort(plan)
     valid, comp, n_valid, matched, overflow = compact(
         mask, tuple(cols[ci] for ci in needed), slots_cap, platform)
     out["overflow"] = overflow
@@ -484,8 +485,6 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
         keys = keys * jnp.int32(card) + ccols[col_idx].astype(jnp.int32)
     keys = jnp.where(valid, keys, space)  # sentinel past the space
 
-    needs_sort = (space > FACTORIZED_GROUP_LIMIT
-                  or any(s.kind in ("min", "max") for s in plan.aggs))
     if needs_sort:
         _sorted_group(plan, keys, valid, ccols, params, space, out,
                       platform)
@@ -616,6 +615,14 @@ def _factorized_group(plan, keys, valid, ccols, params, space, m, out):
                 out[name] = row
 
 
+def _needs_sort(plan: KernelPlan) -> bool:
+    """Whether the compact strategy takes the sort path (vs factorized
+    one-hot matmuls). Shared by _compact_group_aggs (path selection) and
+    build_kernel (capacity selection) so the two can never disagree."""
+    return (plan.group_space > FACTORIZED_GROUP_LIMIT
+            or any(s.kind in ("min", "max") for s in plan.aggs))
+
+
 def _sorted_group(plan, keys, valid, ccols, params, space, out,
                   platform: str = None):
     """Sort-based group aggregation: one lexicographic sort of the compacted
@@ -703,7 +710,8 @@ def _sorted_group(plan, keys, valid, ccols, params, space, out,
 
 def build_kernel(plan: KernelPlan, bucket: int,
                  slots_cap: Optional[int] = None,
-                 platform: Optional[str] = None):
+                 platform: Optional[str] = None,
+                 xfer_compact: bool = True):
     """Return fn(cols, n_docs, params) -> dict of partial aggregation states.
 
     Shape contract: every cols[i] has the same (bucket,) length; n_docs is a
@@ -723,14 +731,20 @@ def build_kernel(plan: KernelPlan, bucket: int,
         mask = valid & _eval_pred(plan.pred, cols, params, bucket)
         out: Dict[str, jax.Array] = {}
         if plan.is_group_by and plan.strategy == "compact":
-            from .compact import default_slots_cap
-            cap = slots_cap or default_slots_cap(bucket)
+            from .compact import default_slots_cap, sorted_default_slots_cap
+            cap = slots_cap or (sorted_default_slots_cap(bucket)
+                                if _needs_sort(plan)
+                                else default_slots_cap(bucket))
             _compact_group_aggs(plan, mask, cols, params, bucket, cap, out,
                                 platform)
+            if xfer_compact:
+                _compact_group_xfer(plan, out)
             return out
         out["matched"] = jnp.sum(mask, dtype=int_acc_dtype())
         if plan.is_group_by:
             _group_aggs(plan, mask, cols, params, bucket, out)
+            if xfer_compact:
+                _compact_group_xfer(plan, out)
         else:
             for i, spec in enumerate(plan.aggs):
                 _scalar_agg(i, spec, mask, cols, params, out)
@@ -739,12 +753,46 @@ def build_kernel(plan: KernelPlan, bucket: int,
     return kernel
 
 
+# dense (space,) group outputs above this space are compacted on device to
+# the non-empty groups before transfer — the tunneled host link makes a
+# 437k-group dense row set (~10MB over several arrays) cost ~0.5s/query
+GROUP_XFER_SPACE = 1 << 15
+GROUP_XFER_CAP = 1 << 15
+
+
+def _compact_group_xfer(plan: KernelPlan, out: Dict[str, jax.Array]) -> None:
+    """Replace dense (space,) group outputs with gathered non-empty rows:
+    group_idx holds the dense space ids (sentinel=space past the count),
+    group_overflow flags >GROUP_XFER_CAP live groups (executor retries with
+    xfer_compact=False). All-or-nothing: any 2-D output (grouped
+    DISTINCTCOUNT presence) disables compaction for the whole result, since
+    extract_partial indexes every output with one positions array."""
+    space = plan.group_space
+    if space < GROUP_XFER_SPACE:
+        return
+    dense = {k: v for k, v in out.items()
+             if k not in ("matched", "overflow")}
+    if not all(v.ndim == 1 and v.shape[0] == space for v in dense.values()):
+        return
+    counts = out["group_count"]
+    live = counts > 0
+    idx, = jnp.nonzero(live, size=GROUP_XFER_CAP, fill_value=space)
+    out["group_idx"] = idx.astype(jnp.int32)
+    out["group_overflow"] = (
+        jnp.sum(live, dtype=jnp.int32) > GROUP_XFER_CAP).astype(jnp.int32)
+    for k, v in dense.items():
+        out[k] = jnp.where(idx < space, v.at[idx].get(mode="clip"),
+                           jnp.zeros((), dtype=v.dtype))
+
+
 @functools.lru_cache(maxsize=1024)
 def jitted_kernel(plan: KernelPlan, bucket: int,
                   slots_cap: Optional[int] = None,
-                  platform: Optional[str] = None):
+                  platform: Optional[str] = None,
+                  xfer_compact: bool = True):
     """jit once per (plan structure, bucket, capacity, target platform) —
     platform keys the cache because f64-bitcast support and the Pallas
     gate differ per backend (mesh execution may target a platform other
     than the process default)."""
-    return jax.jit(build_kernel(plan, bucket, slots_cap, platform))
+    return jax.jit(build_kernel(plan, bucket, slots_cap, platform,
+                                xfer_compact))

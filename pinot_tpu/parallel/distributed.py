@@ -173,7 +173,9 @@ class DistributedTable:
 def _distributed_kernel(kernel_plan, bucket: int, mesh: Mesh,
                         n_cols: int, n_params: int):
     """jit(shard_map(vmap(kernel) + collectives)) cached per plan/mesh."""
-    kern = build_kernel(kernel_plan, bucket)
+    # dense (space,) outputs only: psum/pmin/pmax combine positionally
+    # across shards, which device-side transfer compaction would break
+    kern = build_kernel(kernel_plan, bucket, xfer_compact=False)
 
     def per_device(cols, n_docs, params):
         # cols: tuple of (L, bucket) local shards; n_docs: (L,)

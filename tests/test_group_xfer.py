@@ -1,0 +1,63 @@
+"""Device-side group-output transfer compaction (ops/kernels.
+_compact_group_xfer): big group spaces ship only live groups to the host;
+spill past GROUP_XFER_CAP falls back to dense outputs via the executor
+retry. Oracle-checked through the full broker path.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.ops import kernels as K
+from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+CARD = 200          # space = 200*200 = 40000 >= GROUP_XFER_SPACE
+
+
+def _broker(tmp_path, n, distinct_groups):
+    rng = np.random.default_rng(5)
+    g = np.arange(n) % distinct_groups
+    data = {
+        "ka": (g // CARD).astype(np.int32),
+        "kb": (g % CARD).astype(np.int32),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    }
+    schema = Schema("t", [
+        FieldSpec("ka", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("kb", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC),
+    ])
+    d = SegmentBuilder(schema, TableConfig("t")).build(
+        data, str(tmp_path), "seg_0")
+    dm = TableDataManager("t")
+    dm.add_segment_dir(d)
+    b = Broker()
+    b.register_table(dm)
+    return b, data
+
+
+def _oracle(data):
+    out = {}
+    for a, b, v in zip(data["ka"], data["kb"], data["v"]):
+        k = (int(a), int(b))
+        s, c = out.get(k, (0, 0))
+        out[k] = (s + int(v), c + 1)
+    return out
+
+
+@pytest.mark.parametrize("distinct_groups", [
+    500,                      # few live groups: compacted transfer path
+    K.GROUP_XFER_CAP + 200,   # spill: group_overflow -> dense retry
+], ids=["compacted", "overflow_dense_retry"])
+def test_big_space_group_by(tmp_path, distinct_groups):
+    n = max(60_000, distinct_groups)
+    broker, data = _broker(tmp_path, n, distinct_groups)
+    res = broker.query(
+        "SELECT ka, kb, SUM(v), COUNT(*) FROM t GROUP BY ka, kb "
+        "LIMIT 100000 OPTION(timeoutMs=300000)")
+    oracle = _oracle(data)
+    assert len(res.rows) == distinct_groups
+    for ka, kb, s, c in res.rows:
+        assert oracle[(ka, kb)] == (s, c)
