@@ -71,27 +71,41 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
     groups: Dict[Tuple, List[int]] = {}
     resolved: Dict[int, Tuple[jax.Array, ...]] = {}
 
+    from ..ops.kernels import COMPACT_GROUP_LIMIT, segmented_compact_ok
     from .accounting import global_accountant
     for i, plan in enumerate(plans):
         # preemption point between per-segment launches (the hot-loop
         # ThreadAccountantOps.sample analog): raises on kill/timeout
         global_accountant.sample()
-        if plan.kind != "kernel" or plan.kernel_plan.strategy == "compact":
-            # compact-strategy plans launch per segment: the Pallas
-            # compaction kernel doesn't vmap, and big-space group-bys are
-            # single-large-segment workloads anyway
+        if plan.kind != "kernel":
             results[i] = execute_plan(plan)
+            continue
+        kp = plan.kernel_plan
+        if kp.strategy == "compact":
+            if segmented_compact_ok(kp):
+                # compact group-bys batch via the segmented kernel: the
+                # segment index becomes the leading group-key factor
+                # (ops/kernels.build_segmented_compact_kernel), replacing
+                # the per-segment launches the Pallas compaction forced
+                params = resolve_params(plan)
+                resolved[i] = params
+                key = ("segc", kp, plan.segment.bucket, _param_sig(params))
+                groups.setdefault(key, []).append(i)
+            else:
+                results[i] = execute_plan(plan)
             continue
         params = resolve_params(plan)
         resolved[i] = params
-        key = (plan.kernel_plan, plan.segment.bucket, _param_sig(params))
+        key = ("dense", kp, plan.segment.bucket, _param_sig(params))
         groups.setdefault(key, []).append(i)
 
-    for (plan_struct, bucket, _sig), idxs in groups.items():
+    for (kind, plan_struct, bucket, _sig), idxs in groups.items():
         global_accountant.sample()
-        if len(idxs) == 1:
-            i = idxs[0]
-            results[i] = execute_plan(plans[i])
+        n_seg = len(idxs)
+        if n_seg == 1 or (kind == "segc" and n_seg * plan_struct.group_space
+                          > COMPACT_GROUP_LIMIT):
+            for i in idxs:
+                results[i] = execute_plan(plans[i])
             continue
         group_plans = [plans[i] for i in idxs]
         cols = _stacked_cols(group_plans, bucket)
@@ -100,6 +114,10 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
         params = tuple(
             jnp.stack([resolved[i][j] for i in idxs])
             for j in range(len(resolved[idxs[0]])))
+        if kind == "segc":
+            _run_segmented_compact(plans, idxs, plan_struct, bucket,
+                                   cols, n_docs, params, results)
+            continue
         fn = _vmapped_kernel(plan_struct, bucket)
         out = jax.device_get(fn(cols, n_docs, params))
         global_accountant.track_memory(
@@ -115,3 +133,51 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
             else:
                 results[i] = extract_partial(plans[i], per_seg)
     return results
+
+
+def _run_segmented_compact(plans, idxs, plan_struct, bucket, cols, n_docs,
+                           params, results) -> None:
+    """One device program for S same-plan compact group-by segments;
+    slices the (S*space,) dense outputs apart and extracts per segment."""
+    from ..ops.compact import full_slots_cap
+    from ..ops.kernels import jitted_segmented_compact
+    from .accounting import global_accountant
+
+    n_seg = len(idxs)
+    cap = None
+    fn = jitted_segmented_compact(plan_struct, bucket, n_seg)
+    out = jax.device_get(fn(cols, n_docs, params))
+    if int(out.pop("overflow", 0)):
+        cap = full_slots_cap(n_seg * bucket)
+        fn = jitted_segmented_compact(plan_struct, bucket, n_seg, cap)
+        out = jax.device_get(fn(cols, n_docs, params))
+        out.pop("overflow", None)
+    if int(out.pop("group_overflow", 0)):
+        fn = jitted_segmented_compact(plan_struct, bucket, n_seg, cap,
+                                      xfer_compact=False)
+        out = jax.device_get(fn(cols, n_docs, params))
+        out.pop("overflow", None)
+    global_accountant.track_memory(
+        sum(np.asarray(v).nbytes for v in out.values()))
+    space = plan_struct.group_space
+    matched = out.pop("matched")
+    gi = out.pop("group_idx", None)
+    for k, i in enumerate(idxs):
+        per_seg = {"matched": matched[k]}
+        if gi is not None:
+            # transfer-compacted: rows are live groups of the combined
+            # S*space; this segment owns flat ids [k*space, (k+1)*space)
+            rows = np.nonzero((gi >= k * space) & (gi < (k + 1) * space)
+                              & (np.asarray(out["group_count"]) > 0))[0]
+            per_seg["group_idx"] = np.asarray(gi)[rows] - k * space
+            for name, v in out.items():
+                per_seg[name] = np.asarray(v)[rows]
+        else:
+            for name, v in out.items():
+                v = np.asarray(v)
+                if v.ndim >= 1 and v.shape[0] == n_seg * space:
+                    per_seg[name] = v.reshape(
+                        (n_seg, space) + v.shape[1:])[k]
+                else:
+                    per_seg[name] = v
+        results[i] = extract_partial(plans[i], per_seg)

@@ -138,11 +138,11 @@ class DistributedTable:
     def plan(self, ctx: QueryContext) -> CompiledPlan:
         """Plan against the widened table view; shared dictionaries make the
         dict-id params valid table-wide, and widened min/max keep raw-column
-        constant folds and limb sizing correct for every segment.
-        prefer_dense: the mesh path vmaps the kernel over local segments,
-        which the compact strategy's Pallas call does not support."""
-        return SegmentPlanner(ctx, self._plan_view(),
-                              prefer_dense=True).plan()
+        constant folds and limb sizing correct for every segment. Compact-
+        strategy group-bys run flattened per device (local segments
+        concatenate along the row axis — _distributed_kernel), so the
+        planner chooses strategies exactly as the single-chip path does."""
+        return SegmentPlanner(ctx, self._plan_view()).plan()
 
     def try_execute(self, ctx: QueryContext):
         """Distributed partial, or None when the plan needs the per-segment
@@ -165,33 +165,73 @@ class DistributedTable:
         params = resolve_params(plan, sharding=self._sharding(P()))
         fn = _distributed_kernel(plan.kernel_plan, self.bucket, self.mesh,
                                  len(cols), len(params))
-        out = fn(cols, self._n_docs, params)
-        return jax.device_get(out)
+        host = jax.device_get(fn(cols, self._n_docs, params))
+        if int(host.pop("overflow", 0)):
+            # compact capacity exceeded on some device: rerun at the
+            # cannot-overflow capacity of a full local shard
+            from ..ops.compact import full_slots_cap
+            local = self.n_slots // self.n_dev
+            fn = _distributed_kernel(
+                plan.kernel_plan, self.bucket, self.mesh,
+                len(cols), len(params),
+                slots_cap=full_slots_cap(local * self.bucket))
+            host = jax.device_get(fn(cols, self._n_docs, params))
+            host.pop("overflow", None)
+        return host
 
 
 @functools.lru_cache(maxsize=512)
 def _distributed_kernel(kernel_plan, bucket: int, mesh: Mesh,
-                        n_cols: int, n_params: int):
-    """jit(shard_map(vmap(kernel) + collectives)) cached per plan/mesh."""
+                        n_cols: int, n_params: int,
+                        slots_cap: int = None):
+    """jit(shard_map(kernel + collectives)) cached per plan/mesh."""
     # dense (space,) outputs only: psum/pmin/pmax combine positionally
-    # across shards, which device-side transfer compaction would break
-    kern = build_kernel(kernel_plan, bucket, xfer_compact=False)
+    # across shards, which device-side transfer compaction would break.
+    # platform pins the kernel lowering to the mesh's backend (the
+    # driver's dryrun runs a CPU mesh under a TPU process default).
+    platform = mesh.devices.flat[0].platform
+    compact_gb = (kernel_plan.is_group_by
+                  and kernel_plan.strategy == "compact")
 
     def per_device(cols, n_docs, params):
         # cols: tuple of (L, bucket) local shards; n_docs: (L,)
-        out = jax.vmap(lambda c, n: kern(c, n, params))(cols, n_docs)
+        local_segs = n_docs.shape[0]
+        if compact_gb:
+            # flatten local segments into one row axis: shared table
+            # dictionaries make params segment-agnostic, so one Pallas
+            # compaction + group pass serves the whole local shard
+            kern = build_kernel(kernel_plan, bucket, slots_cap, platform,
+                                xfer_compact=False,
+                                local_segments=local_segs)
+            flat = tuple(c.reshape(local_segs * bucket) for c in cols)
+            local = kern(flat, n_docs, params)
+        else:
+            kern = build_kernel(kernel_plan, bucket, slots_cap, platform,
+                                xfer_compact=False)
+            out = jax.vmap(lambda c, n: kern(c, n, params))(cols, n_docs)
+            local = {}
+            for k, v in out.items():
+                op = _reduce_op(k)
+                if op == "sum":
+                    local[k] = v.sum(axis=0)
+                elif op == "min":
+                    local[k] = v.min(axis=0)
+                elif op == "max":
+                    local[k] = v.max(axis=0)
+                else:
+                    local[k] = v.max(axis=0)
         red = {}
-        for k, v in out.items():
+        for k, v in local.items():
             op = _reduce_op(k)
-            if op == "sum":
-                red[k] = jax.lax.psum(v.sum(axis=0), SEG_AXIS)
+            if k == "overflow" or op == "sum":
+                red[k] = jax.lax.psum(v, SEG_AXIS)
             elif op == "min":
-                red[k] = jax.lax.pmin(v.min(axis=0), SEG_AXIS)
+                red[k] = jax.lax.pmin(v, SEG_AXIS)
             elif op == "max":
-                red[k] = jax.lax.pmax(v.max(axis=0), SEG_AXIS)
+                red[k] = jax.lax.pmax(v, SEG_AXIS)
             else:  # 'or' on bool presence
                 red[k] = jax.lax.pmax(
-                    v.max(axis=0).astype(jnp.int32), SEG_AXIS).astype(bool)
+                    v.astype(jnp.int32), SEG_AXIS).astype(bool)
         return red
 
     in_specs = (tuple(P(SEG_AXIS, None) for _ in range(n_cols)),

@@ -181,14 +181,9 @@ def _like_to_regex(pattern: str) -> "re.Pattern":
 # ---------------------------------------------------------------------------
 
 class SegmentPlanner:
-    def __init__(self, ctx: QueryContext, segment: ImmutableSegment,
-                 prefer_dense: bool = False):
-        """prefer_dense keeps group-bys on the dense one-hot strategy (the
-        vmap/shard_map-compatible shape) — the distributed mesh path sets
-        it because the Pallas compaction kernel is per-device only."""
+    def __init__(self, ctx: QueryContext, segment: ImmutableSegment):
         self.ctx = ctx
         self.seg = segment
-        self.prefer_dense = prefer_dense
         self.b = _Binder(segment)
 
     # -- value expressions -------------------------------------------------
@@ -626,8 +621,7 @@ class SegmentPlanner:
                     break
                 space *= max(m.cardinality, 1)
             from ..ops.kernels import COMPACT_GROUP_LIMIT
-            space_cap = (MAX_DENSE_GROUPS if self.prefer_dense
-                         else max(MAX_DENSE_GROUPS, COMPACT_GROUP_LIMIT))
+            space_cap = max(MAX_DENSE_GROUPS, COMPACT_GROUP_LIMIT)
             if not dense_ok or space > space_cap:
                 return CompiledPlan("host", seg, ctx)
 
@@ -674,8 +668,7 @@ class SegmentPlanner:
             # core numeric agg (min/max ride an exact int64 orderable in a
             # lexicographic sort)
             compact_ok = (
-                not self.prefer_dense
-                and space <= COMPACT_GROUP_LIMIT
+                space <= COMPACT_GROUP_LIMIT
                 and all(s.kind in ("count", "sum", "avg", "min", "max")
                         for s in specs))
             # dense-strategy viability (one-hot over all rows)
