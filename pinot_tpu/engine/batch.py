@@ -81,22 +81,32 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
             results[i] = execute_plan(plan)
             continue
         kp = plan.kernel_plan
+        # column shapes join the group key: same-plan segments can differ
+        # in MV padded width (maxValues), and jnp.stack needs equal shapes
+        shape_sig = tuple(
+            getattr(plan.segment.columns[c], "max_values", None) or 0
+            for c in plan.col_names)
         if kp.strategy == "compact":
-            if segmented_compact_ok(kp):
+            sv_only = all(getattr(plan.segment.columns[c],
+                                  "single_value", True)
+                          for c in plan.col_names)
+            if segmented_compact_ok(kp) and sv_only:
                 # compact group-bys batch via the segmented kernel: the
                 # segment index becomes the leading group-key factor
                 # (ops/kernels.build_segmented_compact_kernel), replacing
                 # the per-segment launches the Pallas compaction forced
                 params = resolve_params(plan)
                 resolved[i] = params
-                key = ("segc", kp, plan.segment.bucket, _param_sig(params))
+                key = ("segc", kp, plan.segment.bucket,
+                       _param_sig(params) + shape_sig)
                 groups.setdefault(key, []).append(i)
             else:
                 results[i] = execute_plan(plan)
             continue
         params = resolve_params(plan)
         resolved[i] = params
-        key = ("dense", kp, plan.segment.bucket, _param_sig(params))
+        key = ("dense", kp, plan.segment.bucket,
+               _param_sig(params) + shape_sig)
         groups.setdefault(key, []).append(i)
 
     for (kind, plan_struct, bucket, _sig), idxs in groups.items():

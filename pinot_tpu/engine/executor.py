@@ -260,7 +260,10 @@ def _group_state(b: AggBinding, out: Dict[str, np.ndarray],
 
 
 def _kind(b: AggBinding) -> str:
-    return b.agg.kind
+    # MV kinds lower to their base kind's device states/names
+    # (SUMMV -> agg<i>_sum etc.; ops/aggregations.MV_BASE_KIND)
+    from ..ops.aggregations import base_kind
+    return base_kind(b.agg.kind)
 
 
 def _py(v: Any) -> Any:

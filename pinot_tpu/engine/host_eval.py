@@ -178,6 +178,66 @@ def _like_regex(pattern: str) -> "re.Pattern":
     return re.compile("^" + "".join(out) + "$", re.DOTALL)
 
 
+def _mv_meta(seg: ImmutableSegment, e: Any):
+    if isinstance(e, Identifier):
+        m = seg.columns.get(e.name)
+        if m is not None and not getattr(m, "single_value", True):
+            return m
+    return None
+
+
+def _mv_pred_mask(seg: ImmutableSegment, name: str, op: str,
+                  val: Any) -> np.ndarray:
+    """Any-over-values predicate on an MV column, in dict-id space (the
+    host peer of kernels._mv_any; pad id -1 is inert)."""
+    ids = np.asarray(seg.fwd(name))          # (n, M)
+    d = seg.dictionary(name)
+    svals = np.asarray(d.values)
+    m = seg.columns[name]
+
+    def coerce(v):
+        if m.data_type.is_numeric and isinstance(v, str):
+            return float(v) if ("." in v or "e" in v.lower()) else int(v)
+        if not m.data_type.is_numeric:
+            return str(v)
+        return v
+
+    if op in ("range", "not_range"):  # val = (lo, hi) incl; elementwise
+        lo_v, hi_v = coerce(val[0]), coerce(val[1])
+        lo = int(np.searchsorted(svals, lo_v, side="left"))
+        hi = int(np.searchsorted(svals, hi_v, side="right"))
+        inside = (ids >= lo) & (ids < hi)
+        if op == "range":
+            return inside.any(axis=1)
+        # NOT BETWEEN: any value outside (value-level negation, reference
+        # NotBetween applyMV); pads stay excluded
+        return (~inside & (ids >= 0)).any(axis=1)
+    if op == "not_in":     # val = list; any value not in the set
+        dids = [d.index_of(coerce(v)) for v in val]
+        hit = np.isin(ids, [i for i in dids if i >= 0])
+        return (~hit & (ids >= 0)).any(axis=1)
+    val = coerce(val)
+    if op == "==":
+        i = d.index_of(val)
+        return (ids == i).any(axis=1) if i >= 0 \
+            else np.zeros(len(ids), dtype=bool)
+    if op == "!=":         # any value differs (value-level negation)
+        i = d.index_of(val)
+        return ((ids != i) & (ids >= 0)).any(axis=1)
+    if op == "<":
+        hi = int(np.searchsorted(svals, val, side="left"))
+        return ((ids >= 0) & (ids < hi)).any(axis=1)
+    if op == "<=":
+        hi = int(np.searchsorted(svals, val, side="right"))
+        return ((ids >= 0) & (ids < hi)).any(axis=1)
+    if op == ">":
+        lo = int(np.searchsorted(svals, val, side="right"))
+        return (ids >= lo).any(axis=1)
+    assert op == ">=", op
+    lo = int(np.searchsorted(svals, val, side="left"))
+    return (ids >= lo).any(axis=1)
+
+
 def eval_filter(e: Any, seg: ImmutableSegment) -> np.ndarray:
     n = seg.n_docs
     if e is None:
@@ -195,6 +255,9 @@ def eval_filter(e: Any, seg: ImmutableSegment) -> np.ndarray:
     if isinstance(e, BoolNot):
         return ~eval_filter(e.child, seg)
     if isinstance(e, Comparison):
+        mvm = _mv_meta(seg, e.lhs)
+        if mvm is not None and isinstance(e.rhs, Literal):
+            return _mv_pred_mask(seg, e.lhs.name, e.op, e.rhs.value)
         # InvertedIndexFilterOperator analog: EQ/NEQ on a dict column with
         # an inverted index answers in O(selectivity) from posting lists
         if e.op in ("==", "!=") and isinstance(e.lhs, Identifier) \
@@ -248,6 +311,11 @@ def eval_filter(e: Any, seg: ImmutableSegment) -> np.ndarray:
                "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal}
         return np.broadcast_to(ops[e.op](l, r), (n,)).copy()
     if isinstance(e, Between):
+        if _mv_meta(seg, e.expr) is not None \
+                and isinstance(e.lo, Literal) and isinstance(e.hi, Literal):
+            return _mv_pred_mask(seg, e.expr.name,
+                                 "not_range" if e.negated else "range",
+                                 (e.lo.value, e.hi.value))
         v = eval_value(e.expr, seg)
         lo = eval_value(e.lo, seg)
         hi = eval_value(e.hi, seg)
@@ -256,6 +324,14 @@ def eval_filter(e: Any, seg: ImmutableSegment) -> np.ndarray:
         m = (v >= lo) & (v <= hi)
         return ~m if e.negated else m
     if isinstance(e, InList):
+        if _mv_meta(seg, e.expr) is not None:
+            if e.negated:
+                return _mv_pred_mask(seg, e.expr.name, "not_in",
+                                     [x.value for x in e.values])
+            m = np.zeros(seg.n_docs, dtype=bool)
+            for x in e.values:
+                m |= _mv_pred_mask(seg, e.expr.name, "==", x.value)
+            return m
         v = eval_value(e.expr, seg)
         vals = [x.value for x in e.values]
         if v.dtype == object:
@@ -363,6 +439,8 @@ def _agg_sel(agg: AggExpr, seg, sel: np.ndarray, na: bool) -> np.ndarray:
 def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
     if agg.kind == "count":
         return int(len(sel))
+    if agg.kind.endswith("_mv"):
+        return _mv_agg_state(agg, seg, sel)
     impl = aggregations.make(agg)  # extended registry kinds
     if impl is not None:
         h = aggregations.HostSel(lambda ast: eval_value(ast, seg, sel),
@@ -388,6 +466,35 @@ def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
     raise SqlError(f"unknown aggregation {agg.kind}")
 
 
+def _mv_agg_state(agg: AggExpr, seg: ImmutableSegment,
+                  sel: np.ndarray) -> Any:
+    """States for the MV aggregation family over list-valued rows (the
+    host peer of the MvReduce device lowering; states match the base
+    kind's — ops/aggregations.MV_BASE_KIND)."""
+    rows = eval_value(agg.arg, seg, sel)  # object array of per-row lists
+    k = agg.kind
+    if k == "count_mv":
+        return int(sum(len(r) for r in rows))
+    if k == "distinct_count_mv":
+        out: set = set()
+        for r in rows:
+            out.update(_scalar(v) for v in r)
+        return out
+    flat = [v for r in rows for v in r]
+    if k == "sum_mv":
+        if not flat:
+            return 0
+        s = sum(flat)
+        return int(s) if isinstance(s, (int, np.integer)) else float(s)
+    if k == "min_mv":
+        return _scalar(min(flat)) if flat else None
+    if k == "max_mv":
+        return _scalar(max(flat)) if flat else None
+    if k == "avg_mv":
+        return (float(sum(flat)), len(flat)) if flat else (0.0, 0)
+    raise SqlError(f"unknown MV aggregation {k}")
+
+
 def _scalar(v: Any) -> Any:
     return v.item() if isinstance(v, np.generic) else v
 
@@ -401,10 +508,33 @@ def host_group_by(ctx: QueryContext, seg: ImmutableSegment,
     if nsel == 0:
         return {}
     na = null_aware(ctx)
+
+    # MV group key: a row joins EVERY group of its values (reference MV
+    # GroupKeyGenerator semantics) — expand matched rows to (row, value)
+    # pairs; SV keys and aggregation inputs repeat per pair
+    mv_flat: Dict[int, np.ndarray] = {}
+    mv_keys = [ki for ki, g in enumerate(ctx.group_by)
+               if isinstance(g, Identifier)
+               and g.name in seg.columns
+               and not getattr(seg.columns[g.name], "single_value", True)]
+    if len(mv_keys) > 1:
+        raise SqlError("GROUP BY supports at most one multi-value column")
+    if mv_keys:
+        ki = mv_keys[0]
+        rows = eval_value(ctx.group_by[ki], seg, sel)
+        lens = np.fromiter((len(r) for r in rows), dtype=np.int64,
+                           count=len(rows))
+        sel = np.repeat(sel, lens)
+        nsel = len(sel)
+        if nsel == 0:
+            return {}
+        flat = [v for r in rows for v in r]
+        mv_flat[ki] = np.asarray(flat)
+
     codes = np.zeros(nsel, dtype=np.int64)
     uniques: List[Tuple[np.ndarray, bool]] = []
-    for g in ctx.group_by:
-        v = eval_value(g, seg, sel)
+    for ki, g in enumerate(ctx.group_by):
+        v = mv_flat[ki] if ki in mv_flat else eval_value(g, seg, sel)
         if v.dtype == object:
             v = v.astype(str)
         nm = expr_null_mask(g, seg) if na else None
@@ -465,6 +595,15 @@ def _group_states(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
     if agg.kind == "count":
         c = np.bincount(inv, minlength=n_groups)
         return [int(x) for x in c]
+    if agg.kind.endswith("_mv"):
+        # one stable partition of sel by group, not a boolean scan per
+        # group (O(n log n) instead of O(n_groups * n))
+        order = np.argsort(inv, kind="stable")
+        bounds = np.searchsorted(inv[order], np.arange(n_groups + 1))
+        sorted_sel = sel[order]
+        return [_mv_agg_state(agg, seg,
+                              sorted_sel[bounds[gi]:bounds[gi + 1]])
+                for gi in range(n_groups)]
     impl = aggregations.make(agg)  # extended registry kinds
     if impl is not None:
         h = aggregations.HostSel(lambda ast: eval_value(ast, seg, sel),

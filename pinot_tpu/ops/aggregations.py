@@ -70,7 +70,27 @@ AGG_NAME_TO_KIND: Dict[str, str] = {
     "boolor": "bool_or",
     "firstwithtime": "first_with_time",
     "lastwithtime": "last_with_time",
+    # multi-value variants (reference: SumMVAggregationFunction.java etc.)
+    "summv": "sum_mv",
+    "countmv": "count_mv",
+    "minmv": "min_mv",
+    "maxmv": "max_mv",
+    "avgmv": "avg_mv",
+    "distinctcountmv": "distinct_count_mv",
 }
+
+# MV aggregation states are value-space identical to a base kind's:
+# COUNTMV merges by addition (a sum of per-row value counts), so its
+# wire/merge base is "sum". Device lowering uses the same mapping
+# (query/planner.resolve_agg builds AggSpec(base, MvReduce(...))).
+MV_BASE_KIND: Dict[str, str] = {
+    "sum_mv": "sum", "count_mv": "sum", "min_mv": "min", "max_mv": "max",
+    "avg_mv": "avg", "distinct_count_mv": "distinct_count",
+}
+
+
+def base_kind(kind: str) -> str:
+    return MV_BASE_KIND.get(kind, kind)
 
 _PERC_RE = re.compile(r"^(percentile(?:est|tdigest|kll)?)(\d{1,2}|100)?$")
 
@@ -797,7 +817,7 @@ def _impl(agg: Any) -> AggImpl:
 
 
 def empty_state(agg: Any) -> Any:
-    k = agg.kind
+    k = base_kind(agg.kind)
     if k in _CLASSIC_EMPTY:
         e = _CLASSIC_EMPTY[k]
         return e() if callable(e) else e
@@ -805,7 +825,7 @@ def empty_state(agg: Any) -> Any:
 
 
 def merge_states(agg: Any, a: Any, b: Any) -> Any:
-    k = agg.kind
+    k = base_kind(agg.kind)
     if k == "count":
         return a + b
     if k == "sum":
@@ -827,7 +847,7 @@ def merge_states(agg: Any, a: Any, b: Any) -> Any:
 
 
 def finalize_state(agg: Any, s: Any) -> Any:
-    k = agg.kind
+    k = base_kind(agg.kind)
     if k == "avg":
         return None if s is None or s[1] == 0 else s[0] / s[1]
     if k == "distinct_count":

@@ -49,6 +49,20 @@ class Bin(ValueExpr):
     rhs: ValueExpr
 
 
+@dataclass(frozen=True)
+class MvReduce(ValueExpr):
+    """Per-row reduction over a multi-value column's padded (N, maxValues)
+    dict-id matrix (pad id -1): mode in {sum, count, min, max}. MV
+    aggregations pre-reduce per row and ride the scalar/group machinery:
+    SUMMV = SUM(MvReduce sum), COUNTMV = SUM(MvReduce count), MINMV =
+    MIN(MvReduce min), MAXMV = MAX(MvReduce max). Reference:
+    pinot-core/.../query/aggregation/function/SumMVAggregationFunction.java
+    (and Count/Min/Max MV variants)."""
+    col: int
+    mode: str
+    dict_param: Optional[int] = None
+
+
 # ---------------------------------------------------------------------------
 # Predicates (operator/filter/ + predicate evaluators in reference)
 # ---------------------------------------------------------------------------
@@ -70,9 +84,15 @@ class FalseP(Pred):
 @dataclass(frozen=True)
 class EqId(Pred):
     """stored[col] == params[param] — dict-id equality (the planner resolved
-    the literal through the sorted dictionary; absent values fold to FalseP)."""
+    the literal through the sorted dictionary; absent values fold to FalseP).
+
+    negated: VALUE-level negation (!=). Distinct from wrapping in Not() for
+    multi-value columns: `mv != x` matches when ANY value differs
+    (reference NotEqualsPredicateEvaluator applyMV), while NOT(mv = x)
+    matches when NO value equals. Identical for single-value columns."""
     col: int
     param: int
+    negated: bool = False
 
 
 @dataclass(frozen=True)
@@ -80,19 +100,23 @@ class IdRange(Pred):
     """lo <= stored[col] <= hi over dict ids or raw sorted-comparable values.
     Bounds are params (inclusive). The planner turns >,>=,<,<=,BETWEEN on
     dict columns into inclusive id ranges via Dictionary.id_range —
-    the sorted-dictionary trick that replaces Pinot's RangeIndexBasedFilterOperator."""
+    the sorted-dictionary trick that replaces Pinot's RangeIndexBasedFilterOperator.
+    negated: value-level NOT BETWEEN (see EqId.negated)."""
     col: int
     lo_param: Optional[int]
     hi_param: Optional[int]
+    negated: bool = False
 
 
 @dataclass(frozen=True)
 class InSet(Pred):
     """stored[col] IN params[param] (padded to static length n with a
-    sentinel that matches nothing). InPredicateEvaluator analog."""
+    sentinel that matches nothing). InPredicateEvaluator analog.
+    negated: value-level NOT IN (see EqId.negated)."""
     col: int
     param: int
     n: int
+    negated: bool = False
 
 
 @dataclass(frozen=True)

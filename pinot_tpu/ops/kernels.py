@@ -39,7 +39,8 @@ import jax
 import jax.numpy as jnp
 
 from .ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange, InSet,
-                 KernelPlan, Lit, MaskParam, Not, Or, Pred, TrueP, ValueExpr)
+                 KernelPlan, Lit, MaskParam, MvReduce, Not, Or, Pred, TrueP,
+                 ValueExpr)
 
 # unrolled masked-reduce limit for group MIN/MAX (no matmul form exists;
 # above this the planner routes to segment ops on CPU or the host path)
@@ -88,6 +89,22 @@ def _eval_value(ve: ValueExpr, cols, params, promote: bool = False
         return arr
     if isinstance(ve, Lit):
         return params[ve.param]
+    if isinstance(ve, MvReduce):
+        ids = cols[ve.col]                       # (N, M) int32, pad -1
+        present = ids >= 0
+        if ve.mode == "count":
+            return present.sum(-1).astype(int_acc_dtype())
+        vals = ids
+        if ve.dict_param is not None:
+            vals = jnp.take(params[ve.dict_param], jnp.maximum(ids, 0))
+        if promote and jnp.issubdtype(vals.dtype, jnp.integer):
+            vals = vals.astype(int_acc_dtype())
+        if ve.mode == "sum":
+            return jnp.where(present, vals,
+                             jnp.zeros((), vals.dtype)).sum(-1)
+        sign = 1 if ve.mode == "min" else -1
+        filled = jnp.where(present, vals, _extreme(vals.dtype, sign))
+        return filled.min(-1) if ve.mode == "min" else filled.max(-1)
     if isinstance(ve, Bin):
         l = _eval_value(ve.lhs, cols, params, promote)
         r = _eval_value(ve.rhs, cols, params, promote)
@@ -110,25 +127,51 @@ def _eval_value(ve: ValueExpr, cols, params, promote: bool = False
 # predicates -> mask
 # ---------------------------------------------------------------------------
 
+def _val_negate(m: jax.Array, arr: jax.Array) -> jax.Array:
+    """Value-level predicate negation (!=, NOT IN, NOT BETWEEN): flip the
+    per-value mask, keeping MV pad slots (-1) unmatched so the any-
+    reduction sees only real values."""
+    m = ~m
+    if arr.ndim == 2:
+        m &= arr >= 0
+    return m
+
+
+def _mv_any(m: jax.Array) -> jax.Array:
+    """MV predicate semantics: a row matches when ANY of its values does
+    (reference predicate evaluators' applySV vs applyMV split). SV masks
+    pass through; (N, M) masks reduce over the value axis. The -1 pad id
+    can never equal a dictionary id or fall in an id range, so pad slots
+    are inert."""
+    return m.any(axis=-1) if m.ndim == 2 else m
+
+
 def _eval_pred(p: Pred, cols, params, bucket: int) -> jax.Array:
     if isinstance(p, TrueP):
         return jnp.ones((bucket,), dtype=jnp.bool_)
     if isinstance(p, FalseP):
         return jnp.zeros((bucket,), dtype=jnp.bool_)
     if isinstance(p, EqId):
-        return cols[p.col] == params[p.param]
+        arr = cols[p.col]
+        m = arr == params[p.param]
+        return _mv_any(_val_negate(m, arr) if p.negated else m)
     if isinstance(p, IdRange):
         arr = cols[p.col]
-        m = jnp.ones((bucket,), dtype=jnp.bool_)
+        m = jnp.ones(arr.shape, dtype=jnp.bool_)
         if p.lo_param is not None:
             m &= arr >= params[p.lo_param]
         if p.hi_param is not None:
             m &= arr <= params[p.hi_param]
-        return m
+        if p.lo_param is None and arr.ndim == 2:
+            # hi-only range on MV: exclude the -1 pad slots (lo-bounded
+            # ranges exclude them already: dict-id bounds are >= 0)
+            m &= arr >= 0
+        return _mv_any(_val_negate(m, arr) if p.negated else m)
     if isinstance(p, InSet):
         arr = cols[p.col]
         vals = params[p.param]  # (n,)
-        return (arr[:, None] == vals[None, :]).any(axis=-1)
+        m = (arr[..., None] == vals[None, :]).any(axis=-1)
+        return _mv_any(_val_negate(m, arr) if p.negated else m)
     if isinstance(p, Cmp):
         l = _eval_value(p.lhs, cols, params)
         r = params[p.param]
@@ -386,7 +429,7 @@ COMPACT_GROUP_LIMIT = 1 << 22
 
 
 def _value_col_indices(ve) -> set:
-    if isinstance(ve, Col):
+    if isinstance(ve, (Col, MvReduce)):
         return {ve.col}
     if isinstance(ve, Bin):
         return _value_col_indices(ve.lhs) | _value_col_indices(ve.rhs)

@@ -155,6 +155,12 @@ class DistributedTable:
                and p[0] in ("nullmask", "validdocs", "docmask")
                for p in plan.params):
             return None  # per-segment data params need the per-segment path
+        if any(not getattr(self.segments[0].columns[c],
+                           "single_value", True)
+               for c in plan.col_names):
+            # MV columns are (bucket, maxValues) matrices; the sharded
+            # column stack is 2-D — per-segment path handles them
+            return None
         out = self._run(plan)
         return extract_partial(plan, out)
 

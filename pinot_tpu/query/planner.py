@@ -193,6 +193,9 @@ class SegmentPlanner:
             m = self.seg.columns.get(e.name)
             if m is None:
                 raise PlanError(f"unknown column {e.name!r}")
+            if not getattr(m, "single_value", True):
+                raise PlanError(f"column {e.name!r} is multi-value; use "
+                                "the MV aggregation forms (SUMMV, ...)")
             if not m.data_type.is_numeric:
                 raise PlanError(f"column {e.name!r} ({m.data_type.value}) "
                                 "is not numeric in a value context")
@@ -233,7 +236,11 @@ class SegmentPlanner:
             return self._comparison(e)
         if isinstance(e, Between):
             p = self._range(e.expr, e.lo, e.hi, True, True)
-            return Not(p) if e.negated else p
+            if e.negated:
+                name = e.expr.name if isinstance(e.expr, Identifier) \
+                    else None
+                return self._value_negate(p, name)
+            return p
         if isinstance(e, InList):
             return self._in_list(e)
         if isinstance(e, Like):
@@ -278,9 +285,10 @@ class SegmentPlanner:
                 if op == "!=":
                     i = d.index_of(self._cast_for(m, v))
                     if i < 0:
-                        return TrueP()
-                    return Not(EqId(self.b.bind_col(name),
-                                    self.b.add_param(np.int32(i))))
+                        return self._value_negate(FalseP(), name)
+                    return self._value_negate(
+                        EqId(self.b.bind_col(name),
+                             self.b.add_param(np.int32(i))), name)
                 lo, hi, il, ih = {
                     "<": (None, v, True, False),
                     "<=": (None, v, True, True),
@@ -392,6 +400,35 @@ class SegmentPlanner:
             kids.append(self._raw_cmp(name, m, "<=" if ih else "<", hi_v))
         return _simplify(And(tuple(kids))) if kids else TrueP()
 
+    def _is_mv(self, name: Optional[str]) -> bool:
+        if name is None:
+            return False
+        m = self.seg.columns.get(name)
+        return m is not None and not getattr(m, "single_value", True)
+
+    def _mv_has_value(self, name: str) -> Pred:
+        """Matches rows with at least one value: value-level negation of a
+        nothing-matches predicate on an MV column (empty arrays match
+        nothing). -2 equals no dict id, so negated-EqId flips every real
+        value true while pads stay excluded."""
+        return EqId(self.b.bind_col(name), self.b.add_param(np.int32(-2)),
+                    negated=True)
+
+    def _value_negate(self, p: Pred, name: Optional[str]) -> Pred:
+        """!=, NOT IN, NOT BETWEEN negate per VALUE: an MV row matches when
+        ANY value fails the base predicate (reference NotEquals/NotIn/
+        NotBetween applyMV semantics) — different from doc-level Not().
+        Identical for single-value columns."""
+        from dataclasses import replace as dc_replace
+        if isinstance(p, (EqId, IdRange, InSet)):
+            return dc_replace(p, negated=not p.negated)
+        if self._is_mv(name):
+            if isinstance(p, FalseP):   # base matched no value
+                return self._mv_has_value(name)
+            if isinstance(p, TrueP):    # base matched every value
+                return FalseP()
+        return Not(p)
+
     def _dict_range(self, name: str, lo: Any, hi: Any, il: bool, ih: bool
                     ) -> Pred:
         m = self.seg.columns[name]
@@ -420,20 +457,22 @@ class SegmentPlanner:
             raise PlanError(f"unknown column {name!r}")
         vals = [v.value for v in e.values]
         if not vals:  # empty IN list (e.g. an empty IN-subquery result)
-            return TrueP() if e.negated else FalseP()
+            return self._value_negate(FalseP(), name) if e.negated \
+                else FalseP()
         if m.has_dict:
             d = self.seg.dictionary(name)
             ids = [d.index_of(self._cast_for(m, v)) for v in vals]
             ids = sorted({i for i in ids if i >= 0})
             if not ids:
-                return TrueP() if e.negated else FalseP()
+                return self._value_negate(FalseP(), name) if e.negated \
+                    else FalseP()
             arr = _pad_dup(np.asarray(ids, dtype=np.int32))
             p = InSet(self.b.bind_col(name), self.b.add_param(arr), len(arr))
         else:
             vals = [self._cast_for(m, v) for v in vals]
             arr = _pad_dup(np.asarray(vals, dtype=m.data_type.np_dtype))
             p = InSet(self.b.bind_col(name), self.b.add_param(arr), len(arr))
-        return Not(p) if e.negated else p
+        return self._value_negate(p, name) if e.negated else p
 
     def _like(self, e: Like) -> Pred:
         if not isinstance(e.expr, Identifier):
@@ -521,6 +560,8 @@ class SegmentPlanner:
         if agg.kind == "count":  # COUNT(col): Pinot counts all rows when
             # null handling is disabled (NullableSingleInputAggregationFunction)
             return AggSpec("count", None, True), AggBinding(agg, i, True)
+        if agg.kind in ("sum_mv", "count_mv", "min_mv", "max_mv"):
+            return self._resolve_mv_agg(i, agg)
         if agg.kind not in ("sum", "min", "max", "avg"):
             raise PlanError(f"no device lowering for {agg.kind} "
                             "(host fallback)")
@@ -528,6 +569,50 @@ class SegmentPlanner:
         bits, signed = self._bits_for(self._range_of(agg.arg))
         return (AggSpec(agg.kind, ve, integral, bits=bits, signed=signed),
                 AggBinding(agg, i, integral))
+
+    def _resolve_mv_agg(self, i: int, agg: AggExpr
+                        ) -> Tuple[AggSpec, AggBinding]:
+        """SUMMV/COUNTMV/MINMV/MAXMV lower to the base kind over a per-row
+        MvReduce (ops/ir.py); AVGMV and DISTINCTCOUNTMV stay host-side
+        (their device states need a values-count column pair / 2-D
+        presence)."""
+        from ..ops.aggregations import base_kind
+        from ..ops.ir import MvReduce
+
+        if not isinstance(agg.arg, Identifier):
+            raise PlanError("MV aggregations take a column argument")
+        name = agg.arg.name
+        m = self.seg.columns.get(name)
+        if m is None or getattr(m, "single_value", True) \
+                or not m.has_dict:
+            raise PlanError(f"{agg.kind} needs a multi-value dictionary "
+                            f"column (host fallback)")
+        idx = self.b.bind_col(name)
+        base = base_kind(agg.kind)
+        if agg.kind == "count_mv":
+            # per-row value count <= maxValues: tiny exact int sums
+            bits = max(1, int(m.max_values or 1).bit_length())
+            spec = AggSpec("sum", MvReduce(idx, "count"), True,
+                           bits=bits, signed=False)
+            return spec, AggBinding(agg, i, True)
+        if not m.data_type.is_numeric:
+            raise PlanError(f"{agg.kind} over a non-numeric MV column "
+                            "(host fallback)")
+        integral = m.data_type.np_dtype.kind in "iu"
+        dict_param = self.b.add_param(("dictvals", name))
+        mode = agg.kind.split("_")[0]  # sum | min | max
+        ve = MvReduce(idx, mode, dict_param)
+        if m.min is None or m.max is None:
+            rng = None
+        elif mode == "sum":
+            # per-row sum bound: maxValues * max magnitude
+            mv = float(m.max_values or 1)
+            rng = (min(0.0, float(m.min) * mv), float(m.max) * mv)
+        else:
+            rng = (float(m.min), float(m.max))
+        bits, signed = self._bits_for(rng)
+        spec = AggSpec(base, ve, integral, bits=bits, signed=signed)
+        return spec, AggBinding(agg, i, integral)
 
     # -- validation --------------------------------------------------------
     def _validate_columns(self) -> None:
@@ -616,7 +701,10 @@ class SegmentPlanner:
                 m = seg.columns.get(g.name)
                 if m is None:
                     raise PlanError(f"unknown column {g.name!r}")
-                if not m.has_dict or m.cardinality == 0:
+                if not m.has_dict or m.cardinality == 0 \
+                        or not getattr(m, "single_value", True):
+                    # MV group keys (row joins every value's group,
+                    # reference MV GroupKeyGenerator) stay host-side
                     dense_ok = False
                     break
                 space *= max(m.cardinality, 1)
@@ -667,10 +755,14 @@ class SegmentPlanner:
             # aggregation (ops/kernels._compact_group_aggs); covers every
             # core numeric agg (min/max ride an exact int64 orderable in a
             # lexicographic sort)
+            from ..ops.ir import MvReduce as _MvR
             compact_ok = (
                 space <= COMPACT_GROUP_LIMIT
                 and all(s.kind in ("count", "sum", "avg", "min", "max")
-                        for s in specs))
+                        for s in specs)
+                # MV value columns are (bucket, maxValues) matrices; the
+                # row compaction primitive is 1-D — dense handles them
+                and not any(isinstance(s.value, _MvR) for s in specs))
             # dense-strategy viability (one-hot over all rows)
             dense_viable = space <= MAX_DENSE_GROUPS
             if slow_scatter and seg.bucket * (space + 1) > DENSE_ONEHOT_BUDGET:

@@ -97,7 +97,13 @@ class SegmentBuilder:
                     cols[f.name] = raw  # type: ignore[assignment]
                     nulls[f.name] = np.zeros(len(raw), dtype=bool)
                     continue
-                arr = np.asarray(raw)
+                if not f.single_value:
+                    # ragged MV rows refuse np.asarray; keep object cells
+                    arr = np.empty(len(raw), dtype=object)
+                    for j, r in enumerate(raw):
+                        arr[j] = r
+                else:
+                    arr = np.asarray(raw)
                 if n is None:
                     n = len(arr)
                 elif len(arr) != n:
@@ -115,6 +121,20 @@ class SegmentBuilder:
 
     def _coerce(self, f, arr: np.ndarray):
         null_mask = np.zeros(len(arr), dtype=bool)
+        if not f.single_value:
+            # multi-value column: rows are sequences (ragged); None -> []
+            # (reference: FixedBitMVForwardIndexReader stores offset+values;
+            # the TPU-native layout is a padded (n, maxValues) id matrix)
+            out = np.empty(len(arr), dtype=object)
+            cast = (str if f.data_type == DataType.STRING
+                    else f.data_type.np_dtype.type)
+            for i, row in enumerate(arr):
+                if row is None:
+                    null_mask[i] = True
+                    out[i] = []
+                else:
+                    out[i] = [cast(v) for v in row]
+            return out, null_mask
         if f.name in self.table_config.indexing.vector_index_columns:
             # vector column: rows are fixed-dim float sequences; stored only
             # through the vector index (index/vector.py), queried only via
@@ -227,8 +247,65 @@ class SegmentBuilder:
             json.dump(meta, fh, indent=1, default=_json_default)
         return seg_dir
 
+    def _build_mv_column(self, f, arr: np.ndarray, seg_dir: str,
+                         shared_dict: Optional[Dictionary] = None
+                         ) -> Dict[str, Any]:
+        """Multi-value column: padded (n, maxValues) dict-id matrix, pad
+        id -1 (signed min-width storage). -1 is inert under any-over-axis
+        predicates and MvReduce aggregations without needing the
+        cardinality at eval time."""
+        n = len(arr)
+        flat = [v for row in arr for v in row]
+        if f.data_type == DataType.STRING:
+            flat_arr = np.asarray(flat, dtype=object)
+        else:
+            flat_arr = np.asarray(flat, dtype=f.data_type.np_dtype) \
+                if flat else np.asarray([], dtype=f.data_type.np_dtype)
+        if shared_dict is not None:
+            dictionary = shared_dict
+            flat_ids = self._encode_with(shared_dict, flat_arr, f.data_type)
+        else:
+            dictionary, flat_ids = Dictionary.build(flat_arr, f.data_type)
+        max_values = max((len(row) for row in arr), default=1) or 1
+        card = dictionary.cardinality
+        dt = next(d for d in (np.int8, np.int16, np.int32)
+                  if card <= np.iinfo(d).max)
+        mat = np.full((n, max_values), -1, dtype=dt)
+        pos = 0
+        for i, row in enumerate(arr):
+            k = len(row)
+            if k:
+                mat[i, :k] = flat_ids[pos:pos + k]
+                pos += k
+        mat.tofile(_fwd_path(seg_dir, f.name))
+        cmeta: Dict[str, Any] = {
+            "dataType": f.data_type.value,
+            "fieldType": f.field_type.value,
+            "encoding": "DICT",
+            "singleValue": False,
+            "maxValues": int(max_values),
+            "fwdDtype": dt().dtype.name,
+            "cardinality": card,
+            "isSorted": False,
+        }
+        if f.data_type == DataType.STRING:
+            with open(_dict_json_path(seg_dir, f.name), "w") as fh:
+                json.dump(list(dictionary.values), fh)
+            cmeta["dictFormat"] = "json"
+        else:
+            vals = np.asarray(dictionary.values, dtype=f.data_type.np_dtype)
+            vals.tofile(_dict_bin_path(seg_dir, f.name))
+            cmeta["dictFormat"] = "bin"
+            cmeta["dictDtype"] = f.data_type.np_dtype.name
+        if card:
+            cmeta["min"] = _json_scalar(dictionary.min_value)
+            cmeta["max"] = _json_scalar(dictionary.max_value)
+        return cmeta
+
     def _build_column(self, f, arr: np.ndarray, seg_dir: str,
                       shared_dict: Optional[Dictionary] = None) -> Dict[str, Any]:
+        if not f.single_value:
+            return self._build_mv_column(f, arr, seg_dir, shared_dict)
         n = len(arr)
         cmeta: Dict[str, Any] = {
             "dataType": f.data_type.value,
@@ -354,6 +431,14 @@ def build_table_dictionaries(schema: Schema, table_config: TableConfig,
             accum[name].append(arr)
     dicts: Dict[str, Dictionary] = {}
     for f in schema.fields:
+        if not f.single_value:
+            # MV columns: union over the flattened values
+            flat = [v for a in accum[f.name] for row in a for v in row]
+            allv = (np.asarray(flat, dtype=object)
+                    if f.data_type == DataType.STRING
+                    else np.asarray(flat, dtype=f.data_type.np_dtype))
+            dicts[f.name], _ = Dictionary.build(allv, f.data_type)
+            continue
         allv = np.concatenate([np.asarray(a, dtype=object)
                                if f.data_type == DataType.STRING else a
                                for a in accum[f.name]])

@@ -53,6 +53,8 @@ class ColumnMeta:
         self.dict_format = d.get("dictFormat")
         self.dict_dtype = d.get("dictDtype")
         self.partitions = d.get("partitions")
+        self.single_value = d.get("singleValue", True)
+        self.max_values = d.get("maxValues")  # MV: padded row width
         # secondary indexes: kind -> extra metadata (index/registry.py)
         self.indexes: Dict[str, Any] = d.get("indexes", {})
 
@@ -113,10 +115,15 @@ class ImmutableSegment:
                 raw = native.decompress(comp, m.raw_size, m.codec)
                 arr = raw.view(m.fwd_dtype)[: self.n_docs]
             elif self._read_mode == "mmap":
+                shape = ((self.n_docs,) if m.single_value
+                         else (self.n_docs, m.max_values))
                 arr = np.memmap(path, dtype=m.fwd_dtype, mode="r",
-                                shape=(self.n_docs,))
+                                shape=shape)
             else:
-                arr = np.fromfile(path, dtype=m.fwd_dtype, count=self.n_docs)
+                count = self.n_docs * (1 if m.single_value else m.max_values)
+                arr = np.fromfile(path, dtype=m.fwd_dtype, count=count)
+                if not m.single_value:
+                    arr = arr.reshape(self.n_docs, m.max_values)
             self._fwd[col] = arr
         return self._fwd[col]
 
@@ -163,6 +170,13 @@ class ImmutableSegment:
         if m.encoding == "VECTOR":
             return np.asarray(self.index_reader(col, "vector").matrix)
         stored = self.fwd(col)
+        if not m.single_value:
+            d = self.dictionary(col)
+            out = np.empty(self.n_docs, dtype=object)
+            for i, row in enumerate(np.asarray(stored)):
+                ids = row[row >= 0]
+                out[i] = list(d.values_for(ids))
+            return out
         if m.has_dict:
             return self.dictionary(col).values_for(np.asarray(stored))
         return np.asarray(stored)
@@ -194,7 +208,11 @@ class ImmutableSegment:
             if m.has_dict:
                 host = host.astype(np.int32, copy=False)
             if bucket > self.n_docs:
-                pad = np.zeros(bucket - self.n_docs, dtype=host.dtype)
+                # MV columns pad rows with -1 (the padded-slot sentinel);
+                # SV padding is inert under validity masks either way
+                pad = np.full((bucket - self.n_docs,) + host.shape[1:],
+                              -1 if not m.single_value else 0,
+                              dtype=host.dtype)
                 host = np.concatenate([host, pad])
             self._device[key] = self._put(host, sharding)
         return self._device[key]

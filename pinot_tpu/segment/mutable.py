@@ -165,6 +165,8 @@ class _ViewColumnMeta:
         self.max = None
         self.has_nulls = any_nulls
         self.partitions = None
+        self.single_value = spec.single_value
+        self.max_values = None
 
     @property
     def has_dict(self) -> bool:

@@ -33,10 +33,15 @@ def _clean_env():
 def test_compact_strategy_on_hardware():
     if os.environ.get("PINOT_SKIP_TPU_HW"):
         pytest.skip("PINOT_SKIP_TPU_HW set")
-    probe = subprocess.run(
-        [sys.executable, "-c",
-         "import jax; print(jax.default_backend())"],
-        env=_clean_env(), capture_output=True, text=True, timeout=120)
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            env=_clean_env(), capture_output=True, text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        # a wedged device tunnel hangs backend init indefinitely; that is
+        # an environment outage, not a code failure
+        pytest.skip("TPU backend init timed out (tunnel down?)")
     if "tpu" not in probe.stdout:
         pytest.skip(f"no TPU attached (backend: {probe.stdout.strip()!r})")
 
