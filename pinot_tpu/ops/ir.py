@@ -120,6 +120,19 @@ class InSet(Pred):
 
 
 @dataclass(frozen=True)
+class InBitmap(Pred):
+    """stored[col] IN <set>, where params[param] is a (cardinality,) bool
+    presence table over dict ids — one gather per value instead of the
+    O(rows x set) broadcast compare InSet pays. The planner picks this for
+    dict columns once the resolved id set exceeds INSET_BITMAP_MIN
+    (reference: DictionaryBasedInPredicateEvaluator, which likewise
+    precomputes the matching-id set once)."""
+    col: int
+    param: int
+    negated: bool = False
+
+
+@dataclass(frozen=True)
 class Cmp(Pred):
     """Generic comparison on a value expression (raw-column / expression
     filters — ScanBasedFilterOperator + ExpressionFilterOperator analog).

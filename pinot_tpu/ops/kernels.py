@@ -38,9 +38,17 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange, InSet,
-                 KernelPlan, Lit, MaskParam, MvReduce, Not, Or, Pred, TrueP,
-                 ValueExpr)
+from .ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange,
+                 InBitmap, InSet, KernelPlan, Lit, MaskParam, MvReduce, Not,
+                 Or, Pred, TrueP, ValueExpr)
+
+# IN lists longer than this use sorted-membership (raw values) or a
+# presence-table gather (dict ids) instead of broadcast compare
+INSET_SEARCH_MIN = 64
+INSET_BITMAP_MIN = 64
+# scalar DISTINCTCOUNT cardinality above which the one-hot presence
+# matmul (rows x card MACs) yields to sort + run boundaries
+DISTINCT_ONEHOT_CARD = 1 << 12
 
 # unrolled masked-reduce limit for group MIN/MAX (no matmul form exists;
 # above this the planner routes to segment ops on CPU or the host path)
@@ -169,8 +177,20 @@ def _eval_pred(p: Pred, cols, params, bucket: int) -> jax.Array:
         return _mv_any(_val_negate(m, arr) if p.negated else m)
     if isinstance(p, InSet):
         arr = cols[p.col]
-        vals = params[p.param]  # (n,)
-        m = (arr[..., None] == vals[None, :]).any(axis=-1)
+        vals = params[p.param]  # (n,) sorted ascending
+        if p.n > INSET_SEARCH_MIN:
+            # sorted membership: binary search beats the O(rows x n)
+            # broadcast compare for big IN lists (InPredicateEvaluator
+            # analog for raw values; dict columns take InBitmap instead)
+            idx = jnp.clip(jnp.searchsorted(vals, arr), 0, p.n - 1)
+            m = jnp.take(vals, idx) == arr
+        else:
+            m = (arr[..., None] == vals[None, :]).any(axis=-1)
+        return _mv_any(_val_negate(m, arr) if p.negated else m)
+    if isinstance(p, InBitmap):
+        arr = cols[p.col]
+        tbl = params[p.param]   # (cardinality,) bool presence over ids
+        m = jnp.take(tbl, jnp.maximum(arr, 0)) & (arr >= 0)
         return _mv_any(_val_negate(m, arr) if p.negated else m)
     if isinstance(p, Cmp):
         l = _eval_value(p.lhs, cols, params)
@@ -269,9 +289,18 @@ def _scalar_agg(i: int, spec: AggSpec, mask, cols, params,
         out[name] = jnp.sum(mask, dtype=cnt_dtype)
         return
     if spec.kind == "distinct_count":
-        # presence via MXU: counts[c] = mask . one_hot(ids)[., c]; > 0
         ids = _eval_value(spec.value, cols, params)
-        ids_s = jnp.where(mask, ids, spec.card)  # sentinel -> zero column
+        ids_s = jnp.where(mask, ids, spec.card)  # sentinel past the card
+        if spec.card > DISTINCT_ONEHOT_CARD:
+            # sort + run boundaries: O(n log n) with no card-sized
+            # matmul operand — scales DISTINCTCOUNT to 1M+ cardinality
+            # (the partial stays the mergeable (card,) presence bitmap)
+            s = jnp.sort(ids_s.astype(jnp.int32))
+            edges = jnp.searchsorted(
+                s, jnp.arange(spec.card + 1, dtype=jnp.int32))
+            out[name + "_present"] = (edges[1:] - edges[:-1]) > 0
+            return
+        # presence via MXU: counts[c] = mask . one_hot(ids)[., c]; > 0
         oh = jax.nn.one_hot(ids_s, spec.card, dtype=jnp.int8)
         counts = _int8_dot(mask.astype(jnp.int8)[None, :], oh)[0]
         out[name + "_present"] = counts > 0
@@ -847,7 +876,7 @@ def _compact_group_xfer(plan: KernelPlan, out: Dict[str, jax.Array]) -> None:
 
 def _pred_col_indices(p) -> set:
     """Stored-column indices a predicate references."""
-    if isinstance(p, (EqId, IdRange, InSet)):
+    if isinstance(p, (EqId, IdRange, InSet, InBitmap)):
         return {p.col}
     if isinstance(p, Cmp):
         return _value_col_indices(p.lhs)

@@ -12,7 +12,8 @@ from __future__ import annotations
 from collections import Counter
 from typing import Any, List, Tuple
 
-from ..ops.ir import (And, Bin, Cmp, Col, EqId, FalseP, IdRange, InSet,
+from ..ops.ir import (And, Bin, Cmp, Col, EqId, FalseP, IdRange, InBitmap,
+                      InSet,
                       KernelPlan, Lit, MaskParam, Not, Or, Pred, TrueP,
                       ValueExpr)
 from ..query.planner import CompiledPlan
@@ -40,6 +41,8 @@ def _pred(p: Pred, cols: List[str]) -> str:
         return f"RANGE_DICT({cols[p.col]})"
     if isinstance(p, InSet):
         return f"IN_SET({cols[p.col]},n={p.n})"
+    if isinstance(p, InBitmap):
+        return f"IN_BITMAP({cols[p.col]})"
     if isinstance(p, Cmp):
         return f"CMP({_ve(p.lhs, cols)}{p.op})"
     if isinstance(p, MaskParam):

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..ops.ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange,
-                      InSet, IsNull as IsNullIR, KernelPlan, Lit,
+                      InBitmap, InSet, IsNull as IsNullIR, KernelPlan, Lit,
                       MaskParam as MaskParamP, Not, Or, Pred, TrueP,
                       ValueExpr)
 from ..segment.immutable import ImmutableSegment
@@ -111,15 +111,17 @@ class _Binder:
 
 
 def _pad_dup(vals: np.ndarray) -> np.ndarray:
-    """Pad a small set to pow2 with copies of the first element (duplicates
-    don't change `any(==)` semantics) to bound recompiles on IN-list size."""
+    """Pad a sorted set to pow2 with copies of the LAST element (duplicates
+    change neither `any(==)` semantics nor sortedness — the kernel's
+    sorted-membership path needs ascending order) to bound recompiles on
+    IN-list size."""
     n = len(vals)
     p = 1
     while p < n:
         p <<= 1
     if p == n:
         return vals
-    return np.concatenate([vals, np.repeat(vals[:1], p - n)])
+    return np.concatenate([vals, np.repeat(vals[-1:], p - n)])
 
 
 def _simplify(p: Pred) -> Pred:
@@ -420,7 +422,7 @@ class SegmentPlanner:
         NotBetween applyMV semantics) — different from doc-level Not().
         Identical for single-value columns."""
         from dataclasses import replace as dc_replace
-        if isinstance(p, (EqId, IdRange, InSet)):
+        if isinstance(p, (EqId, IdRange, InSet, InBitmap)):
             return dc_replace(p, negated=not p.negated)
         if self._is_mv(name):
             if isinstance(p, FalseP):   # base matched no value
@@ -459,6 +461,7 @@ class SegmentPlanner:
         if not vals:  # empty IN list (e.g. an empty IN-subquery result)
             return self._value_negate(FalseP(), name) if e.negated \
                 else FalseP()
+        from ..ops.kernels import INSET_BITMAP_MIN
         if m.has_dict:
             d = self.seg.dictionary(name)
             ids = [d.index_of(self._cast_for(m, v)) for v in vals]
@@ -466,10 +469,19 @@ class SegmentPlanner:
             if not ids:
                 return self._value_negate(FalseP(), name) if e.negated \
                     else FalseP()
-            arr = _pad_dup(np.asarray(ids, dtype=np.int32))
-            p = InSet(self.b.bind_col(name), self.b.add_param(arr), len(arr))
+            if len(ids) > INSET_BITMAP_MIN:
+                # big IN list on a dict column: one presence-table gather
+                # per value (InBitmap) instead of a broadcast compare
+                table = np.zeros(m.cardinality, dtype=bool)
+                table[np.asarray(ids)] = True
+                p: Pred = InBitmap(self.b.bind_col(name),
+                                   self.b.add_param(table))
+            else:
+                arr = _pad_dup(np.asarray(ids, dtype=np.int32))
+                p = InSet(self.b.bind_col(name), self.b.add_param(arr),
+                          len(arr))
         else:
-            vals = [self._cast_for(m, v) for v in vals]
+            vals = sorted(self._cast_for(m, v) for v in vals)
             arr = _pad_dup(np.asarray(vals, dtype=m.data_type.np_dtype))
             p = InSet(self.b.bind_col(name), self.b.add_param(arr), len(arr))
         return self._value_negate(p, name) if e.negated else p
@@ -730,11 +742,12 @@ class SegmentPlanner:
             return CompiledPlan("host", seg, ctx)
 
         if not ctx.is_group_by:
-            # scalar DISTINCTCOUNT presence matrix gate (group path gated
-            # below; backends that materialize one_hot would OOM otherwise)
+            # scalar DISTINCTCOUNT: the sort-boundary path (kernels.
+            # DISTINCT_ONEHOT_CARD) removes the card-sized matmul, so the
+            # gate is only the (card,) presence-bitmap transfer size
             for s in specs:
                 if s.kind == "distinct_count" and s.card is not None \
-                        and s.card > 1 << 16:
+                        and s.card > MAX_DISTINCT_MATRIX:
                     return CompiledPlan("host", seg, ctx)
 
         strategy = "dense"
