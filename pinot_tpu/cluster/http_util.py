@@ -25,7 +25,11 @@ class JsonHandler(BaseHTTPRequestHandler):
     def _dispatch(self, method: str) -> None:
         body = None
         length = int(self.headers.get("Content-Length") or 0)
-        if length:
+        raw = "octet-stream" in (self.headers.get("Content-Type") or "")
+        if length and raw:
+            # binary data plane: the handler receives the raw bytes
+            body = self.rfile.read(length)
+        elif length:
             try:
                 body = json.loads(self.rfile.read(length))
             except ValueError as e:
@@ -81,10 +85,16 @@ def start_http(handler_cls, port: int = 0) -> Tuple[ThreadingHTTPServer,
 
 def http_raw(method: str, url: str, body: Any = None,
              timeout: float = 10.0) -> bytes:
-    """JSON request, raw-bytes response (the binary data plane)."""
-    data = json.dumps(body).encode() if body is not None else None
+    """Raw-bytes response; body may be JSON-able or raw bytes (the latter
+    POSTs as octet-stream — the binary data plane both ways)."""
+    if isinstance(body, (bytes, bytearray)):
+        data = bytes(body)
+        ctype = "application/octet-stream"
+    else:
+        data = json.dumps(body).encode() if body is not None else None
+        ctype = "application/json"
     req = urllib.request.Request(url, data=data, method=method,
-                                 headers={"Content-Type": "application/json"})
+                                 headers={"Content-Type": ctype})
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return resp.read()
 

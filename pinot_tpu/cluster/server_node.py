@@ -43,6 +43,8 @@ class ServerNode:
         # admission + ordering for concurrent HTTP queries
         # (QuerySchedulerFactory analog; fcfs by default)
         self.scheduler = make_scheduler(scheduler_config)
+        from ..multistage.exchange import MailboxService
+        self.mailboxes = MailboxService()  # multi-stage receiving side
         # OOM protection: kill the most expensive query near the RSS limit
         # (PerQueryCPUMemAccountant WatcherTask analog); limit defaults to
         # 90% of system memory, override/disable via
@@ -199,6 +201,15 @@ class ServerNode:
         raw = resp.pop("partials_raw", [])
         return encode_wire_frame(resp, raw)
 
+    def handle_mailbox(self, data: bytes) -> Dict[str, Any]:
+        from ..multistage.dispatch import deliver_mailbox_frame
+        deliver_mailbox_frame(self.mailboxes, data)
+        return {"status": "OK"}
+
+    def handle_stage(self, spec: Dict[str, Any]):
+        from ..multistage.dispatch import execute_stage
+        return execute_stage(self, spec)
+
     def _make_handler(self):
         node = self
 
@@ -209,6 +220,12 @@ class ServerNode:
                     200, node.execute_bin(b["sql"], b.get("segments"))),
                 ("POST", "/query"): lambda h, b: (
                     200, node.execute_json(b["sql"], b.get("segments"))),
+                # multi-stage data plane (mailbox.proto analog) + stage
+                # dispatch (worker.proto Submit analog)
+                ("POST", "/mailbox"): lambda h, b: (
+                    200, node.handle_mailbox(b)),
+                ("POST", "/stage"): lambda h, b: (
+                    200, node.handle_stage(b)),
             }
         return Handler
 

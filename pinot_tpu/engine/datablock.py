@@ -200,6 +200,65 @@ def encode_partial(p: Any) -> bytes:
     return bytes(buf)
 
 
+_REL_MAGIC = b"PREL"
+_REL_MAGIC_Z = b"PRLZ"
+
+
+def encode_relation(rel) -> bytes:
+    """Columnar binary for a multistage Relation block (the RowDataBlock/
+    ColumnarDataBlock wire form of mailbox.proto MailboxContent). Same
+    column layouts as partials; null masks ship bit-packed."""
+    buf = bytearray(_REL_MAGIC)
+    names = list(rel.data.keys())
+    null_cols = [n for n in names if n in rel.nulls]
+    _pack_json(buf, {"name": rel.name, "columns": names,
+                     "nullColumns": null_cols, "rows": rel.n_rows})
+    for n in names:
+        _encode_column(buf, np.asarray(rel.data[n]).tolist())
+    for n in null_cols:
+        raw = np.packbits(np.asarray(rel.nulls[n], dtype=bool)).tobytes()
+        buf += struct.pack("<I", len(raw))
+        buf += raw
+    if len(buf) >= _COMPRESS_MIN:
+        z = zlib.compress(bytes(buf[4:]), 3)
+        if len(z) + 8 < len(buf):
+            return _REL_MAGIC_Z + struct.pack("<I", len(buf) - 4) + z
+    return bytes(buf)
+
+
+def decode_relation(data: bytes):
+    from ..multistage.relation import Relation
+
+    magic = bytes(data[:4])
+    if magic == _REL_MAGIC_Z:
+        (raw_len,) = struct.unpack_from("<I", data, 4)
+        body = zlib.decompress(data[8:], bufsize=raw_len)
+    elif magic == _REL_MAGIC:
+        body = bytes(data[4:])
+    else:
+        raise ValueError(f"bad relation magic {magic!r}")
+    mv = memoryview(body)
+    header, off = _unpack_json(mv, 0)
+    n = header["rows"]
+    cols = {}
+    for name in header["columns"]:
+        cells, off = _decode_column(mv, off)
+        arr = np.asarray(cells)
+        if arr.dtype.kind in "USO":  # strings/mixed stay object cells
+            a2 = np.empty(n, dtype=object)
+            a2[:] = cells
+            arr = a2
+        cols[name] = arr
+    nulls = {}
+    for name in header["nullColumns"]:
+        (ln,) = struct.unpack_from("<I", mv, off)
+        off += 4
+        bits = np.frombuffer(mv, dtype=np.uint8, count=ln, offset=off)
+        off += ln
+        nulls[name] = np.unpackbits(bits)[:n].astype(bool)
+    return Relation(cols, nulls, header.get("name"))
+
+
 _FRAME_MAGIC = b"PWR1"
 
 

@@ -1,0 +1,213 @@
+"""Networked multi-stage dispatch: stages spanning server processes.
+
+Reference parity: the broker->server stage submission of worker.proto:26
+(QueryDispatcher.submitAndReduce -> QueryRunner.processQuery) and the
+gRPC mailbox data plane of mailbox.proto:25 (GrpcSendingMailbox ->
+ReceivingMailbox), collapsed to the cluster's HTTP planes:
+
+- POST /stage     submits one worker's stage of a query plan; leaf
+  stages scan locally and hash/broadcast-exchange blocks to the next
+  stage's workers, join stages block on their receiving mailboxes and
+  return the joined relation as the (binary) response;
+- POST /mailbox   delivers one binary Relation block (or EOS) into the
+  receiving MailboxService of the worker process — the
+  GrpcSendingMailbox.offer analog.
+
+`distributed_join` is the broker-side driver: it assigns the join
+stage's workers, submits every stage, and concatenates the join
+partitions — HashExchange partitioning guarantees rows with equal keys
+meet at the same worker, so the concatenation IS the join result.
+"""
+from __future__ import annotations
+
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..engine.datablock import (_pack_json, _unpack_json, decode_relation,
+                                encode_relation)
+from .exchange import EOS, MailboxService, hash_partition_codes
+from .join import hash_join
+from .relation import Relation
+
+
+# ---------------------------------------------------------------------------
+# mailbox wire frames
+# ---------------------------------------------------------------------------
+
+def encode_mailbox_frame(query_id: str, stage: int, worker: int,
+                         rel: Optional[Relation]) -> bytes:
+    buf = bytearray()
+    _pack_json(buf, {"queryId": query_id, "stage": stage, "worker": worker,
+                     "eos": rel is None})
+    if rel is not None:
+        buf += encode_relation(rel)
+    return bytes(buf)
+
+
+def deliver_mailbox_frame(service: MailboxService, data: bytes) -> None:
+    mv = memoryview(data)
+    header, off = _unpack_json(mv, 0)
+    box = service.mailbox(header["queryId"], header["stage"],
+                          header["worker"])
+    if header.get("eos"):
+        box.offer(EOS)
+    else:
+        box.offer(decode_relation(bytes(mv[off:])))
+
+
+def _send_block(url: str, query_id: str, stage: int, worker: int,
+                rel: Optional[Relation], timeout: float = 30.0) -> None:
+    from ..cluster.http_util import http_raw
+    http_raw("POST", f"{url}/mailbox",
+             encode_mailbox_frame(query_id, stage, worker, rel),
+             timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# stage execution (worker side; ServerNode routes POST /stage here)
+# ---------------------------------------------------------------------------
+
+def _concat(blocks: List[Relation]) -> Relation:
+    assert blocks, "exchange must deliver schema blocks even when empty"
+    return Relation.concat(blocks)
+
+
+def _leaf_relation(node, spec: Dict[str, Any]) -> Relation:
+    """Run the stage's local scan and qualify columns with the alias
+    (LeafStageTransferableBlockOperator analog: the v1 engine's selection
+    rows become a transferable columnar block)."""
+    resp = node.execute(spec["sql"])
+    partials = resp.get("partials_raw", [])
+    labels: List[str] = []
+    rows: List[tuple] = []
+    for p in partials:
+        if getattr(p, "labels", None):
+            labels = p.labels
+        rows.extend(getattr(p, "rows", []))
+    alias = spec.get("alias") or spec.get("table", "t")
+    data: Dict[str, np.ndarray] = {}
+    for ci, label in enumerate(labels):
+        cells = [r[ci] for r in rows]
+        arr = np.asarray(cells)
+        if arr.dtype.kind in "USO":
+            a2 = np.empty(len(cells), dtype=object)
+            a2[:] = cells
+            arr = a2
+        data[f"{alias}.{label}"] = arr
+    if not data:
+        # empty scan (no partials / untabled server): the schema still
+        # ships, derived from the select list, so the join worker's
+        # concat and key lookup never see a schema-less block
+        from ..query.sql import Identifier, parse_sql
+        stmt = parse_sql(spec["sql"])
+        for ci, item in enumerate(stmt.select):
+            e = getattr(item, "expr", item)
+            label = getattr(item, "alias", None) or (
+                e.name if isinstance(e, Identifier) else f"col{ci}")
+            data[f"{alias}.{label}"] = np.asarray([])
+    return Relation(data, {}, alias)
+
+
+def execute_stage(node, spec: Dict[str, Any]):
+    """-> JSON dict (leaf summary) or bytes (root join's relation)."""
+    kind = spec["kind"]
+    query_id = spec["queryId"]
+    if kind == "leaf":
+        rel = _leaf_relation(node, spec)
+        ex = spec["exchange"]
+        targets = ex["targets"]  # [{url, worker}], stage = ex["stage"]
+        stage = ex["stage"]
+        if ex["type"] == "hash":
+            parts = hash_partition_codes(rel, ex["keys"], len(targets))
+            for w, t in enumerate(targets):
+                # empty partitions still ship (schema travels with blocks)
+                _send_block(t["url"], query_id, stage, t["worker"],
+                            rel.take(np.nonzero(parts == w)[0]))
+        else:  # broadcast
+            for t in targets:
+                _send_block(t["url"], query_id, stage, t["worker"], rel)
+        for t in targets:
+            _send_block(t["url"], query_id, stage, t["worker"], None)
+        return {"rows": rel.n_rows}
+    assert kind == "join", kind
+    worker = spec["worker"]
+    lbox = node.mailboxes.mailbox(query_id, spec["leftStage"], worker)
+    rbox = node.mailboxes.mailbox(query_id, spec["rightStage"], worker)
+    timeout = spec.get("timeoutSec", 60.0)
+    try:
+        left = _concat(lbox.drain(timeout, n_eos=spec["nLeftSenders"]))
+        right = _concat(rbox.drain(timeout, n_eos=spec["nRightSenders"]))
+    finally:
+        # per-worker cleanup, even on drain timeout (a dead leaf must not
+        # leak queued blocks); co-located workers keep their own boxes
+        node.mailboxes.release_one(query_id, spec["leftStage"], worker)
+        node.mailboxes.release_one(query_id, spec["rightStage"], worker)
+    out = hash_join(left, right, spec["leftKeys"], spec["rightKeys"],
+                    spec.get("how", "inner"))
+    return encode_relation(out)
+
+
+# ---------------------------------------------------------------------------
+# broker-side driver
+# ---------------------------------------------------------------------------
+
+def distributed_join(left_leaves: List[Dict[str, str]],
+                     right_leaves: List[Dict[str, str]],
+                     join_workers: List[str],
+                     left_keys: List[str], right_keys: List[str],
+                     how: str = "inner",
+                     timeout: float = 60.0) -> Relation:
+    """Run a hash join whose stages span server processes.
+
+    left_leaves/right_leaves: [{"url", "sql", "alias"}] — each runs as a
+    leaf stage on its server (where the table's segments live) and hash-
+    exchanges on the join keys; join_workers: server URLs, one join
+    partition each. Returns the concatenated join relation.
+    """
+    from ..cluster.http_util import http_json, http_raw
+
+    query_id = uuid.uuid4().hex[:12]
+    l_stage, r_stage = 1, 2
+
+    def targets(keys):
+        return {"type": "hash", "keys": keys, "stage": None,
+                "targets": [{"url": u, "worker": w}
+                            for w, u in enumerate(join_workers)]}
+
+    join_specs = [{
+        "kind": "join", "queryId": query_id, "worker": w,
+        "leftStage": l_stage, "rightStage": r_stage,
+        "leftKeys": left_keys, "rightKeys": right_keys, "how": how,
+        "nLeftSenders": len(left_leaves),
+        "nRightSenders": len(right_leaves),
+        "timeoutSec": timeout,
+    } for w in range(len(join_workers))]
+
+    def leaf_spec(leaf, stage, keys):
+        ex = targets(keys)
+        ex["stage"] = stage
+        return {"kind": "leaf", "queryId": query_id, "sql": leaf["sql"],
+                "alias": leaf.get("alias"), "exchange": ex}
+
+    with ThreadPoolExecutor(max_workers=len(join_specs)
+                            + len(left_leaves) + len(right_leaves)) as pool:
+        # join stages first: they block on their mailboxes
+        join_futs = [pool.submit(http_raw, "POST",
+                                 f"{join_workers[w]}/stage", spec,
+                                 timeout)
+                     for w, spec in enumerate(join_specs)]
+        leaf_futs = [pool.submit(http_json, "POST", f"{leaf['url']}/stage",
+                                 leaf_spec(leaf, l_stage, left_keys),
+                                 timeout)
+                     for leaf in left_leaves]
+        leaf_futs += [pool.submit(http_json, "POST", f"{leaf['url']}/stage",
+                                  leaf_spec(leaf, r_stage, right_keys),
+                                  timeout)
+                      for leaf in right_leaves]
+        for f in leaf_futs:
+            f.result()
+        parts = [decode_relation(f.result()) for f in join_futs]
+    return _concat(parts)

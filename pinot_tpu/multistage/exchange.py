@@ -33,13 +33,24 @@ class ReceivingMailbox:
     def poll(self, timeout: Optional[float] = None) -> Any:
         return self._q.get(timeout=timeout)
 
-    def drain(self, timeout: Optional[float] = 30.0) -> List[Relation]:
+    def drain(self, timeout: Optional[float] = 30.0,
+              n_eos: int = 1) -> List[Relation]:
+        """Collect blocks until n_eos end-of-stream markers arrive (one
+        per sender — networked exchanges fan many senders into one box).
+        timeout is a DEADLINE over the whole drain, not per block."""
+        import time
         out: List[Relation] = []
-        while True:
-            b = self.poll(timeout)
+        remaining = n_eos
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while remaining > 0:
+            left = None if deadline is None \
+                else max(deadline - time.monotonic(), 0.001)
+            b = self.poll(left)
             if b is EOS:
-                return out
-            out.append(b)
+                remaining -= 1
+            else:
+                out.append(b)
+        return out
 
 
 class MailboxService:
@@ -68,6 +79,12 @@ class MailboxService:
                         if m.startswith(query_id + "|")]:
                 del self._boxes[mid]
 
+    def release_one(self, query_id: str, stage: int, worker: int) -> None:
+        """Drop a single mailbox (per-worker cleanup: co-located workers
+        of one query must not reap each other's boxes)."""
+        with self._lock:
+            self._boxes.pop(self.mailbox_id(query_id, stage, worker), None)
+
 
 # ---------------------------------------------------------------------------
 # exchanges
@@ -91,7 +108,12 @@ def hash_partition_codes(rel: Relation, key_cols: List[str],
                 u = sv.view(np.uint32).reshape(len(sv), -1)
                 acc = np.zeros(len(sv), dtype=np.uint64)
                 for col in range(u.shape[1]):
-                    acc = acc * np.uint64(31) + u[:, col].astype(np.uint64)
+                    c = u[:, col].astype(np.uint64)
+                    # skip NUL padding: the hash must not depend on the
+                    # array's max string width, or the two sides of a
+                    # networked join (separately built relations) route
+                    # equal keys to different workers
+                    acc = np.where(c != 0, acc * np.uint64(31) + c, acc)
                 codes = acc.view(np.int64)
         else:
             codes = v.astype(np.int64, copy=False)
