@@ -19,6 +19,7 @@ import numpy as np
 from ..ops import aggregations
 from ..ops.kernels import jitted_kernel
 from ..query.context import QueryContext
+from ..query.sql import Star
 from ..query.planner import AggBinding, CompiledPlan, SegmentPlanner
 from ..segment.immutable import ImmutableSegment
 from . import host_eval
@@ -91,9 +92,52 @@ def execute_plan(plan: CompiledPlan):
             return AggPartial(host_eval.host_aggregate(ctx, seg, mask))
         labels, rows, okeys = host_eval.host_selection(ctx, seg, mask)
         return SelectionPartial(labels, rows, okeys)
+    if plan.kind == "kselect":
+        return extract_select(plan, run_select_kernel(plan))
     assert plan.kind == "kernel"
     out = run_kernel(plan)
     return extract_partial(plan, out)
+
+
+def run_select_kernel(plan: CompiledPlan) -> Dict[str, np.ndarray]:
+    from ..ops.kernels import jitted_select_kernel
+    seg = plan.segment
+    cols = seg.device_cols(plan.col_names)
+    params = resolve_params(plan)
+    fn = jitted_select_kernel(plan.select_plan, seg.bucket)
+    host = jax.device_get(fn(cols, np.int32(seg.n_docs), params))
+    from .accounting import global_accountant
+    global_accountant.track_memory(
+        sum(np.asarray(v).nbytes for v in host.values()))
+    return host
+
+
+def extract_select(plan: CompiledPlan, out: Dict[str, np.ndarray]
+                   ) -> "SelectionPartial":
+    """Device top-k winners -> SelectionPartial (values resolved through
+    the segment dictionaries; order keys resolved the same way so the
+    broker's cross-segment merge compares values, not ids)."""
+    seg, sp = plan.segment, plan.select_plan
+    n = min(int(out["matched"]), sp.k)
+    cols_vals: List[np.ndarray] = []
+    for i, name in enumerate(plan.select_names):
+        stored = np.asarray(out[f"sel_{i}"])[:n]
+        d = seg.dictionary(name)
+        cols_vals.append(d.values_for(stored) if d is not None else stored)
+    rows = [tuple(_py(c[r]) for c in cols_vals) for r in range(n)]
+    okeys_cols: List[np.ndarray] = []
+    for j, (col, _d, card) in enumerate(sp.order):
+        stored = np.asarray(out[f"ord_{j}"])[:n]
+        name = plan.col_names[col]
+        d = seg.dictionary(name)
+        okeys_cols.append(d.values_for(stored) if d is not None else stored)
+    okeys = [tuple(_py(c[r]) for c in okeys_cols) for r in range(n)]
+    ctx = plan.ctx
+    if any(isinstance(i, Star) for i in ctx.select_items):
+        labels = list(plan.select_names)
+    else:
+        labels = list(ctx.labels)
+    return SelectionPartial(labels, rows, okeys)
 
 
 def resolve_params(plan: CompiledPlan, sharding=None) -> Tuple[jax.Array, ...]:
@@ -168,9 +212,10 @@ def extract_partial(plan: CompiledPlan, out: Dict[str, np.ndarray]):
     ctx, seg = plan.ctx, plan.segment
     matched = int(out["matched"])
     if not ctx.is_group_by:
+        na = host_eval.null_aware(ctx)
         states: List[Any] = []
         for b in plan.agg_bindings:
-            states.append(_scalar_state(b, out, matched, seg))
+            states.append(_scalar_state(b, out, matched, seg, na))
         return AggPartial(states)
 
     gi = out.get("group_idx")
@@ -204,16 +249,22 @@ def extract_partial(plan: CompiledPlan, out: Dict[str, np.ndarray]):
 
 
 def _scalar_state(b: AggBinding, out: Dict[str, np.ndarray], matched: int,
-                  seg: ImmutableSegment) -> Any:
+                  seg: ImmutableSegment, na: bool = False) -> Any:
     name = f"agg{b.index}_{_kind(b)}"
     k = _kind(b)
+    # null-aware plans emit the aggregation's own non-null row count
+    # (AggSpec.null_param); all-null input finalizes SUM/MIN/MAX to null
+    nnz = out.get(name + "_nnz")
+    eff = int(nnz) if nnz is not None else matched
     if k == "count":
         return int(out[name])
     if k == "sum":
+        if na and eff == 0:
+            return None
         v = out[name]
         return int(v) if b.integral else float(v)
     if k in ("min", "max"):
-        if matched == 0:
+        if eff == 0:
             return None
         v = out[name]
         return int(v) if b.integral else float(v)

@@ -190,11 +190,34 @@ class AggSpec:
     bits: int = 63
     # False when the planner proved the value non-negative (halves the limbs)
     signed: bool = True
+    # enableNullHandling: params[null_param] is the input column's null
+    # mask — the aggregation skips those rows and reports the non-null
+    # count so SUM/MIN/MAX over all-null inputs finalize to null
+    # (NullableSingleInputAggregationFunction semantics)
+    null_param: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
 # The kernel plan
 # ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SelectPlan:
+    """Device selection/order-by: filter mask -> composite int64 order key
+    -> jax.lax.top_k -> gather the selected columns at the winners.
+
+    Reference parity: operator/query/LinearSelectionOrderByOperator.java
+    (per-segment top offset+limit rows under the order, merged at broker
+    reduce). order entries are (col, desc, card): dict columns compose by
+    id (sorted dictionaries make id order == value order), card=0 marks a
+    raw integral column; the planner guarantees the composite fits 63
+    bits. k = offset + limit. Empty order = doc order (selection-only
+    early-exit analog)."""
+    pred: Pred
+    select_cols: Tuple[int, ...]
+    order: Tuple[Tuple[int, bool, int], ...]
+    k: int
+
 
 @dataclass(frozen=True)
 class KernelPlan:

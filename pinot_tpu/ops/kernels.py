@@ -40,7 +40,7 @@ import jax.numpy as jnp
 
 from .ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange,
                  InBitmap, InSet, KernelPlan, Lit, MaskParam, MvReduce, Not,
-                 Or, Pred, TrueP, ValueExpr)
+                 Or, Pred, SelectPlan, TrueP, ValueExpr)
 
 # IN lists longer than this use sorted-membership (raw values) or a
 # presence-table gather (dict ids) instead of broadcast compare
@@ -285,6 +285,12 @@ def _scalar_agg(i: int, spec: AggSpec, mask, cols, params,
                 out: Dict[str, jax.Array]) -> None:
     name = _agg_name(i, spec)
     cnt_dtype = int_acc_dtype()
+    if spec.null_param is not None:
+        # enableNullHandling: this aggregation skips null-input rows and
+        # reports its own non-null count (extract finalizes all-null
+        # SUM/MIN/MAX to null from it)
+        mask = mask & ~params[spec.null_param]
+        out[name + "_nnz"] = jnp.sum(mask, dtype=cnt_dtype)
     if spec.kind == "count":
         out[name] = jnp.sum(mask, dtype=cnt_dtype)
         return
@@ -885,6 +891,54 @@ def _pred_col_indices(p) -> set:
     if isinstance(p, Not):
         return _pred_col_indices(p.child)
     return set()
+
+
+def build_select_kernel(plan: SelectPlan, bucket: int):
+    """fn(cols, n_docs, params) -> {"sel_<i>": (k,) stored values,
+    "ord_<j>": (k,) order-key ids/values, "matched": scalar}.
+
+    The composite order key packs the (col, desc, card) entries most-
+    significant-first into one int64; lax.top_k picks the winners in one
+    fused pass (LinearSelectionOrderByOperator's heap, TPU-shaped).
+    Rows beyond min(matched, k) are garbage — extract slices by matched.
+    """
+    def kernel(cols: Tuple[jax.Array, ...], n_docs: jax.Array,
+               params: Tuple[jax.Array, ...]) -> Dict[str, jax.Array]:
+        mask = (jnp.arange(bucket, dtype=jnp.int32) < n_docs) \
+            & _eval_pred(plan.pred, cols, params, bucket)
+        if plan.order:
+            key = jnp.zeros(bucket, dtype=jnp.int64)
+            for col, desc, card in plan.order:
+                v = cols[col].astype(jnp.int64)
+                if card:  # dict ids: sorted dictionary => id order
+                    if desc:
+                        v = jnp.int64(card - 1) - v
+                    key = key * jnp.int64(card) + v
+                else:     # raw integral key — the planner only emits it
+                    # alone (card-free values can't pack into a radix)
+                    key = -v if desc else v
+            # ascending composite wins smallest; top_k wants max -> negate
+            sort_key = jnp.where(mask, -key, jnp.iinfo(jnp.int64).min)
+        else:
+            # doc order: earliest rows win
+            iota = jnp.arange(bucket, dtype=jnp.int64)
+            sort_key = jnp.where(mask, -iota, jnp.iinfo(jnp.int64).min)
+        _, idx = jax.lax.top_k(sort_key, plan.k)
+        out: Dict[str, jax.Array] = {
+            "matched": jnp.sum(mask, dtype=int_acc_dtype()),
+        }
+        for i, col in enumerate(plan.select_cols):
+            out[f"sel_{i}"] = jnp.take(cols[col], idx, axis=0)
+        for j, (col, _d, _c) in enumerate(plan.order):
+            out[f"ord_{j}"] = jnp.take(cols[col], idx)
+        return out
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=512)
+def jitted_select_kernel(plan: SelectPlan, bucket: int):
+    return jax.jit(build_select_kernel(plan, bucket))
 
 
 def _dict_value_cols(plan: KernelPlan) -> Dict[int, int]:

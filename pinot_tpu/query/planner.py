@@ -75,6 +75,9 @@ class CompiledPlan:
     group_cols: List[str] = field(default_factory=list)   # group key columns
     # fast path: precomputed states per agg
     fast_states: Optional[List[Any]] = None
+    # kselect path (device selection/order-by)
+    select_plan: Optional[Any] = None
+    select_names: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -187,6 +190,7 @@ class SegmentPlanner:
         self.ctx = ctx
         self.seg = segment
         self.b = _Binder(segment)
+        self.null_aware = _truthy(ctx.options.get("enableNullHandling"))
 
     # -- value expressions -------------------------------------------------
     def resolve_value(self, e: Any) -> Tuple[ValueExpr, bool]:
@@ -225,7 +229,54 @@ class SegmentPlanner:
     def resolve_filter(self, e: Any) -> Pred:
         if e is None:
             return TrueP()
+        if self.null_aware and self._nullable_refs(e):
+            # enableNullHandling: a row passes only when the predicate is
+            # TRUE under three-valued logic. The T/F pair propagates
+            # through the tree as ordinary 2VL predicates (host peer:
+            # engine/host_eval.eval_filter_3vl), so the kernel stays
+            # mask-in mask-out
+            t, _f = self._pred_3vl(e)
+            return _simplify(t)
         return _simplify(self._pred(e))
+
+    def _nullable_refs(self, e: Any) -> List[str]:
+        refs: set = set()
+        collect_identifiers(e, refs)
+        return [r for r in sorted(refs)
+                if getattr(self.seg.columns.get(r), "has_nulls", False)]
+
+    def _null_any_pred(self, e: Any) -> Optional[Pred]:
+        """Pred true where ANY input column of e is null (SQL null
+        propagation: one null input makes the comparison UNKNOWN)."""
+        parts = [MaskParamP(self.b.add_param(("nullmask", r)))
+                 for r in self._nullable_refs(e)]
+        if not parts:
+            return None
+        return parts[0] if len(parts) == 1 else Or(tuple(parts))
+
+    def _pred_3vl(self, e: Any) -> Tuple[Pred, Pred]:
+        """-> (T, F) preds under Kleene logic (rows not in T and not in F
+        are UNKNOWN — filtered out, since only TRUE passes)."""
+        if isinstance(e, BoolAnd):
+            ts, fs = zip(*(self._pred_3vl(c) for c in e.children))
+            return And(ts), Or(fs)
+        if isinstance(e, BoolOr):
+            ts, fs = zip(*(self._pred_3vl(c) for c in e.children))
+            return Or(ts), And(fs)
+        if isinstance(e, BoolNot):
+            t, f = self._pred_3vl(e.child)
+            return f, t
+        if isinstance(e, IsNull):
+            t = self._pred(e)  # IS [NOT] NULL never yields UNKNOWN
+            return t, Not(t)
+        # leaf predicate: 2VL result, demoted to UNKNOWN on null inputs
+        # (negated leaves included — host_eval.eval_filter_3vl contract)
+        p = self._pred(e)
+        nm = self._null_any_pred(e)
+        if nm is None:
+            return p, Not(p)
+        valid = Not(nm)
+        return _simplify(And((p, valid))), _simplify(And((Not(p), valid)))
 
     def _pred(self, e: Any) -> Pred:
         if isinstance(e, BoolAnd):
@@ -561,26 +612,132 @@ class SegmentPlanner:
         if agg.kind == "distinct_count":
             if isinstance(agg.arg, Identifier):
                 m = self.seg.columns.get(agg.arg.name)
-                if m is not None and m.has_dict:
+                if m is not None and m.has_dict \
+                        and getattr(m, "single_value", True):
                     idx = self.b.bind_col(agg.arg.name)
                     spec = AggSpec("distinct_count", Col(idx), True,
-                                   card=m.cardinality)
+                                   card=m.cardinality,
+                                   null_param=self._agg_null_param(agg))
                     return spec, AggBinding(agg, i, True,
                                             dict_col=agg.arg.name)
             raise PlanError("DISTINCTCOUNT needs a dictionary column "
                             "(host fallback handles the rest)")
         if agg.kind == "count":  # COUNT(col): Pinot counts all rows when
             # null handling is disabled (NullableSingleInputAggregationFunction)
-            return AggSpec("count", None, True), AggBinding(agg, i, True)
+            # — and skips null inputs when it is enabled
+            return (AggSpec("count", None, True,
+                            null_param=self._agg_null_param(agg)),
+                    AggBinding(agg, i, True))
         if agg.kind in ("sum_mv", "count_mv", "min_mv", "max_mv"):
+            if self.null_aware and isinstance(agg.arg, Identifier) and \
+                    getattr(self.seg.columns.get(agg.arg.name),
+                            "has_nulls", False):
+                raise PlanError("null-aware MV aggregation (host fallback)")
             return self._resolve_mv_agg(i, agg)
         if agg.kind not in ("sum", "min", "max", "avg"):
             raise PlanError(f"no device lowering for {agg.kind} "
                             "(host fallback)")
         ve, integral = self.resolve_value(agg.arg)
         bits, signed = self._bits_for(self._range_of(agg.arg))
-        return (AggSpec(agg.kind, ve, integral, bits=bits, signed=signed),
+        return (AggSpec(agg.kind, ve, integral, bits=bits, signed=signed,
+                        null_param=self._agg_null_param(agg)),
                 AggBinding(agg, i, integral))
+
+    def _agg_null_param(self, agg: AggExpr) -> Optional[int]:
+        """Null-mask param for a null-aware aggregation's input (skip-null
+        semantics, NullableSingleInputAggregationFunction). Host fallback
+        for shapes the kernel can't mask per-agg: multi-column nullable
+        inputs and group-by plans (the group machinery applies one shared
+        mask)."""
+        if not self.null_aware:
+            return None
+        refs: set = set()
+        for arg in (agg.arg, agg.arg2):
+            if arg is not None:
+                collect_identifiers(arg, refs)
+        nullable = [r for r in sorted(refs)
+                    if getattr(self.seg.columns.get(r), "has_nulls", False)]
+        if not nullable:
+            return None
+        if len(nullable) > 1 or self.ctx.is_group_by:
+            raise PlanError("null-aware aggregation shape needs the host "
+                            "path")
+        return self.b.add_param(("nullmask", nullable[0]))
+
+    SELECT_K_CAP = 1 << 14
+
+    def _plan_selection(self) -> Optional[CompiledPlan]:
+        """Device selection: SELECT cols [WHERE ...] [ORDER BY cols]
+        LIMIT k -> filter mask + composite order key + lax.top_k + gather
+        (ops/kernels.build_select_kernel). Returns None when the shape
+        needs the host path (expressions, MV/null cells, non-integral raw
+        order keys, unbounded limit)."""
+        from ..ops.ir import SelectPlan
+        ctx, seg = self.ctx, self.seg
+        if ctx.limit is None:
+            return None
+        # a segment contributes at most bucket rows; lax.top_k also
+        # requires k <= operand length
+        k = min(ctx.offset + ctx.limit, seg.bucket)
+        if not 0 < ctx.offset + ctx.limit <= self.SELECT_K_CAP:
+            return None
+
+        names: List[str] = []
+        for item in ctx.select_items:
+            if isinstance(item, Star):
+                names.extend(seg.columns)
+            elif isinstance(item, Identifier):
+                names.append(item.name)
+            else:
+                return None
+        nh = self.null_aware
+
+        def col_ok(name: str) -> bool:
+            m = seg.columns.get(name)
+            return (m is not None and getattr(m, "single_value", True)
+                    and not (nh and getattr(m, "has_nulls", False)))
+
+        if not all(col_ok(n) for n in names):
+            return None
+
+        order: List[Tuple[str, bool, int]] = []
+        span = 1
+        for o in ctx.order_by:
+            if not isinstance(o.expr, Identifier) or not col_ok(o.expr.name):
+                return None
+            m = seg.columns[o.expr.name]
+            if m.has_dict:
+                card = max(m.cardinality, 1)
+                span *= card
+                order.append((o.expr.name, not o.ascending, card))
+            else:
+                # raw keys can't radix-pack: only a single integral one,
+                # with bounds well inside int64 so negation can't wrap
+                # into (or past) the unmatched-row sentinel
+                if len(ctx.order_by) != 1 or not m.data_type.is_numeric \
+                        or m.data_type.np_dtype.kind not in "iu" \
+                        or m.min is None or m.max is None \
+                        or max(abs(int(m.min)), abs(int(m.max))) >= 1 << 61:
+                    return None
+                order.append((o.expr.name, not o.ascending, 0))
+        if span >= 1 << 62:
+            return None
+
+        pred = self.resolve_filter(ctx.filter)  # PlanError -> host (caller)
+        if isinstance(pred, FalseP):
+            return CompiledPlan("pruned", seg, ctx)
+        if getattr(seg, "valid_docs", None) is not None and \
+                not _truthy(ctx.options.get("skipUpsert")):
+            pred = _simplify(And((pred, MaskParamP(
+                self.b.add_param(("validdocs", None))))))
+
+        sel_idx = tuple(self.b.bind_col(n) for n in names)
+        order_idx = tuple((self.b.bind_col(n), d, c) for n, d, c in order)
+        sp = SelectPlan(pred=pred, select_cols=sel_idx, order=order_idx,
+                        k=k)
+        return CompiledPlan("kselect", seg, ctx, col_names=self.b.cols,
+                            params=self.b.params, select_plan=sp,
+                            select_names=names)
 
     def _resolve_mv_agg(self, i: int, agg: AggExpr
                         ) -> Tuple[AggSpec, AggBinding]:
@@ -659,18 +816,12 @@ class SegmentPlanner:
     def plan(self) -> CompiledPlan:
         ctx, seg = self.ctx, self.seg
         self._validate_columns()
-        if _truthy(ctx.options.get("enableNullHandling")):
-            # null-aware execution: segments whose referenced columns hold
-            # nulls run the host path (3VL predicates, per-agg null skip);
-            # null-free segments keep the device kernels — the common case
-            # since null bitmaps are per-segment-per-column
+        if self.null_aware:
+            # null-aware execution stays on the device: 3VL filters via
+            # resolve_filter's T-tree, per-agg null skip via
+            # AggSpec.null_param. Null group KEYS form their own group —
+            # a representation the dense cartesian id key lacks -> host
             refs: set = set()
-            if ctx.filter is not None:
-                collect_identifiers(ctx.filter, refs)
-            for a in ctx.aggregations:
-                for arg in (a.arg, a.arg2):
-                    if arg is not None:
-                        collect_identifiers(arg, refs)
             for g in ctx.group_by:
                 collect_identifiers(g, refs)
             if any(getattr(seg.columns.get(r), "has_nulls", False)
@@ -681,7 +832,13 @@ class SegmentPlanner:
             # realtime read path analog; rows become device-resident on seal)
             return CompiledPlan("host", seg, ctx)
         if not ctx.is_aggregation:
-            return CompiledPlan("host", seg, ctx)  # selection: host path
+            try:
+                ksel = self._plan_selection()
+            except PlanError:
+                ksel = None
+            if ksel is not None:
+                return ksel
+            return CompiledPlan("host", seg, ctx)  # general selection: host
 
         try:
             pred = self.resolve_filter(ctx.filter)

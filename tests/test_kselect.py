@@ -1,0 +1,158 @@
+"""Device selection/order-by (kselect plans): filter -> composite order
+key -> lax.top_k -> gather, oracle-checked (round-3 item 5b).
+
+Reference parity: LinearSelectionOrderByOperator (per-segment top
+offset+limit under the order, merged at reduce) and the selection-only
+early-exit operator.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.query.context import build_query_context
+from pinot_tpu.query.planner import SegmentPlanner
+from pinot_tpu.query.sql import parse_sql
+from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+N = 5000
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    data = {
+        "city": rng.choice(["nyc", "sf", "austin", "la"], N),
+        "year": rng.integers(2018, 2024, N).astype(np.int32),
+        "salary": rng.integers(1000, 100000, N).astype(np.int64),
+    }
+    schema = Schema("t", [
+        FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("year", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("salary", DataType.LONG, FieldType.METRIC),
+    ])
+    out = tmp_path_factory.mktemp("ksel")
+    d = SegmentBuilder(schema, TableConfig("t")).build(data, str(out),
+                                                       "seg_0")
+    seg = ImmutableSegment.load(d)
+    dm = TableDataManager("t")
+    dm.add_segment(seg)
+    b = Broker()
+    b.register_table(dm)
+    return seg, b, data
+
+
+def _plan(seg, sql):
+    return SegmentPlanner(build_query_context(parse_sql(sql)), seg).plan()
+
+
+def test_order_by_raw_desc_limit(setup):
+    seg, b, data = setup
+    sql = ("SELECT city, year, salary FROM t WHERE year >= 2020 "
+           "ORDER BY salary DESC LIMIT 5")
+    assert _plan(seg, sql).kind == "kselect"
+    res = b.query(sql)
+    m = data["year"] >= 2020
+    order = np.argsort(-data["salary"][m], kind="stable")[:5]
+    exp = [(data["city"][m][i], int(data["year"][m][i]),
+            int(data["salary"][m][i])) for i in order]
+    assert [tuple(r) for r in res.rows] == exp
+
+
+def test_order_by_multi_dict_keys(setup):
+    seg, b, data = setup
+    sql = "SELECT city, year FROM t ORDER BY city, year DESC LIMIT 4"
+    assert _plan(seg, sql).kind == "kselect"
+    res = b.query(sql)
+    exp = sorted(zip(data["city"].tolist(), data["year"].tolist()),
+                 key=lambda t: (t[0], -t[1]))[:4]
+    assert [(r[0], r[1]) for r in res.rows] == \
+        [(c, int(y)) for c, y in exp]
+
+
+def test_order_by_asc_with_offset(setup):
+    seg, b, data = setup
+    sql = "SELECT salary FROM t ORDER BY salary LIMIT 3 OFFSET 7"
+    assert _plan(seg, sql).kind == "kselect"
+    res = b.query(sql)
+    exp = sorted(data["salary"].tolist())[7:10]
+    assert [r[0] for r in res.rows] == exp
+
+
+def test_selection_no_order_doc_order(setup):
+    seg, b, data = setup
+    sql = "SELECT city, salary FROM t LIMIT 6"
+    assert _plan(seg, sql).kind == "kselect"
+    res = b.query(sql)
+    exp = [(data["city"][i], int(data["salary"][i])) for i in range(6)]
+    assert [tuple(r) for r in res.rows] == exp
+
+
+def test_star_selection(setup):
+    seg, b, data = setup
+    sql = "SELECT * FROM t ORDER BY salary LIMIT 2"
+    assert _plan(seg, sql).kind == "kselect"
+    res = b.query(sql)
+    order = np.argsort(data["salary"], kind="stable")[:2]
+    exp = [(data["city"][i], int(data["year"][i]), int(data["salary"][i]))
+           for i in order]
+    assert [tuple(r) for r in res.rows] == exp
+    assert res.columns == ["city", "year", "salary"]
+
+
+def test_kselect_merges_across_segments(tmp_path):
+    rng = np.random.default_rng(9)
+    schema = Schema("m", [
+        FieldSpec("k", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC),
+    ])
+    dm = TableDataManager("m")
+    allv = []
+    for i in range(3):
+        v = rng.integers(0, 10_000, 400).astype(np.int64)
+        allv.append(v)
+        d = SegmentBuilder(schema, TableConfig("m")).build(
+            {"k": np.arange(400, dtype=np.int32), "v": v},
+            str(tmp_path), f"seg_{i}")
+        dm.add_segment_dir(d)
+    b = Broker()
+    b.register_table(dm)
+    res = b.query("SELECT v FROM m ORDER BY v DESC LIMIT 7")
+    exp = sorted(np.concatenate(allv).tolist(), reverse=True)[:7]
+    assert [r[0] for r in res.rows] == exp
+
+
+def test_expression_select_falls_back_to_host(setup):
+    seg, _, _ = setup
+    plan = _plan(seg, "SELECT salary * 2 FROM t ORDER BY salary LIMIT 3")
+    assert plan.kind == "host"
+
+
+def test_limit_beyond_segment_size(setup):
+    """k = offset+limit past the bucket clamps to the segment (the old
+    host path answered these; top_k must not see k > operand length)."""
+    seg, b, data = setup
+    sql = f"SELECT salary FROM t ORDER BY salary LIMIT {N + 3000}"
+    assert _plan(seg, sql).kind == "kselect"
+    res = b.query(sql)
+    assert [r[0] for r in res.rows] == sorted(data["salary"].tolist())
+
+
+def test_raw_key_with_extreme_values_falls_back(tmp_path):
+    """Raw order keys near int64 extremes can't negate safely: host."""
+    schema = Schema("x", [FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    d = SegmentBuilder(schema, TableConfig("x")).build(
+        {"v": np.asarray([np.iinfo(np.int64).min, 5, -3],
+                         dtype=np.int64)}, str(tmp_path), "seg_0")
+    seg = ImmutableSegment.load(d)
+    dm = TableDataManager("x")
+    dm.add_segment(seg)
+    b = Broker()
+    b.register_table(dm)
+    sql = "SELECT v FROM x ORDER BY v LIMIT 3"
+    assert _plan(seg, sql).kind == "host"
+    res = b.query(sql)
+    assert [r[0] for r in res.rows] == \
+        [np.iinfo(np.int64).min, -3, 5]
