@@ -201,6 +201,20 @@ class ServerNode:
         raw = resp.pop("partials_raw", [])
         return encode_wire_frame(resp, raw)
 
+    def handle_reload(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Reload a hosted table's segments against a (new) table config
+        (the reload segment/table REST operation + reload Helix message
+        analog: servers rebuild secondary indexes in place)."""
+        from ..spi.config import TableConfig
+        table = body["table"]
+        dm = self._tables.get(table)
+        if dm is None:
+            return {"reloaded": 0, "added": [], "removed": []}
+        cfg = TableConfig.from_dict(body["tableConfig"]) \
+            if body.get("tableConfig") else None
+        changes = dm.reload(cfg)
+        return {"reloaded": len(dm.acquire_segments()), **changes}
+
     def handle_mailbox(self, data: bytes) -> Dict[str, Any]:
         from ..multistage.dispatch import deliver_mailbox_frame
         deliver_mailbox_frame(self.mailboxes, data)
@@ -224,6 +238,8 @@ class ServerNode:
                 # dispatch (worker.proto Submit analog)
                 ("POST", "/mailbox"): lambda h, b: (
                     200, node.handle_mailbox(b)),
+                ("POST", "/reload"): lambda h, b: (
+                    200, node.handle_reload(b)),
                 ("POST", "/stage"): lambda h, b: (
                     200, node.handle_stage(b)),
             }

@@ -73,6 +73,8 @@ def execute_segment(ctx: QueryContext, segment: ImmutableSegment):
 def execute_plan(plan: CompiledPlan):
     ctx, seg = plan.ctx, plan.segment
     if plan.kind == "pruned":
+        if not ctx.is_aggregation and plan.select_names:
+            return SelectionPartial(list(plan.select_names), [])
         return empty_partial(ctx)
     if plan.kind == "fast":
         return AggPartial(list(plan.fast_states))

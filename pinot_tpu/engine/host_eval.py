@@ -23,10 +23,28 @@ from ..ops import aggregations
 from ..segment.immutable import ImmutableSegment
 
 
+def virtual_column(seg, name: str) -> Optional[np.ndarray]:
+    """$docId / $segmentName / $hostName (segment/virtualcolumn/
+    VirtualColumnProvider analog) — synthesized, never stored."""
+    if name == "$docId":
+        return np.arange(seg.n_docs, dtype=np.int64)
+    if name == "$segmentName":
+        return np.full(seg.n_docs, seg.name, dtype=object)
+    if name == "$hostName":
+        import socket
+        return np.full(seg.n_docs, socket.gethostname(), dtype=object)
+    return None
+
+
 def eval_value(e: Any, seg: ImmutableSegment,
                sel: Optional[np.ndarray] = None) -> np.ndarray:
     """Evaluate a value expression to a numpy array over (selected) docs."""
     if isinstance(e, Identifier):
+        if e.name.startswith("$"):
+            vc = virtual_column(seg, e.name)
+            if vc is None:
+                raise SqlError(f"unknown virtual column {e.name!r}")
+            return vc[sel] if sel is not None else vc
         vals = seg.raw_values(e.name)
         return vals[sel] if sel is not None else vals
     if isinstance(e, Literal):
@@ -117,6 +135,8 @@ def expr_null_mask(e: Any, seg) -> Optional[np.ndarray]:
     from ..query.sql import collect_identifiers
     m: Optional[np.ndarray] = None
     for name in collect_identifiers(e):
+        if name.startswith("$"):
+            continue  # virtual columns are never null
         nm = seg.null_mask(name)
         if nm is not None:
             m = nm.copy() if m is None else (m | nm)

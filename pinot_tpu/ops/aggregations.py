@@ -142,7 +142,11 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
     if kind is None:
         return None
     if kind == "count":
-        return ("count", None, None, ())
+        # COUNT(col) keeps its argument: with null handling disabled it
+        # counts every row anyway, but enableNullHandling skips the
+        # column's null rows (NullableSingleInputAggregationFunction)
+        _need(name, args, 1)
+        return ("count", args[0], None, ())
     if kind in ("covar_pop", "covar_samp"):
         _need(name, args, 2)
         return (kind, args[0], args[1], ())

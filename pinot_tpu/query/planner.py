@@ -725,7 +725,9 @@ class SegmentPlanner:
 
         pred = self.resolve_filter(ctx.filter)  # PlanError -> host (caller)
         if isinstance(pred, FalseP):
-            return CompiledPlan("pruned", seg, ctx)
+            # select_names preserves expanded star labels in the empty
+            # result (the host path expands them even for 0 rows)
+            return CompiledPlan("pruned", seg, ctx, select_names=names)
         if getattr(seg, "valid_docs", None) is not None and \
                 not _truthy(ctx.options.get("skipUpsert")):
             pred = _simplify(And((pred, MaskParamP(
@@ -807,8 +809,10 @@ class SegmentPlanner:
         for item in ctx.select_items:
             if not isinstance(item, (Star,)) and not hasattr(item, "kind"):
                 walk(item)
+        # virtual columns synthesize host-side (host_eval.virtual_column)
+        virtual = {"$docId", "$segmentName", "$hostName"}
         for n in names:
-            if n not in self.seg.columns:
+            if n not in self.seg.columns and n not in virtual:
                 raise PlanError(f"unknown column {n!r}; segment has "
                                 f"{list(self.seg.columns)}")
 
@@ -869,7 +873,10 @@ class SegmentPlanner:
                     break
                 m = seg.columns.get(g.name)
                 if m is None:
-                    raise PlanError(f"unknown column {g.name!r}")
+                    # virtual columns passed validation; they group on
+                    # the host path
+                    dense_ok = False
+                    break
                 if not m.has_dict or m.cardinality == 0 \
                         or not getattr(m, "single_value", True):
                     # MV group keys (row joins every value's group,
@@ -970,7 +977,13 @@ class SegmentPlanner:
         seg, ctx = self.seg, self.ctx
         states: List[Any] = []
         for agg in ctx.aggregations:
-            if agg.kind == "count" :
+            if agg.kind == "count":
+                if self.null_aware and agg.arg is not None and any(
+                        getattr(seg.columns.get(r), "has_nulls", False)
+                        for r in collect_identifiers(agg.arg)):
+                    # COUNT(col) skips nulls under enableNullHandling;
+                    # n_docs would overcount
+                    return None
                 states.append(seg.n_docs)
                 continue
             if agg.kind in ("min", "max") and isinstance(agg.arg, Identifier):

@@ -241,7 +241,7 @@ _TOKEN_RE = re.compile(r"""
       (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
     | (?P<string>'(?:[^']|'')*')
     | (?P<dqident>"(?:[^"]|"")*")
-    | (?P<ident>[A-Za-z_][A-Za-z_0-9$.]*)
+    | (?P<ident>[$A-Za-z_][A-Za-z_0-9$.]*)
     | (?P<op><=|>=|!=|<>|=|<|>|\(|\)|\[|\]|,|\*|\+|-|/|%|;)
     )""", re.VERBOSE)
 

@@ -108,9 +108,7 @@ class RealtimeTableDataManager(TableDataManager):
                 self._post_transformer = CompositeTransformer(
                     chain[fidx + 1:])
 
-        factory = stream_config.consumer_factory
-        if factory is None:
-            raise ValueError("StreamConfig.consumer_factory is required")
+        factory = stream_config.make_consumer_factory()
         n_parts = factory.num_partitions()
         if upsert_config is not None:
             from ..upsert import PartitionUpsertMetadataManager

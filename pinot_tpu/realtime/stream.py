@@ -28,6 +28,22 @@ class StreamConfig:
     flush_threshold_rows: int = 100_000
     flush_threshold_seconds: float = 3600.0
     consumer_factory: Optional["StreamConsumerFactory"] = None
+    # config-named factory (stream.<type>.consumer.factory.class.name
+    # analog): resolved via the plugin loader (spi/plugin.py) when no
+    # factory instance was injected; args pass to its constructor
+    consumer_factory_class: Optional[str] = None
+    consumer_factory_args: Dict[str, Any] = field(default_factory=dict)
+
+    def make_consumer_factory(self) -> "StreamConsumerFactory":
+        if self.consumer_factory is not None:
+            return self.consumer_factory
+        if self.consumer_factory_class is None:
+            raise ValueError("StreamConfig needs consumer_factory or "
+                             "consumer_factory_class")
+        from ..spi.plugin import create_instance
+        self.consumer_factory = create_instance(
+            self.consumer_factory_class, **self.consumer_factory_args)
+        return self.consumer_factory
 
 
 @dataclass

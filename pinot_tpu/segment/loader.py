@@ -1,0 +1,90 @@
+"""Segment reload: reconcile a built segment's indexes with the current
+table config, in place.
+
+Reference parity: pinot-segment-local/.../segment/index/loader/ (the
+IndexHandler family run by ImmutableSegmentLoader's preprocessing): when
+a TableConfig gains or loses index definitions, servers rebuild the
+affected index files on the already-built segment instead of re-ingesting
+— the reload path behind the controller's "reload table/segment" REST
+operations. The TPU-native segment keeps one metadata.json, so
+reconciliation is: build missing index files from the stored forward
+index + dictionary, delete stale ones, rewrite column metadata
+atomically.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..spi.config import TableConfig
+from .builder import METADATA_FILE
+from .immutable import ImmutableSegment
+
+
+def reconcile_indexes(seg_dir: str, table_config: TableConfig
+                      ) -> Dict[str, List[str]]:
+    """Align the segment's secondary indexes with table_config.
+
+    Returns {"added": ["col:kind", ...], "removed": [...]}. No-ops when
+    nothing changed. The forward index and dictionaries are never
+    touched — only secondary indexes reconcile (IndexHandler contract).
+    """
+    from .. import index as index_pkg
+
+    meta_path = os.path.join(seg_dir, METADATA_FILE)
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    seg = ImmutableSegment.load(seg_dir)
+
+    added: List[str] = []
+    removed: List[str] = []
+    idx_cfg = table_config.indexing
+    for name, cmeta in meta["columns"].items():
+        if cmeta.get("encoding") == "VECTOR":
+            continue  # vector storage IS the index; no reload semantics
+        have = set(cmeta.get("indexes", {}) or {})
+        want = set(idx_cfg.indexes_for(name))
+        if have == want:
+            continue
+        m = seg.columns[name]
+        for kind in sorted(have - want):
+            _remove_index_files(seg_dir, name, kind)
+            cmeta["indexes"].pop(kind, None)
+            removed.append(f"{name}:{kind}")
+        missing = sorted(want - have)
+        if missing:
+            if "inverted" in missing and not m.has_dict:
+                raise ValueError(f"inverted index needs a dictionary "
+                                 f"column: {name!r}")
+            values = seg.raw_values(name)
+            ids = np.asarray(seg.fwd(name)) if m.has_dict else None
+            built = index_pkg.build_indexes_for_column(
+                name, missing, seg_dir, values=values, ids=ids,
+                cardinality=m.cardinality)
+            cmeta.setdefault("indexes", {}).update(built)
+            added.extend(f"{name}:{k}" for k in missing)
+        if not cmeta.get("indexes"):
+            cmeta.pop("indexes", None)
+
+    if added or removed:
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(meta, fh, indent=1)
+        os.replace(tmp, meta_path)  # atomic: readers see old or new
+    return {"added": added, "removed": removed}
+
+
+# on-disk file stems per index kind (each kind's module owns its SUFFIX;
+# csr-backed kinds write <stem>.docs.bin/.off.bin sub-files)
+_KIND_STEMS = {"inverted": ".inv", "bloom": ".bloom", "range": ".rng",
+               "text": ".text", "json": ".json", "vector": ".vec"}
+
+
+def _remove_index_files(seg_dir: str, col: str, kind: str) -> None:
+    stem = col + _KIND_STEMS.get(kind, f".{kind}")
+    for fn in os.listdir(seg_dir):
+        if fn == stem or fn.startswith(stem + "."):
+            os.remove(os.path.join(seg_dir, fn))

@@ -57,6 +57,29 @@ class TableDataManager:
     def replace_segment(self, segment: ImmutableSegment) -> None:
         self.add_segment(segment)  # atomic swap by name
 
+    def reload(self, table_config=None) -> Dict[str, List[str]]:
+        """Reconcile every hosted segment's secondary indexes with the
+        table config and swap in freshly loaded segments (the reload REST
+        operation: segment/local loader/ IndexHandlers + reload message).
+        Returns the union of per-segment {'added', 'removed'} changes."""
+        from ..segment.loader import reconcile_indexes
+        cfg = table_config or self.table_config
+        if cfg is None:
+            raise ValueError("reload needs a TableConfig")
+        self.table_config = cfg
+        changes: Dict[str, List[str]] = {"added": [], "removed": []}
+        for seg in self.acquire_segments():
+            seg_dir = getattr(seg, "dir", None)
+            if seg_dir is None:
+                continue  # consuming segments have no on-disk indexes yet
+            delta = reconcile_indexes(seg_dir, cfg)
+            if delta["added"] or delta["removed"]:
+                seg.evict_device()
+                self.replace_segment(ImmutableSegment.load(seg_dir))
+                changes["added"].extend(delta["added"])
+                changes["removed"].extend(delta["removed"])
+        return changes
+
     def acquire_segments(self) -> List[ImmutableSegment]:
         return list(self._segments.values())
 
