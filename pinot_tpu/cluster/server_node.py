@@ -210,9 +210,17 @@ class ServerNode:
         dm = self._tables.get(table)
         if dm is None:
             return {"reloaded": 0, "added": [], "removed": []}
-        cfg = TableConfig.from_dict(body["tableConfig"]) \
-            if body.get("tableConfig") else None
-        changes = dm.reload(cfg)
+        cfg_dict = body.get("tableConfig")
+        if not cfg_dict:
+            # reload against the CURRENT config: the controller's routing
+            # snapshot is the config source of truth for cluster servers
+            snap = http_json("GET", f"{self.controller_url}/routing")
+            cfg_dict = (snap.get("tables", {}).get(table) or {}) \
+                .get("config")
+            if not cfg_dict:
+                raise ValueError(f"no table config for {table!r} at the "
+                                 "controller; pass tableConfig inline")
+        changes = dm.reload(TableConfig.from_dict(cfg_dict))
         return {"reloaded": len(dm.acquire_segments()), **changes}
 
     def handle_mailbox(self, data: bytes) -> Dict[str, Any]:

@@ -34,6 +34,24 @@ _READERS = {
 
 INDEX_KINDS = tuple(_BUILDERS)
 
+# on-disk file stems per kind, derived from each module's SUFFIX constants
+# (single source of truth: the module that writes the files). Removal on
+# reload deletes <col><stem> and <col><stem>.* (csr sub-files).
+_MODULES = {"inverted": inverted, "range": range_index, "bloom": bloom,
+            "text": text, "json": json_index, "vector": vector}
+FILE_STEMS: Dict[str, tuple] = {}
+for _kind, _mod in _MODULES.items():
+    _sufs = [getattr(_mod, a) for a in dir(_mod)
+             if a == "SUFFIX" or a.endswith("_SUFFIX")]
+    # trim trailing .bin etc. down to the shared stem prefix so sub-files
+    # (<stem>.docs.bin / <stem>.min.bin) match by prefix
+    _stems = set()
+    for s in _sufs:
+        parts = s.split(".")
+        _stems.add("." + parts[1])
+    FILE_STEMS[_kind] = tuple(sorted(_stems))
+del _kind, _mod, _sufs, _stems
+
 # filter functions answered by an index (TextMatchFilterOperator,
 # JsonMatchFilterOperator, VectorSimilarityFilterOperator analogs)
 _PREDICATE_FUNCS = ("text_match", "json_match", "vector_similarity")

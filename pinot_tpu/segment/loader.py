@@ -38,10 +38,11 @@ def reconcile_indexes(seg_dir: str, table_config: TableConfig
     with open(meta_path) as fh:
         meta = json.load(fh)
     seg = ImmutableSegment.load(seg_dir)
-
-    added: List[str] = []
-    removed: List[str] = []
     idx_cfg = table_config.indexing
+
+    # pass 1: plan + validate EVERYTHING before touching any file, so a
+    # config error can't strand metadata pointing at deleted indexes
+    plan: List[tuple] = []  # (name, cmeta, to_add, to_remove)
     for name, cmeta in meta["columns"].items():
         if cmeta.get("encoding") == "VECTOR":
             continue  # vector storage IS the index; no reload semantics
@@ -49,42 +50,49 @@ def reconcile_indexes(seg_dir: str, table_config: TableConfig
         want = set(idx_cfg.indexes_for(name))
         if have == want:
             continue
+        to_add = sorted(want - have)
+        if "inverted" in to_add and not seg.columns[name].has_dict:
+            raise ValueError(f"inverted index needs a dictionary "
+                             f"column: {name!r}")
+        plan.append((name, cmeta, to_add, sorted(have - want)))
+
+    # pass 2: build additions (new files; a crash here leaves unreferenced
+    # extras, never a dangling metadata entry)
+    added: List[str] = []
+    removed: List[str] = []
+    for name, cmeta, to_add, to_remove in plan:
         m = seg.columns[name]
-        for kind in sorted(have - want):
-            _remove_index_files(seg_dir, name, kind)
-            cmeta["indexes"].pop(kind, None)
-            removed.append(f"{name}:{kind}")
-        missing = sorted(want - have)
-        if missing:
-            if "inverted" in missing and not m.has_dict:
-                raise ValueError(f"inverted index needs a dictionary "
-                                 f"column: {name!r}")
-            values = seg.raw_values(name)
-            ids = np.asarray(seg.fwd(name)) if m.has_dict else None
+        if to_add:
             built = index_pkg.build_indexes_for_column(
-                name, missing, seg_dir, values=values, ids=ids,
+                name, to_add, seg_dir, values=seg.raw_values(name),
+                ids=np.asarray(seg.fwd(name)) if m.has_dict else None,
                 cardinality=m.cardinality)
             cmeta.setdefault("indexes", {}).update(built)
-            added.extend(f"{name}:{k}" for k in missing)
+            added.extend(f"{name}:{k}" for k in to_add)
+        for kind in to_remove:
+            cmeta["indexes"].pop(kind, None)
+            removed.append(f"{name}:{kind}")
         if not cmeta.get("indexes"):
             cmeta.pop("indexes", None)
 
-    if added or removed:
-        tmp = meta_path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(meta, fh, indent=1)
-        os.replace(tmp, meta_path)  # atomic: readers see old or new
+    if not (added or removed):
+        return {"added": [], "removed": []}
+
+    # pass 3: atomic metadata swap, THEN delete files nothing references
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(meta, fh, indent=1)
+    os.replace(tmp, meta_path)  # readers see old or new, never half
+    for name, _cmeta, _a, to_remove in plan:
+        for kind in to_remove:
+            _remove_index_files(seg_dir, name, kind)
     return {"added": added, "removed": removed}
 
 
-# on-disk file stems per index kind (each kind's module owns its SUFFIX;
-# csr-backed kinds write <stem>.docs.bin/.off.bin sub-files)
-_KIND_STEMS = {"inverted": ".inv", "bloom": ".bloom", "range": ".rng",
-               "text": ".text", "json": ".json", "vector": ".vec"}
-
-
 def _remove_index_files(seg_dir: str, col: str, kind: str) -> None:
-    stem = col + _KIND_STEMS.get(kind, f".{kind}")
-    for fn in os.listdir(seg_dir):
-        if fn == stem or fn.startswith(stem + "."):
-            os.remove(os.path.join(seg_dir, fn))
+    from ..index.registry import FILE_STEMS  # module-owned suffixes
+    for suffix in FILE_STEMS.get(kind, (f".{kind}",)):
+        stem = col + suffix
+        for fn in os.listdir(seg_dir):
+            if fn == stem or fn.startswith(stem + "."):
+                os.remove(os.path.join(seg_dir, fn))

@@ -21,6 +21,7 @@ class TableDataManager:
         self.table_config = table_config  # TableConfig | None
         self._segments: Dict[str, ImmutableSegment] = {}
         self._lock = threading.Lock()
+        self._reload_lock = threading.Lock()
         self._schema = None
         # optional mesh-resident DistributedTable (parallel/distributed.py);
         # the broker prefers it for kernel-plan aggregations
@@ -68,16 +69,27 @@ class TableDataManager:
             raise ValueError("reload needs a TableConfig")
         self.table_config = cfg
         changes: Dict[str, List[str]] = {"added": [], "removed": []}
-        for seg in self.acquire_segments():
-            seg_dir = getattr(seg, "dir", None)
-            if seg_dir is None:
-                continue  # consuming segments have no on-disk indexes yet
-            delta = reconcile_indexes(seg_dir, cfg)
-            if delta["added"] or delta["removed"]:
-                seg.evict_device()
-                self.replace_segment(ImmutableSegment.load(seg_dir))
-                changes["added"].extend(delta["added"])
-                changes["removed"].extend(delta["removed"])
+        with self._reload_lock:  # one reconcile per table at a time
+            for seg in self.acquire_segments():
+                seg_dir = getattr(seg, "dir", None)
+                if seg_dir is None:
+                    continue  # consuming segments: no on-disk indexes yet
+                # in-flight queries may hold the OLD segment object and
+                # lazily open index files on first use; warming its
+                # readers now means it never touches a file this reload
+                # is about to delete
+                for col, m in seg.columns.items():
+                    for kind in list(getattr(m, "indexes", {}) or {}):
+                        try:
+                            seg.index_reader(col, kind)
+                        except Exception:
+                            pass
+                delta = reconcile_indexes(seg_dir, cfg)
+                if delta["added"] or delta["removed"]:
+                    seg.evict_device()
+                    self.replace_segment(ImmutableSegment.load(seg_dir))
+                    changes["added"].extend(delta["added"])
+                    changes["removed"].extend(delta["removed"])
         return changes
 
     def acquire_segments(self) -> List[ImmutableSegment]:

@@ -159,3 +159,23 @@ def test_pruned_star_selection_keeps_labels(seg_dir):
     res = b.query("SELECT * FROM t WHERE city = 'zz' ORDER BY v LIMIT 3")
     assert res.rows == []
     assert res.columns == ["city", "v"]
+
+
+def test_reload_validation_failure_leaves_segment_intact(seg_dir):
+    """A config error (inverted on a raw column) must mutate nothing —
+    not even when the same reload would also remove an existing index."""
+    d, _ = seg_dir
+    cfg = TableConfig("t")
+    cfg.indexing.inverted_index_columns.append("city")
+    reconcile_indexes(d, cfg)
+    assert os.path.exists(os.path.join(d, "city.inv.docs.bin"))
+
+    bad = TableConfig("t")           # drops city:inverted, adds v:inverted
+    bad.indexing.inverted_index_columns.append("v")  # v is raw: invalid
+    with pytest.raises(ValueError):
+        reconcile_indexes(d, bad)
+    # nothing changed: files still present, metadata still lists the index
+    assert os.path.exists(os.path.join(d, "city.inv.docs.bin"))
+    seg = ImmutableSegment.load(d)
+    assert "inverted" in seg.columns["city"].indexes
+    assert seg.index_reader("city", "inverted") is not None
