@@ -205,6 +205,36 @@ class Controller:
         cfg = self._state["tables"].get(table, {}).get("config") or {}
         return cfg.get("serverTenant")
 
+    def _segment_tier_tag(self, table: str, segment: str) -> Optional[str]:
+        """First age-matching tier's server tag, else None (stay on the
+        tenant). TierFactory TIME segmentSelector analog: age is measured
+        from the segment's creationTimeMs metadata."""
+        cfg = self._state["tables"].get(table, {}).get("config") or {}
+        tiers = cfg.get("tiers") or []
+        if not tiers:
+            return None
+        meta = (self._state["segments"].get(table, {}).get(segment)
+                or {}).get("meta") or {}
+        created_ms = meta.get("creationTimeMs")
+        if created_ms is None:
+            return None
+        age = time.time() - created_ms / 1e3
+        for t in tiers:
+            if age >= float(t.get("segmentAgeSeconds", float("inf"))):
+                return t.get("serverTag")
+        return None
+
+    def _segment_live(self, table: str, segment: str,
+                      tenant_live: List[str]) -> List[str]:
+        tag = self._segment_tier_tag(table, segment)
+        if tag is None:
+            return tenant_live
+        tier_live = self.live_servers(tag)
+        # a tier with zero live servers must not unassign the segment:
+        # availability beats placement policy (the reference likewise
+        # keeps serving from the current tier until the target has hosts)
+        return tier_live if tier_live else tenant_live
+
     def _reconcile_locked(self) -> None:
         """Converge assignment: each segment on `replication` live servers
         of the table's tenant, minimal movement (TableRebalancer analog at
@@ -218,10 +248,14 @@ class Controller:
                     if h in load:
                         load[h] += 1
         for table, tmeta in self._state["tables"].items():
-            live = self.live_servers(self._table_tenant(table))
-            repl = min(tmeta.get("replication", 1), max(len(live), 1))
+            tenant_live = self.live_servers(self._table_tenant(table))
             assign = self._state["assignment"].setdefault(table, {})
             for seg in self._state["segments"].get(table, {}):
+                # tier selection may narrow the candidates to the tier
+                # tag's servers (age-based tiered storage); holders off
+                # the tier drop and the segment moves
+                live = self._segment_live(table, seg, tenant_live)
+                repl = min(tmeta.get("replication", 1), max(len(live), 1))
                 holders = [h for h in assign.get(seg, []) if h in live]
                 while len(holders) < repl and live:
                     candidates = [s for s in live if s not in holders]
@@ -266,23 +300,28 @@ class Controller:
             load = {s: 0 for s in live}
             target: Dict[str, List[str]] = {}
             moved = 0
-            # pass 1: keep current holders that are live and under cap
+            # per-segment candidates honor tier placement, exactly like
+            # the reconcile loop (a rebalance must not undo tiering)
+            seg_live = {s: self._segment_live(table, s, live) for s in segs}
+            # pass 1: keep current holders that are candidates, under cap
             for seg in segs:
                 kept = []
                 for h in current[seg]:
-                    if h in load and load[h] < cap and len(kept) < repl:
+                    if h in seg_live[seg] and load.get(h, 0) < cap \
+                            and len(kept) < repl:
                         kept.append(h)
-                        load[h] += 1
+                        load[h] = load.get(h, 0) + 1
                 target[seg] = kept
-            # pass 2: top up from least-loaded
+            # pass 2: top up from least-loaded candidates
             for seg in segs:
-                while len(target[seg]) < repl:
-                    cands = [s for s in live if s not in target[seg]]
+                while len(target[seg]) < min(repl, len(seg_live[seg])):
+                    cands = [s for s in seg_live[seg]
+                             if s not in target[seg]]
                     if not cands:
                         break
-                    pick = min(cands, key=lambda s: load[s])
+                    pick = min(cands, key=lambda s: load.get(s, 0))
                     target[seg].append(pick)
-                    load[pick] += 1
+                    load[pick] = load.get(pick, 0) + 1
                     if pick not in current[seg]:
                         moved += 1
             result = {

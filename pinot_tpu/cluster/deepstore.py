@@ -84,7 +84,8 @@ def pruning_metadata(seg_dir: str):
             cols[name] = entry
     out = {"columns": cols, "totalDocs": m.get("totalDocs"),
            "numPartitions": m.get("numPartitions")}
-    for k in ("startOffset", "endOffset", "partition"):
+    # creationTimeMs drives age-based tier selection at the controller
+    for k in ("startOffset", "endOffset", "partition", "creationTimeMs"):
         if k in m:
             out[k] = m[k]
     return out

@@ -1,13 +1,18 @@
-"""Text index: tokenized inverted index serving TEXT_MATCH.
+"""Text index: tokenized positional inverted index serving TEXT_MATCH.
 
 Reference parity: pinot-segment-local/.../segment/creator/impl/text/
-LuceneTextIndexCreator.java:28-30 (Lucene StandardAnalyzer index) and
+LuceneTextIndexCreator.java:28-30 (Lucene StandardAnalyzer index),
+.../utils/nativefst/ (the in-house FST for prefix/regex term lookup), and
 operator/filter/TextMatchFilterOperator. Lucene stays host-side in the
-reference; here the analyzer is a lowercase alphanumeric tokenizer and the
-index is CSR postings (token -> sorted doc ids). Query syntax is a Lucene
-subset: terms, "quoted phrases" (conjunctive, positions not stored),
-AND / OR / NOT, parentheses; bare terms combine with OR like Lucene's
-default operator.
+reference; here the analyzer is a lowercase alphanumeric tokenizer and
+the index is CSR postings (token -> sorted doc ids) plus a positional
+occurrence file ("quoted phrases" match true adjacency, like Lucene
+PhraseQuery). The FST's job — ordered term lookup so `prefix*` resolves
+to a contiguous term range without scanning — falls to the SORTED vocab
++ binary search (the same trick the sorted dictionaries use); only
+infix/complex wildcards scan. Query syntax is a Lucene subset: terms,
+"quoted phrases", prefix*/wild?cards, AND / OR / NOT, parentheses; bare
+terms combine with OR like Lucene's default operator.
 """
 from __future__ import annotations
 
@@ -43,7 +48,21 @@ def build(col: str, seg_dir: str, *, values: np.ndarray,
               postings_from_doc_keys(doc_keys, len(tokens_sorted)))
     with open(os.path.join(seg_dir, col + SUFFIX + ".vocab.json"), "w") as fh:
         json.dump(tokens_sorted, fh)
-    return {"vocabSize": len(tokens_sorted)}
+    # positional occurrences (PhraseQuery support): (key, doc, pos)
+    # triples sorted by key, plus per-key offsets for O(1) slicing
+    occ = [(remap[t], d, p)
+           for d, toks in enumerate(doc_tokens)
+           for p, t in enumerate(toks)]
+    occ.sort()
+    arr = (np.asarray(occ, dtype=np.int32).reshape(-1, 3)
+           if occ else np.zeros((0, 3), dtype=np.int32))
+    offsets = np.searchsorted(arr[:, 0],
+                              np.arange(len(tokens_sorted) + 1,
+                                        dtype=np.int32)).astype(np.int64)
+    arr[:, 1:].T.tofile(os.path.join(seg_dir, col + SUFFIX + ".pos.bin"))
+    offsets.tofile(os.path.join(seg_dir, col + SUFFIX + ".pos.off.bin"))
+    max_pos = int(arr[:, 2].max()) + 1 if len(arr) else 1
+    return {"vocabSize": len(tokens_sorted), "maxPos": max_pos}
 
 
 class _QueryParser:
@@ -104,22 +123,71 @@ class TextIndexReader:
     def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
         self.postings = CsrPostings(os.path.join(seg_dir, col + SUFFIX))
         with open(os.path.join(seg_dir, col + SUFFIX + ".vocab.json")) as fh:
-            vocab = json.load(fh)
-        self.vocab = {t: i for i, t in enumerate(vocab)}
+            self.terms = json.load(fh)  # sorted: the FST-analog ordering
+        self.vocab = {t: i for i, t in enumerate(self.terms)}
+        self.max_pos = int(meta.get("maxPos", 0) or 0)
+        pos_path = os.path.join(seg_dir, col + SUFFIX + ".pos.bin")
+        if os.path.exists(pos_path):  # older segments: no positions
+            raw = np.fromfile(pos_path, dtype=np.int32).reshape(2, -1)
+            self._occ_doc, self._occ_pos = raw[0], raw[1]
+            self._occ_off = np.fromfile(
+                os.path.join(seg_dir, col + SUFFIX + ".pos.off.bin"),
+                dtype=np.int64)
+        else:
+            self._occ_doc = None
+
+    def _wildcard_keys(self, term: str) -> List[int]:
+        if term.endswith("*") and not any(c in "*?" for c in term[:-1]):
+            # pure prefix: binary-search the sorted term list — the
+            # nativefst/Lucene-FST capability (ordered term dictionary);
+            # bisect on the list itself, no O(vocab) array conversion
+            import bisect
+            prefix = term[:-1]
+            lo = bisect.bisect_left(self.terms, prefix)
+            hi = bisect.bisect_left(self.terms, prefix + "￿")
+            return list(range(lo, hi))
+        # infix/complex wildcard: scan, with metachars escaped
+        pattern = "".join(".*" if c == "*" else "." if c == "?"
+                          else re.escape(c) for c in term)
+        rx = re.compile("^" + pattern + "$")
+        return [i for t, i in self.vocab.items() if rx.match(t)]
 
     def _term_mask(self, term: str, n_docs: int) -> np.ndarray:
-        if "*" in term or "?" in term:  # wildcard: scan the vocab;
-            # escape every other char so regex metachars in user input
-            # match literally instead of raising re.error
-            pattern = "".join(".*" if c == "*" else "." if c == "?"
-                              else re.escape(c) for c in term)
-            rx = re.compile("^" + pattern + "$")
-            keys = [i for t, i in self.vocab.items() if rx.match(t)]
-            return self.postings.mask_for(keys, n_docs)
+        if "*" in term or "?" in term:
+            return self.postings.mask_for(self._wildcard_keys(term), n_docs)
         key = self.vocab.get(term)
         mask = np.zeros(n_docs, dtype=bool)
         if key is not None:
             mask[self.postings.docs_for(key)] = True
+        return mask
+
+    def _phrase_mask(self, tokens: List[str], n_docs: int) -> np.ndarray:
+        """True adjacency (Lucene PhraseQuery): doc matches when the i-th
+        phrase token occurs at position start+i for some start. Falls back
+        to conjunctive containment on position-less (older) indexes."""
+        mask = np.zeros(n_docs, dtype=bool)
+        if not tokens:
+            return ~mask
+        if self._occ_doc is None or len(tokens) == 1:
+            out = np.ones(n_docs, dtype=bool)
+            for t in tokens:
+                out &= self._term_mask(t, n_docs)
+            return out
+        span = self.max_pos + len(tokens) + 1
+        cand = None
+        for i, t in enumerate(tokens):
+            key = self.vocab.get(t)
+            if key is None:
+                return mask
+            s, e = self._occ_off[key], self._occ_off[key + 1]
+            # phrase-start coordinates this occurrence is consistent with
+            starts = (self._occ_doc[s:e].astype(np.int64) * span
+                      + (self._occ_pos[s:e].astype(np.int64) - i))
+            cand = starts if cand is None else np.intersect1d(
+                cand, starts, assume_unique=False)
+            if len(cand) == 0:
+                return mask
+        mask[np.unique(cand // span)] = True
         return mask
 
     def _eval(self, node, n_docs: int) -> np.ndarray:
@@ -127,10 +195,7 @@ class TextIndexReader:
         if kind == "term":
             return self._term_mask(node[1], n_docs)
         if kind == "phrase":
-            mask = np.ones(n_docs, dtype=bool)
-            for t in node[1]:
-                mask &= self._term_mask(t, n_docs)
-            return mask
+            return self._phrase_mask(node[1], n_docs)
         if kind == "and":
             mask = np.ones(n_docs, dtype=bool)
             for c in node[1]:

@@ -79,6 +79,28 @@ class SegmentsConfig:
 
 
 @dataclass
+class TierConfig:
+    """Age-based storage tier (common/tier/TierFactory TIME-based
+    segmentSelector + PINOT_SERVER storageType analog): segments older
+    than segment_age_seconds move to servers carrying server_tag. Tiers
+    evaluate in list order; the first match wins; unmatched segments stay
+    on the table's tenant."""
+    name: str
+    segment_age_seconds: float
+    server_tag: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name,
+                "segmentAgeSeconds": self.segment_age_seconds,
+                "serverTag": self.server_tag}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TierConfig":
+        return cls(d["name"], float(d["segmentAgeSeconds"]),
+                   d["serverTag"])
+
+
+@dataclass
 class TableConfig:
     table_name: str
     table_type: TableType = TableType.OFFLINE
@@ -94,6 +116,8 @@ class TableConfig:
     ingestion: Optional[IngestionConfig] = None
     # max queries/sec for this table (query quota; None = unlimited)
     quota_qps: Optional[float] = None
+    # age-based storage tiers, first match wins (common/tier/ analog)
+    tiers: List[TierConfig] = field(default_factory=list)
 
     @property
     def name_with_type(self) -> str:
@@ -127,6 +151,7 @@ class TableConfig:
                 "filterFunction": self.ingestion.filter_function,
                 "transforms": self.ingestion.transforms,
             },
+            "tiers": [t.to_dict() for t in self.tiers],
         }
 
     def to_json(self) -> str:
@@ -164,6 +189,7 @@ class TableConfig:
                 filter_function=d["ingestion"].get("filterFunction"),
                 transforms=d["ingestion"].get("transforms", []),
             ),
+            tiers=[TierConfig.from_dict(t) for t in d.get("tiers", [])],
         )
 
 

@@ -297,3 +297,34 @@ def test_range_index_host_scan(seg_and_broker, data):
     res = b.query("SELECT views FROM events WHERE views = 9999 LIMIT 100")
     expect_n = int((data["views"] == 9999).sum())
     assert len(res.rows) == min(expect_n, 100)
+
+
+def test_text_phrase_positions_and_prefix(tmp_path):
+    """Positional phrases (PhraseQuery analog) + sorted-vocab prefix
+    ranges (nativefst analog)."""
+    import numpy as np
+    from pinot_tpu.index import text as T
+
+    vals = np.asarray([
+        "quick brown fox",          # 0: phrase "brown fox" matches
+        "brown quick fox",          # 1: terms present, NOT adjacent
+        "the fox is brown",         # 2: reversed order
+        "brownie fox",              # 3: 'brownie' must not match 'brown'
+        "quick brown foxtrot",      # 4: phrase "brown fox" must NOT match
+    ], dtype=object)
+    meta = T.build("c", str(tmp_path), values=vals)
+    r = T.TextIndexReader(str(tmp_path), "c", meta)
+    # true adjacency
+    assert r.match('"brown fox"', 5).tolist() == \
+        [True, False, False, False, False]
+    # conjunctive AND still matches containment anywhere
+    assert r.match("brown AND fox", 5).tolist() == \
+        [True, True, True, False, False]  # doc 4 has 'foxtrot', not 'fox'
+    # prefix via sorted-term binary search
+    assert r.match("fox*", 5).tolist() == [True, True, True, True, True]
+    assert r.match("brow*", 5).tolist() == [True, True, True, True, True]
+    assert r.match("quic*", 5).tolist() == \
+        [True, True, False, False, True]
+    # infix wildcard still scans
+    assert r.match("*rownie", 5).tolist() == \
+        [False, False, False, True, False]
