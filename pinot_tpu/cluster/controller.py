@@ -225,11 +225,18 @@ class Controller:
         return None
 
     def _segment_live(self, table: str, segment: str,
-                      tenant_live: List[str]) -> List[str]:
+                      tenant_live: List[str],
+                      tag_cache: Optional[Dict[str, List[str]]] = None
+                      ) -> List[str]:
         tag = self._segment_tier_tag(table, segment)
         if tag is None:
             return tenant_live
-        tier_live = self.live_servers(tag)
+        if tag_cache is not None and tag in tag_cache:
+            tier_live = tag_cache[tag]
+        else:
+            tier_live = self.live_servers(tag)
+            if tag_cache is not None:
+                tag_cache[tag] = tier_live
         # a tier with zero live servers must not unassign the segment:
         # availability beats placement policy (the reference likewise
         # keeps serving from the current tier until the target has hosts)
@@ -247,16 +254,18 @@ class Controller:
                 for h in holders:
                     if h in load:
                         load[h] += 1
+        tag_cache: Dict[str, List[str]] = {}
         for table, tmeta in self._state["tables"].items():
             tenant_live = self.live_servers(self._table_tenant(table))
             assign = self._state["assignment"].setdefault(table, {})
             for seg in self._state["segments"].get(table, {}):
                 # tier selection may narrow the candidates to the tier
-                # tag's servers (age-based tiered storage); holders off
-                # the tier drop and the segment moves
-                live = self._segment_live(table, seg, tenant_live)
+                # tag's servers (age-based tiered storage)
+                live = self._segment_live(table, seg, tenant_live,
+                                          tag_cache)
                 repl = min(tmeta.get("replication", 1), max(len(live), 1))
-                holders = [h for h in assign.get(seg, []) if h in live]
+                cur = assign.get(seg, [])
+                holders = [h for h in cur if h in live]
                 while len(holders) < repl and live:
                     candidates = [s for s in live if s not in holders]
                     if not candidates:
@@ -264,6 +273,15 @@ class Controller:
                     pick = min(candidates, key=lambda s: load.get(s, 0))
                     holders.append(pick)
                     load[pick] = load.get(pick, 0) + 1
+                if any(h not in cur for h in holders):
+                    # migration in flight (tier move / replacement): keep
+                    # prior live holders serving until the next tick, when
+                    # the new targets have had a poll+download cycle —
+                    # approximation of the reference's external-view
+                    # gating (routing only advertises ONLINE replicas)
+                    for h in cur:
+                        if h in all_live and h not in holders:
+                            holders.append(h)
                 if assign.get(seg) != holders:
                     assign[seg] = holders
                     changed = True
@@ -281,7 +299,17 @@ class Controller:
             if table not in self._state["tables"]:
                 raise KeyError(f"table {table!r} not registered")
             live = self.live_servers(self._table_tenant(table))
-            if not live:
+            # tiered segments may be placeable even when the tenant has no
+            # live servers (and vice versa): gate and cap on the union
+            cfg = self._state["tables"][table].get("config") or {}
+            tag_cache: Dict[str, List[str]] = {}
+            for t in cfg.get("tiers") or []:
+                tag = t.get("serverTag")
+                if tag is not None and tag not in tag_cache:
+                    tag_cache[tag] = self.live_servers(tag)
+            union = list(dict.fromkeys(
+                live + [s for ls in tag_cache.values() for s in ls]))
+            if not union:
                 return {"status": "NO_SERVERS", "table": table}
             if replication is None:
                 replication = self._state["tables"][table].get(
@@ -289,20 +317,21 @@ class Controller:
             elif not dry_run:
                 # a dry run must not change cluster state
                 self._state["tables"][table]["replication"] = replication
-            repl = min(replication, len(live))
+            repl = min(replication, len(union))
             segs = sorted(self._state["segments"].get(table, {}))
             current = {s: list(self._state["assignment"]
                                .get(table, {}).get(s, []))
                        for s in segs}
             # target load per server for THIS table
             total = len(segs) * repl
-            cap = -(-total // len(live))  # ceil
-            load = {s: 0 for s in live}
+            cap = -(-total // len(union))  # ceil
+            load = {s: 0 for s in union}
             target: Dict[str, List[str]] = {}
             moved = 0
             # per-segment candidates honor tier placement, exactly like
             # the reconcile loop (a rebalance must not undo tiering)
-            seg_live = {s: self._segment_live(table, s, live) for s in segs}
+            seg_live = {s: self._segment_live(table, s, live, tag_cache)
+                        for s in segs}
             # pass 1: keep current holders that are candidates, under cap
             for seg in segs:
                 kept = []
@@ -469,6 +498,57 @@ class Controller:
         return out
 
     # -- views -------------------------------------------------------------
+    def ui_page(self) -> str:
+        """Minimal cluster status page (GET /ui) — the controller web
+        app's overview screens (pinot-controller/src/main/resources/app)
+        reduced to one server-rendered HTML table set: instances with
+        liveness, tables with replication, segment assignment."""
+        import html as _h
+        with self._lock:
+            # the raw registries, not routing_snapshot(): the snapshot
+            # strips instance tags and table replication, exactly the two
+            # columns a tiering operator reads this page for
+            instances = {i: dict(info)
+                         for i, info in self._instances.items()}
+            tables = {t: {"replication": m.get("replication", 1)}
+                      for t, m in self._state["tables"].items()}
+            segments = {t: sorted(s)
+                        for t, s in self._state["segments"].items()}
+            assignment = {t: {s: list(h) for s, h in a.items()}
+                          for t, a in self._state["assignment"].items()}
+            version = self._state["version"]
+            live = set(self.live_servers())
+        snap = {"version": version}
+        rows_i = "".join(
+            f"<tr><td>{_h.escape(i)}</td>"
+            f"<td>{'LIVE' if i in live else 'DEAD'}</td>"
+            f"<td>{_h.escape(','.join(info.get('tags') or []))}"
+            f"</td></tr>"
+            for i, info in sorted(instances.items()))
+        rows_t = "".join(
+            f"<tr><td>{_h.escape(t)}</td>"
+            f"<td>{meta['replication']}</td>"
+            f"<td>{len(segments.get(t) or [])}</td></tr>"
+            for t, meta in sorted(tables.items()))
+        rows_a = "".join(
+            f"<tr><td>{_h.escape(t)}</td><td>{_h.escape(s)}</td>"
+            f"<td>{_h.escape(', '.join(holders))}</td></tr>"
+            for t, segs in sorted(assignment.items())
+            for s, holders in sorted(segs.items()))
+        return (
+            "<!doctype html><html><head><title>pinot-tpu controller"
+            "</title><style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse;margin-bottom:2em}"
+            "td,th{border:1px solid #999;padding:4px 10px}</style></head>"
+            f"<body><h1>pinot-tpu controller</h1>"
+            f"<p>routing version {snap.get('version')}</p>"
+            f"<h2>Instances</h2><table><tr><th>id</th><th>state</th>"
+            f"<th>tags</th></tr>{rows_i}</table>"
+            f"<h2>Tables</h2><table><tr><th>table</th><th>replication"
+            f"</th><th>segments</th></tr>{rows_t}</table>"
+            f"<h2>Assignment</h2><table><tr><th>table</th><th>segment"
+            f"</th><th>servers</th></tr>{rows_a}</table></body></html>")
+
     def routing_snapshot(self) -> Dict[str, Any]:
         with self._lock:
             # cache the expensive deep copy per version: brokers poll this
@@ -533,6 +613,8 @@ class Controller:
 
         class Handler(JsonHandler):
             routes = {
+                ("GET", "/ui"): lambda h, b: (
+                    200, ("text/html", ctrl.ui_page())),
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
                 ("POST", "/instances"): lambda h, b: (
                     ctrl.register_instance(b) or (200, {"status": "OK"})),

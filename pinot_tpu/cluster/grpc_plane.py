@@ -1,0 +1,107 @@
+"""gRPC data plane: streaming query responses + mailbox delivery.
+
+Reference parity: pinot-core/.../transport/grpc/GrpcQueryServer.java:165
+(server.proto:25 `rpc Submit(...) returns (stream ...)` — results stream
+back block by block instead of one buffered DataTable) and the gRPC
+mailbox of mailbox.proto:25. Methods register with bytes serializers;
+payloads are the framework's binary frames (engine/datablock.py) — see
+protos/server.proto for the documented contract. HTTP (/query/bin,
+/mailbox) remains the default data plane; gRPC adds streaming delivery
+(partials arrive as they are produced, the reference's
+StreamingResponseUtils behavior) and a persistent-channel alternative
+for mailbox fan-out.
+"""
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import grpc
+
+SERVICE = "pinot.tpu.Server"
+_META = b"META"
+
+
+def _ident(b: bytes) -> bytes:
+    return b
+
+
+class _Handlers(grpc.GenericRpcHandler):
+    def __init__(self, node):
+        self.node = node
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == f"/{SERVICE}/Submit":
+            return grpc.unary_stream_rpc_method_handler(
+                self._submit, request_deserializer=_ident,
+                response_serializer=_ident)
+        if method == f"/{SERVICE}/Mailbox":
+            return grpc.stream_unary_rpc_method_handler(
+                self._mailbox, request_deserializer=_ident,
+                response_serializer=_ident)
+        return None
+
+    def _submit(self, request: bytes, context) -> Iterator[bytes]:
+        """One partial block per chunk AS EACH SEGMENT FINISHES, then a
+        META trailer — the streaming selection/response path the buffered
+        HTTP plane lacks."""
+        from ..engine.datablock import encode_partial
+        req = json.loads(request)
+        resp = self.node.execute(req["sql"], req.get("segments"))
+        partials = resp.pop("partials_raw", [])
+        for p in partials:
+            yield encode_partial(p)
+        yield _META + json.dumps(resp).encode()
+
+    def _mailbox(self, request_iterator, context) -> bytes:
+        from ..multistage.dispatch import deliver_mailbox_frame
+        n = 0
+        for frame in request_iterator:
+            deliver_mailbox_frame(self.node.mailboxes, frame)
+            n += 1
+        return json.dumps({"delivered": n}).encode()
+
+
+def start_grpc(node, port: int = 0) -> Tuple[grpc.Server, int]:
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    server.add_generic_rpc_handlers((_Handlers(node),))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+def submit_stream(target: str, sql: str,
+                  segments: Optional[List[str]] = None,
+                  timeout: float = 60.0):
+    """-> (header dict, [decoded partials]); partials decode as chunks
+    arrive (GrpcBrokerRequestHandler analog)."""
+    from ..engine.datablock import decode_partial
+    partials: List[Any] = []
+    header: Dict[str, Any] = {}
+    with grpc.insecure_channel(target) as channel:
+        call = channel.unary_stream(
+            f"/{SERVICE}/Submit", request_serializer=_ident,
+            response_deserializer=_ident)
+        req = json.dumps({"sql": sql, "segments": segments}).encode()
+        for chunk in call(req, timeout=timeout):
+            if chunk[:4] == _META:
+                header = json.loads(chunk[4:])
+            else:
+                partials.append(decode_partial(chunk))
+    return header, partials
+
+
+def mailbox_send(target: str, frames: List[bytes],
+                 timeout: float = 60.0) -> int:
+    with grpc.insecure_channel(target) as channel:
+        call = channel.stream_unary(
+            f"/{SERVICE}/Mailbox", request_serializer=_ident,
+            response_deserializer=_ident)
+        ack = call(iter(frames), timeout=timeout)
+    return json.loads(ack)["delivered"]

@@ -52,6 +52,12 @@ class JsonHandler(BaseHTTPRequestHandler):
                     # binary data plane (DataTable-over-Netty analog)
                     data = bytes(payload)
                     ctype = "application/octet-stream"
+                elif isinstance(payload, tuple) and len(payload) == 2 \
+                        and isinstance(payload[0], str):
+                    # (content_type, body) — e.g. the controller UI page
+                    ctype, body = payload
+                    data = body if isinstance(body, bytes) \
+                        else str(body).encode()
                 else:
                     data = json.dumps(payload).encode()
                     ctype = "application/json"

@@ -45,6 +45,15 @@ class ServerNode:
         self.scheduler = make_scheduler(scheduler_config)
         from ..multistage.exchange import MailboxService
         self.mailboxes = MailboxService()  # multi-stage receiving side
+        # gRPC data plane (streaming Submit + mailbox; grpc_plane.py).
+        # Optional: environments without grpcio still run the HTTP planes
+        self.grpc_server = None
+        self.grpc_port: Optional[int] = None
+        try:
+            from .grpc_plane import start_grpc
+            self.grpc_server, self.grpc_port = start_grpc(self)
+        except ImportError:
+            pass
         # OOM protection: kill the most expensive query near the RSS limit
         # (PerQueryCPUMemAccountant WatcherTask analog); limit defaults to
         # 90% of system memory, override/disable via
@@ -258,6 +267,8 @@ class ServerNode:
         self.scheduler.stop()
         if self.heap_watcher is not None:
             self.heap_watcher.stop()
+        if self.grpc_server is not None:
+            self.grpc_server.stop(grace=None)
         self._httpd.shutdown()
         self._httpd.server_close()
 

@@ -128,11 +128,14 @@ class TextIndexReader:
         self.max_pos = int(meta.get("maxPos", 0) or 0)
         pos_path = os.path.join(seg_dir, col + SUFFIX + ".pos.bin")
         if os.path.exists(pos_path):  # older segments: no positions
-            raw = np.fromfile(pos_path, dtype=np.int32).reshape(2, -1)
-            self._occ_doc, self._occ_pos = raw[0], raw[1]
-            self._occ_off = np.fromfile(
+            # memmap like the CSR postings — the occurrence file is the
+            # biggest text artifact and phrase queries may never come
+            raw = np.memmap(pos_path, dtype=np.int32, mode="r")
+            half = len(raw) // 2
+            self._occ_doc, self._occ_pos = raw[:half], raw[half:]
+            self._occ_off = np.memmap(
                 os.path.join(seg_dir, col + SUFFIX + ".pos.off.bin"),
-                dtype=np.int64)
+                dtype=np.int64, mode="r")
         else:
             self._occ_doc = None
 

@@ -1,0 +1,62 @@
+"""Config recommender (controller/recommender/ analog) + controller
+status page (web app overview analog)."""
+import numpy as np
+import pytest
+
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.tools.recommender import recommend
+
+
+def test_recommender_rules():
+    schema = Schema("orders", [
+        FieldSpec("customer", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("status", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("note", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("amount", DataType.LONG, FieldType.METRIC),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+    ])
+    workload = [
+        ("SELECT COUNT(*) FROM orders WHERE customer = 'c1'", 10.0),
+        ("SELECT SUM(amount) FROM orders WHERE amount > 100 "
+         "AND customer = 'c2'", 5.0),
+        ("SELECT status, COUNT(*) FROM orders WHERE note LIKE '%vip%' "
+         "GROUP BY status", 2.0),
+    ]
+    rec = recommend(schema, workload,
+                    cardinalities={"customer": 50_000, "status": 5,
+                                   "note": 950_000},
+                    n_rows=1_000_000)
+    cfg = rec.table_config
+    assert "customer" in cfg.indexing.bloom_filter_columns
+    assert cfg.partition_column == "customer"
+    assert cfg.indexing.sorted_column == "amount"
+    assert "note" in cfg.indexing.text_index_columns
+    assert "note" in cfg.indexing.no_dictionary_columns  # near-unique
+    assert cfg.time_column == "ts"
+    assert len(rec.reasons) >= 5
+    assert rec.to_dict()["tableConfig"]["partitionColumn"] == "customer"
+
+
+def test_controller_ui_page(tmp_path):
+    import urllib.request
+
+    from pinot_tpu.cluster import Controller, ServerNode
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.spi import TableConfig
+    ctrl = Controller(str(tmp_path / "c"), reconcile_interval=0.1)
+    srv = ServerNode("s1", ctrl.url, poll_interval=0.1)
+    try:
+        schema = Schema("u", [FieldSpec("v", DataType.INT,
+                                        FieldType.METRIC)])
+        ctrl.add_table("u", schema.to_dict(), replication=1)
+        d = SegmentBuilder(schema, TableConfig("u")).build(
+            {"v": np.arange(3, dtype=np.int32)}, str(tmp_path), "seg_0")
+        ctrl.add_segment("u", "seg_0", d)
+        with urllib.request.urlopen(f"{ctrl.url}/ui", timeout=10) as r:
+            assert "text/html" in r.headers["Content-Type"]
+            page = r.read().decode()
+        assert "pinot-tpu controller" in page
+        assert "s1" in page and "seg_0" in page and "u" in page
+    finally:
+        srv.stop()
+        ctrl.stop()
