@@ -146,6 +146,17 @@ class Controller:
             self._state["assignment"].setdefault(name, {})
             self._bump()
 
+    def update_table_config(self, name: str,
+                            config: Dict[str, Any]) -> None:
+        """Replace a table's config without touching schema/replication/
+        assignment (the updateTableConfig REST operation; reload then
+        reconciles segments against it)."""
+        with self._lock:
+            if name not in self._state["tables"]:
+                raise KeyError(f"table {name!r} not registered")
+            self._state["tables"][name]["config"] = config or {}
+            self._bump()
+
     @staticmethod
     def _delete_artifact(location: Optional[str]) -> None:
         """Best-effort deletion of a retired segment's bytes (local dir or
@@ -629,6 +640,10 @@ class Controller:
                     or (200, {"status": "OK"})),
                 ("DELETE", "/tables/"): lambda h, b: (
                     ctrl.drop_table(h.path.rsplit("/", 1)[1])
+                    or (200, {"status": "OK"})),
+                ("POST", "/tableconfig/"): lambda h, b: (
+                    ctrl.update_table_config(
+                        h.path.rsplit("/", 1)[1], b)
                     or (200, {"status": "OK"})),
                 ("POST", "/segments"): lambda h, b: (
                     ctrl.add_segment(b["table"], b["segment"],

@@ -114,6 +114,76 @@ def cmd_quickstart(args) -> int:
     return 0
 
 
+def cmd_reload_table(args) -> int:
+    """Reload a table's segments on every hosting server (rebuild
+    secondary indexes). With --config-file, the config first persists at
+    the controller — it is the source of truth, or the next restart/
+    rebalance would silently revert the indexes."""
+    from ..cluster.http_util import http_json
+    snap = http_json("GET", f"{args.controller}/routing")
+    if args.table not in (snap.get("tables") or {}):
+        print(f"unknown table {args.table!r}", file=sys.stderr)
+        return 1
+    if args.config_file:
+        with open(args.config_file) as fh:
+            cfg = json.load(fh)
+        http_json("POST", f"{args.controller}/tableconfig/{args.table}",
+                  cfg)
+    servers = {h for holders in
+               (snap.get("assignment", {}).get(args.table) or {}).values()
+               for h in holders}
+    total = {"added": [], "removed": []}
+    for sid in sorted(servers):
+        inst = snap.get("instances", {}).get(sid)
+        if inst is None:
+            continue
+        url = f"http://{inst['host']}:{inst['port']}"
+        # no inline config: servers pull the (just-updated) controller one
+        r = http_json("POST", f"{url}/reload", {"table": args.table},
+                      timeout=120)
+        total["added"].extend(r.get("added", []))
+        total["removed"].extend(r.get("removed", []))
+    print(json.dumps(total))
+    return 0
+
+
+def cmd_rebalance(args) -> int:
+    from ..cluster.http_util import http_json
+    r = http_json("POST", f"{args.controller}/rebalance/{args.table}",
+                  {"dryRun": args.dry_run}, timeout=120)
+    print(json.dumps(r))
+    return 0
+
+
+def cmd_recommend(args) -> int:
+    """Rule-based config advice from a schema + weighted query workload
+    file (one `weight<TAB>sql` per line, or bare sql = weight 1)."""
+    from ..spi.schema import Schema
+    from .recommender import recommend
+    with open(args.schema_file) as fh:
+        schema = Schema.from_dict(json.load(fh))
+    workload = []
+    with open(args.workload_file) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            w, _, rest = line.partition("\t")
+            try:
+                workload.append((rest, float(w)))
+            except ValueError:
+                # no numeric weight prefix (SQL may itself contain tabs)
+                workload.append((line, 1.0))
+    cards = None
+    if args.cardinalities:
+        with open(args.cardinalities) as fh:
+            cards = json.load(fh)
+    rec = recommend(schema, workload, cardinalities=cards,
+                    n_rows=args.rows)
+    print(json.dumps(rec.to_dict(), indent=2))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="pinot-tpu-admin",
@@ -159,6 +229,25 @@ def build_parser() -> argparse.ArgumentParser:
     qs.add_argument("--rows", type=int, default=5000)
     qs.add_argument("--exit-after", action="store_true")
     qs.set_defaults(fn=cmd_quickstart)
+
+    rl = sub.add_parser("ReloadTable")
+    rl.add_argument("--controller", required=True)
+    rl.add_argument("--table", required=True)
+    rl.add_argument("--config-file")
+    rl.set_defaults(fn=cmd_reload_table)
+
+    rb = sub.add_parser("RebalanceTable")
+    rb.add_argument("--controller", required=True)
+    rb.add_argument("--table", required=True)
+    rb.add_argument("--dry-run", action="store_true")
+    rb.set_defaults(fn=cmd_rebalance)
+
+    rc = sub.add_parser("RecommendConfig")
+    rc.add_argument("--schema-file", required=True)
+    rc.add_argument("--workload-file", required=True)
+    rc.add_argument("--cardinalities")
+    rc.add_argument("--rows", type=int, default=1_000_000)
+    rc.set_defaults(fn=cmd_recommend)
     return p
 
 

@@ -60,3 +60,75 @@ def test_controller_ui_page(tmp_path):
     finally:
         srv.stop()
         ctrl.stop()
+
+
+def test_admin_reload_and_rebalance_commands(tmp_path, capsys):
+    import json
+
+    from pinot_tpu.cluster import Controller, ServerNode
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.spi import TableConfig
+    from pinot_tpu.tools.admin import main as admin_main
+    ctrl = Controller(str(tmp_path / "c"), reconcile_interval=0.1)
+    srv = ServerNode("s1", ctrl.url, poll_interval=0.1)
+    try:
+        schema = Schema("a", [
+            FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        ctrl.add_table("a", schema.to_dict(), replication=1)
+        d = SegmentBuilder(schema, TableConfig("a")).build(
+            {"city": np.array(["x", "y", "x"]),
+             "v": np.arange(3, dtype=np.int32)}, str(tmp_path), "seg_0")
+        ctrl.add_segment("a", "seg_0", d)
+        import time
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if srv._tables.get("a") and \
+                    srv._tables["a"].acquire_segments():
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("segment never loaded on the server")
+
+        cfg = TableConfig("a")
+        cfg.indexing.inverted_index_columns.append("city")
+        cfg_file = tmp_path / "cfg.json"
+        cfg_file.write_text(json.dumps(cfg.to_dict()))
+        rc = admin_main(["ReloadTable", "--controller", ctrl.url,
+                         "--table", "a", "--config-file", str(cfg_file)])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["added"] == ["city:inverted"]
+        seg = srv._tables["a"].acquire_segments()[0]
+        assert "inverted" in seg.columns["city"].indexes
+
+        rc = admin_main(["RebalanceTable", "--controller", ctrl.url,
+                         "--table", "a", "--dry-run"])
+        assert rc == 0
+        assert json.loads(capsys.readouterr().out)["status"] == "DRY_RUN"
+    finally:
+        srv.stop()
+        ctrl.stop()
+
+
+def test_admin_recommend_command(tmp_path, capsys):
+    import json
+
+    from pinot_tpu.tools.admin import main as admin_main
+    schema = Schema("r", [
+        FieldSpec("cust", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("amount", DataType.LONG, FieldType.METRIC)])
+    sf = tmp_path / "schema.json"
+    sf.write_text(json.dumps(schema.to_dict()))
+    wf = tmp_path / "workload.txt"
+    wf.write_text("10\tSELECT COUNT(*) FROM r WHERE cust = 'a'\n"
+                  "SELECT SUM(amount) FROM r WHERE amount > 5\n")
+    cf = tmp_path / "cards.json"
+    cf.write_text(json.dumps({"cust": 5000}))
+    rc = admin_main(["RecommendConfig", "--schema-file", str(sf),
+                     "--workload-file", str(wf),
+                     "--cardinalities", str(cf)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "cust" in out["tableConfig"]["indexing"]["bloomFilterColumns"]
+    assert out["tableConfig"]["indexing"]["sortedColumn"] == "amount"
