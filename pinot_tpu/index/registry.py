@@ -12,7 +12,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-from . import bloom, inverted, json_index, range_index, text, vector
+from . import bloom, geo, inverted, json_index, range_index, text, vector
 
 _BUILDERS = {
     "inverted": inverted.build,
@@ -21,6 +21,7 @@ _BUILDERS = {
     "text": text.build,
     "json": json_index.build,
     "vector": vector.build,
+    "geo": geo.build,
 }
 
 _READERS = {
@@ -30,6 +31,7 @@ _READERS = {
     "text": text.TextIndexReader,
     "json": json_index.JsonIndexReader,
     "vector": vector.VectorIndexReader,
+    "geo": geo.GeoIndexReader,
 }
 
 INDEX_KINDS = tuple(_BUILDERS)
@@ -38,7 +40,8 @@ INDEX_KINDS = tuple(_BUILDERS)
 # (single source of truth: the module that writes the files). Removal on
 # reload deletes <col><stem> and <col><stem>.* (csr sub-files).
 _MODULES = {"inverted": inverted, "range": range_index, "bloom": bloom,
-            "text": text, "json": json_index, "vector": vector}
+            "text": text, "json": json_index, "vector": vector,
+            "geo": geo}
 FILE_STEMS: Dict[str, tuple] = {}
 for _kind, _mod in _MODULES.items():
     _sufs = [getattr(_mod, a) for a in dir(_mod)
@@ -62,17 +65,20 @@ def index_predicate_names() -> tuple:
 
 
 def build_indexes_for_column(col: str, kinds, seg_dir: str, *,
-                             values: np.ndarray, ids, cardinality: int
+                             values: np.ndarray, ids, cardinality: int,
+                             configs: Dict[str, Dict[str, Any]] = None
                              ) -> Dict[str, Dict[str, Any]]:
     """Build each configured index; returns {kind: extra_metadata} to embed
-    in the column's metadata under "indexes"."""
+    in the column's metadata under "indexes". ``configs`` carries per-kind
+    build options from the table config (e.g. geo resolution)."""
     out: Dict[str, Dict[str, Any]] = {}
     for kind in kinds:
         if kind not in _BUILDERS:
             raise ValueError(f"unknown index kind {kind!r}; have "
                              f"{INDEX_KINDS}")
         out[kind] = _BUILDERS[kind](col, seg_dir, values=values, ids=ids,
-                                    cardinality=cardinality)
+                                    cardinality=cardinality,
+                                    **((configs or {}).get(kind) or {}))
     return out
 
 

@@ -111,8 +111,29 @@ def build_query_context(stmt: SelectStmt) -> QueryContext:
         aggregations.append(agg)
         return agg
 
-    group_by = list(stmt.group_by)
+    def _resolve_ordinal(e: Any, grouping: bool = False) -> Any:
+        """GROUP BY 2 / ORDER BY 2 name the 2nd select item (Calcite
+        ordinal scope resolution; SqlToRelConverter)."""
+        if isinstance(e, Literal) and isinstance(e.value, int) \
+                and not isinstance(e.value, bool) \
+                and 1 <= e.value <= len(stmt.select) \
+                and not isinstance(stmt.select[e.value - 1].expr, Star):
+            target = stmt.select[e.value - 1].expr
+            if grouping:
+                found: List[FuncCall] = []
+                _find_aggs(target, found)
+                if found:
+                    raise SqlError("aggregate functions are not allowed in "
+                                   f"GROUP BY (ordinal {e.value})")
+            return target
+        return e
+
+    group_by = [_resolve_ordinal(g, grouping=True) for g in stmt.group_by]
     group_labels = {_expr_label(g) for g in group_by}
+    import dataclasses as _dc
+    stmt = _dc.replace(stmt, order_by=[
+        OrderItem(_resolve_ordinal(o.expr), o.ascending)
+        for o in stmt.order_by], group_by=group_by)
 
     for item in stmt.select:
         e = item.expr

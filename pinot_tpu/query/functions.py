@@ -54,12 +54,21 @@ def register_alias(alias: str, name: str) -> None:
     REGISTRY[alias] = REGISTRY[name]
 
 
+def canonical(name: str) -> str:
+    """FunctionRegistry.canonicalize analog: case-insensitive and
+    underscore-insensitive (ST_DISTANCE == stDistance == stdistance)."""
+    return name.replace("_", "").lower()
+
+
 def lookup(name: str) -> Optional[FunctionDef]:
-    return REGISTRY.get(name)
+    fd = REGISTRY.get(name)
+    if fd is None:
+        fd = REGISTRY.get(canonical(name))
+    return fd
 
 
 def call(name: str, *args: Any) -> np.ndarray:
-    fd = REGISTRY.get(name)
+    fd = lookup(name)
     if fd is None:
         raise SqlError(f"unknown function {name!r}")
     n = len(args)
@@ -647,3 +656,6 @@ def cast_value(v: Any, type_name: str) -> np.ndarray:
 
 
 register("cast", 2)(lambda v, t: cast_value(v, str(np.asarray(t))))
+
+# geospatial ST_* family (query/geo_functions.py) registers on import
+from . import geo_functions as _geo_functions  # noqa: E402,F401

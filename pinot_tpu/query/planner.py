@@ -34,7 +34,7 @@ from ..segment.immutable import ImmutableSegment
 from ..spi.schema import DataType
 from .context import AggExpr, QueryContext
 from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, Comparison,
-                  collect_identifiers,
+                  collect_identifiers, FuncCall,
                   Identifier, InList, IsNull, Like, Literal, SqlError, Star)
 
 MAX_DENSE_GROUPS = 1 << 21          # beyond this, host hash group-by
@@ -314,6 +314,16 @@ class SegmentPlanner:
             if mask.all():
                 return TrueP()
             return MaskParamP(self.b.add_param(("docmask", mask)))
+        from ..index.predicates import try_geo_inclusion_mask
+        gmask = try_geo_inclusion_mask(self.seg, e) \
+            if isinstance(e, FuncCall) else None
+        if gmask is not None:
+            # bare boolean ST_Contains/ST_Within over an indexed column
+            if not gmask.any():
+                return FalseP()
+            if gmask.all():
+                return TrueP()
+            return MaskParamP(self.b.add_param(("docmask", gmask)))
         raise PlanError(f"unsupported filter expression {e!r}")
 
     def _comparison(self, e: Comparison) -> Pred:
@@ -351,11 +361,36 @@ class SegmentPlanner:
                 return self._dict_range(name, lo, hi, il, ih)
             # raw column
             return self._raw_cmp(name, m, op, v)
+        geo = self._geo_comparison(lhs, op, rhs)
+        if geo is not None:
+            return geo
         # generic: expr vs expr -> compare difference against zero
         l, li = self.resolve_value(lhs)
         r, ri = self.resolve_value(rhs)
         zero = self.b.add_param(np.int64(0) if (li and ri) else np.float64(0))
         return Cmp(Bin("-", l, r), op, zero)
+
+    def _geo_comparison(self, lhs, op: str, rhs) -> Optional[Pred]:
+        """Index-backed geospatial comparisons (H3IndexFilterOperator /
+        H3InclusionIndexFilterOperator analogs): ST_Distance(col, point)
+        <op> r, and ST_Contains/ST_Within(...) = 0|1. None when the shape
+        doesn't match or the column has no geo index (host path then
+        evaluates the ST_* scalar row-wise, like the reference's scan
+        filter fallback)."""
+        from ..index.predicates import (try_geo_distance_mask,
+                                        try_geo_inclusion_mask)
+        mask = try_geo_distance_mask(self.seg, lhs, op, rhs)
+        if mask is None and isinstance(rhs, Literal) and op in ("==", "!=") \
+                and isinstance(rhs.value, (bool, int)):
+            positive = bool(rhs.value) == (op == "==")
+            mask = try_geo_inclusion_mask(self.seg, lhs, positive=positive)
+        if mask is None:
+            return None
+        if not mask.any():
+            return FalseP()
+        if mask.all():
+            return TrueP()
+        return MaskParamP(self.b.add_param(("docmask", mask)))
 
     def _cast_for(self, m, v: Any) -> Any:
         if m.data_type == DataType.STRING or not m.data_type.is_numeric:

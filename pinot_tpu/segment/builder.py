@@ -398,10 +398,12 @@ class SegmentBuilder:
                                  f"column: {f.name!r}")
             if isinstance(arr, Categorical):  # indexes need materialized rows
                 arr = np.asarray(arr.values, dtype=object)[arr.codes]
+            icfgs = {"geo": self.table_config.indexing
+                     .geo_index_columns.get(f.name) or {}}
             cmeta["indexes"] = index_pkg.build_indexes_for_column(
                 f.name, kinds, seg_dir, values=arr,
                 ids=ids if use_dict else None,
-                cardinality=cardinality)
+                cardinality=cardinality, configs=icfgs)
         return cmeta
 
     @staticmethod

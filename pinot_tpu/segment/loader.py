@@ -66,7 +66,8 @@ def reconcile_indexes(seg_dir: str, table_config: TableConfig
             built = index_pkg.build_indexes_for_column(
                 name, to_add, seg_dir, values=seg.raw_values(name),
                 ids=np.asarray(seg.fwd(name)) if m.has_dict else None,
-                cardinality=m.cardinality)
+                cardinality=m.cardinality,
+                configs={"geo": idx_cfg.geo_index_columns.get(name) or {}})
             cmeta.setdefault("indexes", {}).update(built)
             added.extend(f"{name}:{k}" for k in to_add)
         for kind in to_remove:

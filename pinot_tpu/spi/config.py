@@ -47,6 +47,10 @@ class IndexingConfig:
     # col -> {"dim": int, "metric": "cosine"|"l2"}
     vector_index_columns: Dict[str, Dict[str, Any]] = field(
         default_factory=dict)
+    # col -> {"resolution": int} (H3-analog grid cell index; fieldConfig
+    # H3 indexType + "resolutions" property in the reference)
+    geo_index_columns: Dict[str, Dict[str, Any]] = field(
+        default_factory=dict)
 
     def indexes_for(self, col: str) -> List[str]:
         kinds = []
@@ -59,6 +63,8 @@ class IndexingConfig:
                 kinds.append(kind)
         if col in self.vector_index_columns:
             kinds.append("vector")
+        if col in self.geo_index_columns:
+            kinds.append("geo")
         return kinds
 
 
@@ -138,6 +144,7 @@ class TableConfig:
                 "textIndexColumns": self.indexing.text_index_columns,
                 "jsonIndexColumns": self.indexing.json_index_columns,
                 "vectorIndexColumns": self.indexing.vector_index_columns,
+                "geoIndexColumns": self.indexing.geo_index_columns,
             },
             "segments": {
                 "replication": self.segments.replication,
@@ -176,6 +183,7 @@ class TableConfig:
                 text_index_columns=idx.get("textIndexColumns", []),
                 json_index_columns=idx.get("jsonIndexColumns", []),
                 vector_index_columns=idx.get("vectorIndexColumns", {}),
+                geo_index_columns=idx.get("geoIndexColumns", {}),
             ),
             segments=SegmentsConfig(
                 replication=seg.get("replication", 1),

@@ -1,0 +1,390 @@
+"""Geospatial suite: grid cells, WKT/WKB codecs, ST_* functions, geo
+index filters (kernel docmask path) and host fallback.
+
+Reference test strategy analog: pinot-core geospatial transform function
+tests + H3IndexFilterOperator/H3InclusionIndexFilterOperator query tests
+(pinot-integration-tests GeospatialTest)."""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.geo import (Geometry, area, cells, contains, cover_circle,
+                           cover_polygon, distance, haversine_m,
+                           lat_lng_to_cell, parse_wkb, parse_wkt, to_wkb,
+                           to_wkt)
+from pinot_tpu.geo.cells import cell_bounds, cell_res, parent
+from pinot_tpu.query.functions import call
+from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, IndexingConfig,
+                           Schema, TableConfig)
+
+N = 4000
+# a ~20km x 20km box around downtown SF
+LAT0, LNG0 = 37.77, -122.42
+
+
+def _points(rng, n=N):
+    lat = LAT0 + rng.uniform(-0.1, 0.1, n)
+    lng = LNG0 + rng.uniform(-0.1, 0.1, n)
+    return lat, lng
+
+
+@pytest.fixture(scope="module")
+def geo_data():
+    rng = np.random.default_rng(7)
+    lat, lng = _points(rng)
+    wkb = [to_wkb(Geometry.point(x, y, geography=True)).hex()
+           for x, y in zip(lng, lat)]
+    # a few null/empty rows exercise the invalid-point handling
+    wkb[5] = ""
+    wkb[17] = ""
+    lat[5] = lat[17] = np.nan
+    return {
+        "lat": lat, "lng": lng,
+        "location": np.asarray(wkb, dtype=object),
+        "value": rng.integers(0, 100, N).astype(np.int64),
+    }
+
+
+def _build(geo_data, tmpdir, with_index: bool):
+    schema = Schema("places", [
+        FieldSpec("location", DataType.BYTES, FieldType.DIMENSION),
+        FieldSpec("value", DataType.LONG, FieldType.METRIC),
+    ])
+    idx = IndexingConfig(
+        geo_index_columns={"location": {"resolution": 13}}) \
+        if with_index else IndexingConfig()
+    cfg = TableConfig("places", indexing=idx)
+    data = {"location": geo_data["location"], "value": geo_data["value"]}
+    seg_dir = SegmentBuilder(schema, cfg).build(data, str(tmpdir), "seg_0")
+    seg = ImmutableSegment.load(seg_dir)
+    dm = TableDataManager("places")
+    dm.add_segment_dir(seg_dir)
+    b = Broker()
+    b.register_table(dm)
+    return seg, b
+
+
+@pytest.fixture(scope="module")
+def indexed(geo_data, tmp_path_factory):
+    return _build(geo_data, tmp_path_factory.mktemp("places_idx"), True)
+
+
+@pytest.fixture(scope="module")
+def unindexed(geo_data, tmp_path_factory):
+    return _build(geo_data, tmp_path_factory.mktemp("places_raw"), False)
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+
+def test_cell_roundtrip_bounds():
+    lat = np.array([37.77, -33.86, 0.0, 89.9, -89.9])
+    lng = np.array([-122.42, 151.2, 0.0, 179.9, -179.9])
+    for res in (0, 5, 14, 26):
+        c = lat_lng_to_cell(lat, lng, res)
+        assert (cell_res(c) == res).all()
+        ls, ln, lw, le = cell_bounds(c)
+        assert ((lat >= ls - 1e-9) & (lat <= ln + 1e-9)).all()
+        assert ((lng >= lw - 1e-9) & (lng <= le + 1e-9)).all()
+
+
+def test_cell_parent_hierarchy():
+    c = lat_lng_to_cell(np.array([37.77]), np.array([-122.42]), 14)
+    p = parent(c, 10)
+    assert (cell_res(p) == 10).all()
+    # the parent's bounds contain the child's
+    cls, cln, clw, cle = cell_bounds(c)
+    pls, pln, plw, ple = cell_bounds(p)
+    assert pls <= cls and pln >= cln and plw <= clw and ple >= cle
+
+
+def test_cover_circle_exact_split():
+    rng = np.random.default_rng(0)
+    r = 3000.0
+    cover = cover_circle(LAT0, LNG0, r, 14)
+    assert cover is not None
+    full, bnd = cover
+    lat, lng = _points(rng, 3000)
+    d = haversine_m(lat, lng, LAT0, LNG0)
+    c = lat_lng_to_cell(lat, lng, 14)
+    covered = np.isin(c, np.concatenate([full, bnd]))
+    assert covered[d <= r].all()          # no in-radius point escapes
+    infull = np.isin(c, full)
+    assert (d[infull] <= r + 1e-6).all()  # full cells entirely inside
+
+
+def test_cover_polygon_exact_split():
+    rng = np.random.default_rng(1)
+    poly = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+    cover = cover_polygon(poly.coords, 8)
+    assert cover is not None
+    full, bnd = cover
+    py = rng.uniform(-2, 12, 3000)
+    px = rng.uniform(-2, 12, 3000)
+    inside = (px > 0) & (px < 10) & (py > 0) & (py < 10)
+    c = lat_lng_to_cell(py, px, 8)
+    covered = np.isin(c, np.concatenate([full, bnd]))
+    assert covered[inside].all()
+    infull = np.isin(c, full)
+    assert inside[infull].all()
+
+
+def test_cover_cap_returns_none():
+    assert cover_circle(0.0, 0.0, 5_000_000.0, 20, cap=1024) is None
+
+
+# ---------------------------------------------------------------------------
+# geometry codecs + predicates
+# ---------------------------------------------------------------------------
+
+def test_wkt_wkb_roundtrip():
+    for wkt in ("POINT (-122.42 37.77)",
+                "LINESTRING (0 0, 1 1, 2 0)",
+                "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))",
+                "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), "
+                "(4 4, 6 4, 6 6, 4 6, 4 4))"):
+        g = parse_wkt(wkt)
+        assert parse_wkb(to_wkb(g)) == g
+        assert parse_wkt(to_wkt(g)) == g
+    gg = parse_wkt("POINT (1 2)", geography=True)
+    assert parse_wkb(to_wkb(gg)).geography
+
+
+def test_distance_modes():
+    # geometry: Cartesian units
+    assert distance(Geometry.point(0, 0), Geometry.point(3, 4)) == 5.0
+    # geography: meters (1 deg lng at 37.77N ~ 88km)
+    a = Geometry.point(-122.42, 37.77, True)
+    b = Geometry.point(-122.41, 37.77, True)
+    assert 800 < distance(a, b) < 950
+
+
+def test_contains_with_hole():
+    g = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), "
+                  "(4 4, 6 4, 6 6, 4 6, 4 4))")
+    assert contains(g, Geometry.point(2, 2))
+    assert not contains(g, Geometry.point(5, 5))   # inside the hole
+    assert not contains(g, Geometry.point(15, 5))
+
+
+def test_area():
+    g = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))")
+    assert abs(area(g) - 100.0) < 1e-9
+    gg = parse_wkt("POLYGON ((0 0, 0.01 0, 0.01 0.01, 0 0.01, 0 0))",
+                   geography=True)
+    # ~1.11km x 1.11km at the equator
+    assert 1.1e6 < area(gg) < 1.3e6
+
+
+# ---------------------------------------------------------------------------
+# ST_* scalar functions
+# ---------------------------------------------------------------------------
+
+def test_st_function_registry():
+    p = call("stpoint", np.array([-122.42]), np.array([37.77]),
+             np.array([1]))
+    assert call("stastext", p)[0] == "POINT (-122.42 37.77)"
+    assert call("stgeometrytype", p)[0] == "Point"
+    t = call("stgeogfromtext",
+             np.array(["POINT (-122.41 37.77)"], dtype=object))
+    d = call("stdistance", p, t)
+    assert 800 < d[0] < 950
+    poly = call("stgeomfromtext", np.array(
+        ["POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))"], dtype=object))
+    inside = call("stpoint", np.array([5.0]), np.array([5.0]))
+    outside = call("stpoint", np.array([15.0]), np.array([5.0]))
+    assert call("stcontains", poly, inside)[0] == 1
+    assert call("stcontains", poly, outside)[0] == 0
+    assert call("stwithin", inside, poly)[0] == 1
+    assert call("stequals", inside, inside)[0] == 1
+    assert call("stequals", inside, outside)[0] == 0
+    assert call("starea", poly)[0] == pytest.approx(100.0)
+    wkb_hex = call("stasbinary", inside)
+    assert call("stgeomfromwkb", wkb_hex)[0] == wkb_hex[0]
+    c2 = call("geotoh3", p, np.array([12]))
+    assert c2.dtype == np.int64
+
+
+# ---------------------------------------------------------------------------
+# geo index: build/reader
+# ---------------------------------------------------------------------------
+
+def test_geo_index_distance_mask_oracle(indexed, geo_data):
+    seg, _ = indexed
+    rd = seg.index_reader("location", "geo")
+    assert rd is not None and rd.resolution == 13
+    q = Geometry.point(LNG0, LAT0, True)
+    d = haversine_m(geo_data["lat"], geo_data["lng"], LAT0, LNG0)
+    for op, cmp in (("<", np.less), ("<=", np.less_equal),
+                    (">", np.greater), (">=", np.greater_equal)):
+        mask = rd.distance_mask(q, 4000.0, op, seg.n_docs)
+        with np.errstate(invalid="ignore"):
+            expect = cmp(d, 4000.0)
+        expect[np.isnan(d)] = False
+        np.testing.assert_array_equal(mask, expect, err_msg=op)
+
+
+def test_geo_index_inclusion_mask_oracle(indexed, geo_data):
+    seg, _ = indexed
+    rd = seg.index_reader("location", "geo")
+    poly = parse_wkt(
+        f"POLYGON (({LNG0 - 0.05} {LAT0 - 0.05}, {LNG0 + 0.02} "
+        f"{LAT0 - 0.05}, {LNG0 + 0.02} {LAT0 + 0.03}, {LNG0 - 0.05} "
+        f"{LAT0 + 0.03}, {LNG0 - 0.05} {LAT0 - 0.05}))")
+    mask = rd.inclusion_mask(poly, seg.n_docs)
+    from pinot_tpu.geo.geometry import points_in_polygon
+    valid = ~np.isnan(geo_data["lat"])
+    expect = np.zeros(seg.n_docs, dtype=bool)
+    expect[valid] = points_in_polygon(geo_data["lng"][valid],
+                                      geo_data["lat"][valid], poly)
+    np.testing.assert_array_equal(mask, expect)
+
+
+# ---------------------------------------------------------------------------
+# SQL: kernel docmask path (indexed) vs host path (unindexed), same answers
+# ---------------------------------------------------------------------------
+
+_DIST_SQL = ("SELECT COUNT(*), SUM(value) FROM places WHERE "
+             f"ST_DISTANCE(location, ST_POINT({LNG0}, {LAT0}, 1)) < 4000")
+_POLY = (f"POLYGON (({LNG0 - 0.05} {LAT0 - 0.05}, {LNG0 + 0.02} "
+         f"{LAT0 - 0.05}, {LNG0 + 0.02} {LAT0 + 0.03}, {LNG0 - 0.05} "
+         f"{LAT0 + 0.03}, {LNG0 - 0.05} {LAT0 - 0.05}))")
+_INCL_SQL = ("SELECT COUNT(*) FROM places WHERE "
+             f"ST_CONTAINS(ST_GEOM_FROM_TEXT('{_POLY}'), location) = 1")
+
+
+def _oracle_count(geo_data, radius):
+    d = haversine_m(geo_data["lat"], geo_data["lng"], LAT0, LNG0)
+    with np.errstate(invalid="ignore"):
+        m = d < radius
+    m[np.isnan(d)] = False
+    return m
+
+
+def test_sql_distance_indexed_kernel_path(indexed, geo_data):
+    seg, b = indexed
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.planner import SegmentPlanner
+    from pinot_tpu.query.sql import parse_sql
+    plan = SegmentPlanner(build_query_context(parse_sql(_DIST_SQL)),
+                          seg).plan()
+    assert plan.kind == "kernel"   # geo index answers via docmask param
+    res = b.query(_DIST_SQL)
+    m = _oracle_count(geo_data, 4000.0)
+    assert res.rows[0][0] == int(m.sum())
+    assert res.rows[0][1] == int(geo_data["value"][m].sum())
+
+
+def test_sql_distance_unindexed_host_path(unindexed, geo_data):
+    _, b = unindexed
+    res = b.query(_DIST_SQL)
+    m = _oracle_count(geo_data, 4000.0)
+    assert res.rows[0][0] == int(m.sum())
+    assert res.rows[0][1] == int(geo_data["value"][m].sum())
+
+
+def test_sql_inclusion_indexed_matches_unindexed(indexed, unindexed):
+    _, bi = indexed
+    _, bu = unindexed
+    ri = bi.query(_INCL_SQL)
+    ru = bu.query(_INCL_SQL)
+    assert ri.rows[0][0] == ru.rows[0][0] > 0
+
+
+def test_sql_distance_complement_ops_match(indexed, unindexed, geo_data):
+    sql = ("SELECT COUNT(*) FROM places WHERE "
+           f"ST_DISTANCE(location, ST_POINT({LNG0}, {LAT0}, 1)) >= 4000")
+    _, bi = indexed
+    _, bu = unindexed
+    ci = bi.query(sql).rows[0][0]
+    cu = bu.query(sql).rows[0][0]
+    d = haversine_m(geo_data["lat"], geo_data["lng"], LAT0, LNG0)
+    with np.errstate(invalid="ignore"):
+        m = d >= 4000.0
+    m[np.isnan(d)] = False
+    assert ci == int(m.sum())
+    # host path evaluates the scalar over every row; NaN >= r is False
+    # there too, so both paths agree
+    assert cu == ci
+
+
+def test_geo_index_rejects_polygon_rows(tmp_path):
+    schema = Schema("bad", [
+        FieldSpec("g", DataType.BYTES, FieldType.DIMENSION),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    cfg = TableConfig("bad", indexing=IndexingConfig(
+        geo_index_columns={"g": {}}))
+    poly = to_wkb(parse_wkt("POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))")).hex()
+    data = {"g": np.asarray([poly] * 4, dtype=object),
+            "v": np.arange(4, dtype=np.int64)}
+    with pytest.raises(Exception, match="POINT"):
+        SegmentBuilder(schema, cfg).build(data, str(tmp_path), "seg_0")
+
+
+def test_geo_config_roundtrip():
+    cfg = TableConfig("t", indexing=IndexingConfig(
+        geo_index_columns={"loc": {"resolution": 12}}))
+    back = TableConfig.from_dict(cfg.to_dict())
+    assert back.indexing.geo_index_columns == {"loc": {"resolution": 12}}
+    assert back.indexing.indexes_for("loc") == ["geo"]
+
+
+# ---------------------------------------------------------------------------
+# review regressions: geography inference, null-robust build, negated
+# containment consistency between index and host paths
+# ---------------------------------------------------------------------------
+
+def test_index_uses_column_geography_for_plain_literals(tmp_path):
+    # geography column + plain-WKT (non-geography) query literal: the
+    # index must still measure meters, like the row-wise host evaluation
+    schema = Schema("gg", [
+        FieldSpec("loc", DataType.BYTES, FieldType.DIMENSION),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    cfg = TableConfig("gg", indexing=IndexingConfig(
+        geo_index_columns={"loc": {}}))
+    pts = [to_wkb(Geometry.point(0.0, 0.0, True)).hex(),
+           to_wkb(Geometry.point(1.0, 0.0, True)).hex()]
+    data = {"loc": np.asarray(pts, dtype=object),
+            "v": np.arange(2, dtype=np.int64)}
+    seg = ImmutableSegment.load(
+        SegmentBuilder(schema, cfg).build(data, str(tmp_path), "s0"))
+    rd = seg.index_reader("loc", "geo")
+    # 50km: in meters only the origin matches; planar would match both
+    m = rd.distance_mask("POINT (0 0)", 50000.0, "<", 2)
+    np.testing.assert_array_equal(m, [True, False])
+
+
+def test_geo_build_tolerates_empty_bytes_and_blank(tmp_path):
+    schema = Schema("nb", [
+        FieldSpec("loc", DataType.BYTES, FieldType.DIMENSION),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    cfg = TableConfig("nb", indexing=IndexingConfig(
+        geo_index_columns={"loc": {}}))
+    vals = np.asarray([to_wkb(Geometry.point(1, 2, True)).hex(),
+                       "", "  ", "zz-not-hex"], dtype=object)
+    data = {"loc": vals, "v": np.arange(4, dtype=np.int64)}
+    seg = ImmutableSegment.load(
+        SegmentBuilder(schema, cfg).build(data, str(tmp_path), "s0"))
+    rd = seg.index_reader("loc", "geo")
+    np.testing.assert_array_equal(rd.valid_mask(4),
+                                  [True, False, False, False])
+
+
+def test_negated_containment_index_matches_host(indexed, unindexed):
+    sql = ("SELECT COUNT(*) FROM places WHERE "
+           f"ST_CONTAINS(ST_GEOM_FROM_TEXT('{_POLY}'), location) = 0")
+    _, bi = indexed
+    _, bu = unindexed
+    # null rows evaluate ST_CONTAINS to 0 and match "= 0" on both paths
+    assert bi.query(sql).rows[0][0] == bu.query(sql).rows[0][0]
+
+
+def test_group_by_aggregate_ordinal_rejected():
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.sql import parse_sql, SqlError
+    with pytest.raises(SqlError, match="GROUP BY"):
+        build_query_context(parse_sql(
+            "SELECT a, SUM(b) FROM t GROUP BY 1, 2"))

@@ -96,3 +96,16 @@ def test_errors():
 def test_options():
     s = parse_sql("SELECT a FROM t LIMIT 1 OPTION(timeoutMs=100)")
     assert s.options["timeoutMs"] == 100
+
+
+def test_ordinal_group_and_order_resolution():
+    # GROUP BY 1 / ORDER BY 2 name select items (Calcite ordinal scopes)
+    from pinot_tpu.query.context import build_query_context
+    ctx = build_query_context(parse_sql(
+        "SELECT a, SUM(b) FROM t GROUP BY 1 ORDER BY 2 DESC"))
+    assert ctx.group_by and ctx.group_by[0].name == "a"
+    o = ctx.order_by[0]
+    assert not o.ascending and getattr(o.expr, "name", None) == "sum"
+    # out-of-range ordinals stay literal (match reference leniency)
+    ctx2 = build_query_context(parse_sql("SELECT a FROM t ORDER BY 7"))
+    assert ctx2.order_by[0].expr.value == 7
