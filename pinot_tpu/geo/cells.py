@@ -100,6 +100,9 @@ def cell_bounds(cell) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
 def parent(cell, res: int) -> np.ndarray:
     """Ancestor of each cell at coarser resolution ``res``."""
     c = np.asarray(cell, dtype=np.int64)
+    if (cell_res(c) < res).any():
+        raise ValueError(f"parent resolution {res} is finer than the "
+                         "cell's own resolution")
     shift = (cell_res(c) - res) * 2
     code = (c & ((np.int64(1) << 58) - 1)) >> shift
     return (np.int64(res) << 58) | code
@@ -229,13 +232,14 @@ def _segments_intersect_rect(ax, ay, bx, by, x0, x1, y0, y1) -> np.ndarray:
 
 
 def cover_polygon(shell: np.ndarray, res: int, cap: int = 1 << 14,
-                  point_in_fn=None
+                  point_in_fn=None, holes=()
                   ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Cells covering a polygon shell ((k, 2) lng/lat) -> (full, boundary).
+    """Cells covering a polygon -> (full, boundary). ``shell``/``holes``
+    are (k, 2) lng/lat rings.
 
-    A cell with no shell edge crossing it is uniformly inside or outside
-    (test its center); a crossed cell is boundary. Mirrors
-    H3Utils.coverGeometry's fullCover/partialCover split.
+    A cell crossed by NO boundary edge (shell or hole) is uniformly
+    inside or outside (test its center); a crossed cell is boundary.
+    Mirrors H3Utils.coverGeometry's fullCover/partialCover split.
     """
     lngs, lats = shell[:, 0], shell[:, 1]
     xy = _grid_cells(float(lats.min()), float(lats.max()),
@@ -244,8 +248,11 @@ def cover_polygon(shell: np.ndarray, res: int, cap: int = 1 << 14,
         return None
     cells = _xy_to_cell(xy[0], xy[1], res)
     lat_s, lat_n, lng_w, lng_e = cell_bounds(cells)
-    ax, ay = lngs[:-1], lats[:-1]
-    bx, by = lngs[1:], lats[1:]
+    rings = [shell] + list(holes)
+    ax = np.concatenate([r[:-1, 0] for r in rings])
+    ay = np.concatenate([r[:-1, 1] for r in rings])
+    bx = np.concatenate([r[1:, 0] for r in rings])
+    by = np.concatenate([r[1:, 1] for r in rings])
     crossed = _segments_intersect_rect(ax, ay, bx, by,
                                        lng_w, lng_e, lat_s, lat_n)
     cx = (lng_w + lng_e) / 2.0

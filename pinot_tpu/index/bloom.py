@@ -45,8 +45,9 @@ def build(col: str, seg_dir: str, *, values: np.ndarray,
 class BloomFilterReader:
     def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
         self.m_bits = int(meta["mBits"])
-        packed = np.fromfile(os.path.join(seg_dir, col + SUFFIX),
-                             dtype=np.uint8)
+        from ..segment import segdir
+        packed = np.asarray(segdir.read_array(seg_dir, col + SUFFIX,
+                                              np.uint8, mmap=False))
         self.bits = np.unpackbits(packed)[: self.m_bits].astype(bool)
 
     def might_contain(self, value: Any) -> bool:

@@ -25,13 +25,15 @@ def write_csr(path_prefix: str, postings: Sequence[np.ndarray]) -> None:
 
 
 class CsrPostings:
-    """Memmapped CSR posting lists."""
+    """Memmapped CSR posting lists (v1 loose files or v3 packed slices
+    via segment.segdir)."""
 
-    def __init__(self, path_prefix: str):
-        self.docs = np.memmap(path_prefix + ".docs.bin", dtype=np.int32,
-                              mode="r") if os.path.getsize(
-            path_prefix + ".docs.bin") else np.zeros(0, dtype=np.int32)
-        self.offsets = np.fromfile(path_prefix + ".off.bin", dtype=np.int64)
+    def __init__(self, seg_dir: str, prefix: str):
+        from ..segment import segdir
+        self.docs = segdir.read_array(seg_dir, prefix + ".docs.bin",
+                                      np.int32)
+        self.offsets = np.asarray(segdir.read_array(
+            seg_dir, prefix + ".off.bin", np.int64, mmap=False))
 
     @property
     def n_keys(self) -> int:

@@ -78,14 +78,14 @@ class GeoIndexReader:
     def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
         self.resolution = int(meta["resolution"])
         self.geography = bool(meta.get("geography", True))
-        self.pts = np.memmap(os.path.join(seg_dir, col + PTS_SUFFIX),
-                             dtype=np.float64, mode="r").reshape(-1, 2)
-        self.cells = np.fromfile(
-            os.path.join(seg_dir, col + CELLS_SUFFIX), dtype=np.int64)
-        self.offs = np.fromfile(
-            os.path.join(seg_dir, col + OFFS_SUFFIX), dtype=np.int64)
-        self.docs = np.memmap(os.path.join(seg_dir, col + DOCS_SUFFIX),
-                              dtype=np.int32, mode="r")
+        from ..segment import segdir
+        self.pts = segdir.read_array(seg_dir, col + PTS_SUFFIX,
+                                     np.float64).reshape(-1, 2)
+        self.cells = np.asarray(segdir.read_array(
+            seg_dir, col + CELLS_SUFFIX, np.int64, mmap=False))
+        self.offs = np.asarray(segdir.read_array(
+            seg_dir, col + OFFS_SUFFIX, np.int64, mmap=False))
+        self.docs = segdir.read_array(seg_dir, col + DOCS_SUFFIX, np.int32)
 
     # -- postings -----------------------------------------------------
     def _docs_for_cells(self, wanted: np.ndarray) -> np.ndarray:
@@ -150,7 +150,8 @@ class GeoIndexReader:
         cover = _cells.cover_polygon(
             g.coords, self.resolution, cap=MAX_COVER_CELLS,
             point_in_fn=(lambda px, py:
-                         _geometry.points_in_polygon(px, py, g)))
+                         _geometry.points_in_polygon(px, py, g)),
+            holes=g.holes)
         if cover is not None:
             full, boundary = cover
             mask[self._docs_for_cells(full)] = True

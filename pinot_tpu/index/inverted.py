@@ -29,7 +29,7 @@ def build(col: str, seg_dir: str, *, ids: np.ndarray, cardinality: int,
 
 class InvertedIndexReader:
     def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
-        self.postings = CsrPostings(os.path.join(seg_dir, col + SUFFIX))
+        self.postings = CsrPostings(seg_dir, col + SUFFIX)
 
     def docs_for(self, dict_id: int) -> np.ndarray:
         return self.postings.docs_for(dict_id)

@@ -138,9 +138,9 @@ class _FilterParser:
 
 class JsonIndexReader:
     def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
-        self.postings = CsrPostings(os.path.join(seg_dir, col + SUFFIX))
-        with open(os.path.join(seg_dir, col + SUFFIX + ".keys.json")) as fh:
-            keys = json.load(fh)
+        self.postings = CsrPostings(seg_dir, col + SUFFIX)
+        from ..segment import segdir
+        keys = segdir.read_json(seg_dir, col + SUFFIX + ".keys.json")
         self.keys = {k: i for i, k in enumerate(keys)}
 
     def _mask_for_key(self, key: str, n_docs: int) -> np.ndarray:

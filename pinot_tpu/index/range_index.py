@@ -42,8 +42,11 @@ class RangeIndexReader:
     def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
         dt = np.dtype(meta.get("dtype", "int64"))
         self.chunk = int(meta.get("chunk", CHUNK))
-        self.mins = np.fromfile(os.path.join(seg_dir, col + MIN_SUFFIX), dt)
-        self.maxs = np.fromfile(os.path.join(seg_dir, col + MAX_SUFFIX), dt)
+        from ..segment import segdir
+        self.mins = np.asarray(segdir.read_array(seg_dir, col + MIN_SUFFIX,
+                                                 dt, mmap=False))
+        self.maxs = np.asarray(segdir.read_array(seg_dir, col + MAX_SUFFIX,
+                                                 dt, mmap=False))
 
     def candidate_chunks(self, lo, hi) -> np.ndarray:
         """Bool per chunk: may contain a value in [lo, hi] (inclusive;

@@ -121,21 +121,22 @@ class _QueryParser:
 
 class TextIndexReader:
     def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
-        self.postings = CsrPostings(os.path.join(seg_dir, col + SUFFIX))
-        with open(os.path.join(seg_dir, col + SUFFIX + ".vocab.json")) as fh:
-            self.terms = json.load(fh)  # sorted: the FST-analog ordering
+        self.postings = CsrPostings(seg_dir, col + SUFFIX)
+        from ..segment import segdir
+        # sorted: the FST-analog ordering
+        self.terms = segdir.read_json(seg_dir, col + SUFFIX + ".vocab.json")
         self.vocab = {t: i for i, t in enumerate(self.terms)}
         self.max_pos = int(meta.get("maxPos", 0) or 0)
-        pos_path = os.path.join(seg_dir, col + SUFFIX + ".pos.bin")
-        if os.path.exists(pos_path):  # older segments: no positions
+        if segdir.exists(seg_dir, col + SUFFIX + ".pos.bin"):
             # memmap like the CSR postings — the occurrence file is the
             # biggest text artifact and phrase queries may never come
-            raw = np.memmap(pos_path, dtype=np.int32, mode="r")
+            # (older segments have no positions at all)
+            raw = segdir.read_array(seg_dir, col + SUFFIX + ".pos.bin",
+                                    np.int32)
             half = len(raw) // 2
             self._occ_doc, self._occ_pos = raw[:half], raw[half:]
-            self._occ_off = np.memmap(
-                os.path.join(seg_dir, col + SUFFIX + ".pos.off.bin"),
-                dtype=np.int64, mode="r")
+            self._occ_off = segdir.read_array(
+                seg_dir, col + SUFFIX + ".pos.off.bin", np.int64)
         else:
             self._occ_doc = None
 

@@ -39,8 +39,8 @@ class VectorIndexReader:
     def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
         self.dim = int(meta["dim"])
         self.metric = meta.get("metric", "cosine")
-        raw = np.memmap(os.path.join(seg_dir, col + SUFFIX),
-                        dtype=np.float32, mode="r")
+        from ..segment import segdir
+        raw = segdir.read_array(seg_dir, col + SUFFIX, np.float32)
         self.matrix = raw.reshape(-1, self.dim)
         self._device = None
 

@@ -30,16 +30,6 @@ from .functions import register
 from .sql import SqlError
 
 
-def _scalar(v):
-    """Unwrap a 0-d/1-element array to a python scalar, else None."""
-    a = np.asarray(v)
-    if a.ndim == 0:
-        return a.item()
-    if a.ndim == 1 and a.shape[0] == 1:
-        return a[0]
-    return None
-
-
 def _to_geoms(v, geography: Optional[bool] = None) -> List[Geometry]:
     a = np.atleast_1d(np.asarray(v, dtype=object))
     return [_geom.coerce(x, geography) for x in a.ravel()]
@@ -124,7 +114,7 @@ def _st_distance(a, b):
                        for x, y in zip(ga, gb)], dtype=np.float64)
 
 
-def _containment(outer, inner, mode: str) -> np.ndarray:
+def _containment(outer, inner) -> np.ndarray:
     go = _to_geoms(outer)
     gi = _to_geoms(inner)
     n = max(len(go), len(gi))
@@ -144,8 +134,8 @@ def _containment(outer, inner, mode: str) -> np.ndarray:
 
 
 # ST_Contains(a, b): a contains b.  ST_Within(a, b): a within b.
-register("stcontains", 2)(lambda a, b: _containment(a, b, "contains"))
-register("stwithin", 2)(lambda a, b: _containment(b, a, "within"))
+register("stcontains", 2)(lambda a, b: _containment(a, b))
+register("stwithin", 2)(lambda a, b: _containment(b, a))
 
 
 @register("stequals", 2)

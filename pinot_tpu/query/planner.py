@@ -308,22 +308,13 @@ class SegmentPlanner:
             # evaluates host-side into a doc mask shipped as a kernel param
             # (SqlError propagates when the index is missing — user error,
             # not host fallback)
-            mask = index_filter_mask(self.seg, e)
-            if not mask.any():
-                return FalseP()
-            if mask.all():
-                return TrueP()
-            return MaskParamP(self.b.add_param(("docmask", mask)))
+            return self._mask_pred(index_filter_mask(self.seg, e))
         from ..index.predicates import try_geo_inclusion_mask
         gmask = try_geo_inclusion_mask(self.seg, e) \
             if isinstance(e, FuncCall) else None
         if gmask is not None:
             # bare boolean ST_Contains/ST_Within over an indexed column
-            if not gmask.any():
-                return FalseP()
-            if gmask.all():
-                return TrueP()
-            return MaskParamP(self.b.add_param(("docmask", gmask)))
+            return self._mask_pred(gmask)
         raise PlanError(f"unsupported filter expression {e!r}")
 
     def _comparison(self, e: Comparison) -> Pred:
@@ -381,11 +372,17 @@ class SegmentPlanner:
                                         try_geo_inclusion_mask)
         mask = try_geo_distance_mask(self.seg, lhs, op, rhs)
         if mask is None and isinstance(rhs, Literal) and op in ("==", "!=") \
-                and isinstance(rhs.value, (bool, int)):
+                and isinstance(rhs.value, (bool, int)) \
+                and rhs.value in (0, 1, True, False):
             positive = bool(rhs.value) == (op == "==")
             mask = try_geo_inclusion_mask(self.seg, lhs, positive=positive)
         if mask is None:
             return None
+        return self._mask_pred(mask)
+
+    def _mask_pred(self, mask) -> Pred:
+        """Host-computed doc mask -> constant-folded pred or docmask
+        kernel param (shared by index, geo, and bare-boolean filters)."""
         if not mask.any():
             return FalseP()
         if mask.all():

@@ -27,7 +27,7 @@ from ..spi.config import TableConfig
 from ..spi.schema import DataType, FieldType, Schema
 from .dictionary import Dictionary, min_id_dtype
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = "v1"
 METADATA_FILE = "metadata.json"
 
 
@@ -245,6 +245,9 @@ class SegmentBuilder:
 
         with open(os.path.join(seg_dir, METADATA_FILE), "w") as fh:
             json.dump(meta, fh, indent=1, default=_json_default)
+        if self.table_config.segments.format_version == "v3":
+            from . import segdir
+            segdir.convert_to_v3(seg_dir)
         return seg_dir
 
     def _build_mv_column(self, f, arr: np.ndarray, seg_dir: str,

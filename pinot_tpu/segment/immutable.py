@@ -20,8 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..spi.schema import DataType, Schema
-from .builder import (METADATA_FILE, _dict_bin_path, _dict_json_path,
-                      _fwd_path, _null_path)
+from . import segdir
+from .builder import METADATA_FILE
 from .dictionary import Dictionary
 
 MIN_BUCKET = 1 << 10
@@ -87,14 +87,21 @@ class ImmutableSegment:
         # device-resident copy invalidates on update
         self.valid_docs: Optional[np.ndarray] = None
         self.valid_docs_version = 0
-        valid_path = os.path.join(seg_dir, "valid.bin")
-        if os.path.exists(valid_path):
-            bits = np.fromfile(valid_path, dtype=np.uint8)
+        if segdir.exists(seg_dir, "valid.bin"):
+            bits = np.asarray(segdir.read_array(seg_dir, "valid.bin",
+                                                np.uint8, mmap=False))
             self.valid_docs = np.unpackbits(bits)[: self.n_docs].astype(bool)
 
     @classmethod
     def load(cls, seg_dir: str, read_mode: str = "mmap") -> "ImmutableSegment":
         return cls(seg_dir, read_mode)
+
+    @property
+    def format_version(self) -> str:
+        """SegmentVersion lineage: "v1" (file per index) or "v3" (single
+        packed columns.psf); numeric 1 is the pre-versioning spelling."""
+        v = self.metadata.get("formatVersion", "v1")
+        return "v1" if v in (1, "1", "v1") else str(v)
 
     # -- host access -------------------------------------------------------
     def fwd(self, col: str) -> np.ndarray:
@@ -104,26 +111,24 @@ class ImmutableSegment:
         through the native runtime (pinot_tpu.native) and cache."""
         if col not in self._fwd:
             m = self.columns[col]
-            path = _fwd_path(self.dir, col)
+            name = f"{col}.fwd.bin"
             if m.fwd_format == "BITPACK":
                 from .. import native
-                buf = np.fromfile(path, dtype=np.uint8)
+                buf = np.ascontiguousarray(segdir.read_array(
+                    self.dir, name, np.uint8))
                 arr = native.fixedbit_unpack(buf, self.n_docs, m.bits)
             elif m.fwd_format == "COMPRESSED":
                 from .. import native
-                comp = np.fromfile(path, dtype=np.uint8)
+                comp = np.ascontiguousarray(segdir.read_array(
+                    self.dir, name, np.uint8))
                 raw = native.decompress(comp, m.raw_size, m.codec)
                 arr = raw.view(m.fwd_dtype)[: self.n_docs]
-            elif self._read_mode == "mmap":
+            else:
                 shape = ((self.n_docs,) if m.single_value
                          else (self.n_docs, m.max_values))
-                arr = np.memmap(path, dtype=m.fwd_dtype, mode="r",
-                                shape=shape)
-            else:
-                count = self.n_docs * (1 if m.single_value else m.max_values)
-                arr = np.fromfile(path, dtype=m.fwd_dtype, count=count)
-                if not m.single_value:
-                    arr = arr.reshape(self.n_docs, m.max_values)
+                arr = segdir.read_array(self.dir, name, m.fwd_dtype,
+                                        shape=shape,
+                                        mmap=self._read_mode == "mmap")
             self._fwd[col] = arr
         return self._fwd[col]
 
@@ -133,12 +138,12 @@ class ImmutableSegment:
             return None
         if col not in self._dicts:
             if m.dict_format == "json":
-                with open(_dict_json_path(self.dir, col)) as fh:
-                    vals = json.load(fh)
+                vals = segdir.read_json(self.dir, f"{col}.dict.json")
                 self._dicts[col] = Dictionary(vals, m.data_type)
             else:
-                vals = np.fromfile(_dict_bin_path(self.dir, col),
-                                   dtype=np.dtype(m.dict_dtype))
+                vals = np.asarray(segdir.read_array(
+                    self.dir, f"{col}.dict.bin", np.dtype(m.dict_dtype),
+                    mmap=False))
                 self._dicts[col] = Dictionary(vals, m.data_type)
         return self._dicts[col]
 
@@ -147,7 +152,8 @@ class ImmutableSegment:
         if not m.has_nulls:
             return None
         if col not in self._nulls:
-            bits = np.fromfile(_null_path(self.dir, col), dtype=np.uint8)
+            bits = np.asarray(segdir.read_array(
+                self.dir, f"{col}.null.bin", np.uint8, mmap=False))
             self._nulls[col] = np.unpackbits(bits)[: self.n_docs].astype(bool)
         return self._nulls[col]
 

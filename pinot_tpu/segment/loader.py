@@ -21,6 +21,7 @@ import numpy as np
 
 from ..spi.config import TableConfig
 from .builder import METADATA_FILE
+from . import segdir
 from .immutable import ImmutableSegment
 
 
@@ -87,6 +88,10 @@ def reconcile_indexes(seg_dir: str, table_config: TableConfig
     for name, _cmeta, _a, to_remove in plan:
         for kind in to_remove:
             _remove_index_files(seg_dir, name, kind)
+    # v3 segments: absorb freshly built loose index files into the packed
+    # file (the reference's v3 SegmentDirectory.Writer appends the same
+    # way; removal above only dropped map entries, bytes repack later)
+    segdir.fold_new_files(seg_dir)
     return {"added": added, "removed": removed}
 
 
@@ -94,6 +99,10 @@ def _remove_index_files(seg_dir: str, col: str, kind: str) -> None:
     from ..index.registry import FILE_STEMS  # module-owned suffixes
     for suffix in FILE_STEMS.get(kind, (f".{kind}",)):
         stem = col + suffix
-        for fn in os.listdir(seg_dir):
-            if fn == stem or fn.startswith(stem + "."):
-                os.remove(os.path.join(seg_dir, fn))
+        doomed = [fn for fn in segdir.entry_names(seg_dir)
+                  if fn == stem or fn.startswith(stem + ".")]
+        segdir.remove_entries(seg_dir, doomed)
+        for fn in doomed:
+            path = os.path.join(seg_dir, fn)
+            if os.path.exists(path):
+                os.remove(path)

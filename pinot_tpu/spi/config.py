@@ -82,6 +82,9 @@ class SegmentsConfig:
     replication: int = 1
     # pad segments to pow2 buckets >= this floor to bound XLA recompiles
     min_bucket: int = 1 << 10
+    # on-disk layout: "v1" = file per column/index, "v3" = single packed
+    # columns.psf + index map (SegmentVersion analog; segment/segdir.py)
+    format_version: str = "v1"
 
 
 @dataclass
@@ -149,6 +152,7 @@ class TableConfig:
             "segments": {
                 "replication": self.segments.replication,
                 "minBucket": self.segments.min_bucket,
+                "formatVersion": self.segments.format_version,
             },
             "partitionColumn": self.partition_column,
             "numPartitions": self.num_partitions,
@@ -188,6 +192,7 @@ class TableConfig:
             segments=SegmentsConfig(
                 replication=seg.get("replication", 1),
                 min_bucket=seg.get("minBucket", 1 << 10),
+                format_version=seg.get("formatVersion", "v1"),
             ),
             partition_column=d.get("partitionColumn"),
             num_partitions=d.get("numPartitions", 1),

@@ -155,6 +155,19 @@ def cmd_rebalance(args) -> int:
     return 0
 
 
+def cmd_convert_format(args) -> int:
+    """SegmentFormatConverter analog: repack a segment dir between v1
+    (file per index) and v3 (single packed columns.psf)."""
+    from ..segment import segdir
+    if args.to == "v3":
+        segdir.convert_to_v3(args.segment_dir)
+    else:
+        segdir.convert_to_v1(args.segment_dir)
+    print(json.dumps({"segmentDir": args.segment_dir,
+                      "formatVersion": args.to}))
+    return 0
+
+
 def cmd_recommend(args) -> int:
     """Rule-based config advice from a schema + weighted query workload
     file (one `weight<TAB>sql` per line, or bare sql = weight 1)."""
@@ -241,6 +254,11 @@ def build_parser() -> argparse.ArgumentParser:
     rb.add_argument("--table", required=True)
     rb.add_argument("--dry-run", action="store_true")
     rb.set_defaults(fn=cmd_rebalance)
+
+    cf = sub.add_parser("ConvertSegmentFormat")
+    cf.add_argument("--segment-dir", required=True)
+    cf.add_argument("--to", choices=("v1", "v3"), default="v3")
+    cf.set_defaults(fn=cmd_convert_format)
 
     rc = sub.add_parser("RecommendConfig")
     rc.add_argument("--schema-file", required=True)

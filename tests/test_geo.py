@@ -388,3 +388,32 @@ def test_group_by_aggregate_ordinal_rejected():
     with pytest.raises(SqlError, match="GROUP BY"):
         build_query_context(parse_sql(
             "SELECT a, SUM(b) FROM t GROUP BY 1, 2"))
+
+
+def test_inclusion_index_respects_holes(tmp_path):
+    # a point inside a polygon HOLE must be excluded by the index path
+    # exactly as the host ray-cast excludes it (review regression)
+    schema = Schema("hh", [
+        FieldSpec("loc", DataType.BYTES, FieldType.DIMENSION),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    cfg = TableConfig("hh", indexing=IndexingConfig(
+        geo_index_columns={"loc": {"resolution": 10}}))
+    pts = [(4.1, 5.0), (2.0, 2.0), (5.0, 5.0), (8.0, 8.0)]
+    vals = np.asarray([to_wkb(Geometry.point(x, y)).hex()
+                       for x, y in pts], dtype=object)
+    data = {"loc": vals, "v": np.arange(4, dtype=np.int64)}
+    seg = ImmutableSegment.load(
+        SegmentBuilder(schema, cfg).build(data, str(tmp_path), "s0"))
+    rd = seg.index_reader("loc", "geo")
+    poly = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), "
+                     "(4 4, 6 4, 6 6, 4 6, 4 4))")
+    mask = rd.inclusion_mask(poly, 4)
+    from pinot_tpu.geo.geometry import points_in_polygon
+    px = np.array([p[0] for p in pts]); py = np.array([p[1] for p in pts])
+    np.testing.assert_array_equal(mask, points_in_polygon(px, py, poly))
+
+
+def test_parent_rejects_finer_resolution():
+    c = lat_lng_to_cell(np.array([10.0]), np.array([10.0]), 5)
+    with pytest.raises(ValueError, match="finer"):
+        parent(c, 7)
