@@ -259,7 +259,12 @@ def cover_polygon(shell: np.ndarray, res: int, cap: int = 1 << 14,
     cy = (lat_s + lat_n) / 2.0
     if point_in_fn is None:
         from .geometry import points_in_ring
-        point_in_fn = lambda px, py: points_in_ring(px, py, shell)  # noqa
+
+        def point_in_fn(px, py, _shell=shell, _holes=tuple(holes)):
+            m = points_in_ring(px, py, _shell)
+            for h in _holes:
+                m &= ~points_in_ring(px, py, h)
+            return m
     inside = point_in_fn(cx, cy)
     full = cells[~crossed & inside]
     boundary = cells[crossed]

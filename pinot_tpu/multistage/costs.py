@@ -1,0 +1,221 @@
+"""Cost model for the multi-stage engine: cardinality + selectivity
+estimation from segment metadata, join-output estimates, and greedy
+INNER-join reordering.
+
+Reference parity: the reference plans v2 queries through Calcite's
+cost-based optimizer (pinot-query-planner/.../QueryEnvironment.java wires
+HepPlanner programs; PinotJoinToDynamicBroadcastRule and friends pick
+physical join strategies; RelMdRowCount/RelMdSelectivity supply the
+estimates). The TPU-native engine has no Calcite, so this module supplies
+the same three decisions from segment metadata directly:
+
+1. scan cardinality  = sum(segment totalDocs) x predicate selectivity
+   (Calcite RelMdSelectivity defaults: eq -> 1/NDV, range -> span
+   fraction, unknown -> 0.25);
+2. join cardinality  = |L| x |R| / max(NDV(left key), NDV(right key))
+   (the classic System-R formula Calcite's RelMdRowCount uses);
+3. join ORDER: greedy smallest-intermediate-first over consecutive INNER
+   joins (LEFT joins are reorder barriers — preserved-row semantics pin
+   both their position and their probe side).
+
+Estimates only ever steer physical choices (order, build side,
+broadcast vs shuffle); correctness never depends on them.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..query.sql import (Between, BoolAnd, BoolNot, BoolOr, Comparison,
+                         Identifier, InList, IsNull, Like, Literal)
+
+DEFAULT_SEL = 0.25          # Calcite's RelMdUtil guess for opaque predicates
+EQ_DEFAULT_SEL = 0.15       # eq against an un-profiled column
+MIN_SEL = 1e-6
+
+
+class TableStats:
+    """Aggregated column statistics for one table's loaded segments."""
+
+    def __init__(self, total_docs: int,
+                 cols: Dict[str, Dict[str, Any]]):
+        self.total_docs = total_docs
+        self.cols = cols          # col -> {ndv, min, max}
+
+    @classmethod
+    def from_segments(cls, segments: Sequence[Any]) -> "TableStats":
+        total = 0
+        cols: Dict[str, Dict[str, Any]] = {}
+        for seg in segments:
+            total += seg.n_docs
+            for name, m in seg.columns.items():
+                c = cols.setdefault(name, {"ndv": 0, "min": None,
+                                           "max": None})
+                c["ndv"] += max(int(getattr(m, "cardinality", 0) or 0), 1)
+                for attr, pick in (("min", min), ("max", max)):
+                    v = getattr(m, attr, None)
+                    if v is None or isinstance(v, str):
+                        continue
+                    cur = c[attr]
+                    c[attr] = v if cur is None else pick(cur, v)
+        return cls(total, cols)
+
+    def ndv(self, col: str) -> Optional[int]:
+        c = self.cols.get(col)
+        if c is None or not c["ndv"]:
+            return None
+        # summing per-segment cardinalities over-counts shared values;
+        # cap at totalDocs (an NDV can never exceed the row count)
+        return min(c["ndv"], max(self.total_docs, 1))
+
+    def value_range(self, col: str) -> Optional[Tuple[float, float]]:
+        c = self.cols.get(col)
+        if c is None or c["min"] is None or c["max"] is None:
+            return None
+        return float(c["min"]), float(c["max"])
+
+
+def _col_of(e: Any) -> Optional[str]:
+    return e.name.split(".")[-1] if isinstance(e, Identifier) else None
+
+
+def selectivity(pred: Any, stats: TableStats) -> float:
+    """Fraction of rows a single-table predicate keeps (RelMdSelectivity
+    analog over segment metadata)."""
+    if pred is None:
+        return 1.0
+    if isinstance(pred, BoolAnd):
+        s = 1.0
+        for c in pred.children:
+            s *= selectivity(c, stats)
+        return max(s, MIN_SEL)
+    if isinstance(pred, BoolOr):
+        s = 1.0
+        for c in pred.children:
+            s *= 1.0 - selectivity(c, stats)
+        return max(1.0 - s, MIN_SEL)
+    if isinstance(pred, BoolNot):
+        return max(1.0 - selectivity(pred.child, stats), MIN_SEL)
+    if isinstance(pred, Comparison):
+        col = _col_of(pred.lhs) or _col_of(pred.rhs)
+        if col is None:
+            return DEFAULT_SEL
+        if pred.op == "==":
+            ndv = stats.ndv(col)
+            return max(1.0 / ndv, MIN_SEL) if ndv else EQ_DEFAULT_SEL
+        if pred.op == "!=":
+            ndv = stats.ndv(col)
+            return 1.0 - (1.0 / ndv if ndv else EQ_DEFAULT_SEL)
+        # range: fraction of the [min, max] span on the literal side
+        lit = pred.rhs if isinstance(pred.rhs, Literal) else (
+            pred.lhs if isinstance(pred.lhs, Literal) else None)
+        rng = stats.value_range(col)
+        if lit is None or rng is None or \
+                not isinstance(lit.value, (int, float)) or \
+                isinstance(lit.value, bool):
+            return DEFAULT_SEL
+        lo, hi = rng
+        if hi <= lo:
+            return DEFAULT_SEL
+        frac = (float(lit.value) - lo) / (hi - lo)
+        frac = min(max(frac, 0.0), 1.0)
+        op = pred.op
+        if isinstance(pred.lhs, Literal):   # lit <op> col: flip
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        return max(frac if op in ("<", "<=") else 1.0 - frac, MIN_SEL)
+    if isinstance(pred, Between):
+        col = _col_of(pred.expr)
+        rng = stats.value_range(col) if col else None
+        if rng and isinstance(pred.lo, Literal) and \
+                isinstance(pred.hi, Literal) and \
+                isinstance(pred.lo.value, (int, float)) and \
+                isinstance(pred.hi.value, (int, float)):
+            lo, hi = rng
+            if hi > lo:
+                frac = (min(float(pred.hi.value), hi)
+                        - max(float(pred.lo.value), lo)) / (hi - lo)
+                s = min(max(frac, MIN_SEL), 1.0)
+                return 1.0 - s if pred.negated else s
+        return DEFAULT_SEL
+    if isinstance(pred, InList):
+        col = _col_of(pred.expr)
+        ndv = stats.ndv(col) if col else None
+        k = len(pred.values)
+        s = min(k / ndv, 1.0) if ndv else min(k * EQ_DEFAULT_SEL, 0.5)
+        s = max(s, MIN_SEL)
+        return 1.0 - s if pred.negated else s
+    if isinstance(pred, Like):
+        return 0.05 if not pred.negated else 0.95
+    if isinstance(pred, IsNull):
+        return 0.1 if not pred.negated else 0.9
+    return DEFAULT_SEL
+
+
+def scan_cardinality(stats: TableStats, pred: Any) -> float:
+    return max(stats.total_docs * selectivity(pred, stats), 1.0)
+
+
+def join_cardinality(l_rows: float, r_rows: float,
+                     l_ndv: Optional[int], r_ndv: Optional[int]) -> float:
+    """|L x R| / max(NDV_l, NDV_r) — System-R / RelMdRowCount equi-join
+    estimate; missing NDVs degrade to max(|L|, |R|) (FK-join guess)."""
+    ndv = max(l_ndv or 0, r_ndv or 0)
+    if ndv <= 0:
+        return max(l_rows, r_rows)
+    return max(l_rows * r_rows / ndv, 1.0)
+
+
+def order_inner_joins(joins: List[Any], base_label: str,
+                      table_rows: Dict[str, float],
+                      key_ndv_fn, equi_fn) -> Tuple[List[Any], List[Dict]]:
+    """Greedy smallest-intermediate-first join order.
+
+    ``joins``: the SQL JoinClause list. Only maximal runs of INNER joins
+    reorder; LEFT joins are barriers (their probe side must contain every
+    previously joined table, and null-extension order is semantic).
+    ``equi_fn(join, joined_labels) -> bool`` tells whether the join's ON
+    has an equi condition against the already-joined set (a reorder
+    candidate must, or it would degenerate to a cross join).
+    Returns (new_join_order, per-step estimate trace).
+    """
+    trace: List[Dict] = []
+    out: List[Any] = []
+    joined: Set[str] = {base_label}
+    rows = table_rows.get(base_label, 1.0)
+    pending = list(joins)
+    while pending:
+        # the barrier prefix rule: any LEFT join must wait until every
+        # join textually before it has executed (its semantics depend on
+        # the accumulated left side), so only the INNER prefix of the
+        # remaining list competes
+        candidates = []
+        for i, j in enumerate(pending):
+            if j.join_type != "inner":
+                break
+            if equi_fn(j, joined):
+                candidates.append((i, j))
+        if not candidates:
+            # either the head is a LEFT join or no inner candidate
+            # connects yet: execute the head in textual order
+            i, j = 0, pending[0]
+            est = None
+        else:
+            best = None
+            for i, j in candidates:
+                r = table_rows.get(j.table.label, 1.0)
+                ndv_l, ndv_r = key_ndv_fn(j, joined)
+                est = join_cardinality(rows, r, ndv_l, ndv_r)
+                if best is None or est < best[0]:
+                    best = (est, i, j)
+            est, i, j = best
+        out.append(j)
+        pending.pop(i)
+        r = table_rows.get(j.table.label, 1.0)
+        ndv_l, ndv_r = key_ndv_fn(j, joined)
+        rows = join_cardinality(rows, r, ndv_l, ndv_r) \
+            if j.join_type == "inner" else max(rows, 1.0)
+        trace.append({"table": j.table.label, "rightRows": round(r),
+                      "estRows": round(rows)})
+        joined.add(j.table.label)
+    return out, trace

@@ -197,6 +197,45 @@ class MultiStageExecutor:
                              for c in cols}, name=label)
         return Relation.concat(blocks)
 
+    # -- cost-based planning (Calcite CBO analog; multistage/costs.py) -----
+    def plan_join_order(self, pushed: Dict[str, List[Any]]
+                        ) -> Tuple[List[Any], List[Dict]]:
+        """Reorder consecutive INNER joins greedily by estimated
+        intermediate cardinality; LEFT joins are barriers. Returns the
+        execution order plus the estimate trace (surfaced by EXPLAIN)."""
+        from .costs import TableStats, order_inner_joins, scan_cardinality
+        stats = {t.label: TableStats.from_segments(
+            self.broker.table(t.name).acquire_segments())
+            for t in self.tables}
+        table_rows = {lbl: scan_cardinality(stats[lbl],
+                                            _and(pushed.get(lbl, [])))
+                      for lbl in stats}
+        self._table_row_est = table_rows
+
+        def equi_ok(j, joined: Set[str]) -> bool:
+            labels = set()
+            for r in _refs(j.on):
+                try:
+                    labels.add(self.owner_of(r)[0])
+                except SqlError:
+                    return False
+            if not labels <= (joined | {j.table.label}):
+                return False
+            equi, _ = self._split_on(j.on, joined, j.table.label)
+            return bool(equi)
+
+        def key_ndv(j, joined: Set[str]):
+            equi, _ = self._split_on(j.on, joined, j.table.label)
+            if len(equi) != 1:
+                return None, None
+            (lref, rref) = equi[0]
+            ll, lc = lref.split(".", 1)
+            rl, rc = rref.split(".", 1)
+            return stats[ll].ndv(lc), stats[rl].ndv(rc)
+
+        return order_inner_joins(self.stmt.joins, self.tables[0].label,
+                                 table_rows, key_ndv, equi_ok)
+
     # -- joins -------------------------------------------------------------
     def _split_on(self, on: Any, left_labels: Set[str], right_label: str
                   ) -> Tuple[List[Tuple[str, str]], List[Any]]:
@@ -221,6 +260,12 @@ class MultiStageExecutor:
     def _join(self, left: Relation, right: Relation,
               lkeys: List[str], rkeys: List[str], how: str,
               query_id: str, stage: int) -> Relation:
+        if how == "inner" and left.n_rows < right.n_rows:
+            # cost-based build-side choice: hash_join builds its table on
+            # the second relation, so put the SMALLER side there (Calcite
+            # swaps join inputs the same way; LEFT joins pin their sides)
+            left, right = right, left
+            lkeys, rkeys = rkeys, lkeys
         if right.n_rows <= BROADCAST_THRESHOLD or how == "left":
             # broadcast join (small build side / preserved-row semantics)
             return hash_join(left, right, lkeys, rkeys, how)
@@ -263,7 +308,10 @@ class MultiStageExecutor:
         current = self.leaf_scan(base, needed[base.label],
                                  _and(pushed[base.label]))
         joined_labels = {base.label}
-        for si, j in enumerate(stmt.joins):
+        # stats collection only pays off when an order choice exists
+        ordered_joins = stmt.joins if len(stmt.joins) < 2 \
+            else self.plan_join_order(pushed)[0]
+        for si, j in enumerate(ordered_joins):
             label = j.table.label
             right = self.leaf_scan(j.table, needed[label],
                                    _and(pushed[label]))
@@ -373,16 +421,19 @@ def explain_multistage(broker, stmt: SelectStmt) -> ResultTable:
     if post:
         final = emit(f"FILTER(post_join_conjuncts:{len(post)})", final)
     parent = final
-    for j in reversed(stmt.joins):
+    ordered, trace = ex.plan_join_order(pushed)
+    for j, step in zip(reversed(ordered), reversed(trace)):
         label = j.table.label
         equi, rest = ex._split_on(
             j.on, {t.label for t in ex.tables if t.label != label}, label)
         parent = emit(
             f"HASH_JOIN({j.join_type.upper()},keys:{len(equi)},"
-            f"non_equi:{len(rest)})", parent)
+            f"non_equi:{len(rest)},est_rows:{step['estRows']})", parent)
         emit(f"LEAF_SCAN({label},cols:{len(needed[label])},"
-             f"pushed_filters:{len(pushed[label])})", parent)
+             f"pushed_filters:{len(pushed[label])},"
+             f"est_rows:{round(ex._table_row_est[label])})", parent)
     base = ex.tables[0].label
     emit(f"LEAF_SCAN({base},cols:{len(needed[base])},"
-         f"pushed_filters:{len(pushed[base])})", parent)
+         f"pushed_filters:{len(pushed[base])},"
+         f"est_rows:{round(ex._table_row_est[base])})", parent)
     return ResultTable(["Operator", "Operator_Id", "Parent_Id"], rows)

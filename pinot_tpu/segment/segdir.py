@@ -34,8 +34,14 @@ METADATA_FILE = "metadata.json"
 RUNTIME_FILES = frozenset({"valid.bin"})
 _ALIGN = 64  # slice alignment so device uploads see aligned hosts buffers
 
-# seg_dir -> (packed mmap, {name: [offset, length]}, map mtime)
-_CACHE: Dict[str, Tuple[np.memmap, Dict[str, List[int]], float]] = {}
+# seg_dir -> (packed mmap, {name: [offset, length]}, map mtime).
+# Bounded LRU: segment churn (rebalance, minion purge) must not pin
+# unlinked columns.psf mmaps for process lifetime; removal paths also
+# call invalidate() eagerly.
+from collections import OrderedDict
+_CACHE: "OrderedDict[str, Tuple[np.memmap, Dict[str, List[int]], float]]" \
+    = OrderedDict()
+_CACHE_MAX = 256
 
 
 def is_v3(seg_dir: str) -> bool:
@@ -47,12 +53,16 @@ def _load_map(seg_dir: str) -> Tuple[np.memmap, Dict[str, List[int]]]:
     mtime = os.path.getmtime(map_path)
     hit = _CACHE.get(seg_dir)
     if hit is not None and hit[2] == mtime:
+        _CACHE.move_to_end(seg_dir)
         return hit[0], hit[1]
     with open(map_path) as fh:
         index_map = json.load(fh)
     packed = np.memmap(os.path.join(seg_dir, V3_FILE), dtype=np.uint8,
                        mode="r")
     _CACHE[seg_dir] = (packed, index_map, mtime)
+    _CACHE.move_to_end(seg_dir)
+    while len(_CACHE) > _CACHE_MAX:
+        _CACHE.popitem(last=False)
     return packed, index_map
 
 
@@ -96,6 +106,11 @@ def read_array(seg_dir: str, name: str, dtype, count: int = -1,
         arr = view.view(dt)
         if count >= 0:
             arr = arr[:count]
+        return arr.reshape(shape) if shape is not None else arr
+    if os.path.getsize(path) == 0:
+        # np.memmap refuses empty files; a 0-byte artifact is legitimate
+        # (CSR docs file of an index with no postings)
+        arr = np.zeros(0, dtype=dt)
         return arr.reshape(shape) if shape is not None else arr
     if shape is not None and mmap:
         return np.memmap(path, dtype=dt, mode="r", shape=shape)

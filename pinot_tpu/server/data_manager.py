@@ -52,8 +52,13 @@ class TableDataManager:
     def remove_segment(self, name: str) -> None:
         with self._lock:
             segs = dict(self._segments)
-            segs.pop(name, None)
+            seg = segs.pop(name, None)
             self._segments = segs
+        if seg is not None and getattr(seg, "dir", None):
+            # drop any pinned v3 packed-file mmap so unlinked segment
+            # files release their disk blocks (segdir LRU backstops this)
+            from ..segment import segdir
+            segdir.invalidate(seg.dir)
 
     def replace_segment(self, segment: ImmutableSegment) -> None:
         self.add_segment(segment)  # atomic swap by name

@@ -1,0 +1,156 @@
+"""Cost-based multistage optimization: selectivity estimates, greedy
+INNER-join reordering with LEFT-join barriers, build-side selection.
+
+Reference test strategy analog: pinot-query-planner QueryEnvironment
+plan tests (Calcite CBO rule coverage asserts operator trees + join
+strategies chosen per statistics)."""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.multistage.costs import (TableStats, join_cardinality,
+                                        scan_cardinality, selectivity)
+from pinot_tpu.query.sql import parse_sql
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+
+def _table(broker, name, data, schema, tmpdir):
+    d = SegmentBuilder(schema, TableConfig(name)).build(
+        data, str(tmpdir), "s0")
+    dm = TableDataManager(name)
+    dm.add_segment_dir(d)
+    broker.register_table(dm)
+    return dm
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    rng = np.random.default_rng(21)
+    b = Broker()
+    root = tmp_path_factory.mktemp("cost_tables")
+    # facts: 60k rows, keys into both dims
+    n = 60000
+    _table(b, "facts", {
+        "cust_id": rng.integers(0, 5000, n).astype(np.int64),
+        "item_id": rng.integers(0, 40, n).astype(np.int64),
+        "amount": rng.integers(1, 100, n).astype(np.int64),
+    }, Schema("facts", [
+        FieldSpec("cust_id", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("item_id", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("amount", DataType.LONG, FieldType.METRIC)]), root / "f")
+    # big dim: 5000 customers
+    _table(b, "customers", {
+        "cust_id": np.arange(5000, dtype=np.int64),
+        "region": rng.choice(["eu", "us", "apac"], 5000),
+    }, Schema("customers", [
+        FieldSpec("cust_id", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("region", DataType.STRING, FieldType.DIMENSION)]),
+        root / "c")
+    # tiny dim: 40 items
+    _table(b, "items", {
+        "item_id": np.arange(40, dtype=np.int64),
+        "cat": rng.choice(["a", "b"], 40),
+    }, Schema("items", [
+        FieldSpec("item_id", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("cat", DataType.STRING, FieldType.DIMENSION)]),
+        root / "i")
+    return b
+
+
+def _stats(broker, name):
+    return TableStats.from_segments(
+        broker.table(name).acquire_segments())
+
+
+def test_selectivity_shapes(cluster):
+    st = _stats(cluster, "facts")
+    eq = selectivity(parse_sql(
+        "SELECT 1 FROM facts WHERE item_id = 7").where, st)
+    assert eq == pytest.approx(1 / 40, rel=0.2)
+    rng_sel = selectivity(parse_sql(
+        "SELECT 1 FROM facts WHERE amount < 50").where, st)
+    assert 0.3 < rng_sel < 0.7
+    both = selectivity(parse_sql(
+        "SELECT 1 FROM facts WHERE item_id = 7 AND amount < 50").where, st)
+    assert both == pytest.approx(eq * rng_sel, rel=1e-6)
+    inl = selectivity(parse_sql(
+        "SELECT 1 FROM facts WHERE item_id IN (1, 2, 3, 4)").where, st)
+    assert inl == pytest.approx(4 / 40, rel=0.2)
+
+
+def test_scan_and_join_cardinality(cluster):
+    st = _stats(cluster, "facts")
+    est = scan_cardinality(st, parse_sql(
+        "SELECT 1 FROM facts WHERE item_id = 7").where)
+    assert 500 < est < 4500   # true ~1500
+    # FK join facts->customers on cust_id: ~|facts|
+    jc = join_cardinality(60000, 5000, 5000, 5000)
+    assert jc == pytest.approx(60000)
+
+
+def test_join_reorder_small_table_first(cluster):
+    from pinot_tpu.multistage.executor import MultiStageExecutor
+    stmt = parse_sql(
+        "SELECT COUNT(*) FROM facts "
+        "JOIN customers ON facts.cust_id = customers.cust_id "
+        "JOIN items ON facts.item_id = items.item_id "
+        "WHERE items.cat = 'a'")
+    ex = MultiStageExecutor(cluster, stmt)
+    pushed, _ = ex._split_where()
+    ordered, trace = ex.plan_join_order(pushed)
+    # the filtered 40-row items table joins before the 5000-row customers
+    assert [j.table.label for j in ordered] == ["items", "customers"]
+    assert trace[0]["table"] == "items"
+
+
+def test_left_join_is_reorder_barrier(cluster):
+    from pinot_tpu.multistage.executor import MultiStageExecutor
+    stmt = parse_sql(
+        "SELECT COUNT(*) FROM facts "
+        "LEFT JOIN customers ON facts.cust_id = customers.cust_id "
+        "JOIN items ON facts.item_id = items.item_id")
+    ex = MultiStageExecutor(cluster, stmt)
+    pushed, _ = ex._split_where()
+    ordered, _ = ex.plan_join_order(pushed)
+    # the LEFT join must stay first even though items is far smaller
+    assert [j.table.label for j in ordered] == ["customers", "items"]
+
+
+def test_reordered_results_match_textual_order(cluster):
+    # same answer whichever order the optimizer picks
+    sql = ("SELECT items.cat, COUNT(*), SUM(facts.amount) FROM facts "
+           "JOIN customers ON facts.cust_id = customers.cust_id "
+           "JOIN items ON facts.item_id = items.item_id "
+           "WHERE customers.region = 'eu' "
+           "GROUP BY items.cat ORDER BY items.cat")
+    swapped = ("SELECT items.cat, COUNT(*), SUM(facts.amount) FROM facts "
+               "JOIN items ON facts.item_id = items.item_id "
+               "JOIN customers ON facts.cust_id = customers.cust_id "
+               "WHERE customers.region = 'eu' "
+               "GROUP BY items.cat ORDER BY items.cat")
+    assert cluster.query(sql).rows == cluster.query(swapped).rows
+    assert cluster.query(sql).rows[0][1] > 0
+
+
+def test_build_side_swap_preserves_inner_join(cluster):
+    # big LEFT side, small right side and vice versa give identical rows
+    a = cluster.query(
+        "SELECT COUNT(*) FROM facts JOIN items "
+        "ON facts.item_id = items.item_id WHERE items.cat = 'b'")
+    b = cluster.query(
+        "SELECT COUNT(*) FROM items JOIN facts "
+        "ON facts.item_id = items.item_id WHERE items.cat = 'b'")
+    assert a.rows == b.rows
+    assert a.rows[0][0] > 0
+
+
+def test_explain_shows_estimates(cluster):
+    res = cluster.query(
+        "EXPLAIN PLAN FOR SELECT COUNT(*) FROM facts "
+        "JOIN items ON facts.item_id = items.item_id")
+    ops = [r[0] for r in res.rows]
+    assert any("est_rows" in op and "HASH_JOIN" in op for op in ops)
+    assert any("LEAF_SCAN" in op and "est_rows" in op for op in ops)

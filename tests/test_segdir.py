@@ -155,3 +155,34 @@ def test_admin_convert_cli(built, capsys):
     assert main(["ConvertSegmentFormat", "--segment-dir", seg_dir,
                  "--to", "v1"]) == 0
     assert not segdir.is_v3(seg_dir)
+
+
+def test_empty_csr_docs_file_loads(tmp_path):
+    # a text/json index whose postings are all empty writes a 0-byte
+    # .docs.bin; loading must not crash (review regression: np.memmap
+    # refuses empty files)
+    schema = Schema("et", [
+        FieldSpec("doc", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    cfg = TableConfig("et", indexing=IndexingConfig(
+        json_index_columns=["doc"]))
+    data = {"doc": np.asarray(["{}", "{}"], dtype=object),
+            "v": np.arange(2, dtype=np.int64)}
+    seg_dir = SegmentBuilder(schema, cfg).build(data, str(tmp_path), "s0")
+    seg = ImmutableSegment.load(seg_dir)
+    rd = seg.index_reader("doc", "json")
+    assert rd is not None and rd.postings.n_keys >= 0
+    # and the v3 round trip of the empty entry also works
+    segdir.convert_to_v3(seg_dir)
+    seg = ImmutableSegment.load(seg_dir)
+    assert seg.index_reader("doc", "json") is not None
+
+
+def test_cover_polygon_default_point_fn_respects_holes():
+    from pinot_tpu.geo import cover_polygon, lat_lng_to_cell, parse_wkt
+    poly = parse_wkt("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), "
+                     "(3 3, 7 3, 7 7, 3 7, 3 3))")
+    full, bnd = cover_polygon(poly.coords, 8, holes=poly.holes)
+    # a cell deep inside the hole must not be in the full cover
+    hole_cell = lat_lng_to_cell(np.array([5.0]), np.array([5.0]), 8)
+    assert hole_cell[0] not in full
