@@ -118,6 +118,18 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
                 results[i] = execute_plan(plans[i])
             continue
         group_plans = [plans[i] for i in idxs]
+        if kind == "dense":
+            from .pipeline import (execute_kernel_plans_pipelined,
+                                   group_stack_bytes, hbm_budget_bytes)
+            if group_stack_bytes(group_plans, bucket) > hbm_budget_bytes():
+                # working set exceeds the HBM budget: stream segments
+                # through the double-buffered pipeline instead of
+                # staking everything resident (engine/pipeline.py)
+                partials = execute_kernel_plans_pipelined(
+                    plans, plan_struct, bucket, resolved, idxs)
+                for k, i in enumerate(idxs):
+                    results[i] = partials[k]
+                continue
         cols = _stacked_cols(group_plans, bucket)
         n_docs = jnp.asarray([p.segment.n_docs for p in group_plans],
                              dtype=jnp.int32)

@@ -1,0 +1,114 @@
+"""Pipelined (double-buffered) segment scan: overlap host->device
+transfer of the next segment with compute on the current one.
+
+Reference parity: SURVEY 2.9 "pipelined streaming" — the reference keeps
+servers saturated by streaming blocks through operator chains on thread
+pools (BaseCombineOperator workers + Netty streaming responses). On a
+TPU the analogous overlap is the DMA/compute pipeline: JAX dispatch is
+asynchronous, so enqueueing segment i+1's ``jax.device_put`` before
+blocking on segment i's kernel lets the H2D copy ride the transfer
+engine while the MXU works. This path exists for COLD scans whose
+working set exceeds the HBM budget: the resident-cache path
+(engine/batch.py) stacks everything in HBM and launches once, which is
+faster but needs the data to fit; this one holds at most TWO segments'
+columns in device memory at a time and streams the rest.
+
+The router (execute_plans_batched) sends a same-structure kernel group
+here when its stacked footprint exceeds ``hbm_budget_bytes()``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query.planner import CompiledPlan
+
+# default budget: v5e has 16GB HBM; leave headroom for outputs/compile
+_DEFAULT_BUDGET = 8 << 30
+
+# observability: how many pipelined streams ran (tests + trace hooks)
+STATS = {"pipelined_groups": 0, "pipelined_segments": 0}
+
+
+def hbm_budget_bytes() -> int:
+    """Resident-scan budget (PINOT_HBM_BUDGET_BYTES overrides; the
+    reference sizes off-heap buffers from server config the same way)."""
+    return int(os.environ.get("PINOT_HBM_BUDGET_BYTES", _DEFAULT_BUDGET))
+
+
+def group_stack_bytes(plans: List[CompiledPlan], bucket: int) -> int:
+    """Footprint of stacking this group's columns in HBM (what
+    engine/batch.py would upload)."""
+    total = 0
+    for p in plans:
+        for c in p.col_names:
+            m = p.segment.columns[c]
+            width = 1 if getattr(m, "single_value", True) else \
+                (m.max_values or 1)
+            # dict ids upload as int32; raw columns keep their dtype
+            item = 4 if m.has_dict else np.dtype(m.fwd_dtype).itemsize
+            total += bucket * width * item
+    return total
+
+
+def execute_kernel_plans_pipelined(plans: List[CompiledPlan],
+                                   plan_struct, bucket: int,
+                                   resolved_params: Dict[int, Tuple],
+                                   idxs: List[int]) -> List[Any]:
+    """Run same-structure kernel plans one segment at a time with the
+    next segment's transfer in flight; returns partials in plans order.
+
+    Double-buffer discipline: at any moment device memory holds the
+    in-flight transfer (i+1) plus the executing segment (i); segment
+    i-1's columns are dropped as soon as its kernel output is enqueued
+    (jax frees the buffers when the last reference dies after the
+    dependent computation completes).
+    """
+    from ..ops.kernels import jitted_kernel
+    from .accounting import global_accountant
+    from .executor import extract_partial
+
+    fn = jitted_kernel(plan_struct, bucket)  # lru-cached jit: repeated
+    # over-budget queries must not pay a fresh XLA compile per group
+    group = [plans[i] for i in idxs]
+
+    def stage(k: int):
+        seg = group[k].segment
+        return tuple(jax.device_put(seg.host_col_padded(c, bucket))
+                     for c in group[k].col_names)
+
+    STATS["pipelined_groups"] += 1
+    results: List[Any] = []
+    staged = stage(0)
+    outs: List[Any] = []
+    for k, plan in enumerate(group):
+        global_accountant.sample()
+        cur = staged
+        # enqueue the NEXT transfer before compute: async dispatch lets
+        # the H2D copy overlap this kernel on the transfer engine
+        staged = stage(k + 1) if k + 1 < len(group) else None
+        out = fn(cur, jnp.int32(plan.segment.n_docs),
+                 resolved_params[idxs[k]])
+        outs.append(out)
+        del cur  # last py-reference; freed once the kernel consumes it
+        STATS["pipelined_segments"] += 1
+        if k >= 1:
+            # bound in-flight work to the double buffer: resolve the
+            # previous segment's output before enqueueing more
+            outs[k - 1] = jax.device_get(outs[k - 1])
+    outs[-1] = jax.device_get(outs[-1])
+    for plan, out in zip(group, outs):
+        out = {name: np.asarray(v) for name, v in out.items()}
+        global_accountant.track_memory(
+            sum(v.nbytes for v in out.values()))
+        if int(out.pop("group_overflow", 0)):
+            from .executor import run_kernel
+            dense = run_kernel(plan, xfer_compact=False)
+            results.append(extract_partial(plan, dense))
+        else:
+            results.append(extract_partial(plan, out))
+    return results

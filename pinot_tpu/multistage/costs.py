@@ -52,7 +52,10 @@ class TableStats:
             for name, m in seg.columns.items():
                 c = cols.setdefault(name, {"ndv": 0, "min": None,
                                            "max": None})
-                c["ndv"] += max(int(getattr(m, "cardinality", 0) or 0), 1)
+                # only profiled cardinalities count: consuming mutable
+                # segments report 0, and flooring them to 1 would fake an
+                # NDV of n_segments and poison equality selectivity
+                c["ndv"] += int(getattr(m, "cardinality", 0) or 0)
                 for attr, pick in (("min", min), ("max", max)):
                     v = getattr(m, attr, None)
                     if v is None or isinstance(v, str):

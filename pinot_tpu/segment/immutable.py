@@ -209,19 +209,28 @@ class ImmutableSegment:
         bucket = bucket or self.bucket
         key = (col, bucket, sharding)
         if key not in self._device:
-            m = self.columns[col]
-            host = np.asarray(self.fwd(col))
-            if m.has_dict:
-                host = host.astype(np.int32, copy=False)
-            if bucket > self.n_docs:
-                # MV columns pad rows with -1 (the padded-slot sentinel);
-                # SV padding is inert under validity masks either way
-                pad = np.full((bucket - self.n_docs,) + host.shape[1:],
-                              -1 if not m.single_value else 0,
-                              dtype=host.dtype)
-                host = np.concatenate([host, pad])
-            self._device[key] = self._put(host, sharding)
+            self._device[key] = self._put(
+                self.host_col_padded(col, bucket), sharding)
         return self._device[key]
+
+    def host_col_padded(self, col: str, bucket: Optional[int] = None
+                        ) -> np.ndarray:
+        """The bucket-padded host representation device_col uploads —
+        exposed separately so the streaming scan path (engine/pipeline.py)
+        can double-buffer transfers WITHOUT populating the device cache."""
+        bucket = bucket or self.bucket
+        m = self.columns[col]
+        host = np.asarray(self.fwd(col))
+        if m.has_dict:
+            host = host.astype(np.int32, copy=False)
+        if bucket > self.n_docs:
+            # MV columns pad rows with -1 (the padded-slot sentinel);
+            # SV padding is inert under validity masks either way
+            pad = np.full((bucket - self.n_docs,) + host.shape[1:],
+                          -1 if not m.single_value else 0,
+                          dtype=host.dtype)
+            host = np.concatenate([host, pad])
+        return host
 
     def device_cols(self, cols: List[str], bucket: Optional[int] = None,
                     sharding=None) -> Tuple[jax.Array, ...]:

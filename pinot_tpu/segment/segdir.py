@@ -39,9 +39,12 @@ _ALIGN = 64  # slice alignment so device uploads see aligned hosts buffers
 # unlinked columns.psf mmaps for process lifetime; removal paths also
 # call invalidate() eagerly.
 from collections import OrderedDict
+import threading
 _CACHE: "OrderedDict[str, Tuple[np.memmap, Dict[str, List[int]], float]]" \
     = OrderedDict()
 _CACHE_MAX = 256
+_CACHE_LOCK = threading.Lock()  # LRU mutation is not GIL-atomic; broker/
+# gRPC thread pools hit _load_map concurrently
 
 
 def is_v3(seg_dir: str) -> bool:
@@ -51,23 +54,26 @@ def is_v3(seg_dir: str) -> bool:
 def _load_map(seg_dir: str) -> Tuple[np.memmap, Dict[str, List[int]]]:
     map_path = os.path.join(seg_dir, V3_MAP)
     mtime = os.path.getmtime(map_path)
-    hit = _CACHE.get(seg_dir)
-    if hit is not None and hit[2] == mtime:
-        _CACHE.move_to_end(seg_dir)
-        return hit[0], hit[1]
+    with _CACHE_LOCK:
+        hit = _CACHE.get(seg_dir)
+        if hit is not None and hit[2] == mtime:
+            _CACHE.move_to_end(seg_dir)
+            return hit[0], hit[1]
     with open(map_path) as fh:
         index_map = json.load(fh)
     packed = np.memmap(os.path.join(seg_dir, V3_FILE), dtype=np.uint8,
                        mode="r")
-    _CACHE[seg_dir] = (packed, index_map, mtime)
-    _CACHE.move_to_end(seg_dir)
-    while len(_CACHE) > _CACHE_MAX:
-        _CACHE.popitem(last=False)
+    with _CACHE_LOCK:
+        _CACHE[seg_dir] = (packed, index_map, mtime)
+        _CACHE.move_to_end(seg_dir)
+        while len(_CACHE) > _CACHE_MAX:
+            _CACHE.popitem(last=False)
     return packed, index_map
 
 
 def invalidate(seg_dir: str) -> None:
-    _CACHE.pop(seg_dir, None)
+    with _CACHE_LOCK:
+        _CACHE.pop(seg_dir, None)
 
 
 def exists(seg_dir: str, name: str) -> bool:
