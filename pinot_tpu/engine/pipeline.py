@@ -106,8 +106,21 @@ def execute_kernel_plans_pipelined(plans: List[CompiledPlan],
         global_accountant.track_memory(
             sum(v.nbytes for v in out.values()))
         if int(out.pop("group_overflow", 0)):
-            from .executor import run_kernel
-            dense = run_kernel(plan, xfer_compact=False)
+            # rerun this segment dense (no transfer compaction) WITHOUT
+            # run_kernel: that path populates the persistent device cache,
+            # which would make the over-budget working set resident —
+            # exactly what this streaming path exists to avoid
+            from ..ops.kernels import jitted_kernel
+            dense_fn = jitted_kernel(plan_struct, bucket,
+                                     xfer_compact=False)
+            seg = plan.segment
+            cols = tuple(jax.device_put(seg.host_col_padded(c, bucket))
+                         for c in plan.col_names)
+            dense = jax.device_get(dense_fn(
+                cols, jnp.int32(seg.n_docs),
+                resolved_params[idxs[len(results)]]))
+            del cols
+            dense.pop("group_overflow", None)
             results.append(extract_partial(plan, dense))
         else:
             results.append(extract_partial(plan, out))

@@ -24,6 +24,8 @@ EOS = object()  # end-of-stream marker (MetadataBlock EOS analog)
 
 class ReceivingMailbox:
     def __init__(self, mailbox_id: str):
+        from ..utils.leak import track
+        track(self, "mailbox", mailbox_id)
         self.mailbox_id = mailbox_id
         self._q: "queue.Queue[Any]" = queue.Queue()
 

@@ -99,7 +99,138 @@ int64_t zstd_decompress_chunk(const uint8_t* src, int64_t n, uint8_t* dst,
 int64_t compress_bound(int64_t n) {
     uLong zb = compressBound((uLong)n);
     size_t sb = ZSTD_compressBound((size_t)n);
-    return (int64_t)(zb > sb ? zb : sb);
+    int64_t lb = n + n / 255 + 16;  // LZ4 worst case (incompressible)
+    int64_t m = (int64_t)(zb > sb ? zb : sb);
+    return m > lb ? m : lb;
+}
+
+// --------------------------------------------------------------------------
+// LZ4 block format (lz4-java analog; spec: 4-bit literal/match token,
+// 2-byte little-endian offsets, minmatch 4). Self-contained greedy
+// hash-table compressor + branchy-but-safe decompressor — no external
+// lz4 dependency exists in this image, and the block format is simple
+// enough that a correct from-scratch implementation beats gating the
+// codec away.
+// --------------------------------------------------------------------------
+
+static inline uint32_t lz4_hash(uint32_t seq) {
+    return (seq * 2654435761u) >> 18;  // 14-bit table
+}
+
+int64_t lz4_compress_chunk(const uint8_t* src, int64_t n, uint8_t* dst,
+                           int64_t cap, int /*level*/) {
+    const int64_t MINMATCH = 4, MFLIMIT = 12, LASTLITERALS = 5;
+    int32_t table[1 << 14];
+    for (int i = 0; i < (1 << 14); ++i) table[i] = -1;
+    int64_t ip = 0, op = 0, anchor = 0;
+    if (n >= MFLIMIT) {
+        const int64_t mflimit = n - MFLIMIT;
+        while (ip <= mflimit) {
+            uint32_t seq;
+            memcpy(&seq, src + ip, 4);
+            uint32_t h = lz4_hash(seq);
+            int64_t ref = table[h];
+            table[h] = (int32_t)ip;
+            uint32_t refseq;
+            if (ref < 0 || ip - ref > 65535 ||
+                (memcpy(&refseq, src + ref, 4), refseq != seq)) {
+                ++ip;
+                continue;
+            }
+            // extend the match forward (stay clear of the last literals)
+            int64_t mlen = MINMATCH;
+            const int64_t limit = n - LASTLITERALS;
+            while (ip + mlen < limit && src[ip + mlen] == src[ref + mlen])
+                ++mlen;
+            int64_t litlen = ip - anchor;
+            // token + extended literal lengths + literals + offset +
+            // extended match lengths must fit
+            if (op + 1 + litlen + (litlen / 255 + 1) + 2 +
+                (mlen / 255 + 1) + LASTLITERALS > cap)
+                return -1;
+            uint8_t* token = dst + op++;
+            if (litlen >= 15) {
+                *token = (uint8_t)(15 << 4);
+                int64_t rem = litlen - 15;
+                for (; rem >= 255; rem -= 255) dst[op++] = 255;
+                dst[op++] = (uint8_t)rem;
+            } else {
+                *token = (uint8_t)(litlen << 4);
+            }
+            memcpy(dst + op, src + anchor, (size_t)litlen);
+            op += litlen;
+            uint16_t off = (uint16_t)(ip - ref);
+            dst[op++] = (uint8_t)(off & 0xff);
+            dst[op++] = (uint8_t)(off >> 8);
+            int64_t mcode = mlen - MINMATCH;
+            if (mcode >= 15) {
+                *token |= 15;
+                mcode -= 15;
+                for (; mcode >= 255; mcode -= 255) dst[op++] = 255;
+                dst[op++] = (uint8_t)mcode;
+            } else {
+                *token |= (uint8_t)mcode;
+            }
+            ip += mlen;
+            anchor = ip;
+        }
+    }
+    // final literal run
+    int64_t litlen = n - anchor;
+    if (op + 1 + litlen + litlen / 255 + 1 > cap) return -1;
+    uint8_t* token = dst + op++;
+    if (litlen >= 15) {
+        *token = (uint8_t)(15 << 4);
+        int64_t rem = litlen - 15;
+        for (; rem >= 255; rem -= 255) dst[op++] = 255;
+        dst[op++] = (uint8_t)rem;
+    } else {
+        *token = (uint8_t)(litlen << 4);
+    }
+    memcpy(dst + op, src + anchor, (size_t)litlen);
+    op += litlen;
+    return op;
+}
+
+int64_t lz4_decompress_chunk(const uint8_t* src, int64_t n, uint8_t* dst,
+                             int64_t cap) {
+    int64_t ip = 0, op = 0;
+    while (ip < n) {
+        uint8_t token = src[ip++];
+        int64_t litlen = token >> 4;
+        if (litlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                litlen += b;
+            } while (b == 255);
+        }
+        if (ip + litlen > n || op + litlen > cap) return -1;
+        memcpy(dst + op, src + ip, (size_t)litlen);
+        ip += litlen;
+        op += litlen;
+        if (ip >= n) break;  // last sequence carries no match
+        if (ip + 2 > n) return -1;
+        int64_t off = src[ip] | ((int64_t)src[ip + 1] << 8);
+        ip += 2;
+        if (off == 0 || off > op) return -1;
+        int64_t mlen = (token & 15);
+        if (mlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                mlen += b;
+            } while (b == 255);
+        }
+        mlen += 4;
+        if (op + mlen > cap) return -1;
+        // overlapping copies are the point (RLE via offset < mlen):
+        // byte-by-byte preserves the semantics
+        for (int64_t k = 0; k < mlen; ++k, ++op) dst[op] = dst[op - off];
+    }
+    return op;
 }
 
 // --------------------------------------------------------------------------

@@ -379,10 +379,22 @@ class SegmentBuilder:
             if idx_cfg.compression:
                 from .. import native
                 codec = idx_cfg.compression
-                if codec == "ZSTD" and not native.available():
+                if codec in ("ZSTD", "LZ4") and not native.available():
                     codec = "ZLIB"  # degrade to the pure-python codec; the
                     # metadata must always name the stream actually written
-                comp = native.compress(arr, codec)
+                if codec == "DELTA" and (arr.dtype.kind not in "iu"
+                                         or arr.ndim != 1):
+                    codec = "ZLIB"  # DELTA is integer-only
+                if codec == "DELTA":
+                    try:
+                        comp = native.compress(arr, codec)
+                    except RuntimeError:
+                        # data-dependent: deltas wider than 32 bits —
+                        # degrade like every other unsupported case
+                        codec = "ZLIB"
+                        comp = native.compress(arr, codec)
+                else:
+                    comp = native.compress(arr, codec)
                 comp.tofile(_fwd_path(seg_dir, f.name))
                 cmeta["fwdFormat"] = "COMPRESSED"
                 cmeta["codec"] = codec

@@ -91,6 +91,8 @@ class ImmutableSegment:
             bits = np.asarray(segdir.read_array(seg_dir, "valid.bin",
                                                 np.uint8, mmap=False))
             self.valid_docs = np.unpackbits(bits)[: self.n_docs].astype(bool)
+        from ..utils import leak
+        leak.track(self, "segment", self.name)
 
     @classmethod
     def load(cls, seg_dir: str, read_mode: str = "mmap") -> "ImmutableSegment":

@@ -63,6 +63,8 @@ def _load_map(seg_dir: str) -> Tuple[np.memmap, Dict[str, List[int]]]:
         index_map = json.load(fh)
     packed = np.memmap(os.path.join(seg_dir, V3_FILE), dtype=np.uint8,
                        mode="r")
+    from ..utils.leak import track
+    track(packed, "segdir_mmap", seg_dir)
     with _CACHE_LOCK:
         _CACHE[seg_dir] = (packed, index_map, mtime)
         _CACHE.move_to_end(seg_dir)

@@ -35,7 +35,10 @@ class IndexingConfig:
     # storage codecs (native C++ pack/compress; pinot io/compression analog):
     # bit-pack dict ids at ceil(log2(card)) bits instead of byte-aligned
     bit_packed_ids: bool = False
-    # compress raw columns: None | "ZSTD" | "ZLIB"
+    # compress raw columns: None | "ZSTD" | "ZLIB" | "LZ4" |
+    # "PASS_THROUGH" | "DELTA" (zigzag-delta bitpack, integer columns —
+    # the sorted-timestamp specialist; io/compression ChunkCompressionType
+    # analog)
     compression: Optional[str] = None
     # secondary per-column indexes (StandardIndexes analog; built by
     # pinot_tpu.index registry at segment-build time)
