@@ -230,15 +230,20 @@ def extract_partial(plan: CompiledPlan, out: Dict[str, np.ndarray]):
     else:
         idxs = np.nonzero(gc > 0)[0]
         sel = idxs
-    # decode dense cartesian keys -> per-column ids -> values
+    # decode dense cartesian keys -> per-key ids -> values
     key_cols: List[np.ndarray] = []
     rem = idxs.copy()
-    dims = [(name, seg.columns[name].cardinality)
-            for name in plan.group_cols]
-    for name, card in reversed(dims):
+    decoders = plan.group_decoders or [
+        ("dict", name, seg.columns[name].cardinality)
+        for name in plan.group_cols]
+    for dec in reversed(decoders):
+        card = dec[-1]
         ids = rem % card
         rem = rem // card
-        key_cols.append(seg.dictionary(name).values_for(ids))
+        if dec[0] == "dict":
+            key_cols.append(seg.dictionary(dec[1]).values_for(ids))
+        else:  # ("int", lo, stride, card): expression keys (YEAR(ts)...)
+            key_cols.append(dec[1] + ids.astype(np.int64) * dec[2])
     key_cols.reverse()
     keys = [tuple(_py(kc[i]) for kc in key_cols) for i in range(len(idxs))]
 

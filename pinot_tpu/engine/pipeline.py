@@ -101,7 +101,8 @@ def execute_kernel_plans_pipelined(plans: List[CompiledPlan],
             # previous segment's output before enqueueing more
             outs[k - 1] = jax.device_get(outs[k - 1])
     outs[-1] = jax.device_get(outs[-1])
-    for plan, out in zip(group, outs):
+    dense_fn = None
+    for k, (plan, out) in enumerate(zip(group, outs)):
         out = {name: np.asarray(v) for name, v in out.items()}
         global_accountant.track_memory(
             sum(v.nbytes for v in out.values()))
@@ -110,17 +111,18 @@ def execute_kernel_plans_pipelined(plans: List[CompiledPlan],
             # run_kernel: that path populates the persistent device cache,
             # which would make the over-budget working set resident —
             # exactly what this streaming path exists to avoid
-            from ..ops.kernels import jitted_kernel
-            dense_fn = jitted_kernel(plan_struct, bucket,
-                                     xfer_compact=False)
+            if dense_fn is None:
+                dense_fn = jitted_kernel(plan_struct, bucket,
+                                         xfer_compact=False)
             seg = plan.segment
             cols = tuple(jax.device_put(seg.host_col_padded(c, bucket))
                          for c in plan.col_names)
             dense = jax.device_get(dense_fn(
-                cols, jnp.int32(seg.n_docs),
-                resolved_params[idxs[len(results)]]))
+                cols, jnp.int32(seg.n_docs), resolved_params[idxs[k]]))
             del cols
             dense.pop("group_overflow", None)
+            global_accountant.track_memory(
+                sum(np.asarray(v).nbytes for v in dense.values()))
             results.append(extract_partial(plan, dense))
         else:
             results.append(extract_partial(plan, out))

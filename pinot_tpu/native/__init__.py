@@ -160,7 +160,11 @@ def decompress(data: np.ndarray, raw_size: int, codec: str = "ZSTD"
     if codec == "PASS_THROUGH":
         return buf[:raw_size]
     if codec == "DELTA":
-        return delta_unpack(buf)
+        out = delta_unpack(buf)
+        if len(out) != raw_size:
+            raise RuntimeError(
+                f"DELTA decompression failed ({len(out)} != {raw_size})")
+        return out
     lib = load()
     if lib is not None:
         out = np.empty(raw_size, dtype=np.uint8)

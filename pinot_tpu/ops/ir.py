@@ -63,6 +63,26 @@ class MvReduce(ValueExpr):
     dict_param: Optional[int] = None
 
 
+@dataclass(frozen=True)
+class Func(ValueExpr):
+    """Device scalar transform: closed-form math (datetime extraction
+    over epoch millis via civil-from-days integer arithmetic, casts,
+    abs/floor/ceil/sqrt...). The device lowering of the reference's
+    transform-function classes (DateTimeTransformFunction, CastTransform
+    Function, ...); host peers live in query/functions.py and MUST agree
+    exactly — oracle tests compare the two paths."""
+    name: str
+    args: Tuple["ValueExpr", ...]
+
+
+@dataclass(frozen=True)
+class Case(ValueExpr):
+    """CASE WHEN <pred> THEN <value> ... ELSE <value> END as a where
+    chain (CaseTransformFunction device lowering)."""
+    whens: Tuple[Tuple["Pred", "ValueExpr"], ...]
+    else_: "ValueExpr"
+
+
 # ---------------------------------------------------------------------------
 # Predicates (operator/filter/ + predicate evaluators in reference)
 # ---------------------------------------------------------------------------
@@ -237,6 +257,12 @@ class KernelPlan:
     aggs: Tuple[AggSpec, ...]
     group_keys: Tuple[Tuple[int, int], ...] = ()
     strategy: str = "dense"
+    # expression group keys (GROUP BY YEAR(ts), ...): parallel to
+    # group_keys; entry k, when not None, is a ValueExpr already shifted
+    # into [0, card_k) — evaluated instead of cols[col_idx]. Expression
+    # keys force the dense strategy (compaction gathers key columns by
+    # index). () means all-column keys.
+    key_exprs: Tuple[Optional["ValueExpr"], ...] = ()
 
     @property
     def group_space(self) -> int:

@@ -38,9 +38,9 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange,
-                 InBitmap, InSet, KernelPlan, Lit, MaskParam, MvReduce, Not,
-                 Or, Pred, SelectPlan, TrueP, ValueExpr)
+from .ir import (AggSpec, And, Bin, Case, Cmp, Col, EqId, FalseP, Func,
+                 IdRange, InBitmap, InSet, KernelPlan, Lit, MaskParam,
+                 MvReduce, Not, Or, Pred, SelectPlan, TrueP, ValueExpr)
 
 # IN lists longer than this use sorted-membership (raw values) or a
 # presence-table gather (dict ids) instead of broadcast compare
@@ -127,8 +127,125 @@ def _eval_value(ve: ValueExpr, cols, params, promote: bool = False
             return l.astype(float_acc_dtype()) / r.astype(float_acc_dtype())
         if ve.op == "%":
             return l % r
+        if ve.op == "//":
+            return jnp.floor_divide(l, r)
         raise ValueError(f"unknown binary op {ve.op!r}")
+    if isinstance(ve, Func):
+        args = [_eval_value(a, cols, params, promote) for a in ve.args]
+        return _eval_func(ve.name, args)
+    if isinstance(ve, Case):
+        out = _eval_value(ve.else_, cols, params, promote)
+        bucket = cols[0].shape[0] if cols else out.shape[0]
+        out = jnp.broadcast_to(out, (bucket,) + out.shape[1:])
+        # reverse order: the first matching WHEN must win
+        for pred, val in reversed(ve.whens):
+            m = _eval_pred(pred, cols, params, bucket)
+            v = _eval_value(val, cols, params, promote)
+            ct = jnp.promote_types(v.dtype, out.dtype)
+            out = jnp.where(m, v.astype(ct), out.astype(ct))
+        return out
     raise TypeError(f"unknown value expr {ve!r}")
+
+
+# closed-form device datetime math over epoch millis. Civil-from-days is
+# Howard Hinnant's branchless algorithm — pure integer ops that lower to
+# XLA unchanged. Semantics MUST match query/functions.py's numpy
+# datetime64 host path (floor division handles pre-1970 correctly).
+_MS_DAY = 86_400_000
+
+
+def _civil_ymd(days):
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(
+        doe - jnp.floor_divide(doe, 1460) + jnp.floor_divide(doe, 36524)
+        - jnp.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4)
+                 - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4)         - jnp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+def _eval_func(name: str, args) -> jax.Array:
+    a = args[0]
+    if name in ("cast_long", "cast_int"):
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            a = jnp.trunc(a)  # C-style truncation (host cast_value)
+        return a.astype(jnp.int64 if name == "cast_long" else jnp.int32)
+    if name in ("cast_double", "cast_float"):
+        return a.astype(jnp.float64 if name == "cast_double"
+                        else jnp.float32)
+    if name == "abs":
+        return jnp.abs(a)
+    if name == "floor":
+        return jnp.floor(a.astype(float_acc_dtype()))
+    if name == "ceil":
+        return jnp.ceil(a.astype(float_acc_dtype()))
+    if name == "sqrt":
+        return jnp.sqrt(a.astype(float_acc_dtype()))
+    if name == "exp":
+        return jnp.exp(a.astype(float_acc_dtype()))
+    if name == "ln":
+        return jnp.log(a.astype(float_acc_dtype()))
+    ms = a.astype(jnp.int64)
+    days = jnp.floor_divide(ms, _MS_DAY)
+    if name == "year":
+        return _civil_ymd(days)[0]
+    if name == "month":
+        return _civil_ymd(days)[1]
+    if name == "day":
+        return _civil_ymd(days)[2]
+    if name == "quarter":
+        return jnp.floor_divide(_civil_ymd(days)[1] - 1, 3) + 1
+    if name == "dayofweek":
+        # 1=Monday..7=Sunday (host _field; epoch day 0 was a Thursday)
+        return (days + 3) % 7 + 1
+    if name == "hour":
+        return jnp.floor_divide(ms, 3_600_000) % 24
+    if name == "minute":
+        return jnp.floor_divide(ms, 60_000) % 60
+    if name == "second":
+        return jnp.floor_divide(ms, 1000) % 60
+    if name == "millisecond":
+        return ms % 1000
+    if name.startswith("trunc_"):
+        unit = name[6:]
+        if unit == "second":
+            return jnp.floor_divide(ms, 1000) * 1000
+        if unit == "minute":
+            return jnp.floor_divide(ms, 60_000) * 60_000
+        if unit == "hour":
+            return jnp.floor_divide(ms, 3_600_000) * 3_600_000
+        if unit == "day":
+            return days * _MS_DAY
+        if unit == "week":
+            # ISO week start (Monday); day 0 = Thursday -> offset 3
+            return (jnp.floor_divide(days + 3, 7) * 7 - 3) * _MS_DAY
+        y, m, _d = _civil_ymd(days)
+        if unit == "month":
+            return _days_from_civil(y, m, jnp.ones_like(m)) * _MS_DAY
+        if unit == "quarter":
+            qm = jnp.floor_divide(m - 1, 3) * 3 + 1
+            return _days_from_civil(y, qm, jnp.ones_like(m)) * _MS_DAY
+        if unit == "year":
+            return _days_from_civil(y, jnp.ones_like(m),
+                                    jnp.ones_like(m)) * _MS_DAY
+    raise ValueError(f"no device lowering for function {name!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -337,8 +454,15 @@ def _group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
     space = plan.group_space
     # dense cartesian dict-id key (DictionaryBasedGroupKeyGenerator.java:63)
     keys = jnp.zeros((bucket,), dtype=jnp.int32)
-    for col_idx, card in plan.group_keys:
-        keys = keys * jnp.int32(card) + cols[col_idx].astype(jnp.int32)
+    exprs = plan.key_exprs or (None,) * len(plan.group_keys)
+    for (col_idx, card), kexpr in zip(plan.group_keys, exprs):
+        ids = cols[col_idx] if kexpr is None             else _eval_value(kexpr, cols, params)
+        keys = keys * jnp.int32(card) + ids.astype(jnp.int32)
+    if plan.key_exprs:
+        # expression keys have no dictionary guarantee: clamp strays
+        # (pre-epoch garbage etc.) onto the sentinel instead of wrapping
+        # into a wrong group
+        mask = mask & (keys >= 0) & (keys < space)
     keys_s = jnp.where(mask, keys, space)  # sentinel -> all-zero one-hot col
     oh8 = jax.nn.one_hot(keys_s, space, dtype=jnp.int8)
 

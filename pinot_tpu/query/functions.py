@@ -123,7 +123,7 @@ def _vec_str(fn: Callable[[str], Any], v: Any, dtype=None) -> np.ndarray:
 # math (ArithmeticFunctions.java / transform function analogs)
 # ---------------------------------------------------------------------------
 
-register("abs")(lambda v: np.abs(_f(v)))
+register("abs")(lambda v: np.abs(np.asarray(v)))
 register("ceil")(lambda v: np.ceil(_f(v)))
 register_alias("ceiling", "ceil")
 register("floor")(lambda v: np.floor(_f(v)))
@@ -429,10 +429,16 @@ register("toepochmillis")(lambda v: _i(v))
 def _datetrunc(unit, millis, out_unit=None):
     u = str(np.asarray(unit)).lower()
     d = _dt64(millis)
-    trunc_map = {"year": "Y", "month": "M", "week": "W", "day": "D",
+    trunc_map = {"year": "Y", "month": "M", "day": "D",
                  "hour": "h", "minute": "m", "second": "s",
-                 "millisecond": "ms", "quarter": None}
-    if u == "quarter":
+                 "millisecond": "ms", "quarter": None, "week": None}
+    if u == "week":
+        # ISO Monday anchor (java.time/joda semantics the reference
+        # uses); numpy datetime64[W] anchors on the Thursday epoch and
+        # would disagree with the device lowering
+        days = np.floor_divide(_i(millis), 86_400_000)
+        res = (np.floor_divide(days + 3, 7) * 7 - 3) * 86_400_000
+    elif u == "quarter":
         y = d.astype("datetime64[Y]")
         mo = (d.astype("datetime64[M]") - y).astype(np.int64) // 3 * 3
         out = (y.astype("datetime64[M]") + mo.astype("timedelta64[M]"))

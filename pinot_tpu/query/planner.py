@@ -26,15 +26,16 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..ops.ir import (AggSpec, And, Bin, Cmp, Col, EqId, FalseP, IdRange,
+from ..ops.ir import (AggSpec, And, Bin, Case as CaseIR, Cmp, Col, EqId,
+                      FalseP, Func as FuncIR, IdRange,
                       InBitmap, InSet, IsNull as IsNullIR, KernelPlan, Lit,
                       MaskParam as MaskParamP, Not, Or, Pred, TrueP,
                       ValueExpr)
 from ..segment.immutable import ImmutableSegment
 from ..spi.schema import DataType
-from .context import AggExpr, QueryContext
-from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, Comparison,
-                  collect_identifiers, FuncCall,
+from .context import AggExpr, QueryContext, _expr_label as _expr_label_of
+from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, CaseWhen,
+                  Cast, Comparison, collect_identifiers, FuncCall,
                   Identifier, InList, IsNull, Like, Literal, SqlError, Star)
 
 MAX_DENSE_GROUPS = 1 << 21          # beyond this, host hash group-by
@@ -73,6 +74,10 @@ class CompiledPlan:
     params: List[Any] = field(default_factory=list)
     agg_bindings: List["AggBinding"] = field(default_factory=list)
     group_cols: List[str] = field(default_factory=list)   # group key columns
+    # per-key decode recipe for extract_partial: ("dict", col, card) |
+    # ("int", lo, stride, card) — expression keys (GROUP BY YEAR(ts))
+    # have no dictionary; their ids decode as lo + id*stride
+    group_decoders: List[tuple] = field(default_factory=list)
     # fast path: precomputed states per agg
     fast_states: Optional[List[Any]] = None
     # kselect path (device selection/order-by)
@@ -223,7 +228,157 @@ class SegmentPlanner:
             r, ri = self.resolve_value(e.rhs)
             integral = li and ri and e.op != "/"
             return Bin(e.op, l, r), integral
+        if isinstance(e, FuncCall):
+            return self._device_func(e)
+        if isinstance(e, Cast):
+            return self._device_cast(e)
+        if isinstance(e, CaseWhen):
+            return self._device_case(e)
         raise PlanError(f"unsupported value expression {e!r}")
+
+    # datetime/math scalar functions with closed-form device lowerings
+    # (DateTimeTransformFunction / CastTransformFunction analogs; full
+    # registry stays host-side in query/functions.py — PlanError here
+    # means the host path evaluates instead)
+    _DEVICE_FUNCS = {
+        "year": True, "month": True, "day": True, "dayofmonth": True,
+        "quarter": True, "dayofweek": True, "hour": True, "minute": True,
+        "second": True, "millisecond": True,
+        "abs": None, "floor": False, "ceil": False, "sqrt": False,
+        "exp": False, "ln": False,
+    }
+
+    # constant output ranges of datetime field extractors (lo, hi)
+    _FIELD_RANGES = {"month": (1, 12), "day": (1, 31), "quarter": (1, 4),
+                     "dayofweek": (1, 7), "hour": (0, 23),
+                     "minute": (0, 59), "second": (0, 59),
+                     "millisecond": (0, 999)}
+    _TRUNC_STRIDES = {"second": 1000, "minute": 60_000,
+                      "hour": 3_600_000, "day": 86_400_000,
+                      "week": 7 * 86_400_000}
+
+    def _expr_key_range(self, g: Any):
+        """GROUP BY expression -> (lo, stride, cardinality) when the
+        expression has a device lowering AND a bounded integer range
+        derivable from column metadata; None -> host path. The device
+        answer to expression group keys (the reference evaluates a
+        transform function then runs NoDictionaryGroupKeyGenerator;
+        here the key arithmetic fuses into the kernel)."""
+        from .functions import canonical
+        if not isinstance(g, FuncCall):
+            return None
+        name = canonical(g.name)
+        name = "day" if name == "dayofmonth" else name
+        if name in self._FIELD_RANGES and len(g.args) == 1:
+            lo, hi = self._FIELD_RANGES[name]
+            return lo, 1, hi - lo + 1
+        arg_rng = None
+        if name == "year" and len(g.args) == 1:
+            arg_rng = self._range_of(g.args[0])
+            if arg_rng is None:
+                return None
+            import numpy as _np
+            y_lo = int(_np.datetime64(int(arg_rng[0]), "ms")
+                       .astype("datetime64[Y]").astype(_np.int64)) + 1970
+            y_hi = int(_np.datetime64(int(arg_rng[1]), "ms")
+                       .astype("datetime64[Y]").astype(_np.int64)) + 1970
+            return y_lo, 1, y_hi - y_lo + 1
+        if name == "datetrunc" and len(g.args) == 2 \
+                and isinstance(g.args[0], Literal):
+            unit = str(g.args[0].value).lower()
+            stride = self._TRUNC_STRIDES.get(unit)
+            if stride is None:
+                return None
+            arg_rng = self._range_of(g.args[1])
+            if arg_rng is None:
+                return None
+            ms_lo, ms_hi = int(arg_rng[0]), int(arg_rng[1])
+            if unit == "week":
+                import math as _math
+                d_lo = _math.floor(ms_lo / 86_400_000)
+                d_hi = _math.floor(ms_hi / 86_400_000)
+                t_lo = ((d_lo + 3) // 7 * 7 - 3) * 86_400_000
+                t_hi = ((d_hi + 3) // 7 * 7 - 3) * 86_400_000
+            else:
+                import math as _math
+                t_lo = _math.floor(ms_lo / stride) * stride
+                t_hi = _math.floor(ms_hi / stride) * stride
+            return t_lo, stride, (t_hi - t_lo) // stride + 1
+
+        return None
+
+    def _expr_key_ir(self, g: FuncCall, lo: int, stride: int) -> ValueExpr:
+        """The [0, card) key expression for a ranged group expression."""
+        from .functions import canonical
+        name = canonical(g.name)
+        name = "day" if name == "dayofmonth" else name
+        if name == "datetrunc":
+            unit = str(g.args[0].value).lower()
+            v, vi = self.resolve_value(g.args[1])
+            if not vi:
+                raise PlanError("dateTrunc key over non-integer (host)")
+            f = FuncIR(f"trunc_{unit}", (v,))
+        else:
+            v, vi = self.resolve_value(g.args[0])
+            if not vi:
+                raise PlanError(f"{g.name} key over non-integer (host)")
+            f = FuncIR(name, (v,))
+        out: ValueExpr = f
+        if lo:
+            out = Bin("-", out, Lit(self.b.add_param(np.int64(lo))))
+        if stride != 1:
+            out = Bin("//", out, Lit(self.b.add_param(np.int64(stride))))
+        return out
+
+    def _device_func(self, e: FuncCall) -> Tuple[ValueExpr, bool]:
+        from .functions import canonical
+        name = canonical(e.name)
+        if name == "datetrunc" and len(e.args) == 2 and                 isinstance(e.args[0], Literal):
+            unit = str(e.args[0].value).lower()
+            if unit in ("second", "minute", "hour", "day", "week",
+                        "month", "quarter", "year"):
+                v, vi = self.resolve_value(e.args[1])
+                if not vi:
+                    raise PlanError("dateTrunc over non-integer (host)")
+                return FuncIR(f"trunc_{unit}", (v,)), True
+            raise PlanError(f"dateTrunc unit {unit!r} (host fallback)")
+        integral = self._DEVICE_FUNCS.get("day" if name == "dayofmonth"
+                                          else name, "missing")
+        if integral == "missing" or len(e.args) != 1 or e.distinct:
+            raise PlanError(f"no device lowering for {e.name!r} "
+                            "(host fallback)")
+        v, vi = self.resolve_value(e.args[0])
+        if integral is True and not vi:
+            raise PlanError(f"{e.name} over non-integer input (host)")
+        name = "day" if name == "dayofmonth" else name
+        out_integral = vi if integral is None else integral
+        return FuncIR(name, (v,)), out_integral
+
+    _DEVICE_CASTS = {"long": "cast_long", "bigint": "cast_long",
+                     "int": "cast_int", "integer": "cast_int",
+                     "double": "cast_double", "float": "cast_float"}
+
+    def _device_cast(self, e: Cast) -> Tuple[ValueExpr, bool]:
+        fn = self._DEVICE_CASTS.get(e.type_name.lower())
+        if fn is None:
+            raise PlanError(f"CAST to {e.type_name!r} (host fallback)")
+        v, _vi = self.resolve_value(e.expr)
+        return FuncIR(fn, (v,)), fn in ("cast_long", "cast_int")
+
+    def _device_case(self, e: CaseWhen) -> Tuple[ValueExpr, bool]:
+        if e.else_ is None:
+            # CASE with no ELSE yields NULL for unmatched rows — null
+            # result semantics live on the host path
+            raise PlanError("CASE without ELSE (host fallback)")
+        whens = []
+        integral = True
+        for cond, res in e.whens:
+            pred = _simplify(self._pred(cond))
+            v, vi = self.resolve_value(res)
+            integral = integral and vi
+            whens.append((pred, v))
+        ev, ei = self.resolve_value(e.else_)
+        return CaseIR(tuple(whens), ev), integral and ei
 
     # -- predicates --------------------------------------------------------
     def resolve_filter(self, e: Any) -> Pred:
@@ -893,29 +1048,32 @@ class SegmentPlanner:
             pred = _simplify(And((pred, MaskParam(
                 self.b.add_param(("validdocs", None))))))
 
-        # group-by feasibility
+        # group-by feasibility: column keys (dict ids) or expression
+        # keys with a metadata-derivable bounded integer range
         group_cols: List[str] = []
         group_keys: List[Tuple[int, int]] = []
+        gspecs: List[tuple] = []   # ("col", name, card)|("expr", g, lo, stride, card)
         if ctx.is_group_by:
             dense_ok = True
             space = 1
             for g in ctx.group_by:
-                if not isinstance(g, Identifier):
+                if isinstance(g, Identifier):
+                    m = seg.columns.get(g.name)
+                    if m is None or not m.has_dict or m.cardinality == 0 \
+                            or not getattr(m, "single_value", True):
+                        # virtual / raw / MV keys stay host-side
+                        dense_ok = False
+                        break
+                    gspecs.append(("col", g.name, m.cardinality))
+                    space *= max(m.cardinality, 1)
+                    continue
+                rng = self._expr_key_range(g)
+                if rng is None:
                     dense_ok = False
                     break
-                m = seg.columns.get(g.name)
-                if m is None:
-                    # virtual columns passed validation; they group on
-                    # the host path
-                    dense_ok = False
-                    break
-                if not m.has_dict or m.cardinality == 0 \
-                        or not getattr(m, "single_value", True):
-                    # MV group keys (row joins every value's group,
-                    # reference MV GroupKeyGenerator) stay host-side
-                    dense_ok = False
-                    break
-                space *= max(m.cardinality, 1)
+                lo, stride, card = rng
+                gspecs.append(("expr", g, lo, stride, card))
+                space *= max(card, 1)
             from ..ops.kernels import COMPACT_GROUP_LIMIT
             space_cap = max(MAX_DENSE_GROUPS, COMPACT_GROUP_LIMIT)
             if not dense_ok or space > space_cap:
@@ -947,12 +1105,27 @@ class SegmentPlanner:
                     return CompiledPlan("host", seg, ctx)
 
         strategy = "dense"
+        key_exprs: List[Any] = []
+        group_decoders: List[tuple] = []
         if ctx.is_group_by:
-            for g in ctx.group_by:
-                m = seg.columns[g.name]
-                idx = self.b.bind_col(g.name)
-                group_keys.append((idx, m.cardinality))
-                group_cols.append(g.name)
+            try:
+                for spec in gspecs:
+                    if spec[0] == "col":
+                        _tag, name, card = spec
+                        idx = self.b.bind_col(name)
+                        group_keys.append((idx, card))
+                        key_exprs.append(None)
+                        group_cols.append(name)
+                        group_decoders.append(("dict", name, card))
+                    else:
+                        _tag, g, lo, stride, card = spec
+                        ve = self._expr_key_ir(g, lo, stride)
+                        group_keys.append((0, card))
+                        key_exprs.append(ve)
+                        group_cols.append(_expr_label_of(g))
+                        group_decoders.append(("int", lo, stride, card))
+            except PlanError:
+                return CompiledPlan("host", seg, ctx)
             space = 1
             for _, c in group_keys:
                 space *= max(c, 1)
@@ -966,7 +1139,8 @@ class SegmentPlanner:
             # lexicographic sort)
             from ..ops.ir import MvReduce as _MvR
             compact_ok = (
-                space <= COMPACT_GROUP_LIMIT
+                not any(e is not None for e in key_exprs)
+                and space <= COMPACT_GROUP_LIMIT
                 and all(s.kind in ("count", "sum", "avg", "min", "max")
                         for s in specs)
                 # MV value columns are (bucket, maxValues) matrices; the
@@ -974,10 +1148,14 @@ class SegmentPlanner:
                 and not any(isinstance(s.value, _MvR) for s in specs))
             # dense-strategy viability (one-hot over all rows)
             dense_viable = space <= MAX_DENSE_GROUPS
-            if slow_scatter and seg.bucket * (space + 1) > DENSE_ONEHOT_BUDGET:
+            has_expr_keys = any(e is not None for e in key_exprs)
+            if (slow_scatter or has_expr_keys) and \
+                    seg.bucket * (space + 1) > DENSE_ONEHOT_BUDGET:
                 # the (bucket, space) int8 one-hot operand would not fit /
                 # would dominate HBM traffic; matched-row compaction first
-                # is strictly better at any real selectivity
+                # is strictly better at any real selectivity. Expression
+                # keys can't compact (no key column to gather), so the
+                # budget gates them to host on every backend.
                 dense_viable = False
             for s in specs:
                 if s.kind == "distinct_count" and s.card is not None \
@@ -995,13 +1173,17 @@ class SegmentPlanner:
 
         plan = KernelPlan(pred=pred, aggs=tuple(specs),
                           group_keys=tuple(group_keys),
-                          strategy=strategy)
+                          strategy=strategy,
+                          key_exprs=(tuple(key_exprs)
+                                     if any(e is not None
+                                            for e in key_exprs) else ()))
         return CompiledPlan("kernel", seg, ctx,
                             col_names=list(self.b.cols),
                             kernel_plan=plan,
                             params=list(self.b.params),
                             agg_bindings=bindings,
-                            group_cols=group_cols)
+                            group_cols=group_cols,
+                            group_decoders=group_decoders)
 
     def _try_fast_path(self) -> Optional[CompiledPlan]:
         """Metadata/dictionary-only answers (AggregationPlanNode.java:98-112

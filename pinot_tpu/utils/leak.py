@@ -46,10 +46,14 @@ def track(obj: Any, kind: str, name: str = "") -> None:
 
 def live(kind: str = None) -> List[tuple]:
     """(kind, name) for every still-alive tracked resource."""
+    return [(k, n) for _key, (k, n) in _live_entries(kind)]
+
+
+def _live_entries(kind: str = None) -> List[tuple]:
     gc.collect()
     with _LOCK:
-        entries = list(_LIVE.values())
-    return [(k, n) for k, n, r in entries
+        entries = list(_LIVE.items())
+    return [(key, (k, n)) for key, (k, n, r) in entries
             if r() is not None and (kind is None or k == kind)]
 
 
@@ -64,9 +68,10 @@ def leak_check(kind: str = None):
             ... query ...
             del seg
     """
-    before = {(k, n) for k, n in live(kind)}
+    # diff ledger KEYS (unique per track call): an identically-named
+    # pre-existing resource must not mask a leaked newcomer
+    before = {key for key, _ in _live_entries(kind)}
     yield
-    after = live(kind)
-    leaked = [e for e in after if e not in before]
+    leaked = [e for key, e in _live_entries(kind) if key not in before]
     if leaked:
         raise AssertionError(f"leaked resources: {leaked}")

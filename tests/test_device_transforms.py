@@ -1,0 +1,221 @@
+"""Device transform lowering: CASE/CAST/datetime on the kernel path,
+expression group keys, host-path agreement oracles.
+
+Reference test strategy analog: pinot-core transform function tests
+(DateTimeFunctionsTest, CaseTransformFunctionTest,
+CastTransformFunctionTest) + group-by with transform expressions in
+InterSegmentAggregationMultiValueQueriesTest."""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.query.context import build_query_context
+from pinot_tpu.query.planner import SegmentPlanner
+from pinot_tpu.query.sql import parse_sql
+from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+N = 30000
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    rng = np.random.default_rng(17)
+    # spans 1951..2033: pre-1970 negative epoch millis exercise floor
+    # division; timestamps land at arbitrary ms offsets
+    ts = rng.integers(-600_000_000_000, 2_000_000_000_000, N) \
+        .astype(np.int64)
+    amt = rng.integers(1, 100, N).astype(np.int64)
+    price = rng.uniform(0.5, 99.5, N)
+    schema = Schema("tx", [
+        FieldSpec("ts", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("amt", DataType.LONG, FieldType.METRIC),
+        FieldSpec("price", DataType.DOUBLE, FieldType.METRIC)])
+    segs = []
+    out = tmp_path_factory.mktemp("tx")
+    dm = TableDataManager("tx")
+    for i, sl in enumerate((slice(0, N // 2), slice(N // 2, N))):
+        d = SegmentBuilder(schema, TableConfig("tx")).build(
+            {"ts": ts[sl], "amt": amt[sl], "price": price[sl]},
+            str(out), f"s{i}")
+        segs.append(ImmutableSegment.load(d))
+        dm.add_segment(segs[-1])
+    b = Broker()
+    b.register_table(dm)
+    return b, segs[0], {"ts": ts, "amt": amt, "price": price}
+
+
+def _plan_kind(seg, sql):
+    plan = SegmentPlanner(build_query_context(parse_sql(sql)), seg).plan()
+    return plan.kind, plan
+
+
+def _dt(ts):
+    return ts.astype("datetime64[ms]")
+
+
+def test_datetime_fields_device_match_host(table):
+    b, seg, data = table
+    ts = data["ts"]
+    d = _dt(ts)
+    day = d.astype("datetime64[D]")
+    oracles = {
+        "YEAR": d.astype("datetime64[Y]").astype(np.int64) + 1970,
+        "MONTH": (d.astype("datetime64[M]")
+                  - d.astype("datetime64[Y]")).astype(np.int64) + 1,
+        "DAY": (day - d.astype("datetime64[M]")).astype(np.int64) + 1,
+        "HOUR": (d.astype("datetime64[h]") - day).astype(np.int64),
+        "MINUTE": (d.astype("datetime64[m]")
+                   - d.astype("datetime64[h]")).astype(np.int64),
+        "SECOND": (d.astype("datetime64[s]")
+                   - d.astype("datetime64[m]")).astype(np.int64),
+        "DAYOFWEEK": (day.astype(np.int64) + 3) % 7 + 1,
+        "QUARTER": ((d.astype("datetime64[M]")
+                     - d.astype("datetime64[Y]")).astype(np.int64)) // 3
+        + 1,
+    }
+    for fn, oracle in oracles.items():
+        sql = (f"SELECT {fn}(ts), COUNT(*) FROM tx GROUP BY 1 "
+               "ORDER BY 1 LIMIT 100000")
+        kind, _ = _plan_kind(seg, sql)
+        assert kind == "kernel", fn
+        rows = b.query(sql).rows
+        assert len(rows) == len(np.unique(oracle)), fn
+        for k, cnt in rows:
+            assert cnt == int((oracle == k).sum()), (fn, k)
+
+
+def test_datetrunc_group_key_device(table, tmp_path):
+    # wide-span table: key spaces exceed the one-hot budget -> host path
+    # serves and agrees with the oracle
+    b, seg, data = table
+    ts = data["ts"]
+    for unit, stride in (("day", 86_400_000), ("hour", 3_600_000)):
+        oracle = np.floor_divide(ts, stride) * stride
+        sql = (f"SELECT DATETRUNC('{unit}', ts), COUNT(*) FROM tx "
+               "GROUP BY 1 ORDER BY 2 DESC, 1 LIMIT 100000")
+        rows = b.query(sql).rows
+        assert len(rows) == len(np.unique(oracle))
+        got = {r[0]: r[1] for r in rows}
+        uniq, counts = np.unique(oracle, return_counts=True)
+        assert got == {int(u): int(c) for u, c in zip(uniq, counts)}
+    # narrow-span segment (how time-partitioned tables actually look):
+    # day-trunc keys stay on the kernel path
+    rng = np.random.default_rng(23)
+    nts = rng.integers(1_700_000_000_000, 1_705_184_000_000, 8000) \
+        .astype(np.int64)   # ~60 days
+    schema = Schema("nt", [
+        FieldSpec("ts", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("amt", DataType.LONG, FieldType.METRIC)])
+    d = SegmentBuilder(schema, TableConfig("nt")).build(
+        {"ts": nts, "amt": np.ones(8000, dtype=np.int64)},
+        str(tmp_path), "s0")
+    nseg = ImmutableSegment.load(d)
+    dm = TableDataManager("nt")
+    dm.add_segment(nseg)
+    nb = Broker()
+    nb.register_table(dm)
+    sql = ("SELECT DATETRUNC('day', ts), COUNT(*) FROM nt GROUP BY 1 "
+           "ORDER BY 1 LIMIT 100000")
+    kind, _ = _plan_kind(nseg, sql)
+    assert kind == "kernel"
+    oracle = np.floor_divide(nts, 86_400_000) * 86_400_000
+    uniq, counts = np.unique(oracle, return_counts=True)
+    assert {r[0]: r[1] for r in nb.query(sql).rows} == \
+        {int(u): int(c) for u, c in zip(uniq, counts)}
+
+
+def test_datetrunc_week_alignment(table):
+    b, seg, data = table
+    ts = data["ts"]
+    days = np.floor_divide(ts, 86_400_000)
+    week_ms = (np.floor_divide(days + 3, 7) * 7 - 3) * 86_400_000
+    sql = ("SELECT DATETRUNC('week', ts), COUNT(*) FROM tx GROUP BY 1 "
+           "ORDER BY 1 LIMIT 100000")
+    rows = b.query(sql).rows
+    uniq, counts = np.unique(week_ms, return_counts=True)
+    assert {r[0]: r[1] for r in rows} == \
+        {int(u): int(c) for u, c in zip(uniq, counts)}
+    # every key is a Monday (ISO week start)
+    for k, _c in rows[:20]:
+        d = np.int64(k) // 86_400_000
+        assert (d + 3) % 7 == 0
+
+
+def test_filter_on_datetime_expression(table):
+    b, seg, data = table
+    years = _dt(data["ts"]).astype("datetime64[Y]").astype(np.int64) + 1970
+    sql = "SELECT SUM(amt), COUNT(*) FROM tx WHERE YEAR(ts) = 2020"
+    kind, _ = _plan_kind(seg, sql)
+    assert kind == "kernel"
+    m = years == 2020
+    assert b.query(sql).rows[0] == (int(data["amt"][m].sum()),
+                                    int(m.sum()))
+
+
+def test_case_when_aggregation_device(table):
+    b, seg, data = table
+    amt = data["amt"]
+    sql = ("SELECT SUM(CASE WHEN amt > 50 THEN amt ELSE 0 END), "
+           "SUM(CASE WHEN amt > 75 THEN 2 WHEN amt > 25 THEN 1 "
+           "ELSE 0 END) FROM tx")
+    kind, _ = _plan_kind(seg, sql)
+    assert kind == "kernel"
+    r = b.query(sql).rows[0]
+    assert r[0] == int(amt[amt > 50].sum())
+    assert r[1] == int(2 * (amt > 75).sum()
+                       + ((amt > 25) & (amt <= 75)).sum())
+
+
+def test_cast_device(table):
+    b, seg, data = table
+    sql = ("SELECT SUM(CAST(amt AS DOUBLE) / 4), "
+           "SUM(CAST(price AS LONG)) FROM tx")
+    kind, _ = _plan_kind(seg, sql)
+    assert kind == "kernel"
+    r = b.query(sql).rows[0]
+    assert r[0] == pytest.approx(float((data["amt"] / 4).sum()), rel=1e-9)
+    assert r[1] == int(np.trunc(data["price"]).sum())
+
+
+def test_case_without_else_hosts(table):
+    _b, seg, _data = table
+    kind, _ = _plan_kind(
+        seg, "SELECT SUM(CASE WHEN amt > 50 THEN amt END) FROM tx")
+    assert kind == "host"
+
+
+def test_month_trunc_hosts_but_agrees(table):
+    # month truncation has no fixed stride: host path serves it, and the
+    # answer still matches the oracle
+    b, _seg, data = table
+    d = _dt(data["ts"]).astype("datetime64[M]")
+    oracle = d.astype("datetime64[ms]").astype(np.int64)
+    rows = b.query("SELECT DATETRUNC('month', ts), COUNT(*) FROM tx "
+                   "GROUP BY 1 ORDER BY 1 LIMIT 100000").rows
+    uniq, counts = np.unique(oracle, return_counts=True)
+    assert {r[0]: r[1] for r in rows} == \
+        {int(u): int(c) for u, c in zip(uniq, counts)}
+
+
+def test_week_trunc_host_matches_device(table):
+    # review regression: host dateTrunc('week') must use the ISO Monday
+    # anchor the device lowering uses, not numpy's Thursday-epoch weeks
+    from pinot_tpu.query.functions import call
+    _b, _seg, data = table
+    ts = data["ts"]
+    host = call("datetrunc", np.asarray("week"), ts)
+    days = np.floor_divide(ts, 86_400_000)
+    device_semantics = (np.floor_divide(days + 3, 7) * 7 - 3) * 86_400_000
+    np.testing.assert_array_equal(np.asarray(host, dtype=np.int64),
+                                  device_semantics)
+
+
+def test_abs_preserves_int_dtype():
+    from pinot_tpu.query.functions import call
+    big = np.array([-(2 ** 60), 2 ** 60 - 7], dtype=np.int64)
+    out = call("abs", big)
+    assert out.dtype == np.int64
+    np.testing.assert_array_equal(out, np.abs(big))
