@@ -135,7 +135,19 @@ def _eval_value(ve: ValueExpr, cols, params, promote: bool = False
         return _eval_func(ve.name, args)
     if isinstance(ve, Case):
         out = _eval_value(ve.else_, cols, params, promote)
-        bucket = cols[0].shape[0] if cols else out.shape[0]
+        if cols:
+            bucket = cols[0].shape[0]
+        elif out.ndim:
+            bucket = out.shape[0]
+        else:
+            # all-literal CASE (predicates const-folded, no columns):
+            # stay scalar; broadcasting happens at the consumer
+            for pred, val in reversed(ve.whens):
+                m = jnp.reshape(_eval_pred(pred, cols, params, 1), (-1,))[0]
+                v = _eval_value(val, cols, params, promote)
+                ct = jnp.promote_types(v.dtype, out.dtype)
+                out = jnp.where(m, v.astype(ct), out.astype(ct))
+            return out
         out = jnp.broadcast_to(out, (bucket,) + out.shape[1:])
         # reverse order: the first matching WHEN must win
         for pred, val in reversed(ve.whens):

@@ -1,0 +1,136 @@
+"""Pluggable metrics sinks: statsd lines, Prometheus textfiles, callbacks.
+
+Reference parity: pinot-plugins/pinot-metrics/ — the yammer/dropwizard
+PinotMetricsFactory implementations behind the metrics SPI, chosen by
+config name (pinot.broker.metrics.factory.className). Here each sink is
+a plugin (spi/plugin.py short names "statsd", "prometheus_file",
+"callback") fed by a periodic flush task, so operators wire exporters
+without touching engine code.
+"""
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+from ..cluster.periodic import BasePeriodicTask
+from .metrics import MetricsRegistry, global_metrics
+
+
+class MetricsSink:
+    """emit() receives a MetricsRegistry.snapshot() dict."""
+
+    def emit(self, snapshot: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StatsdSink(MetricsSink):
+    """Fire-and-forget UDP statsd lines (counters |c, gauges |g, timer
+    p50/p99 as gauges) — the statsd/datadog exporter shape."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "pinot_tpu"):
+        self.addr = (host, int(port))
+        self.prefix = prefix
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._last_counters: Dict[str, int] = {}
+
+    def emit(self, snapshot: Dict[str, Any]) -> None:
+        lines: List[str] = []
+        sent_counters: List[tuple] = []
+        for k, v in snapshot["counters"].items():
+            delta = v - self._last_counters.get(k, 0)
+            if delta:
+                lines.append(f"{self.prefix}.{k}:{delta}|c")
+                sent_counters.append((k, v))
+        for k, v in snapshot["gauges"].items():
+            lines.append(f"{self.prefix}.{k}:{v}|g")
+        for k, t in snapshot["timers"].items():
+            lines.append(f"{self.prefix}.{k}.p50:{t['p50']:.3f}|g")
+            lines.append(f"{self.prefix}.{k}.p99:{t['p99']:.3f}|g")
+        for line in lines:
+            try:
+                self.sock.sendto(line.encode(), self.addr)
+            except OSError:
+                return  # exporter gone: drop, never fail the engine —
+                # counter marks stay un-advanced so the deltas re-emit
+                # on the next flush
+        # only a fully sent flush advances the delta baseline
+        for k, v in sent_counters:
+            self._last_counters[k] = v
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class PrometheusFileSink(MetricsSink):
+    """Atomic textfile for the node-exporter textfile collector."""
+
+    def __init__(self, path: str, prefix: str = "pinot_tpu"):
+        self.path = path
+        self.prefix = prefix
+
+    def emit(self, snapshot: Dict[str, Any]) -> None:
+        # render from the SNAPSHOT (the sink contract) — not from some
+        # registry of our own, which would export the wrong metrics when
+        # the flush task carries a non-global registry
+        lines: List[str] = []
+        for k, v in snapshot["counters"].items():
+            lines.append(f"{self.prefix}_{k}_total {v}")
+        for k, v in snapshot["gauges"].items():
+            lines.append(f"{self.prefix}_{k} {v}")
+        for k, t in snapshot["timers"].items():
+            lines.append(f"{self.prefix}_{k}_ms_p50 {t['p50']:.3f}")
+            lines.append(f"{self.prefix}_{k}_ms_p99 {t['p99']:.3f}")
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        os.replace(tmp, self.path)
+
+
+class CallbackSink(MetricsSink):
+    def __init__(self, fn: Callable[[Dict[str, Any]], None]):
+        self.fn = fn
+
+    def emit(self, snapshot: Dict[str, Any]) -> None:
+        self.fn(snapshot)
+
+
+class MetricsFlushTask(BasePeriodicTask):
+    """Periodic emitter: snapshot once, fan out to every sink
+    (the metrics factory's scheduled reporters analog)."""
+
+    def __init__(self, sinks: List[MetricsSink], interval_s: float = 10.0,
+                 registry: MetricsRegistry = None):
+        super().__init__("metricsFlush", interval_s, self._flush)
+        self.sinks = list(sinks)
+        self.registry = registry or global_metrics
+
+    def _flush(self) -> None:
+        snap = self.registry.snapshot()
+        for sink in self.sinks:
+            sink.emit(snap)
+
+
+def sinks_from_config(conf: List[Dict[str, Any]]) -> List[MetricsSink]:
+    """[{"type": "statsd", "host": ..., ...}, ...] -> sink instances via
+    the plugin loader (createInstance by config name)."""
+    from ..spi.plugin import create_instance
+    out: List[MetricsSink] = []
+    for entry in conf:
+        kwargs = {k: v for k, v in entry.items() if k != "type"}
+        out.append(create_instance(entry["type"], **kwargs))
+    return out
+
+
+def _register() -> None:
+    from ..spi.plugin import register_plugin
+    register_plugin("statsd", StatsdSink)
+    register_plugin("prometheus_file", PrometheusFileSink)
+    register_plugin("callback", CallbackSink)
+
+
+_register()

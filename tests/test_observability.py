@@ -123,3 +123,59 @@ def test_explain_join_does_not_execute(broker, tmp_path):
     ops = [r[0] for r in res.rows]
     assert any(o.startswith("HASH_JOIN") for o in ops)
     assert sum(1 for o in ops if o.startswith("LEAF_SCAN")) == 2
+
+
+# ---------------------------------------------------------------------------
+# pluggable metrics sinks (pinot-plugins/pinot-metrics analog)
+# ---------------------------------------------------------------------------
+
+def test_statsd_sink_emits_deltas_over_udp():
+    import socket
+    from pinot_tpu.utils.metrics import MetricsRegistry
+    from pinot_tpu.utils.metrics_sinks import StatsdSink
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(2.0)
+    port = rx.getsockname()[1]
+    reg = MetricsRegistry()
+    reg.count("queries", 5)
+    reg.gauge("segments", 7)
+    sink = StatsdSink("127.0.0.1", port)
+    sink.emit(reg.snapshot())
+    got = set()
+    for _ in range(2):
+        got.add(rx.recv(1024).decode())
+    assert "pinot_tpu.queries:5|c" in got
+    assert "pinot_tpu.segments:7|g" in got
+    # second flush with no new counts emits no counter delta
+    reg.count("queries", 2)
+    sink.emit(reg.snapshot())
+    assert rx.recv(1024).decode() == "pinot_tpu.queries:2|c"
+    sink.close()
+    rx.close()
+
+
+def test_prometheus_file_sink_atomic(tmp_path):
+    from pinot_tpu.utils.metrics import MetricsRegistry
+    from pinot_tpu.utils.metrics_sinks import PrometheusFileSink
+    reg = MetricsRegistry()
+    reg.count("served", 3)
+    path = str(tmp_path / "pinot.prom")
+    sink = PrometheusFileSink(path)
+    sink.emit(reg.snapshot())
+    text = open(path).read()
+    assert "pinot_tpu_served_total 3" in text
+
+
+def test_metrics_flush_task_and_plugin_config():
+    from pinot_tpu.utils.metrics import MetricsRegistry
+    from pinot_tpu.utils.metrics_sinks import (MetricsFlushTask,
+                                               sinks_from_config)
+    seen = []
+    reg = MetricsRegistry()
+    reg.count("x", 1)
+    sinks = sinks_from_config([{"type": "callback",
+                                "fn": lambda s: seen.append(s)}])
+    task = MetricsFlushTask(sinks, interval_s=0.01, registry=reg)
+    task.run_once()
+    assert seen and seen[0]["counters"]["x"] == 1

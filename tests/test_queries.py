@@ -319,3 +319,11 @@ def test_is_null_filters(tmp_path):
         == [(2,)]
     # default null-handling: null v indexed as default 0 still counts in SUM
     assert rows_of(b.query("SELECT SUM(v) FROM nt")) == [(3,)]
+
+
+def test_all_literal_case_kernel(broker):
+    # CASE with no column references (predicates const-fold) must not
+    # crash the kernel path (review regression)
+    r = broker.query(
+        "SELECT SUM(CASE WHEN 1 = 1 THEN 1 ELSE 0 END) FROM stats")
+    assert r.rows[0][0] == N_ROWS
