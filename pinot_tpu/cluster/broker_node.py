@@ -384,9 +384,68 @@ class BrokerNode:
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
+                ("GET", "/ui"): lambda h, b: (
+                    200, ("text/html", node.ui_page())),
                 ("POST", "/query/sql"): q,
             }
         return Handler
+
+    def ui_page(self) -> str:
+        """Query console (GET /ui): the broker-side piece of the
+        reference's controller web app (its Query Console tab posts to
+        the broker exactly like this page). Server-rendered shell +
+        vanilla JS against the existing /query/sql endpoint."""
+        return """<!doctype html><html><head><title>pinot-tpu console</title>
+<style>
+ body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+ textarea{width:100%;height:6em;background:#1b1b1b;color:#ddd;
+   border:1px solid #444;padding:.5em;font-family:monospace}
+ button{margin:.5em 0;padding:.4em 1.2em;background:#2a6;border:0;
+   color:#fff;cursor:pointer}
+ table{border-collapse:collapse;margin-top:1em}
+ td,th{border:1px solid #444;padding:.25em .6em;text-align:left}
+ th{background:#222}
+ #stats{color:#8a8;margin-top:.5em}
+ #err{color:#e66;white-space:pre-wrap}
+</style></head><body>
+<h2>pinot-tpu query console</h2>
+<textarea id=sql>SELECT * FROM mytable LIMIT 10</textarea><br>
+<button onclick=run()>Run (Ctrl-Enter)</button>
+<div id=stats></div><div id=err></div><div id=out></div>
+<script>
+const esc=s=>String(s).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+const sqlEl=document.getElementById('sql');
+sqlEl.addEventListener('keydown',e=>{
+  if(e.ctrlKey&&e.key==='Enter')run();});
+async function run(){
+  const t0=performance.now();
+  document.getElementById('err').textContent='';
+  document.getElementById('out').innerHTML='';
+  let j;
+  try{
+    const r=await fetch('/query/sql',{method:'POST',
+      headers:{'Content-Type':'application/json'},
+      body:JSON.stringify({sql:sqlEl.value})});
+    j=await r.json();
+  }catch(e){document.getElementById('err').textContent=e;return;}
+  if(j.error){document.getElementById('err').textContent=j.error;return;}
+  const rt=j.resultTable||j;
+  const cols=(rt.dataSchema&&rt.dataSchema.columnNames)||rt.columns||[];
+  const rows=rt.rows||[];
+  let h='<table><tr>'+cols.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>';
+  for(const row of rows)
+    h+='<tr>'+row.map(v=>'<td>'+esc(v)+'</td>').join('')+'</tr>';
+  h+='</table>';
+  document.getElementById('out').innerHTML=h;
+  const ms=(performance.now()-t0).toFixed(1);
+  const srvMs=j.timeUsedMs!==undefined?j.timeUsedMs:j.timeMs;
+  document.getElementById('stats').textContent=
+    rows.length+' rows | server '+(srvMs!==undefined?
+    srvMs.toFixed(1):'?')+' ms | wall '+ms+' ms | docs scanned '+
+    (j.numDocsScanned!==undefined?j.numDocsScanned:'?');
+}
+</script></body></html>"""
 
     def stop(self) -> None:
         self._stop.set()

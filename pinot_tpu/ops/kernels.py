@@ -135,27 +135,20 @@ def _eval_value(ve: ValueExpr, cols, params, promote: bool = False
         return _eval_func(ve.name, args)
     if isinstance(ve, Case):
         out = _eval_value(ve.else_, cols, params, promote)
-        if cols:
-            bucket = cols[0].shape[0]
-        elif out.ndim:
-            bucket = out.shape[0]
-        else:
-            # all-literal CASE (predicates const-folded, no columns):
-            # stay scalar; broadcasting happens at the consumer
-            for pred, val in reversed(ve.whens):
-                m = jnp.reshape(_eval_pred(pred, cols, params, 1), (-1,))[0]
-                v = _eval_value(val, cols, params, promote)
-                ct = jnp.promote_types(v.dtype, out.dtype)
-                out = jnp.where(m, v.astype(ct), out.astype(ct))
-            return out
+        # all-literal CASE (no columns, predicates const-folded) folds at
+        # bucket 1 and returns a scalar for the consumer to broadcast
+        scalar = not cols and not out.ndim
+        bucket = (cols[0].shape[0] if cols
+                  else (out.shape[0] if out.ndim else 1))
         out = jnp.broadcast_to(out, (bucket,) + out.shape[1:])
         # reverse order: the first matching WHEN must win
         for pred, val in reversed(ve.whens):
-            m = _eval_pred(pred, cols, params, bucket)
+            m = jnp.reshape(_eval_pred(pred, cols, params, bucket),
+                            (bucket,))
             v = _eval_value(val, cols, params, promote)
             ct = jnp.promote_types(v.dtype, out.dtype)
             out = jnp.where(m, v.astype(ct), out.astype(ct))
-        return out
+        return out[0] if scalar else out
     raise TypeError(f"unknown value expr {ve!r}")
 
 

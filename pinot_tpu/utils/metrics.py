@@ -58,16 +58,23 @@ class MetricsRegistry:
                     "timers": timers}
 
     def prometheus(self) -> str:
-        snap = self.snapshot()
-        lines = []
-        for k, v in snap["counters"].items():
-            lines.append(f"pinot_tpu_{k}_total {v}")
-        for k, v in snap["gauges"].items():
-            lines.append(f"pinot_tpu_{k} {v}")
-        for k, t in snap["timers"].items():
-            lines.append(f"pinot_tpu_{k}_ms_p50 {t['p50']:.3f}")
-            lines.append(f"pinot_tpu_{k}_ms_p99 {t['p99']:.3f}")
-        return "\n".join(lines) + "\n"
+        return render_prometheus(self.snapshot())
+
+
+def render_prometheus(snapshot: Dict[str, Any],
+                      prefix: str = "pinot_tpu") -> str:
+    """Prometheus exposition text from a snapshot — the ONE place the
+    name/suffix rules live (the /metrics endpoints and the textfile sink
+    both render through here)."""
+    lines = []
+    for k, v in snapshot["counters"].items():
+        lines.append(f"{prefix}_{k}_total {v}")
+    for k, v in snapshot["gauges"].items():
+        lines.append(f"{prefix}_{k} {v}")
+    for k, t in snapshot["timers"].items():
+        lines.append(f"{prefix}_{k}_ms_p50 {t['p50']:.3f}")
+        lines.append(f"{prefix}_{k}_ms_p99 {t['p99']:.3f}")
+    return "\n".join(lines) + "\n"
 
 
 global_metrics = MetricsRegistry()

@@ -39,13 +39,21 @@ class StatsdSink(MetricsSink):
         self._last_counters: Dict[str, int] = {}
 
     def emit(self, snapshot: Dict[str, Any]) -> None:
-        lines: List[str] = []
-        sent_counters: List[tuple] = []
+        # counters first, each advancing its baseline as its datagram is
+        # handed to the kernel: a mid-flush OSError then neither loses a
+        # delivered delta (no re-send) nor drops an unsent one (re-emits
+        # next flush); gauges/timers are absolute and safely droppable
         for k, v in snapshot["counters"].items():
             delta = v - self._last_counters.get(k, 0)
-            if delta:
-                lines.append(f"{self.prefix}.{k}:{delta}|c")
-                sent_counters.append((k, v))
+            if not delta:
+                continue
+            try:
+                self.sock.sendto(f"{self.prefix}.{k}:{delta}|c".encode(),
+                                 self.addr)
+            except OSError:
+                return  # exporter gone: never fail the engine
+            self._last_counters[k] = v
+        lines: List[str] = []
         for k, v in snapshot["gauges"].items():
             lines.append(f"{self.prefix}.{k}:{v}|g")
         for k, t in snapshot["timers"].items():
@@ -55,12 +63,7 @@ class StatsdSink(MetricsSink):
             try:
                 self.sock.sendto(line.encode(), self.addr)
             except OSError:
-                return  # exporter gone: drop, never fail the engine —
-                # counter marks stay un-advanced so the deltas re-emit
-                # on the next flush
-        # only a fully sent flush advances the delta baseline
-        for k, v in sent_counters:
-            self._last_counters[k] = v
+                return
 
     def close(self) -> None:
         self.sock.close()
@@ -74,20 +77,12 @@ class PrometheusFileSink(MetricsSink):
         self.prefix = prefix
 
     def emit(self, snapshot: Dict[str, Any]) -> None:
-        # render from the SNAPSHOT (the sink contract) — not from some
-        # registry of our own, which would export the wrong metrics when
-        # the flush task carries a non-global registry
-        lines: List[str] = []
-        for k, v in snapshot["counters"].items():
-            lines.append(f"{self.prefix}_{k}_total {v}")
-        for k, v in snapshot["gauges"].items():
-            lines.append(f"{self.prefix}_{k} {v}")
-        for k, t in snapshot["timers"].items():
-            lines.append(f"{self.prefix}_{k}_ms_p50 {t['p50']:.3f}")
-            lines.append(f"{self.prefix}_{k}_ms_p99 {t['p99']:.3f}")
+        # renders from the SNAPSHOT (the sink contract) through the one
+        # shared exposition formatter
+        from .metrics import render_prometheus
         tmp = self.path + ".tmp"
         with open(tmp, "w") as fh:
-            fh.write("\n".join(lines) + "\n")
+            fh.write(render_prometheus(snapshot, self.prefix))
         os.replace(tmp, self.path)
 
 
@@ -112,7 +107,12 @@ class MetricsFlushTask(BasePeriodicTask):
     def _flush(self) -> None:
         snap = self.registry.snapshot()
         for sink in self.sinks:
-            sink.emit(snap)
+            try:
+                sink.emit(snap)
+            except Exception:
+                # one broken exporter (read-only textfile path, closed
+                # socket) must not starve the sinks after it
+                continue
 
 
 def sinks_from_config(conf: List[Dict[str, Any]]) -> List[MetricsSink]:

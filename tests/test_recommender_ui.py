@@ -132,3 +132,42 @@ def test_admin_recommend_command(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out)
     assert "cust" in out["tableConfig"]["indexing"]["bloomFilterColumns"]
     assert out["tableConfig"]["indexing"]["sortedColumn"] == "amount"
+
+
+def test_broker_query_console_page(tmp_path):
+    """GET /ui on the broker serves the query console; the page's fetch
+    target /query/sql answers with the shape the JS renders."""
+    import json
+    import urllib.request
+
+    from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.spi import TableConfig
+    ctrl = Controller(str(tmp_path / "c"), reconcile_interval=0.1)
+    srv = ServerNode("s1", ctrl.url, poll_interval=0.1)
+    brk = BrokerNode(ctrl.url, routing_refresh=0.1)
+    try:
+        schema = Schema("ev", [FieldSpec("v", DataType.INT,
+                                         FieldType.METRIC)])
+        ctrl.add_table("ev", schema.to_dict(), replication=1)
+        d = SegmentBuilder(schema, TableConfig("ev")).build(
+            {"v": np.arange(5, dtype=np.int32)}, str(tmp_path), "seg_0")
+        ctrl.add_segment("ev", "seg_0", d)
+        v = ctrl.routing_snapshot()["version"]
+        assert srv.wait_for_version(v)
+        assert brk.wait_for_version(v)
+        req = urllib.request.Request(
+            brk.url + "/query/sql",
+            data=json.dumps({"sql": "SELECT COUNT(*) FROM ev"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["resultTable"]["rows"][0][0] == 5
+        with urllib.request.urlopen(brk.url + "/ui", timeout=10) as r:
+            assert "text/html" in r.headers["Content-Type"]
+            html = r.read().decode()
+        assert "query console" in html and "/query/sql" in html
+    finally:
+        brk.stop()
+        srv.stop()
+        ctrl.stop()
