@@ -5,9 +5,13 @@ Reference parity: pinot-spi/.../ingestion/batch/spec/
 SegmentGenerationJobSpec + pinot-plugins/pinot-batch-ingestion/
 pinot-batch-ingestion-standalone (the standalone runner) with the two
 push modes: tar/metadata push to a controller (deep store) or plain
-local segment output. Spark/Hadoop runners in the reference parallelize
-the same per-file work; here files chunk into segments serially (a
-process pool can slot in behind run() without changing the spec).
+local segment output. The reference's Spark/Hadoop runners
+(pinot-batch-ingestion-spark SparkSegmentGenerationJobRunner) map one
+input file to one segment-generation task across executors; the
+"parallel" execution framework here does the same over a local process
+pool (executionFrameworkSpec: {"name": "parallel", "numWorkers": N}) —
+per-file tasks, worker-disjoint segment names, pushes serialized in the
+driver exactly like the reference's runner.
 
 Job spec (dict; JSON/YAML-friendly, SegmentGenerationJobSpec analog):
     {
@@ -66,6 +70,90 @@ class BatchIngestionJob:
 
     # -- run ---------------------------------------------------------------
     def run(self) -> List[str]:
+        fw = (self.spec.get("executionFrameworkSpec") or {})
+        if fw.get("name") in ("parallel", "spark", "hadoop"):
+            return self._run_parallel(int(fw.get("numWorkers") or 0))
+        return self._run_standalone()
+
+    def _run_parallel(self, workers: int) -> List[str]:
+        """Per-file fan-out over WORKER PROCESSES the driver launches
+        (Spark runner analog: one segment-generation task per input
+        file; rowsPerSegment splits within a file). Plain subprocesses
+        running ``python -m pinot_tpu.ingestion.batch --file-task``, not
+        a multiprocessing pool: fork would deadlock a parent holding
+        JAX runtime threads, and spawn/forkserver re-import the parent's
+        __main__ (broken for REPL/stdin drivers). Segment names carry
+        the file index so tasks never collide; pushes happen in the
+        driver, in order."""
+        import json as _json
+        import subprocess
+        import sys
+        import tempfile
+
+        files = self.input_files()
+        workers = workers or min(len(files), os.cpu_count() or 1)
+        push = self.spec.get("push") or {}
+        import time as _time
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as fh:
+            _json.dump(self.spec, fh)
+            spec_path = fh.name
+        procs: List[tuple] = []
+        pending = list(enumerate(files))
+        results: Dict[int, List[str]] = {}
+        try:
+            while pending or procs:
+                while pending and len(procs) < workers:
+                    idx, path = pending.pop(0)
+                    procs.append((idx, subprocess.Popen(
+                        [sys.executable, "-m",
+                         "pinot_tpu.ingestion.batch", "--file-task",
+                         spec_path, path, str(idx)],
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.PIPE)))
+                # reap ANY finished worker (no head-of-line blocking: a
+                # big file must not idle the other slots). Workers emit
+                # one small JSON line, so the un-drained-pipe limit is
+                # never hit before exit.
+                done = [i for i, (_idx, p) in enumerate(procs)
+                        if p.poll() is not None]
+                if not done:
+                    _time.sleep(0.05)
+                    continue
+                for i in reversed(done):
+                    idx, p = procs.pop(i)
+                    out, err = p.communicate()
+                    if p.returncode != 0:
+                        raise RuntimeError(
+                            f"ingestion task {idx} failed: "
+                            f"{err.decode()[-2000:]}")
+                    results[idx] = _json.loads(out.decode())
+            seg_dirs = [d for idx in sorted(results)
+                        for d in results[idx]]
+        finally:
+            # a failed task must not leave siblings running (they would
+            # keep writing segments after the job reported failure)
+            for _idx, p in procs:
+                p.kill()
+                p.wait()
+            os.unlink(spec_path)
+        if not push.get("controllerUrl"):
+            return seg_dirs
+        return [self._push(d, push) for d in seg_dirs]
+
+    def job_params(self):
+        """(fmt, pipeline, out_dir, prefix, per_seg, builder) — the ONE
+        derivation of spec keys both runners share."""
+        return (self.spec.get("format", ""),
+                CompositeTransformer.from_table_config(
+                    self.table_config, self.schema),
+                self.spec["outputDirURI"],
+                self.spec.get("segmentNamePrefix", self.table),
+                int(self.spec.get("rowsPerSegment", 1_000_000)),
+                SegmentBuilder(self.schema, self.table_config))
+
+    def _run_standalone(self) -> List[str]:
         """Execute the job; returns the registered segment locations
         (deep-store URIs in tar-push mode, local dirs otherwise).
 
@@ -74,13 +162,8 @@ class BatchIngestionJob:
         memory is one file plus one segment of rows — never the whole
         dataset (the transform pipeline is row-independent, so chunking
         preserves semantics)."""
-        fmt = self.spec.get("format", "")
-        pipeline = CompositeTransformer.from_table_config(
-            self.table_config, self.schema)
-        out_dir = self.spec["outputDirURI"]
-        prefix = self.spec.get("segmentNamePrefix", self.table)
-        per_seg = int(self.spec.get("rowsPerSegment", 1_000_000))
-        builder = SegmentBuilder(self.schema, self.table_config)
+        fmt, pipeline, out_dir, prefix, per_seg, builder = \
+            self.job_params()
         push = self.spec.get("push") or {}
 
         locations: List[str] = []
@@ -120,5 +203,38 @@ class BatchIngestionJob:
         return location
 
 
+def _build_file_segments(spec: Dict[str, Any], path: str,
+                         file_idx: int) -> List[str]:
+    """One parallel task: read + transform + build segments for ONE
+    input file (module-level so the process pool can pickle it)."""
+    job = BatchIngestionJob(spec)
+    fmt, pipeline, out_dir, prefix, per_seg, builder = job.job_params()
+    rows = pipeline.transform(read_records(path, fmt))
+    out: List[str] = []
+    for k in range(0, max(len(rows), 1), per_seg):
+        chunk = rows[k:k + per_seg]
+        if not chunk:
+            break
+        name = f"{prefix}_{file_idx}_{k // per_seg}"
+        out.append(builder.build(chunk, out_dir, name))
+    return out
+
+
 def run_batch_ingestion(spec: Dict[str, Any]) -> List[str]:
     return BatchIngestionJob(spec).run()
+
+
+if __name__ == "__main__":   # worker entry: --file-task spec.json path idx
+    import json as _json
+    import sys as _sys
+
+    if len(_sys.argv) == 5 and _sys.argv[1] == "--file-task":
+        with open(_sys.argv[2]) as _fh:
+            _spec = _json.load(_fh)
+        _dirs = _build_file_segments(_spec, _sys.argv[3],
+                                     int(_sys.argv[4]))
+        print(_json.dumps(_dirs))
+    else:
+        raise SystemExit(
+            "usage: python -m pinot_tpu.ingestion.batch "
+            "--file-task <spec.json> <input-file> <file-idx>")

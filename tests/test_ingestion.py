@@ -174,3 +174,48 @@ class TestRealtimeTransforms:
         # offsets still advance one per stream row
         assert m._partition_state(0)["next_offset"] == 0  # not sealed yet
         assert m._mutables[0].n_docs == 10
+
+
+def test_parallel_execution_framework(tmp_path):
+    """executionFrameworkSpec 'parallel' (Spark-runner analog): per-file
+    process-pool tasks produce the same table the standalone runner
+    does."""
+    import csv
+
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.ingestion import run_batch_ingestion
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+    rng = np.random.default_rng(31)
+    indir = tmp_path / "in"
+    indir.mkdir()
+    total = 0
+    for i in range(4):
+        with open(indir / f"part_{i}.csv", "w", newline="") as fh:
+            w = csv.writer(fh)
+            w.writerow(["city", "v"])
+            for _ in range(500):
+                w.writerow([rng.choice(["a", "b"]), int(rng.integers(0, 9))])
+                total += 1
+    schema = Schema("pj", [
+        FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC)])
+    spec = {
+        "inputDirURI": str(indir),
+        "includeFileNamePattern": "*.csv",
+        "format": "csv",
+        "outputDirURI": str(tmp_path / "segs"),
+        "tableName": "pj",
+        "schema": schema.to_dict(),
+        "rowsPerSegment": 300,
+        "executionFrameworkSpec": {"name": "parallel", "numWorkers": 2},
+    }
+    locations = run_batch_ingestion(spec)
+    # 4 files x 500 rows at 300/segment = 2 segments per file
+    assert len(locations) == 8
+    dm = TableDataManager("pj")
+    for d in sorted(locations):
+        dm.add_segment_dir(d)
+    b = Broker()
+    b.register_table(dm)
+    assert b.query("SELECT COUNT(*) FROM pj").rows[0][0] == total
