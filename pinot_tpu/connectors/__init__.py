@@ -1,0 +1,91 @@
+"""Ecosystem connectors: query results and raw segments as pandas /
+numpy / torch structures.
+
+Reference parity: pinot-connectors/ (pinot-spark-connector,
+pinot-spark-3-connector, pinot-flink-connector) — their read path plans
+a table scan, splits it per segment/server, and hands each split to the
+compute framework as that framework's native rows. The Python data
+ecosystem's "Spark" is pandas/torch, so the connector surface here is:
+
+- ``read_sql``       broker SQL -> pandas.DataFrame
+- ``read_table``     whole-table (or column-projected) scan over the
+                     segments a data manager holds -> DataFrame, one
+                     per-segment split at a time like the Spark
+                     connector's PinotInputPartition
+- ``to_torch``       DataFrame/ResultTable -> dict of torch tensors
+                     (the feature-ingest handoff)
+
+Writes go the other way through the batch ingestion job spec
+(ingestion/batch.py), which is the reference's write-connector shape.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+def _pandas():
+    import pandas as pd
+    return pd
+
+
+def read_sql(conn: Any, sql: str):
+    """Execute SQL through any connection-ish object (in-process
+    ``connect()`` callable, Broker, or HttpConnection) -> DataFrame."""
+    if callable(conn) and not hasattr(conn, "query") \
+            and not hasattr(conn, "execute"):
+        res = conn(sql)
+    elif hasattr(conn, "execute"):
+        res = conn.execute(sql)
+    else:
+        res = conn.query(sql)
+    pd = _pandas()
+    return pd.DataFrame([tuple(r) for r in res.rows], columns=res.columns)
+
+
+def iter_segment_frames(dm: Any, columns: Optional[Sequence[str]] = None
+                        ) -> Iterator[Any]:
+    """One DataFrame per segment split (PinotInputPartition analog):
+    callers stream the table without materializing it whole."""
+    pd = _pandas()
+    for seg in dm.acquire_segments():
+        cols = list(columns) if columns else list(seg.columns)
+        data = {}
+        for c in cols:
+            vals = np.asarray(seg.raw_values(c))
+            if not getattr(seg.columns[c], "single_value", True):
+                vals = list(vals)  # ragged MV rows stay python lists
+            data[c] = vals
+        frame = pd.DataFrame(data)
+        if seg.valid_docs is not None:
+            frame = frame[np.asarray(seg.valid_docs)].reset_index(
+                drop=True)
+        yield frame
+
+
+def read_table(dm: Any, columns: Optional[Sequence[str]] = None):
+    """Whole table -> one DataFrame (concat of the per-segment splits)."""
+    pd = _pandas()
+    frames = list(iter_segment_frames(dm, columns))
+    if not frames:
+        return pd.DataFrame(columns=list(columns or []))
+    return pd.concat(frames, ignore_index=True)
+
+
+def to_torch(frame_or_result: Any) -> Dict[str, Any]:
+    """Numeric columns -> torch tensors (strings stay out; the caller
+    encodes those through the table dictionaries if needed)."""
+    import torch
+    if hasattr(frame_or_result, "rows"):  # ResultTable
+        frame_or_result = read_sql(lambda _s: frame_or_result, "")
+    out: Dict[str, Any] = {}
+    for name in frame_or_result.columns:
+        col = frame_or_result[name].to_numpy()
+        if col.dtype == object or col.dtype.kind in "US":
+            continue
+        # copy: segment memmaps are read-only and torch tensors must be
+        # writable (training code mutates feature buffers in place)
+        out[name] = torch.from_numpy(
+            np.array(col, copy=True, order="C"))
+    return out

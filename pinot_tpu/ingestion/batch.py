@@ -86,19 +86,26 @@ class BatchIngestionJob:
         the file index so tasks never collide; pushes happen in the
         driver, in order."""
         import json as _json
+        import shutil
         import subprocess
         import sys
         import tempfile
+        import time as _time
 
         files = self.input_files()
         workers = workers or min(len(files), os.cpu_count() or 1)
         push = self.spec.get("push") or {}
-        import time as _time
-
-        with tempfile.NamedTemporaryFile("w", suffix=".json",
-                                         delete=False) as fh:
+        work_dir = tempfile.mkdtemp(prefix="pinot_ingest_")
+        spec_path = os.path.join(work_dir, "spec.json")
+        with open(spec_path, "w") as fh:
             _json.dump(self.spec, fh)
-            spec_path = fh.name
+        # workers must import pinot_tpu in a FRESH interpreter: carry
+        # the driver's sys.path (REPL drivers patch it rather than
+        # installing the package)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p]
+            + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
         procs: List[tuple] = []
         pending = list(enumerate(files))
         results: Dict[int, List[str]] = {}
@@ -106,38 +113,48 @@ class BatchIngestionJob:
             while pending or procs:
                 while pending and len(procs) < workers:
                     idx, path = pending.pop(0)
+                    out_path = os.path.join(work_dir, f"task_{idx}.json")
+                    log_path = os.path.join(work_dir, f"task_{idx}.log")
+                    # results travel via --out FILES and worker output
+                    # via a redirected log file, never pipes: a chatty
+                    # worker can neither block on a full pipe nor
+                    # corrupt the result protocol with stray prints
+                    log_fh = open(log_path, "wb")
                     procs.append((idx, subprocess.Popen(
                         [sys.executable, "-m",
                          "pinot_tpu.ingestion.batch", "--file-task",
-                         spec_path, path, str(idx)],
-                        stdout=subprocess.PIPE,
-                        stderr=subprocess.PIPE)))
+                         spec_path, path, str(idx), "--out", out_path],
+                        stdout=log_fh, stderr=subprocess.STDOUT,
+                        env=env), out_path, log_path, log_fh))
                 # reap ANY finished worker (no head-of-line blocking: a
-                # big file must not idle the other slots). Workers emit
-                # one small JSON line, so the un-drained-pipe limit is
-                # never hit before exit.
-                done = [i for i, (_idx, p) in enumerate(procs)
-                        if p.poll() is not None]
+                # big file must not idle the other slots)
+                done = [i for i, entry in enumerate(procs)
+                        if entry[1].poll() is not None]
                 if not done:
                     _time.sleep(0.05)
                     continue
                 for i in reversed(done):
-                    idx, p = procs.pop(i)
-                    out, err = p.communicate()
-                    if p.returncode != 0:
+                    idx, p, out_path, log_path, log_fh = procs.pop(i)
+                    p.wait()
+                    log_fh.close()
+                    if p.returncode != 0 or not os.path.exists(out_path):
+                        with open(log_path, "rb") as lf:
+                            tail = lf.read()[-2000:].decode(
+                                errors="replace")
                         raise RuntimeError(
-                            f"ingestion task {idx} failed: "
-                            f"{err.decode()[-2000:]}")
-                    results[idx] = _json.loads(out.decode())
+                            f"ingestion task {idx} failed: {tail}")
+                    with open(out_path) as rf:
+                        results[idx] = _json.load(rf)
             seg_dirs = [d for idx in sorted(results)
                         for d in results[idx]]
         finally:
             # a failed task must not leave siblings running (they would
             # keep writing segments after the job reported failure)
-            for _idx, p in procs:
-                p.kill()
-                p.wait()
-            os.unlink(spec_path)
+            for entry in procs:
+                entry[1].kill()
+                entry[1].wait()
+                entry[4].close()
+            shutil.rmtree(work_dir, ignore_errors=True)
         if not push.get("controllerUrl"):
             return seg_dirs
         return [self._push(d, push) for d in seg_dirs]
@@ -206,17 +223,14 @@ class BatchIngestionJob:
 def _build_file_segments(spec: Dict[str, Any], path: str,
                          file_idx: int) -> List[str]:
     """One parallel task: read + transform + build segments for ONE
-    input file (module-level so the process pool can pickle it)."""
+    input file (the body of the ``--file-task`` worker subprocess)."""
     job = BatchIngestionJob(spec)
     fmt, pipeline, out_dir, prefix, per_seg, builder = job.job_params()
     rows = pipeline.transform(read_records(path, fmt))
     out: List[str] = []
-    for k in range(0, max(len(rows), 1), per_seg):
-        chunk = rows[k:k + per_seg]
-        if not chunk:
-            break
+    for k in range(0, len(rows), per_seg):
         name = f"{prefix}_{file_idx}_{k // per_seg}"
-        out.append(builder.build(chunk, out_dir, name))
+        out.append(builder.build(rows[k:k + per_seg], out_dir, name))
     return out
 
 
@@ -224,17 +238,23 @@ def run_batch_ingestion(spec: Dict[str, Any]) -> List[str]:
     return BatchIngestionJob(spec).run()
 
 
-if __name__ == "__main__":   # worker entry: --file-task spec.json path idx
+if __name__ == "__main__":
+    # worker entry: --file-task spec.json path idx --out result.json
     import json as _json
     import sys as _sys
 
-    if len(_sys.argv) == 5 and _sys.argv[1] == "--file-task":
+    if len(_sys.argv) == 7 and _sys.argv[1] == "--file-task" \
+            and _sys.argv[5] == "--out":
         with open(_sys.argv[2]) as _fh:
             _spec = _json.load(_fh)
         _dirs = _build_file_segments(_spec, _sys.argv[3],
                                      int(_sys.argv[4]))
-        print(_json.dumps(_dirs))
+        _tmp = _sys.argv[6] + ".tmp"
+        with open(_tmp, "w") as _out:
+            _json.dump(_dirs, _out)
+        os.replace(_tmp, _sys.argv[6])  # exists == complete
     else:
         raise SystemExit(
             "usage: python -m pinot_tpu.ingestion.batch "
-            "--file-task <spec.json> <input-file> <file-idx>")
+            "--file-task <spec.json> <input-file> <file-idx> "
+            "--out <result.json>")
