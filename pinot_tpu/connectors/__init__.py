@@ -30,6 +30,12 @@ def _pandas():
     return pd
 
 
+def _to_frame(res: Any):
+    """ResultTable -> DataFrame (shared by read_sql and to_torch)."""
+    pd = _pandas()
+    return pd.DataFrame([tuple(r) for r in res.rows], columns=res.columns)
+
+
 def read_sql(conn: Any, sql: str):
     """Execute SQL through any connection-ish object (in-process
     ``connect()`` callable, Broker, or HttpConnection) -> DataFrame."""
@@ -40,8 +46,7 @@ def read_sql(conn: Any, sql: str):
         res = conn.execute(sql)
     else:
         res = conn.query(sql)
-    pd = _pandas()
-    return pd.DataFrame([tuple(r) for r in res.rows], columns=res.columns)
+    return _to_frame(res)
 
 
 def iter_segment_frames(dm: Any, columns: Optional[Sequence[str]] = None
@@ -56,6 +61,12 @@ def iter_segment_frames(dm: Any, columns: Optional[Sequence[str]] = None
             vals = np.asarray(seg.raw_values(c))
             if not getattr(seg.columns[c], "single_value", True):
                 vals = list(vals)  # ragged MV rows stay python lists
+            nm = seg.null_mask(c)
+            if nm is not None and np.any(nm):
+                # surface NULLs as None/NaN, not stored default values
+                # (training on default-0 "nulls" silently corrupts)
+                vals = np.asarray(vals, dtype=object)
+                vals[np.asarray(nm)] = None
             data[c] = vals
         frame = pd.DataFrame(data)
         if seg.valid_docs is not None:
@@ -78,7 +89,7 @@ def to_torch(frame_or_result: Any) -> Dict[str, Any]:
     encodes those through the table dictionaries if needed)."""
     import torch
     if hasattr(frame_or_result, "rows"):  # ResultTable
-        frame_or_result = read_sql(lambda _s: frame_or_result, "")
+        frame_or_result = _to_frame(frame_or_result)
     out: Dict[str, Any] = {}
     for name in frame_or_result.columns:
         col = frame_or_result[name].to_numpy()

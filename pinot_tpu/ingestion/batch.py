@@ -139,8 +139,9 @@ class BatchIngestionJob:
                     log_fh.close()
                     if p.returncode != 0 or not os.path.exists(out_path):
                         with open(log_path, "rb") as lf:
-                            tail = lf.read()[-2000:].decode(
-                                errors="replace")
+                            lf.seek(max(0, os.path.getsize(log_path)
+                                        - 2000))
+                            tail = lf.read().decode(errors="replace")
                         raise RuntimeError(
                             f"ingestion task {idx} failed: {tail}")
                     with open(out_path) as rf:

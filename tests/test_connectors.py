@@ -66,3 +66,18 @@ def test_to_torch_numeric_only(table):
     assert set(t) == {"v", "w"}     # string column excluded
     import torch
     assert t["v"].dtype == torch.int64 and t["v"].shape == (3000,)
+
+
+def test_null_values_surface_as_none(tmp_path):
+    # review regression: NULL rows must not leak stored defaults into
+    # frames/tensors
+    schema = Schema("nn", [
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    data = {"v": np.asarray([1, None, 3], dtype=object)}
+    dm = TableDataManager("nn")
+    dm.add_segment_dir(SegmentBuilder(schema, TableConfig("nn")).build(
+        data, str(tmp_path), "s0"))
+    df = read_table(dm)
+    assert df["v"][0] == 1 and df["v"][2] == 3
+    assert df["v"][1] is None or (isinstance(df["v"][1], float)
+                                  and np.isnan(df["v"][1]))

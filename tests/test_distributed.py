@@ -279,3 +279,48 @@ def test_distributed_compact_group_by(big_table):
         assert gmx == pytest.approx(mx, abs=1e-6)
     assert sorted(map(tuple, local.rows)) == sorted(map(tuple,
                                                         distributed.rows))
+
+
+def test_distributed_expression_group_key(tmp_path_factory):
+    """GROUP BY YEAR(ts) on the mesh: the widened table view derives a
+    TABLE-WIDE key range, so per-device partials land in the same key
+    space and psum-combine correctly."""
+    rng = np.random.default_rng(29)
+    schema = Schema("ev", [
+        FieldSpec("ts", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("amt", DataType.LONG, FieldType.METRIC)])
+    cfg = TableConfig("ev")
+    chunks = []
+    for i in range(8):
+        # segments cover DIFFERENT year windows: a per-segment offset
+        # would mis-bucket under the shared-plan mesh path
+        lo = 1_500_000_000_000 + i * 40_000_000_000
+        chunks.append({
+            "ts": rng.integers(lo, lo + 60_000_000_000, 400)
+            .astype(np.int64),
+            "amt": rng.integers(1, 100, 400).astype(np.int64)})
+    shared = build_table_dictionaries(schema, cfg, chunks)
+    builder = SegmentBuilder(schema, cfg)
+    out = tmp_path_factory.mktemp("ev_expr")
+    dm = TableDataManager("ev")
+    for i, c in enumerate(chunks):
+        dm.add_segment_dir(builder.build(c, str(out), f"seg_{i}",
+                                         shared_dicts=shared))
+    mesh = segment_mesh(8)
+    dist = DistributedTable(dm.acquire_segments(), mesh)
+    sql = ("SELECT YEAR(ts), COUNT(*), SUM(amt) FROM ev "
+           "GROUP BY 1 ORDER BY 1 LIMIT 100")
+    plan = dist.plan(_ctx(sql))
+    assert plan.kind == "kernel" and plan.kernel_plan.key_exprs
+    partial = dist.try_execute(_ctx(sql))
+    assert partial is not None
+    from pinot_tpu.engine.reduce import reduce_partials
+    rows = [tuple(r) for r in reduce_partials(_ctx(sql), [partial]).rows]
+    ts = np.concatenate([c["ts"] for c in chunks])
+    amt = np.concatenate([c["amt"] for c in chunks])
+    years = ts.astype("datetime64[ms]").astype("datetime64[Y]") \
+        .astype(np.int64) + 1970
+    expected = [(int(y), int((years == y).sum()),
+                 int(amt[years == y].sum()))
+                for y in np.unique(years)]
+    assert rows == expected
