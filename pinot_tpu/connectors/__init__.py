@@ -64,9 +64,14 @@ def iter_segment_frames(dm: Any, columns: Optional[Sequence[str]] = None
             nm = seg.null_mask(c)
             if nm is not None and np.any(nm):
                 # surface NULLs as None/NaN, not stored default values
-                # (training on default-0 "nulls" silently corrupts)
-                vals = np.asarray(vals, dtype=object)
-                vals[np.asarray(nm)] = None
+                # (training on default-0 "nulls" silently corrupts).
+                # Build the object vector explicitly: np.asarray over
+                # equal-length row lists would go 2-D and break pandas
+                obj = np.empty(len(vals), dtype=object)
+                for i, x in enumerate(vals):
+                    obj[i] = x
+                obj[np.asarray(nm)] = None
+                vals = obj
             data[c] = vals
         frame = pd.DataFrame(data)
         if seg.valid_docs is not None:

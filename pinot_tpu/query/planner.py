@@ -470,6 +470,10 @@ class SegmentPlanner:
         if gmask is not None:
             # bare boolean ST_Contains/ST_Within over an indexed column
             return self._mask_pred(gmask)
+        if isinstance(e, FuncCall):
+            p = self._dict_transform_bool(e)
+            if p is not None:
+                return p
         raise PlanError(f"unsupported filter expression {e!r}")
 
     def _comparison(self, e: Comparison) -> Pred:
@@ -511,10 +515,127 @@ class SegmentPlanner:
         if geo is not None:
             return geo
         # generic: expr vs expr -> compare difference against zero
-        l, li = self.resolve_value(lhs)
-        r, ri = self.resolve_value(rhs)
+        try:
+            l, li = self.resolve_value(lhs)
+            r, ri = self.resolve_value(rhs)
+        except PlanError:
+            # no device lowering (string functions etc.): a transform of
+            # ONE dict column still plans on-device by evaluating the
+            # expression over the DICTIONARY host-side and shipping the
+            # matching-id set — the dictionary-based predicate evaluator
+            # trick LIKE already uses (reference:
+            # predicate/EqualsPredicateEvaluatorFactory dictionary path)
+            p = self._dict_transform_cmp(lhs, op, rhs)
+            if p is not None:
+                return p
+            raise
         zero = self.b.add_param(np.int64(0) if (li and ri) else np.float64(0))
         return Cmp(Bin("-", l, r), op, zero)
+
+    # dictionary cardinality above which per-query host evaluation over
+    # the dictionary stops paying for itself
+    DICT_EVAL_LIMIT = 1 << 17
+
+    def _dict_transform_cmp(self, lhs: Any, op: str,
+                            rhs: Any) -> Optional[Pred]:
+        if not isinstance(rhs, Literal):
+            return None
+        out, name = self._eval_over_dict(lhs)
+        if out is None:
+            return None
+        v = rhs.value
+        try:
+            with np.errstate(all="ignore"):
+                if op == "==":
+                    hit = out == v
+                elif op == "!=":
+                    hit = out != v
+                else:
+                    cmpf = {"<": np.less, "<=": np.less_equal,
+                            ">": np.greater,
+                            ">=": np.greater_equal}[op]
+                    hit = cmpf(out, v)
+        except (TypeError, ValueError):
+            return None
+        return self._ids_pred(name, np.nonzero(np.asarray(hit))[0])
+
+    def _dict_transform_bool(self, e: Any) -> Optional[Pred]:
+        """Bare boolean transform (startsWith(city, 'x')) over one dict
+        column -> matching-id pred."""
+        out, name = self._eval_over_dict(e)
+        if out is None:
+            return None
+        try:
+            hit = np.asarray(out).astype(bool)
+        except (TypeError, ValueError):
+            return None
+        return self._ids_pred(name, np.nonzero(hit)[0])
+
+    def _ids_pred(self, name: str, ids: np.ndarray) -> Pred:
+        m = self.seg.columns[name]
+        if len(ids) == 0:
+            return FalseP()
+        if len(ids) == m.cardinality:
+            # full coverage folds to "has any value": empty MV rows must
+            # still NOT match (the direct dictionary path's semantics)
+            return self._mv_has_value(name) if self._is_mv(name) \
+                else TrueP()
+        from ..ops.kernels import INSET_BITMAP_MIN
+        if m.cardinality >= INSET_BITMAP_MIN * 4 \
+                and len(ids) > m.cardinality // 8:
+            table = np.zeros(m.cardinality, dtype=bool)
+            table[ids] = True
+            return InBitmap(self.b.bind_col(name), self.b.add_param(table))
+        arr = _pad_dup(np.sort(ids).astype(np.int32))
+        return InSet(self.b.bind_col(name), self.b.add_param(arr),
+                     len(arr))
+
+    def _eval_over_dict(self, e: Any):
+        """Evaluate an elementwise single-column transform expression
+        over the column's dictionary -> (values per dict id, col name);
+        (None, None) when the shape doesn't qualify."""
+        refs: set = set()
+        collect_identifiers(e, refs)
+        if len(refs) != 1:
+            return None, None
+        name = next(iter(refs))
+        m = self.seg.columns.get(name)
+        if m is None or not m.has_dict or m.cardinality == 0 \
+                or m.cardinality > self.DICT_EVAL_LIMIT:
+            return None, None
+        vals = np.asarray(self.seg.dictionary(name).values)
+
+        from . import functions as F
+
+        def ev(node: Any):
+            if isinstance(node, Identifier):
+                return vals
+            if isinstance(node, Literal):
+                return node.value
+            if isinstance(node, FuncCall) and not node.distinct:
+                fd = F.lookup(node.name)
+                if fd is None or not fd.elementwise:
+                    raise PlanError(f"non-elementwise {node.name!r}")
+                return fd.fn(*[ev(a) for a in node.args])
+            if isinstance(node, BinaryOp):
+                l, r = ev(node.lhs), ev(node.rhs)
+                return {"+": lambda: l + r, "-": lambda: l - r,
+                        "*": lambda: l * r,
+                        "/": lambda: np.asarray(l, dtype=np.float64)
+                        / np.asarray(r, dtype=np.float64),
+                        "%": lambda: l % r}[node.op]()
+            if isinstance(node, Cast):
+                return F.cast_value(ev(node.expr), node.type_name)
+            raise PlanError(f"no dictionary evaluation for {node!r}")
+
+        try:
+            out = ev(e)
+        except (PlanError, SqlError, TypeError, ValueError, KeyError):
+            return None, None
+        out = np.asarray(out)
+        if out.shape != (m.cardinality,):
+            return None, None
+        return out, name
 
     def _geo_comparison(self, lhs, op: str, rhs) -> Optional[Pred]:
         """Index-backed geospatial comparisons (H3IndexFilterOperator /

@@ -219,3 +219,92 @@ def test_abs_preserves_int_dtype():
     out = call("abs", big)
     assert out.dtype == np.int64
     np.testing.assert_array_equal(out, np.abs(big))
+
+
+# ---------------------------------------------------------------------------
+# dictionary-evaluated transform predicates (string functions on the
+# kernel path via matching-id sets — the LIKE trick generalized)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def str_table(tmp_path_factory):
+    rng = np.random.default_rng(53)
+    n = 20000
+    cities = rng.choice(["Amsterdam", "berlin", "Chicago", "denver",
+                         "Boston"], n)
+    v = rng.integers(0, 100, n).astype(np.int64)
+    schema = Schema("st", [
+        FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    seg = ImmutableSegment.load(
+        SegmentBuilder(schema, TableConfig("st")).build(
+            {"city": cities, "v": v},
+            str(tmp_path_factory.mktemp("st")), "s0"))
+    dm = TableDataManager("st")
+    dm.add_segment(seg)
+    b = Broker()
+    b.register_table(dm)
+    return b, seg, cities.astype(str), v
+
+
+def test_string_transform_predicates_kernel(str_table):
+    b, seg, cities, v = str_table
+    cases = [
+        ("LOWER(city) = 'amsterdam'",
+         np.char.lower(cities) == "amsterdam"),
+        ("startsWith(city, 'B')", np.char.startswith(cities, "B")),
+        ("LENGTH(city) > 6", np.char.str_len(cities) > 6),
+        ("UPPER(city) != 'BERLIN'", np.char.upper(cities) != "BERLIN"),
+        ("CONCAT(city, '!') = 'denver!'", cities == "denver"),
+    ]
+    for cond, m in cases:
+        sql = f"SELECT COUNT(*), SUM(v) FROM st WHERE {cond}"
+        kind, _ = _plan_kind(seg, sql)
+        assert kind == "kernel", cond
+        assert b.query(sql).rows[0] == (int(m.sum()), int(v[m].sum())), \
+            cond
+
+
+def test_string_transform_composes_with_other_predicates(str_table):
+    b, seg, cities, v = str_table
+    sql = ("SELECT city, COUNT(*) FROM st "
+           "WHERE LOWER(city) != 'berlin' AND v >= 50 "
+           "GROUP BY city ORDER BY city")
+    kind, _ = _plan_kind(seg, sql)
+    assert kind == "kernel"
+    m = (np.char.lower(cities) != "berlin") & (v >= 50)
+    expect = sorted((c, int((m & (cities == c)).sum()))
+                    for c in np.unique(cities[m]))
+    assert [tuple(r) for r in b.query(sql).rows] == expect
+
+
+def test_single_column_referenced_twice_is_kernel(str_table):
+    b, seg, cities, _v = str_table
+    sql = ("SELECT COUNT(*) FROM st WHERE "
+           "CONCAT(city, city) = 'denverdenver'")
+    kind, _ = _plan_kind(seg, sql)
+    # single column referenced twice still qualifies (refs == {city})
+    assert kind == "kernel"
+    assert b.query(sql).rows[0][0] == int((cities == "denver").sum())
+
+
+def test_two_distinct_column_transform_hosts(tmp_path):
+    # transforms over TWO dict columns have no single dictionary to
+    # evaluate over: host path serves, answers still correct
+    rng = np.random.default_rng(59)
+    a = rng.choice(["x", "y"], 2000)
+    c = rng.choice(["p", "q"], 2000)
+    schema = Schema("tw", [
+        FieldSpec("a", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("c", DataType.STRING, FieldType.DIMENSION)])
+    seg = ImmutableSegment.load(
+        SegmentBuilder(schema, TableConfig("tw")).build(
+            {"a": a, "c": c}, str(tmp_path), "s0"))
+    dm = TableDataManager("tw")
+    dm.add_segment(seg)
+    b = Broker()
+    b.register_table(dm)
+    sql = "SELECT COUNT(*) FROM tw WHERE CONCAT(a, c) = 'xq'"
+    kind, _ = _plan_kind(seg, sql)
+    assert kind == "host"
+    assert b.query(sql).rows[0][0] == int(((a == "x") & (c == "q")).sum())

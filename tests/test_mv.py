@@ -186,3 +186,26 @@ def test_mv_selection(seg_broker, data):
     for i, (city, tags) in enumerate(res.rows):
         assert city == data["city"][i]
         assert list(tags) == list(data["tags"][i])
+
+
+def test_dict_transform_predicate_excludes_empty_mv_rows(tmp_path):
+    # review regression: full-coverage dict-transform predicates must
+    # keep "has any value" semantics for MV columns (empty rows don't
+    # match), like the direct dictionary path
+    schema = Schema("mvt", [
+        FieldSpec("tags", DataType.STRING, FieldType.DIMENSION,
+                  single_value=False),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    data = {"tags": [["a", "b"], [], ["c"]],
+            "v": np.arange(3, dtype=np.int64)}
+    seg = ImmutableSegment.load(
+        SegmentBuilder(schema, TableConfig("mvt")).build(
+            data, str(tmp_path), "s0"))
+    dm = TableDataManager("mvt")
+    dm.add_segment(seg)
+    b = Broker()
+    b.register_table(dm)
+    direct = b.query("SELECT COUNT(*) FROM mvt WHERE tags != 'zzz'")
+    xform = b.query("SELECT COUNT(*) FROM mvt WHERE LOWER(tags) != 'zzz'")
+    assert direct.rows[0][0] == 2       # empty row excluded
+    assert xform.rows[0][0] == direct.rows[0][0]
