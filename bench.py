@@ -355,7 +355,13 @@ def kernel_time(seg, sql, iters):
     return best, plan.kernel_plan.strategy, nbytes
 
 
+METRIC = "ssb_q1.1-q4.3_geomean_rows_per_sec_per_chip"
+
+
 def main() -> None:
+    from bench_common import finish, require_backend
+
+    backend = require_backend(METRIC)  # never hang on a wedged tunnel
     seg = build_or_load_segment()
     from pinot_tpu.broker import Broker
     from pinot_tpu.server import TableDataManager
@@ -401,18 +407,14 @@ def main() -> None:
     geo_speedup = math.exp(sum(math.log(s) for s in speedups)
                            / len(speedups))
     out = {
-        "metric": "ssb_q1.1-q4.3_geomean_rows_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(geo_rate),
         "unit": "rows/s",
         "vs_baseline": round(geo_speedup, 2),
         "n_rows": N_ROWS,
         "queries": detail,
     }
-    if not all_ok:
-        out["error"] = "digest mismatch vs numpy oracle"
-        print(json.dumps(out))
-        sys.exit(1)
-    print(json.dumps(out))
+    finish(out, backend, all_ok)
 
 
 if __name__ == "__main__":

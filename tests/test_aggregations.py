@@ -226,3 +226,17 @@ def test_mode_bad_reducer_and_hll_bad_log2m(broker):
         broker.query("SELECT MODE(iv, 'bogus') FROM agg")
     with pytest.raises(SqlError, match="log2m"):
         broker.query("SELECT DISTINCTCOUNTHLL(iv, 'abc') FROM agg")
+
+
+def test_numeric_agg_over_string_column_is_typed_error(broker):
+    """SUM/AVG over a STRING column must raise SqlError, never a raw
+    numpy ValueError — in both the ungrouped and grouped host paths
+    (reference: Pinot rejects these at plan time)."""
+    from pinot_tpu.query.sql import SqlError
+    for sql in ("SELECT SUM(grp) FROM agg",
+                "SELECT AVG(grp) FROM agg",
+                "SELECT flag, SUM(grp) FROM agg GROUP BY flag",
+                "SELECT flag, MIN(grp) FROM agg GROUP BY flag",
+                "SELECT PERCENTILE(grp, 50) FROM agg"):
+        with pytest.raises(SqlError):
+            broker.query(sql)
