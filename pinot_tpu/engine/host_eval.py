@@ -466,6 +466,20 @@ def _require_numeric(agg: AggExpr, vals: np.ndarray,
                        f"{agg.arg!r} is a string expression")
 
 
+def _typed_ev(impl, agg: AggExpr, seg, sel: np.ndarray):
+    """HostSel evaluator that rejects string inputs to numeric-only
+    registry impls with a typed SqlError BEFORE the impl's math sees
+    them (no numpy-message sniffing; impls that legitimately take
+    strings set numeric_input = False)."""
+    def ev(ast):
+        vals = eval_value(ast, seg, sel)
+        if impl.numeric_input and vals.dtype.kind in "USO":
+            raise SqlError(f"{agg.kind.upper()} requires numeric input; "
+                           f"{ast!r} is a string expression")
+        return vals
+    return ev
+
+
 def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
     if agg.kind == "count":
         return int(len(sel))
@@ -473,15 +487,8 @@ def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
         return _mv_agg_state(agg, seg, sel)
     impl = aggregations.make(agg)  # extended registry kinds
     if impl is not None:
-        h = aggregations.HostSel(lambda ast: eval_value(ast, seg, sel),
-                                 len(sel))
-        try:
-            return impl.state(h)
-        except ValueError as e:
-            if "convert" in str(e).lower():  # numpy string->float cast
-                raise SqlError(f"{agg.kind.upper()}: non-numeric "
-                               f"input ({e})") from e
-            raise
+        h = aggregations.HostSel(_typed_ev(impl, agg, seg, sel), len(sel))
+        return impl.state(h)
     vals = eval_value(agg.arg, seg, sel)
     _require_numeric(agg, vals, ("sum", "avg"))
     if agg.kind == "sum":
@@ -490,6 +497,13 @@ def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
         if np.issubdtype(vals.dtype, np.integer):
             return int(vals.astype(np.int64).sum())
         return float(vals.astype(np.float64).sum())
+    if agg.kind in ("min", "max") and vals.dtype.kind in "USO":
+        # lexicographic string min/max (numpy 2.x has no unicode
+        # minimum ufunc — use the builtin over the selected values)
+        if len(sel) == 0:
+            return None
+        pick = min if agg.kind == "min" else max
+        return _scalar(pick(vals))
     if agg.kind == "min":
         return None if len(sel) == 0 else _scalar(vals.min())
     if agg.kind == "max":
@@ -643,19 +657,21 @@ def _group_states(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
                 for gi in range(n_groups)]
     impl = aggregations.make(agg)  # extended registry kinds
     if impl is not None:
-        h = aggregations.HostSel(lambda ast: eval_value(ast, seg, sel),
+        h = aggregations.HostSel(_typed_ev(impl, agg, seg, sel),
                                  len(sel), inv, n_groups)
-        try:
-            return impl.group_states(h)
-        except ValueError as e:
-            if "convert" in str(e).lower():  # numpy string->float cast
-                raise SqlError(f"{agg.kind.upper()}: non-numeric "
-                               f"input ({e})") from e
-            raise
+        return impl.group_states(h)
     vals = eval_value(agg.arg, seg, sel)
-    # grouped min/max accumulate via float scatter, so strings cannot
-    # take the ungrouped lexicographic path here — reject them too
-    _require_numeric(agg, vals, ("sum", "avg", "min", "max"))
+    _require_numeric(agg, vals, ("sum", "avg"))
+    if agg.kind in ("min", "max") and vals.dtype.kind in "USO":
+        # lexicographic string min/max per group (matches the ungrouped
+        # path's vals.min()/.max() semantics) via one stable sort-split
+        order = np.argsort(inv, kind="stable")
+        sv, si = vals[order], inv[order]
+        bounds = np.searchsorted(si, np.arange(n_groups + 1))
+        pick = min if agg.kind == "min" else max
+        return [_scalar(pick(sv[bounds[g]:bounds[g + 1]]))
+                if bounds[g + 1] > bounds[g] else None
+                for g in range(n_groups)]
     if agg.kind == "sum":
         if np.issubdtype(vals.dtype, np.integer):
             s2 = np.zeros(n_groups, dtype=np.int64)  # exact int accumulation

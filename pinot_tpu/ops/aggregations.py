@@ -239,6 +239,11 @@ def _py(v: Any) -> Any:
 class AggImpl:
     """One aggregation bound to its AggExpr (params in self.agg.params)."""
 
+    # impls whose math needs numeric inputs keep True: the host path
+    # raises a typed SqlError (not a numpy cast error) on string input.
+    # Hash/selection-based impls (HLL, FIRST/LASTWITHTIME) flip it off.
+    numeric_input = True
+
     def __init__(self, agg: Any):
         self.agg = agg
 
@@ -599,6 +604,8 @@ def _hash64(v: np.ndarray) -> np.ndarray:
 class HllAgg(AggImpl):
     """HyperLogLog: state = list[int] of 2^log2m registers; merge = max."""
 
+    numeric_input = False  # _hash64 hashes strings (md5) like Pinot HLL
+
     @property
     def log2m(self) -> int:
         return int(self.agg.params[0]) if self.agg.params \
@@ -715,6 +722,9 @@ class BoolAgg(AggImpl):
 
 class WithTimeAgg(AggImpl):
     """FIRSTWITHTIME / LASTWITHTIME: state = (time, value) | None."""
+
+    numeric_input = False  # selection-based: string values are picked,
+    # never cast
 
     def __init__(self, agg, last: bool):
         super().__init__(agg)

@@ -236,7 +236,22 @@ def test_numeric_agg_over_string_column_is_typed_error(broker):
     for sql in ("SELECT SUM(grp) FROM agg",
                 "SELECT AVG(grp) FROM agg",
                 "SELECT flag, SUM(grp) FROM agg GROUP BY flag",
-                "SELECT flag, MIN(grp) FROM agg GROUP BY flag",
+                "SELECT flag, PERCENTILE(grp, 50) FROM agg GROUP BY flag",
                 "SELECT PERCENTILE(grp, 50) FROM agg"):
         with pytest.raises(SqlError):
             broker.query(sql)
+
+
+def test_string_min_max_lexicographic_both_paths(broker, data):
+    """MIN/MAX over strings is lexicographic — consistently in the
+    ungrouped AND grouped host paths; HLL over strings hashes (md5)."""
+    assert one(broker.query("SELECT MIN(grp), MAX(grp) FROM agg")) \
+        == ("a", "d")
+    rows = broker.query("SELECT flag, MIN(grp), MAX(grp) FROM agg "
+                        "GROUP BY flag ORDER BY flag").rows
+    g, f = data["grp"].astype(str), data["flag"]
+    assert [tuple(r) for r in rows] == [
+        (int(fv), min(g[f == fv]), max(g[f == fv]))
+        for fv in np.unique(f)]
+    got = one(broker.query("SELECT DISTINCTCOUNTHLL(grp) FROM agg"))[0]
+    assert abs(got - 4) <= 1  # 4 distinct values, HLL estimate

@@ -6,7 +6,14 @@ bitcast-convert through the X64 rewriter, Pallas Mosaic lowering):
 1. compact() Pallas kernel vs the XLA nonzero fallback — identical
    multisets per dtype class (INT, LONG, FLOAT, DOUBLE);
 2. one compact-strategy group-by query per dtype class through the full
-   broker path, checked against a numpy oracle.
+   broker path, checked against a numpy oracle;
+3. (round-4, VERDICT r3 item 2) one query through EVERY round-3 device
+   path that had only ever run on CPU: device CASE/CAST/datetime +
+   dateTrunc group keys, expression group keys, dictionary-evaluated
+   string predicates, device top_k selection (kselect), segmented
+   multi-segment compact batching, and a pipelined over-HBM-budget
+   scan. Each check asserts the PLAN engaged the device lowering (not
+   a host fallback) and the answers match a numpy oracle.
 
 Prints one JSON line: {"ok": true, "backend": "tpu", ...} or an error.
 """
@@ -132,9 +139,277 @@ def main() -> int:
                 raise AssertionError(f"{sql!r}: got {got}, want {expect}")
         out["checks"].append(f"query:{sql.split('(')[1].split(')')[0]}")
 
+    check_device_transforms(out)
+    check_string_predicates(out)
+    check_kselect(out)
+    check_segmented_batch(out)
+    check_pipelined_scan(out)
+
     out["ok"] = True
     print(json.dumps(out))
     return 0
+
+
+def _mini_table(name, schema_fields, data):
+    """Build a one-segment table; returns (broker, seg)."""
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import Schema, TableConfig
+
+    tmp = tempfile.mkdtemp()
+    d = SegmentBuilder(Schema(name, schema_fields),
+                       TableConfig(name)).build(data, tmp, "seg_0")
+    seg = ImmutableSegment.load(d)
+    dm = TableDataManager(name)
+    dm.add_segment(seg)
+    b = Broker()
+    b.register_table(dm)
+    return b, seg
+
+
+def _assert_plan(seg, sql, want_kind):
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.planner import SegmentPlanner
+    from pinot_tpu.query.sql import parse_sql
+
+    plan = SegmentPlanner(build_query_context(parse_sql(sql)), seg).plan()
+    if plan.kind != want_kind:
+        raise AssertionError(
+            f"{sql!r} planned {plan.kind!r}, want {want_kind!r} — the "
+            "device lowering did not engage on hardware")
+    return plan
+
+
+def check_device_transforms(out) -> None:
+    """Device CASE/CAST/datetime + dateTrunc/expression group keys
+    (round-3 device transforms — tests/test_device_transforms.py run
+    CPU-only; this certifies the same lowerings compile on the chip)."""
+    import numpy as np
+
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType
+
+    rng = np.random.default_rng(29)
+    n = 20_000
+    # narrow ~60-day span keeps dateTrunc('day') keys on the kernel path
+    ts = rng.integers(1_700_000_000_000, 1_705_184_000_000, n) \
+        .astype(np.int64)
+    amt = rng.integers(1, 100, n).astype(np.int64)
+    price = rng.uniform(0.5, 99.5, n)
+    b, seg = _mini_table("tx", [
+        FieldSpec("ts", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("amt", DataType.LONG, FieldType.METRIC),
+        FieldSpec("price", DataType.DOUBLE, FieldType.METRIC)],
+        {"ts": ts, "amt": amt, "price": price})
+
+    # expression group key: YEAR(ts)
+    sql = ("SELECT YEAR(ts), COUNT(*) FROM tx GROUP BY 1 "
+           "ORDER BY 1 LIMIT 100000")
+    _assert_plan(seg, sql, "kernel")
+    years = (ts.astype("datetime64[ms]").astype("datetime64[Y]")
+             .astype(np.int64) + 1970)
+    uniq, cnt = np.unique(years, return_counts=True)
+    got = {r[0]: r[1] for r in b.query(sql).rows}
+    if got != {int(u): int(c) for u, c in zip(uniq, cnt)}:
+        raise AssertionError("YEAR(ts) group key mismatch on chip")
+    out["checks"].append("device:year_group_key")
+
+    # dateTrunc('day') group key
+    sql = ("SELECT DATETRUNC('day', ts), COUNT(*) FROM tx GROUP BY 1 "
+           "ORDER BY 1 LIMIT 100000")
+    _assert_plan(seg, sql, "kernel")
+    oracle = np.floor_divide(ts, 86_400_000) * 86_400_000
+    uniq, cnt = np.unique(oracle, return_counts=True)
+    got = {r[0]: r[1] for r in b.query(sql).rows}
+    if got != {int(u): int(c) for u, c in zip(uniq, cnt)}:
+        raise AssertionError("dateTrunc('day') group key mismatch on chip")
+    out["checks"].append("device:datetrunc_group_key")
+
+    # CASE WHEN aggregation + filter on a datetime expression
+    sql = ("SELECT SUM(CASE WHEN amt > 75 THEN 2 WHEN amt > 25 THEN 1 "
+           "ELSE 0 END) FROM tx WHERE MONTH(ts) = 12")
+    _assert_plan(seg, sql, "kernel")
+    d = ts.astype("datetime64[ms]")
+    months = (d.astype("datetime64[M]")
+              - d.astype("datetime64[Y]")).astype(np.int64) + 1
+    m = months == 12
+    exp = int(2 * (amt[m] > 75).sum()
+              + ((amt[m] > 25) & (amt[m] <= 75)).sum())
+    if b.query(sql).rows[0][0] != exp:
+        raise AssertionError("CASE WHEN + MONTH filter mismatch on chip")
+    out["checks"].append("device:case_when_month_filter")
+
+    # CAST in a value expression (f64 division on chip)
+    sql = "SELECT SUM(CAST(amt AS DOUBLE) / 4), SUM(CAST(price AS LONG)) " \
+          "FROM tx"
+    _assert_plan(seg, sql, "kernel")
+    r = b.query(sql).rows[0]
+    if abs(r[0] - float((amt / 4).sum())) > 1e-6 * abs(r[0]) \
+            or r[1] != int(np.trunc(price).sum()):
+        raise AssertionError("CAST value expression mismatch on chip")
+    out["checks"].append("device:cast")
+
+
+def check_string_predicates(out) -> None:
+    """Dictionary-evaluated string-transform predicates (round-3 final
+    commit) on the chip: the predicate evaluates on the host dictionary
+    but the doc-mask scan runs in the device kernel."""
+    import numpy as np
+
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType
+
+    rng = np.random.default_rng(31)
+    n = 20_000
+    cities = rng.choice(["Amsterdam", "berlin", "Chicago", "denver",
+                         "Boston"], n)
+    v = rng.integers(0, 100, n).astype(np.int64)
+    b, seg = _mini_table("st", [
+        FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)],
+        {"city": cities, "v": v})
+    cities = cities.astype(str)
+    for cond, m in [
+            ("LOWER(city) = 'amsterdam'",
+             np.char.lower(cities) == "amsterdam"),
+            ("startsWith(city, 'B')", np.char.startswith(cities, "B")),
+            ("LENGTH(city) > 6", np.char.str_len(cities) > 6)]:
+        sql = f"SELECT COUNT(*), SUM(v) FROM st WHERE {cond}"
+        _assert_plan(seg, sql, "kernel")
+        if tuple(b.query(sql).rows[0]) != (int(m.sum()), int(v[m].sum())):
+            raise AssertionError(f"string predicate {cond!r} wrong on chip")
+    out["checks"].append("device:string_transform_predicates")
+
+
+def check_kselect(out) -> None:
+    """Device selection/order-by via lax.top_k (round-3 item 5b)."""
+    import numpy as np
+
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType
+
+    rng = np.random.default_rng(37)
+    n = 20_000
+    data = {
+        "city": rng.choice(["nyc", "sf", "austin", "la"], n),
+        "year": rng.integers(2018, 2024, n).astype(np.int32),
+        "salary": rng.integers(1000, 100000, n).astype(np.int64),
+    }
+    b, seg = _mini_table("ks", [
+        FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("year", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("salary", DataType.LONG, FieldType.METRIC)], data)
+    sql = ("SELECT city, year, salary FROM ks WHERE year >= 2020 "
+           "ORDER BY salary DESC LIMIT 5")
+    _assert_plan(seg, sql, "kselect")
+    m = data["year"] >= 2020
+    order = np.argsort(-data["salary"][m], kind="stable")[:5]
+    exp = [(str(data["city"][m][i]), int(data["year"][m][i]),
+            int(data["salary"][m][i])) for i in order]
+    if [tuple(r) for r in b.query(sql).rows] != exp:
+        raise AssertionError("kselect top_k selection mismatch on chip")
+    out["checks"].append("device:kselect_top_k")
+
+
+def check_segmented_batch(out) -> None:
+    """Segmented multi-segment compact batching: same-plan compact
+    segments must run as ONE device program on the chip."""
+    import numpy as np
+
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.ops import kernels as K
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    rng = np.random.default_rng(41)
+    n_seg, rows, card_a, card_b = 4, 1500, 40, 210
+    schema = Schema("sb", [
+        FieldSpec("ka", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("kb", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("price", DataType.INT, FieldType.METRIC)])
+    tmp = tempfile.mkdtemp()
+    dm = TableDataManager("sb")
+    chunks = []
+    for i in range(n_seg):
+        chunk = {
+            "ka": np.array([f"a{k:02d}" for k in
+                            rng.integers(0, card_a, rows)]),
+            "kb": np.array([f"b{k:03d}" for k in
+                            rng.integers(0, card_b, rows)]),
+            "price": rng.integers(0, 10_000, rows).astype(np.int64),
+        }
+        chunk["ka"][:card_a] = [f"a{k:02d}" for k in range(card_a)]
+        chunk["kb"][:card_b] = [f"b{k:03d}" for k in range(card_b)]
+        chunks.append(chunk)
+        dm.add_segment_dir(SegmentBuilder(schema, TableConfig("sb"))
+                           .build(chunk, tmp, f"seg_{i}"))
+    b = Broker()
+    b.register_table(dm)
+    before = K.jitted_segmented_compact.cache_info().misses
+    sql = ("SELECT ka, kb, SUM(price) FROM sb GROUP BY ka, kb "
+           "ORDER BY ka, kb LIMIT 100000")
+    got = {(r[0], r[1]): r[2] for r in b.query(sql).rows}
+    after = K.jitted_segmented_compact.cache_info().misses
+    if after <= before:
+        raise AssertionError("segmented compact batch kernel did not run")
+    ka = np.concatenate([c["ka"] for c in chunks]).astype(str)
+    kb = np.concatenate([c["kb"] for c in chunks]).astype(str)
+    price = np.concatenate([c["price"] for c in chunks])
+    exp = {}
+    for a, bb, p in zip(ka, kb, price):
+        exp[(a, bb)] = exp.get((a, bb), 0) + int(p)
+    if got != exp:
+        raise AssertionError("segmented compact batch mismatch on chip")
+    out["checks"].append("device:segmented_compact_batch")
+
+
+def check_pipelined_scan(out) -> None:
+    """Pipelined over-HBM-budget scan: a 1-byte budget reroutes dense
+    groups through the double-buffered streaming path on the chip."""
+    import numpy as np
+
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.engine import pipeline
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    rng = np.random.default_rng(43)
+    n_seg, rows = 3, 4000
+    schema = Schema("pl", [
+        FieldSpec("g", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("x", DataType.LONG, FieldType.METRIC)])
+    tmp = tempfile.mkdtemp()
+    dm = TableDataManager("pl")
+    gs, xs = [], []
+    for i in range(n_seg):
+        g = rng.integers(0, 50, rows).astype(np.int32)
+        x = rng.integers(0, 1000, rows).astype(np.int64)
+        gs.append(g)
+        xs.append(x)
+        dm.add_segment_dir(SegmentBuilder(schema, TableConfig("pl"))
+                           .build({"g": g, "x": x}, tmp, f"seg_{i}"))
+    b = Broker()
+    b.register_table(dm)
+    before = pipeline.STATS["pipelined_groups"]
+    os.environ["PINOT_HBM_BUDGET_BYTES"] = "1"
+    try:
+        sql = ("SELECT g, SUM(x), COUNT(*) FROM pl GROUP BY g "
+               "ORDER BY g LIMIT 100000")
+        rows_out = b.query(sql).rows
+    finally:
+        del os.environ["PINOT_HBM_BUDGET_BYTES"]
+    if pipeline.STATS["pipelined_groups"] <= before:
+        raise AssertionError("over-budget scan did not take the "
+                             "pipelined path")
+    g = np.concatenate(gs)
+    x = np.concatenate(xs)
+    exp = [(int(u), int(x[g == u].sum()), int((g == u).sum()))
+           for u in np.unique(g)]
+    if [tuple(r) for r in rows_out] != exp:
+        raise AssertionError("pipelined scan mismatch on chip")
+    out["checks"].append("device:pipelined_over_budget_scan")
 
 
 if __name__ == "__main__":
