@@ -29,6 +29,8 @@ from ..query.context import build_query_context
 from ..query.sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr,
                          Comparison, FuncCall, Identifier, InList, IsNull,
                          Like, Literal, SelectStmt, SqlError, Star, TableRef)
+from . import device_join
+from .device_join import try_device_join
 from .exchange import HashExchange, MailboxService, hash_partition_codes
 from .join import hash_join, null_extend
 from .relation import Relation
@@ -94,6 +96,7 @@ class MultiStageExecutor:
         self.schemas: Dict[str, Any] = {
             t.label: self._table_schema(t.name) for t in self.tables}
         self.mailboxes = MailboxService()
+        self.join_backends: List[str] = []  # one entry per executed join
 
     def _table_schema(self, name: str):
         dm = self.broker.table(name)
@@ -267,11 +270,22 @@ class MultiStageExecutor:
             left, right = right, left
             lkeys, rkeys = rkeys, lkeys
         if right.n_rows <= BROADCAST_THRESHOLD or how == "left":
-            # broadcast join (small build side / preserved-row semantics)
-            return hash_join(left, right, lkeys, rkeys, how)
+            # broadcast join (small build side / preserved-row semantics):
+            # device sort+searchsorted probe when the shape fits the
+            # dense formulation, numpy otherwise (device_join.py)
+            rel, backend = try_device_join(left, right, lkeys, rkeys,
+                                           how, BROADCAST_THRESHOLD)
+            if rel is None:
+                device_join.STATS["numpy_joins"] += 1
+                self.join_backends.append(f"numpy({backend})")
+                return hash_join(left, right, lkeys, rkeys, how)
+            self.join_backends.append(backend)
+            return rel
         # hash-shuffle both sides into P partitions, join each
         # (HashExchange over in-memory mailboxes; multi-host transport and
         # on-device all_to_all plug in behind the same exchange API)
+        device_join.STATS["numpy_joins"] += 1
+        self.join_backends.append("numpy_shuffle")
         lex = HashExchange(self.mailboxes, query_id, stage, SHUFFLE_PARTITIONS,
                            lkeys)
         rex = HashExchange(self.mailboxes, query_id, stage + 1000,
@@ -325,6 +339,8 @@ class MultiStageExecutor:
             if j.join_type == "left" and rest:
                 # LEFT JOIN with non-equi ON conjuncts: rows whose matches
                 # all fail the conjunct are null-extended, never dropped
+                device_join.STATS["numpy_joins"] += 1
+                self.join_backends.append("numpy(non_equi_left)")
                 inner, l_idx, _ = hash_join(current, right, lkeys, rkeys,
                                             "inner", return_lidx=True)
                 m = np.ones(inner.n_rows, dtype=bool)
@@ -422,13 +438,20 @@ def explain_multistage(broker, stmt: SelectStmt) -> ResultTable:
         final = emit(f"FILTER(post_join_conjuncts:{len(post)})", final)
     parent = final
     ordered, trace = ex.plan_join_order(pushed)
-    for j, step in zip(reversed(ordered), reversed(trace)):
+    base_est = ex._table_row_est[ex.tables[0].label]
+    # probe-side estimate entering join i = output estimate of join i-1
+    probe_ests = [base_est] + [s["estRows"] for s in trace[:-1]]
+    for j, step, probe_est in zip(reversed(ordered), reversed(trace),
+                                  reversed(probe_ests)):
         label = j.table.label
         equi, rest = ex._split_on(
             j.on, {t.label for t in ex.tables if t.label != label}, label)
+        backend = device_join.predict_backend(
+            probe_est, step["rightRows"], j.join_type, BROADCAST_THRESHOLD)
         parent = emit(
             f"HASH_JOIN({j.join_type.upper()},keys:{len(equi)},"
-            f"non_equi:{len(rest)},est_rows:{step['estRows']})", parent)
+            f"non_equi:{len(rest)},est_rows:{step['estRows']},"
+            f"backend:{backend})", parent)
         emit(f"LEAF_SCAN({label},cols:{len(needed[label])},"
              f"pushed_filters:{len(pushed[label])},"
              f"est_rows:{round(ex._table_row_est[label])})", parent)

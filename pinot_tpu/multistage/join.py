@@ -113,6 +113,18 @@ def hash_join(left: Relation, right: Relation,
                      np.minimum(r_pos, max(len(order) - 1, 0)), 0)
     r_idx = order[r_pos] if len(order) else np.zeros(total, dtype=np.int64)
 
+    rel = materialize_join(left, right, l_idx, r_idx, matched, how)
+    if return_lidx:
+        return rel, l_idx, matched
+    return rel
+
+
+def materialize_join(left: Relation, right: Relation, l_idx: np.ndarray,
+                     r_idx: np.ndarray, matched: np.ndarray,
+                     how: str) -> Relation:
+    """Gather output columns for resolved join pairs (shared by the
+    numpy hash join above and the device join in device_join.py)."""
+    total = len(l_idx)
     data: Dict[str, np.ndarray] = {}
     nulls: Dict[str, np.ndarray] = {}
     for k, v in left.data.items():
@@ -133,10 +145,7 @@ def hash_join(left: Relation, right: Relation,
         if nm is not None and nm.any():
             nulls[k] = nm
         data[k] = col
-    rel = Relation(data, nulls, f"{left.name}*{right.name}")
-    if return_lidx:
-        return rel, l_idx, matched
-    return rel
+    return Relation(data, nulls, f"{left.name}*{right.name}")
 
 
 def _default_for(dtype) -> object:

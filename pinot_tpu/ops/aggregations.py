@@ -408,6 +408,8 @@ class CovarAgg(AggImpl):
 class ModeAgg(AggImpl):
     """state = {value: count}; finalize picks per reducer (min|max|avg)."""
 
+    numeric_input = False  # _counts handles US/object dtypes directly
+
     def empty(self):
         return {}
 

@@ -1,0 +1,202 @@
+"""Device-backed multi-stage joins (round-4, VERDICT r3 item 3): the
+ops/join.py sort+searchsorted kernel wired into the executor.
+
+Contract under test: try_device_join output is BYTE-IDENTICAL to numpy
+hash_join (data, nulls, row order), the broker join path actually takes
+the device/mesh backend when eligible (STATS counters), EXPLAIN names
+the chosen backend, and every fallback reason routes to numpy.
+
+Reference analog: pinot-query-runtime/.../operator/HashJoinOperator.java
+execution tests; the suite's 8-virtual-CPU-device mesh makes the
+mesh_broadcast path the default here.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.multistage import device_join
+from pinot_tpu.multistage.device_join import try_device_join
+from pinot_tpu.multistage.join import hash_join
+from pinot_tpu.multistage.relation import Relation
+
+THRESH = 50_000
+
+
+def _rand_relations(rng, n_l=5000, n_r=300, with_nulls=True,
+                    string_keys=False):
+    if string_keys:
+        key_pool = np.array([f"k{i:03d}" for i in range(80)])
+        lk = rng.choice(key_pool, n_l)
+        rk = rng.choice(key_pool, n_r)        # dup keys guaranteed
+    else:
+        lk = rng.integers(0, 80, n_l).astype(np.int64)
+        rk = rng.integers(0, 80, n_r).astype(np.int64)
+    left = Relation({"l.k": lk,
+                     "l.v": rng.integers(0, 1000, n_l).astype(np.int64)})
+    right = Relation({"r.k": rk,
+                      "r.w": rng.integers(0, 9, n_r).astype(np.int32),
+                      "r.s": rng.choice(["x", "y", "z"], n_r)})
+    if with_nulls:
+        left.nulls["l.k"] = rng.random(n_l) < 0.05
+        right.nulls["r.k"] = rng.random(n_r) < 0.05
+        right.nulls["r.w"] = rng.random(n_r) < 0.1
+    return left, right
+
+
+def _assert_identical(a: Relation, b: Relation):
+    assert set(a.data) == set(b.data)
+    for k in a.data:
+        np.testing.assert_array_equal(a.data[k], b.data[k], err_msg=k)
+    assert set(a.nulls) == set(b.nulls)
+    for k in a.nulls:
+        np.testing.assert_array_equal(a.nulls[k], b.nulls[k], err_msg=k)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+@pytest.mark.parametrize("string_keys", [False, True])
+def test_device_join_byte_identical_to_numpy(monkeypatch, how,
+                                             string_keys):
+    monkeypatch.setenv("PINOT_DEVICE_JOIN_MIN_ROWS", "0")
+    rng = np.random.default_rng(61)
+    left, right = _rand_relations(rng, string_keys=string_keys)
+    got, backend = try_device_join(left, right, ["l.k"], ["r.k"], how,
+                                   THRESH)
+    assert got is not None, backend
+    assert backend in ("device", "mesh_broadcast")
+    exp = hash_join(left, right, ["l.k"], ["r.k"], how)
+    _assert_identical(got, exp)
+
+
+def test_device_join_composite_keys_and_dups(monkeypatch):
+    monkeypatch.setenv("PINOT_DEVICE_JOIN_MIN_ROWS", "0")
+    rng = np.random.default_rng(67)
+    n_l, n_r = 4000, 200
+    left = Relation({
+        "l.a": rng.integers(0, 10, n_l).astype(np.int64),
+        "l.b": rng.choice(["p", "q", "r"], n_l),
+        "l.v": rng.integers(0, 100, n_l).astype(np.int64)})
+    right = Relation({
+        "r.a": rng.integers(0, 10, n_r).astype(np.int64),
+        "r.b": rng.choice(["p", "q", "r"], n_r),
+        "r.w": rng.integers(0, 100, n_r).astype(np.int64)})
+    for how in ("inner", "left"):
+        got, backend = try_device_join(left, right, ["l.a", "l.b"],
+                                       ["r.a", "r.b"], how, THRESH)
+        assert got is not None, backend
+        _assert_identical(got, hash_join(left, right, ["l.a", "l.b"],
+                                         ["r.a", "r.b"], how))
+
+
+def test_fallback_reasons(monkeypatch):
+    rng = np.random.default_rng(71)
+    left, right = _rand_relations(rng, n_l=500)
+    # default min-probe threshold: small relations stay numpy
+    monkeypatch.delenv("PINOT_DEVICE_JOIN_MIN_ROWS", raising=False)
+    rel, why = try_device_join(left, right, ["l.k"], ["r.k"], "inner",
+                               THRESH)
+    assert rel is None and why == "probe_too_small"
+    monkeypatch.setenv("PINOT_DEVICE_JOIN_MIN_ROWS", "0")
+    # build side past the broadcast bound
+    rel, why = try_device_join(left, right, ["l.k"], ["r.k"], "inner", 10)
+    assert rel is None and why == "build_too_big"
+    # key multiplicity past the dense candidate bound
+    monkeypatch.setenv("PINOT_DEVICE_JOIN_MAX_DUP", "2")
+    rel, why = try_device_join(left, right, ["l.k"], ["r.k"], "inner",
+                               THRESH)
+    assert rel is None and why == "max_dup"
+    monkeypatch.delenv("PINOT_DEVICE_JOIN_MAX_DUP")
+    # unsupported join types
+    rel, why = try_device_join(left, right, ["l.k"], ["r.k"], "full",
+                               THRESH)
+    assert rel is None and why == "join_type"
+    # all-null build keys -> empty build
+    n = right.n_rows
+    right.nulls["r.k"] = np.ones(n, dtype=bool)
+    rel, why = try_device_join(left, right, ["l.k"], ["r.k"], "inner",
+                               THRESH)
+    assert rel is None and why == "empty_build"
+
+
+def test_broker_join_runs_mesh_backend(monkeypatch, tmp_path):
+    """Full broker path: the star join executes on the 8-device mesh
+    and answers exactly match the numpy backend."""
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    rng = np.random.default_rng(73)
+    n = 6000
+    cust = {"c_id": np.arange(50).astype(np.int32),
+            "c_nation": rng.choice(["us", "de", "jp"], 50)}
+    orders = {"o_cust": rng.integers(0, 50, n).astype(np.int32),
+              "o_price": rng.integers(1, 500, n).astype(np.int64)}
+    broker = Broker()
+    for name, cols, fields in (
+            ("cust", cust, [FieldSpec("c_id", DataType.INT),
+                            FieldSpec("c_nation", DataType.STRING)]),
+            ("orders", orders,
+             [FieldSpec("o_cust", DataType.INT),
+              FieldSpec("o_price", DataType.LONG, FieldType.METRIC)])):
+        dm = TableDataManager(name)
+        dm.add_segment_dir(SegmentBuilder(
+            Schema(name, fields), TableConfig(name)).build(
+                cols, str(tmp_path / name), "s0"))
+        broker.register_table(dm)
+    sql = ("SELECT c_nation, SUM(o_price) FROM orders "
+           "JOIN cust ON o_cust = c_id "
+           "GROUP BY c_nation ORDER BY c_nation")
+    numpy_rows = broker.query(sql).rows
+
+    import jax
+    monkeypatch.setenv("PINOT_DEVICE_JOIN_MIN_ROWS", "0")
+    want = "mesh_joins" if jax.device_count() > 1 else "device_joins"
+    before = device_join.STATS[want]
+    device_rows = broker.query(sql).rows
+    assert device_join.STATS[want] == before + 1
+    assert device_rows == numpy_rows
+    # oracle: denormalized group-by
+    nation = cust["c_nation"][orders["o_cust"]]
+    exp = [(str(u), int(orders["o_price"][nation == u].sum()))
+           for u in np.unique(nation)]
+    assert [tuple(r) for r in device_rows] == exp
+
+
+def test_explain_names_join_backend(monkeypatch, tmp_path):
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import DataType, FieldSpec, Schema, TableConfig
+
+    rng = np.random.default_rng(79)
+    broker = Broker()
+    for name, cols, fields in (
+            ("d", {"d_id": np.arange(20).astype(np.int32)},
+             [FieldSpec("d_id", DataType.INT)]),
+            ("f", {"f_d": rng.integers(0, 20, 1000).astype(np.int32)},
+             [FieldSpec("f_d", DataType.INT)])):
+        dm = TableDataManager(name)
+        dm.add_segment_dir(SegmentBuilder(
+            Schema(name, fields), TableConfig(name)).build(
+                cols, str(tmp_path / name), "s0"))
+        broker.register_table(dm)
+    monkeypatch.setenv("PINOT_DEVICE_JOIN_MIN_ROWS", "0")
+    rows = broker.query(
+        "EXPLAIN PLAN FOR SELECT COUNT(*) FROM f JOIN d "
+        "ON f_d = d_id").rows
+    join_ops = [r[0] for r in rows if r[0].startswith("HASH_JOIN")]
+    assert join_ops and "backend:device_broadcast" in join_ops[0]
+
+
+def test_explain_predicts_swapped_build_side(monkeypatch, tmp_path):
+    """EXPLAIN's backend prediction mirrors the runtime build-side swap:
+    probe smaller than build on an INNER join still predicts the
+    broadcast backend because the runtime swaps sides."""
+    from pinot_tpu.multistage.device_join import predict_backend
+    monkeypatch.setenv("PINOT_DEVICE_JOIN_MIN_ROWS", "0")
+    # un-swapped: build 120k > 50k threshold would read numpy_shuffle;
+    # the swap makes probe=120k build=100 -> device_broadcast
+    assert predict_backend(100, 120_000, "inner", 50_000) \
+        == "device_broadcast"
+    # LEFT joins pin their sides: no swap, big build -> numpy
+    assert predict_backend(100, 120_000, "left", 50_000) == "numpy"
