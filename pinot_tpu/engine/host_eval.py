@@ -429,7 +429,7 @@ def host_aggregate(ctx: QueryContext, seg: ImmutableSegment,
     states: List[Any] = []
     for agg in ctx.aggregations:
         sel2 = _agg_sel(agg, seg, sel, na)
-        s = _agg_state(agg, seg, sel2)
+        s = _agg_state(agg, seg, sel2, na)
         if na and agg.kind == "sum" and len(sel2) == 0:
             s = None  # SUM over all-null input is null, not 0
         states.append(s)
@@ -442,10 +442,13 @@ def _agg_keep(agg: AggExpr, seg, sel: np.ndarray) -> Optional[np.ndarray]:
     inputs have no nulls. COUNT(*) (arg None) keeps every filtered row."""
     nm = None
     for arg in (agg.arg, agg.arg2):
-        if arg is not None:
-            m = expr_null_mask(arg, seg)
-            if m is not None:
-                nm = m if nm is None else (nm | m)
+        if arg is None or isinstance(arg, tuple):
+            # tuple = funnel step predicates; a null input makes the
+            # predicate false (SQL three-valued logic), not a skipped row
+            continue
+        m = expr_null_mask(arg, seg)
+        if m is not None:
+            nm = m if nm is None else (nm | m)
     return None if nm is None else ~nm[sel]
 
 
@@ -480,14 +483,29 @@ def _typed_ev(impl, agg: AggExpr, seg, sel: np.ndarray):
     return ev
 
 
-def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray) -> Any:
+def _bool_ev(seg, sel: np.ndarray, na: bool = False):
+    """HostSel.ev_bool: a boolean predicate AST evaluated over the
+    selected docs (funnel step expressions). Under enableNullHandling
+    only definitely-TRUE rows match (3VL: a null input never satisfies
+    a step predicate)."""
+    def ev_bool(ast):
+        if na:
+            t, _f = eval_filter_3vl(ast, seg)
+            return np.asarray(t, dtype=bool)[sel]
+        return np.asarray(eval_filter(ast, seg), dtype=bool)[sel]
+    return ev_bool
+
+
+def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
+               na: bool = False) -> Any:
     if agg.kind == "count":
         return int(len(sel))
     if agg.kind.endswith("_mv"):
         return _mv_agg_state(agg, seg, sel)
     impl = aggregations.make(agg)  # extended registry kinds
     if impl is not None:
-        h = aggregations.HostSel(_typed_ev(impl, agg, seg, sel), len(sel))
+        h = aggregations.HostSel(_typed_ev(impl, agg, seg, sel), len(sel),
+                                 ev_bool=_bool_ev(seg, sel, na))
         return impl.state(h)
     vals = eval_value(agg.arg, seg, sel)
     _require_numeric(agg, vals, ("sum", "avg"))
@@ -626,10 +644,10 @@ def host_group_by(ctx: QueryContext, seg: ImmutableSegment,
     for agg in ctx.aggregations:
         keep = _agg_keep(agg, seg, sel) if na else None
         if keep is None or keep.all():
-            per_group = _group_states(agg, seg, sel, inv, n_groups)
+            per_group = _group_states(agg, seg, sel, inv, n_groups, na)
         else:
             per_group = _group_states(agg, seg, sel[keep], inv[keep],
-                                      n_groups)
+                                      n_groups, na)
             if agg.kind in ("sum", "min", "max", "avg"):
                 # groups whose inputs were all null -> null result, not a
                 # sentinel from the empty reduction
@@ -642,7 +660,8 @@ def host_group_by(ctx: QueryContext, seg: ImmutableSegment,
 
 
 def _group_states(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
-                  inv: np.ndarray, n_groups: int) -> List[Any]:
+                  inv: np.ndarray, n_groups: int,
+                  na: bool = False) -> List[Any]:
     if agg.kind == "count":
         c = np.bincount(inv, minlength=n_groups)
         return [int(x) for x in c]
@@ -658,7 +677,8 @@ def _group_states(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
     impl = aggregations.make(agg)  # extended registry kinds
     if impl is not None:
         h = aggregations.HostSel(_typed_ev(impl, agg, seg, sel),
-                                 len(sel), inv, n_groups)
+                                 len(sel), inv, n_groups,
+                                 ev_bool=_bool_ev(seg, sel, na))
         return impl.group_states(h)
     vals = eval_value(agg.arg, seg, sel)
     _require_numeric(agg, vals, ("sum", "avg"))

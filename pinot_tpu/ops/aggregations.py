@@ -70,6 +70,35 @@ AGG_NAME_TO_KIND: Dict[str, str] = {
     "boolor": "bool_or",
     "firstwithtime": "first_with_time",
     "lastwithtime": "last_with_time",
+    # sketch families (round-4; ops/sketches.py — reference:
+    # DistinctCountThetaSketch/CPCSketch/ULL + Raw* variants)
+    "distinctcountthetasketch": "distinct_count_theta",
+    "distinctcountrawthetasketch": "raw_theta",
+    "distinctcountcpcsketch": "distinct_count_cpc",
+    "distinctcountrawcpcsketch": "raw_cpc",
+    "distinctcountull": "distinct_count_ull",
+    "distinctcountrawull": "raw_ull",
+    "distinctcountrawhll": "raw_hll",
+    "distinctcountrawhllplus": "raw_hll",
+    "distinctcountsmarthll": "distinct_count_hll",
+    "fasthll": "distinct_count_hll",
+    "distinctcountintegertuplesketch": "distinct_count_theta",
+    # funnel family (reference: funnel/ + funnel/window/)
+    "funnelcount": "funnel_count",
+    "funnelmaxstep": "funnel_max_step",
+    "funnelmatchstep": "funnel_match_step",
+    "funnelcompletecount": "funnel_complete_count",
+    # distinct-input scalars + collections + misc sketches
+    "distinctsum": "distinct_sum",
+    "distinctavg": "distinct_avg",
+    "arrayagg": "array_agg",
+    "array_agg": "array_agg",
+    "listagg": "listagg",
+    "histogram": "histogram",
+    "frequentlongssketch": "frequent_items",
+    "frequentstringssketch": "frequent_items",
+    "idset": "idset",
+    "percentilesmarttdigest": "percentile_sketch",
     # multi-value variants (reference: SumMVAggregationFunction.java etc.)
     "summv": "sum_mv",
     "countmv": "count_mv",
@@ -92,16 +121,23 @@ MV_BASE_KIND: Dict[str, str] = {
 def base_kind(kind: str) -> str:
     return MV_BASE_KIND.get(kind, kind)
 
-_PERC_RE = re.compile(r"^(percentile(?:est|tdigest|kll)?)(\d{1,2}|100)?$")
+_PERC_RE = re.compile(
+    r"^(percentile(?:raw)?(?:est|tdigest|kll)?)(\d{1,2}|100)?$")
 
 _SKETCH_KINDS = {"percentileest": "percentile_sketch",
                  "percentiletdigest": "percentile_sketch",
                  "percentilekll": "percentile_sketch",
+                 "percentilerawest": "percentile_raw_sketch",
+                 "percentilerawtdigest": "percentile_raw_sketch",
+                 "percentilerawkll": "percentile_raw_sketch",
                  "percentile": "percentile"}
 
 
 def is_agg_name(name: str) -> bool:
-    return name in AGG_NAME_TO_KIND or _PERC_RE.match(name) is not None
+    if name in AGG_NAME_TO_KIND:
+        return True
+    m = _PERC_RE.match(name)
+    return m is not None and m.group(1) in _SKETCH_KINDS
 
 
 def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
@@ -122,7 +158,7 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
             f"{name}(DISTINCT ...) is not supported; only "
             "COUNT(DISTINCT ...)")
     m = _PERC_RE.match(name)
-    if m is not None:
+    if m is not None and m.group(1) in _SKETCH_KINDS:
         base, suffix = m.group(1), m.group(2)
         kind = _SKETCH_KINDS[base]
         if suffix is not None:
@@ -184,8 +220,145 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
             return (kind, args[0], None, (log2m,))
         _need(name, args, 1)
         return (kind, args[0], None, (HLL_DEFAULT_LOG2M,))
+    if kind in ("percentile", "percentile_sketch", "percentile_raw_sketch"):
+        # reached by plain-name aliases outside the percentile regex
+        # (PERCENTILESMARTTDIGEST): same (column, percentile) contract
+        if len(args) != 2:
+            raise _sql_mod().SqlError(f"{name} needs (column, percentile)")
+        p = args[1]
+        if not isinstance(p, _sql_mod().Literal) or isinstance(p.value, str):
+            raise _sql_mod().SqlError(
+                f"{name}: percentile must be a numeric literal")
+        pv = float(p.value)
+        if not 0.0 <= pv <= 100.0:
+            raise _sql_mod().SqlError(
+                f"{name}: percentile must be in [0, 100], got {pv}")
+        return (kind, args[0], None, (pv,))
+    if kind in ("distinct_count_theta", "raw_theta", "distinct_count_cpc",
+                "raw_cpc", "distinct_count_ull", "raw_ull", "raw_hll",
+                "frequent_items"):
+        # (column[, sizing literal]): nominalEntries / lgK / p / log2m /
+        # maxMapSize — one optional integer parameter
+        if len(args) == 2:
+            r = args[1]
+            if not isinstance(r, _sql_mod().Literal):
+                raise _sql_mod().SqlError(
+                    f"{name}: size parameter must be a literal")
+            try:
+                size = int(r.value)
+            except (TypeError, ValueError):
+                raise _sql_mod().SqlError(
+                    f"{name}: size parameter must be an integer, "
+                    f"got {r.value!r}") from None
+            if size <= 0:
+                raise _sql_mod().SqlError(
+                    f"{name}: size parameter must be > 0, got {size}")
+            return (kind, args[0], None, (size,))
+        _need(name, args, 1)
+        return (kind, args[0], None, ())
+    if kind == "funnel_count":
+        return _resolve_funnel_count(name, args)
+    if kind in ("funnel_max_step", "funnel_match_step",
+                "funnel_complete_count"):
+        return _resolve_funnel_window(name, kind, args)
+    if kind == "array_agg":
+        # ARRAYAGG(col, 'dataType'[, distinct]) — the dataType literal is
+        # accepted for reference-signature parity and ignored (numpy
+        # carries the dtype); third literal true -> distinct
+        if len(args) not in (1, 2, 3):
+            raise _sql_mod().SqlError(
+                f"{name} needs (column[, 'dataType'[, distinct]])")
+        distinct_p: Tuple[Any, ...] = ()
+        if len(args) == 3:
+            d = args[2]
+            if isinstance(d, _sql_mod().Literal) and \
+                    str(d.value).lower() in ("true", "1"):
+                distinct_p = ("distinct",)
+        return (kind, args[0], None, distinct_p)
+    if kind == "listagg":
+        if len(args) != 2 or not isinstance(args[1], _sql_mod().Literal):
+            raise _sql_mod().SqlError(
+                f"{name} needs (column, 'separator')")
+        return (kind, args[0], None, (str(args[1].value),))
+    if kind == "histogram":
+        if len(args) != 4:
+            raise _sql_mod().SqlError(
+                f"{name} needs (column, lower, upper, numBins)")
+        vals = []
+        for a in args[1:]:
+            if not isinstance(a, _sql_mod().Literal) or \
+                    isinstance(a.value, str):
+                raise _sql_mod().SqlError(
+                    f"{name}: lower/upper/numBins must be numeric literals")
+            vals.append(a.value)
+        lo, hi, bins = float(vals[0]), float(vals[1]), int(vals[2])
+        if not (hi > lo and bins > 0):
+            raise _sql_mod().SqlError(
+                f"{name}: needs upper > lower and numBins > 0")
+        return (kind, args[0], None, (lo, hi, bins))
     _need(name, args, 1)
     return (kind, args[0], None, ())
+
+
+def _resolve_funnel_count(name: str, args: Tuple[Any, ...]):
+    """FUNNELCOUNT(STEPS(p1, ...), CORRELATEBY(col)[, SETTINGS(...)]) —
+    FunnelCountAggregationFunctionFactory argument shape; the SETTINGS
+    strategy literals are accepted and ignored (one set-based strategy
+    serves all of them here)."""
+    sql = _sql_mod()
+    steps = correlate = None
+    for a in args:
+        if isinstance(a, sql.FuncCall) and a.name == "steps":
+            steps = a.args
+        elif isinstance(a, sql.FuncCall) and a.name == "correlateby":
+            if len(a.args) != 1:
+                raise sql.SqlError(f"{name}: CORRELATEBY takes one column")
+            correlate = a.args[0]
+        elif isinstance(a, sql.FuncCall) and a.name == "settings":
+            continue
+        else:
+            raise sql.SqlError(
+                f"{name} args must be STEPS(...), CORRELATEBY(col)"
+                "[, SETTINGS(...)]")
+    if steps is None or not steps:
+        raise sql.SqlError(f"{name} needs STEPS(...) with >= 1 predicate")
+    if correlate is None:
+        raise sql.SqlError(f"{name} needs CORRELATEBY(column)")
+    return ("funnel_count", correlate, tuple(steps), ())
+
+
+def _resolve_funnel_window(name: str, kind: str, args: Tuple[Any, ...]):
+    """FUNNEL{MAXSTEP,MATCHSTEP,COMPLETECOUNT}(timestampExpression,
+    windowSize, numberSteps, stepExpression..., [mode...]) —
+    FunnelBaseAggregationFunction argument shape."""
+    sql = _sql_mod()
+    if len(args) < 4:
+        raise sql.SqlError(
+            f"{name} needs (timestampExpr, windowSize, numSteps, "
+            "stepExpr, ...)")
+    for i, what in ((1, "windowSize"), (2, "numberSteps")):
+        if not isinstance(args[i], sql.Literal) or \
+                isinstance(args[i].value, str):
+            raise sql.SqlError(f"{name}: {what} must be a numeric literal")
+    window = int(args[1].value)
+    n_steps = int(args[2].value)
+    if window <= 0 or n_steps <= 0:
+        raise sql.SqlError(f"{name}: windowSize and numberSteps must be > 0")
+    if len(args) < 3 + n_steps:
+        raise sql.SqlError(
+            f"{name}: expected {n_steps} step expressions, "
+            f"got {len(args) - 3}")
+    steps = tuple(args[3:3 + n_steps])
+    modes = []
+    for a in args[3 + n_steps:]:
+        if not isinstance(a, sql.Literal) or not isinstance(a.value, str):
+            raise sql.SqlError(f"{name}: modes must be string literals")
+        mode = a.value.upper()
+        if mode not in ("STRICT_DEDUPLICATION", "STRICT_ORDER",
+                        "STRICT_INCREASE", "KEEP_ALL"):
+            raise sql.SqlError(f"{name}: unknown mode {a.value!r}")
+        modes.append(mode)
+    return (kind, args[0], steps, (window, n_steps, *modes))
 
 
 def _need(name: str, args: Tuple[Any, ...], n: int) -> None:
@@ -200,17 +373,20 @@ def _need(name: str, args: Tuple[Any, ...], n: int) -> None:
 class HostSel:
     """Selected-docs view handed to aggregation state extractors.
 
-    ev(ast) -> numpy array over the selected docs; inv/n_groups present in
-    group-by context (inv = group index per selected doc).
+    ev(ast) -> numpy array over the selected docs; ev_bool(ast) -> bool
+    mask over the selected docs (funnel step predicates); inv/n_groups
+    present in group-by context (inv = group index per selected doc).
     """
-    __slots__ = ("ev", "n", "inv", "n_groups")
+    __slots__ = ("ev", "n", "inv", "n_groups", "ev_bool")
 
     def __init__(self, ev: Callable[[Any], np.ndarray], n: int,
-                 inv: Optional[np.ndarray] = None, n_groups: int = 0):
+                 inv: Optional[np.ndarray] = None, n_groups: int = 0,
+                 ev_bool: Optional[Callable[[Any], np.ndarray]] = None):
         self.ev = ev
         self.n = n
         self.inv = inv
         self.n_groups = n_groups
+        self.ev_bool = ev_bool
 
 
 def _per_group_apply(vals: np.ndarray, inv: np.ndarray, n_groups: int,
@@ -222,6 +398,20 @@ def _per_group_apply(vals: np.ndarray, inv: np.ndarray, n_groups: int,
     si = inv[order]
     bounds = np.searchsorted(si, np.arange(n_groups + 1))
     return [fn(sv[bounds[g]:bounds[g + 1]]) for g in range(n_groups)]
+
+
+def _per_group_apply_multi(arrays: List[np.ndarray], inv: np.ndarray,
+                           n_groups: int,
+                           fn: Callable[..., Any]) -> List[Any]:
+    """_per_group_apply over parallel arrays: fn receives one slice per
+    input array (funnel states need correlate values + step masks from
+    the same partition)."""
+    order = np.argsort(inv, kind="stable")
+    si = inv[order]
+    bounds = np.searchsorted(si, np.arange(n_groups + 1))
+    sliced = [a[order] for a in arrays]
+    return [fn(*(a[bounds[g]:bounds[g + 1]] for a in sliced))
+            for g in range(n_groups)]
 
 
 def _f64(v: np.ndarray) -> np.ndarray:
@@ -811,6 +1001,55 @@ def make(agg: Any) -> Optional[AggImpl]:
         return WithTimeAgg(agg, last=False)
     if k == "last_with_time":
         return WithTimeAgg(agg, last=True)
+    impl = _make_sketch(agg, k)
+    if impl is not None:
+        return impl
+    return None
+
+
+def _make_sketch(agg: Any, k: str):
+    """Round-4 families (ops/sketches.py); separate module, one routing
+    point here."""
+    from . import sketches as S
+
+    if k == "distinct_count_theta":
+        return S.ThetaSketchAgg(agg)
+    if k == "distinct_count_cpc":
+        return S.CpcSketchAgg(agg)
+    if k == "distinct_count_ull":
+        return S.UllSketchAgg(agg)
+    if k == "raw_hll":
+        return S.RawAgg(agg, HllAgg(agg))
+    if k == "raw_theta":
+        return S.RawAgg(agg, S.ThetaSketchAgg(agg))
+    if k == "raw_cpc":
+        return S.RawAgg(agg, S.CpcSketchAgg(agg))
+    if k == "raw_ull":
+        return S.RawAgg(agg, S.UllSketchAgg(agg))
+    if k == "percentile_raw_sketch":
+        return S.RawAgg(agg, PercentileSketchAgg(agg))
+    if k == "funnel_count":
+        return S.FunnelCountAgg(agg)
+    if k == "funnel_max_step":
+        return S.FunnelMaxStepAgg(agg)
+    if k == "funnel_match_step":
+        return S.FunnelMatchStepAgg(agg)
+    if k == "funnel_complete_count":
+        return S.FunnelCompleteCountAgg(agg)
+    if k == "distinct_sum":
+        return S.DistinctSumAgg(agg, avg=False)
+    if k == "distinct_avg":
+        return S.DistinctSumAgg(agg, avg=True)
+    if k == "array_agg":
+        return S.ArrayAggAgg(agg)
+    if k == "listagg":
+        return S.ArrayAggAgg(agg, listagg=True)
+    if k == "histogram":
+        return S.HistogramAgg(agg)
+    if k == "frequent_items":
+        return S.FrequentItemsAgg(agg)
+    if k == "idset":
+        return S.IdSetAgg(agg)
     return None
 
 

@@ -245,6 +245,11 @@ _TOKEN_RE = re.compile(r"""
     | (?P<op><=|>=|!=|<>|=|<|>|\(|\)|\[|\]|,|\*|\+|-|/|%|;)
     )""", re.VERBOSE)
 
+# functions whose call arguments may be boolean predicates (funnel step
+# expressions; STEPS(...) is the nested wrapper inside FUNNELCOUNT)
+_BOOL_ARG_FUNCS = {"funnelcount", "funnelmaxstep", "funnelmatchstep",
+                   "funnelcompletecount", "steps"}
+
 KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "and", "or", "not", "between", "in", "like", "is", "null",
@@ -662,15 +667,20 @@ class _Parser:
             if self.peek().kind == "op" and self.peek().value == "(":
                 self.next()
                 distinct = bool(self.accept_kw("distinct"))
+                # the funnel family takes boolean step predicates as
+                # arguments (FunnelBaseAggregationFunction: stepExpression
+                # args) — parse those args with the boolean grammar
+                argp = self.or_expr if t.value.lower() in _BOOL_ARG_FUNCS \
+                    else self.add_expr
                 args: List[Any] = []
                 if not (self.peek().kind == "op" and self.peek().value == ")"):
                     if self.peek().kind == "op" and self.peek().value == "*":
                         self.next()
                         args.append(Star())
                     else:
-                        args.append(self.add_expr())
+                        args.append(argp())
                     while self.accept_op(","):
-                        args.append(self.add_expr())
+                        args.append(argp())
                 self.expect_op(")")
                 fc = FuncCall(t.value.lower(), tuple(args), distinct)
                 if self.accept_kw("over"):
