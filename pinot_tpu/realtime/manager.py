@@ -259,13 +259,22 @@ class RealtimeTableDataManager(TableDataManager):
             m.index_batch(rows)
             return
         for i, row in enumerate(rows):
-            doc = m.index(row)
             if drop is not None and drop[i]:
-                m.invalidate_doc(doc)  # ingestion-filtered row
-            elif dedup is not None and dedup.should_drop(row):
-                m.invalidate_doc(doc)
+                m.invalidate_doc(m.index(row))  # ingestion-filtered row
+            elif dedup is not None:
+                doc = m.index(row)
+                if dedup.should_drop(row):
+                    m.invalidate_doc(doc)
             elif upsert is not None:
+                # partial mode merges with the current live row BEFORE
+                # indexing, so the indexed row is already the merged one
+                row = upsert.prepare_row(row)
+                doc = m.index(row)
                 upsert.add_row(m, doc, row, offset + i)
+            else:
+                m.index(row)
+        if upsert is not None:
+            upsert.evict_expired()  # metadata TTL housekeeping per batch
 
     def _maybe_seal(self, p: int) -> None:
         m = self._mutables[p]

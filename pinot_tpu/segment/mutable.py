@@ -33,9 +33,12 @@ _INITIAL_CAPACITY = 4096
 class _MutableColumn:
     def __init__(self, spec: FieldSpec):
         self.spec = spec
+        self.is_mv = not spec.single_value
         self.is_string = (spec.data_type == DataType.STRING
                           or not spec.data_type.is_numeric)
-        if self.is_string:
+        if self.is_string or self.is_mv:
+            # MV columns hold python lists per row (round-4: partial
+            # upsert APPEND/UNION need MV on the consuming segment)
             self.values: Any = np.empty(_INITIAL_CAPACITY, dtype=object)
         else:
             self.values = np.zeros(_INITIAL_CAPACITY,
@@ -60,8 +63,14 @@ class _MutableColumn:
         if v is None:
             self.nulls[i] = True
             self.any_nulls = True
+            if self.is_mv:
+                self.values[i] = []
+                return
             v = self.spec.null_value()
-        if self.is_string:
+        if self.is_mv:
+            self.values[i] = list(v) if isinstance(v, (list, tuple)) \
+                else [v]
+        elif self.is_string:
             self.values[i] = str(v)
         else:
             if self.spec.data_type == DataType.BOOLEAN and isinstance(
@@ -109,6 +118,21 @@ class MutableSegment:
     def invalidate_doc(self, doc_id: int) -> None:
         """Upsert: an earlier row for this PK was superseded."""
         self._valid[doc_id] = False
+
+    def get_row(self, doc_id: int) -> Dict[str, Any]:
+        """One indexed row in value space (None for nulls) — the
+        partial-upsert merge reads the previous live row through this
+        (GenericRow readback; MutableSegmentImpl.getRecord analog)."""
+        row: Dict[str, Any] = {}
+        for name, c in self._cols.items():
+            if c.nulls[doc_id]:
+                row[name] = None
+            elif c.is_mv:
+                row[name] = list(c.values[doc_id])
+            else:
+                v = c.values[doc_id]
+                row[name] = v.item() if isinstance(v, np.generic) else v
+        return row
 
     def valid_mask(self, n: int) -> np.ndarray:
         return self._valid[:n]

@@ -24,6 +24,20 @@ import numpy as np
 class UpsertConfig:
     pk_columns: List[str]
     comparison_column: Optional[str] = None  # None -> stream order wins
+    # round-4: partial upsert (reference UpsertConfig.Mode.PARTIAL +
+    # partialUpsertStrategies) and metadata TTL (metadataTTL, in
+    # comparison-value units)
+    mode: str = "full"                       # "full" | "partial"
+    partial_strategies: Dict[str, str] = field(default_factory=dict)
+    default_strategy: str = "overwrite"
+    metadata_ttl: Optional[float] = None
+
+    def __post_init__(self):
+        if self.mode not in ("full", "partial"):
+            raise ValueError(f"upsert mode must be full|partial, "
+                             f"got {self.mode!r}")
+        if self.metadata_ttl is not None and self.metadata_ttl <= 0:
+            raise ValueError("metadata_ttl must be > 0")
 
 
 @dataclass
@@ -38,6 +52,55 @@ class PartitionUpsertMetadataManager:
         self.config = config
         self._map: Dict[Tuple, Tuple[Any, int, Any]] = {}
         self._lock = threading.Lock()
+        self._largest_cmp: Any = None   # TTL watermark (reference:
+        # BasePartitionUpsertMetadataManager._largestSeenComparisonValue)
+        self._last_evict_watermark: Any = None
+        self.merger = None
+        if config.mode == "partial":
+            from .merger import PartialUpsertMerger
+            self.merger = PartialUpsertMerger(
+                config.pk_columns, config.comparison_column,
+                config.partial_strategies, config.default_strategy)
+
+    def prepare_row(self, row) -> Any:
+        """Partial upsert: merge the incoming row with the current live
+        row for its PK BEFORE indexing (PartialUpsertColumnarMerger is
+        applied on ingestion in the reference too). Full mode and
+        first-seen PKs return the row unchanged."""
+        if self.merger is None:
+            return row
+        pk = self._pk(row)
+        with self._lock:
+            cur = self._map.get(pk)
+        if cur is None:
+            return row
+        from .merger import read_row
+        seg, doc, _cmp = cur
+        return self.merger.merge(read_row(seg, doc), dict(row))
+
+    def _note_cmp(self, cmp_val: Any) -> None:
+        if isinstance(cmp_val, (int, float)) and (
+                self._largest_cmp is None or cmp_val > self._largest_cmp):
+            self._largest_cmp = cmp_val
+
+    def evict_expired(self) -> int:
+        """Metadata TTL: drop tracking for PKs whose comparison value
+        fell behind the watermark by more than metadata_ttl. Their rows
+        stay queryable — only upsert management stops (reference
+        removeExpiredPrimaryKeys semantics). Returns evicted count."""
+        ttl = self.config.metadata_ttl
+        if ttl is None or self._largest_cmp is None:
+            return 0
+        if self._largest_cmp == self._last_evict_watermark:
+            return 0   # watermark unchanged: the O(keys) scan is skipped
+        self._last_evict_watermark = self._largest_cmp
+        horizon = self._largest_cmp - ttl
+        with self._lock:
+            stale = [pk for pk, (_s, _d, c) in self._map.items()
+                     if isinstance(c, (int, float)) and c < horizon]
+            for pk in stale:
+                del self._map[pk]
+        return len(stale)
 
     def _pk(self, row) -> Tuple:
         return tuple(row[c] for c in self.config.pk_columns)
@@ -54,6 +117,7 @@ class PartitionUpsertMetadataManager:
         existing newer record (its own bit should drop)."""
         pk = self._pk(row)
         cmp_val = self._cmp(row, order_token)
+        self._note_cmp(cmp_val)
         with self._lock:
             cur = self._map.get(pk)
             if cur is not None:
@@ -72,6 +136,8 @@ class PartitionUpsertMetadataManager:
         """Restart rehydration: replay a committed segment's keys in doc
         order; builds this segment's valid mask and supersedes older ones."""
         valid = np.ones(len(rows_pk), dtype=bool)
+        for c in cmp_vals:
+            self._note_cmp(c)
         with self._lock:
             for doc_id, (pk, cmp_val) in enumerate(zip(rows_pk, cmp_vals)):
                 cur = self._map.get(pk)
