@@ -122,12 +122,10 @@ def _reduce_group_by(ctx: QueryContext, partials: List[GroupByPartial]
             env[agg.label] = finalize_state(agg, states[i])
         if ctx.having is not None and not _eval_scalar_bool(ctx.having, env):
             continue
-        row = tuple(env[item.label] if isinstance(item, AggExpr)
-                    else env[_expr_label(item)]
-                    if _expr_label(item) in env
-                    else _eval_scalar(item, env)
-                    for item in ctx.select_items)
-        rows.append((row, env))  # env kept for ORDER BY evaluation
+        rows.append((_build_row(ctx, env), env))  # env kept for ORDER BY
+
+    if ctx.gapfill is not None:
+        rows = _apply_gapfill(ctx, rows)
 
     if ctx.order_by:
         def sort_key(entry):
@@ -145,6 +143,71 @@ def _reduce_group_by(ctx: QueryContext, partials: List[GroupByPartial]
     rows = rows[ctx.offset: ctx.offset + limit]
     labels = list(ctx.labels)
     return ResultTable(labels, [r for r, _ in rows])
+
+
+def _build_row(ctx: QueryContext, env: Dict[str, Any]) -> tuple:
+    return tuple(env[item.label] if isinstance(item, AggExpr)
+                 else env[_expr_label(item)]
+                 if _expr_label(item) in env
+                 else _eval_scalar(item, env)
+                 for item in ctx.select_items)
+
+
+def _apply_gapfill(ctx: QueryContext, entries: List[tuple]) -> List[tuple]:
+    """Time-bucket gapfill over reduced group-by rows (GapfillProcessor
+    analog). For every TIMESERIESON series observed in the result, emit
+    one row per bucket in [start, end); missing buckets take
+    FILL_PREVIOUS_VALUE (carry-forward along the series),
+    FILL_DEFAULT_VALUE (zero-value of the column's observed type), or
+    NULL for unfilled columns. Runs BEFORE order/limit, so LIMIT applies
+    to the gapfilled output like the reference's outer query."""
+    g = ctx.gapfill
+    tl = g.time_label
+
+    existing: Dict[tuple, Dict[int, Dict[str, Any]]] = {}
+    series_order: List[tuple] = []
+    other_labels: set = set()
+    defaults: Dict[str, Any] = {}
+    for _row, env in entries:
+        t = env.get(tl)
+        if not isinstance(t, (int, float)) or not g.start <= t < g.end:
+            continue
+        bucket = g.start + int((t - g.start) // g.interval) * g.interval
+        sk = tuple(env.get(l) for l in g.series_labels)
+        per = existing.get(sk)
+        if per is None:
+            per = existing[sk] = {}
+            series_order.append(sk)
+        per.setdefault(bucket, env)  # finer-than-interval rows: first wins
+        for lbl, v in env.items():
+            other_labels.add(lbl)
+            if v is not None and lbl not in defaults:
+                defaults[lbl] = type(v)()  # zero-value: 0 / 0.0 / ""
+    other_labels -= {tl, *g.series_labels}
+
+    out: List[tuple] = []
+    for sk in series_order:
+        per = existing[sk]
+        prev_env: Optional[Dict[str, Any]] = None
+        for bucket in range(g.start, g.end, g.interval):
+            env = per.get(bucket)
+            if env is None:
+                env = {tl: bucket}
+                env.update(zip(g.series_labels, sk))
+                for lbl in other_labels:
+                    mode = g.fills.get(lbl)
+                    if mode == "previous" and prev_env is not None:
+                        env[lbl] = prev_env.get(lbl)
+                    elif mode == "default":
+                        env[lbl] = defaults.get(lbl)
+                    else:
+                        env[lbl] = None
+            else:
+                env = dict(env)
+                env[tl] = bucket
+            out.append((_build_row(ctx, env), env))
+            prev_env = env
+    return out
 
 
 def _key_sortable(row: tuple) -> tuple:

@@ -9,7 +9,7 @@ planner (planner.py) lowers this to per-segment kernel plans.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from .sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr, CaseWhen,
                   Cast, Comparison, FuncCall, Identifier, InList, IsNull,
@@ -33,6 +33,21 @@ class AggExpr:
 
 
 @dataclass
+class GapfillSpec:
+    """Broker-reduce time-bucket gapfill (round-4, VERDICT r3 item 7;
+    reference: pinot-core/.../query/reduce/GapfillProcessor.java:50).
+    Extracted from GAPFILL(timeExpr, start, end, interval,
+    FILL(col, 'FILL_PREVIOUS_VALUE'|'FILL_DEFAULT_VALUE')...,
+    TIMESERIESON(col, ...)) in the select list."""
+    time_label: str
+    start: int
+    end: int
+    interval: int
+    fills: Dict[str, str]              # env label -> previous | default
+    series_labels: List[str]
+
+
+@dataclass
 class QueryContext:
     table: str
     select_items: List[Any]            # AggExpr | expr AST (group key / selection)
@@ -45,6 +60,7 @@ class QueryContext:
     limit: Optional[int]
     offset: int
     options: dict = field(default_factory=dict)
+    gapfill: Optional[GapfillSpec] = None
 
     @property
     def is_aggregation(self) -> bool:
@@ -89,7 +105,75 @@ def _find_aggs(e: Any, out: List[FuncCall]) -> None:
         _find_aggs(a, out)
 
 
+def _extract_gapfill(stmt: SelectStmt
+                     ) -> Tuple[SelectStmt, Optional[GapfillSpec]]:
+    """Pull a GAPFILL(...) wrapper off the select list, leaving the bare
+    time expression in its place (planning stays unchanged; the fill
+    happens at broker reduce — GapfillProcessor analog)."""
+    from .sql import SelectItem
+    spec: Optional[GapfillSpec] = None
+    alias_map = {item.alias: _expr_label(item.expr)
+                 for item in stmt.select
+                 if item.alias and not isinstance(item.expr, Star)}
+
+    def _target_label(e: Any) -> str:
+        if isinstance(e, Identifier) and e.name in alias_map:
+            return alias_map[e.name]
+        return _expr_label(e)
+
+    new_select: List[SelectItem] = []
+    for item in stmt.select:
+        e = item.expr
+        if not (isinstance(e, FuncCall) and e.name == "gapfill"):
+            new_select.append(item)
+            continue
+        if spec is not None:
+            raise SqlError("multiple GAPFILL expressions")
+        args = e.args
+        if len(args) < 4:
+            raise SqlError(
+                "GAPFILL needs (timeExpr, start, end, interval"
+                "[, FILL(col, mode)..., TIMESERIESON(col, ...)])")
+        nums = []
+        for a, what in zip(args[1:4], ("start", "end", "interval")):
+            if not isinstance(a, Literal) or isinstance(a.value, str):
+                raise SqlError(f"GAPFILL {what} must be a numeric literal")
+            nums.append(int(a.value))
+        start, end, interval = nums
+        if interval <= 0 or end <= start:
+            raise SqlError("GAPFILL needs end > start and interval > 0")
+        fills: Dict[str, str] = {}
+        series: List[str] = []
+        for a in args[4:]:
+            if isinstance(a, FuncCall) and a.name == "fill":
+                if len(a.args) != 2 or not isinstance(a.args[1], Literal):
+                    raise SqlError("FILL needs (column, 'mode')")
+                mode = str(a.args[1].value).upper()
+                if mode not in ("FILL_PREVIOUS_VALUE",
+                                "FILL_DEFAULT_VALUE"):
+                    raise SqlError(f"unknown FILL mode {a.args[1].value!r}")
+                fills[_target_label(a.args[0])] = \
+                    "previous" if mode == "FILL_PREVIOUS_VALUE" \
+                    else "default"
+            elif isinstance(a, FuncCall) and a.name == "timeserieson":
+                if not a.args:
+                    raise SqlError("TIMESERIESON needs >= 1 column")
+                series = [_target_label(x) for x in a.args]
+            else:
+                raise SqlError(
+                    "GAPFILL extras must be FILL(...) or TIMESERIESON(...)")
+        time_expr = args[0]
+        spec = GapfillSpec(_expr_label(time_expr), start, end, interval,
+                           fills, series)
+        new_select.append(SelectItem(time_expr, item.alias))
+    if spec is None:
+        return stmt, None
+    import dataclasses as _dc
+    return _dc.replace(stmt, select=new_select), spec
+
+
 def build_query_context(stmt: SelectStmt) -> QueryContext:
+    stmt, gapfill_spec = _extract_gapfill(stmt)
     aggregations: List[AggExpr] = []
     select_items: List[Any] = []
     labels: List[str] = []
@@ -214,6 +298,8 @@ def build_query_context(stmt: SelectStmt) -> QueryContext:
         if isinstance(o.expr, Identifier) and o.expr.name in alias_exprs
         else o for o in stmt.order_by]
 
+    if gapfill_spec is not None and not group_by:
+        raise SqlError("GAPFILL requires a GROUP BY over the time bucket")
     return QueryContext(
         table=stmt.table,
         select_items=select_items,
@@ -226,6 +312,7 @@ def build_query_context(stmt: SelectStmt) -> QueryContext:
         limit=limit,
         offset=stmt.offset,
         options=stmt.options,
+        gapfill=gapfill_spec,
     )
 
 
