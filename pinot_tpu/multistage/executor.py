@@ -32,7 +32,7 @@ from ..query.sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr,
 from . import device_join
 from .device_join import try_device_join
 from .exchange import HashExchange, MailboxService, hash_partition_codes
-from .join import hash_join, null_extend
+from .join import cross_join, hash_join, null_extend
 from .relation import Relation
 
 BROADCAST_THRESHOLD = 50_000   # right side smaller -> broadcast join
@@ -149,13 +149,41 @@ class MultiStageExecutor:
                         continue
                     raise
                 needed[label].add(col)
+        for t in self.tables:
+            if not needed[t.label]:
+                # a relation with zero columns has zero rows — COUNT(*)
+                # over a CROSS JOIN still needs each side's row count, so
+                # carry one (arbitrary) column per unreferenced table
+                cols = self.schemas[t.label].column_names
+                if cols:
+                    needed[t.label].add(cols[0])
         return needed
 
+    def _null_extended_labels(self) -> Set[str]:
+        """Tables whose rows can be null-extended by some outer join: a
+        LEFT join null-extends its right table, a RIGHT join the whole
+        accumulated left side, FULL both. (Outer joins are reorder
+        barriers, so textual order is execution order here.)"""
+        out: Set[str] = set()
+        seen = {self.tables[0].label}
+        for j in self.stmt.joins:
+            if j.join_type in ("left", "full"):
+                out.add(j.table.label)
+            if j.join_type in ("right", "full"):
+                out |= seen
+            seen.add(j.table.label)
+        return out
+
     def _pushable(self, label: str) -> bool:
-        # base scans always take their filters; joined sides only when the
-        # join is INNER (pushing into the LEFT JOIN's right side would turn
-        # preserved rows into dropped ones)
-        return self.join_types[label] in ("base", "inner")
+        # a WHERE conjunct pushes into a leaf scan only when that table's
+        # rows are never null-extended downstream — pushing below the
+        # null-extending side would resurrect rows the post-join filter
+        # must drop (LEFT's right side) or drop preserved rows. Preserved
+        # sides (base/inner with no RIGHT/FULL above them, the right side
+        # of a RIGHT join) stay pushable.
+        if label in self._null_extended_labels():
+            return False
+        return self.join_types[label] in ("base", "inner", "right")
 
     def _split_where(self) -> Tuple[Dict[str, List[Any]], List[Any]]:
         pushed: Dict[str, List[Any]] = {t.label: [] for t in self.tables}
@@ -266,10 +294,10 @@ class MultiStageExecutor:
         if how == "inner" and left.n_rows < right.n_rows:
             # cost-based build-side choice: hash_join builds its table on
             # the second relation, so put the SMALLER side there (Calcite
-            # swaps join inputs the same way; LEFT joins pin their sides)
+            # swaps join inputs the same way; outer joins pin their sides)
             left, right = right, left
             lkeys, rkeys = rkeys, lkeys
-        if right.n_rows <= BROADCAST_THRESHOLD or how == "left":
+        if right.n_rows <= BROADCAST_THRESHOLD or how != "inner":
             # broadcast join (small build side / preserved-row semantics):
             # device sort+searchsorted probe when the shape fits the
             # dense formulation, numpy otherwise (device_join.py)
@@ -330,28 +358,43 @@ class MultiStageExecutor:
             right = self.leaf_scan(j.table, needed[label],
                                    _and(pushed[label]))
             equi, rest = self._split_on(j.on, joined_labels, label)
-            if not equi:
-                raise SqlError(
-                    f"join with {label!r} has no equi condition; "
-                    "cross joins are not supported yet")
+            if j.join_type == "cross" or not equi:
+                if j.join_type != "cross":
+                    raise SqlError(
+                        f"join with {label!r} has no equi condition; "
+                        "use CROSS JOIN for a cartesian product")
+                # parser guarantees CROSS has no ON, so rest is empty
+                self.join_backends.append("numpy(cross)")
+                device_join.STATS["numpy_joins"] += 1
+                current = cross_join(current, right)
+                joined_labels.add(label)
+                continue
             lkeys = [p[0] for p in equi]
             rkeys = [p[1] for p in equi]
-            if j.join_type == "left" and rest:
-                # LEFT JOIN with non-equi ON conjuncts: rows whose matches
-                # all fail the conjunct are null-extended, never dropped
+            if j.join_type in ("left", "right", "full") and rest:
+                # OUTER JOIN with non-equi ON conjuncts: pairs failing
+                # the conjunct are NON-matches — preserved-side rows
+                # null-extend, never drop (HashJoinOperator join-clause
+                # semantics; a post-join filter would wrongly drop them)
                 device_join.STATS["numpy_joins"] += 1
-                self.join_backends.append("numpy(non_equi_left)")
-                inner, l_idx, _ = hash_join(current, right, lkeys, rkeys,
-                                            "inner", return_lidx=True)
+                self.join_backends.append(f"numpy(non_equi_{j.join_type})")
+                inner, l_idx, r_idx, _m = hash_join(
+                    current, right, lkeys, rkeys, "inner",
+                    return_idx=True)
                 m = np.ones(inner.n_rows, dtype=bool)
                 for conj in rest:
                     m &= host_eval.eval_filter(conj, inner)
                 keep = np.nonzero(m)[0]
-                surviving = inner.take(keep)
-                surv_l = np.unique(l_idx[keep])
-                unmatched = np.setdiff1d(np.arange(current.n_rows), surv_l)
-                current = Relation.concat([
-                    surviving, null_extend(current.take(unmatched), right)])
+                parts = [inner.take(keep)]
+                if j.join_type in ("left", "full"):
+                    un_l = np.setdiff1d(np.arange(current.n_rows),
+                                        np.unique(l_idx[keep]))
+                    parts.append(null_extend(current.take(un_l), right))
+                if j.join_type in ("right", "full"):
+                    un_r = np.setdiff1d(np.arange(right.n_rows),
+                                        np.unique(r_idx[keep]))
+                    parts.append(null_extend(right.take(un_r), current))
+                current = Relation.concat(parts)
             else:
                 current = self._join(current, right, lkeys, rkeys,
                                      j.join_type, query_id, si + 2)
@@ -446,12 +489,17 @@ def explain_multistage(broker, stmt: SelectStmt) -> ResultTable:
         label = j.table.label
         equi, rest = ex._split_on(
             j.on, {t.label for t in ex.tables if t.label != label}, label)
-        backend = device_join.predict_backend(
-            probe_est, step["rightRows"], j.join_type, BROADCAST_THRESHOLD)
-        parent = emit(
-            f"HASH_JOIN({j.join_type.upper()},keys:{len(equi)},"
-            f"non_equi:{len(rest)},est_rows:{step['estRows']},"
-            f"backend:{backend})", parent)
+        if j.join_type == "cross":
+            parent = emit(f"CROSS_JOIN(est_rows:{step['estRows']})",
+                          parent)
+        else:
+            backend = device_join.predict_backend(
+                probe_est, step["rightRows"], j.join_type,
+                BROADCAST_THRESHOLD)
+            parent = emit(
+                f"HASH_JOIN({j.join_type.upper()},keys:{len(equi)},"
+                f"non_equi:{len(rest)},est_rows:{step['estRows']},"
+                f"backend:{backend})", parent)
         emit(f"LEAF_SCAN({label},cols:{len(needed[label])},"
              f"pushed_filters:{len(pushed[label])},"
              f"est_rows:{round(ex._table_row_est[label])})", parent)

@@ -67,13 +67,24 @@ def null_extend(left: Relation, right: Relation) -> Relation:
 
 def hash_join(left: Relation, right: Relation,
               left_keys: List[str], right_keys: List[str],
-              how: str = "inner", return_lidx: bool = False):
-    """-> Relation, or (Relation, l_idx, matched) when return_lidx.
+              how: str = "inner", return_idx: bool = False):
+    """-> Relation, or (Relation, l_idx, r_idx, matched) when return_idx.
 
-    l_idx maps each output row to its source left row; matched is False on
-    LEFT-join null-extended rows.
+    l_idx/r_idx map each output row to its source rows; matched is False
+    on LEFT-join null-extended rows. RIGHT is LEFT with the sides
+    swapped (column set identical); FULL is LEFT plus null-extended
+    unmatched build rows (HashJoinOperator.java:60-76 coverage).
+    return_idx is only meaningful for INNER/LEFT (FULL's appended rows
+    have no probe index; the executor's unified non-equi path uses
+    INNER + explicit null-extension for the outer types).
     """
-    if how not in ("inner", "left"):
+    if how == "right":
+        if return_idx:
+            raise ValueError("return_idx unsupported for RIGHT joins")
+        return hash_join(right, left, right_keys, left_keys, "left")
+    if how == "full" and return_idx:
+        raise ValueError("return_idx unsupported for FULL joins")
+    if how not in ("inner", "left", "full"):
         raise ValueError(f"unsupported join type {how!r}")
     code_l, code_r = _composite_codes(
         [left.raw_values(k) for k in left_keys],
@@ -98,7 +109,7 @@ def hash_join(left: Relation, right: Relation,
     if lnull is not None:
         counts = np.where(lnull, 0, counts)
 
-    if how == "left":
+    if how in ("left", "full"):
         out_counts = np.maximum(counts, 1)  # unmatched keep one null row
     else:
         out_counts = counts
@@ -114,8 +125,16 @@ def hash_join(left: Relation, right: Relation,
     r_idx = order[r_pos] if len(order) else np.zeros(total, dtype=np.int64)
 
     rel = materialize_join(left, right, l_idx, r_idx, matched, how)
-    if return_lidx:
-        return rel, l_idx, matched
+    if how == "full":
+        # append right rows no probe row matched, left columns null
+        hit = np.zeros(right.n_rows, dtype=bool)
+        if matched.any():
+            hit[r_idx[matched]] = True
+        un = np.nonzero(~hit)[0]
+        if len(un):
+            rel = Relation.concat([rel, null_extend(right.take(un), left)])
+    if return_idx:
+        return rel, l_idx, r_idx, matched
     return rel
 
 
@@ -135,7 +154,7 @@ def materialize_join(left: Relation, right: Relation, l_idx: np.ndarray,
         col = v[r_idx] if len(v) else np.zeros(total, dtype=v.dtype)
         nm = right.nulls.get(k)
         nm = nm[r_idx] if nm is not None else None
-        if how == "left":
+        if how in ("left", "full"):
             unmatched = ~matched
             if unmatched.any():
                 col = col.copy()
@@ -146,6 +165,27 @@ def materialize_join(left: Relation, right: Relation, l_idx: np.ndarray,
             nulls[k] = nm
         data[k] = col
     return Relation(data, nulls, f"{left.name}*{right.name}")
+
+
+def cross_join(left: Relation, right: Relation,
+               max_rows: Optional[int] = None) -> Relation:
+    """Cartesian product (CROSS JOIN). Bounded by max_rows (default from
+    PINOT_MAX_ROWS_IN_JOIN, 25M) — the reference guards the same blowup
+    with the maxRowsInJoin hint (HashJoinOperator join-row limits)."""
+    import os
+
+    cap = max_rows if max_rows is not None else int(
+        os.environ.get("PINOT_MAX_ROWS_IN_JOIN", 25_000_000))
+    total = left.n_rows * right.n_rows
+    if total > cap:
+        from ..query.sql import SqlError
+        raise SqlError(
+            f"CROSS JOIN would produce {total} rows (cap {cap}; raise "
+            "PINOT_MAX_ROWS_IN_JOIN to override)")
+    l_idx = np.repeat(np.arange(left.n_rows), right.n_rows)
+    r_idx = np.tile(np.arange(right.n_rows), left.n_rows)
+    matched = np.ones(total, dtype=bool)
+    return materialize_join(left, right, l_idx, r_idx, matched, "inner")
 
 
 def _default_for(dtype) -> object:

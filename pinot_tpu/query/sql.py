@@ -437,15 +437,25 @@ class _Parser:
                 self.accept_kw("outer")
                 self.expect_kw("join")
                 jt = "left"
-            elif self.peek().kind == "kw" and self.peek().value in (
-                    "right", "full", "cross"):
-                raise SqlError(f"{self.peek().value.upper()} JOIN "
-                               "not supported yet")
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                jt = "right"
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                jt = "full"
+            elif self.accept_kw("cross"):
+                self.expect_kw("join")
+                jt = "cross"
             if jt is None:
                 break
             tref = self.table_ref()
-            self.expect_kw("on")
-            cond = self.or_expr()
+            if jt == "cross":
+                cond = None             # cartesian product: no ON clause
+            else:
+                self.expect_kw("on")
+                cond = self.or_expr()
             stmt.joins.append(JoinClause(tref, cond, jt))
         if self.accept_kw("where"):
             stmt.where = self.or_expr()
