@@ -266,7 +266,10 @@ def _scalar_state(b: AggBinding, out: Dict[str, np.ndarray], matched: int,
     if k == "count":
         return int(out[name])
     if k == "sum":
-        if na and eff == 0:
+        # COUNTMV rides the sum state but keeps COUNT semantics: empty
+        # input is 0, never null (round-4 fuzzer finding — the host path
+        # and the SQL standard agree)
+        if na and eff == 0 and b.agg.kind != "count_mv":
             return None
         v = out[name]
         return int(v) if b.integral else float(v)

@@ -430,8 +430,9 @@ def host_aggregate(ctx: QueryContext, seg: ImmutableSegment,
     for agg in ctx.aggregations:
         sel2 = _agg_sel(agg, seg, sel, na)
         s = _agg_state(agg, seg, sel2, na)
-        if na and agg.kind == "sum" and len(sel2) == 0:
+        if na and agg.kind in ("sum", "sum_mv") and len(sel2) == 0:
             s = None  # SUM over all-null input is null, not 0
+            # (COUNT_MV stays 0 — count semantics)
         states.append(s)
     return states
 
@@ -541,7 +542,10 @@ def _mv_agg_state(agg: AggExpr, seg: ImmutableSegment,
     host peer of the MvReduce device lowering; states match the base
     kind's — ops/aggregations.MV_BASE_KIND)."""
     rows = eval_value(agg.arg, seg, sel)  # object array of per-row lists
-    k = agg.kind
+    return _mv_state_from_rows(agg.kind, rows)
+
+
+def _mv_state_from_rows(k: str, rows) -> Any:
     if k == "count_mv":
         return int(sum(len(r) for r in rows))
     if k == "distinct_count_mv":
@@ -666,13 +670,16 @@ def _group_states(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
         c = np.bincount(inv, minlength=n_groups)
         return [int(x) for x in c]
     if agg.kind.endswith("_mv"):
-        # one stable partition of sel by group, not a boolean scan per
-        # group (O(n log n) instead of O(n_groups * n))
+        # evaluate the MV column ONCE, then sort-split — calling
+        # _mv_agg_state per group would re-decode the whole MV forward
+        # index per group (O(n_groups * n), seconds at a few hundred
+        # groups; round-4 fuzzer finding)
+        rows_all = eval_value(agg.arg, seg, sel)
         order = np.argsort(inv, kind="stable")
         bounds = np.searchsorted(inv[order], np.arange(n_groups + 1))
-        sorted_sel = sel[order]
-        return [_mv_agg_state(agg, seg,
-                              sorted_sel[bounds[gi]:bounds[gi + 1]])
+        sorted_rows = rows_all[order]
+        return [_mv_state_from_rows(agg.kind,
+                                    sorted_rows[bounds[gi]:bounds[gi + 1]])
                 for gi in range(n_groups)]
     impl = aggregations.make(agg)  # extended registry kinds
     if impl is not None:

@@ -1128,6 +1128,11 @@ class SegmentPlanner:
     def plan(self) -> CompiledPlan:
         ctx, seg = self.ctx, self.seg
         self._validate_columns()
+        if _truthy(ctx.options.get("forceHostExecution")):
+            # kernel-vs-host differential testing hook (the fuzzer diffs
+            # both paths against a numpy oracle; reference analog:
+            # QueryGenerator runs against H2)
+            return CompiledPlan("host", seg, ctx)
         if self.null_aware:
             # null-aware execution stays on the device: 3VL filters via
             # resolve_filter's T-tree, per-agg null skip via
