@@ -501,13 +501,16 @@ def _agg_state(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
                na: bool = False) -> Any:
     if agg.kind == "count":
         return int(len(sel))
-    if agg.kind.endswith("_mv"):
-        return _mv_agg_state(agg, seg, sel)
-    impl = aggregations.make(agg)  # extended registry kinds
+    # registry first: MV variants of extended kinds (hll_mv, ...) carry
+    # their own impls; the classic six _mv kinds fall through (make ->
+    # None) to the hand-coded path below
+    impl = aggregations.make(agg)
     if impl is not None:
         h = aggregations.HostSel(_typed_ev(impl, agg, seg, sel), len(sel),
                                  ev_bool=_bool_ev(seg, sel, na))
         return impl.state(h)
+    if agg.kind.endswith("_mv"):
+        return _mv_agg_state(agg, seg, sel)
     vals = eval_value(agg.arg, seg, sel)
     _require_numeric(agg, vals, ("sum", "avg"))
     if agg.kind == "sum":
@@ -546,6 +549,9 @@ def _mv_agg_state(agg: AggExpr, seg: ImmutableSegment,
 
 
 def _mv_state_from_rows(k: str, rows) -> Any:
+    if len(rows) and not isinstance(rows[0], (list, tuple, np.ndarray)):
+        # single-value input would iterate characters (strings) or crash
+        raise SqlError(f"{k.upper()} requires a multi-value column")
     if k == "count_mv":
         return int(sum(len(r) for r in rows))
     if k == "distinct_count_mv":
@@ -669,6 +675,12 @@ def _group_states(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
     if agg.kind == "count":
         c = np.bincount(inv, minlength=n_groups)
         return [int(x) for x in c]
+    impl = aggregations.make(agg)  # extended registry kinds (MV incl.)
+    if impl is not None:
+        h = aggregations.HostSel(_typed_ev(impl, agg, seg, sel),
+                                 len(sel), inv, n_groups,
+                                 ev_bool=_bool_ev(seg, sel, na))
+        return impl.group_states(h)
     if agg.kind.endswith("_mv"):
         # evaluate the MV column ONCE, then sort-split — calling
         # _mv_agg_state per group would re-decode the whole MV forward
@@ -681,12 +693,6 @@ def _group_states(agg: AggExpr, seg: ImmutableSegment, sel: np.ndarray,
         return [_mv_state_from_rows(agg.kind,
                                     sorted_rows[bounds[gi]:bounds[gi + 1]])
                 for gi in range(n_groups)]
-    impl = aggregations.make(agg)  # extended registry kinds
-    if impl is not None:
-        h = aggregations.HostSel(_typed_ev(impl, agg, seg, sel),
-                                 len(sel), inv, n_groups,
-                                 ev_bool=_bool_ev(seg, sel, na))
-        return impl.group_states(h)
     vals = eval_value(agg.arg, seg, sel)
     _require_numeric(agg, vals, ("sum", "avg"))
     if agg.kind in ("min", "max") and vals.dtype.kind in "USO":

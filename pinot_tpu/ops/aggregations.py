@@ -82,6 +82,16 @@ AGG_NAME_TO_KIND: Dict[str, str] = {
     "distinctcountrawhllplus": "raw_hll",
     "distinctcountsmarthll": "distinct_count_hll",
     "fasthll": "distinct_count_hll",
+    # MV variants of registry kinds (MvWrapAgg; reference:
+    # DistinctCountHLLMV / DistinctSumMV / MinMaxRangeMV / ...)
+    "distinctcounthllmv": "distinct_count_hll_mv",
+    "distinctcounthllplusmv": "distinct_count_hll_mv",
+    "distinctcountrawhllmv": "raw_hll_mv",
+    "distinctcountrawhllplusmv": "raw_hll_mv",
+    "distinctcountbitmapmv": "distinct_count_mv",
+    "distinctsummv": "distinct_sum_mv",
+    "distinctavgmv": "distinct_avg_mv",
+    "minmaxrangemv": "minmaxrange_mv",
     "distinctcountintegertuplesketch": "distinct_count_theta",
     # funnel family (reference: funnel/ + funnel/window/)
     "funnelcount": "funnel_count",
@@ -122,7 +132,7 @@ def base_kind(kind: str) -> str:
     return MV_BASE_KIND.get(kind, kind)
 
 _PERC_RE = re.compile(
-    r"^(percentile(?:raw)?(?:est|tdigest|kll)?)(\d{1,2}|100)?$")
+    r"^(percentile(?:raw)?(?:est|tdigest|kll)?)(\d{1,2}|100)?(mv)?$")
 
 _SKETCH_KINDS = {"percentileest": "percentile_sketch",
                  "percentiletdigest": "percentile_sketch",
@@ -161,6 +171,8 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
     if m is not None and m.group(1) in _SKETCH_KINDS:
         base, suffix = m.group(1), m.group(2)
         kind = _SKETCH_KINDS[base]
+        if m.group(3):          # ...MV form: flattened per-row lists
+            kind += "_mv"
         if suffix is not None:
             _need(name, args, 1)
             return (kind, args[0], None, (float(suffix),))
@@ -202,24 +214,29 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
             return (kind, args[0], None, (reducer,))
         _need(name, args, 1)
         return (kind, args[0], None, ("min",))
-    if kind == "distinct_count_hll":
+    if kind in ("distinct_count_hll", "raw_hll", "distinct_count_hll_mv",
+                "raw_hll_mv", "distinct_count_cpc", "raw_cpc",
+                "distinct_count_ull", "raw_ull"):
+        # every register sketch allocates 2^param registers — the [4, 20]
+        # bound is a memory-safety contract, not a style check
         if len(args) == 2:
             r = args[1]
             if not isinstance(r, _sql_mod().Literal):
-                raise _sql_mod().SqlError("distinctcounthll: log2m must be a literal")
+                raise _sql_mod().SqlError(f"{name}: log2m must be a literal")
             try:
                 log2m = int(r.value)
             except (TypeError, ValueError):
                 raise _sql_mod().SqlError(
-                    f"distinctcounthll: log2m must be an integer, "
+                    f"{name}: log2m must be an integer, "
                     f"got {r.value!r}") from None
             if not 4 <= log2m <= 20:
                 raise _sql_mod().SqlError(
-                    f"distinctcounthll: log2m must be in [4, 20], "
-                    f"got {log2m}")
+                    f"{name}: log2m must be in [4, 20], got {log2m}")
             return (kind, args[0], None, (log2m,))
         _need(name, args, 1)
-        return (kind, args[0], None, (HLL_DEFAULT_LOG2M,))
+        if kind.startswith(("distinct_count_hll", "raw_hll")):
+            return (kind, args[0], None, (HLL_DEFAULT_LOG2M,))
+        return (kind, args[0], None, ())
     if kind in ("percentile", "percentile_sketch", "percentile_raw_sketch"):
         # reached by plain-name aliases outside the percentile regex
         # (PERCENTILESMARTTDIGEST): same (column, percentile) contract
@@ -234,11 +251,10 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
             raise _sql_mod().SqlError(
                 f"{name}: percentile must be in [0, 100], got {pv}")
         return (kind, args[0], None, (pv,))
-    if kind in ("distinct_count_theta", "raw_theta", "distinct_count_cpc",
-                "raw_cpc", "distinct_count_ull", "raw_ull", "raw_hll",
-                "frequent_items"):
-        # (column[, sizing literal]): nominalEntries / lgK / p / log2m /
-        # maxMapSize — one optional integer parameter
+    if kind in ("distinct_count_theta", "raw_theta", "frequent_items"):
+        # (column[, sizing literal]): nominalEntries / maxMapSize — a
+        # retained-item count, bounded to keep one query from pinning
+        # gigabytes of sketch state
         if len(args) == 2:
             r = args[1]
             if not isinstance(r, _sql_mod().Literal):
@@ -250,9 +266,10 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
                 raise _sql_mod().SqlError(
                     f"{name}: size parameter must be an integer, "
                     f"got {r.value!r}") from None
-            if size <= 0:
+            if not 1 <= size <= (1 << 20):
                 raise _sql_mod().SqlError(
-                    f"{name}: size parameter must be > 0, got {size}")
+                    f"{name}: size parameter must be in [1, 2^20], "
+                    f"got {size}")
             return (kind, args[0], None, (size,))
         _need(name, args, 1)
         return (kind, args[0], None, ())
@@ -964,7 +981,10 @@ class WithTimeAgg(AggImpl):
 def make(agg: Any) -> Optional[AggImpl]:
     """AggImpl for extended kinds; None for the classic six (inlined in
     host_eval/kernels with matched state formats)."""
-    k = agg.kind
+    return _make_for_kind(agg, agg.kind)
+
+
+def _make_for_kind(agg: Any, k: str) -> Optional[AggImpl]:
     if k == "var_pop":
         return VarianceAgg(agg, sample=False, stddev=False)
     if k == "var_samp":
@@ -1004,6 +1024,15 @@ def make(agg: Any) -> Optional[AggImpl]:
     impl = _make_sketch(agg, k)
     if impl is not None:
         return impl
+    if k.endswith("_mv"):
+        # MV variant of any registry kind: wrap the base impl with the
+        # flattening adapter (classic six _mv kinds return None here and
+        # keep their hand-coded host/device paths)
+        inner = _make_for_kind(agg, k[: -len("_mv")])
+        if inner is not None:
+            from . import sketches as S
+
+            return S.MvWrapAgg(agg, inner)
     return None
 
 

@@ -535,6 +535,68 @@ class FrequentItemsAgg(AggImpl):
         return json.dumps({str(k): int(c) for k, c in items})
 
 
+class MvWrapAgg(AggImpl):
+    """MV variant of any single-input registry impl: per-row value
+    lists flatten into one value stream (each value counts once, the
+    reference's *MVAggregationFunction contract — e.g.
+    DistinctCountHLLMVAggregationFunction, PercentileEstMV...). Group
+    context repeats the row's group index per value."""
+
+    def __init__(self, agg: Any, inner: AggImpl):
+        super().__init__(agg)
+        self.inner = inner
+        self.numeric_input = False   # rows are object arrays of lists
+
+    def _flatten(self, rows) -> np.ndarray:
+        if len(rows) and not isinstance(rows[0], (list, tuple,
+                                                  np.ndarray)):
+            # a single-value column here would silently iterate
+            # characters (strings) or crash (numerics)
+            from ..query.sql import SqlError
+            raise SqlError(
+                f"{self.agg.kind.upper()} requires a multi-value "
+                f"column; {self.agg.arg!r} is single-value")
+        flat = [v for r in rows for v in r]
+        if not flat:
+            return np.array([], dtype=np.float64)
+        if any(isinstance(v, str) for v in flat):
+            arr = np.asarray(flat, dtype=object)
+        else:
+            arr = np.asarray(flat)
+        if self.inner.numeric_input and arr.dtype.kind in "USO" \
+                and arr.size:
+            # re-apply the inner impl's input contract on the flattened
+            # stream (the outer object-array eval bypassed _typed_ev)
+            from ..query.sql import SqlError
+            raise SqlError(
+                f"{self.agg.kind.upper()} requires numeric input; "
+                f"{self.agg.arg!r} is a string expression")
+        return arr
+
+    def empty(self):
+        return self.inner.empty()
+
+    def state(self, h: HostSel):
+        flat = self._flatten(h.ev(self.agg.arg))
+        h2 = HostSel(lambda _ast: flat, len(flat))
+        return self.inner.state(h2)
+
+    def group_states(self, h: HostSel):
+        rows = h.ev(self.agg.arg)
+        lens = np.asarray([len(r) for r in rows], dtype=np.int64)
+        flat = self._flatten(rows)
+        inv2 = np.repeat(h.inv, lens) if len(rows) else \
+            np.array([], dtype=np.int64)
+        h2 = HostSel(lambda _ast: flat, len(flat), inv2, h.n_groups)
+        return self.inner.group_states(h2)
+
+    def merge(self, a, b):
+        return self.inner.merge(a, b)
+
+    def finalize(self, s):
+        return self.inner.finalize(s)
+
+
 class IdSetAgg(AggImpl):
     """IDSET(col): serialized set of distinct ids
     (IdSetAggregationFunction; pairs with the IN_ID_SET filter)."""

@@ -328,3 +328,78 @@ def test_funnel_null_steps_3vl(tmp_path):
          "CORRELATEBY(uid)) FROM t")
     assert one(b.query(q + " OPTION(enableNullHandling=true)"))[0] == (0, 0)
     assert one(b.query(q))[0] == (1, 0)
+
+
+# -- MV variants of registry aggregations (MvWrapAgg) ------------------------
+
+@pytest.fixture(scope="module")
+def mv_broker(tmp_path_factory):
+    rng = np.random.default_rng(101)
+    n = 3000
+    mv = [sorted(set(rng.integers(0, 40, rng.integers(1, 5)).tolist()))
+          for _ in range(n)]
+    g = rng.choice(["x", "y"], n)
+    schema = Schema("mvt", [
+        FieldSpec("g", DataType.STRING),
+        FieldSpec("mv", DataType.INT, single_value=False)])
+    dm = TableDataManager("mvt")
+    out = tmp_path_factory.mktemp("mvt")
+    b = SegmentBuilder(schema, TableConfig("mvt"))
+    for i, sl in enumerate((slice(0, n // 2), slice(n // 2, n))):
+        dm.add_segment_dir(b.build({"g": g[sl], "mv": mv[sl]},
+                                   str(out), f"s{i}"))
+    broker = Broker()
+    broker.register_table(dm)
+    return broker, g, mv
+
+
+def test_mv_registry_variants_vs_oracle(mv_broker):
+    broker, g, mv = mv_broker
+    flat = [v for r in mv for v in r]
+    got = one(broker.query(
+        "SELECT DISTINCTCOUNTHLLMV(mv), MINMAXRANGEMV(mv), "
+        "DISTINCTSUMMV(mv), DISTINCTAVGMV(mv), "
+        "PERCENTILEESTMV(mv, 50) FROM mvt"))
+    assert abs(got[0] - len(set(flat))) <= max(2, 0.05 * len(set(flat)))
+    assert got[1] == max(flat) - min(flat)
+    assert got[2] == sum(set(flat))
+    assert got[3] == pytest.approx(sum(set(flat)) / len(set(flat)))
+    assert abs(got[4] - float(np.percentile(flat, 50))) <= 2
+
+
+def test_mv_registry_variants_grouped(mv_broker):
+    broker, g, mv = mv_broker
+    rows = broker.query(
+        "SELECT g, MINMAXRANGEMV(mv), DISTINCTSUMMV(mv) FROM mvt "
+        "GROUP BY g ORDER BY g").rows
+    for gv, rng_got, ds_got in rows:
+        flat = [v for r, gg in zip(mv, g.astype(str)) if gg == gv
+                for v in r]
+        assert rng_got == max(flat) - min(flat), gv
+        assert ds_got == sum(set(flat)), gv
+
+
+def test_mv_raw_and_suffix_forms(mv_broker):
+    broker, _g, mv = mv_broker
+    raw = one(broker.query("SELECT DISTINCTCOUNTRAWHLLMV(mv) FROM mvt"))[0]
+    regs = deserialize_sketch(raw)
+    assert isinstance(regs, list) and len(regs) == 1 << 12
+    p90 = one(broker.query("SELECT PERCENTILETDIGEST90MV(mv) FROM mvt"))[0]
+    flat = [v for r in mv for v in r]
+    assert abs(p90 - float(np.percentile(flat, 90))) <= 2
+
+
+def test_mv_agg_input_validation(mv_broker):
+    """MV aggs over single-value or string inputs raise typed errors;
+    register-sketch sizes are memory-bounded (review regressions)."""
+    broker, _g, _mv = mv_broker
+    from pinot_tpu.query.sql import SqlError
+    for sql in ("SELECT DISTINCTCOUNTHLLMV(g) FROM mvt",     # SV string
+                "SELECT SUMMV(g) FROM mvt",                  # classic MV
+                "SELECT DISTINCTCOUNTHLLMV(mv, 3) FROM mvt",   # log2m < 4
+                "SELECT DISTINCTCOUNTHLLMV(mv, 64) FROM mvt",  # 2^64 regs
+                "SELECT DISTINCTCOUNTRAWHLL(g, 64) FROM mvt",
+                "SELECT DISTINCTCOUNTCPCSKETCH(g, 64) FROM mvt",
+                "SELECT DISTINCTCOUNTTHETASKETCH(g, 99999999) FROM mvt"):
+        with pytest.raises(SqlError):
+            broker.query(sql)
