@@ -13,7 +13,8 @@ import json
 import os
 from typing import Any, Dict, List
 
-FORMATS = ("csv", "json", "avro", "parquet")
+FORMATS = ("csv", "json", "avro", "parquet", "orc", "protobuf",
+           "thrift", "clp")
 
 
 def _infer(v: str) -> Any:
@@ -71,13 +72,23 @@ _READERS = {"csv": read_csv, "json": read_json, "avro": read_avro,
             "parquet": read_parquet}
 
 
-def read_records(path: str, fmt: str = "") -> List[Dict[str, Any]]:
+def read_records(path: str, fmt: str = "",
+                 **format_args: Any) -> List[Dict[str, Any]]:
     """Read a file into row dicts; format inferred from the extension when
-    not given."""
+    not given. protobuf needs (descriptor_file, message_type), thrift
+    needs field_names={id: name}, clp accepts fields=(...) — see
+    inputformat/extended.py."""
     fmt = (fmt or os.path.splitext(path)[1].lstrip(".")).lower()
     if fmt == "jsonl":
         fmt = "json"
+    if fmt in ("orc", "protobuf", "thrift", "clp") \
+            and fmt not in _READERS:
+        from . import extended
+        _READERS.update(orc=extended.read_orc,
+                        protobuf=extended.read_protobuf,
+                        thrift=extended.read_thrift,
+                        clp=extended.read_clp)
     reader = _READERS.get(fmt)
     if reader is None:
         raise ValueError(f"unknown input format {fmt!r}; have {FORMATS}")
-    return reader(path)
+    return reader(path, **format_args)
