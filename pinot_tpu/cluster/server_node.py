@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import urllib.error
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -81,9 +82,18 @@ class ServerNode:
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
             try:
-                http_json("POST",
-                          f"{self.controller_url}/heartbeat/"
-                          f"{self.instance_id}")
+                try:
+                    http_json("POST",
+                              f"{self.controller_url}/heartbeat/"
+                              f"{self.instance_id}")
+                except urllib.error.HTTPError as e:
+                    if e.code != 404:
+                        raise
+                    # a RESTARTED controller has empty ephemeral state
+                    # and answers 404 for unknown instances: re-announce
+                    # (the ZK ephemeral-node re-registration Helix does
+                    # on session re-establishment)
+                    self._register()
                 self._sync_assignment()
             except Exception:
                 pass  # controller briefly unreachable; keep serving

@@ -17,7 +17,11 @@ Job spec (dict; JSON/YAML-friendly, SegmentGenerationJobSpec analog):
     {
       "inputDirURI": "/data/in",            # or "inputFiles": [...]
       "includeFileNamePattern": "*.csv",    # fnmatch, default all
-      "format": "csv",                      # csv|json|jsonl|avro|parquet
+      "format": "csv",                # csv|json|jsonl|avro|parquet|orc|
+                                      # protobuf|thrift|clp
+      "formatArgs": {...},            # reader config (protobuf:
+                                      # descriptor_file+message_type;
+                                      # thrift: field_names; clp: fields)
       "outputDirURI": "/data/segments",
       "tableName": "mytable",
       "schema": {...},                      # Schema.to_dict()
@@ -194,7 +198,8 @@ class BatchIngestionJob:
                              if push.get("controllerUrl") else seg_dir)
 
         for path in self.input_files():
-            buf.extend(pipeline.transform(read_records(path, fmt)))
+            buf.extend(pipeline.transform(read_records(
+                path, fmt, **(self.spec.get("formatArgs") or {}))))
             while len(buf) >= per_seg:
                 flush(buf[:per_seg])
                 buf = buf[per_seg:]
@@ -227,7 +232,8 @@ def _build_file_segments(spec: Dict[str, Any], path: str,
     input file (the body of the ``--file-task`` worker subprocess)."""
     job = BatchIngestionJob(spec)
     fmt, pipeline, out_dir, prefix, per_seg, builder = job.job_params()
-    rows = pipeline.transform(read_records(path, fmt))
+    rows = pipeline.transform(read_records(
+        path, fmt, **(spec.get("formatArgs") or {})))
     out: List[str] = []
     for k in range(0, len(rows), per_seg):
         name = f"{prefix}_{file_idx}_{k // per_seg}"

@@ -71,7 +71,16 @@ def _msg_to_row(msg) -> Dict[str, Any]:
     row: Dict[str, Any] = {}
     for f in msg.DESCRIPTOR.fields:
         v = getattr(msg, f.name)
-        if f.label == f.LABEL_REPEATED:
+        repeated = f.is_repeated if hasattr(f, "is_repeated") \
+            else f.label == f.LABEL_REPEATED  # protobuf<5 fallback
+        if f.message_type is not None and \
+                f.message_type.GetOptions().map_entry:
+            # map fields iterate as keys — materialize the mapping
+            val_f = f.message_type.fields_by_name["value"]
+            row[f.name] = {k: (_msg_to_row(v[k])
+                               if val_f.message_type else v[k])
+                           for k in v}
+        elif repeated:
             row[f.name] = [(_msg_to_row(x) if f.message_type else x)
                            for x in v]
         elif f.message_type is not None:
@@ -199,6 +208,8 @@ def read_thrift(path: str,
 _PH_INT = "\x11"
 _PH_FLOAT = "\x12"
 _PH_DICT = "\x13"
+_ESC = "\x1b"   # literal 0x11-0x13 (or 0x1b) bytes in the message are
+# escaped in the logtype so they can never be misread as var slots
 
 _VAR_TOKEN = re.compile(
     r"(?P<float>-?\d+\.\d+)|(?P<int>-?\d+)|(?P<dict>[A-Za-z0-9_./:\-]*"
@@ -212,6 +223,8 @@ def clp_encode(message: str) -> Tuple[str, List[str], List[int]]:
     the logtype."""
     dict_vars: List[str] = []
     enc_vars: List[int] = []
+    for ch in (_ESC, _PH_INT, _PH_FLOAT, _PH_DICT):
+        message = message.replace(ch, _ESC + ch)
 
     def sub(m: re.Match) -> str:
         tok = m.group()
@@ -242,8 +255,11 @@ def clp_decode(logtype: str, dict_vars: List[str],
     di = iter(dict_vars)
     ei = iter(enc_vars)
     out: List[str] = []
-    for ch in logtype:
-        if ch == _PH_INT:
+    it = iter(logtype)
+    for ch in it:
+        if ch == _ESC:
+            out.append(next(it))          # escaped literal byte
+        elif ch == _PH_INT:
             out.append(str(next(ei)))
         elif ch == _PH_FLOAT:
             out.append(repr(struct.unpack(

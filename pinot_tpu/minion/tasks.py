@@ -173,7 +173,8 @@ def segment_generation_and_push_task(spec: TaskSpec, ctx: MinionContext
     if not path or not os.path.exists(path):
         raise ValueError(f"inputPath missing or not found: {path!r}")
     from ..inputformat import read_records
-    rows = read_records(path, fmt)
+    rows = read_records(path, fmt,
+                        **(spec.config.get("formatArgs") or {}))
     schema = dm.schema
     if schema is None:
         raise ValueError(f"table {spec.table!r} has no schema "

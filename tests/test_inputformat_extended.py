@@ -155,3 +155,68 @@ def test_orc_roundtrip_or_gated(tmp_path):
 def test_unknown_format_lists_all():
     with pytest.raises(ValueError, match="protobuf"):
         read_records("/x.bogus", "bogus")
+
+
+def test_protobuf_map_fields(tmp_path):
+    if shutil.which("protoc") is None:
+        pytest.skip("no protoc")
+    (tmp_path / "m.proto").write_text(
+        'syntax = "proto3";\npackage fmt;\n'
+        "message Ev { string id = 1; map<string, int32> counts = 2; }\n")
+    subprocess.run(
+        ["protoc", f"--descriptor_set_out={tmp_path}/m.desc",
+         "-I", str(tmp_path), str(tmp_path / "m.proto")], check=True)
+    from pinot_tpu.inputformat.extended import _message_class
+    cls = _message_class(str(tmp_path / "m.desc"), "fmt.Ev")
+    m = cls(id="a")
+    m.counts["x"] = 3
+    m.counts["y"] = 5
+    write_protobuf(str(tmp_path / "ev.pb"), [m])
+    rows = read_protobuf(str(tmp_path / "ev.pb"),
+                         str(tmp_path / "m.desc"), "fmt.Ev")
+    assert rows == [{"id": "a", "counts": {"x": 3, "y": 5}}]
+
+
+def test_clp_placeholder_bytes_escaped():
+    for m in ("weird\x11byte", "mix \x12 7 and \x13x",
+              "esc \x1b here 42"):
+        assert clp_decode(*clp_encode(m)) == m, repr(m)
+
+
+def test_batch_ingestion_format_args(tmp_path):
+    """formatArgs flow from the job spec to the reader (protobuf batch
+    ingestion end-to-end)."""
+    if shutil.which("protoc") is None:
+        pytest.skip("no protoc")
+    import numpy as np
+
+    from pinot_tpu.ingestion.batch import BatchIngestionJob
+    from pinot_tpu.segment import ImmutableSegment
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+    (tmp_path / "trip.proto").write_text(PROTO)
+    subprocess.run(
+        ["protoc", f"--descriptor_set_out={tmp_path}/trip.desc",
+         "-I", str(tmp_path), str(tmp_path / "trip.proto")], check=True)
+    from pinot_tpu.inputformat.extended import _message_class
+    cls = _message_class(str(tmp_path / "trip.desc"), "fmt.Trip")
+    (tmp_path / "in").mkdir()
+    write_protobuf(str(tmp_path / "in" / "a.pb"),
+                   [cls(city="nyc", fare=10), cls(city="sf", fare=20)])
+    schema = Schema("trips", [
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("fare", DataType.LONG, FieldType.METRIC)])
+    job = BatchIngestionJob({
+        "inputDirURI": str(tmp_path / "in"),
+        "includeFileNamePattern": "*.pb",
+        "format": "protobuf",
+        "formatArgs": {"descriptor_file": str(tmp_path / "trip.desc"),
+                       "message_type": "fmt.Trip"},
+        "outputDirURI": str(tmp_path / "out"),
+        "tableName": "trips",
+        "schema": schema.to_dict(),
+    })
+    (loc,) = job.run()
+    seg = ImmutableSegment.load(loc)
+    assert seg.n_docs == 2
+    assert sorted(np.asarray(seg.raw_values("city")).tolist()) == \
+        ["nyc", "sf"]
