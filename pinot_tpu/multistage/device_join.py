@@ -17,10 +17,13 @@ ops/join.py docstring) — key factorization stays on the host (it is a
 dictionary build), the O(L log R) probe work runs on the device.
 
 Output is BYTE-IDENTICAL to numpy hash_join, including row order
-(left-major, build rows within a run in stable sorted-key order): both
-formulations resolve pairs through the same stable sort of the same
-factorized codes, so the executor can switch backends per join with no
-downstream difference.
+(left-major, build rows within a run in stable sorted-key order): the
+broadcast backends resolve pairs through the same stable sort of the
+same factorized codes, and the mesh shuffle backend lexsorts its pair
+stream back into that canonical order — the executor switches backends
+per join with no downstream difference. (The mailbox HashExchange
+fallback concatenates per-partition outputs and remains the one
+order-divergent path, as it always was.)
 """
 from __future__ import annotations
 
@@ -68,6 +71,55 @@ def predict_backend(probe_rows: float, build_rows: float, how: str,
     if probe_rows < _min_probe_rows():
         return "numpy"
     return "device_broadcast"
+
+
+def try_mesh_shuffle_join(left: Relation, right: Relation,
+                          lkeys: List[str], rkeys: List[str]
+                          ) -> Optional[Relation]:
+    """Device hash-shuffle INNER join over the mesh (big build sides the
+    broadcast path rejects): one lax.all_to_all repartitions both key
+    streams across devices, each device joins its partition locally
+    (ops.join.mesh_shuffle_join). None -> caller falls back to the
+    mailbox HashExchange (too few devices, small probe, oversized key
+    multiplicity, or bucket overflow after a slack retry)."""
+    import jax
+
+    if jax.device_count() <= 1:
+        return None
+    if left.n_rows < _min_probe_rows() or right.n_rows == 0:
+        return None
+    code_l, code_r = _composite_codes(
+        [left.raw_values(k) for k in lkeys],
+        [right.raw_values(k) for k in rkeys])
+    lnull = _key_nulls(left, lkeys)
+    if lnull is not None:
+        code_l = np.where(lnull, np.int64(-1), code_l)
+    rnull = _key_nulls(right, rkeys)
+    if rnull is not None:
+        code_r = np.where(rnull, np.int64(-1), code_r)
+    valid_r = code_r[code_r >= 0]
+    if valid_r.size == 0:
+        return None
+    max_dup = int(np.unique(valid_r, return_counts=True)[1].max())
+    if max_dup > _max_dup_bound():
+        return None
+    max_dup = 1 << (max_dup - 1).bit_length() if max_dup > 1 else 1
+
+    from ..ops.join import mesh_shuffle_join
+    from ..parallel.mesh import segment_mesh
+
+    mesh = segment_mesh()
+    pairs = mesh_shuffle_join(mesh, code_l, code_r, max_dup)
+    if pairs is None:
+        pairs = mesh_shuffle_join(mesh, code_l, code_r, max_dup,
+                                  slack=4.0)   # one skew retry
+    if pairs is None:
+        return None
+    l_idx, r_idx = pairs
+    STATS["mesh_joins"] += 1
+    matched = np.ones(len(l_idx), dtype=bool)
+    return materialize_join(left, right, l_idx.astype(np.int64),
+                            r_idx.astype(np.int64), matched, "inner")
 
 
 @functools.lru_cache(maxsize=64)

@@ -309,9 +309,14 @@ class MultiStageExecutor:
                 return hash_join(left, right, lkeys, rkeys, how)
             self.join_backends.append(backend)
             return rel
-        # hash-shuffle both sides into P partitions, join each
-        # (HashExchange over in-memory mailboxes; multi-host transport and
-        # on-device all_to_all plug in behind the same exchange API)
+        # big build side: the device hash-shuffle (ONE lax.all_to_all
+        # repartition over the mesh + per-device partition joins —
+        # SURVEY 2.9's HashExchange -> all-to-all mapping) runs first;
+        # the mailbox HashExchange is the host fallback
+        rel = device_join.try_mesh_shuffle_join(left, right, lkeys, rkeys)
+        if rel is not None:
+            self.join_backends.append("mesh_shuffle")
+            return rel
         device_join.STATS["numpy_joins"] += 1
         self.join_backends.append("numpy_shuffle")
         lex = HashExchange(self.mailboxes, query_id, stage, SHUFFLE_PARTITIONS,

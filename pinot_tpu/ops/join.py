@@ -26,12 +26,15 @@ too big to replicate, which dict-encoded dimension tables are not.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEG_AXIS = "seg"   # matches parallel.mesh.SEG_AXIS (ops cannot import
+# parallel without a cycle; segment_mesh builds the same axis name)
 
 
 def device_equi_join(lk: jax.Array, rk: jax.Array, max_dup: int
@@ -64,6 +67,132 @@ def _mesh_join_jit(lk, rk, max_dup, mesh):
         in_specs=(P("seg"), P()),
         out_specs=(P("seg"), P("seg")),
         check_vma=False)(lk, rk)
+
+
+def _splitmix32(x):
+    """Device-side mix so hash partitioning is uniform even for
+    sequential dict codes (skew would overflow a bucket)."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _shuffle_exchange_jit(codes, ids, n_dev, cap, mesh):
+    """Hash-partition (code, id) pairs across the mesh with ONE
+    lax.all_to_all over ICI (SURVEY 2.9: the HashExchange ->
+    on-device all-to-all mapping — this is that collective, not a
+    comment). Returns per-device received (n_dev*cap,) codes/ids with
+    -1 padding, plus an overflow flag (bucket capacity exceeded ->
+    caller falls back)."""
+    def per_device(c, i):
+        m = c.shape[0]
+        part = (_splitmix32(c) % jnp.uint32(n_dev)).astype(jnp.int32)
+        # invalid rows (-1 code, padding) route to pseudo-partition
+        # n_dev: they sort LAST (no real partition's rank inflates) and
+        # every write lands out of bounds -> dropped, never clobbering
+        # a live slot
+        valid = c >= 0
+        part_eff = jnp.where(valid, part, n_dev).astype(jnp.int32)
+        order = jnp.argsort(part_eff)
+        sp = jnp.take(part_eff, order)
+        sc = jnp.take(jnp.where(valid, c, -1), order)
+        si = jnp.take(i, order)
+        # rank within each partition run = position - run start
+        run_start = jnp.searchsorted(sp, sp)
+        within = jnp.arange(m, dtype=jnp.int32) \
+            - run_start.astype(jnp.int32)
+        live = sp < n_dev
+        ok = (within < cap) & live
+        overflow = jnp.any((within >= cap) & live)
+        buckets_c = jnp.full((n_dev, cap), -1, dtype=c.dtype)
+        buckets_i = jnp.full((n_dev, cap), -1, dtype=ids.dtype)
+        tp = jnp.where(ok, sp, n_dev)     # non-ok writes drop (OOB)
+        buckets_c = buckets_c.at[tp, within].set(sc, mode="drop")
+        buckets_i = buckets_i.at[tp, within].set(si, mode="drop")
+        # the collective: bucket d of every device lands on device d
+        rc = jax.lax.all_to_all(buckets_c, SEG_AXIS, 0, 0, tiled=True)
+        ri = jax.lax.all_to_all(buckets_i, SEG_AXIS, 0, 0, tiled=True)
+        return rc.reshape(-1), ri.reshape(-1), overflow[None]
+
+    return jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(SEG_AXIS), P(SEG_AXIS)),
+        out_specs=(P(SEG_AXIS), P(SEG_AXIS), P(SEG_AXIS)),
+        check_vma=False)(codes, ids)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _partition_join_jit(lk, lids, rk, rids, max_dup, mesh):
+    """Per-device partition join after the exchange: every device joins
+    its hash partition locally (zero collectives in the probe)."""
+    def per_device(lc, li, rc, ri):
+        match, r_pos = device_equi_join(lc, rc, max_dup)
+        match = match & (lc >= 0)[:, None]       # dead probe entries
+        r_glob = jnp.take(ri, r_pos)
+        return match, jnp.broadcast_to(li[:, None], match.shape), r_glob
+
+    return jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(SEG_AXIS), P(SEG_AXIS), P(SEG_AXIS), P(SEG_AXIS)),
+        out_specs=(P(SEG_AXIS), P(SEG_AXIS), P(SEG_AXIS)),
+        check_vma=False)(lk, lids, rk, rids)
+
+
+def mesh_shuffle_join(mesh: Mesh, lk: np.ndarray, rk: np.ndarray,
+                      max_dup: int, slack: float = 2.0
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Distributed hash-shuffle INNER join: both key arrays shard over
+    the mesh, ONE all_to_all redistributes (code, row_id) pairs so equal
+    codes land on the same device, then every device joins its
+    partition locally. Returns global (l_idx, r_idx) matched pairs, or
+    None when a hash bucket overflowed its capacity (caller retries
+    with more slack or falls back to the host join).
+
+    Reference mapping: HashExchange.java + HashJoinOperator — the
+    repartitioning rides the ICI collective instead of mailboxes."""
+    n_dev = mesh.devices.size
+
+    def shard(arr, fill):
+        pad = (-len(arr)) % n_dev
+        if pad:
+            arr = np.concatenate(
+                [arr, np.full(pad, fill, dtype=arr.dtype)])
+        return arr
+
+    out = []
+    for keys in (lk, rk):
+        codes = shard(keys, -1)
+        ids = shard(np.arange(len(keys), dtype=np.int64), -1)
+        m = len(codes) // n_dev
+        cap = max(int(m / n_dev * slack) + 16, 16)
+        cap = 1 << (cap - 1).bit_length()   # pow2 bucket: bounded XLA
+        # program count (cap is a jit static arg)
+        c_d = jax.device_put(codes, NamedSharding(mesh, P(SEG_AXIS)))
+        i_d = jax.device_put(ids, NamedSharding(mesh, P(SEG_AXIS)))
+        rc, ri, ovf = _shuffle_exchange_jit(c_d, i_d, n_dev, cap, mesh)
+        if bool(np.any(jax.device_get(ovf))):
+            return None
+        out.append((rc, ri))
+    (lc, li), (rc, ri) = out
+    match, l_glob, r_glob = _partition_join_jit(lc, li, rc, ri,
+                                                max_dup, mesh)
+    match = np.asarray(match)
+    l_glob = np.asarray(l_glob)
+    r_glob = np.asarray(r_glob)
+    pairs = np.nonzero(match)
+    l_idx = l_glob[pairs]
+    r_idx = r_glob[pairs]
+    keep = (l_idx >= 0) & (r_idx >= 0)
+    l_idx = l_idx[keep]
+    r_idx = r_idx[keep]
+    # restore hash_join's exact output order (left-major; within a left
+    # row matches share one code, and the stable build sort emits them
+    # by ascending original right index) so every backend stays
+    # byte-identical downstream
+    o = np.lexsort((r_idx, l_idx))
+    return l_idx[o], r_idx[o]
 
 
 def mesh_equi_join(mesh: Mesh, lk: np.ndarray, rk: np.ndarray,
