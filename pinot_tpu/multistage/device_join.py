@@ -73,6 +73,32 @@ def predict_backend(probe_rows: float, build_rows: float, how: str,
     return "device_broadcast"
 
 
+def _poisoned_codes(left: Relation, right: Relation,
+                    lkeys: List[str], rkeys: List[str]):
+    """Factorized join codes with NULL keys poisoned to -1 on both
+    sides (a null key never matches), shared by every device backend."""
+    code_l, code_r = _composite_codes(
+        [left.raw_values(k) for k in lkeys],
+        [right.raw_values(k) for k in rkeys])
+    lnull = _key_nulls(left, lkeys)
+    if lnull is not None:
+        code_l = np.where(lnull, np.int64(-1), code_l)
+    rnull = _key_nulls(right, rkeys)
+    if rnull is not None:
+        code_r = np.where(rnull, np.int64(-1), code_r)
+    return code_l, code_r
+
+
+def _bounded_max_dup(valid_build_codes: np.ndarray) -> Optional[int]:
+    """Build-side key multiplicity rounded to a power of two, or None
+    past the dense-candidate bound."""
+    max_dup = int(np.unique(valid_build_codes,
+                            return_counts=True)[1].max())
+    if max_dup > _max_dup_bound():
+        return None
+    return 1 << (max_dup - 1).bit_length() if max_dup > 1 else 1
+
+
 def try_mesh_shuffle_join(left: Relation, right: Relation,
                           lkeys: List[str], rkeys: List[str]
                           ) -> Optional[Relation]:
@@ -88,22 +114,13 @@ def try_mesh_shuffle_join(left: Relation, right: Relation,
         return None
     if left.n_rows < _min_probe_rows() or right.n_rows == 0:
         return None
-    code_l, code_r = _composite_codes(
-        [left.raw_values(k) for k in lkeys],
-        [right.raw_values(k) for k in rkeys])
-    lnull = _key_nulls(left, lkeys)
-    if lnull is not None:
-        code_l = np.where(lnull, np.int64(-1), code_l)
-    rnull = _key_nulls(right, rkeys)
-    if rnull is not None:
-        code_r = np.where(rnull, np.int64(-1), code_r)
+    code_l, code_r = _poisoned_codes(left, right, lkeys, rkeys)
     valid_r = code_r[code_r >= 0]
     if valid_r.size == 0:
         return None
-    max_dup = int(np.unique(valid_r, return_counts=True)[1].max())
-    if max_dup > _max_dup_bound():
+    max_dup = _bounded_max_dup(valid_r)
+    if max_dup is None:
         return None
-    max_dup = 1 << (max_dup - 1).bit_length() if max_dup > 1 else 1
 
     from ..ops.join import mesh_shuffle_join
     from ..parallel.mesh import segment_mesh
@@ -150,32 +167,20 @@ def try_device_join(left: Relation, right: Relation,
     if left.n_rows < _min_probe_rows():
         return None, "probe_too_small"
 
-    code_l, code_r = _composite_codes(
-        [left.raw_values(k) for k in lkeys],
-        [right.raw_values(k) for k in rkeys])
-
-    # NULL keys never match: drop null build rows before the device
-    # call, poison null probe codes (factorized codes are >= 0)
-    rnull = _key_nulls(right, rkeys)
-    if rnull is not None and rnull.any():
-        valid_r = np.nonzero(~rnull)[0]
+    code_l, code_r = _poisoned_codes(left, right, lkeys, rkeys)
+    # the broadcast kernel replicates the build side: DROP its null
+    # rows (smaller replica) instead of carrying poisoned entries
+    keep_r = code_r >= 0
+    if not keep_r.all():
+        valid_r = np.nonzero(keep_r)[0]
         code_r = code_r[valid_r]
     else:
         valid_r = None
-    lnull = _key_nulls(left, lkeys)
-    if lnull is not None and lnull.any():
-        code_l = np.where(lnull, np.int64(-1), code_l)
     if len(code_r) == 0:
         return None, "empty_build"
-
-    uniq_counts = np.unique(code_r, return_counts=True)[1]
-    max_dup = int(uniq_counts.max())
-    if max_dup > _max_dup_bound():
+    max_dup = _bounded_max_dup(code_r)
+    if max_dup is None:
         return None, "max_dup"
-    # bucket to the next power of two: one compiled XLA program per
-    # bucket (<= 2x wasted candidate slots, killed by the match mask)
-    # instead of one multi-second device compile per distinct max_dup
-    max_dup = 1 << (max_dup - 1).bit_length() if max_dup > 1 else 1
 
     if code_l.max(initial=0) < 2**31 and code_r.max(initial=0) < 2**31 \
             and code_l.min(initial=0) >= -(2**31):

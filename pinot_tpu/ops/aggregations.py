@@ -93,6 +93,13 @@ AGG_NAME_TO_KIND: Dict[str, str] = {
     "distinctavgmv": "distinct_avg_mv",
     "minmaxrangemv": "minmaxrange_mv",
     "distinctcountintegertuplesketch": "distinct_count_theta",
+    "sumvaluesintegertuplesketch": "tuple_sketch_sum",
+    "avgvalueintegertuplesketch": "tuple_sketch_avg",
+    "exprmin": "expr_min",
+    "exprmax": "expr_max",
+    "stunion": "st_union",
+    "st_union": "st_union",
+    "fourthmoment": "fourthmoment",
     # funnel family (reference: funnel/ + funnel/window/)
     "funnelcount": "funnel_count",
     "funnelmaxstep": "funnel_max_step",
@@ -195,7 +202,21 @@ def resolve_call(name: str, args: Tuple[Any, ...], distinct: bool
         # column's null rows (NullableSingleInputAggregationFunction)
         _need(name, args, 1)
         return ("count", args[0], None, ())
-    if kind in ("covar_pop", "covar_samp"):
+    if kind in ("covar_pop", "covar_samp", "expr_min", "expr_max"):
+        _need(name, args, 2)
+        return (kind, args[0], args[1], ())
+    if kind in ("tuple_sketch_sum", "tuple_sketch_avg"):
+        # (keyExpr, valueExpr[, nominalEntries])
+        if len(args) == 3:
+            r = args[2]
+            if not isinstance(r, _sql_mod().Literal):
+                raise _sql_mod().SqlError(
+                    f"{name}: nominalEntries must be a literal")
+            size = int(r.value)
+            if not 1 <= size <= (1 << 20):
+                raise _sql_mod().SqlError(
+                    f"{name}: nominalEntries must be in [1, 2^20]")
+            return (kind, args[0], args[1], (size,))
         _need(name, args, 2)
         return (kind, args[0], args[1], ())
     if kind in ("first_with_time", "last_with_time"):
@@ -563,8 +584,14 @@ class SkewnessAgg(_CentralMoments):
 class KurtosisAgg(_CentralMoments):
     K = 4
 
+    def __init__(self, agg: Any, raw_m4: bool = False):
+        super().__init__(agg)
+        self.raw_m4 = raw_m4   # FOURTHMOMENT surfaces the m4 power sum
+
     def finalize(self, s):
         n, _mean, m2, m3, m4 = s
+        if self.raw_m4:
+            return float(m4) if n else None
         if n < 4:
             return None
         if m2 <= 0:
@@ -1021,6 +1048,18 @@ def _make_for_kind(agg: Any, k: str) -> Optional[AggImpl]:
         return WithTimeAgg(agg, last=False)
     if k == "last_with_time":
         return WithTimeAgg(agg, last=True)
+    if k == "expr_min":
+        # EXPRMIN(proj, measure) == value-at-minimal-measure: exactly
+        # the FIRSTWITHTIME state machine with measure as the time axis
+        # (ChildExprMinMaxAggregationFunction analog)
+        return WithTimeAgg(agg, last=False)
+    if k == "expr_max":
+        return WithTimeAgg(agg, last=True)
+    if k == "fourthmoment":
+        # raw power sums up to m4 (FourthMomentAggregationFunction);
+        # kurtosis shares the state machine and finalizes the ratio —
+        # FOURTHMOMENT surfaces the m4 sum itself
+        return KurtosisAgg(agg, raw_m4=True)
     impl = _make_sketch(agg, k)
     if impl is not None:
         return impl
@@ -1041,6 +1080,12 @@ def _make_sketch(agg: Any, k: str):
     point here."""
     from . import sketches as S
 
+    if k == "tuple_sketch_sum":
+        return S.TupleSketchAgg(agg, "sum")
+    if k == "tuple_sketch_avg":
+        return S.TupleSketchAgg(agg, "avg")
+    if k == "st_union":
+        return S.StUnionAgg(agg)
     if k == "distinct_count_theta":
         return S.ThetaSketchAgg(agg)
     if k == "distinct_count_cpc":

@@ -535,6 +535,126 @@ class FrequentItemsAgg(AggImpl):
         return json.dumps({str(k): int(c) for k, c in items})
 
 
+class TupleSketchAgg(AggImpl):
+    """Integer tuple sketch (Sum/AvgValueIntegerTupleSketch analog):
+    KMV over hashed keys, each retained entry carrying the SUM of its
+    key's values. State = {"t": theta_hash | None, "e": [[hash, sum]]}
+    with every retained hash STRICTLY below theta (exclusive sampling
+    bound; None = 1.0, the exact regime below k entries). Merge takes
+    theta = min of the sides and discards entries past it — an entry
+    one side dropped can never survive with a partial sum — then
+    re-caps at k. 'sum' finalizes sum_retained / theta (unbiased,
+    exact below k); 'avg' is sum/count over retained entries (unbiased
+    without scaling)."""
+
+    numeric_input = False   # keys hash; values validated separately
+
+    def __init__(self, agg: Any, mode: str):
+        super().__init__(agg)
+        self.mode = mode
+
+    @property
+    def k(self) -> int:
+        return int(self.agg.params[0]) if self.agg.params \
+            else THETA_DEFAULT_NOMINAL
+
+    def empty(self):
+        return {"t": None, "e": []}
+
+    def _cap(self, entries, theta):
+        """Keep the k smallest-hash entries; theta tightens to the
+        (k+1)-th smallest so retained hashes stay strictly below it."""
+        entries.sort(key=lambda e: e[0])
+        if len(entries) > self.k:
+            theta_h = entries[self.k][0]
+            if theta is None or theta_h < theta:
+                theta = theta_h
+            entries = [e for e in entries if e[0] < theta][: self.k]
+        return {"t": theta, "e": entries}
+
+    def _from_pair(self, keys, vals):
+        if len(keys) == 0:
+            return {"t": None, "e": []}
+        hs = _hash64(np.asarray(keys))
+        uniq, inv = np.unique(hs, return_inverse=True)
+        sums = np.bincount(inv, weights=np.asarray(vals, np.float64),
+                           minlength=len(uniq))
+        return self._cap([[int(u), float(s)]
+                          for u, s in zip(uniq, sums)], None)
+
+    def state(self, h: HostSel):
+        return self._from_pair(h.ev(self.agg.arg),
+                               np.asarray(h.ev(self.agg.arg2),
+                                          dtype=np.float64))
+
+    def group_states(self, h: HostSel):
+        keys = h.ev(self.agg.arg)
+        vals = np.asarray(h.ev(self.agg.arg2), dtype=np.float64)
+        return _per_group_apply_multi([keys, vals], h.inv, h.n_groups,
+                                      self._from_pair)
+
+    def merge(self, a, b):
+        thetas = [t for t in (a.get("t"), b.get("t")) if t is not None]
+        theta = min(thetas) if thetas else None
+        acc: dict = {}
+        for h_, s in list(a["e"]) + list(b["e"]):
+            if theta is not None and h_ >= theta:
+                continue   # past the tighter side's sampling bound
+            acc[h_] = acc.get(h_, 0.0) + s
+        return self._cap([[h_, v] for h_, v in acc.items()], theta)
+
+    def finalize(self, s):
+        entries = s["e"]
+        if not entries:
+            return None if self.mode == "avg" else 0.0
+        total = sum(v for _h, v in entries)
+        if self.mode == "avg":
+            return total / len(entries)
+        frac = 1.0 if s["t"] is None else float(s["t"]) / _TWO64
+        return total / frac
+
+
+class StUnionAgg(AggImpl):
+    """ST_UNION over POINT geometries: the distinct-point union as a
+    MULTIPOINT (StUnionAggregationFunction's behavior for point data —
+    the overwhelmingly common case; polygon union raises a clear
+    not-supported error rather than a wrong answer)."""
+
+    numeric_input = False
+
+    def empty(self):
+        return set()
+
+    def _pts(self, v: np.ndarray) -> set:
+        from ..geo.geometry import parse_wkb, parse_wkt
+        out = set()
+        for g in v:
+            geom = parse_wkb(g) if isinstance(g, (bytes, bytearray)) \
+                else parse_wkt(str(g))
+            if geom.kind != "point":
+                raise ValueError(
+                    "ST_UNION supports POINT geometries only")
+            out.add((geom.lng, geom.lat))
+        return out
+
+    def state(self, h: HostSel):
+        return self._pts(h.ev(self.agg.arg))
+
+    def group_states(self, h: HostSel):
+        return _per_group_apply(h.ev(self.agg.arg), h.inv, h.n_groups,
+                                self._pts)
+
+    def merge(self, a, b):
+        return a | b
+
+    def finalize(self, s):
+        from ..geo.geometry import _fmt
+        if not s:
+            return "MULTIPOINT EMPTY"
+        pts = ", ".join(f"{_fmt(x)} {_fmt(y)}" for x, y in sorted(s))
+        return f"MULTIPOINT ({pts})"
+
+
 class MvWrapAgg(AggImpl):
     """MV variant of any single-input registry impl: per-row value
     lists flatten into one value stream (each value counts once, the

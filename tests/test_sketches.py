@@ -403,3 +403,107 @@ def test_mv_agg_input_validation(mv_broker):
                 "SELECT DISTINCTCOUNTTHETASKETCH(g, 99999999) FROM mvt"):
         with pytest.raises(SqlError):
             broker.query(sql)
+
+
+# -- round-4b: exprmin/max, tuple sketches, ST_UNION, FOURTHMOMENT ----------
+
+@pytest.fixture(scope="module")
+def xb(tmp_path_factory):
+    rng = np.random.default_rng(113)
+    n = 4000
+    cols = {
+        "uid": rng.integers(0, 500, n).astype(np.int64),
+        "amt": rng.integers(1, 100, n).astype(np.int64),
+        "nm": rng.choice(["a", "b", "c"], n),
+        "pt": np.array([f"POINT ({x} {y})" for x, y in
+                        zip(rng.integers(0, 4, n),
+                            rng.integers(0, 4, n))]),
+    }
+    schema = Schema("x", [
+        FieldSpec("uid", DataType.LONG),
+        FieldSpec("amt", DataType.LONG, FieldType.METRIC),
+        FieldSpec("nm", DataType.STRING),
+        FieldSpec("pt", DataType.STRING)])
+    dm = TableDataManager("x")
+    b = SegmentBuilder(schema, TableConfig("x"))
+    out = tmp_path_factory.mktemp("xb")
+    for i, sl in enumerate((slice(0, n // 2), slice(n // 2, n))):
+        dm.add_segment_dir(b.build({k: v[sl] for k, v in cols.items()},
+                                   str(out), f"s{i}"))
+    broker = Broker()
+    broker.register_table(dm)
+    return broker, cols
+
+
+def test_exprmin_exprmax(xb):
+    broker, cols = xb
+    got = one(broker.query("SELECT EXPRMIN(nm, amt), EXPRMAX(nm, amt) "
+                           "FROM x"))
+    amt, nm = cols["amt"], cols["nm"].astype(str)
+    assert got == (nm[np.argmin(amt)], nm[np.argmax(amt)])
+
+
+def test_tuple_sketch_sum_avg_exact_below_k(xb):
+    broker, cols = xb
+    uid, amt = cols["uid"], cols["amt"]
+    per_key = {u: int(amt[uid == u].sum()) for u in np.unique(uid)}
+    got = one(broker.query(
+        "SELECT SUMVALUESINTEGERTUPLESKETCH(uid, amt), "
+        "AVGVALUEINTEGERTUPLESKETCH(uid, amt) FROM x"))
+    assert got[0] == float(sum(per_key.values()))
+    assert got[1] == pytest.approx(sum(per_key.values()) / len(per_key))
+
+
+def test_tuple_sketch_sum_estimates_above_k(xb):
+    broker, cols = xb
+    true = float(cols["amt"].sum())
+    est = one(broker.query(
+        "SELECT SUMVALUESINTEGERTUPLESKETCH(uid, amt, 64) FROM x"))[0]
+    assert abs(est - true) / true < 0.35   # KMV ~1/sqrt(64)
+
+
+def test_st_union_points(xb):
+    broker, cols = xb
+    m = cols["uid"] < 3
+    wkt = one(broker.query("SELECT STUNION(pt) FROM x WHERE uid < 3"))[0]
+    assert wkt.startswith("MULTIPOINT (")
+    exp = {tuple(map(float, p.split()))
+           for p in (s[len("POINT ("):-1]
+                     for s in cols["pt"][m].astype(str))}
+    got = {tuple(map(float, p.split()))
+           for p in wkt[len("MULTIPOINT ("):-1].split(", ")}
+    assert got == exp
+
+
+def test_fourthmoment_raw_power_sum(xb):
+    broker, cols = xb
+    amt = cols["amt"].astype(np.float64)
+    got = one(broker.query("SELECT FOURTHMOMENT(amt) FROM x"))[0]
+    assert got == pytest.approx(((amt - amt.mean()) ** 4).sum())
+
+
+def test_tuple_sketch_theta_merge_no_bias(tmp_path):
+    """Merging saturated tuple sketches honors theta = min(sides): an
+    entry one side dropped never survives with a partial sum (review
+    regression — undercounted sums past one side's theta)."""
+    from pinot_tpu.ops.sketches import TupleSketchAgg
+    from pinot_tpu.query.context import AggExpr
+    agg = AggExpr("tuple_sketch_sum", None, "t", None, (32,))
+    impl = TupleSketchAgg(agg, "sum")
+    rng = np.random.default_rng(127)
+    keys = np.arange(2000)
+    vals = rng.integers(1, 100, 2000).astype(np.float64)
+    halves = [impl._from_pair(keys[sl], vals[sl])
+              for sl in (slice(0, 1000), slice(1000, 2000))]
+    # overlapping second pass re-adds every key into both halves
+    halves = [impl.merge(h, impl._from_pair(keys, vals))
+              for h in halves]
+    merged = impl.merge(*halves)
+    # every retained hash is strictly below theta
+    assert all(h < merged["t"] for h, _v in merged["e"])
+    est = impl.finalize(merged)
+    true = float(vals.sum()) * 2 + float(vals.sum())  # 3x per key... 
+    # each key's total = vals[i] (own half) + vals[i]x2 (full passes
+    # into both halves) -> merged per-key sum = 3*vals[i]
+    true = 3 * float(vals.sum())
+    assert abs(est - true) / true < 0.5   # KMV k=32 variance
