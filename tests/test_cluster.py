@@ -310,7 +310,14 @@ def test_controller_restart_mid_rebalance_converges(tmp_path):
         for s in servers:
             s.controller_url = ctrl2.url
         broker.controller_url = ctrl2.url
-        deadline = time.monotonic() + 15
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if len(ctrl2.live_servers()) == 2:
+                break
+            time.sleep(0.1)
+        assert len(ctrl2.live_servers()) == 2, \
+            "servers did not re-register with the restarted controller"
+        deadline = time.monotonic() + 20
         target = {f"seg_{i}" for i in range(N_SEGMENTS)}
         while time.monotonic() < deadline:
             asn = ctrl2.routing_snapshot()["assignment"].get("sales", {})
@@ -318,7 +325,8 @@ def test_controller_restart_mid_rebalance_converges(tmp_path):
                 break
             time.sleep(0.1)
         asn = ctrl2.routing_snapshot()["assignment"]["sales"]
-        assert all(len(asn.get(s, [])) == 2 for s in target), asn
+        assert all(len(asn.get(s, [])) == 2 for s in target), \
+            (asn, ctrl2.live_servers())
         # and the data still answers correctly through the broker
         _sync(ctrl2, servers, broker)
         resp = http_json("POST", f"{broker.url}/query/sql", {
