@@ -170,12 +170,15 @@ def compute_window(rel, wf: WindowFunc) -> np.ndarray:
     # unordered aggregate window IS a segment reduction + gather — at
     # scale that is jax.ops.segment_* on the device instead of the host
     # sort machinery (the sort/scan shapes below stay the general path)
+    pre_v = None
     if (name in AGG_FUNCS and not wf.spec.order_by
             and wf.spec.frame is None and not wf.func.distinct
             and n >= _device_window_min_rows()):
-        out = _device_partition_agg(rel, wf, pk)
+        out, pre_v = _device_partition_agg(rel, wf, pk)
         if out is not None:
             return out
+        # bail path: the evaluated argument is reused below, not
+        # re-evaluated (large-n queries are exactly where that matters)
 
     sort_keys = list(reversed(order_cols)) + [pk]  # lexsort: last = primary
     sidx = np.lexsort(sort_keys)
@@ -191,7 +194,7 @@ def compute_window(rel, wf: WindowFunc) -> np.ndarray:
     pos = np.arange(n, dtype=np.int64)
 
     out = _compute_sorted(rel, wf, sidx, pos, part, new_part, part_start,
-                          part_ids, new_peer)
+                          part_ids, new_peer, pre_v)
 
     unsorted = np.empty(n, dtype=np.asarray(out).dtype)
     unsorted[sidx] = out
@@ -203,14 +206,16 @@ def _device_window_min_rows() -> int:
     return int(os.environ.get("PINOT_DEVICE_WINDOW_MIN_ROWS", 200_000))
 
 
-def _device_partition_agg(rel, wf: WindowFunc,
-                          pk: np.ndarray) -> Optional[np.ndarray]:
+def _device_partition_agg(rel, wf: WindowFunc, pk: np.ndarray
+                          ) -> Tuple[Optional[np.ndarray],
+                                     Optional[np.ndarray]]:
     """SUM/COUNT/AVG/MIN/MAX OVER (PARTITION BY ...) on device:
     segment reduction over the factorized partition ids, then a
     row-aligned gather. num_segments buckets to powers of two so the
     XLA program count stays bounded. Output dtypes mirror the host
     whole-partition branch (int64 for integral sum/count/min/max,
-    float64 otherwise). None -> caller keeps the host path."""
+    float64 otherwise). Returns (result, evaluated_arg); result None ->
+    caller keeps the host path, reusing the evaluated argument."""
     from ..query.sql import Star
     name = wf.func.name
     args = wf.func.args
@@ -219,9 +224,9 @@ def _device_partition_agg(rel, wf: WindowFunc,
     else:
         v = np.asarray(host_eval.eval_value(args[0], rel))
         if v.dtype.kind not in "iufb":
-            return None              # string aggs stay on host
+            return None, v           # string aggs stay on host
         if v.dtype.kind == "f" and np.isnan(v).any():
-            return None  # NaN semantics stay with the host machinery
+            return None, v  # NaN semantics stay with the host machinery
     integral = v.dtype.kind in "iub" and name != "avg"
 
     import jax
@@ -232,7 +237,7 @@ def _device_partition_agg(rel, wf: WindowFunc,
     vals = v.astype(np.int64 if integral else np.float64)
     out = jax.device_get(_segment_agg_jit(name, n_seg_p)(
         jnp.asarray(vals), jnp.asarray(pk)))
-    return np.asarray(out)
+    return np.asarray(out), v
 
 
 @functools.lru_cache(maxsize=64)
@@ -259,12 +264,14 @@ def _segment_agg_jit(op: str, segs: int):
     return run
 
 
-def _arg_value(rel, wf: WindowFunc, sidx: np.ndarray, i: int = 0
-               ) -> np.ndarray:
+def _arg_value(rel, wf: WindowFunc, sidx: np.ndarray, i: int = 0,
+               pre: Optional[np.ndarray] = None) -> np.ndarray:
     from ..query.sql import Star
     args = wf.func.args
     if not args or isinstance(args[0], Star):
         return np.ones(len(sidx), dtype=np.int64)
+    if i == 0 and pre is not None:
+        return pre[sidx]     # reuse the device-path bail evaluation
     v = np.asarray(host_eval.eval_value(args[i], rel))
     return v[sidx]
 
@@ -281,7 +288,8 @@ def _lit(wf: WindowFunc, i: int, default: Any) -> Any:
 
 
 def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
-                    part_start, part_ids, new_peer) -> np.ndarray:
+                    part_start, part_ids, new_peer,
+                    pre_v: Optional[np.ndarray] = None) -> np.ndarray:
     name = wf.func.name
     n = len(sidx)
     row_number = pos - part_start + 1
@@ -330,7 +338,7 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
                 "COUNT(DISTINCT x) OVER (PARTITION BY ...) without "
                 "ORDER BY or frames")
         # distinct count per partition, broadcast to every row
-        v = _arg_value(rel, wf, sidx)
+        v = _arg_value(rel, wf, sidx, pre=pre_v)
         _, vc_codes = np.unique(v, return_inverse=True)
         pair = part * (int(vc_codes.max()) + 1) + vc_codes
         order2 = np.argsort(pair, kind="stable")
@@ -339,7 +347,7 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
         uniq_per_part = np.bincount(part[order2][first],
                                     minlength=int(part.max()) + 1)
         return uniq_per_part[part]
-    v = _arg_value(rel, wf, sidx)
+    v = _arg_value(rel, wf, sidx, pre=pre_v)
     if name == "count":
         v = np.ones(n, dtype=np.int64)
     acc = v.astype(np.int64) if v.dtype.kind in "iub" and name != "avg" \
@@ -365,23 +373,20 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
     mode, lo, hi = frame
     part_end = _ends_from_starts(new_part)
     if lo is None and hi is None:
-        if name in ("sum", "count") and acc.dtype.kind in "iu":
-            # exact int64 accumulation (float64 bincount weights lose
-            # precision past 2^53 and would diverge from the device
-            # segment-sum path)
-            t = np.zeros(int(part.max()) + 1, dtype=np.int64)
-            np.add.at(t, part, acc)
-            return t[part]
-        sums = np.bincount(part, weights=acc.astype(np.float64))
+        # whole-partition reductions: part is the primary sort key here,
+        # so reduceat over the run starts is both vectorized AND exact
+        # in the native dtype — int64 sums/extrema past 2^53 stay exact
+        # and identical to the device segment_* path
+        starts = np.where(new_part)[0]
         if name in ("sum", "count"):
-            t = sums[part]
-            return t.astype(np.int64) if acc.dtype.kind in "iu" else t
+            t = np.add.reduceat(acc, starts)
+            return t[part_ids]
         if name == "avg":
-            return sums[part] / np.bincount(part)[part]
-        ext = _seg_cummax(acc, part_ids) if name == "max" \
-            else _seg_cummin(acc, part_ids)
-        t = ext[part_end]
-        return t.astype(acc.dtype) if acc.dtype.kind in "iu" else t
+            t = np.add.reduceat(acc.astype(np.float64), starts)
+            return t[part_ids] / np.bincount(part)[part]
+        ext = np.maximum.reduceat(acc, starts) if name == "max" \
+            else np.minimum.reduceat(acc, starts)
+        return ext[part_ids]
 
     # ROWS frame with at least one finite bound
     lo_pos = part_start if lo is None \
