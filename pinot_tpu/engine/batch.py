@@ -32,8 +32,16 @@ _STACK_CACHE_MAX = 32
 
 
 @functools.lru_cache(maxsize=512)
+def _vmapped_kernel_cached(plan_struct, bucket: int, scatter: bool):
+    return jax.jit(jax.vmap(build_kernel(plan_struct, bucket,
+                                         scatter=scatter)))
+
+
 def _vmapped_kernel(plan_struct, bucket: int):
-    return jax.jit(jax.vmap(build_kernel(plan_struct, bucket)))
+    from ..ops.kernels import cpu_scatter_default
+
+    return _vmapped_kernel_cached(plan_struct, bucket,
+                                  cpu_scatter_default())
 
 
 def _param_sig(params: Tuple[jax.Array, ...]) -> Tuple:

@@ -33,6 +33,7 @@ Kernel signature (shape-stable, no data-dependent shapes):
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -53,6 +54,22 @@ DISTINCT_ONEHOT_CARD = 1 << 12
 # unrolled masked-reduce limit for group MIN/MAX (no matmul form exists;
 # above this the planner routes to segment ops on CPU or the host path)
 MINMAX_UNROLL_GROUPS = 64
+
+
+def cpu_scatter_default(platform: Optional[str] = None) -> bool:
+    """Whether group-by kernels should take the scatter (segment-ops) path.
+
+    The one-hot MXU formulation is the TPU design; XLA:CPU executes those
+    int8 matmuls 50-100x slower than a plain scatter-add (PERF_LEDGER r04:
+    compact kernels at 0.01-0.16x the numpy baseline on the CPU fallback).
+    CPU scatter-add is fast, so when the execution platform is cpu the
+    kernels swap the aggregation core for jax.ops.segment_* — same dense
+    (space,) outputs, same extraction. PINOT_CPU_FAST_GROUPBY=0 pins the
+    MXU formulation everywhere (the test suite does this so the TPU-shaped
+    code stays covered on the virtual CPU mesh)."""
+    plat = platform or jax.default_backend()
+    return (plat == "cpu"
+            and os.environ.get("PINOT_CPU_FAST_GROUPBY", "1") == "1")
 
 
 def float_acc_dtype() -> jnp.dtype:
@@ -451,24 +468,83 @@ def _scalar_agg(i: int, spec: AggSpec, mask, cols, params,
 
 
 # ---------------------------------------------------------------------------
-# group-by aggregation (one-hot dot_general)
+# group-by aggregation (one-hot dot_general; scatter on CPU)
 # ---------------------------------------------------------------------------
 
-def _group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
-                out: Dict[str, jax.Array]) -> None:
+def _group_keys_sentinel(plan: KernelPlan, mask, cols, params):
+    """Shared cartesian dict-id key build (DictionaryBasedGroupKeyGenerator
+    .java:63 arithmetic) + sentinel application: returns (mask, keys_s)
+    with unmatched rows (and out-of-range expression keys) mapped to the
+    sentinel key == plan.group_space. Single source of truth for the
+    one-hot, scatter, and compact cores."""
     space = plan.group_space
-    # dense cartesian dict-id key (DictionaryBasedGroupKeyGenerator.java:63)
-    keys = jnp.zeros((bucket,), dtype=jnp.int32)
+    keys = jnp.zeros(mask.shape, dtype=jnp.int32)
     exprs = plan.key_exprs or (None,) * len(plan.group_keys)
     for (col_idx, card), kexpr in zip(plan.group_keys, exprs):
-        ids = cols[col_idx] if kexpr is None             else _eval_value(kexpr, cols, params)
+        ids = cols[col_idx] if kexpr is None \
+            else _eval_value(kexpr, cols, params)
         keys = keys * jnp.int32(card) + ids.astype(jnp.int32)
     if plan.key_exprs:
         # expression keys have no dictionary guarantee: clamp strays
         # (pre-epoch garbage etc.) onto the sentinel instead of wrapping
         # into a wrong group
         mask = mask & (keys >= 0) & (keys < space)
-    keys_s = jnp.where(mask, keys, space)  # sentinel -> all-zero one-hot col
+    return mask, jnp.where(mask, keys, space)
+
+
+def _scatter_group(plan: KernelPlan, mask, keys_s, cols, params, space: int,
+                   out: Dict[str, jax.Array]) -> None:
+    """CPU-fast group aggregation core: jax.ops.segment_* over sentinel
+    keys (sentinel = space, sliced off). Output contract is identical to
+    the one-hot formulation — dense (space,) arrays — so extraction and
+    broker reduce are oblivious to which core ran."""
+    nseg = space + 1
+    cnt_dtype = int_acc_dtype()
+    counts = jax.ops.segment_sum(mask.astype(cnt_dtype), keys_s,
+                                 num_segments=nseg)[:space]
+    out["group_count"] = counts
+    for i, spec in enumerate(plan.aggs):
+        name = _agg_name(i, spec)
+        if spec.kind == "count":
+            continue
+        if spec.kind == "distinct_count":
+            ids = _eval_value(spec.value, cols, params)
+            comb = jnp.where(
+                mask, keys_s.astype(jnp.int64) * spec.card + ids,
+                jnp.int64(space) * spec.card)
+            pres = jax.ops.segment_sum(
+                jnp.ones(comb.shape, dtype=jnp.int32), comb,
+                num_segments=space * spec.card + 1)[:space * spec.card]
+            out[name + "_present"] = pres.reshape(space, spec.card) > 0
+            continue
+        vals = _eval_value(spec.value, cols, params, promote=spec.integral)
+        acc = _acc_dtype(spec)
+        if spec.kind in ("sum", "avg"):
+            s = jax.ops.segment_sum(
+                jnp.where(mask, vals, 0).astype(acc), keys_s,
+                num_segments=nseg)[:space]
+            if spec.kind == "avg":
+                out[name + "_sum"] = s
+                out[name + "_cnt"] = counts
+            else:
+                out[name] = s
+        elif spec.kind in ("min", "max"):
+            sign = +1 if spec.kind == "min" else -1
+            segf = (jax.ops.segment_min if spec.kind == "min"
+                    else jax.ops.segment_max)
+            filled = jnp.where(mask, vals.astype(acc), _extreme(acc, sign))
+            out[name] = segf(filled, keys_s, num_segments=nseg)[:space]
+        else:
+            raise ValueError(f"unknown agg kind {spec.kind!r}")
+
+
+def _group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
+                out: Dict[str, jax.Array], scatter: bool = False) -> None:
+    space = plan.group_space
+    mask, keys_s = _group_keys_sentinel(plan, mask, cols, params)
+    if scatter:
+        _scatter_group(plan, mask, keys_s, cols, params, space, out)
+        return
     oh8 = jax.nn.one_hot(keys_s, space, dtype=jnp.int8)
 
     # one int8 limb matrix serves counts + every exact integer sum
@@ -542,7 +618,7 @@ def _group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
             else:
                 out[name] = row
         elif how == "minmax":
-            _group_minmax(i, spec, mask, keys, space, cols, params, out)
+            _group_minmax(i, spec, mask, keys_s, space, cols, params, out)
         elif how == "distinct":
             ids = _eval_value(spec.value, cols, params)
             ids_s = jnp.where(mask, ids, spec.card)
@@ -658,7 +734,8 @@ def _from_orderable64(o: jax.Array, mode: str, acc_f) -> jax.Array:
 
 def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
                         slots_cap: int, out: Dict[str, jax.Array],
-                        platform: str = None) -> None:
+                        platform: str = None,
+                        scatter: bool = False) -> None:
     """Group aggregation over compacted matched rows.
 
     Reference parity: DocIdSetOperator (docId materialization) +
@@ -669,10 +746,21 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
     cumsum + boundary diffs (any agg, space <= 2^20) finishes the job.
     Outputs are the same dense (space,) arrays as the dense strategy, so
     extraction and broker reduce are strategy-agnostic.
+
+    scatter=True (CPU execution, cpu_scatter_default): skip compaction
+    entirely — one segment-op pass over all rows with sentinel keys is the
+    fastest CPU form and removes the overflow/retry machinery from the
+    trace (overflow is emitted as a constant 0).
     """
     from .compact import compact
 
     space = plan.group_space
+    if scatter:
+        mask, keys_s = _group_keys_sentinel(plan, mask, cols, params)
+        out["overflow"] = jnp.zeros((), dtype=jnp.int32)
+        out["matched"] = jnp.sum(mask, dtype=int_acc_dtype())
+        _scatter_group(plan, mask, keys_s, cols, params, space, out)
+        return
     needed = sorted({ci for ci, _ in plan.group_keys}
                     | set().union(*[_value_col_indices(s.value)
                                     for s in plan.aggs if s.value is not None]
@@ -687,10 +775,7 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
         ccols[ci] = comp[i]
     m = valid.shape[0]
 
-    keys = jnp.zeros((m,), dtype=jnp.int32)
-    for col_idx, card in plan.group_keys:
-        keys = keys * jnp.int32(card) + ccols[col_idx].astype(jnp.int32)
-    keys = jnp.where(valid, keys, space)  # sentinel past the space
+    _, keys = _group_keys_sentinel(plan, valid, ccols, params)
 
     if needs_sort:
         _sorted_group(plan, keys, valid, ccols, params, space, out,
@@ -925,7 +1010,8 @@ def build_kernel(plan: KernelPlan, bucket: int,
                  slots_cap: Optional[int] = None,
                  platform: Optional[str] = None,
                  xfer_compact: bool = True,
-                 local_segments: int = 1):
+                 local_segments: int = 1,
+                 scatter: bool = False):
     """Return fn(cols, n_docs, params) -> dict of partial aggregation states.
 
     Shape contract: every cols[i] has the same (bucket,) length; n_docs is a
@@ -960,13 +1046,13 @@ def build_kernel(plan: KernelPlan, bucket: int,
                                 if _needs_sort(plan)
                                 else default_slots_cap(total))
             _compact_group_aggs(plan, mask, cols, params, total, cap, out,
-                                platform)
+                                platform, scatter)
             if xfer_compact:
                 _compact_group_xfer(plan, out)
             return out
         out["matched"] = jnp.sum(mask, dtype=int_acc_dtype())
         if plan.is_group_by:
-            _group_aggs(plan, mask, cols, params, total, out)
+            _group_aggs(plan, mask, cols, params, total, out, scatter)
             if xfer_compact:
                 _compact_group_xfer(plan, out)
         else:
@@ -1102,7 +1188,8 @@ def build_segmented_compact_kernel(plan: KernelPlan, bucket: int,
                                    n_segments: int,
                                    slots_cap: Optional[int] = None,
                                    platform: Optional[str] = None,
-                                   xfer_compact: bool = True):
+                                   xfer_compact: bool = True,
+                                   scatter: bool = False):
     """Multi-segment compact group-by as ONE device program.
 
     Reference parity: GroupByCombineOperator.java:125 runs the same
@@ -1166,7 +1253,7 @@ def build_segmented_compact_kernel(plan: KernelPlan, bucket: int,
                             else default_slots_cap(total))
         out: Dict[str, jax.Array] = {}
         _compact_group_aggs(plan2, masks.reshape(total), tuple(flat_cols),
-                            vparams, total, cap, out, platform)
+                            vparams, total, cap, out, platform, scatter)
         out["matched"] = masks.sum(axis=1, dtype=int_acc_dtype())  # (S,)
         if xfer_compact:
             # live-group gather over the combined S*space — the executor
@@ -1178,23 +1265,54 @@ def build_segmented_compact_kernel(plan: KernelPlan, bucket: int,
 
 
 @functools.lru_cache(maxsize=256)
+def _jitted_segmented_cached(plan, bucket, n_segments, slots_cap, platform,
+                             xfer_compact, scatter):
+    return jax.jit(build_segmented_compact_kernel(
+        plan, bucket, n_segments, slots_cap, platform, xfer_compact,
+        scatter))
+
+
 def jitted_segmented_compact(plan: KernelPlan, bucket: int,
                              n_segments: int,
                              slots_cap: Optional[int] = None,
                              platform: Optional[str] = None,
-                             xfer_compact: bool = True):
-    return jax.jit(build_segmented_compact_kernel(
-        plan, bucket, n_segments, slots_cap, platform, xfer_compact))
+                             xfer_compact: bool = True,
+                             scatter: Optional[bool] = None):
+    if scatter is None:
+        scatter = cpu_scatter_default(platform)
+    return _jitted_segmented_cached(plan, bucket, n_segments, slots_cap,
+                                    platform, xfer_compact, scatter)
+
+
+# the env-flag wrapper keeps the lru_cache introspection surface
+# (tests/tpu_hw_script assert cache hits across the retry ladder)
+jitted_segmented_compact.cache_info = _jitted_segmented_cached.cache_info
+jitted_segmented_compact.cache_clear = _jitted_segmented_cached.cache_clear
 
 
 @functools.lru_cache(maxsize=1024)
+def _jitted_kernel_cached(plan, bucket, slots_cap, platform, xfer_compact,
+                          scatter):
+    return jax.jit(build_kernel(plan, bucket, slots_cap, platform,
+                                xfer_compact, scatter=scatter))
+
+
 def jitted_kernel(plan: KernelPlan, bucket: int,
                   slots_cap: Optional[int] = None,
                   platform: Optional[str] = None,
-                  xfer_compact: bool = True):
-    """jit once per (plan structure, bucket, capacity, target platform) —
-    platform keys the cache because f64-bitcast support and the Pallas
-    gate differ per backend (mesh execution may target a platform other
-    than the process default)."""
-    return jax.jit(build_kernel(plan, bucket, slots_cap, platform,
-                                xfer_compact))
+                  xfer_compact: bool = True,
+                  scatter: Optional[bool] = None):
+    """jit once per (plan structure, bucket, capacity, target platform,
+    aggregation core) — platform keys the cache because f64-bitcast
+    support and the Pallas gate differ per backend (mesh execution may
+    target a platform other than the process default); scatter=None
+    resolves from the platform + PINOT_CPU_FAST_GROUPBY at call time
+    (cpu_scatter_default) so the flag is part of the cache key."""
+    if scatter is None:
+        scatter = cpu_scatter_default(platform)
+    return _jitted_kernel_cached(plan, bucket, slots_cap, platform,
+                                 xfer_compact, scatter)
+
+
+jitted_kernel.cache_info = _jitted_kernel_cached.cache_info
+jitted_kernel.cache_clear = _jitted_kernel_cached.cache_clear

@@ -186,10 +186,21 @@ class DistributedTable:
         return host
 
 
-@functools.lru_cache(maxsize=512)
 def _distributed_kernel(kernel_plan, bucket: int, mesh: Mesh,
                         n_cols: int, n_params: int,
                         slots_cap: int = None):
+    from ..ops.kernels import cpu_scatter_default
+
+    platform = mesh.devices.flat[0].platform
+    return _distributed_kernel_cached(kernel_plan, bucket, mesh, n_cols,
+                                      n_params, slots_cap,
+                                      cpu_scatter_default(platform))
+
+
+@functools.lru_cache(maxsize=512)
+def _distributed_kernel_cached(kernel_plan, bucket: int, mesh: Mesh,
+                               n_cols: int, n_params: int,
+                               slots_cap: int, scatter: bool):
     """jit(shard_map(kernel + collectives)) cached per plan/mesh."""
     # dense (space,) outputs only: psum/pmin/pmax combine positionally
     # across shards, which device-side transfer compaction would break.
@@ -208,12 +219,13 @@ def _distributed_kernel(kernel_plan, bucket: int, mesh: Mesh,
             # compaction + group pass serves the whole local shard
             kern = build_kernel(kernel_plan, bucket, slots_cap, platform,
                                 xfer_compact=False,
-                                local_segments=local_segs)
+                                local_segments=local_segs,
+                                scatter=scatter)
             flat = tuple(c.reshape(local_segs * bucket) for c in cols)
             local = kern(flat, n_docs, params)
         else:
             kern = build_kernel(kernel_plan, bucket, slots_cap, platform,
-                                xfer_compact=False)
+                                xfer_compact=False, scatter=scatter)
             out = jax.vmap(lambda c, n: kern(c, n, params))(cols, n_docs)
             local = {}
             for k, v in out.items():

@@ -7,6 +7,12 @@ tests exercise a jax.sharding.Mesh over 8 virtual CPU devices
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The suite's job is to validate the TPU-shaped kernels on the virtual CPU
+# mesh, so pin the CPU scatter-core hedge OFF here (ops/kernels.
+# cpu_scatter_default) — hard assignment, so an inherited =1 in the
+# environment can't silently flip the whole suite onto the scatter core;
+# tests/test_cpu_scatter.py flips it on explicitly per-test.
+os.environ["PINOT_CPU_FAST_GROUPBY"] = "0"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,153 @@
+"""CPU scatter-core group-by (PINOT_CPU_FAST_GROUPBY=1).
+
+Reference parity: DefaultGroupByExecutor semantics — but the aggregation
+core swaps the one-hot MXU formulation for jax.ops.segment_* when the
+execution platform is cpu (ops/kernels.cpu_scatter_default). The rest of
+the suite pins the flag OFF (conftest) so the TPU-shaped kernels stay
+covered; this module flips it ON and diffs both strategies ('dense' and
+'compact') against numpy oracles AND against the MXU-core results, so
+the two cores can never drift apart.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.segment import ImmutableSegment, SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+N_ROWS = 5000
+CARD_A = 40
+CARD_B = 50
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(23)
+    n = N_ROWS
+    return {
+        "ka": np.array([f"a{i:03d}" for i in rng.integers(0, CARD_A, n)]),
+        "kb": np.array([f"b{i:03d}" for i in rng.integers(0, CARD_B, n)]),
+        "sel": rng.integers(0, 100, n).astype(np.int32),
+        "v": rng.integers(-1000, 1000, n).astype(np.int32),
+        "big": rng.integers(-4_000_000_000, 4_000_000_000,
+                            n).astype(np.int64),
+        "f": np.round(rng.normal(0, 50, n), 3),
+    }
+
+
+@pytest.fixture(scope="module")
+def broker(data, tmp_path_factory):
+    schema = Schema("t", [
+        FieldSpec("ka", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("kb", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("sel", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+        FieldSpec("big", DataType.LONG, FieldType.METRIC),
+        FieldSpec("f", DataType.DOUBLE, FieldType.METRIC),
+    ])
+    out = tmp_path_factory.mktemp("scatter_table")
+    d = SegmentBuilder(schema, TableConfig("t")).build(data, str(out),
+                                                      "seg_0")
+    dm = TableDataManager("t")
+    dm.add_segment_dir(d)
+    b = Broker()
+    b.register_table(dm)
+    orig = b.query
+
+    def patient_query(sql):
+        if "OPTION(" not in sql:
+            sql += " OPTION(timeoutMs=300000)"
+        return orig(sql)
+
+    b.query = patient_query
+    return b
+
+
+@pytest.fixture()
+def scatter_on(monkeypatch):
+    monkeypatch.setenv("PINOT_CPU_FAST_GROUPBY", "1")
+
+
+def _both_cores(broker, monkeypatch, sql):
+    """Run sql with the MXU core and the scatter core; return both."""
+    monkeypatch.setenv("PINOT_CPU_FAST_GROUPBY", "0")
+    mxu = broker.query(sql).rows
+    monkeypatch.setenv("PINOT_CPU_FAST_GROUPBY", "1")
+    sc = broker.query(sql).rows
+    return mxu, sc
+
+
+QUERIES = [
+    # dense strategy (small space)
+    "SELECT ka, SUM(v), COUNT(*) FROM t GROUP BY ka LIMIT 100000",
+    "SELECT ka, MIN(v), MAX(v), AVG(v) FROM t WHERE sel < 40 "
+    "GROUP BY ka LIMIT 100000",
+    "SELECT ka, DISTINCTCOUNT(kb) FROM t GROUP BY ka LIMIT 100000",
+    # compact strategy (space 2000 > DENSE_SMALL_GROUPS)
+    "SELECT ka, kb, SUM(v), COUNT(*), SUM(big) FROM t WHERE sel < 20 "
+    "GROUP BY ka, kb LIMIT 100000",
+    "SELECT ka, kb, MIN(v), MAX(v), MIN(f), MAX(f) FROM t "
+    "WHERE sel >= 50 GROUP BY ka, kb LIMIT 100000",
+    "SELECT ka, kb, COUNT(*) FROM t GROUP BY ka, kb LIMIT 100000",
+    # sort-path space (40*50*100 = 200k) on the MXU core
+    "SELECT ka, kb, sel, SUM(v), COUNT(*) FROM t WHERE v > 0 "
+    "GROUP BY ka, kb, sel LIMIT 1000000",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_scatter_matches_mxu_core(broker, monkeypatch, sql):
+    mxu, sc = _both_cores(broker, monkeypatch, sql)
+    key = len([c for c in sql.split("GROUP BY")[1].split("LIMIT")[0]
+               .split(",") if c.strip()])
+
+    def norm(rows):
+        out = []
+        for r in rows:
+            out.append(tuple(r[:key]) + tuple(
+                round(x, 6) if isinstance(x, float) else x
+                for x in r[key:]))
+        return sorted(out)
+
+    assert norm(mxu) == norm(sc)
+
+
+def test_scatter_sums_vs_numpy(broker, data, scatter_on):
+    res = broker.query(
+        "SELECT ka, kb, SUM(v), COUNT(*), SUM(big) FROM t "
+        "WHERE sel < 20 GROUP BY ka, kb LIMIT 100000")
+    m = data["sel"] < 20
+    oracle = {}
+    for i in np.nonzero(m)[0]:
+        k = (data["ka"][i], data["kb"][i])
+        s = oracle.setdefault(k, [0, 0, 0])
+        s[0] += int(data["v"][i])
+        s[1] += 1
+        s[2] += int(data["big"][i])
+    got = {(r[0], r[1]): (r[2], r[3], r[4]) for r in res.rows}
+    assert got == {k: tuple(v) for k, v in oracle.items()}
+
+
+def test_scatter_distinctcount_vs_numpy(broker, data, scatter_on):
+    res = broker.query(
+        "SELECT ka, DISTINCTCOUNT(kb) FROM t GROUP BY ka LIMIT 100000")
+    oracle = {}
+    for i in range(N_ROWS):
+        oracle.setdefault(data["ka"][i], set()).add(data["kb"][i])
+    got = {r[0]: r[1] for r in res.rows}
+    assert got == {k: len(v) for k, v in oracle.items()}
+
+
+def test_scatter_overflow_free(broker, data, scatter_on):
+    """The scatter core emits overflow=0 unconditionally (no compaction,
+    no capacity): an all-match query must not trigger the retry."""
+    res = broker.query(
+        "SELECT ka, kb, COUNT(*) FROM t GROUP BY ka, kb LIMIT 100000")
+    oracle = {}
+    for i in range(N_ROWS):
+        k = (data["ka"][i], data["kb"][i])
+        oracle[k] = oracle.get(k, 0) + 1
+    got = {(r[0], r[1]): r[2] for r in res.rows}
+    assert got == oracle
