@@ -1,0 +1,216 @@
+"""Kafka wire-protocol stream plugin (round-5, VERDICT r4 next-step #5).
+
+Reference analog: KafkaPartitionLevelConsumer.java:42 tested against the
+embedded kafka fixture (pinot-integration-tests). Here the fixture is
+FakeKafkaBroker — an in-process TCP server speaking the real protocol
+(ApiVersions/Metadata/ListOffsets/Fetch/Produce, RecordBatch v2 with
+CRC32C) — and the clients decode/encode the same bytes from scratch.
+"""
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.realtime import RealtimeTableDataManager, StreamConfig
+from pinot_tpu.realtime.kafka import (FakeKafkaBroker, KafkaError,
+                                      KafkaPartitionConsumer,
+                                      KafkaProducer, KafkaStream, crc32c,
+                                      decode_record_batches,
+                                      encode_record_batch, _varint,
+                                      _Reader)
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+
+# ---------------------------------------------------------------------------
+# codec units
+# ---------------------------------------------------------------------------
+
+def test_crc32c_known_answer():
+    # RFC 3720 check value for "123456789"
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+
+
+@pytest.mark.parametrize("v", [0, 1, -1, 63, -64, 64, 300, -301,
+                               (1 << 31) - 1, -(1 << 31), (1 << 40)])
+def test_varint_zigzag_roundtrip(v):
+    assert _Reader(_varint(v)).varint() == v
+
+
+def test_record_batch_roundtrip():
+    recs = [(None, b'{"a":1}'), (b"k1", b'{"a":2}'), (None, b"")]
+    batch = encode_record_batch(42, recs, 1700000000000)
+    out = decode_record_batches(batch)
+    assert [(o, k, v) for o, k, v in out] == [
+        (42, None, b'{"a":1}'), (43, b"k1", b'{"a":2}'), (44, None, b"")]
+
+
+def test_record_batch_crc_detects_corruption():
+    batch = bytearray(encode_record_batch(0, [(None, b'{"x":9}')], 0))
+    batch[-1] ^= 0xFF  # flip a value byte; CRC must catch it
+    with pytest.raises(KafkaError, match="CRC32C"):
+        decode_record_batches(bytes(batch))
+
+
+def test_multiple_batches_in_one_record_set():
+    data = (encode_record_batch(0, [(None, b"0"), (None, b"1")], 0)
+            + encode_record_batch(2, [(None, b"2")], 0))
+    assert [o for o, _k, _v in decode_record_batches(data)] == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# protocol round-trips against the fake broker
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def kafka():
+    broker = FakeKafkaBroker({"events": 2})
+    yield broker
+    broker.stop()
+
+
+def test_metadata_num_partitions(kafka):
+    assert KafkaStream("events", port=kafka.port).num_partitions() == 2
+
+
+def test_metadata_unknown_topic(kafka):
+    with pytest.raises(KafkaError, match="metadata error 3"):
+        KafkaStream("missing", port=kafka.port).num_partitions()
+
+
+def test_produce_fetch_listoffsets_roundtrip(kafka):
+    prod = KafkaProducer("127.0.0.1", kafka.port)
+    base = prod.produce_many("events", 0,
+                             [{"a": 1}, {"a": 2}, {"a": 3}])
+    assert base == 0
+    assert prod.produce_many("events", 0, [{"a": 4}]) == 3
+    prod.produce_many("events", 1, [{"b": 9}])
+
+    c0 = KafkaPartitionConsumer("events", "127.0.0.1", kafka.port, 0, 5.0)
+    batch = c0.fetch(0, 10)
+    assert [r["a"] for r in batch.rows] == [1, 2, 3, 4]
+    assert batch.next_offset == 4
+    assert c0.latest_offset() == 4
+    # offset resume mid-log
+    assert [r["a"] for r in c0.fetch(2, 1).rows] == [3]
+    c1 = KafkaPartitionConsumer("events", "127.0.0.1", kafka.port, 1, 5.0)
+    assert c1.fetch(0, 10).rows == [{"b": 9}]
+    c0.close()
+    c1.close()
+    prod.close()
+
+
+def test_fetch_offset_out_of_range(kafka):
+    kafka.append("events", 0, [{"a": 1}])
+    c = KafkaPartitionConsumer("events", "127.0.0.1", kafka.port, 0, 5.0)
+    with pytest.raises(KafkaError, match="out of range"):
+        c.fetch(99, 10)
+    c.close()
+
+
+def test_fetch_empty_partition_returns_empty_batch(kafka):
+    c = KafkaPartitionConsumer("events", "127.0.0.1", kafka.port, 0, 5.0)
+    batch = c.fetch(0, 10)
+    assert batch.rows == [] and batch.next_offset == 0
+    c.close()
+
+
+def test_unknown_partition_is_error(kafka):
+    c = KafkaPartitionConsumer("events", "127.0.0.1", kafka.port, 7, 5.0)
+    with pytest.raises(KafkaError):
+        c.fetch(0, 10)
+    c.close()
+
+
+def test_max_messages_bounds_batch(kafka):
+    kafka.append("events", 0, [{"i": i} for i in range(50)])
+    c = KafkaPartitionConsumer("events", "127.0.0.1", kafka.port, 0, 5.0)
+    batch = c.fetch(0, 7)
+    assert [r["i"] for r in batch.rows] == list(range(7))
+    assert batch.next_offset == 7
+    # continue from next_offset: contiguous, no dup/loss
+    batch2 = c.fetch(batch.next_offset, 100)
+    assert [r["i"] for r in batch2.rows] == list(range(7, 50))
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# realtime table over the Kafka protocol (consume + seal + resume)
+# ---------------------------------------------------------------------------
+
+def _schema():
+    return Schema("kt", [FieldSpec("k", DataType.STRING),
+                         FieldSpec("v", DataType.INT, FieldType.METRIC)])
+
+
+def test_realtime_table_over_kafka(kafka, tmp_path):
+    rng = np.random.default_rng(5)
+    rows = [{"k": str(rng.choice(["a", "b"])), "v": int(v)}
+            for v in rng.integers(0, 100, 40)]
+    prod = KafkaProducer("127.0.0.1", kafka.port)
+    for i in range(0, len(rows), 4):
+        prod.produce_many("events", (i // 4) % 2, rows[i:i + 4])
+
+    cfg = StreamConfig("kt", num_partitions=2, flush_threshold_rows=15,
+                       consumer_factory=KafkaStream("events",
+                                                    port=kafka.port))
+    dm = RealtimeTableDataManager("kt", _schema(), cfg, str(tmp_path / "t"))
+    dm.consume_once(0)
+    dm.consume_once(1)
+    b = Broker()
+    b.register_table(dm)
+    got = b.query("SELECT COUNT(*), SUM(v) FROM kt").rows[0]
+    assert got == (len(rows), sum(r["v"] for r in rows))
+    # late arrivals after sealing keep flowing
+    prod.produce_many("events", 0, [{"k": "c", "v": 7}, {"k": "c", "v": 8}])
+    dm.consume_once(0)
+    got = b.query("SELECT COUNT(*), SUM(v) FROM kt").rows[0]
+    assert got == (len(rows) + 2, sum(r["v"] for r in rows) + 15)
+    prod.close()
+
+
+def test_restart_resumes_exactly_once_from_kafka(kafka, tmp_path):
+    """Crash-restart contract over the real protocol: committed segments
+    re-register from the checkpoint, the unsealed tail re-consumes from
+    the committed offset — no duplicates, no loss (VERDICT r4 #5 done
+    criterion)."""
+    kafka.append("events", 0, [{"k": "a", "v": i} for i in range(150)])
+    cfg = StreamConfig("kt", num_partitions=2, flush_threshold_rows=100,
+                       consumer_factory=KafkaStream("events",
+                                                    port=kafka.port))
+    dm = RealtimeTableDataManager("kt", _schema(), cfg, str(tmp_path / "t"))
+    dm.consume_once(0)
+    assert dm.num_segments == 1          # 100 sealed, 50 consuming
+
+    # 'crash' (no seal of the tail); fresh manager on the same dir
+    cfg2 = StreamConfig("kt", num_partitions=2, flush_threshold_rows=100,
+                        consumer_factory=KafkaStream("events",
+                                                     port=kafka.port))
+    dm2 = RealtimeTableDataManager("kt", _schema(), cfg2,
+                                   str(tmp_path / "t"))
+    assert dm2.num_segments == 1
+    kafka.append("events", 0, [{"k": "a", "v": i} for i in range(150, 180)])
+    dm2.consume_once(0)
+    b = Broker()
+    b.register_table(dm2)
+    got = b.query("SELECT COUNT(*), SUM(v) FROM kt").rows[0]
+    assert got == (180, sum(range(180)))
+
+
+def test_factory_via_plugin_loader(kafka, tmp_path):
+    kafka.append("events", 0, [{"k": "z", "v": 1}, {"k": "z", "v": 2}])
+    cfg = StreamConfig(
+        "kp", num_partitions=2,
+        consumer_factory_class="pinot_tpu.realtime.kafka.KafkaStream",
+        consumer_factory_args={"topic": "events", "port": kafka.port})
+    dm = RealtimeTableDataManager("kp", Schema("kp", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("v", DataType.INT, FieldType.METRIC)]), cfg,
+        str(tmp_path / "t"))
+    dm.consume_once(0)
+    b = Broker()
+    b.register_table(dm)
+    assert b.query("SELECT SUM(v) FROM kp").rows[0][0] == 3
