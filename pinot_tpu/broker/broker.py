@@ -258,17 +258,28 @@ class Broker:
                 # silently truncating to a wrong answer
                 cap = int(stmt.options.get("inSubqueryLimit", 100_000))
                 sub = e.stmt
-                if sub.limit is None or sub.limit > cap + 1:
+                # an explicit user LIMIT within the cap is honored as-is
+                # (bounded materialization with the documented
+                # deterministic-truncation LIMIT contract); anything else
+                # — no LIMIT, or a LIMIT above the cap — keeps the cap+1
+                # probe + error so the resource guard stays enforceable
+                user_limit = sub.limit
+                honored = user_limit is not None and user_limit <= cap
+                if not honored:
                     sub.limit = cap + 1
                 res = self._execute_stmt(sub, time.perf_counter())
                 if len(res.columns) != 1:
                     raise SqlError(
                         f"IN subquery must select exactly 1 column, "
                         f"got {len(res.columns)}")
-                if len(res.rows) > cap:
+                if not honored and len(res.rows) > cap:
+                    over = (f" (its LIMIT {user_limit} exceeds the cap "
+                            "and was not applied)"
+                            if user_limit is not None else "")
                     raise SqlError(
-                        f"IN subquery produced more than {cap} rows; "
-                        "narrow it or raise OPTION(inSubqueryLimit=...)")
+                        f"IN subquery produced more than {cap} rows"
+                        f"{over}; add a LIMIT <= {cap}, narrow it, or "
+                        "raise OPTION(inSubqueryLimit=...)")
                 vals = tuple(Literal(r[0].item() if hasattr(r[0], "item")
                                      else r[0]) for r in res.rows)
                 return InList(e.expr, vals, e.negated)

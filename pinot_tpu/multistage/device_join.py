@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -43,6 +44,15 @@ MIN_PROBE_ROWS = 200_000
 MAX_DUP_BOUND = 64
 
 STATS = {"device_joins": 0, "mesh_joins": 0, "numpy_joins": 0}
+_STATS_LOCK = threading.Lock()
+
+
+def bump(key: str) -> None:
+    """Thread-safe STATS increment: the broker serves concurrent HTTP
+    queries and tests assert exact counts — an unguarded += can lose
+    increments under races."""
+    with _STATS_LOCK:
+        STATS[key] += 1
 
 
 def _min_probe_rows() -> int:
@@ -133,7 +143,7 @@ def try_mesh_shuffle_join(left: Relation, right: Relation,
     if pairs is None:
         return None
     l_idx, r_idx = pairs
-    STATS["mesh_joins"] += 1
+    bump("mesh_joins")
     matched = np.ones(len(l_idx), dtype=bool)
     return materialize_join(left, right, l_idx.astype(np.int64),
                             r_idx.astype(np.int64), matched, "inner")
@@ -196,14 +206,14 @@ def try_device_join(left: Relation, right: Relation,
         mesh = segment_mesh()
         match, r_dense = mesh_equi_join(mesh, code_l, code_r, max_dup)
         backend = "mesh_broadcast"
-        STATS["mesh_joins"] += 1
+        bump("mesh_joins")
     else:
         import jax.numpy as jnp
 
         match, r_dense = jax.device_get(_jitted_equi_join(max_dup)(
             jnp.asarray(code_l), jnp.asarray(code_r)))
         backend = "device"
-        STATS["device_joins"] += 1
+        bump("device_joins")
 
     match = np.asarray(match)
     r_dense = np.asarray(r_dense)

@@ -304,7 +304,7 @@ class MultiStageExecutor:
             rel, backend = try_device_join(left, right, lkeys, rkeys,
                                            how, BROADCAST_THRESHOLD)
             if rel is None:
-                device_join.STATS["numpy_joins"] += 1
+                device_join.bump("numpy_joins")
                 self.join_backends.append(f"numpy({backend})")
                 return hash_join(left, right, lkeys, rkeys, how)
             self.join_backends.append(backend)
@@ -317,7 +317,7 @@ class MultiStageExecutor:
         if rel is not None:
             self.join_backends.append("mesh_shuffle")
             return rel
-        device_join.STATS["numpy_joins"] += 1
+        device_join.bump("numpy_joins")
         self.join_backends.append("numpy_shuffle")
         lex = HashExchange(self.mailboxes, query_id, stage, SHUFFLE_PARTITIONS,
                            lkeys)
@@ -370,7 +370,7 @@ class MultiStageExecutor:
                         "use CROSS JOIN for a cartesian product")
                 # parser guarantees CROSS has no ON, so rest is empty
                 self.join_backends.append("numpy(cross)")
-                device_join.STATS["numpy_joins"] += 1
+                device_join.bump("numpy_joins")
                 current = cross_join(current, right)
                 joined_labels.add(label)
                 continue
@@ -381,7 +381,7 @@ class MultiStageExecutor:
                 # the conjunct are NON-matches — preserved-side rows
                 # null-extend, never drop (HashJoinOperator join-clause
                 # semantics; a post-join filter would wrongly drop them)
-                device_join.STATS["numpy_joins"] += 1
+                device_join.bump("numpy_joins")
                 self.join_backends.append(f"numpy(non_equi_{j.join_type})")
                 inner, l_idx, r_idx, _m = hash_join(
                     current, right, lkeys, rkeys, "inner",

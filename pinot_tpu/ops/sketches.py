@@ -582,14 +582,25 @@ class TupleSketchAgg(AggImpl):
         return self._cap([[int(u), float(s)]
                           for u, s in zip(uniq, sums)], None)
 
+    def _numeric_values(self, h: HostSel) -> np.ndarray:
+        """The value argument must be numeric (the key may be anything);
+        numeric_input=False skips _typed_ev for the key, so enforce the
+        value contract here with a typed SqlError instead of letting
+        np.asarray raise a raw numpy ValueError on string columns."""
+        vals = np.asarray(h.ev(self.agg.arg2))
+        if vals.dtype.kind in "USO" and vals.size:
+            from ..query.sql import SqlError
+            raise SqlError(
+                f"{self.agg.kind.upper()} requires a numeric value "
+                f"expression; {self.agg.arg2!r} is a string expression")
+        return vals.astype(np.float64)
+
     def state(self, h: HostSel):
-        return self._from_pair(h.ev(self.agg.arg),
-                               np.asarray(h.ev(self.agg.arg2),
-                                          dtype=np.float64))
+        return self._from_pair(h.ev(self.agg.arg), self._numeric_values(h))
 
     def group_states(self, h: HostSel):
         keys = h.ev(self.agg.arg)
-        vals = np.asarray(h.ev(self.agg.arg2), dtype=np.float64)
+        vals = self._numeric_values(h)
         return _per_group_apply_multi([keys, vals], h.inv, h.n_groups,
                                       self._from_pair)
 

@@ -103,6 +103,11 @@ class _PartitionLog:
                 for m in msgs:
                     self.fh.write(struct.pack(">I", len(m)) + m)
                 self.fh.flush()
+                # PRODUCE acks the base offset and the module contract
+                # says a restarted broker serves the same offsets — that
+                # must hold across an OS/process crash, not just a clean
+                # restart, so fsync before acknowledging
+                os.fsync(self.fh.fileno())
             return base
 
     def read(self, offset: int, max_n: int) -> Tuple[List[bytes], int]:

@@ -454,6 +454,16 @@ def test_tuple_sketch_sum_avg_exact_below_k(xb):
     assert got[1] == pytest.approx(sum(per_key.values()) / len(per_key))
 
 
+def test_tuple_sketch_string_value_is_sql_error(xb):
+    """A string value column raises a typed SqlError, not a raw numpy
+    ValueError (advisor r4: numeric_input=False skips _typed_ev for the
+    key, so the value argument needs its own validation)."""
+    broker, _cols = xb
+    from pinot_tpu.query.sql import SqlError
+    with pytest.raises(SqlError, match="numeric value"):
+        broker.query("SELECT SUMVALUESINTEGERTUPLESKETCH(uid, nm) FROM x")
+
+
 def test_tuple_sketch_sum_estimates_above_k(xb):
     broker, cols = xb
     true = float(cols["amt"].sum())

@@ -257,6 +257,25 @@ class TestInSubqueryGuard:
             "(SELECT salary FROM emp) OPTION(inSubqueryLimit=1000)")
         assert r.rows[0][0] > 0
 
+    def test_explicit_user_limit_within_cap_is_honored(self, broker):
+        """An explicit subquery LIMIT within the cap bounds
+        materialization and truncates deterministically — no error
+        (advisor r4: the clamp used to overwrite the user LIMIT and then
+        blame the subquery)."""
+        r = broker.query(
+            "SELECT COUNT(*) FROM emp WHERE salary IN "
+            "(SELECT salary FROM emp LIMIT 2) OPTION(inSubqueryLimit=3)")
+        assert r.rows[0][0] > 0
+
+    def test_user_limit_above_cap_still_errors(self, broker):
+        """A LIMIT above the cap cannot bypass the resource guard; the
+        error names the overridden LIMIT."""
+        with pytest.raises(SqlError, match="LIMIT 1000000 exceeds"):
+            broker.query(
+                "SELECT COUNT(*) FROM emp WHERE salary IN "
+                "(SELECT salary FROM emp LIMIT 1000000) "
+                "OPTION(inSubqueryLimit=2)")
+
 
 class TestDeviceWindowPath:
     """Partition-only unordered aggregate windows run as device segment
