@@ -1,0 +1,2 @@
+"""Filesystem plugins (pinot-plugins/pinot-file-system analog)."""
+from .s3 import S3Client, S3PinotFS, sigv4_headers  # noqa: F401

@@ -93,14 +93,15 @@ class LocalPinotFS(PinotFS):
 
 
 class _GatedFS(PinotFS):
-    """Cloud filesystem placeholder: every operation raises with the
-    missing dependency spelled out."""
+    """Unconfigured/unavailable filesystem placeholder: every operation
+    raises with the remedy spelled out."""
 
-    def __init__(self, scheme: str, needs: str):
-        self._msg = (f"{scheme}:// deep store needs the {needs!r} client "
-                     f"library, which is not installed in this "
-                     f"environment; register a real implementation via "
-                     f"pinot_tpu.spi.filesystem.register_fs({scheme!r}, ...)")
+    def __init__(self, scheme: str, needs: str = "", msg: str = ""):
+        self._msg = msg or (
+            f"{scheme}:// deep store needs the {needs!r} client library, "
+            f"which is not installed in this environment; register a "
+            f"real implementation via "
+            f"pinot_tpu.spi.filesystem.register_fs({scheme!r}, ...)")
 
     def _raise(self, *a, **kw):
         raise RuntimeError(self._msg)
@@ -109,10 +110,20 @@ class _GatedFS(PinotFS):
     copy_to_local = copy_from_local = length = _raise
 
 
+def _UnconfiguredS3() -> PinotFS:
+    """s3:// has a real implementation (pinot_tpu.fs.S3PinotFS) but it
+    needs endpoint + credentials; until registered, operations explain
+    how."""
+    return _GatedFS("s3", msg=(
+        "s3:// deep store is not configured; call "
+        "pinot_tpu.fs.S3PinotFS.register(endpoint_url=..., "
+        "access_key=..., secret_key=..., region=...) first"))
+
+
 _REGISTRY: Dict[str, Callable[[], PinotFS]] = {
     "": LocalPinotFS,
     "file": LocalPinotFS,
-    "s3": lambda: _GatedFS("s3", "boto3"),
+    "s3": _UnconfiguredS3,
     "gs": lambda: _GatedFS("gs", "google-cloud-storage"),
     "abfs": lambda: _GatedFS("abfs", "azure-storage-file-datalake"),
     "hdfs": lambda: _GatedFS("hdfs", "pyarrow.hdfs"),
