@@ -1,0 +1,88 @@
+"""Vector similarity bench: 1M x 128d device matmul top-k.
+
+VERDICT r4 next-step #7 done-criterion: VECTOR_SIMILARITY runs on device
+at >= 1M x 128d with a PERF_LEDGER entry. Prints ONE JSON line
+{"metric": "vector_similarity_1m_128d_qps", ...}; vs_baseline is the
+speedup over the single-thread numpy brute-force scan of the same data
+(the stand-in for Lucene HNSW, which trades recall for speed — this path
+is exact, recall 1.0). Appends every successful capture to
+PERF_LEDGER.jsonl like bench.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("PINOT_BENCH_VEC_ROWS", 1 << 20))
+DIM = int(os.environ.get("PINOT_BENCH_VEC_DIM", 128))
+K = 10
+QUERIES = 20
+
+# size-keyed so ledger comparisons never mix differently-sized captures
+METRIC = f"vector_similarity_{N_ROWS}x{DIM}d_qps"
+
+
+def main() -> None:
+    from bench_common import finish, require_backend
+
+    backend = require_backend(METRIC)
+
+    from pinot_tpu.index.vector import VectorIndexReader
+
+    rng = np.random.default_rng(7)
+    mat = rng.standard_normal((N_ROWS, DIM), dtype=np.float32)
+    queries = rng.standard_normal((QUERIES, DIM), dtype=np.float32)
+
+    reader = VectorIndexReader.__new__(VectorIndexReader)
+    reader.dim = DIM
+    reader.metric = "cosine"
+    reader.matrix = mat
+    reader._device = None
+    reader._row_sq = None
+
+    # warm: residency + compile
+    got = reader.top_k_docs(queries[0], K)
+    t0 = time.perf_counter()
+    for q in queries:
+        reader.top_k_docs(q, K)
+    dev_t = (time.perf_counter() - t0) / QUERIES
+
+    # numpy single-thread baseline (normalized matmul + argpartition)
+    norms = np.linalg.norm(mat, axis=1, keepdims=True)
+    mn = mat / np.maximum(norms, 1e-30)
+    qn = queries[0] / np.linalg.norm(queries[0])
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        sims = mn @ qn
+        idx = np.argpartition(-sims, K - 1)[:K]
+        base = idx[np.argsort(-sims[idx])]
+    cpu_t = (time.perf_counter() - t0) / reps
+
+    del got
+    # exactness check on the warm query (device and numpy agree on top-k)
+    ok = set(reader.top_k_docs(queries[0], K).tolist()) == \
+        set(base.tolist())
+
+    out = {
+        "metric": METRIC,
+        "value": round(1.0 / dev_t, 2),
+        "unit": "queries/s",
+        "vs_baseline": round(cpu_t / dev_t, 2),
+        "n_rows": N_ROWS,
+        "queries": {
+            "topk": {"ok": ok, "dim": DIM, "k": K,
+                     "device_ms": round(dev_t * 1e3, 3),
+                     "cpu_ms": round(cpu_t * 1e3, 3),
+                     "rows_per_sec": round(N_ROWS / dev_t)},
+        },
+    }
+    finish(out, backend, ok)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ here when its stacked footprint exceeds ``hbm_budget_bytes()``.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Dict, List, Tuple
 
 import jax
@@ -32,6 +33,15 @@ _DEFAULT_BUDGET = 8 << 30
 
 # observability: how many pipelined streams ran (tests + trace hooks)
 STATS = {"pipelined_groups": 0, "pipelined_segments": 0}
+_STATS_LOCK = threading.Lock()
+
+
+def bump(key: str) -> None:
+    """Thread-safe STATS increment (same rationale as
+    multistage/device_join.bump: concurrent broker queries, tests
+    assert exact counts)."""
+    with _STATS_LOCK:
+        STATS[key] += 1
 
 
 def hbm_budget_bytes() -> int:
@@ -81,7 +91,7 @@ def execute_kernel_plans_pipelined(plans: List[CompiledPlan],
         return tuple(jax.device_put(seg.host_col_padded(c, bucket))
                      for c in group[k].col_names)
 
-    STATS["pipelined_groups"] += 1
+    bump("pipelined_groups")
     results: List[Any] = []
     staged = stage(0)
     outs: List[Any] = []
@@ -95,7 +105,7 @@ def execute_kernel_plans_pipelined(plans: List[CompiledPlan],
                  resolved_params[idxs[k]])
         outs.append(out)
         del cur  # last py-reference; freed once the kernel consumes it
-        STATS["pipelined_segments"] += 1
+        bump("pipelined_segments")
         if k >= 1:
             # bound in-flight work to the double buffer: resolve the
             # previous segment's output before enqueueing more
