@@ -1,8 +1,8 @@
 """Vector similarity bench: 1M x 128d device matmul top-k.
 
 VERDICT r4 next-step #7 done-criterion: VECTOR_SIMILARITY runs on device
-at >= 1M x 128d with a PERF_LEDGER entry. Prints ONE JSON line
-{"metric": "vector_similarity_1m_128d_qps", ...}; vs_baseline is the
+at >= 1M x 128d with a PERF_LEDGER entry. Prints ONE JSON line with the
+size-keyed metric "vector_similarity_<rows>x<dim>d_qps"; vs_baseline is the
 speedup over the single-thread numpy brute-force scan of the same data
 (the stand-in for Lucene HNSW, which trades recall for speed — this path
 is exact, recall 1.0). Appends every successful capture to
@@ -37,12 +37,7 @@ def main() -> None:
     mat = rng.standard_normal((N_ROWS, DIM), dtype=np.float32)
     queries = rng.standard_normal((QUERIES, DIM), dtype=np.float32)
 
-    reader = VectorIndexReader.__new__(VectorIndexReader)
-    reader.dim = DIM
-    reader.metric = "cosine"
-    reader.matrix = mat
-    reader._device = None
-    reader._row_sq = None
+    reader = VectorIndexReader.from_matrix(mat)
 
     # warm: residency + compile
     got = reader.top_k_docs(queries[0], K)

@@ -19,7 +19,6 @@ here when its stacked footprint exceeds ``hbm_budget_bytes()``.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Any, Dict, List, Tuple
 
 import jax
@@ -27,21 +26,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..query.planner import CompiledPlan
+from ..utils.stats import make_bump
 
 # default budget: v5e has 16GB HBM; leave headroom for outputs/compile
 _DEFAULT_BUDGET = 8 << 30
 
-# observability: how many pipelined streams ran (tests + trace hooks)
+# observability: how many pipelined streams ran (tests + trace hooks);
+# thread-safe — concurrent broker queries, tests assert exact counts
 STATS = {"pipelined_groups": 0, "pipelined_segments": 0}
-_STATS_LOCK = threading.Lock()
-
-
-def bump(key: str) -> None:
-    """Thread-safe STATS increment (same rationale as
-    multistage/device_join.bump: concurrent broker queries, tests
-    assert exact counts)."""
-    with _STATS_LOCK:
-        STATS[key] += 1
+bump = make_bump(STATS)
 
 
 def hbm_budget_bytes() -> int:

@@ -66,13 +66,25 @@ def _jitted_search(metric: str, k_pad: int):
 
 class VectorIndexReader:
     def __init__(self, seg_dir: str, col: str, meta: Dict[str, Any]):
-        self.dim = int(meta["dim"])
-        self.metric = meta.get("metric", "cosine")
         from ..segment import segdir
         raw = segdir.read_array(seg_dir, col + SUFFIX, np.float32)
-        self.matrix = raw.reshape(-1, self.dim)
+        self._init(raw.reshape(-1, int(meta["dim"])),
+                   meta.get("metric", "cosine"))
+
+    def _init(self, matrix: np.ndarray, metric: str) -> None:
+        self.dim = matrix.shape[1]
+        self.metric = metric
+        self.matrix = matrix
         self._device = None
         self._row_sq = None
+
+    @classmethod
+    def from_matrix(cls, matrix: np.ndarray,
+                    metric: str = "cosine") -> "VectorIndexReader":
+        """Reader over an in-memory matrix (benches, mutable segments)."""
+        r = cls.__new__(cls)
+        r._init(np.asarray(matrix, dtype=np.float32), metric)
+        return r
 
     def _query_vec(self, query: np.ndarray) -> np.ndarray:
         q = np.asarray(query, dtype=np.float32)

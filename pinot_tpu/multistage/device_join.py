@@ -29,11 +29,11 @@ from __future__ import annotations
 
 import functools
 import os
-import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.stats import make_bump
 from .join import _composite_codes, _key_nulls, materialize_join
 from .relation import Relation
 
@@ -43,16 +43,10 @@ MIN_PROBE_ROWS = 200_000
 # dense (L, max_dup) candidate matrices stop paying past this bound
 MAX_DUP_BOUND = 64
 
+# thread-safe (utils/stats): the broker serves concurrent HTTP queries
+# and tests assert exact counts — an unguarded += can lose increments
 STATS = {"device_joins": 0, "mesh_joins": 0, "numpy_joins": 0}
-_STATS_LOCK = threading.Lock()
-
-
-def bump(key: str) -> None:
-    """Thread-safe STATS increment: the broker serves concurrent HTTP
-    queries and tests assert exact counts — an unguarded += can lose
-    increments under races."""
-    with _STATS_LOCK:
-        STATS[key] += 1
+bump = make_bump(STATS)
 
 
 def _min_probe_rows() -> int:

@@ -140,6 +140,87 @@ def _seg_cummin(v: np.ndarray, part_ids: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# device segmented scans (round-5, VERDICT r4 next-step #4): ORDER BY
+# frames — running SUM/COUNT/AVG prefix sums, running MIN/MAX, and the
+# rank-function scans — lower to jax.lax.associative_scan with the
+# classic segmented-scan monoid: elements are (reset_flag, value) and
+#   combine((fa,va),(fb,vb)) = (fa|fb, fb ? vb : op(va, vb))
+# so partition boundaries reset the accumulation. One compiled program
+# per (op, pow2-padded length); padding rows carry a reset flag so they
+# can't leak into real partitions.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=128)
+def _seg_scan_jit(op: str, n_pad: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(vals, flags):
+        def combine(a, b):
+            fa, va = a
+            fb, vb = b
+            if op == "sum":
+                v = jnp.where(fb, vb, va + vb)
+            elif op == "min":
+                v = jnp.where(fb, vb, jnp.minimum(va, vb))
+            else:
+                v = jnp.where(fb, vb, jnp.maximum(va, vb))
+            return fa | fb, v
+        _f, v = jax.lax.associative_scan(combine, (flags, vals))
+        return v
+    return run
+
+
+def _device_seg_scan(op: str, v: np.ndarray,
+                     new_part: np.ndarray) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+
+    n = len(v)
+    n_pad = 1 << max(n - 1, 0).bit_length()
+    flags = np.zeros(n_pad, dtype=bool)
+    flags[:n] = new_part
+    if n_pad > n:
+        flags[n] = True      # isolate the padding tail
+    vals = np.zeros(n_pad, dtype=v.dtype)
+    vals[:n] = v
+    out = jax.device_get(_seg_scan_jit(op, n_pad)(
+        jnp.asarray(vals), jnp.asarray(flags)))
+    return np.asarray(out)[:n]
+
+
+def _scan_on_device(n: int, *vs: np.ndarray) -> bool:
+    """Device scans above the row threshold for clean numeric inputs;
+    NaN min/max semantics and object dtypes stay with the host
+    machinery. float64 is fine here: the reduce stage's device is
+    whatever backend serves the broker, and the CPU fallback keeps
+    digest exactness (on-TPU float windows accept the documented f32
+    tolerance via jax's x64-on-tpu handling)."""
+    if n < _device_window_min_rows():
+        return False
+    for v in vs:
+        if v.dtype.kind not in "iufb":
+            return False
+        if v.dtype.kind == "f" and np.isnan(v).any():
+            return False
+    return True
+
+
+def _seg_run(op: str, v: np.ndarray, new_part: np.ndarray,
+             part_start: np.ndarray, part_ids: np.ndarray) -> np.ndarray:
+    """Segmented running scan: device associative_scan above the
+    threshold, host cumsum/offset-trick below."""
+    if _scan_on_device(len(v), v):
+        return _device_seg_scan(op, v, new_part)
+    if op == "sum":
+        return _seg_cumsum(v, part_start)
+    if op == "max":
+        return _seg_cummax(v, part_ids)
+    return _seg_cummin(v, part_ids)
+
+
+# ---------------------------------------------------------------------------
 # the evaluator
 # ---------------------------------------------------------------------------
 
@@ -292,6 +373,21 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
                     pre_v: Optional[np.ndarray] = None) -> np.ndarray:
     name = wf.func.name
     n = len(sidx)
+    if name in ("row_number", "rank", "dense_rank") and _scan_on_device(n):
+        # rank scans on device: row_number is the segmented running
+        # count, rank the running max of row_number at peer starts,
+        # dense_rank the running count of peer starts — one
+        # associative_scan each over (reset=new_part, value). NTILE
+        # stays host-side: its formula needs only part sizes and the
+        # O(1)-per-row row_number arithmetic below.
+        if name == "dense_rank":
+            return _device_seg_scan(
+                "sum", new_peer.astype(np.int64), new_part)
+        rn = _device_seg_scan("sum", np.ones(n, dtype=np.int64), new_part)
+        if name == "row_number":
+            return rn
+        return _device_seg_scan("max", np.where(new_peer, rn, 0),
+                                new_part)
     row_number = pos - part_start + 1
 
     if name == "row_number":
@@ -360,13 +456,15 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
         # RANGE UNBOUNDED PRECEDING..CURRENT ROW incl. peers
         peer_end = _ends_from_starts(new_peer)
         if name in ("sum", "count"):
-            return _seg_cumsum(acc, part_start)[peer_end]
+            return _seg_run("sum", acc, new_part, part_start,
+                            part_ids)[peer_end]
         if name == "avg":
-            s = _seg_cumsum(acc, part_start)[peer_end]
-            c = _seg_cumsum(np.ones(n), part_start)[peer_end]
+            s = _seg_run("sum", acc, new_part, part_start,
+                         part_ids)[peer_end]
+            c = _seg_run("sum", np.ones(n), new_part, part_start,
+                         part_ids)[peer_end]
             return s / c
-        run = _seg_cummax(acc, part_ids) if name == "max" \
-            else _seg_cummin(acc, part_ids)
+        run = _seg_run(name, acc, new_part, part_start, part_ids)
         out = run[peer_end]
         return out.astype(acc.dtype) if acc.dtype.kind in "iu" else out
 
@@ -395,7 +493,8 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
         else np.clip(pos + hi, part_start - 1, part_end)
     empty = hi_pos < lo_pos
     if name in ("sum", "count", "avg"):
-        P = _seg_cumsum(acc.astype(np.float64), part_start)
+        P = _seg_run("sum", acc.astype(np.float64), new_part, part_start,
+                     part_ids)
         Pm1 = np.where(lo_pos > part_start, P[np.maximum(lo_pos - 1, 0)], 0.0)
         total = np.where(empty, 0.0, P[np.minimum(hi_pos, len(P) - 1)] - Pm1)
         if name == "avg":
@@ -404,14 +503,21 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
         return total.astype(np.int64) if acc.dtype.kind in "iu" else total
     # sliding min/max
     if lo is None:                      # prefix up to hi_pos
-        run = _seg_cummax(acc, part_ids) if name == "max" \
-            else _seg_cummin(acc, part_ids)
+        run = _seg_run(name, acc, new_part, part_start, part_ids)
         out = run[np.maximum(hi_pos, 0)]
     elif hi is None:                    # suffix from lo_pos: reverse scan
         racc = acc[::-1]
-        rpart = part_ids[::-1]
-        rrun = _seg_cummax(racc, rpart) if name == "max" \
-            else _seg_cummin(racc, rpart)
+        # reversed partition ids DECREASE; the offset trick needs
+        # nondecreasing ids, so renumber (review r5: the raw reversal
+        # leaked maxima across partitions), and reversed reset flags
+        # mark each partition's LAST row
+        rnew = np.r_[True, part[::-1][1:] != part[::-1][:-1]]
+        if _scan_on_device(n, racc):
+            rrun = _device_seg_scan(name, racc, rnew)
+        else:
+            rpart = (int(part_ids[-1]) - part_ids)[::-1]
+            rrun = _seg_cummax(racc, rpart) if name == "max" \
+                else _seg_cummin(racc, rpart)
         run = rrun[::-1]
         out = run[np.minimum(lo_pos, len(acc) - 1)]
     else:                               # both finite: O(n·w) masked view

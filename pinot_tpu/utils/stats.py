@@ -1,0 +1,23 @@
+"""Thread-safe module STATS counters.
+
+The broker serves concurrent HTTP queries and tests assert exact
+counter values, so bare `STATS[k] += 1` can lose increments under
+races. Modules declare their dict and wrap it:
+
+    STATS = {"things": 0}
+    bump = make_bump(STATS)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+
+def make_bump(stats: Dict[str, int]) -> Callable[[str], None]:
+    lock = threading.Lock()
+
+    def bump(key: str) -> None:
+        with lock:
+            stats[key] += 1
+
+    return bump
