@@ -159,6 +159,13 @@ def resolve_params(plan: CompiledPlan, sharding=None) -> Tuple[jax.Array, ...]:
     for p in plan.params:
         if isinstance(p, tuple) and len(p) == 2 and p[0] == "dictvals":
             out.append(seg.device_dict_values(p[1], sharding=sharding))
+        elif isinstance(p, tuple) and len(p) == 2 and p[0] == "hash64":
+            # per-dict-id 64-bit hash table for sketch aggregations
+            # (host _hash64 — md5 for strings — so device and host
+            # sketches agree bit-for-bit)
+            from ..ops.aggregations import _hash64
+            vals = np.asarray(seg.dictionary(p[1]).values)
+            out.append(put(_hash64(vals)))
         elif isinstance(p, tuple) and len(p) == 2 and p[0] == "nullmask":
             out.append(seg.device_null_mask(p[1], sharding=sharding))
         elif isinstance(p, tuple) and len(p) == 2 and p[0] == "validdocs":
@@ -287,6 +294,29 @@ def _scalar_state(b: AggBinding, out: Dict[str, np.ndarray], matched: int,
         ids = np.nonzero(present)[0]
         vals = seg.dictionary(b.dict_col).values_for(ids)
         return set(_py(v) for v in vals)
+    # device sketch partials -> host AggImpl state formats (the broker
+    # reduce merges them through ops/aggregations like any host partial);
+    # RAW forms share their base sketch's state (RawAgg delegates)
+    k = {"raw_hll": "distinct_count_hll",
+         "raw_theta": "distinct_count_theta",
+         "percentile_raw_sketch": "percentile_sketch"}.get(k, k)
+    if k == "distinct_count_hll":
+        from ..ops.aggregations import HllAgg
+        p = HllAgg(b.agg).log2m
+        r_levels = 64 - p + 1
+        pm = np.asarray(out[name + "_present"]).reshape(1 << p, r_levels)
+        ranks = np.arange(1, r_levels + 1, dtype=np.int64)
+        return np.where(pm.any(axis=1), (pm * ranks).max(axis=1),
+                        0).tolist()
+    if k == "distinct_count_theta":
+        h = np.asarray(out[name + "_hashes"]).astype(np.uint64)
+        sent = np.uint64(0xFFFFFFFFFFFFFFFF)
+        return [int(x) for x in h if x != sent]
+    if k == "percentile_sketch":
+        means = np.asarray(out[name + "_pc_mean"])
+        ws = np.asarray(out[name + "_pc_w"])
+        return [[float(m_), float(w_)]
+                for m_, w_ in zip(means, ws) if w_ > 0]
     raise ValueError(k)
 
 

@@ -417,6 +417,117 @@ def _limb_rows(vals64: jax.Array, mask: jax.Array, bits: int, signed: bool,
 
 
 # ---------------------------------------------------------------------------
+# device sketch lowerings (round-5, VERDICT r4 next-step #2): the
+# flagship sketch aggregations stop demoting queries to host execution.
+# Partial-state formats match the host AggImpl registry exactly, so
+# kernel partials merge with host partials in the broker reduce.
+# ---------------------------------------------------------------------------
+
+def _device_splitmix64(v: jax.Array) -> jax.Array:
+    """aggregations._splitmix64 on device (bit-identical): the shared
+    64-bit hash for HLL/theta over raw numeric columns. Floats view
+    their float64 bits as int64 first, exactly like the host _hash64."""
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v = jax.lax.bitcast_convert_type(v.astype(jnp.float64), jnp.int64)
+    x = v.astype(jnp.uint64)
+    x = x + jnp.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def _agg_hashes(spec: AggSpec, cols, params) -> jax.Array:
+    """The 64-bit hash stream for a sketch aggregation: dict columns
+    gather a precomputed per-id hash table (params[dict_param], host
+    _hash64 over the dictionary values — md5 for strings); raw numeric
+    columns hash on device."""
+    ve = spec.value
+    if isinstance(ve, Col) and ve.dict_param is not None:
+        return jnp.take(params[ve.dict_param], cols[ve.col])
+    return _device_splitmix64(_eval_value(ve, cols, params))
+
+
+def _sorted_presence(comb: jax.Array, n_slots: int) -> jax.Array:
+    """(n_slots,) bool: which slot ids appear in comb (sentinel rows
+    carry id == n_slots). Sort + searchsorted boundary diffs — the same
+    scatter-free shape as the big-cardinality DISTINCTCOUNT path."""
+    s = jnp.sort(comb.astype(jnp.int32))
+    edges = jnp.searchsorted(s, jnp.arange(n_slots + 1, dtype=jnp.int32))
+    return (edges[1:] - edges[:-1]) > 0
+
+
+def _scalar_hll(name: str, spec: AggSpec, mask, cols, params,
+                out: Dict[str, jax.Array]) -> None:
+    """DISTINCTCOUNTHLL: register index = top log2m hash bits, rank =
+    leading zeros of the remainder + 1 (sentinel bit bounds it), then a
+    (m * R) presence bitmap; extraction maxes over the rank axis to the
+    host HllAgg register list."""
+    p = spec.card                    # log2m
+    r_levels = 64 - p + 1
+    h = _agg_hashes(spec, cols, params)
+    idx = (h >> jnp.uint64(64 - p)).astype(jnp.int32)
+    rest = (h << jnp.uint64(p)) | jnp.uint64(1 << (p - 1))
+    rank = jax.lax.clz(rest).astype(jnp.int32) + 1   # 1 .. R
+    comb = jnp.where(mask, idx * r_levels + (rank - 1),
+                     (1 << p) * r_levels)
+    out[name + "_present"] = _sorted_presence(comb, (1 << p) * r_levels)
+
+
+def _scalar_theta(name: str, spec: AggSpec, mask, cols, params,
+                  out: Dict[str, jax.Array]) -> None:
+    """KMV theta sketch: the k smallest DISTINCT hashes. Sort with an
+    all-ones sentinel for unmatched rows, flag first occurrences, and
+    gather the positions of unique-ranks 1..k (searchsorted over the
+    cumulative unique count — no data-dependent shapes)."""
+    k = spec.card
+    h = _agg_hashes(spec, cols, params)
+    sentinel = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    s = jnp.sort(jnp.where(mask, h, sentinel))
+    uniq = jnp.concatenate([jnp.ones(1, jnp.bool_), s[1:] != s[:-1]])
+    ranks = chunked_cumsum(uniq.astype(jnp.int32)).astype(jnp.int32)
+    pos = jnp.searchsorted(ranks, jnp.arange(1, k + 1, dtype=jnp.int32))
+    picked = s.at[jnp.minimum(pos, s.shape[0] - 1)].get(mode="clip")
+    n_uniq = ranks[-1]
+    valid = jnp.arange(k, dtype=jnp.int32) < n_uniq
+    # sentinel-valued picks are unmatched-row hashes, not data: mask them
+    out[name + "_hashes"] = jnp.where(valid & (picked != sentinel),
+                                      picked, sentinel)
+
+
+def _scalar_percentile(name: str, spec: AggSpec, mask, cols, params,
+                       out: Dict[str, jax.Array]) -> None:
+    """Mergeable quantile summary: device sort of the matched values,
+    equal-count chunk boundaries over the matched prefix, centroid
+    means via prefix-sum differences. Output (C,) means + weights maps
+    to the host PercentileSketchAgg centroid list."""
+    c = spec.card                    # number of centroids
+    vals = _eval_value(spec.value, cols, params).astype(float_acc_dtype())
+    big = jnp.asarray(jnp.inf, vals.dtype)
+    s = jnp.sort(jnp.where(mask, vals, big))    # matched prefix first
+    mcount = jnp.sum(mask, dtype=jnp.int32)
+    ps = chunked_cumsum(jnp.where(jnp.isfinite(s), s, 0))
+    bounds = (jnp.arange(c + 1, dtype=jnp.int64) * mcount) // c
+    totals = jnp.where(bounds > 0,
+                       ps.at[jnp.maximum(bounds - 1, 0)].get(mode="clip"),
+                       0)
+    w = (bounds[1:] - bounds[:-1]).astype(jnp.int32)
+    sums = totals[1:] - totals[:-1]
+    out[name + "_pc_mean"] = jnp.where(
+        w > 0, sums / jnp.maximum(w, 1).astype(sums.dtype), 0.0)
+    out[name + "_pc_w"] = w
+
+
+_SKETCH_SCALAR = {"distinct_count_hll": _scalar_hll,
+                  "distinct_count_theta": _scalar_theta,
+                  "percentile_sketch": _scalar_percentile,
+                  # RAW forms share the kernels: RawAgg delegates state
+                  # to the inner sketch impl, only finalize serializes
+                  "raw_hll": _scalar_hll,
+                  "raw_theta": _scalar_theta,
+                  "percentile_raw_sketch": _scalar_percentile}
+
+
+# ---------------------------------------------------------------------------
 # scalar (non-group-by) aggregation
 # ---------------------------------------------------------------------------
 
@@ -430,6 +541,10 @@ def _scalar_agg(i: int, spec: AggSpec, mask, cols, params,
         # SUM/MIN/MAX to null from it)
         mask = mask & ~params[spec.null_param]
         out[name + "_nnz"] = jnp.sum(mask, dtype=cnt_dtype)
+    sketch_fn = _SKETCH_SCALAR.get(spec.kind)
+    if sketch_fn is not None:
+        sketch_fn(name, spec, mask, cols, params, out)
+        return
     if spec.kind == "count":
         out[name] = jnp.sum(mask, dtype=cnt_dtype)
         return

@@ -161,6 +161,14 @@ class DistributedTable:
             # MV columns are (bucket, maxValues) matrices; the sharded
             # column stack is 2-D — per-segment path handles them
             return None
+        if plan.kernel_plan is not None and any(
+                s.kind in ("distinct_count_theta", "percentile_sketch",
+                           "raw_theta", "percentile_raw_sketch")
+                for s in plan.kernel_plan.aggs):
+            # theta hash lists / percentile centroids are NOT
+            # positionally combinable across shards (HLL presence is —
+            # it rides the 'or' reduce); per-segment path merges them
+            return None
         out = self._run(plan)
         return extract_partial(plan, out)
 

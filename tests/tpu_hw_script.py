@@ -139,6 +139,33 @@ def main() -> int:
                 raise AssertionError(f"{sql!r}: got {got}, want {expect}")
         out["checks"].append(f"query:{sql.split('(')[1].split(')')[0]}")
 
+    # device sketch lowerings (round-5): HLL registers and theta hashes
+    # must be BIT-identical to the host registry on the real chip;
+    # percentile centroids within sketch tolerance
+    sk_cases = [
+        ("SELECT DISTINCTCOUNTHLL(k) FROM t", None),
+        ("SELECT DISTINCTCOUNTTHETASKETCH(k, 512) FROM t", None),
+        ("SELECT PERCENTILEKLL(d, 50) FROM t", 0.02),
+    ]
+    for sql, tol in sk_cases:
+        ctx = build_query_context(parse_sql(sql))
+        plan = SegmentPlanner(ctx, seg).plan()
+        if plan.kind != "kernel":
+            raise AssertionError(f"{sql!r} planned {plan.kind}, "
+                                 "want kernel")
+        dev = broker.query(sql + " OPTION(timeoutMs=600000)").rows[0][0]
+        host = broker.query(
+            sql + " OPTION(forceHostExecution=true,"
+            "timeoutMs=600000)").rows[0][0]
+        if tol is None:
+            ok = dev == host
+        else:
+            spread = float(srcs["double"].max() - srcs["double"].min())
+            ok = abs(dev - host) <= tol * spread
+        if not ok:
+            raise AssertionError(f"{sql!r}: device {dev} vs host {host}")
+        out["checks"].append(f"sketch:{sql.split('(')[0].split()[-1]}")
+
     check_device_transforms(out)
     check_string_predicates(out)
     check_kselect(out)
