@@ -31,6 +31,48 @@ from ..utils.trace import Tracing
 DEFAULT_TIMEOUT_MS = 10_000
 
 
+def _cte_table(name: str, columns: List[str], rows: List[tuple],
+               tmpdirs: List[str]) -> TableDataManager:
+    """Materialize a CTE result as a single-segment table. Types are
+    inferred per column (all-int -> LONG, numeric -> DOUBLE, else
+    STRING); an empty result registers a segment-less manager."""
+    import tempfile
+
+    import numpy as np
+
+    from ..segment import SegmentBuilder
+    from ..spi import DataType, FieldSpec, FieldType, Schema, TableConfig
+
+    dm = TableDataManager(name)
+    if not rows:
+        return dm
+    cols: Dict[str, Any] = {}
+    fields: List[FieldSpec] = []
+    for j, cname in enumerate(columns):
+        vals = [r[j] for r in rows]
+        if any(v is None for v in vals):
+            raise SqlError(f"CTE {name!r} column {cname!r} produced NULL "
+                           "values; filter them in the CTE query")
+        if all(isinstance(v, (int, np.integer))
+               and not isinstance(v, (bool, np.bool_)) for v in vals):
+            cols[cname] = np.asarray(vals, dtype=np.int64)
+            dt = DataType.LONG
+        elif all(isinstance(v, (int, float, np.integer, np.floating))
+                 and not isinstance(v, (bool, np.bool_)) for v in vals):
+            cols[cname] = np.asarray(vals, dtype=np.float64)
+            dt = DataType.DOUBLE
+        else:
+            cols[cname] = np.asarray([str(v) for v in vals])
+            dt = DataType.STRING
+        fields.append(FieldSpec(cname, dt, FieldType.DIMENSION))
+    out = tempfile.mkdtemp(prefix="ptpu_cte_")
+    tmpdirs.append(out)
+    seg_dir = SegmentBuilder(Schema(name, fields),
+                             TableConfig(name)).build(cols, out, "cte_0")
+    dm.add_segment_dir(seg_dir)
+    return dm
+
+
 class QueryTimeoutError(SqlError):
     pass
 
@@ -79,6 +121,8 @@ class Broker:
             f"{table}_REALTIME" in self._tables
 
     def _execute_stmt(self, stmt, t0: float) -> ResultTable:
+        if getattr(stmt, "ctes", None):
+            return self._execute_with_ctes(stmt, t0)
         if isinstance(stmt, SetOpStmt):
             return self._execute_setop(stmt, t0)
         stmt = self._resolve_subqueries(stmt)
@@ -232,6 +276,63 @@ class Broker:
         rows.append(("BROKER_REDUCE", 0, -1))
         emit(stmt, 0)
         return ResultTable(["Operator", "Operator_Id", "Parent_Id"], rows)
+
+    # -- WITH / common table expressions -----------------------------------
+    def _execute_with_ctes(self, stmt, t0: float) -> ResultTable:
+        """Materialize each CTE (in order — later CTEs may reference
+        earlier ones) into an in-memory segment registered under a
+        SCOPED broker copy, then run the main statement against it.
+        The scope shadows real tables for this query only and is torn
+        down afterwards. Reference:
+        pinot-query-planner/.../QueryEnvironment.java:126 (Calcite CTE
+        planning); materialization-first is the TPU-friendly stance —
+        the CTE result becomes a real segment every engine path (joins,
+        windows, group-by kernels) already handles."""
+        import copy
+        import dataclasses
+        import shutil
+
+        scoped = copy.copy(self)
+        scoped._tables = dict(self._tables)
+        tmpdirs: List[str] = []
+        try:
+            cap = int(stmt.options.get("cteLimit", 1_000_000))
+            for cte in stmt.ctes:
+                sub = dataclasses.replace(cte.stmt, ctes=[])
+                if "timeoutMs" in stmt.options:
+                    sub.options.setdefault("timeoutMs",
+                                           stmt.options["timeoutMs"])
+                # a CTE materializes its FULL result (no engine default
+                # LIMIT 10), bounded by the cteLimit resource guard the
+                # same way IN-subqueries are: an explicit LIMIT within
+                # the cap is honored, anything else gets the cap+1
+                # probe + error so the guard stays enforceable
+                user_limit = sub.limit
+                honored = user_limit is not None and user_limit <= cap
+                if not honored:
+                    sub.limit = cap + 1
+                res = scoped._execute_stmt(sub, time.perf_counter())
+                if not honored and len(res.rows) > cap:
+                    over = (f" (its LIMIT {user_limit} exceeds the cap "
+                            "and was not applied)"
+                            if user_limit is not None else "")
+                    raise SqlError(
+                        f"CTE {cte.name!r} produced more than {cap} "
+                        f"rows{over}; add a LIMIT <= {cap} or raise "
+                        "OPTION(cteLimit=...)")
+                names = cte.columns or res.columns
+                if len(names) != len(res.columns):
+                    raise SqlError(
+                        f"CTE {cte.name!r} declares {len(cte.columns)} "
+                        f"columns but its query produces "
+                        f"{len(res.columns)}")
+                scoped._tables[cte.name] = _cte_table(
+                    cte.name, list(names), res.rows, tmpdirs)
+            inner = dataclasses.replace(stmt, ctes=[])
+            return scoped._execute_stmt(inner, t0)
+        finally:
+            for d in tmpdirs:
+                shutil.rmtree(d, ignore_errors=True)
 
     # -- subqueries (IN_SUBQUERY / scalar rewrite at the broker) -----------
     def _resolve_subqueries(self, stmt: SelectStmt) -> SelectStmt:

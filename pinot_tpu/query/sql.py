@@ -214,6 +214,17 @@ class SelectStmt:
     offset: int = 0
     options: dict = field(default_factory=dict)
     explain: bool = False
+    # WITH name [(cols)] AS (stmt), ... — materialized by the broker
+    # before the main statement runs (QueryEnvironment.java:126 CTE
+    # support analog)
+    ctes: List["CteDef"] = field(default_factory=list)
+
+
+@dataclass
+class CteDef:
+    name: str
+    columns: Optional[List[str]]   # optional column alias list
+    stmt: Any                      # SelectStmt | SetOpStmt
 
 
 @dataclass
@@ -230,6 +241,7 @@ class SetOpStmt:
     offset: int = 0
     options: dict = field(default_factory=dict)
     explain: bool = False
+    ctes: List[CteDef] = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -359,13 +371,47 @@ class _Parser:
                     raise SqlError(f"expected FOR after EXPLAIN PLAN "
                                    f"at {t2.pos}")
             explain = True
+        ctes = self._with_clause()
         stmt = self.compound()
+        stmt.ctes = ctes
         self.accept_op(";")
         if self.peek().kind != "eof":
             t = self.peek()
             raise SqlError(f"unexpected trailing token {t.value!r} at {t.pos}")
         stmt.explain = explain
         return stmt
+
+    def _with_clause(self) -> List[CteDef]:
+        """WITH name [(col, ...)] AS ( stmt ) [, ...] — 'with' stays
+        contextual (a valid column name elsewhere); only the statement
+        head position treats it as a keyword."""
+        t = self.peek()
+        if not (t.kind == "ident" and str(t.value).lower() == "with"):
+            return []
+        self.next()
+        out: List[CteDef] = []
+        while True:
+            nt = self.next()
+            if nt.kind != "ident":
+                raise SqlError(f"expected CTE name at {nt.pos}")
+            cols = None
+            if self.accept_op("("):
+                cols = []
+                while True:
+                    c = self.next()
+                    if c.kind != "ident":
+                        raise SqlError(f"expected CTE column at {c.pos}")
+                    cols.append(str(c.value))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            self.expect_kw("as")
+            self.expect_op("(")
+            sub = self.compound()
+            self.expect_op(")")
+            out.append(CteDef(str(nt.value), cols, sub))
+            if not self.accept_op(","):
+                return out
 
     def compound(self) -> Union[SelectStmt, "SetOpStmt"]:
         """select_core ((UNION|EXCEPT) [ALL] select_core)* with INTERSECT
