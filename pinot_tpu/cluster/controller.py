@@ -31,13 +31,28 @@ RECONCILE_INTERVAL_S = 1.0
 class Controller:
     def __init__(self, data_dir: str, port: int = 0,
                  heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S,
-                 reconcile_interval: float = RECONCILE_INTERVAL_S):
+                 reconcile_interval: float = RECONCILE_INTERVAL_S,
+                 lease_ttl: Optional[float] = None,
+                 instance_id: Optional[str] = None):
+        """lease_ttl enables HA mode (round-5, VERDICT r4 next-step
+        #10; LeadControllerManager analog): controllers sharing a
+        data_dir contend for a file lease; exactly one leads (runs
+        reconcile/periodic tasks, accepts writes) while the others tail
+        the versioned property store and serve stale-ok reads, taking
+        over within ~lease_ttl of the leader dying. lease_ttl=None is
+        the classic single-node controller."""
+        import uuid as _uuid
+
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self._lock = threading.RLock()
         self._routing_cache: Optional[Dict[str, Any]] = None
         self.heartbeat_timeout = heartbeat_timeout
         self.reconcile_interval = reconcile_interval
+        self.lease_ttl = lease_ttl
+        self.instance_id = instance_id or f"controller_{_uuid.uuid4().hex[:8]}"
+        self.is_leader = False
+        self._recon: Optional[threading.Thread] = None
         self._state: Dict[str, Any] = self._load() or {
             "version": 0,
             "tables": {},      # name -> {schema, config, replication}
@@ -54,11 +69,16 @@ class Controller:
         # a half-constructed controller
         from .periodic import BasePeriodicTask, PeriodicTaskScheduler
         self.scheduler = PeriodicTaskScheduler()
+        # periodic tasks are leader-gated in HA mode: an abdicated
+        # controller's scheduler keeps ticking (restartability) but its
+        # tasks no-op — a fenced-out epoch must never mutate the shared
+        # property store or delete deep-store artifacts
         self.scheduler.register(BasePeriodicTask(
-            "RetentionManager", interval_s=60.0, fn=self.run_retention))
+            "RetentionManager", interval_s=60.0,
+            fn=self._leader_gated(self.run_retention)))
         self.scheduler.register(BasePeriodicTask(
             "SegmentStatusChecker", interval_s=30.0,
-            fn=self.run_status_check))
+            fn=self._leader_gated(self.run_status_check)))
         # realtime commit arbitration (SegmentCompletionManager FSM); the
         # registry fallback keeps restarts/purges from re-electing a
         # committer for an already-registered segment
@@ -78,10 +98,145 @@ class Controller:
             .get(t, {}).get("replication", 1),
             registered_segment=_registered)
         self._httpd, self.port, _ = start_http(self._make_handler(), port)
-        self._recon = threading.Thread(target=self._reconcile_loop,
-                                       daemon=True)
-        self._recon.start()
-        self.scheduler.start()
+        if self.lease_ttl is None:
+            self._become_leader()
+        else:
+            # one synchronous acquire attempt so constructing against a
+            # free lease returns an already-leading controller; then the
+            # lease loop renews / tails / takes over
+            if self._try_acquire_lease():
+                self._become_leader()
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, daemon=True)
+            self._lease_thread.start()
+
+    # -- leadership (LeadControllerManager analog) -------------------------
+    def _lease_path(self) -> str:
+        return os.path.join(self.data_dir, "leader.lease")
+
+    def _read_lease(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._lease_path()) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def _write_lease(self, epoch: int) -> None:
+        tmp = self._lease_path() + f".w{self.instance_id}"
+        with open(tmp, "w") as fh:
+            json.dump({"holder": self.instance_id, "epoch": epoch,
+                       "expires": time.time() + self.lease_ttl}, fh)
+        os.replace(tmp, self._lease_path())
+
+    def _try_acquire_lease(self) -> bool:
+        """Claim the lease if free/expired. A short-lived O_EXCL lock
+        file serializes contenders (stale locks from a crash mid-claim
+        are broken after 2x ttl)."""
+        now = time.time()
+        cur = self._read_lease()
+        if cur and cur.get("holder") != self.instance_id \
+                and cur.get("expires", 0) > now:
+            return False
+        lock = self._lease_path() + ".lock"
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                if now - os.path.getmtime(lock) > \
+                        max(self.lease_ttl or 1.0, 1.0) * 2:
+                    os.unlink(lock)
+            except OSError:
+                pass
+            return False
+        try:
+            os.close(fd)
+            cur = self._read_lease()   # re-check under the claim lock
+            if cur and cur.get("holder") != self.instance_id \
+                    and cur.get("expires", 0) > now:
+                return False
+            epoch = (cur or {}).get("epoch", 0)
+            if not cur or cur.get("holder") != self.instance_id:
+                epoch += 1             # fencing token: bumps on takeover
+            self._write_lease(epoch)
+            return True
+        finally:
+            try:
+                os.unlink(lock)
+            except OSError:
+                pass
+
+    def _leader_gated(self, fn):
+        def run():
+            if self.lease_ttl is not None and not self.is_leader:
+                return
+            fn()
+        return run
+
+    def _renew_lease(self) -> bool:
+        """Renew under the same claim lock acquisition takes, re-checking
+        the holder — a stalled leader must never clobber a standby's
+        fresh claim or regress the fencing epoch. False -> abdicate."""
+        lock = self._lease_path() + ".lock"
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            # a contender holds the claim lock this tick; keep leading
+            # until the holder check resolves next tick
+            cur = self._read_lease()
+            return not cur or cur.get("holder") == self.instance_id
+        try:
+            os.close(fd)
+            cur = self._read_lease()
+            if cur and cur.get("holder") != self.instance_id:
+                return False           # stolen while we stalled
+            self._write_lease((cur or {}).get("epoch", 1))
+            return True
+        finally:
+            try:
+                os.unlink(lock)
+            except OSError:
+                pass
+
+    def _become_leader(self) -> None:
+        with self._lock:
+            # the previous leader may have written newer state: reload
+            fresh = self._load()
+            if fresh is not None and fresh.get("version", 0) >= \
+                    self._state.get("version", 0):
+                self._state = fresh
+                self._state.setdefault("lineage", {})
+                self._routing_cache = None
+            self.is_leader = True
+        if self._recon is None:
+            self._recon = threading.Thread(target=self._reconcile_loop,
+                                           daemon=True)
+            self._recon.start()
+            self.scheduler.start()
+
+    def _tail_state(self) -> None:
+        """Standby read path: follow the leader's property-store writes
+        so reads (routing, status, UI) serve fresh-enough snapshots."""
+        fresh = self._load()
+        if fresh is None:
+            return
+        with self._lock:
+            if fresh.get("version", 0) > self._state.get("version", 0):
+                self._state = fresh
+                self._state.setdefault("lineage", {})
+                self._routing_cache = None
+
+    def _lease_loop(self) -> None:
+        interval = max(self.lease_ttl / 3.0, 0.05)
+        while not self._stop.wait(interval):
+            if self.is_leader:
+                if not self._renew_lease():
+                    # lease stolen (e.g. long GC pause past expiry):
+                    # abdicate — never act on a fenced-out epoch
+                    self.is_leader = False
+            else:
+                self._tail_state()
+                if self._try_acquire_lease():
+                    self._become_leader()
 
     # -- property store ----------------------------------------------------
     def _path(self) -> str:
@@ -209,6 +364,8 @@ class Controller:
     # -- assignment / reconciliation ---------------------------------------
     def _reconcile_loop(self) -> None:
         while not self._stop.wait(self.reconcile_interval):
+            if self.lease_ttl is not None and not self.is_leader:
+                continue   # abdicated: a fenced-out epoch must not act
             with self._lock:
                 self._reconcile_locked()
 
@@ -622,6 +779,18 @@ class Controller:
     def _make_handler(self):
         ctrl = self
 
+        def guard(fn):
+            """HA mode: writes only land on the lease holder — a
+            standby answers 503 so clients retry/repoint instead of
+            split-braining the property store."""
+            def wrapped(h, b):
+                if ctrl.lease_ttl is not None and not ctrl.is_leader:
+                    return 503, {"error": "not leader",
+                                 "leader": (ctrl._read_lease() or {})
+                                 .get("holder")}
+                return fn(h, b)
+            return wrapped
+
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/ui"): lambda h, b: (
@@ -690,11 +859,25 @@ class Controller:
                 ("GET", "/status"): lambda h, b: (
                     ctrl.run_status_check() or (200, ctrl._status)),
             }
+
+        Handler.routes = {k: (v if k[0] == "GET" else guard(v))
+                          for k, v in Handler.routes.items()}
         return Handler
 
-    def stop(self) -> None:
+    def stop(self, release_lease: bool = True) -> None:
+        """release_lease=False simulates a crash: the lease expires
+        naturally and the standby takes over after ~lease_ttl (tests);
+        the default deletes the lease for an immediate handoff."""
         self._stop.set()
         self.scheduler.stop()
+        if self.lease_ttl is not None and release_lease and self.is_leader:
+            cur = self._read_lease()
+            if cur and cur.get("holder") == self.instance_id:
+                try:
+                    os.unlink(self._lease_path())
+                except OSError:
+                    pass
+        self.is_leader = False
         self._httpd.shutdown()
         self._httpd.server_close()
 
