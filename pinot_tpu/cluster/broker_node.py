@@ -85,8 +85,15 @@ class BrokerNode:
     def _refresh_routing(self) -> None:
         snap = http_json("GET", f"{self.controller_url}/routing")
         with self._lock:
-            if snap["version"] != self._routing.get("version"):
-                self._routing = snap
+            # always swap: instance host/port and liveServers are
+            # heartbeat-driven, NOT version-driven — a rolled server
+            # re-registers on a new port with the assignment version
+            # unchanged, and a version-gated swap would keep routing
+            # queries to the dead port forever (found by the rolling-
+            # upgrade compat verifier, round-5). Consumers take one
+            # snapshot reference, so the whole-dict swap stays
+            # tear-free.
+            self._routing = snap
 
     def wait_for_version(self, version: int, timeout: float = 10.0) -> bool:
         deadline = time.monotonic() + timeout

@@ -249,6 +249,18 @@ class Controller:
         return None
 
     def _persist(self) -> None:
+        if self.lease_ttl is not None:
+            # epoch fence on the STORE, not just the lease: a stalled
+            # ex-leader can keep is_leader for up to one renewal tick
+            # after a takeover — re-check the lease holder immediately
+            # before every write so its stale in-memory state can never
+            # clobber the new leader's property store. (Review r5: the
+            # lease file alone protected only itself.)
+            cur = self._read_lease()
+            if not self.is_leader or (
+                    cur and cur.get("holder") != self.instance_id):
+                self.is_leader = False
+                return   # abdicate silently; _tail_state re-syncs reads
         tmp = self._path() + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(self._state, fh, indent=1)
