@@ -2,9 +2,11 @@
 
 Reference parity: pinot-plugins/pinot-input-format/ — RecordReader SPI
 implementations for csv, json, avro, parquet, orc, protobuf, thrift.
-Python-native: csv/json(l) read with the stdlib; avro and parquet load
-through fastavro/pyarrow when present and raise a clear gating error when
-not (the environment does not allow installing them).
+Python-native: csv/json(l) read with the stdlib; avro container files
+decode through the from-scratch binary codec (inputformat/avro.py — no
+fastavro dependency); parquet loads through pyarrow when present and
+raises a clear gating error when not (the environment does not allow
+installing it).
 """
 from __future__ import annotations
 
@@ -48,14 +50,10 @@ def read_json(path: str) -> List[Dict[str, Any]]:
 
 
 def read_avro(path: str) -> List[Dict[str, Any]]:
-    try:
-        import fastavro  # type: ignore[import-not-found]
-    except ImportError:
-        raise RuntimeError(
-            "avro input needs the 'fastavro' package, which is not "
-            "installed in this environment") from None
-    with open(path, "rb") as fh:
-        return list(fastavro.reader(fh))
+    """Object-container-file reader — from-scratch binary codec
+    (inputformat/avro.py), no fastavro dependency (round-5)."""
+    from .avro import read_container
+    return read_container(path)
 
 
 def read_parquet(path: str) -> List[Dict[str, Any]]:

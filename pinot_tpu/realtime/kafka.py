@@ -599,11 +599,16 @@ class KafkaStream(StreamConsumerFactory):
     consumer_factory_class='pinot_tpu.realtime.kafka.KafkaStream')."""
 
     def __init__(self, topic: str, host: str = "127.0.0.1", port: int = 0,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, value_decoder=None):
+        """value_decoder: bytes -> row dict (default JSON). Pass a
+        pinot_tpu.inputformat.avro.ConfluentAvroDecoder for
+        schema-registry-framed Avro messages (the
+        KafkaConfluentSchemaRegistryAvroMessageDecoder analog)."""
         self.topic = topic
         self.host = host
         self.port = int(port)
         self.timeout = timeout
+        self.value_decoder = value_decoder
         self._n_parts: Optional[int] = None
 
     def num_partitions(self) -> int:
@@ -649,7 +654,8 @@ class KafkaStream(StreamConsumerFactory):
 
     def create_consumer(self, partition: int) -> "KafkaPartitionConsumer":
         return KafkaPartitionConsumer(self.topic, self.host, self.port,
-                                      partition, self.timeout)
+                                      partition, self.timeout,
+                                      self.value_decoder)
 
 
 class KafkaPartitionConsumer(PartitionGroupConsumer):
@@ -660,9 +666,10 @@ class KafkaPartitionConsumer(PartitionGroupConsumer):
     FETCH_MAX_BYTES = 4 << 20
 
     def __init__(self, topic: str, host: str, port: int, partition: int,
-                 timeout: float):
+                 timeout: float, value_decoder=None):
         self.topic = topic
         self.partition = partition
+        self._decode = value_decoder or (lambda v: json.loads(v))
         self._conn = _KafkaConn(host, port, timeout)
 
     def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
@@ -704,7 +711,7 @@ class KafkaPartitionConsumer(PartitionGroupConsumer):
                         continue             # batch may start earlier
                     if len(rows) >= max_messages:
                         break
-                    rows.append(json.loads(value))
+                    rows.append(self._decode(value))
                     next_offset = off + 1
         return MessageBatch(rows, next_offset)
 
