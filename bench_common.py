@@ -219,7 +219,8 @@ def finish(out: dict, backend: str, all_ok: bool) -> None:
     out["backend"] = backend
     ledger_append(out, backend, ok=all_ok)
     if not all_ok:
-        out["error"] = "digest mismatch vs numpy oracle"
+        # keep a more specific error (capture failures) when present
+        out.setdefault("error", "digest mismatch vs numpy oracle")
         print(json.dumps(out))
         sys.exit(1)
     print(json.dumps(out))

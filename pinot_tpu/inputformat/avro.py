@@ -20,6 +20,12 @@ public specs — implemented here directly:
   (GET /schemas/ids/{id}) and cached per id. SchemaRegistryStub is the
   in-process registry for tests (POST /subjects/{s}/versions assigns
   ids like the real service).
+
+logicalType handling: decimal decodes to decimal.Decimal (unscaled
+big-endian two's complement / 10^scale); date / time-* / timestamp-*
+/ uuid deliberately pass through as their underlying int/long/string —
+the ingestion pipeline consumes epoch numbers natively (dateTime field
+specs), so no datetime objects are fabricated.
 """
 from __future__ import annotations
 
@@ -128,18 +134,30 @@ class AvroCodec:
         if t == "null":
             return None, pos
         if t == "boolean":
+            if pos >= len(buf):
+                raise AvroError("truncated boolean")
             return buf[pos] != 0, pos + 1
         if t in ("int", "long"):
             return _zigzag_decode(buf, pos)
         if t == "float":
+            if pos + 4 > len(buf):
+                raise AvroError("truncated float")
             return struct.unpack("<f", buf[pos:pos + 4])[0], pos + 4
         if t == "double":
+            if pos + 8 > len(buf):
+                raise AvroError("truncated double")
             return struct.unpack("<d", buf[pos:pos + 8])[0], pos + 8
         if t in ("bytes", "string"):
             n, pos = _zigzag_decode(buf, pos)
             raw = buf[pos:pos + n]
             if len(raw) != n:
                 raise AvroError("truncated bytes/string")
+            if t == "bytes" and isinstance(s, dict) \
+                    and s.get("logicalType") == "decimal":
+                import decimal
+                unscaled = int.from_bytes(raw, "big", signed=True)
+                return decimal.Decimal(unscaled).scaleb(
+                    -int(s.get("scale", 0))), pos + n
             return (raw.decode() if t == "string" else raw), pos + n
         if t == "fixed":
             n = s["size"]
@@ -253,7 +271,12 @@ class AvroCodec:
             return False
         if t == "boolean":
             return isinstance(v, bool)
-        if t in ("int", "long"):
+        if t == "int":
+            # int32-bounded: a 2^40 value must NOT be written into an
+            # int branch (conformant readers would overflow/reject)
+            return isinstance(v, int) and not isinstance(v, bool) \
+                and -(1 << 31) <= v < (1 << 31)
+        if t == "long":
             return isinstance(v, int) and not isinstance(v, bool)
         if t in ("float", "double"):
             # int promotes to float/double (every standard Avro writer
@@ -375,6 +398,8 @@ class ConfluentAvroDecoder:
         if not message or message[0] != 0:
             raise AvroError(
                 "not a Confluent-framed message (magic byte != 0)")
+        if len(message) < 5:
+            raise AvroError("truncated Confluent frame header")
         (schema_id,) = struct.unpack(">i", message[1:5])
         value, _pos = self._codec(schema_id).decode(message, 5)
         return value

@@ -177,3 +177,36 @@ def test_realtime_table_consumes_confluent_avro(registry, tmp_path):
         assert got == (len(rows), sum(r["v"] for r in rows))
     finally:
         kafka.stop()
+
+
+def test_decimal_logical_type_decodes():
+    import decimal
+    schema = {"type": "record", "name": "D", "fields": [
+        {"name": "amt", "type": {"type": "bytes", "logicalType": "decimal",
+                                 "precision": 10, "scale": 2}}]}
+    codec = AvroCodec(schema)
+    # unscaled 12345, scale 2 -> 123.45 (big-endian two's complement)
+    wire = codec.encode({"amt": (12345).to_bytes(2, "big")})
+    assert codec.decode(wire)[0]["amt"] == decimal.Decimal("123.45")
+
+
+def test_big_int_never_writes_invalid_int_branch():
+    codec = AvroCodec(["null", "int", "long"])
+    # 2^40 must take the long branch, not emit an oversized int varint
+    assert codec.decode(codec.encode(1 << 40))[0] == 1 << 40
+    with pytest.raises(AvroError, match="no union branch"):
+        AvroCodec(["null", "int"]).encode(1 << 40)
+
+
+def test_truncated_confluent_frame_is_avro_error():
+    from pinot_tpu.inputformat.avro import ConfluentAvroDecoder
+    dec = ConfluentAvroDecoder("http://127.0.0.1:1")
+    with pytest.raises(AvroError, match="truncated"):
+        dec(b"\x00\x01\x02")
+
+
+def test_truncated_primitives_raise_avro_error():
+    for schema, wire in (("double", b"\x01"), ("float", b""),
+                         ("boolean", b"")):
+        with pytest.raises(AvroError, match="truncated"):
+            AvroCodec(schema).decode(wire)
