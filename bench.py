@@ -390,6 +390,10 @@ def run_queries(qids) -> Tuple[dict, bool]:
             "ok": ok,
             "strategy": strategy,
             "groups": len(expected) if gcols else 0,
+            # raw seconds: the parent's geomeans must never run through
+            # 2-decimal rounding (a 0.00 speedup would log(0) -> crash)
+            "e2e_s": e2e_t,
+            "cpu_s": cpu_t,
             "kernel_ms": round(k_t * 1e3, 3) if k_t else None,
             "e2e_ms": round(e2e_t * 1e3, 2),
             "cpu_ms": round(cpu_t * 1e3, 1),
@@ -483,8 +487,13 @@ def main() -> None:
             json.dump({"backend": backend, "n_rows": N_ROWS,
                        "queries": detail}, fh)
 
-    rates = [d["rows_per_sec_e2e"] for d in detail.values()]
-    spds = [d["speedup_e2e"] for d in detail.values()]
+    rates = []
+    spds = []
+    for d in detail.values():
+        e2e_s = d.pop("e2e_s")
+        cpu_s = d.pop("cpu_s")
+        rates.append(max(N_ROWS / e2e_s, 1e-12))
+        spds.append(max(cpu_s / e2e_s, 1e-12))
     geo_rate = math.exp(sum(math.log(r) for r in rates)
                         / len(rates)) if rates else 0.0
     geo_speedup = math.exp(sum(math.log(s) for s in spds)

@@ -21,9 +21,10 @@ public specs — implemented here directly:
   in-process registry for tests (POST /subjects/{s}/versions assigns
   ids like the real service).
 
-logicalType handling: decimal decodes to decimal.Decimal (unscaled
-big-endian two's complement / 10^scale); date / time-* / timestamp-*
-/ uuid deliberately pass through as their underlying int/long/string —
+logicalType handling: decimal (bytes- OR fixed-backed) decodes to
+decimal.Decimal (unscaled big-endian two's complement / 10^scale) and
+Decimal values re-encode symmetrically; date / time-* / timestamp-* /
+uuid deliberately pass through as their underlying int/long/string —
 the ingestion pipeline consumes epoch numbers natively (dateTime field
 specs), so no datetime objects are fabricated.
 """
@@ -78,6 +79,27 @@ def _zigzag_decode(buf: bytes, pos: int) -> Tuple[int, int]:
 
 _PRIMITIVES = {"null", "boolean", "int", "long", "float", "double",
                "bytes", "string"}
+
+
+def _is_decimal_schema(s: Any) -> bool:
+    return isinstance(s, dict) and s.get("logicalType") == "decimal" \
+        and s.get("type") in ("bytes", "fixed")
+
+
+def _decimal_from_bytes(raw: bytes, s: Dict[str, Any]):
+    import decimal
+    unscaled = int.from_bytes(raw, "big", signed=True)
+    return decimal.Decimal(unscaled).scaleb(-int(s.get("scale", 0)))
+
+
+def _decimal_to_bytes(v, s: Dict[str, Any]) -> bytes:
+    import decimal
+    unscaled = int(decimal.Decimal(v).scaleb(int(s.get("scale", 0)))
+                   .to_integral_value())
+    if s.get("type") == "fixed":
+        return unscaled.to_bytes(s["size"], "big", signed=True)
+    n = max((unscaled.bit_length() + 8) // 8, 1)   # minimal two's compl.
+    return unscaled.to_bytes(n, "big", signed=True)
 
 
 def _type_name(schema: Any) -> str:
@@ -154,16 +176,15 @@ class AvroCodec:
                 raise AvroError("truncated bytes/string")
             if t == "bytes" and isinstance(s, dict) \
                     and s.get("logicalType") == "decimal":
-                import decimal
-                unscaled = int.from_bytes(raw, "big", signed=True)
-                return decimal.Decimal(unscaled).scaleb(
-                    -int(s.get("scale", 0))), pos + n
+                return _decimal_from_bytes(raw, s), pos + n
             return (raw.decode() if t == "string" else raw), pos + n
         if t == "fixed":
             n = s["size"]
             raw = buf[pos:pos + n]
             if len(raw) != n:
                 raise AvroError("truncated fixed")
+            if s.get("logicalType") == "decimal":
+                return _decimal_from_bytes(raw, s), pos + n
             return raw, pos + n
         if t == "enum":
             i, pos = _zigzag_decode(buf, pos)
@@ -220,7 +241,13 @@ class AvroCodec:
             return
         if t == "boolean":
             out.append(1 if v else 0)
-        elif t in ("int", "long"):
+        elif t == "int":
+            # encoder-level int32 bound (not just union matching): an
+            # out-of-range value must raise, never emit an invalid varint
+            if not -(1 << 31) <= int(v) < (1 << 31):
+                raise AvroError(f"value {v!r} out of int32 range")
+            out += _zigzag_encode(int(v))
+        elif t == "long":
             out += _zigzag_encode(int(v))
         elif t == "float":
             out += struct.pack("<f", float(v))
@@ -230,8 +257,14 @@ class AvroCodec:
             b = str(v).encode()
             out += _zigzag_encode(len(b)) + b
         elif t == "bytes":
+            if _is_decimal_schema(s) and not isinstance(v, (bytes,
+                                                            bytearray)):
+                v = _decimal_to_bytes(v, s)    # round-trippable decimals
             out += _zigzag_encode(len(v)) + bytes(v)
         elif t == "fixed":
+            if _is_decimal_schema(s) and not isinstance(v, (bytes,
+                                                            bytearray)):
+                v = _decimal_to_bytes(v, s)
             if len(v) != s["size"]:
                 raise AvroError("fixed size mismatch")
             out += bytes(v)
@@ -285,6 +318,9 @@ class AvroCodec:
         if t == "string":
             return isinstance(v, str)
         if t in ("bytes", "fixed"):
+            if _is_decimal_schema(self._resolve(s)):
+                import decimal
+                return isinstance(v, (bytes, bytearray, decimal.Decimal))
             return isinstance(v, (bytes, bytearray))
         if t == "record":
             return isinstance(v, dict)

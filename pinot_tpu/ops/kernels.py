@@ -1162,13 +1162,16 @@ def build_kernel(plan: KernelPlan, bucket: int,
                                 else default_slots_cap(total))
             _compact_group_aggs(plan, mask, cols, params, total, cap, out,
                                 platform, scatter)
-            if xfer_compact:
+            # scatter implies CPU execution, where the "transfer" the
+            # device-side live-group compaction optimizes is free — the
+            # nonzero over a big space only adds kernel time there
+            if xfer_compact and not scatter:
                 _compact_group_xfer(plan, out)
             return out
         out["matched"] = jnp.sum(mask, dtype=int_acc_dtype())
         if plan.is_group_by:
             _group_aggs(plan, mask, cols, params, total, out, scatter)
-            if xfer_compact:
+            if xfer_compact and not scatter:
                 _compact_group_xfer(plan, out)
         else:
             for i, spec in enumerate(plan.aggs):
@@ -1370,7 +1373,7 @@ def build_segmented_compact_kernel(plan: KernelPlan, bucket: int,
         _compact_group_aggs(plan2, masks.reshape(total), tuple(flat_cols),
                             vparams, total, cap, out, platform, scatter)
         out["matched"] = masks.sum(axis=1, dtype=int_acc_dtype())  # (S,)
-        if xfer_compact:
+        if xfer_compact and not scatter:
             # live-group gather over the combined S*space — the executor
             # splits segments host-side via group_idx // space
             _compact_group_xfer(plan2, out)

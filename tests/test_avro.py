@@ -210,3 +210,25 @@ def test_truncated_primitives_raise_avro_error():
                          ("boolean", b"")):
         with pytest.raises(AvroError, match="truncated"):
             AvroCodec(schema).decode(wire)
+
+
+def test_decimal_roundtrips_both_backings():
+    import decimal
+    for backing in ({"type": "bytes", "logicalType": "decimal",
+                     "scale": 2},
+                    {"type": "fixed", "name": "D8", "size": 8,
+                     "logicalType": "decimal", "scale": 2}):
+        codec = AvroCodec({"type": "record", "name": "R", "fields": [
+            {"name": "amt", "type": backing}]})
+        for v in (decimal.Decimal("123.45"), decimal.Decimal("-0.07")):
+            got = codec.decode(codec.encode({"amt": v}))[0]["amt"]
+            assert got == v, (backing["type"], v, got)
+        # unions accept Decimal too
+        u = AvroCodec(["null", dict(backing)])
+        assert u.decode(u.encode(decimal.Decimal("9.99")))[0] == \
+            decimal.Decimal("9.99")
+
+
+def test_plain_int_schema_rejects_out_of_range():
+    with pytest.raises(AvroError, match="int32"):
+        AvroCodec("int").encode(1 << 40)
