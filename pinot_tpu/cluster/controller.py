@@ -678,6 +678,83 @@ class Controller:
         return out
 
     # -- views -------------------------------------------------------------
+    # -- admin REST reads (pinot-controller/.../api/resources analog) -----
+    def admin_tables(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"tables": [
+                {"name": t, "replication": m.get("replication", 1),
+                 "segments": len(self._state["segments"].get(t, {})),
+                 "serverTenant": (m.get("config") or {})
+                 .get("serverTenant")}
+                for t, m in self._state["tables"].items()]}
+
+    def admin_table(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            m = self._state["tables"].get(name)
+            if m is None:
+                return None
+            return {"name": name, "schema": m["schema"],
+                    "config": m.get("config"),
+                    "replication": m.get("replication", 1),
+                    "segments": sorted(
+                        self._state["segments"].get(name, {})),
+                    "assignment": dict(
+                        self._state["assignment"].get(name, {})),
+                    "lineage": list(self._state["lineage"].get(name, []))}
+
+    def admin_segments(self, table: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            segs = self._state["segments"].get(table)
+            if segs is None:
+                return None
+            asn = self._state["assignment"].get(table, {})
+            return {"table": table, "segments": {
+                s: {"location": e.get("location"),
+                    "metadata": e.get("meta"),
+                    "servers": list(asn.get(s, []))}
+                for s, e in segs.items()}}
+
+    def admin_instances(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lock:
+            return {"instances": sorted(({
+                "id": i["id"], "role": i.get("role"),
+                "host": i.get("host"), "port": i.get("port"),
+                "tags": i.get("tags") or [],
+                "lastHeartbeatSecondsAgo":
+                    round(now - i["lastHeartbeat"], 1),
+                "live": now - i["lastHeartbeat"]
+                    <= self.heartbeat_timeout}
+                for i in self._instances.values()),
+                key=lambda x: x["id"])}
+
+    def admin_leadership(self) -> Dict[str, Any]:
+        return {"haEnabled": self.lease_ttl is not None,
+                "isLeader": self.is_leader,
+                "instanceId": self.instance_id,
+                "lease": self._read_lease()}
+
+    def _delete_segment_route(self, table: str, segment: str):
+        """Route adapter: unknown names are a routine 404, not a 500
+        (consistent with the GET admin endpoints)."""
+        try:
+            self.delete_segment(table, segment)
+        except KeyError as e:
+            return 404, {"error": str(e).strip("'")}
+        return 200, {"status": "OK"}
+
+    def delete_segment(self, table: str, segment: str) -> None:
+        """Admin segment drop: metadata + assignment + artifact
+        (PinotSegmentRestletResource delete analog)."""
+        with self._lock:
+            entry = self._state["segments"].get(table, {}).pop(segment,
+                                                               None)
+            if entry is None:
+                raise KeyError(f"unknown segment {table}/{segment}")
+            self._state["assignment"].get(table, {}).pop(segment, None)
+            self._bump()
+        self._delete_artifact(entry.get("location"))
+
     def ui_page(self) -> str:
         """Minimal cluster status page (GET /ui) — the controller web
         app's overview screens (pinot-controller/src/main/resources/app)
@@ -870,6 +947,24 @@ class Controller:
                             b.get("metadata")))),
                 ("GET", "/status"): lambda h, b: (
                     ctrl.run_status_check() or (200, ctrl._status)),
+                # admin REST reads (controller/api/resources analog)
+                ("GET", "/tables"): lambda h, b: (
+                    200, ctrl.admin_tables()),
+                ("GET", "/tables/"): lambda h, b: (
+                    (lambda t: (200, t) if t is not None else
+                     (404, {"error": "unknown table"}))(
+                        ctrl.admin_table(h.path.rsplit("/", 1)[1]))),
+                ("GET", "/segments/"): lambda h, b: (
+                    (lambda t: (200, t) if t is not None else
+                     (404, {"error": "unknown table"}))(
+                        ctrl.admin_segments(h.path.rsplit("/", 1)[1]))),
+                ("GET", "/instances"): lambda h, b: (
+                    200, ctrl.admin_instances()),
+                ("GET", "/leadership"): lambda h, b: (
+                    200, ctrl.admin_leadership()),
+                ("DELETE", "/segments/"): lambda h, b: (
+                    ctrl._delete_segment_route(
+                        *h.path.rstrip("/").rsplit("/", 2)[1:])),
             }
 
         Handler.routes = {k: (v if k[0] == "GET" else guard(v))

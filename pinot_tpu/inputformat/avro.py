@@ -94,10 +94,19 @@ def _decimal_from_bytes(raw: bytes, s: Dict[str, Any]):
 
 def _decimal_to_bytes(v, s: Dict[str, Any]) -> bytes:
     import decimal
-    unscaled = int(decimal.Decimal(v).scaleb(int(s.get("scale", 0)))
-                   .to_integral_value())
+    scaled = decimal.Decimal(v).scaleb(int(s.get("scale", 0)))
+    unscaled = int(scaled)
+    if unscaled != scaled:
+        # reference Avro writers reject scale mismatches; silently
+        # rounding would corrupt (monetary) values on ingest
+        raise AvroError(
+            f"decimal {v} does not fit scale {s.get('scale', 0)}")
     if s.get("type") == "fixed":
-        return unscaled.to_bytes(s["size"], "big", signed=True)
+        try:
+            return unscaled.to_bytes(s["size"], "big", signed=True)
+        except OverflowError:
+            raise AvroError(
+                f"decimal {v} overflows fixed size {s['size']}") from None
     n = max((unscaled.bit_length() + 8) // 8, 1)   # minimal two's compl.
     return unscaled.to_bytes(n, "big", signed=True)
 

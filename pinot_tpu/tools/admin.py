@@ -108,6 +108,33 @@ def cmd_post_query(args) -> int:
     return 0
 
 
+def cmd_list_tables(args) -> int:
+    """Admin REST reads (controller/api/resources analog, round-5)."""
+    import json as _json
+
+    from ..cluster.http_util import http_json
+    out = http_json("GET", f"{args.controller}/tables")
+    print(_json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_list_segments(args) -> int:
+    import json as _json
+
+    from ..cluster.http_util import http_json
+    out = http_json("GET", f"{args.controller}/segments/{args.table}")
+    print(_json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_delete_segment(args) -> int:
+    from ..cluster.http_util import http_json
+    http_json("DELETE",
+              f"{args.controller}/segments/{args.table}/{args.segment}")
+    print(f"deleted {args.table}/{args.segment}")
+    return 0
+
+
 def cmd_quickstart(args) -> int:
     from .quickstart import main
     main(keep_running=not args.exit_after, rows=args.rows)
@@ -266,6 +293,21 @@ def build_parser() -> argparse.ArgumentParser:
     rc.add_argument("--cardinalities")
     rc.add_argument("--rows", type=int, default=1_000_000)
     rc.set_defaults(fn=cmd_recommend)
+
+    lt = sub.add_parser("ListTables")
+    lt.add_argument("--controller", required=True)
+    lt.set_defaults(fn=cmd_list_tables)
+
+    ls = sub.add_parser("ListSegments")
+    ls.add_argument("--controller", required=True)
+    ls.add_argument("--table", required=True)
+    ls.set_defaults(fn=cmd_list_segments)
+
+    ds = sub.add_parser("DeleteSegment")
+    ds.add_argument("--controller", required=True)
+    ds.add_argument("--table", required=True)
+    ds.add_argument("--segment", required=True)
+    ds.set_defaults(fn=cmd_delete_segment)
     return p
 
 

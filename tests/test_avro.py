@@ -232,3 +232,14 @@ def test_decimal_roundtrips_both_backings():
 def test_plain_int_schema_rejects_out_of_range():
     with pytest.raises(AvroError, match="int32"):
         AvroCodec("int").encode(1 << 40)
+
+
+def test_decimal_scale_mismatch_and_overflow_are_avro_errors():
+    import decimal
+    c = AvroCodec({"type": "bytes", "logicalType": "decimal", "scale": 2})
+    with pytest.raises(AvroError, match="scale"):
+        c.encode(decimal.Decimal("1.234"))
+    cf = AvroCodec({"type": "fixed", "name": "D1", "size": 1,
+                    "logicalType": "decimal", "scale": 0})
+    with pytest.raises(AvroError, match="overflows"):
+        cf.encode(decimal.Decimal("300"))
