@@ -734,11 +734,15 @@ class Controller:
                 "instanceId": self.instance_id,
                 "lease": self._read_lease()}
 
-    def _delete_segment_route(self, table: str, segment: str):
-        """Route adapter: unknown names are a routine 404, not a 500
+    def _delete_segment_route(self, path: str):
+        """Route adapter for DELETE /segments/{table}/{segment}:
+        malformed paths and unknown names are routine 404s, never 500s
         (consistent with the GET admin endpoints)."""
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if len(parts) != 3 or parts[0] != "segments":
+            return 404, {"error": "expected /segments/{table}/{segment}"}
         try:
-            self.delete_segment(table, segment)
+            self.delete_segment(parts[1], parts[2])
         except KeyError as e:
             return 404, {"error": str(e).strip("'")}
         return 200, {"status": "OK"}
@@ -963,8 +967,7 @@ class Controller:
                 ("GET", "/leadership"): lambda h, b: (
                     200, ctrl.admin_leadership()),
                 ("DELETE", "/segments/"): lambda h, b: (
-                    ctrl._delete_segment_route(
-                        *h.path.rstrip("/").rsplit("/", 2)[1:])),
+                    ctrl._delete_segment_route(h.path)),
             }
 
         Handler.routes = {k: (v if k[0] == "GET" else guard(v))

@@ -301,13 +301,7 @@ def _scalar_state(b: AggBinding, out: Dict[str, np.ndarray], matched: int,
          "raw_theta": "distinct_count_theta",
          "percentile_raw_sketch": "percentile_sketch"}.get(k, k)
     if k == "distinct_count_hll":
-        from ..ops.aggregations import HllAgg
-        p = HllAgg(b.agg).log2m
-        r_levels = 64 - p + 1
-        pm = np.asarray(out[name + "_present"]).reshape(1 << p, r_levels)
-        ranks = np.arange(1, r_levels + 1, dtype=np.int64)
-        return np.where(pm.any(axis=1), (pm * ranks).max(axis=1),
-                        0).tolist()
+        return _hll_registers(out[name + "_present"], b)[0]
     if k == "distinct_count_theta":
         h = np.asarray(out[name + "_hashes"]).astype(np.uint64)
         sent = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -347,7 +341,24 @@ def _group_state(b: AggBinding, out: Dict[str, np.ndarray],
             ids = np.nonzero(row)[0]
             res.append(set(_py(v) for v in d.values_for(ids)))
         return res
+    if k in ("distinct_count_hll", "raw_hll"):
+        return _hll_registers(np.asarray(out[name + "_present"])[idxs], b)
     raise ValueError(k)
+
+
+def _hll_registers(pm: np.ndarray, b: AggBinding) -> List[List[int]]:
+    """(n?, m*R) presence bitmap(s) -> per-row HllAgg register lists,
+    vectorized across groups (one reshape + two reductions)."""
+    from ..ops.aggregations import HllAgg
+    p = HllAgg(b.agg).log2m
+    r_levels = 64 - p + 1
+    pm = np.asarray(pm)
+    if pm.ndim == 1:
+        pm = pm[None, :]
+    rr = pm.reshape(pm.shape[0], 1 << p, r_levels)
+    ranks = np.arange(1, r_levels + 1, dtype=np.int64)
+    regs = np.where(rr.any(axis=2), (rr * ranks).max(axis=2), 0)
+    return [row.tolist() for row in regs]
 
 
 def _kind(b: AggBinding) -> str:

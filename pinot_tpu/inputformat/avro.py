@@ -94,6 +94,11 @@ def _decimal_from_bytes(raw: bytes, s: Dict[str, Any]):
 
 def _decimal_to_bytes(v, s: Dict[str, Any]) -> bytes:
     import decimal
+    if isinstance(v, float):
+        # floats normalize through their shortest repr: 1.23 means the
+        # written "1.23" (fits scale 2), not its binary expansion
+        # 1.2299999999999999822... (which would reject every non-dyadic)
+        v = decimal.Decimal(str(v))
     scaled = decimal.Decimal(v).scaleb(int(s.get("scale", 0)))
     unscaled = int(scaled)
     if unscaled != scaled:

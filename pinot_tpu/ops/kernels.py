@@ -456,21 +456,29 @@ def _sorted_presence(comb: jax.Array, n_slots: int) -> jax.Array:
     return (edges[1:] - edges[:-1]) > 0
 
 
-def _scalar_hll(name: str, spec: AggSpec, mask, cols, params,
-                out: Dict[str, jax.Array]) -> None:
-    """DISTINCTCOUNTHLL: register index = top log2m hash bits, rank =
-    leading zeros of the remainder + 1 (sentinel bit bounds it), then a
-    (m * R) presence bitmap; extraction maxes over the rank axis to the
-    host HllAgg register list."""
+def _hll_slots(spec: AggSpec, cols, params):
+    """(slot, r_levels): register index = top log2m hash bits, rank =
+    leading zeros of the remainder + 1 (sentinel bit bounds it), slot =
+    idx * r_levels + (rank - 1). The single source of the device HLL
+    scheme (scalar + grouped); must stay bit-identical to the host
+    HllAgg._regs."""
     p = spec.card                    # log2m
     r_levels = 64 - p + 1
     h = _agg_hashes(spec, cols, params)
     idx = (h >> jnp.uint64(64 - p)).astype(jnp.int32)
     rest = (h << jnp.uint64(p)) | jnp.uint64(1 << (p - 1))
     rank = jax.lax.clz(rest).astype(jnp.int32) + 1   # 1 .. R
-    comb = jnp.where(mask, idx * r_levels + (rank - 1),
-                     (1 << p) * r_levels)
-    out[name + "_present"] = _sorted_presence(comb, (1 << p) * r_levels)
+    return idx * r_levels + (rank - 1), r_levels
+
+
+def _scalar_hll(name: str, spec: AggSpec, mask, cols, params,
+                out: Dict[str, jax.Array]) -> None:
+    """DISTINCTCOUNTHLL: (m * R) presence bitmap; extraction maxes over
+    the rank axis into the host HllAgg register list."""
+    slot, r_levels = _hll_slots(spec, cols, params)
+    n_slots = (1 << spec.card) * r_levels
+    comb = jnp.where(mask, slot, n_slots)
+    out[name + "_present"] = _sorted_presence(comb, n_slots)
 
 
 def _scalar_theta(name: str, spec: AggSpec, mask, cols, params,
@@ -525,6 +533,29 @@ _SKETCH_SCALAR = {"distinct_count_hll": _scalar_hll,
                   "raw_hll": _scalar_hll,
                   "raw_theta": _scalar_theta,
                   "percentile_raw_sketch": _scalar_percentile}
+
+_HLL_KINDS = ("distinct_count_hll", "raw_hll")
+
+# grouped HLL presence bitmap cap: space * 2^log2m * rank_levels slots
+# (bool). 2^23 = 8MB per aggregation — plenty for dashboard-shaped
+# group-bys; larger spaces keep the host registry.
+GROUPED_HLL_LIMIT = 1 << 23
+
+
+def _group_hll(name: str, spec: AggSpec, mask, keys_s, space: int, cols,
+               params, out: Dict[str, jax.Array]) -> None:
+    """Grouped DISTINCTCOUNTHLL on device (round-5): one combined key
+    (group, register, rank) presence bitmap via the scatter-free
+    sort+searchsorted shape. Output (space, m*R) bool rows merge across
+    segments/shards by elementwise OR; extraction maxes ranks per group
+    into host HllAgg register lists."""
+    slot, r_levels = _hll_slots(spec, cols, params)
+    m = 1 << spec.card
+    comb = jnp.where(mask & (keys_s < space),
+                     keys_s * (m * r_levels) + slot,
+                     space * m * r_levels)
+    pres = _sorted_presence(comb, space * m * r_levels)
+    out[name + "_present"] = pres.reshape(space, m * r_levels)
 
 
 # ---------------------------------------------------------------------------
@@ -622,6 +653,10 @@ def _scatter_group(plan: KernelPlan, mask, keys_s, cols, params, space: int,
         name = _agg_name(i, spec)
         if spec.kind == "count":
             continue
+        if spec.kind in _HLL_KINDS:
+            # the grouped HLL presence shape is backend-agnostic
+            _group_hll(name, spec, mask, keys_s, space, cols, params, out)
+            continue
         if spec.kind == "distinct_count":
             ids = _eval_value(spec.value, cols, params)
             comb = jnp.where(
@@ -677,6 +712,9 @@ def _group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
         kind = spec.kind
         if kind == "count":
             continue  # served by the shared count row
+        if kind in _HLL_KINDS:
+            deferred.append((i, spec, "hll"))
+            continue
         if kind in ("sum", "avg") and spec.integral:
             vals = _eval_value(spec.value, cols, params, promote=True)
             rows, signs, b = _limb_rows(vals, mask, spec.bits, spec.signed,
@@ -732,6 +770,8 @@ def _group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
                 out[name + "_cnt"] = counts
             else:
                 out[name] = row
+        elif how == "hll":
+            _group_hll(name, spec, mask, keys_s, space, cols, params, out)
         elif how == "minmax":
             _group_minmax(i, spec, mask, keys_s, space, cols, params, out)
         elif how == "distinct":

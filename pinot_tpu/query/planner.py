@@ -964,7 +964,11 @@ class SegmentPlanner:
         Partial states match ops/aggregations' host AggImpl formats, so
         kernel and host partials merge interchangeably at the broker.
         Scalar plans only — grouped sketches keep the host registry."""
-        if self.ctx.is_group_by:
+        if self.ctx.is_group_by and agg.kind not in ("distinct_count_hll",
+                                                     "raw_hll"):
+            # grouped HLL has a device lowering (presence bitmap, OR-
+            # mergeable); theta/percentile group states keep the host
+            # registry
             raise PlanError("grouped sketch aggregations use the host "
                             "registry")
         if not isinstance(agg.arg, Identifier):
@@ -1352,6 +1356,12 @@ class SegmentPlanner:
                 if s.kind == "distinct_count" and s.card is not None \
                         and space * s.card > MAX_DISTINCT_MATRIX:
                     dense_viable = False
+                if s.kind in ("distinct_count_hll", "raw_hll"):
+                    from ..ops.kernels import GROUPED_HLL_LIMIT
+                    r_levels = 64 - s.card + 1
+                    if space * (1 << s.card) * r_levels \
+                            > GROUPED_HLL_LIMIT:
+                        return CompiledPlan("host", seg, ctx)
                 if s.kind in ("min", "max") and slow_scatter and space > 64:
                     # no matmul form for min/max; TPU scatter is
                     # pathological (kernels.MINMAX_UNROLL_GROUPS)

@@ -176,10 +176,11 @@ def test_raw_forms_share_device_kernels(broker):
     assert _plan_kind(broker, sql).kind == "kernel"
 
 
-def test_grouped_sketches_stay_host(broker):
-    plan = _plan_kind(
-        broker, "SELECT sel, DISTINCTCOUNTHLL(s) FROM t GROUP BY sel")
-    assert plan.kind == "host"
+def test_grouped_theta_percentile_stay_host(broker):
+    for agg in ("DISTINCTCOUNTTHETASKETCH(s)", "PERCENTILEKLL(rawf, 50)"):
+        plan = _plan_kind(
+            broker, f"SELECT sel, {agg} FROM t GROUP BY sel")
+        assert plan.kind == "host", agg
 
 
 def test_empty_result_sketches(broker):
@@ -206,3 +207,44 @@ def test_fuzz_hll_theta_random_filters(broker, data):
             sql = f"SELECT {agg} FROM t {where}"
             assert broker.query(sql).rows[0][0] == \
                 _host(broker, sql).rows[0][0], (agg, where)
+
+
+class TestGroupedHll:
+    """Grouped DISTINCTCOUNTHLL on device (round-5): (space, m*R)
+    presence bitmaps, OR-mergeable across segments, bit-identical to
+    the host registry."""
+
+    def test_plans_kernel_and_matches_host(self, broker, data):
+        sql = ("SELECT sel, DISTINCTCOUNTHLL(s, 8) FROM t GROUP BY sel "
+               "ORDER BY sel LIMIT 1000")
+        plan = _plan_kind(broker, sql)
+        assert plan.kind == "kernel"
+        dev = broker.query(sql).rows
+        host = _host(broker, sql).rows
+        assert dev == host and len(dev) == 100
+
+    def test_multi_segment_or_merge(self, broker, data):
+        # the fixture's two segments force a presence-bitmap OR merge
+        sql = ("SELECT sel, DISTINCTCOUNTHLL(k, 8), COUNT(*) FROM t "
+               "WHERE sel < 10 GROUP BY sel ORDER BY sel LIMIT 1000")
+        assert _plan_kind(broker, sql).kind == "kernel"
+        assert broker.query(sql).rows == _host(broker, sql).rows
+
+    def test_over_limit_space_stays_host(self, broker):
+        # default log2m=12: space 100 * 4096 * 53 slots > GROUPED_HLL_LIMIT
+        plan = _plan_kind(
+            broker, "SELECT sel, DISTINCTCOUNTHLL(s) FROM t GROUP BY sel")
+        assert plan.kind == "host"
+
+    def test_grouped_raw_hll_roundtrip(self, broker):
+        from pinot_tpu.ops.sketches import deserialize_sketch
+        sql = ("SELECT sel, DISTINCTCOUNTRAWHLL(s, 8), "
+               "DISTINCTCOUNTHLL(s, 8) FROM t WHERE sel < 5 "
+               "GROUP BY sel ORDER BY sel LIMIT 10")
+        assert _plan_kind(broker, sql).kind == "kernel"
+        from pinot_tpu.ops.aggregations import HllAgg
+        from pinot_tpu.query.context import AggExpr
+        agg = AggExpr("distinct_count_hll", None, "x", None, (8,))
+        for row in broker.query(sql).rows:
+            assert HllAgg(agg).finalize(deserialize_sketch(row[1])) \
+                == row[2]
