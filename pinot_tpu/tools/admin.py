@@ -35,7 +35,8 @@ def _wait_forever(label: str, url: str) -> None:
 
 def cmd_start_controller(args) -> int:
     from ..cluster import Controller
-    c = Controller(args.data_dir, port=args.port)
+    c = Controller(args.data_dir, port=args.port,
+                   lease_ttl=args.lease_ttl, instance_id=args.id)
     try:
         _wait_forever("controller", c.url)
     finally:
@@ -233,6 +234,11 @@ def build_parser() -> argparse.ArgumentParser:
     sc = sub.add_parser("StartController")
     sc.add_argument("--data-dir", required=True)
     sc.add_argument("--port", type=int, default=0)
+    sc.add_argument("--lease-ttl", type=float, default=None,
+                    help="enable HA leadership: controllers sharing "
+                    "--data-dir contend for the file lease")
+    sc.add_argument("--id", default=None,
+                    help="controller instance id (HA observability)")
     sc.set_defaults(fn=cmd_start_controller)
 
     ss = sub.add_parser("StartServer")
