@@ -966,6 +966,13 @@ class Controller:
                     200, ctrl.admin_instances()),
                 ("GET", "/leadership"): lambda h, b: (
                     200, ctrl.admin_leadership()),
+                # readiness for HA deployments: 200 only on the lease
+                # holder, so a k8s Service readiness probe routes
+                # clients to the leader (deploy/k8s.yaml)
+                ("GET", "/health/leader"): lambda h, b: (
+                    (200, {"status": "LEADER"})
+                    if ctrl.lease_ttl is None or ctrl.is_leader
+                    else (503, {"status": "STANDBY"})),
                 ("DELETE", "/segments/"): lambda h, b: (
                     ctrl._delete_segment_route(h.path)),
             }

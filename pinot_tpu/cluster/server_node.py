@@ -33,10 +33,18 @@ class ServerNode:
     def __init__(self, instance_id: str, controller_url: str, port: int = 0,
                  poll_interval: float = 0.3,
                  scheduler_config: Optional[Dict[str, Any]] = None,
-                 tags: Optional[List[str]] = None):
+                 tags: Optional[List[str]] = None,
+                 advertise_host: Optional[str] = None):
+        import os as _os
         self.instance_id = instance_id
         self.controller_url = controller_url
         self.poll_interval = poll_interval
+        # the host OTHER nodes dial (containers/k8s must advertise their
+        # service-reachable name, not loopback); env override for
+        # image-based deployments (deploy/)
+        self.advertise_host = (advertise_host
+                               or _os.environ.get("PINOT_ADVERTISE_HOST")
+                               or "127.0.0.1")
         self.tags = list(tags or [])  # tenant tags (Helix instance tags)
         import tempfile
         # local segment store for deep-store downloads (tar.gz locations)
@@ -69,15 +77,26 @@ class ServerNode:
         self._assignment_version = -1
         self._stop = threading.Event()
         self._httpd, self.port, _ = start_http(self._make_handler(), port)
-        self._register()
+        self._register(retries=20)   # ~1min of startup tolerance
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     # -- control plane -----------------------------------------------------
-    def _register(self) -> None:
-        http_json("POST", f"{self.controller_url}/instances", {
-            "id": self.instance_id, "host": "127.0.0.1",
-            "port": self.port, "role": "server", "tags": self.tags})
+    def _register(self, retries: int = 0) -> None:
+        """retries > 0: tolerate startup transients — an HA standby's
+        503, a not-yet-scheduled controller — with linear backoff (the
+        crash-looping alternative is what k8s would otherwise do)."""
+        for attempt in range(retries + 1):
+            try:
+                http_json("POST", f"{self.controller_url}/instances", {
+                    "id": self.instance_id, "host": self.advertise_host,
+                    "port": self.port, "role": "server",
+                    "tags": self.tags})
+                return
+            except Exception:
+                if attempt == retries:
+                    raise
+                time.sleep(min(0.5 * (attempt + 1), 5.0))
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_interval):

@@ -308,23 +308,67 @@ def _eval_scalar(e: Any, env: Dict[str, Any]) -> Any:
 
 
 def _eval_scalar_bool(e: Any, env: Dict[str, Any]) -> bool:
+    """HAVING acceptance: only TRUE passes (SQL three-valued logic —
+    a NULL aggregate, e.g. SUM over all-null inputs under
+    enableNullHandling, makes the predicate NULL, which filters the
+    group instead of raising; round-5 fuzz seed 777/166)."""
+    return _bool3(e, env) is True
+
+
+def _bool3(e: Any, env: Dict[str, Any]) -> Optional[bool]:
+    """True / False / None (UNKNOWN), Kleene semantics."""
     if isinstance(e, BoolAnd):
-        return all(_eval_scalar_bool(c, env) for c in e.children)
+        saw_null = False
+        for c in e.children:          # short-circuits on False
+            v = _bool3(c, env)
+            if v is False:
+                return False
+            saw_null = saw_null or v is None
+        return None if saw_null else True
     if isinstance(e, BoolOr):
-        return any(_eval_scalar_bool(c, env) for c in e.children)
+        saw_null = False
+        for c in e.children:          # short-circuits on True
+            v = _bool3(c, env)
+            if v is True:
+                return True
+            saw_null = saw_null or v is None
+        return None if saw_null else False
     if isinstance(e, BoolNot):
-        return not _eval_scalar_bool(e.child, env)
+        v = _bool3(e.child, env)
+        return None if v is None else not v
     if isinstance(e, Comparison):
         l = _eval_scalar(e.lhs, env)
         r = _eval_scalar(e.rhs, env)
-        return {"==": l == r, "!=": l != r, "<": l < r,
-                "<=": l <= r, ">": l > r, ">=": l >= r}[e.op]
+        if l is None or r is None:
+            return None
+        try:                          # dispatch per op: == must never
+            if e.op == "==":          # evaluate an ordering comparison
+                return l == r
+            if e.op == "!=":
+                return l != r
+            if e.op == "<":
+                return l < r
+            if e.op == "<=":
+                return l <= r
+            if e.op == ">":
+                return l > r
+            return l >= r
+        except TypeError:
+            raise SqlError(
+                f"cannot compare {type(l).__name__} with "
+                f"{type(r).__name__} in HAVING ({e.op})") from None
     if isinstance(e, Between):
         v = _eval_scalar(e.expr, env)
-        ok = _eval_scalar(e.lo, env) <= v <= _eval_scalar(e.hi, env)
+        lo = _eval_scalar(e.lo, env)
+        hi = _eval_scalar(e.hi, env)
+        if v is None or lo is None or hi is None:
+            return None
+        ok = lo <= v <= hi
         return not ok if e.negated else ok
     if isinstance(e, InList):
         v = _eval_scalar(e.expr, env)
+        if v is None:
+            return None
         ok = v in {x.value for x in e.values}
         return not ok if e.negated else ok
     if isinstance(e, IsNull):
@@ -332,5 +376,6 @@ def _eval_scalar_bool(e: Any, env: Dict[str, Any]) -> bool:
         isnull = v is None or (isinstance(v, float) and v != v)
         return not isnull if e.negated else isnull
     if isinstance(e, (FuncCall, Literal, CaseWhen, Cast)):
-        return bool(_eval_scalar(e, env))
+        v = _eval_scalar(e, env)
+        return None if v is None else bool(v)
     raise SqlError(f"unsupported HAVING expression {e!r}")

@@ -44,10 +44,14 @@ def cmd_start_controller(args) -> int:
     return 0
 
 
+def _advertise(args):
+    return getattr(args, "advertise_host", None)
+
+
 def cmd_start_server(args) -> int:
     from ..cluster import ServerNode
     s = ServerNode(args.id, args.controller, port=args.port,
-                   tags=args.tag or [])
+                   tags=args.tag or [], advertise_host=_advertise(args))
     try:
         _wait_forever(f"server {args.id}", s.url)
     finally:
@@ -246,6 +250,9 @@ def build_parser() -> argparse.ArgumentParser:
     ss.add_argument("--id", required=True)
     ss.add_argument("--port", type=int, default=0)
     ss.add_argument("--tag", action="append")
+    ss.add_argument("--advertise-host", default=None,
+                    help="host other nodes dial (container/service "
+                    "name; default 127.0.0.1 or PINOT_ADVERTISE_HOST)")
     ss.set_defaults(fn=cmd_start_server)
 
     sb = sub.add_parser("StartBroker")

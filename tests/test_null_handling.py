@@ -182,3 +182,43 @@ class TestDeviceNullPlans:
     def test_all_null_sum_is_null_on_kernel_path(self, broker):
         res = broker.query("SELECT SUM(v) FROM nt WHERE v IS NULL" + NH)
         assert res.rows[0][0] is None
+
+
+def test_null_aggregate_in_having_filters_not_raises(tmp_path):
+    """SQL 3VL in HAVING (round-5 fuzz seed 777/166): a group whose
+    SUM is NULL (all-null inputs under enableNullHandling) makes the
+    predicate UNKNOWN — the group is filtered, never a TypeError; and
+    IS NULL / NOT over UNKNOWN keep Kleene semantics."""
+    import numpy as np
+
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    rows = [{"g": "a", "v": 1}, {"g": "a", "v": 2},
+            {"g": "b", "v": None}, {"g": "b", "v": None},
+            {"g": "c", "v": 5}]
+    cols = {"g": np.array([r["g"] for r in rows]),
+            "v": np.array([r["v"] if r["v"] is not None else None
+                           for r in rows], dtype=object)}
+    schema = Schema("nh", [
+        FieldSpec("g", DataType.STRING),
+        FieldSpec("v", DataType.INT, FieldType.METRIC)])
+    d = SegmentBuilder(schema, TableConfig("nh")).build(
+        cols, str(tmp_path), "s0")
+    dm = TableDataManager("nh")
+    dm.add_segment_dir(d)
+    b = Broker()
+    b.register_table(dm)
+    opt = " OPTION(enableNullHandling=true,timeoutMs=300000)"
+    got = b.query("SELECT g, SUM(v) FROM nh GROUP BY g "
+                  "HAVING SUM(v) > 1 ORDER BY g" + opt).rows
+    assert got == [("a", 3), ("c", 5)]       # b's NULL sum filtered
+    got = b.query("SELECT g, SUM(v) FROM nh GROUP BY g "
+                  "HAVING NOT SUM(v) > 1 ORDER BY g" + opt).rows
+    assert got == []                          # NOT UNKNOWN is UNKNOWN
+    got = b.query("SELECT g FROM nh GROUP BY g "
+                  "HAVING SUM(v) IS NULL ORDER BY g" + opt).rows
+    assert got == [("b",)]
