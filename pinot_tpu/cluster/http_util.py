@@ -83,7 +83,13 @@ class JsonHandler(BaseHTTPRequestHandler):
 
 def start_http(handler_cls, port: int = 0) -> Tuple[ThreadingHTTPServer,
                                                     int, threading.Thread]:
-    srv = ThreadingHTTPServer(("127.0.0.1", port), handler_cls)
+    """Bind host: loopback by default (in-process clusters, tests);
+    containerized deployments set PINOT_BIND_HOST=0.0.0.0 so the
+    advertised service names are actually reachable across containers
+    (deploy/)."""
+    import os
+    host = os.environ.get("PINOT_BIND_HOST", "127.0.0.1")
+    srv = ThreadingHTTPServer((host, port), handler_cls)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1], t

@@ -35,7 +35,6 @@ class ServerNode:
                  scheduler_config: Optional[Dict[str, Any]] = None,
                  tags: Optional[List[str]] = None,
                  advertise_host: Optional[str] = None):
-        import os as _os
         self.instance_id = instance_id
         self.controller_url = controller_url
         self.poll_interval = poll_interval
@@ -43,7 +42,7 @@ class ServerNode:
         # service-reachable name, not loopback); env override for
         # image-based deployments (deploy/)
         self.advertise_host = (advertise_host
-                               or _os.environ.get("PINOT_ADVERTISE_HOST")
+                               or os.environ.get("PINOT_ADVERTISE_HOST")
                                or "127.0.0.1")
         self.tags = list(tags or [])  # tenant tags (Helix instance tags)
         import tempfile

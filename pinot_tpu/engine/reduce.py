@@ -315,6 +315,13 @@ def _eval_scalar_bool(e: Any, env: Dict[str, Any]) -> bool:
     return _bool3(e, env) is True
 
 
+def _nullish(v: Any) -> bool:
+    """NULL in either representation: None, or float NaN (what a null
+    aggregate finalizes to on some paths — the same definition the
+    IS NULL branch uses, so 3VL is consistent across predicates)."""
+    return v is None or (isinstance(v, float) and v != v)
+
+
 def _bool3(e: Any, env: Dict[str, Any]) -> Optional[bool]:
     """True / False / None (UNKNOWN), Kleene semantics."""
     if isinstance(e, BoolAnd):
@@ -339,7 +346,7 @@ def _bool3(e: Any, env: Dict[str, Any]) -> Optional[bool]:
     if isinstance(e, Comparison):
         l = _eval_scalar(e.lhs, env)
         r = _eval_scalar(e.rhs, env)
-        if l is None or r is None:
+        if _nullish(l) or _nullish(r):
             return None
         try:                          # dispatch per op: == must never
             if e.op == "==":          # evaluate an ordering comparison
@@ -361,19 +368,19 @@ def _bool3(e: Any, env: Dict[str, Any]) -> Optional[bool]:
         v = _eval_scalar(e.expr, env)
         lo = _eval_scalar(e.lo, env)
         hi = _eval_scalar(e.hi, env)
-        if v is None or lo is None or hi is None:
+        if _nullish(v) or _nullish(lo) or _nullish(hi):
             return None
         ok = lo <= v <= hi
         return not ok if e.negated else ok
     if isinstance(e, InList):
         v = _eval_scalar(e.expr, env)
-        if v is None:
+        if _nullish(v):
             return None
         ok = v in {x.value for x in e.values}
         return not ok if e.negated else ok
     if isinstance(e, IsNull):
         v = _eval_scalar(e.expr, env)
-        isnull = v is None or (isinstance(v, float) and v != v)
+        isnull = _nullish(v)
         return not isnull if e.negated else isnull
     if isinstance(e, (FuncCall, Literal, CaseWhen, Cast)):
         v = _eval_scalar(e, env)

@@ -97,6 +97,7 @@ class MultiStageExecutor:
             t.label: self._table_schema(t.name) for t in self.tables}
         self.mailboxes = MailboxService()
         self.join_backends: List[str] = []  # one entry per executed join
+        self.dynamic_filters: List[str] = []  # semi-join pushdowns applied
 
     def _table_schema(self, name: str):
         dm = self.broker.table(name)
@@ -288,6 +289,30 @@ class MultiStageExecutor:
             rest.append(conj)
         return equi, rest
 
+    # dynamic filter (the reference's pipeline-breaker / dynamic
+    # broadcast: runtime/plan/pipeline/, PinotJoinToDynamicBroadcastRule
+    # analog): when the already-materialized side of a join is small,
+    # its distinct join keys push a semi-join IN filter into the other
+    # leaf's SCAN, so the probe side never materializes rows that
+    # cannot match. Safe for INNER and LEFT joins (the filtered side is
+    # not preserved there); RIGHT/FULL preserve the scanned side.
+    DYNAMIC_FILTER_MAX_BUILD = 50_000
+    DYNAMIC_FILTER_MAX_KEYS = 10_000
+
+    def _dynamic_filter(self, j, equi, current: Relation):
+        if j.join_type not in ("inner", "left") or len(equi) != 1:
+            return None
+        if not 0 < current.n_rows <= self.DYNAMIC_FILTER_MAX_BUILD:
+            return None
+        lref, rref = equi[0]
+        vals = [v for v in dict.fromkeys(current.raw_values(lref).tolist())
+                if v is not None and v == v]    # drop null keys (no match)
+        if not 0 < len(vals) <= self.DYNAMIC_FILTER_MAX_KEYS:
+            return None
+        self.dynamic_filters.append(f"{rref} IN <{len(vals)} keys>")
+        return InList(Identifier(rref),
+                      tuple(Literal(v) for v in vals), False)
+
     def _join(self, left: Relation, right: Relation,
               lkeys: List[str], rkeys: List[str], how: str,
               query_id: str, stage: int) -> Relation:
@@ -360,9 +385,12 @@ class MultiStageExecutor:
             else self.plan_join_order(pushed)[0]
         for si, j in enumerate(ordered_joins):
             label = j.table.label
-            right = self.leaf_scan(j.table, needed[label],
-                                   _and(pushed[label]))
-            equi, rest = self._split_on(j.on, joined_labels, label)
+            equi, rest = (self._split_on(j.on, joined_labels, label)
+                          if j.on is not None else ([], []))
+            dyn = self._dynamic_filter(j, equi, current)
+            right = self.leaf_scan(
+                j.table, needed[label],
+                _and(pushed[label] + ([dyn] if dyn is not None else [])))
             if j.join_type == "cross" or not equi:
                 if j.join_type != "cross":
                     raise SqlError(

@@ -44,14 +44,11 @@ def cmd_start_controller(args) -> int:
     return 0
 
 
-def _advertise(args):
-    return getattr(args, "advertise_host", None)
-
-
 def cmd_start_server(args) -> int:
     from ..cluster import ServerNode
     s = ServerNode(args.id, args.controller, port=args.port,
-                   tags=args.tag or [], advertise_host=_advertise(args))
+                   tags=args.tag or [],
+                   advertise_host=args.advertise_host)
     try:
         _wait_forever(f"server {args.id}", s.url)
     finally:

@@ -222,3 +222,16 @@ def test_null_aggregate_in_having_filters_not_raises(tmp_path):
     got = b.query("SELECT g FROM nh GROUP BY g "
                   "HAVING SUM(v) IS NULL ORDER BY g" + opt).rows
     assert got == [("b",)]
+
+
+def test_nan_aggregate_in_having_is_null_3vl():
+    """NaN is the other NULL representation (reduce._nullish): a NaN
+    aggregate makes HAVING predicates UNKNOWN — NOT(NaN > 1) must not
+    resurrect the group (review r5)."""
+    from pinot_tpu.engine.reduce import _bool3
+    from pinot_tpu.query.sql import parse_sql
+    having = parse_sql(
+        "SELECT g FROM t GROUP BY g HAVING NOT x > 1").having
+    assert _bool3(having, {"x": float("nan")}) is None
+    assert _bool3(having, {"x": 0.5}) is True
+    assert _bool3(having, {"x": 2.0}) is False
