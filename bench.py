@@ -466,9 +466,18 @@ def main() -> None:
     detail: dict = {}
     errors: dict = {}
     all_ok = True
+    consecutive_timeouts = 0
     for qid, _p, _v, _g in QUERIES:
+        if consecutive_timeouts >= 2:
+            # circuit breaker: a backend that wedged mid-capture would
+            # otherwise burn (queries x retries x timeout) hours; stop
+            # spending and ship what was captured
+            errors[qid] = "skipped after consecutive backend timeouts"
+            all_ok = False
+            continue
         res = err = None
-        for attempt in range(WORKER_RETRIES + 1):
+        retries = WORKER_RETRIES if consecutive_timeouts == 0 else 0
+        for attempt in range(retries + 1):
             res, err = _run_worker([qid], WORKER_TIMEOUT)
             if res is not None:
                 break
@@ -477,7 +486,13 @@ def main() -> None:
         if res is None:
             errors[qid] = err
             all_ok = False
+            if "timed out" in str(err):
+                consecutive_timeouts += 1
+            else:
+                consecutive_timeouts = 0  # a fast failure means the
+                # backend answered: only genuinely consecutive hangs trip
             continue
+        consecutive_timeouts = 0
         detail.update(res["queries"])
         all_ok = all_ok and res["ok"]
         # persist PROGRESS immediately (VERDICT r4 next-step #1a): a

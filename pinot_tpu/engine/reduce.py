@@ -384,5 +384,5 @@ def _bool3(e: Any, env: Dict[str, Any]) -> Optional[bool]:
         return not isnull if e.negated else isnull
     if isinstance(e, (FuncCall, Literal, CaseWhen, Cast)):
         v = _eval_scalar(e, env)
-        return None if v is None else bool(v)
+        return None if _nullish(v) else bool(v)
     raise SqlError(f"unsupported HAVING expression {e!r}")

@@ -385,8 +385,7 @@ class MultiStageExecutor:
             else self.plan_join_order(pushed)[0]
         for si, j in enumerate(ordered_joins):
             label = j.table.label
-            equi, rest = (self._split_on(j.on, joined_labels, label)
-                          if j.on is not None else ([], []))
+            equi, rest = self._split_on(j.on, joined_labels, label)
             dyn = self._dynamic_filter(j, equi, current)
             right = self.leaf_scan(
                 j.table, needed[label],
