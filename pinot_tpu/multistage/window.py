@@ -282,6 +282,125 @@ def compute_window(rel, wf: WindowFunc) -> np.ndarray:
     return unsorted
 
 
+def _value_frame_positions(rel, wf: WindowFunc, sidx, pos, part,
+                           new_part, part_start, part_ids):
+    """Explicit-frame window bounds for FIRST_VALUE/LAST_VALUE, or None
+    for the default frame (whole partition / peer semantics). Covers
+    both ROWS row offsets and RANGE value offsets."""
+    frame = wf.spec.frame
+    if frame is None:
+        return None
+    mode, lo, hi = frame
+    part_end = _ends_from_starts(new_part)
+    if lo is None and hi is None:     # whole partition, either mode
+        return part_start, part_end, np.zeros(len(pos), dtype=bool)
+    if mode == "range":
+        if lo is None and hi == 0:
+            return None               # default running-frame semantics
+        return _range_positions(rel, wf, sidx, new_part, part_start,
+                                part_ids, lo, hi)
+    lo_pos = part_start if lo is None \
+        else np.clip(pos + lo, part_start, part_end + 1)
+    hi_pos = part_end if hi is None \
+        else np.clip(pos + hi, part_start - 1, part_end)
+    return lo_pos, hi_pos, hi_pos < lo_pos
+
+
+def _range_positions(rel, wf: WindowFunc, sidx, new_part, part_start,
+                     part_ids, lo, hi):
+    """-> (lo_pos, hi_pos, empty) window bounds for a RANGE value-offset
+    frame: the window of row i is every partition row whose ORDER BY
+    key lies in [v_i + lo, v_i + hi] (direction-normalized, so DESC
+    works via the sign flip). ONE global searchsorted via the partition
+    banding trick (keys are sorted within partitions; shifting each
+    partition into a disjoint band keeps the array globally sorted) —
+    no per-partition Python loops."""
+    ob = wf.spec.order_by
+    if len(ob) != 1:
+        raise SqlError("RANGE offset frames need exactly one ORDER BY "
+                       "key")
+    v = np.asarray(host_eval.eval_value(ob[0].expr, rel))
+    if v.dtype.kind not in "iuf":
+        raise SqlError("RANGE offset frames need a numeric ORDER BY key")
+    v = v.astype(np.float64)[sidx]
+    if np.isnan(v).any():
+        raise SqlError("RANGE offset frames need non-null ORDER BY keys")
+    u = v if ob[0].ascending else -v
+    part_end = _ends_from_starts(new_part)
+    off = max(abs(float(lo)) if lo is not None else 0.0,
+              abs(float(hi)) if hi is not None else 0.0)
+    span = float(u.max() - u.min()) + off + 1.0
+    ub = (u - u.min()) + part_ids * span
+    lo_pos = (np.searchsorted(ub, ub + float(lo), side="left")
+              if lo is not None else part_start)
+    hi_pos = (np.searchsorted(ub, ub + float(hi), side="right") - 1
+              if hi is not None else part_end)
+    return lo_pos, hi_pos, hi_pos < lo_pos
+
+
+def _range_frame(rel, wf: WindowFunc, acc: np.ndarray, sidx,
+                 new_part, part_start, part_ids, lo, hi) -> np.ndarray:
+    """RANGE value-offset aggregate frames (reference:
+    pinot-query-runtime/.../operator/window/ range operators):
+    SUM/COUNT/AVG by prefix-sum differences, MIN/MAX by a sparse-table
+    (prefix-doubling) range query. Empty windows follow SQL: COUNT 0,
+    everything else NULL."""
+    fname = wf.func.name
+    lo_pos, hi_pos, empty = _range_positions(
+        rel, wf, sidx, new_part, part_start, part_ids, lo, hi)
+    n = len(acc)
+
+    if fname in ("sum", "count", "avg"):
+        P = _seg_cumsum(acc.astype(np.float64), part_start)
+        Pm1 = np.where(lo_pos > part_start,
+                       P[np.maximum(lo_pos - 1, 0)], 0.0)
+        total = np.where(empty, 0.0,
+                         P[np.minimum(np.maximum(hi_pos, 0), n - 1)] - Pm1)
+        if fname == "count":
+            return total.astype(np.int64)        # empty window counts 0
+        if fname == "avg":
+            cnt = np.where(empty, 1, hi_pos - lo_pos + 1)
+            return np.where(empty, np.nan, total / cnt)
+        if np.any(empty):                        # SUM over empty is NULL
+            return np.where(empty, np.nan, total)
+        return total.astype(np.int64) if acc.dtype.kind in "iu" \
+            else total
+    # sliding min/max over monotone-but-variable-width windows
+    out = _sparse_range_minmax(acc.astype(np.float64), lo_pos, hi_pos,
+                               fname == "max")
+    out = np.where(empty, np.nan, out)
+    return out.astype(acc.dtype) if acc.dtype.kind in "iu" \
+        and not np.any(empty) else out
+
+
+def _sparse_range_minmax(a: np.ndarray, lo_pos, hi_pos,
+                         is_max: bool) -> np.ndarray:
+    """O(n log n) prefix-doubling table; each [lo, hi] query is the
+    reduction of two overlapping power-of-two blocks."""
+    n = len(a)
+    op = np.maximum if is_max else np.minimum
+    table = [a]
+    j = 1
+    while (1 << j) <= n:
+        prev = table[-1]
+        half = 1 << (j - 1)
+        length = n - (1 << j) + 1
+        table.append(op(prev[:length], prev[half:half + length]))
+        j += 1
+    width = hi_pos - lo_pos + 1
+    out = np.empty(n, dtype=a.dtype)
+    valid = width > 0
+    if valid.any():
+        k = np.zeros(n, dtype=np.int64)
+        k[valid] = np.floor(np.log2(width[valid])).astype(np.int64)
+        for lvl in np.unique(k[valid]):
+            m = valid & (k == lvl)
+            t = table[lvl]
+            out[m] = op(t[lo_pos[m]],
+                        t[hi_pos[m] - (1 << lvl) + 1])
+    return out
+
+
 def _device_window_min_rows() -> int:
     import os
     return int(os.environ.get("PINOT_DEVICE_WINDOW_MIN_ROWS", 200_000))
@@ -417,11 +536,24 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
         out[:] = fill
         out[valid] = v[src[valid]]
         return out
-    if name == "first_value":
+    if name in ("first_value", "last_value"):
         v = _arg_value(rel, wf, sidx)
-        return v[part_start]
-    if name == "last_value":
-        v = _arg_value(rel, wf, sidx)
+        fpos = _value_frame_positions(rel, wf, sidx, pos, part, new_part,
+                                      part_start, part_ids)
+        if fpos is not None:
+            # explicit frame: the framed first/last row's value (was
+            # silently the partition start/end before round-5)
+            lo_pos, hi_pos, empty = fpos
+            src = lo_pos if name == "first_value" else hi_pos
+            out = v[np.clip(src, 0, n - 1)].astype(np.float64) \
+                if v.dtype.kind in "iuf" else v[np.clip(src, 0, n - 1)]
+            if v.dtype.kind in "iuf":
+                return np.where(empty, np.nan, out)
+            out = out.astype(object)
+            out[empty] = None
+            return out
+        if name == "first_value":
+            return v[part_start]
         if wf.spec.order_by and wf.spec.frame is None:
             return v[_ends_from_starts(new_peer)]  # end of peer group
         return v[_ends_from_starts(new_part)]
@@ -452,6 +584,19 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
     frame = wf.spec.frame
     if frame is None and not wf.spec.order_by:
         frame = ("rows", None, None)          # whole partition
+    if frame is not None and frame[0] == "range":
+        if not wf.spec.order_by:
+            raise SqlError("RANGE frames require ORDER BY in the OVER "
+                           "clause")
+        if frame[1] is None and frame[2] == 0:
+            # explicit RANGE UNBOUNDED PRECEDING..CURRENT ROW is the
+            # default frame — peer-aware, unlike a ROWS 0 bound
+            frame = None
+        elif frame[1] is None and frame[2] is None:
+            frame = ("rows", None, None)      # whole partition
+        else:
+            return _range_frame(rel, wf, acc, sidx, new_part,
+                                part_start, part_ids, frame[1], frame[2])
     if frame is None:
         # RANGE UNBOUNDED PRECEDING..CURRENT ROW incl. peers
         peer_end = _ends_from_starts(new_peer)
@@ -497,9 +642,13 @@ def _compute_sorted(rel, wf: WindowFunc, sidx, pos, part, new_part,
                      part_ids)
         Pm1 = np.where(lo_pos > part_start, P[np.maximum(lo_pos - 1, 0)], 0.0)
         total = np.where(empty, 0.0, P[np.minimum(hi_pos, len(P) - 1)] - Pm1)
+        if name == "count":
+            return total.astype(np.int64)    # empty window counts 0
         if name == "avg":
             cnt = np.where(empty, 1, hi_pos - lo_pos + 1)
             return np.where(empty, np.nan, total / cnt)
+        if np.any(empty):                    # SQL: SUM over empty is NULL
+            return np.where(empty, np.nan, total)
         return total.astype(np.int64) if acc.dtype.kind in "iu" else total
     # sliding min/max
     if lo is None:                      # prefix up to hi_pos

@@ -789,7 +789,11 @@ class _Parser:
                 ctx_ident("row")
                 return 0
             if t.kind == "number":
-                n = int(self.next().value)
+                raw = self.next().value
+                # ROWS offsets are row counts (ints); RANGE offsets are
+                # values on the ORDER BY key and may be fractional
+                n = (float(raw) if mode == "range" and "." in str(raw)
+                     else int(raw))
                 side = ctx_ident("preceding", "following")
                 return -n if side == "preceding" else n
             raise SqlError(f"expected frame bound at {t.pos}")
@@ -800,9 +804,6 @@ class _Parser:
             hi = bound(False)
         else:
             lo, hi = bound(True), 0
-        if mode == "range" and not (lo is None and hi == 0):
-            raise SqlError("RANGE frames support only "
-                           "UNBOUNDED PRECEDING AND CURRENT ROW")
         return (mode, lo, hi)
 
     def case_expr(self) -> CaseWhen:

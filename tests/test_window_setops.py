@@ -51,10 +51,13 @@ class TestParser:
         assert isinstance(s, SetOpStmt) and s.op == "union"
         assert isinstance(s.right, SetOpStmt) and s.right.op == "intersect"
 
-    def test_range_frame_restricted(self):
-        with pytest.raises(SqlError):
-            parse_sql("SELECT SUM(x) OVER (ORDER BY y RANGE BETWEEN "
+    def test_range_frame_ast(self):
+        s = parse_sql("SELECT SUM(x) OVER (ORDER BY y RANGE BETWEEN "
                       "2 PRECEDING AND CURRENT ROW) FROM t")
+        assert s.select[0].expr.spec.frame == ("range", -2, 0)
+        s2 = parse_sql("SELECT SUM(x) OVER (ORDER BY y RANGE BETWEEN "
+                      "0.5 PRECEDING AND 1.5 FOLLOWING) FROM t")
+        assert s2.select[0].expr.spec.frame == ("range", -0.5, 1.5)
 
     def test_rank_requires_order(self, broker):
         with pytest.raises(SqlError):
@@ -418,3 +421,141 @@ class TestFramedWindowFuzz:
         for h, d in zip(host, dev):
             assert h[:2] == d[:2]
             assert d[2] == pytest.approx(h[2], rel=1e-12)
+
+
+class TestRangeValueFrames:
+    """RANGE value-offset frames (round-5): window = peer-partition
+    rows whose ORDER BY key lies in [v+lo, v+hi]. Oracle-diffed for
+    SUM/COUNT/AVG/MIN/MAX, ASC and DESC, plus peer semantics of the
+    explicit UNBOUNDED..CURRENT form."""
+
+    @pytest.fixture(scope="class")
+    def rbroker(self, tmp_path_factory):
+        rng = np.random.default_rng(55)
+        n = 300
+        out = str(tmp_path_factory.mktemp("rangewin"))
+        schema = Schema("rw", [
+            FieldSpec("part", DataType.STRING, FieldType.DIMENSION),
+            FieldSpec("ok", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC),
+        ])
+        cols = {
+            "part": np.array([f"p{i}" for i in rng.integers(0, 4, n)]),
+            "ok": rng.integers(0, 60, n).astype(np.int32),  # with ties
+            "v": rng.integers(-100, 100, n).astype(np.int32),
+        }
+        d = SegmentBuilder(schema, TableConfig("rw")).build(cols, out,
+                                                           "s0")
+        dm = TableDataManager("rw")
+        dm.add_segment(ImmutableSegment.load(d))
+        b = Broker()
+        b.register_table(dm)
+        return b, cols
+
+    @staticmethod
+    def _oracle(cols, fn, lo, hi, asc=True):
+        n = len(cols["v"])
+        out = {}
+        for i in range(n):
+            vi = int(cols["ok"][i])
+            window = [int(cols["v"][j]) for j in range(n)
+                      if cols["part"][j] == cols["part"][i]
+                      and (lo is None or
+                           (vi - int(cols["ok"][j]) <= -lo if asc
+                            else int(cols["ok"][j]) - vi <= -lo))
+                      and (hi is None or
+                           (int(cols["ok"][j]) - vi <= hi if asc
+                            else vi - int(cols["ok"][j]) <= hi))]
+            out[i] = fn(window) if window else None
+        return out
+
+    @pytest.mark.parametrize("agg,red", [("SUM", sum), ("COUNT", len),
+                                         ("MIN", min), ("MAX", max)])
+    def test_range_offsets_vs_oracle(self, rbroker, agg, red):
+        b, cols = rbroker
+        arg = "*" if agg == "COUNT" else "v"
+        sql = (f"SELECT part, ok, v, {agg}({arg}) OVER (PARTITION BY "
+               "part ORDER BY ok RANGE BETWEEN 5 PRECEDING AND "
+               "3 FOLLOWING) AS w FROM rw LIMIT 100000"
+               " OPTION(timeoutMs=300000)")
+        rows = b.query(sql).rows
+        exp = self._oracle(cols, red, -5, 3)
+        # align by (part, ok, v) multisets per window value
+        got = sorted((r[0], r[1], r[2], r[3]) for r in rows)
+        want = sorted((cols["part"][i], int(cols["ok"][i]),
+                       int(cols["v"][i]), exp[i])
+                      for i in range(len(cols["v"])))
+        assert got == want
+
+    def test_range_desc_direction(self, rbroker):
+        b, cols = rbroker
+        sql = ("SELECT part, ok, v, SUM(v) OVER (PARTITION BY part "
+               "ORDER BY ok DESC RANGE BETWEEN 4 PRECEDING AND "
+               "CURRENT ROW) AS w FROM rw LIMIT 100000"
+               " OPTION(timeoutMs=300000)")
+        rows = b.query(sql).rows
+        exp = self._oracle(cols, sum, -4, 0, asc=False)
+        got = sorted((r[0], r[1], r[2], r[3]) for r in rows)
+        want = sorted((cols["part"][i], int(cols["ok"][i]),
+                       int(cols["v"][i]), exp[i])
+                      for i in range(len(cols["v"])))
+        assert got == want
+
+    def test_explicit_range_current_row_includes_peers(self, rbroker):
+        b, cols = rbroker
+        sql = ("SELECT part, ok, SUM(v) OVER (PARTITION BY part "
+               "ORDER BY ok RANGE BETWEEN UNBOUNDED PRECEDING AND "
+               "CURRENT ROW) AS w FROM rw LIMIT 100000"
+               " OPTION(timeoutMs=300000)")
+        rows = b.query(sql).rows
+        # peers (tied ok) must share the same running value
+        seen = {}
+        for part, ok, w in rows:
+            seen.setdefault((part, ok), set()).add(w)
+        assert all(len(s) == 1 for s in seen.values())
+
+
+class TestFramedValueFunctions:
+    """FIRST_VALUE/LAST_VALUE honor explicit frames (round-5 review:
+    frames were silently ignored, returning partition start/end)."""
+
+    def test_first_last_with_rows_frame(self, broker):
+        r = broker.query(
+            "SELECT salary, "
+            "FIRST_VALUE(salary) OVER (ORDER BY salary ROWS BETWEEN "
+            "1 PRECEDING AND CURRENT ROW) AS f, "
+            "LAST_VALUE(salary) OVER (ORDER BY salary ROWS BETWEEN "
+            "CURRENT ROW AND 1 FOLLOWING) AS l "
+            "FROM emp ORDER BY salary")
+        sal = [50, 75, 100, 150, 200, 300]
+        for i, (s, f, l) in enumerate(r.rows):
+            assert f == sal[max(i - 1, 0)]
+            assert l == sal[min(i + 1, len(sal) - 1)]
+
+    def test_first_value_with_range_frame(self, broker):
+        # reproduce the review scenario shape: framed first over values
+        r = broker.query(
+            "SELECT salary, FIRST_VALUE(salary) OVER (ORDER BY salary "
+            "RANGE BETWEEN 50 PRECEDING AND CURRENT ROW) AS f "
+            "FROM emp ORDER BY salary")
+        # salaries 50,75,100,150,200,300; window = [v-50, v]
+        assert [row[1] for row in r.rows] == [50, 50, 50, 100, 150, 300]
+
+    def test_empty_frame_value_and_sum_are_null(self, broker):
+        r = broker.query(
+            "SELECT salary, "
+            "FIRST_VALUE(salary) OVER (ORDER BY salary ROWS BETWEEN "
+            "3 FOLLOWING AND 5 FOLLOWING) AS f, "
+            "SUM(salary) OVER (ORDER BY salary ROWS BETWEEN "
+            "3 FOLLOWING AND 5 FOLLOWING) AS s, "
+            "COUNT(*) OVER (ORDER BY salary ROWS BETWEEN "
+            "3 FOLLOWING AND 5 FOLLOWING) AS c "
+            "FROM emp ORDER BY salary")
+        # last 3 rows have EMPTY windows: value/sum NULL(NaN), count 0
+        import math
+        for i, (s, f, sm, c) in enumerate(r.rows):
+            if i >= 3:
+                assert (f is None or math.isnan(f)) and \
+                    (sm is None or math.isnan(sm)) and c == 0
+            else:
+                assert c >= 1
