@@ -71,7 +71,9 @@ class _QueryParser:
     Adjacent units with no operator combine with OR (Lucene default)."""
 
     def __init__(self, q: str):
-        self.toks = re.findall(r"\(|\)|\"[^\"]*\"|[^\s()]+", q)
+        # regex tokens allow backslash-escaped slashes (Lucene /a\/b/)
+        self.toks = re.findall(
+            r"\(|\)|\"[^\"]*\"|/(?:\\.|[^/\\])*/|[^\s()]+", q)
         self.i = 0
 
     def peek(self):
@@ -116,6 +118,19 @@ class _QueryParser:
         self.i += 1
         if t.startswith('"'):
             return ("phrase", tokenize(t.strip('"')))
+        if len(t) >= 2 and t.startswith("/") and t.endswith("/"):
+            # Lucene RegexpQuery: /pattern/ full-matches vocabulary
+            # terms; \/ unescapes. Matching is case-insensitive (the
+            # vocabulary is lowercased at build, so a verbatim-cased
+            # pattern would silently miss everything) — IGNORECASE, not
+            # pattern lowercasing, which would corrupt classes like \W.
+            return ("regex", t[1:-1].replace("\\/", "/"))
+        m = re.fullmatch(r"(.+?)~(\d?)", t)
+        if m:
+            # Lucene FuzzyQuery: term~ / term~N (max edit distance,
+            # default 2 like Lucene)
+            return ("fuzzy", m.group(1).lower(),
+                    int(m.group(2)) if m.group(2) else 2)
         return ("term", t.lower())
 
 
@@ -194,10 +209,57 @@ class TextIndexReader:
         mask[np.unique(cand // span)] = True
         return mask
 
+    def _regex_mask(self, pattern: str, n_docs: int) -> np.ndarray:
+        """Lucene RegexpQuery analog: the pattern full-matches terms of
+        the sorted vocabulary; matching terms' postings OR together.
+        Where Lucene compiles the regex to an automaton intersected
+        with the FST, the vocabulary here is small enough that a direct
+        vectorized scan is the honest TPU-host form."""
+        try:
+            rx = re.compile(pattern, re.IGNORECASE)
+        except re.error as e:
+            raise ValueError(f"bad TEXT_MATCH regex {pattern!r}: {e}")
+        keys = [i for i, t in enumerate(self.terms) if rx.fullmatch(t)]
+        return self.postings.mask_for(keys, n_docs)
+
+    def _fuzzy_keys(self, term: str, max_edits: int) -> List[int]:
+        """Vocabulary terms within Levenshtein distance max_edits:
+        one vectorized DP over the (pre-filtered by length) term list —
+        the FuzzyQuery Levenshtein-automaton role."""
+        lens = np.array([len(t) for t in self.terms])
+        cand = np.nonzero(np.abs(lens - len(term)) <= max_edits)[0]
+        if len(cand) == 0:
+            return []
+        maxlen = int(lens[cand].max())
+        # (n_cand, maxlen) code-point matrix, -1 padded
+        mat = np.full((len(cand), maxlen), -1, dtype=np.int32)
+        for r, i in enumerate(cand):
+            t = self.terms[i]
+            mat[r, :len(t)] = [ord(c) for c in t]
+        q = np.array([ord(c) for c in term], dtype=np.int32)
+        # DP rows vectorized across candidates
+        prev = np.broadcast_to(np.arange(maxlen + 1, dtype=np.int32),
+                               (len(cand), maxlen + 1)).copy()
+        for qi in range(1, len(term) + 1):
+            cur = np.empty_like(prev)
+            cur[:, 0] = qi
+            sub = prev[:, :-1] + (mat != q[qi - 1])
+            for j in range(1, maxlen + 1):
+                cur[:, j] = np.minimum(np.minimum(
+                    cur[:, j - 1] + 1, prev[:, j] + 1), sub[:, j - 1])
+            prev = cur
+        dist = prev[np.arange(len(cand)), lens[cand]]
+        return [int(cand[r]) for r in np.nonzero(dist <= max_edits)[0]]
+
     def _eval(self, node, n_docs: int) -> np.ndarray:
         kind = node[0]
         if kind == "term":
             return self._term_mask(node[1], n_docs)
+        if kind == "regex":
+            return self._regex_mask(node[1], n_docs)
+        if kind == "fuzzy":
+            return self.postings.mask_for(
+                self._fuzzy_keys(node[1], node[2]), n_docs)
         if kind == "phrase":
             return self._phrase_mask(node[1], n_docs)
         if kind == "and":

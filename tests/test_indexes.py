@@ -328,3 +328,61 @@ def test_text_phrase_positions_and_prefix(tmp_path):
     # infix wildcard still scans
     assert r.match("*rownie", 5).tolist() == \
         [False, False, False, True, False]
+
+
+class TestTextRegexFuzzy:
+    """Lucene RegexpQuery / FuzzyQuery analogs (round-5): /pattern/
+    full-matches vocabulary terms; term~N matches within Levenshtein
+    distance N (default 2), vocab-scan standing in for the automaton."""
+
+    @pytest.fixture(scope="class")
+    def tbroker(self, tmp_path_factory):
+        docs = np.array([
+            "quick brown fox", "the quack of ducks", "quilt patterns",
+            "slow green turtle", "brown bread baking", "foxes and quirks",
+        ])
+        schema = Schema("tx", [
+            FieldSpec("doc", DataType.STRING, FieldType.DIMENSION),
+            FieldSpec("i", DataType.INT, FieldType.METRIC)])
+        cfg = TableConfig("tx", indexing=IndexingConfig(
+            text_index_columns=["doc"]))
+        out = tmp_path_factory.mktemp("textrx")
+        d = SegmentBuilder(schema, cfg).build(
+            {"doc": docs, "i": np.arange(6, dtype=np.int32)},
+            str(out), "s0")
+        dm = TableDataManager("tx")
+        dm.add_segment_dir(d)
+        b = Broker()
+        b.register_table(dm)
+        return b
+
+    def _ids(self, b, q):
+        return sorted(r[0] for r in b.query(
+            f"SELECT i FROM tx WHERE TEXT_MATCH(doc, '{q}') "
+            "LIMIT 100").rows)
+
+    def test_regex_term_query(self, tbroker):
+        assert self._ids(tbroker, "/qu.ck/") == [0, 1]      # quick,quack
+        assert self._ids(tbroker, "/fox(es)?/") == [0, 5]
+        assert self._ids(tbroker, "/b.*n/") == [0, 4]       # brown
+
+    def test_fuzzy_query(self, tbroker):
+        assert self._ids(tbroker, "quick~1") == [0, 1]      # quick,quack
+        assert self._ids(tbroker, "quick~") == [0, 1, 2, 5]  # +quilt,quirks? 
+        assert self._ids(tbroker, "turtle~0") == [3]
+
+    def test_regex_composes_with_boolean(self, tbroker):
+        assert self._ids(tbroker, "/qu.*/ AND brown") == [0]
+        assert self._ids(tbroker, "NOT /.*o.*/") == [2]
+
+    def test_bad_regex_is_clear_error(self, tbroker):
+        with pytest.raises(Exception, match="regex"):
+            self._ids(tbroker, "/[unclosed/")
+
+    def test_regex_case_insensitive_and_slash_escape(self, tbroker):
+        # vocab is lowercased at build: cased patterns must still match
+        assert self._ids(tbroker, "/Brown/") == [0, 4]
+        assert self._ids(tbroker, "/FOX(ES)?/") == [0, 5]
+        # \/ escapes a slash inside the pattern (no vocab term has one:
+        # empty result, NOT a tokenizer/compile error)
+        assert self._ids(tbroker, "/a\\/b/") == []
