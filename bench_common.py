@@ -44,15 +44,23 @@ def _force_cpu() -> bool:
     return os.environ.get("PINOT_BENCH_FORCE_CPU") == "1"
 
 
-def probe_backend(timeout: float = PROBE_TIMEOUT) -> tuple[str | None, str]:
+# set when require_backend degraded to the forced-CPU fallback: the
+# bench attaches it to its output so the capture is self-describing
+LAST_OUTAGE: dict | None = None
+
+
+def probe_backend(timeout: float = PROBE_TIMEOUT,
+                  pin_cpu: bool = False) -> tuple[str | None, str]:
     """Ask a subprocess which jax backend initializes.
 
     Returns (backend_name, detail). backend_name is None when init
     failed or timed out — the subprocess boundary is what makes the
-    timeout enforceable against a wedged device tunnel.
+    timeout enforceable against a wedged device tunnel. pin_cpu forces
+    the cpu backend via jax.config BEFORE any init (the only override
+    sitecustomize respects), never touching the tunnel.
     """
     pin = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
-           if _force_cpu() else "import jax; ")
+           if pin_cpu or _force_cpu() else "import jax; ")
     code = pin + "print(jax.default_backend(), len(jax.devices()))"
     try:
         proc = subprocess.run(
@@ -97,6 +105,26 @@ def require_backend(metric: str) -> str:
             break
         if i < PROBE_RETRIES:
             time.sleep(PROBE_SLEEP)
+    if backend is None and os.environ.get("PINOT_BENCH_ALLOW_CPU") != "0":
+        # round-5: the device tunnel was wedged for entire rounds 3 and
+        # 4, leaving those rounds with NO number at all. Last resort: a
+        # forced-CPU capture (jax.config pins cpu before any backend
+        # init, so the wedged tunnel is never touched) with the outage
+        # recorded in the output — a degraded, self-describing number
+        # beats a lost round.
+        cpu_backend, detail = probe_backend(pin_cpu=True)
+        print(f"  cpu-fallback probe: {detail}", file=sys.stderr)
+        if cpu_backend == "cpu":
+            global LAST_OUTAGE
+            LAST_OUTAGE = {"error": "tpu_backend_outage",
+                           "attempts": attempts,
+                           "detail": "captured on the forced-CPU "
+                                     "fallback backend"}
+            os.environ["PINOT_BENCH_FORCE_CPU"] = "1"  # workers pin cpu
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            return "cpu"
     if backend is None:
         print(json.dumps({
             "metric": metric, "value": 0, "unit": "rows/s",
@@ -217,6 +245,10 @@ def finish(out: dict, backend: str, all_ok: bool) -> None:
               if d.get("vs_baseline") is not None else
               "  deltas vs last capture recorded", file=sys.stderr)
     out["backend"] = backend
+    if LAST_OUTAGE is not None:
+        # the forced-CPU fallback must be self-describing in EVERY
+        # bench's output and ledger entry, not just bench.py's
+        out["tpu_outage"] = LAST_OUTAGE
     ledger_append(out, backend, ok=all_ok)
     if not all_ok:
         # keep a more specific error (capture failures) when present

@@ -71,9 +71,11 @@ class _QueryParser:
     Adjacent units with no operator combine with OR (Lucene default)."""
 
     def __init__(self, q: str):
-        # regex tokens allow backslash-escaped slashes (Lucene /a\/b/)
+        # regex tokens allow backslash-escaped slashes (Lucene /a\/b/);
+        # the closing / must END the token, so a path-like literal
+        # ('/foo/bar') stays ONE term instead of regex-plus-term
         self.toks = re.findall(
-            r"\(|\)|\"[^\"]*\"|/(?:\\.|[^/\\])*/|[^\s()]+", q)
+            r"\(|\)|\"[^\"]*\"|/(?:\\.|[^/\\])*/(?=[\s()]|$)|[^\s()]+", q)
         self.i = 0
 
     def peek(self):
@@ -125,12 +127,16 @@ class _QueryParser:
             # pattern would silently miss everything) — IGNORECASE, not
             # pattern lowercasing, which would corrupt classes like \W.
             return ("regex", t[1:-1].replace("\\/", "/"))
-        m = re.fullmatch(r"(.+?)~(\d?)", t)
+        m = re.fullmatch(r"(.+?)~(\d*)", t)
         if m:
             # Lucene FuzzyQuery: term~ / term~N (max edit distance,
-            # default 2 like Lucene)
-            return ("fuzzy", m.group(1).lower(),
-                    int(m.group(2)) if m.group(2) else 2)
+            # default 2; >2 is a parse error like Lucene — never a
+            # silent literal-term lookup)
+            edits = int(m.group(2)) if m.group(2) else 2
+            if edits > 2:
+                raise ValueError(
+                    f"fuzzy edit distance {edits} > 2 in {t!r}")
+            return ("fuzzy", m.group(1).lower(), edits)
         return ("term", t.lower())
 
 

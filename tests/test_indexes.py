@@ -386,3 +386,10 @@ class TestTextRegexFuzzy:
         # \/ escapes a slash inside the pattern (no vocab term has one:
         # empty result, NOT a tokenizer/compile error)
         assert self._ids(tbroker, "/a\\/b/") == []
+
+    def test_fuzzy_syntax_edges(self, tbroker):
+        import pytest as _pt
+        with _pt.raises(Exception, match="edit distance"):
+            self._ids(tbroker, "quick~10")
+        # path-like literal stays ONE term (not regex OR term)
+        assert self._ids(tbroker, "/foo/bar") == []
