@@ -7,6 +7,8 @@ operators.
 """
 import json
 
+import re
+
 import numpy as np
 import pytest
 
@@ -447,7 +449,7 @@ class TestIndexScale:
         opt = " OPTION(timeoutMs=300000)"
         got = b.query("SELECT COUNT(*) FROM big WHERE "
                       "TEXT_MATCH(doc, '/w123.[05]/')" + opt).rows[0][0]
-        rx = __import__("re").compile(r"w123.[05]")
+        rx = re.compile(r"w123.[05]")
         exp = sum(any(rx.fullmatch(t) for t in d.split()) for d in docs)
         assert got == exp > 0
         # fuzzy ~1 on an 18k vocab: w00100 matches w00100/w0010x/...
