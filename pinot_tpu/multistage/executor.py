@@ -521,6 +521,7 @@ def explain_multistage(broker, stmt: SelectStmt) -> ResultTable:
         label = j.table.label
         equi, rest = ex._split_on(
             j.on, {t.label for t in ex.tables if t.label != label}, label)
+        dyn = False
         if j.join_type == "cross":
             parent = emit(f"CROSS_JOIN(est_rows:{step['estRows']})",
                           parent)
@@ -528,13 +529,21 @@ def explain_multistage(broker, stmt: SelectStmt) -> ResultTable:
             backend = device_join.predict_backend(
                 probe_est, step["rightRows"], j.join_type,
                 BROADCAST_THRESHOLD)
+            # dynamic semi-join filter prediction (the runtime decides
+            # on ACTUAL materialized rows; the estimate mirrors
+            # _dynamic_filter's gates so EXPLAIN shows the plan intent)
+            dyn = (j.join_type in ("inner", "left") and len(equi) == 1
+                   and 0 < probe_est
+                   <= MultiStageExecutor.DYNAMIC_FILTER_MAX_BUILD)
             parent = emit(
                 f"HASH_JOIN({j.join_type.upper()},keys:{len(equi)},"
                 f"non_equi:{len(rest)},est_rows:{step['estRows']},"
                 f"backend:{backend})", parent)
         emit(f"LEAF_SCAN({label},cols:{len(needed[label])},"
              f"pushed_filters:{len(pushed[label])},"
-             f"est_rows:{round(ex._table_row_est[label])})", parent)
+             + (f"dynamic_filter:{equi[0][1]}," if j.join_type != "cross"
+                and dyn else "")
+             + f"est_rows:{round(ex._table_row_est[label])})", parent)
     base = ex.tables[0].label
     emit(f"LEAF_SCAN({base},cols:{len(needed[base])},"
          f"pushed_filters:{len(pushed[base])},"

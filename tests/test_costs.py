@@ -154,3 +154,11 @@ def test_explain_shows_estimates(cluster):
     ops = [r[0] for r in res.rows]
     assert any("est_rows" in op and "HASH_JOIN" in op for op in ops)
     assert any("LEAF_SCAN" in op and "est_rows" in op for op in ops)
+
+
+def test_explain_shows_dynamic_filter(cluster):
+    r = cluster.query(
+        "EXPLAIN PLAN FOR SELECT COUNT(*) FROM items JOIN facts "
+        "ON items.item_id = facts.item_id")
+    scans = [row[0] for row in r.rows if row[0].startswith("LEAF_SCAN")]
+    assert any("dynamic_filter:" in s for s in scans), scans
