@@ -351,7 +351,10 @@ def _range_frame(rel, wf: WindowFunc, acc: np.ndarray, sidx,
     n = len(acc)
 
     if fname in ("sum", "count", "avg"):
-        P = _seg_cumsum(acc.astype(np.float64), part_start)
+        # the prefix sums ride the device associative_scan above the
+        # row threshold, like every other framed aggregate
+        P = _seg_run("sum", acc.astype(np.float64), new_part, part_start,
+                     part_ids)
         Pm1 = np.where(lo_pos > part_start,
                        P[np.maximum(lo_pos - 1, 0)], 0.0)
         total = np.where(empty, 0.0,

@@ -559,3 +559,12 @@ class TestFramedValueFunctions:
                     (sm is None or math.isnan(sm)) and c == 0
             else:
                 assert c >= 1
+
+def test_range_frame_device_matches_host(broker, monkeypatch):
+    sql = ("SELECT dept, salary, SUM(salary) OVER (PARTITION BY dept "
+           "ORDER BY salary RANGE BETWEEN 100 PRECEDING AND "
+           "50 FOLLOWING) AS w FROM emp ORDER BY dept, salary")
+    monkeypatch.setenv("PINOT_DEVICE_WINDOW_MIN_ROWS", str(1 << 30))
+    host = broker.query(sql).rows
+    monkeypatch.setenv("PINOT_DEVICE_WINDOW_MIN_ROWS", "0")
+    assert broker.query(sql).rows == host
