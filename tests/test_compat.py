@@ -62,3 +62,40 @@ def test_golden_segment_loads_and_answers():
     for case in fixture["queries"]:
         rows = [list(r) for r in b.query(case["sql"]).rows]
         assert rows == case["rows"], case["sql"]
+
+
+def test_golden_wire_formats_decode():
+    """Wire blobs written by a previous incarnation (committed under
+    tests/resources/golden/) must keep decoding: the PREL relation
+    codec, the StagePlan proto, and a full mailbox frame — the
+    rolling-upgrade wire-stability gate alongside the on-disk one."""
+    from pinot_tpu.engine.datablock import decode_relation
+    from pinot_tpu.multistage.dispatch import (decode_stage_plan,
+                                               deliver_mailbox_frame)
+    from pinot_tpu.multistage.exchange import MailboxService
+
+    with open(os.path.join(GOLDEN, "wire_expected.json")) as fh:
+        exp = json.load(fh)
+
+    rel = decode_relation(
+        open(os.path.join(GOLDEN, "relation.prel.bin"), "rb").read())
+    assert sorted(rel.data) == exp["relation"]["columns"]
+    assert rel.n_rows == exp["relation"]["n_rows"]
+    assert int(rel.data["t.v"].sum()) == exp["relation"]["v_sum"]
+    assert rel.nulls["t.k"].tolist() == [False, False, False, True]
+
+    plan = decode_stage_plan(
+        open(os.path.join(GOLDEN, "stageplan.pb.bin"), "rb").read())
+    assert plan["queryId"] == exp["stageplan"]["queryId"]
+    assert plan["sql"] == exp["stageplan"]["sql"]
+    assert plan["exchange"]["targets"] == [{"url": "http://h:1",
+                                           "worker": 0}]
+
+    svc = MailboxService()
+    deliver_mailbox_frame(svc, open(
+        os.path.join(GOLDEN, "mailbox.frame.bin"), "rb").read())
+    from pinot_tpu.multistage.dispatch import encode_mailbox_frame
+    deliver_mailbox_frame(svc, encode_mailbox_frame("golden-q", 1, 0,
+                                                    None))  # EOS
+    blocks = svc.mailbox("golden-q", 1, 0).drain(5.0, n_eos=1)
+    assert len(blocks) == 1 and blocks[0].n_rows == 4
