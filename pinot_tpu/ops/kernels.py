@@ -902,20 +902,17 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
     Outputs are the same dense (space,) arrays as the dense strategy, so
     extraction and broker reduce are strategy-agnostic.
 
-    scatter=True (CPU execution, cpu_scatter_default): skip compaction
-    entirely — one segment-op pass over all rows with sentinel keys is the
-    fastest CPU form and removes the overflow/retry machinery from the
-    trace (overflow is emitted as a constant 0).
+    scatter=True (CPU execution, cpu_scatter_default): the aggregation
+    core after compaction is jax.ops.segment_* instead of the
+    factorized/sorted MXU shapes. Compaction still runs first — the
+    XLA nonzero fallback is cheap on CPU and at low selectivity it
+    shrinks the scatter's input by orders of magnitude (134M-row SSB:
+    q2.x kernels went seconds -> sub-second when the scatter stopped
+    touching unmatched rows).
     """
     from .compact import compact
 
     space = plan.group_space
-    if scatter:
-        mask, keys_s = _group_keys_sentinel(plan, mask, cols, params)
-        out["overflow"] = jnp.zeros((), dtype=jnp.int32)
-        out["matched"] = jnp.sum(mask, dtype=int_acc_dtype())
-        _scatter_group(plan, mask, keys_s, cols, params, space, out)
-        return
     needed = sorted({ci for ci, _ in plan.group_keys}
                     | set().union(*[_value_col_indices(s.value)
                                     for s in plan.aggs if s.value is not None]
@@ -932,7 +929,9 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
 
     _, keys = _group_keys_sentinel(plan, valid, ccols, params)
 
-    if needs_sort:
+    if scatter:
+        _scatter_group(plan, valid, keys, ccols, params, space, out)
+    elif needs_sort:
         _sorted_group(plan, keys, valid, ccols, params, space, out,
                       platform)
     else:
@@ -1197,8 +1196,11 @@ def build_kernel(plan: KernelPlan, bucket: int,
         out: Dict[str, jax.Array] = {}
         if plan.is_group_by and plan.strategy == "compact":
             from .compact import default_slots_cap, sorted_default_slots_cap
+            # scatter mode compacts exactly (XLA nonzero), so the tight
+            # sorted-path cap applies: smaller gathers + scatter inputs,
+            # and the overflow retry covers dense matches
             cap = slots_cap or (sorted_default_slots_cap(total)
-                                if _needs_sort(plan)
+                                if _needs_sort(plan) or scatter
                                 else default_slots_cap(total))
             _compact_group_aggs(plan, mask, cols, params, total, cap, out,
                                 platform, scatter)

@@ -140,9 +140,11 @@ def test_scatter_distinctcount_vs_numpy(broker, data, scatter_on):
     assert got == {k: len(v) for k, v in oracle.items()}
 
 
-def test_scatter_overflow_free(broker, data, scatter_on):
-    """The scatter core emits overflow=0 unconditionally (no compaction,
-    no capacity): an all-match query must not trigger the retry."""
+def test_scatter_all_match_overflow_retry(broker, data, scatter_on):
+    """An all-match query overflows the default compaction capacity;
+    the executor's retry ladder must deliver exact results through the
+    scatter core (compaction now runs before the scatter — the nonzero
+    is cheap on CPU and low selectivity shrinks the scatter input)."""
     res = broker.query(
         "SELECT ka, kb, COUNT(*) FROM t GROUP BY ka, kb LIMIT 100000")
     oracle = {}
