@@ -887,10 +887,54 @@ def _from_orderable64(o: jax.Array, mode: str, acc_f) -> jax.Array:
     return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(acc_f)
 
 
+# post-aggregation size ladder: below this static capacity (elements) the
+# sort/matmul cost is trivial and the extra lax.switch branches only cost
+# compile time (the CPU test suite lives here). Env override for tests.
+def _ladder_min_elems() -> int:
+    return int(os.environ.get("PINOT_COMPACT_LADDER_MIN", 1 << 22))
+
+
+def _two_pass_mode() -> str:
+    """'auto' (second compaction pass only after the loose Pallas pass),
+    '1' force (tests exercise the wiring on the XLA fallback), '0' off."""
+    return os.environ.get("PINOT_COMPACT_TWO_PASS", "auto")
+
+
+def _post_sizes(cap_rows: int) -> List[int]:
+    """Geometric /8 ladder of slot-row sizes up to the full capacity."""
+    sizes = [cap_rows]
+    while sizes[-1] // 8 >= 512:
+        sizes.append(sizes[-1] // 8)
+    return sorted(set(sizes))
+
+
+def _ladder_switch(sizes: List[int], n_valid, make_branch,
+                   extra_branch=None, extra_when=None):
+    """Dispatch the post-aggregation at the smallest ladder size whose
+    element capacity covers n_valid. extra_branch (with its extra_when
+    device predicate) appends an override branch — the two-pass path's
+    pass-1 fallback on pass-2 overflow."""
+    from .compact import LANES
+
+    thresholds = jnp.asarray([s * LANES for s in sizes[:-1]],
+                             dtype=jnp.int32)
+    idx = jnp.sum((thresholds < n_valid).astype(jnp.int32)) \
+        if sizes[:-1] else jnp.int32(0)
+    branches = [make_branch(s) for s in sizes]
+    if extra_branch is not None:
+        idx = jnp.where(extra_when, jnp.int32(len(sizes)), idx)
+        branches.append(extra_branch)
+    if len(branches) == 1:
+        return branches[0]()
+    return jax.lax.switch(idx, branches)
+
+
 def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
                         slots_cap: int, out: Dict[str, jax.Array],
                         platform: str = None,
-                        scatter: bool = False) -> None:
+                        scatter: bool = False,
+                        two_pass_mode: Optional[str] = None,
+                        ladder_min: Optional[int] = None) -> None:
     """Group aggregation over compacted matched rows.
 
     Reference parity: DocIdSetOperator (docId materialization) +
@@ -902,6 +946,22 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
     Outputs are the same dense (space,) arrays as the dense strategy, so
     extraction and broker reduce are strategy-agnostic.
 
+    Two refinements keep the post-aggregation cost proportional to the
+    rows actually matched instead of the static capacity (SSB Q2-Q4 are
+    0.001-1% selective, yet the sort/matmul used to run over the full
+    slots_cap every time):
+
+    - a SECOND compaction pass over the first pass's output (Pallas path
+      only by default): lane-wise compaction is loose — every 32-row
+      subtile with any match advances a full slot row, so a sparse mask
+      inflates 10-45x; re-compacting the already-small output costs a
+      fraction of pass 1 and lands within ~2-4x of the true matched
+      count. Pass-2 overflow falls back to the pass-1 arrays in-kernel
+      (a lax.switch branch), never to a host retry;
+    - a lax.switch SIZE LADDER: the post-aggregation is traced at a few
+      static sizes (slot rows, /8 apart) and the branch picked on device
+      by the compacted row count, so the sort sees ~the matched rows.
+
     scatter=True (CPU execution, cpu_scatter_default): the aggregation
     core after compaction is jax.ops.segment_* instead of the
     factorized/sorted MXU shapes. Compaction still runs first — the
@@ -910,7 +970,7 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
     q2.x kernels went seconds -> sub-second when the scatter stopped
     touching unmatched rows).
     """
-    from .compact import compact
+    from .compact import LANES, _use_pallas, compact
 
     space = plan.group_space
     needed = sorted({ci for ci, _ in plan.group_keys}
@@ -922,20 +982,54 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
         mask, tuple(cols[ci] for ci in needed), slots_cap, platform)
     out["overflow"] = overflow
     out["matched"] = matched.astype(int_acc_dtype())
-    ccols: List[Optional[jax.Array]] = [None] * len(cols)
-    for i, ci in enumerate(needed):
-        ccols[ci] = comp[i]
-    m = valid.shape[0]
 
-    _, keys = _group_keys_sentinel(plan, valid, ccols, params)
+    def assemble(comp_t) -> List[Optional[jax.Array]]:
+        full: List[Optional[jax.Array]] = [None] * len(cols)
+        for i, ci in enumerate(needed):
+            full[ci] = comp_t[i]
+        return full
 
     if scatter:
+        ccols = assemble(comp)
+        _, keys = _group_keys_sentinel(plan, valid, ccols, params)
         _scatter_group(plan, valid, keys, ccols, params, space, out)
-    elif needs_sort:
-        _sorted_group(plan, keys, valid, ccols, params, space, out,
-                      platform)
-    else:
-        _factorized_group(plan, keys, valid, ccols, params, space, m, out)
+        return
+
+    def post(valid_a, comp_t, rows: int) -> Dict[str, jax.Array]:
+        cc = assemble(tuple(c[:rows] for c in comp_t))
+        v = valid_a[:rows]
+        _, k = _group_keys_sentinel(plan, v, cc, params)
+        o: Dict[str, jax.Array] = {}
+        if needs_sort:
+            _sorted_group(plan, k, v, cc, params, space, o, platform)
+        else:
+            _factorized_group(plan, k, v, cc, params, space, rows, o)
+        return o
+
+    cap_rows = valid.shape[0]          # slots_cap * LANES elements
+    mode = two_pass_mode if two_pass_mode is not None else _two_pass_mode()
+    min_elems = ladder_min if ladder_min is not None else _ladder_min_elems()
+    two_pass = comp and (
+        mode == "1"
+        or (mode == "auto" and _use_pallas(bucket, platform)
+            and cap_rows >= min_elems))
+    if two_pass:
+        cap2 = max(slots_cap // 4, 512)
+        valid2, comp2, n_valid2, _m2, of2 = compact(
+            valid, comp, cap2, platform)
+        out.update(_ladder_switch(
+            _post_sizes(valid2.shape[0] // LANES), n_valid2,
+            lambda s: functools.partial(post, valid2, comp2, s * LANES),
+            # pass-2 overflow: aggregate the (complete) pass-1 arrays
+            extra_branch=functools.partial(post, valid, comp, cap_rows),
+            extra_when=of2 > 0))
+        return
+
+    sizes = (_post_sizes(cap_rows // LANES) if cap_rows >= min_elems
+             else [cap_rows // LANES])
+    out.update(_ladder_switch(
+        sizes, n_valid,
+        lambda s: functools.partial(post, valid, comp, s * LANES)))
 
 
 def _factorized_group(plan, keys, valid, ccols, params, space, m, out):
@@ -1165,7 +1259,9 @@ def build_kernel(plan: KernelPlan, bucket: int,
                  platform: Optional[str] = None,
                  xfer_compact: bool = True,
                  local_segments: int = 1,
-                 scatter: bool = False):
+                 scatter: bool = False,
+                 two_pass_mode: Optional[str] = None,
+                 ladder_min: Optional[int] = None):
     """Return fn(cols, n_docs, params) -> dict of partial aggregation states.
 
     Shape contract: every cols[i] has the same (bucket,) length; n_docs is a
@@ -1203,7 +1299,8 @@ def build_kernel(plan: KernelPlan, bucket: int,
                                 if _needs_sort(plan) or scatter
                                 else default_slots_cap(total))
             _compact_group_aggs(plan, mask, cols, params, total, cap, out,
-                                platform, scatter)
+                                platform, scatter, two_pass_mode,
+                                ladder_min)
             # scatter implies CPU execution, where the "transfer" the
             # device-side live-group compaction optimizes is free — the
             # nonzero over a big space only adds kernel time there
@@ -1349,7 +1446,9 @@ def build_segmented_compact_kernel(plan: KernelPlan, bucket: int,
                                    slots_cap: Optional[int] = None,
                                    platform: Optional[str] = None,
                                    xfer_compact: bool = True,
-                                   scatter: bool = False):
+                                   scatter: bool = False,
+                                   two_pass_mode: Optional[str] = None,
+                                   ladder_min: Optional[int] = None):
     """Multi-segment compact group-by as ONE device program.
 
     Reference parity: GroupByCombineOperator.java:125 runs the same
@@ -1413,7 +1512,8 @@ def build_segmented_compact_kernel(plan: KernelPlan, bucket: int,
                             else default_slots_cap(total))
         out: Dict[str, jax.Array] = {}
         _compact_group_aggs(plan2, masks.reshape(total), tuple(flat_cols),
-                            vparams, total, cap, out, platform, scatter)
+                            vparams, total, cap, out, platform, scatter,
+                            two_pass_mode, ladder_min)
         out["matched"] = masks.sum(axis=1, dtype=int_acc_dtype())  # (S,)
         if xfer_compact and not scatter:
             # live-group gather over the combined S*space — the executor
@@ -1426,10 +1526,11 @@ def build_segmented_compact_kernel(plan: KernelPlan, bucket: int,
 
 @functools.lru_cache(maxsize=256)
 def _jitted_segmented_cached(plan, bucket, n_segments, slots_cap, platform,
-                             xfer_compact, scatter):
+                             xfer_compact, scatter, two_pass_mode,
+                             ladder_min):
     return jax.jit(build_segmented_compact_kernel(
         plan, bucket, n_segments, slots_cap, platform, xfer_compact,
-        scatter))
+        scatter, two_pass_mode, ladder_min))
 
 
 def jitted_segmented_compact(plan: KernelPlan, bucket: int,
@@ -1441,7 +1542,8 @@ def jitted_segmented_compact(plan: KernelPlan, bucket: int,
     if scatter is None:
         scatter = cpu_scatter_default(platform)
     return _jitted_segmented_cached(plan, bucket, n_segments, slots_cap,
-                                    platform, xfer_compact, scatter)
+                                    platform, xfer_compact, scatter,
+                                    _two_pass_mode(), _ladder_min_elems())
 
 
 # the env-flag wrapper keeps the lru_cache introspection surface
@@ -1452,9 +1554,11 @@ jitted_segmented_compact.cache_clear = _jitted_segmented_cached.cache_clear
 
 @functools.lru_cache(maxsize=1024)
 def _jitted_kernel_cached(plan, bucket, slots_cap, platform, xfer_compact,
-                          scatter):
+                          scatter, two_pass_mode, ladder_min):
     return jax.jit(build_kernel(plan, bucket, slots_cap, platform,
-                                xfer_compact, scatter=scatter))
+                                xfer_compact, scatter=scatter,
+                                two_pass_mode=two_pass_mode,
+                                ladder_min=ladder_min))
 
 
 def jitted_kernel(plan: KernelPlan, bucket: int,
@@ -1467,11 +1571,15 @@ def jitted_kernel(plan: KernelPlan, bucket: int,
     support and the Pallas gate differ per backend (mesh execution may
     target a platform other than the process default); scatter=None
     resolves from the platform + PINOT_CPU_FAST_GROUPBY at call time
-    (cpu_scatter_default) so the flag is part of the cache key."""
+    (cpu_scatter_default) so the flag is part of the cache key, and the
+    compact-path knobs (PINOT_COMPACT_TWO_PASS / _LADDER_MIN) resolve
+    here for the same reason — flipping the env between calls must not
+    hit a stale cached kernel."""
     if scatter is None:
         scatter = cpu_scatter_default(platform)
     return _jitted_kernel_cached(plan, bucket, slots_cap, platform,
-                                 xfer_compact, scatter)
+                                 xfer_compact, scatter,
+                                 _two_pass_mode(), _ladder_min_elems())
 
 
 jitted_kernel.cache_info = _jitted_kernel_cached.cache_info

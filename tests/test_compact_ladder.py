@@ -1,0 +1,155 @@
+"""Size-ladder + two-pass compaction for the compact group-by strategy.
+
+The TPU Pallas compaction is loose (ops/compact.py: a sparse mask
+inflates the compacted size 10-45x) and the post-aggregation used to run
+over the full static capacity. kernels._compact_group_aggs now
+re-compacts the first pass's output and picks the smallest static
+post-aggregation size via lax.switch. On CPU the XLA fallback compaction
+is already tight, so these tests force the machinery with the env knobs
+(PINOT_COMPACT_TWO_PASS=1, PINOT_COMPACT_LADDER_MIN=0) and diff against
+numpy oracles — including the pass-2-overflow fallback branch (dense
+mask overflows the tighter second-pass capacity; the kernel must swing
+back to the pass-1 arrays in-kernel and stay exact).
+
+Reference parity: DocIdSetOperator.java:59-86 + DefaultGroupByExecutor
+(the compact strategy is their TPU reshape).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pinot_tpu.ops import kernels as K
+from pinot_tpu.ops.ir import AggSpec, Cmp, Col, KernelPlan, TrueP
+
+N = 1 << 15
+CARD_A, CARD_B = 40, 50          # space 2000 > DENSE_SMALL_GROUPS
+
+
+def _data(rng, sel_pct):
+    ka = rng.integers(0, CARD_A, N).astype(np.int32)
+    kb = rng.integers(0, CARD_B, N).astype(np.int32)
+    sel = rng.integers(0, 100, N).astype(np.int32)
+    v = rng.integers(-1000, 1000, N).astype(np.int32)
+    mask = sel < sel_pct
+    return ka, kb, sel, v, mask
+
+
+def _sum_plan(pred):
+    return KernelPlan(
+        pred=pred,
+        aggs=(AggSpec(kind="sum", value=Col(3), integral=True,
+                      bits=11, signed=True),),
+        group_keys=((0, CARD_A), (1, CARD_B)),
+        strategy="compact",
+    )
+
+
+def _run(plan, cols, params, monkeypatch, two_pass="1", slots_cap=None):
+    monkeypatch.setenv("PINOT_COMPACT_TWO_PASS", two_pass)
+    monkeypatch.setenv("PINOT_COMPACT_LADDER_MIN", "0")
+    fn = jax.jit(K.build_kernel(plan, N, slots_cap=slots_cap,
+                                scatter=False))
+    out = fn(tuple(jnp.asarray(c) for c in cols), np.int32(N),
+             tuple(jnp.asarray(p) for p in params))
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def _oracle(ka, kb, v, mask, space):
+    keys = ka.astype(np.int64) * CARD_B + kb
+    sums = np.bincount(keys[mask], weights=v[mask].astype(np.float64),
+                       minlength=space)
+    cnts = np.bincount(keys[mask], minlength=space)
+    return sums.astype(np.int64), cnts
+
+
+@pytest.mark.parametrize("sel_pct", [1, 30])
+def test_ladder_factorized_sums(monkeypatch, sel_pct):
+    """space 2000 <= FACTORIZED_GROUP_LIMIT: the switch branches run the
+    factorized one-hot matmul at ladder sizes; sparse and dense masks
+    pick different branches, both exact."""
+    rng = np.random.default_rng(7 + sel_pct)
+    ka, kb, sel, v, mask = _data(rng, sel_pct)
+    plan = _sum_plan(Cmp(Col(2), "<", 0))
+    out = _run(plan, (ka, kb, sel, v), (np.int32(sel_pct),), monkeypatch)
+    sums, cnts = _oracle(ka, kb, v, mask, plan.group_space)
+    assert int(out["matched"]) == int(mask.sum())
+    assert int(out["overflow"]) == 0
+    assert np.array_equal(out["group_count"], cnts)
+    assert np.array_equal(out["agg0_sum"], sums)
+
+
+def test_ladder_sorted_minmax(monkeypatch):
+    """MIN/MAX forces the sort path; the ladder slices must keep the
+    lexicographic sort + boundary-diff exact."""
+    rng = np.random.default_rng(17)
+    ka, kb, sel, v, mask = _data(rng, 5)
+    plan = KernelPlan(
+        pred=Cmp(Col(2), "<", 0),
+        aggs=(AggSpec(kind="min", value=Col(3), integral=True),
+              AggSpec(kind="max", value=Col(3), integral=True),
+              AggSpec(kind="sum", value=Col(3), integral=True,
+                      bits=11, signed=True)),
+        group_keys=((0, CARD_A), (1, CARD_B)),
+        strategy="compact",
+    )
+    out = _run(plan, (ka, kb, sel, v), (np.int32(5),), monkeypatch)
+    keys = ka.astype(np.int64) * CARD_B + kb
+    sums, cnts = _oracle(ka, kb, v, mask, plan.group_space)
+    assert np.array_equal(out["group_count"], cnts)
+    assert np.array_equal(out["agg2_sum"], sums)
+    for g in np.nonzero(cnts)[0]:
+        vals = v[mask & (keys == g)]
+        assert out["agg0_min"][g] == vals.min()
+        assert out["agg1_max"][g] == vals.max()
+
+
+def test_two_pass_overflow_falls_back_to_pass1(monkeypatch):
+    """An all-match mask overflows the tighter pass-2 capacity; the
+    lax.switch fallback branch must aggregate the pass-1 arrays and stay
+    exact (no host retry, out['overflow'] still 0).
+
+    N must be large enough that matched > cap2 * 128 elements, where
+    cap2 = max(slots_cap // 4, 512): with n = 1 << 17 all-match,
+    matched = 131072 > 512 * 128 = 65536, so of2 = 1 genuinely fires
+    (at the module N = 1 << 15 the fallback branch would be traced but
+    never executed)."""
+    from pinot_tpu.ops.compact import full_slots_cap
+    n = 1 << 17
+    rng = np.random.default_rng(23)
+    ka = rng.integers(0, CARD_A, n).astype(np.int32)
+    kb = rng.integers(0, CARD_B, n).astype(np.int32)
+    sel = rng.integers(0, 100, n).astype(np.int32)
+    v = rng.integers(-1000, 1000, n).astype(np.int32)
+    mask = np.ones(n, bool)
+    cap1 = full_slots_cap(n)
+    assert n > max(cap1 // 4, 512) * 128, "test would not overflow pass 2"
+    plan = _sum_plan(TrueP())
+    monkeypatch.setenv("PINOT_COMPACT_TWO_PASS", "1")
+    monkeypatch.setenv("PINOT_COMPACT_LADDER_MIN", "0")
+    fn = jax.jit(K.build_kernel(plan, n, slots_cap=cap1, scatter=False))
+    out = {k: np.asarray(val) for k, val in fn(
+        tuple(jnp.asarray(c) for c in (ka, kb, sel, v)),
+        np.int32(n), ()).items()}
+    assert int(out["overflow"]) == 0
+    sums, cnts = _oracle(ka, kb, v, mask, plan.group_space)
+    assert np.array_equal(out["group_count"], cnts)
+    assert np.array_equal(out["agg0_sum"], sums)
+
+
+def test_ladder_off_by_default_small_caps(monkeypatch):
+    """With default knobs and a tiny capacity the single-branch path runs
+    (no switch) — results identical to the forced-ladder run."""
+    rng = np.random.default_rng(29)
+    ka, kb, sel, v, mask = _data(rng, 10)
+    plan = _sum_plan(Cmp(Col(2), "<", 0))
+    monkeypatch.delenv("PINOT_COMPACT_TWO_PASS", raising=False)
+    monkeypatch.delenv("PINOT_COMPACT_LADDER_MIN", raising=False)
+    fn = jax.jit(K.build_kernel(plan, N, scatter=False))
+    out_plain = {k: np.asarray(val) for k, val in fn(
+        tuple(jnp.asarray(c) for c in (ka, kb, sel, v)),
+        np.int32(N), (jnp.asarray(np.int32(10)),)).items()}
+    out_forced = _run(plan, (ka, kb, sel, v), (np.int32(10),), monkeypatch)
+    for k in ("group_count", "agg0_sum", "matched"):
+        assert np.array_equal(out_plain[k], out_forced[k]), k

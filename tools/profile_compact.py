@@ -1,0 +1,117 @@
+"""Phase-level TPU profile of the compact-strategy SSB kernels.
+
+Decomposes kernel time into mask-eval / compaction / post-aggregation /
+transfer-compaction for the slow compact-path queries so optimization
+targets the real bottleneck (VERDICT r4 next-step #1b). Run standalone on
+the real chip (bounded by the caller):
+
+    python tools/profile_compact.py q2.1 q3.2 q4.3
+
+Prints one JSON line per query with phase times and compaction stats.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def timeit(fn, *args, iters=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = [fn(*args) for _ in range(iters)]
+    jax.block_until_ready(outs)
+    t_k = time.perf_counter() - t0
+    return max((t_k - t_one) / iters, 1e-9)
+
+
+def main():
+    qids = set(sys.argv[1:]) or {"q2.1", "q3.2", "q4.3"}
+    from bench import QUERIES, build_or_load_segment, spec_to_sql
+    from pinot_tpu.engine.executor import resolve_params
+    from pinot_tpu.ops import kernels
+    from pinot_tpu.ops.compact import (default_slots_cap, full_slots_cap,
+                                       sorted_default_slots_cap)
+    from pinot_tpu.ops.kernels import _needs_sort, jitted_kernel
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.planner import SegmentPlanner
+    from pinot_tpu.query.sql import parse_sql
+
+    seg = build_or_load_segment()
+    bucket = seg.bucket
+    n = np.int32(seg.n_docs)
+
+    for qid, preds, vexpr, gcols in QUERIES:
+        if qid not in qids:
+            continue
+        sql = spec_to_sql(preds, vexpr, gcols)
+        ctx = build_query_context(parse_sql(sql))
+        plan = SegmentPlanner(ctx, seg).plan()
+        kp = plan.kernel_plan
+        cols = seg.device_cols(plan.col_names)
+        params = resolve_params(plan)
+
+        res = {"qid": qid, "strategy": kp.strategy,
+               "space": kp.group_space if kp.is_group_by else 0,
+               "n_cols": len(cols),
+               "col_dtypes": [str(c.dtype) for c in cols],
+               "needs_sort": _needs_sort(kp) if kp.is_group_by else None}
+
+        # phase 1: mask eval only
+        def mask_fn(cols, n, params):
+            valid = jnp.arange(bucket, dtype=jnp.int32) < n
+            return valid & kernels._eval_pred(kp.pred, cols, params, bucket)
+
+        jmask = jax.jit(mask_fn)
+        res["t_mask_ms"] = round(timeit(jmask, cols, n, params) * 1e3, 2)
+
+        if kp.strategy == "compact":
+            from pinot_tpu.ops.compact import compact
+            needed = sorted({ci for ci, _ in kp.group_keys}
+                            | set().union(
+                                *[kernels._value_col_indices(s.value)
+                                  for s in kp.aggs if s.value is not None]
+                                or [set()]))
+            cap = (sorted_default_slots_cap(bucket) if _needs_sort(kp)
+                   else default_slots_cap(bucket))
+            res["slots_cap"] = cap
+            res["cap_rows"] = cap * 128
+
+            def comp_fn(cols, n, params):
+                m = mask_fn(cols, n, params)
+                return compact(m, tuple(cols[ci] for ci in needed), cap)
+
+            jcomp = jax.jit(comp_fn)
+            res["t_mask_compact_ms"] = round(
+                timeit(jcomp, cols, n, params) * 1e3, 2)
+            valid, ccols, n_valid, matched, overflow = jcomp(cols, n, params)
+            res["matched"] = int(matched)
+            res["n_valid_rows"] = int(n_valid)
+            res["overflow"] = int(overflow)
+            res["inflation"] = round(int(n_valid) / max(int(matched), 1), 2)
+
+            # full kernel without transfer compaction
+            f_noxfer = jitted_kernel(kp, bucket, xfer_compact=False)
+            res["t_kernel_noxfer_ms"] = round(
+                timeit(f_noxfer, cols, n, params) * 1e3, 2)
+
+        # full kernel (as shipped)
+        ffull = jitted_kernel(kp, bucket)
+        res["t_kernel_ms"] = round(timeit(ffull, cols, n, params) * 1e3, 2)
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    import jax
+    import jax.numpy as jnp
+    main()
