@@ -31,6 +31,7 @@ On CPU (tests, host fallback) an XLA nonzero-based implementation is used.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -38,9 +39,46 @@ import jax.numpy as jnp
 
 LANES = 128
 R = 32                 # sublane rows per subtile
-K = 8                  # subtiles per grid step
-STEP = K * R           # input rows consumed per grid step
-STAGE = K * R + R      # staging rows (worst case: K subtiles all full + pad)
+K_MIN = 8              # minimum subtiles per grid step (gate + capacity math)
+K_MAX = 32             # maximum (VMEM permitting — _choose_k)
+STEP = K_MIN * R       # minimum rows per grid step (pallas gate, caps)
+STAGE = K_MIN * R + R  # staging rows at K_MIN (capacity math only)
+
+
+def _interpret() -> bool:
+    """Test-only escape hatch: run the Pallas kernel in interpret mode on
+    CPU (trace-time; dedicated tests call compact() directly, so the
+    jitted-kernel caches never see a stale value)."""
+    return os.environ.get("PINOT_PALLAS_INTERPRET", "0") == "1"
+
+
+def _choose_k(n_cols: int, n: int) -> int:
+    """Subtiles per grid step: as large as VMEM comfortably allows.
+
+    Larger K cuts the sequential grid (fewer DMA waits / SMEM carry
+    round-trips) and deepens the placement matmul contraction from R=32
+    to K*R (the 128x128 MXU is depth-starved at 32). Rough VMEM budget
+    per column stream: double-buffered input block (2*K*R*LANES*4B) +
+    staging ((K+1)*R*LANES*4B) + the bf16 part tiles; cap the estimate
+    at ~10MB of the ~16MB core VMEM."""
+    k = K_MAX
+    while k > K_MIN and k * R * LANES > n:
+        k //= 2               # don't pad small inputs up to a giant step
+    while k > K_MIN:
+        in_blocks = 2 * k * R * LANES * 4 * (n_cols + 1)
+        staging = (k + 1) * R * LANES * 4 * (n_cols + 1)
+        parts = (4 * n_cols + 1) * k * R * LANES * 2
+        stack = (k + 1) * R * k * R * 2
+        if in_blocks + staging + parts + stack <= 10 << 20:
+            break
+        k //= 2
+    # the grid consumes k*R*LANES rows per step; n is padded to that
+    return k
+
+
+# capacity margins must cover the LARGEST staging block any chosen K can
+# write ((K_MAX+1)*R rows) — the kernel's fits check is off+stage<=cap
+STAGE_MAX = (K_MAX + 1) * R
 
 
 def default_slots_cap(n: int) -> int:
@@ -51,7 +89,7 @@ def default_slots_cap(n: int) -> int:
     Binomial(R, p) over 128 lanes] / R, about 4-5x p for p around a few
     percent. 1/4 covers p <~ 8% without overflow; denser masks trigger the
     executor's full_slots_cap retry (engine/executor.py run_kernel)."""
-    return max(n // (4 * LANES), 2 * STAGE) + STAGE
+    return max(n // (4 * LANES), 2 * STAGE_MAX) + STAGE_MAX
 
 
 def sorted_default_slots_cap(n: int) -> int:
@@ -63,13 +101,13 @@ def sorted_default_slots_cap(n: int) -> int:
     advance floor is ~1 slot row per 32-row subtile with any match
     (~3.2%), so 1/16 (6.25%) keeps headroom; denser masks pay the
     full-capacity retry like everything else."""
-    return max(n // (16 * LANES), 2 * STAGE) + STAGE
+    return max(n // (16 * LANES), 2 * STAGE_MAX) + STAGE_MAX
 
 
 def full_slots_cap(n: int) -> int:
     """Capacity that can never overflow: total slot advance is bounded by
     one slot row per input row-of-128 plus one pad row per subtile."""
-    return n // LANES + n // (R * LANES) + STAGE
+    return n // LANES + n // (R * LANES) + STAGE_MAX
 
 
 def f64_bitcast_ok(platform: str = None) -> bool:
@@ -113,17 +151,25 @@ def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int,
             split_cols.append(c.astype(jnp.int32))
             recipes.append((jnp.dtype(jnp.int32), 1))
 
-    if _use_pallas(n, platform):
-        # the kernel consumes STEP*LANES rows per grid step; pad odd sizes
-        # with unmatched rows (mask False) so every segment shape qualifies
-        rem = n % (STEP * LANES)
+    k_sub = _choose_k(len(split_cols), n)
+    # the staging DMA writes (k_sub+1)*R rows; a cap smaller than one
+    # staging block can't hold it (shape-invalid even when predicated
+    # off) — shrink K, then fall back to XLA for pathological caps
+    while (k_sub + 1) * R > slots_cap and k_sub > K_MIN:
+        k_sub //= 2
+    if _use_pallas(n, platform) and (k_sub + 1) * R <= slots_cap:
+        # the kernel consumes k_sub*R*LANES rows per grid step; pad odd
+        # sizes with unmatched rows (mask False) so every shape qualifies
+        step_rows = k_sub * R * LANES
+        rem = n % step_rows
         if rem:
-            pad = STEP * LANES - rem
+            pad = step_rows - rem
             mask = jnp.pad(mask, (0, pad))
             split_cols = [jnp.pad(c, (0, pad)) for c in split_cols]
         valid, outs, n_slots, matched, overflow = _compact_pallas(
-            mask, tuple(split_cols), n + (STEP * LANES - rem if rem else 0),
-            slots_cap)
+            mask, tuple(split_cols),
+            n + (step_rows - rem if rem else 0), slots_cap, k_sub,
+            _interpret())
     else:
         valid, outs, n_slots, matched, overflow = _compact_xla(
             mask, tuple(split_cols), n, slots_cap)
@@ -145,8 +191,11 @@ def compact(mask: jax.Array, cols: Tuple[jax.Array, ...], slots_cap: int,
 
 
 def _use_pallas(n: int, platform: str = None) -> bool:
-    return ((platform or jax.default_backend()) == "tpu"
-            and n >= STEP * LANES)
+    if n < STEP * LANES:
+        return False
+    if _interpret():
+        return True            # test-only: interpret-mode kernel on CPU
+    return (platform or jax.default_backend()) == "tpu"
 
 
 def _compact_xla(mask, cols, n, slots_cap):
@@ -176,10 +225,12 @@ def _compact_xla(mask, cols, n, slots_cap):
 # Pallas TPU kernel
 # ---------------------------------------------------------------------------
 
-def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int):
+def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int,
+            k_sub: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    stage_rows = (k_sub + 1) * R
     col_refs = rest[:n_cols]
     valid_out = rest[n_cols]
     col_outs = rest[n_cols + 1: 2 * n_cols + 1]
@@ -205,21 +256,26 @@ def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int):
     stril = (row_i > col_i).astype(jnp.int32).astype(jnp.float32)
     out_iota = jax.lax.broadcasted_iota(jnp.int32, (R, R, LANES), 0)
     row_iota = jax.lax.broadcasted_iota(jnp.int32, (R, LANES), 0)
-    stage_iota = jax.lax.broadcasted_iota(jnp.int32, (STAGE, R), 0)
-    sub_iota = jax.lax.broadcasted_iota(jnp.int32, (STAGE, R), 1)
+    stage_iota = jax.lax.broadcasted_iota(jnp.int32, (stage_rows, R), 0)
+    sub_iota = jax.lax.broadcasted_iota(jnp.int32, (stage_rows, R), 1)
 
-    # staging accumulators as values; each subtile contributes via an
-    # (STAGE, R) one-hot stacking matmul (invalid slots are exact zeros,
-    # so overlapping garbage rows can't corrupt the sums). Stacking runs
-    # in single-pass bf16: columns are split into bytes (|v| <= 255 is
-    # bf16-exact) and recombined after f32 accumulation.
-    valid_acc = jnp.zeros((STAGE, LANES), jnp.float32)
-    byte_accs = [[jnp.zeros((STAGE, LANES), jnp.float32) for _ in range(4)]
-                 for _ in range(n_cols)]
-
+    # Per subtile: in-lane compaction (dest via the stril matmul, then a
+    # one-hot gather-sum). Placement into the staging block happens in ONE
+    # deep matmul per byte part across all k_sub subtiles:
+    #     staging = stack_all @ vstack(subtile parts)
+    # stack_all (stage_rows, k_sub*R) stacks each subtile's one-hot
+    # placement at its running offset; invalid slots are exact zeros, so
+    # overlapping garbage rows can't corrupt the sums. A k_sub*R-deep
+    # contraction keeps the 128x128 MXU fed (per-subtile R=32-deep
+    # matmuls ran it at ~25% depth utilization). Values stay bf16-exact:
+    # columns are split into bytes (|v| <= 255) and recombined after f32
+    # accumulation.
+    valid_tiles = []
+    part_tiles = [[[] for _ in range(4)] for _ in range(n_cols)]
+    offs = []
     local_off = jnp.int32(0)
     total = jnp.int32(0)
-    for k in range(K):
+    for k in range(k_sub):
         sl = slice(k * R, (k + 1) * R)
         m = mask_ref[sl, :] != 0                       # (R, 128)
         mf = m.astype(jnp.int32).astype(jnp.float32)
@@ -230,17 +286,8 @@ def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int):
             stril, mf, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(jnp.int32)
         scat = (dest[None, :, :] == out_iota) & m[None, :, :]  # (R, R, 128)
-        stack = (stage_iota == local_off + sub_iota)\
-            .astype(jnp.int32).astype(jnp.bfloat16)
-
-        def place(tile_bf16):
-            return jax.lax.dot_general(
-                stack, tile_bf16, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-
-        valid_acc = valid_acc + place(
-            (row_iota < cnt[None, :]).astype(jnp.int32)
-            .astype(jnp.bfloat16))
+        valid_tiles.append((row_iota < cnt[None, :]).astype(jnp.int32)
+                           .astype(jnp.bfloat16))
         for ci in range(n_cols):
             x = col_refs[ci][sl, :]
             comp = jnp.sum(jnp.where(scat, x[None, :, :], jnp.int32(0)),
@@ -252,22 +299,34 @@ def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int):
                         jnp.int32(0xFF))
                 else:
                     part = jax.lax.shift_right_arithmetic(comp, jnp.int32(24))
-                byte_accs[ci][b] = byte_accs[ci][b] + place(
+                part_tiles[ci][b].append(
                     part.astype(jnp.float32).astype(jnp.bfloat16))
+        offs.append(local_off)
         local_off = local_off + adv
         # f32 scalar sum (exact: <= 4096 per step); jnp.sum-to-scalar on
         # int32 sneaks an int64 intermediate past the Mosaic lowering
         total = total + jnp.sum(cnt.astype(jnp.float32),
                                 dtype=jnp.float32).astype(jnp.int32)
 
+    stack_all = jnp.concatenate(
+        [(stage_iota == offs[k] + sub_iota).astype(jnp.int32)
+         .astype(jnp.bfloat16) for k in range(k_sub)],
+        axis=1)                                        # (stage_rows, k_sub*R)
+
+    def place_all(tiles):
+        t = jnp.concatenate(tiles, axis=0)             # (k_sub*R, 128)
+        return jax.lax.dot_general(
+            stack_all, t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
     off = carry[0]
-    fits = off + STAGE <= slots_cap
+    fits = off + stage_rows <= slots_cap
 
     for ci in range(n_cols + 1):
         if ci == 0:
-            val = valid_acc.astype(jnp.int32)
+            val = place_all(valid_tiles).astype(jnp.int32)
         else:
-            acc = byte_accs[ci - 1]
+            acc = [place_all(part_tiles[ci - 1][b]) for b in range(4)]
             val = (((acc[3].astype(jnp.int32) * jnp.int32(256)
                      + acc[2].astype(jnp.int32)) * jnp.int32(256)
                     + acc[1].astype(jnp.int32)) * jnp.int32(256)
@@ -282,7 +341,8 @@ def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int):
         for ci in range(n_cols + 1):
             dst = valid_out if ci == 0 else col_outs[ci - 1]
             cp = pltpu.make_async_copy(
-                stages[ci].at[:], dst.at[pl.ds(off, STAGE)], sems.at[ci])
+                stages[ci].at[:], dst.at[pl.ds(off, stage_rows)],
+                sems.at[ci])
             cp.start()
             cps.append(cp)
         for cp in cps:
@@ -302,17 +362,19 @@ def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int):
         overflow_ref[0, 0] = oflow[0]
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _compact_pallas(mask, cols, n, slots_cap):
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _compact_pallas(mask, cols, n, slots_cap, k_sub, interp):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n_cols = len(cols)
-    n_steps = n // (STEP * LANES)
+    step_rows = k_sub * R
+    stage_rows = (k_sub + 1) * R
+    n_steps = n // (step_rows * LANES)
     mask2d = mask.reshape(n // LANES, LANES).astype(jnp.uint8)
     cols2d = [c.reshape(n // LANES, LANES) for c in cols]
 
-    in_specs = [pl.BlockSpec((STEP, LANES), lambda i: (i, 0),
+    in_specs = [pl.BlockSpec((step_rows, LANES), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)] * (n_cols + 1)
     out_shapes = ([jax.ShapeDtypeStruct((slots_cap, LANES), jnp.int32)]
                   * (n_cols + 1)
@@ -321,7 +383,7 @@ def _compact_pallas(mask, cols, n, slots_cap):
                  + [pl.BlockSpec(memory_space=pltpu.SMEM)] * 3)
 
     kern = functools.partial(_kernel, n_cols=n_cols, slots_cap=slots_cap,
-                             n_steps=n_steps)
+                             n_steps=n_steps, k_sub=k_sub)
     call = pl.pallas_call(
         kern,
         grid=(n_steps,),
@@ -331,8 +393,9 @@ def _compact_pallas(mask, cols, n, slots_cap):
         scratch_shapes=[
             pltpu.SMEM((2,), jnp.int32),
             pltpu.SMEM((1,), jnp.int32),
-        ] + [pltpu.VMEM((STAGE, LANES), jnp.int32)] * (n_cols + 1)
+        ] + [pltpu.VMEM((stage_rows, LANES), jnp.int32)] * (n_cols + 1)
           + [pltpu.SemaphoreType.DMA((n_cols + 1,))],
+        interpret=interp,
     )
     # the kernel is pure 32-bit; keep x64 promotion rules out of the trace
     with jax.enable_x64(False):
