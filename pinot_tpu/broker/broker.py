@@ -21,9 +21,9 @@ from ..engine.reduce import ResultTable, reduce_partials
 from ..engine.setops import combine_setop, order_limit_rows
 from ..query.context import build_query_context
 from ..query.planner import SegmentPlanner, _truthy
-from ..query.sql import (InList, InSubquery, Literal, ScalarSubquery,
-                         SelectStmt, SetOpStmt, SqlError, map_expr,
-                         parse_sql)
+from ..query.sql import (Comparison, Exists, InList, InSubquery, Literal,
+                         ScalarSubquery, SelectStmt, SetOpStmt, SqlError,
+                         map_expr, parse_sql)
 from ..server.data_manager import TableDataManager
 from ..utils.metrics import global_metrics
 from ..utils.trace import Tracing
@@ -334,7 +334,118 @@ class Broker:
             for d in tmpdirs:
                 shutil.rmtree(d, ignore_errors=True)
 
-    # -- subqueries (IN_SUBQUERY / scalar rewrite at the broker) -----------
+    # -- subqueries (IN_SUBQUERY / scalar / EXISTS rewrite at the broker) --
+    _TRUE = Comparison("==", Literal(1), Literal(1))
+    _FALSE = Comparison("==", Literal(1), Literal(0))
+
+    def _decorrelate_exists(self, e: "Exists", stmt: SelectStmt):
+        """Rewrite EXISTS to something the existing machinery executes.
+
+        Uncorrelated: the subquery runs once with LIMIT 1 and folds to a
+        constant predicate. Equality-correlated (the decorrelatable
+        class Calcite's SubQueryRemoveRule handles as a semi-join):
+        exactly one top-level AND-ed `inner.col = outer.col` conjunct —
+        rewritten to `outer.col IN (SELECT inner.col FROM ... WHERE
+        <remaining conjuncts>)`, which the IN-subquery (IdSet) path then
+        materializes. Returns the replacement predicate node, or raises
+        SqlError for correlation shapes outside that class."""
+        import dataclasses
+
+        from ..query.sql import BoolAnd, Comparison as Cmp, Identifier, \
+            IsNull, SelectItem, collect_identifiers
+
+        sub = e.stmt
+        # standard SQL scoping: an alias REPLACES the table name as the
+        # qualifier (so a self-table subquery with an alias still sees
+        # the outer name as a correlation, not as itself)
+        outer_labels = {(stmt.table_alias or stmt.table).lower()}
+        inner_labels = {(sub.table_alias or sub.table).lower()}
+        outer_schema = self.table(stmt.table).schema
+        outer_cols = {f.name for f in outer_schema.fields} \
+            if outer_schema else set()
+        inner_schema = self.table(sub.table).schema
+        inner_cols = {f.name for f in inner_schema.fields} \
+            if inner_schema else set()
+
+        def side(ident: str):
+            """'inner' | 'outer' for an identifier in the subquery."""
+            if "." in ident:
+                qual, col = ident.split(".", 1)
+                if qual.lower() in inner_labels:
+                    return "inner", col
+                if qual.lower() in outer_labels:
+                    return "outer", col
+                raise SqlError(
+                    f"unknown qualifier {qual!r} in EXISTS subquery "
+                    f"(tables in scope: {sorted(inner_labels)} inner, "
+                    f"{sorted(outer_labels)} outer)")
+            if ident in inner_cols:
+                return "inner", ident
+            if ident in outer_cols:
+                return "outer", ident
+            return "inner", ident   # let execution raise unknown-column
+
+        conjuncts = (list(sub.where.children)
+                     if isinstance(sub.where, BoolAnd)
+                     else [sub.where] if sub.where is not None else [])
+        corr, local = [], []
+        for c in conjuncts:
+            sides = {side(i)[0] for i in collect_identifiers(c)}
+            (corr if "outer" in sides else local).append(c)
+        if not corr:
+            probe = dataclasses.replace(
+                sub, limit=1, ctes=[],
+                options={**stmt.options, **sub.options})
+            res = self._execute_stmt(probe, time.perf_counter())
+            return self._TRUE if res.rows else self._FALSE
+
+        if len(corr) != 1 or sub.joins or sub.group_by or sub.having:
+            raise SqlError(
+                "correlated EXISTS is supported with exactly one "
+                "top-level `inner.col = outer.col` equality and no "
+                "joins/GROUP BY/HAVING in the subquery; rewrite the "
+                "query as an explicit JOIN instead")
+        c = corr[0]
+        if not (isinstance(c, Cmp) and c.op == "=="
+                and isinstance(c.lhs, Identifier)
+                and isinstance(c.rhs, Identifier)):
+            raise SqlError(
+                "correlated EXISTS predicate must be a plain equality "
+                f"between one inner and one outer column, got "
+                f"{type(c).__name__}")
+        s1, col1 = side(c.lhs.name)
+        s2, col2 = side(c.rhs.name)
+        if {s1, s2} != {"inner", "outer"}:
+            raise SqlError(
+                "correlated EXISTS equality must reference exactly one "
+                "inner and one outer column")
+        inner_col = col1 if s1 == "inner" else col2
+        outer_col = col2 if s1 == "inner" else col1
+
+        def strip(expr):
+            from ..query.sql import map_expr
+
+            def unqualify(x):
+                if isinstance(x, Identifier) and "." in x.name:
+                    qual, col = x.name.split(".", 1)
+                    if qual.lower() in inner_labels:
+                        return Identifier(col)
+                return x
+            return map_expr(expr, unqualify)
+
+        remaining = [strip(x) for x in local]
+        # inner NULLs can never witness the equality; filtering them keeps
+        # the materialized IN list clean for the NOT EXISTS (BoolNot) form
+        remaining.append(IsNull(Identifier(inner_col), negated=True))
+        where = remaining[0] if len(remaining) == 1 \
+            else BoolAnd(tuple(remaining))
+        sub2 = dataclasses.replace(
+            sub, select=[SelectItem(Identifier(inner_col))],
+            distinct=True, where=where, limit=None, order_by=[],
+            table_alias=None,
+            options={**stmt.options, **sub.options})
+        return InSubquery(Identifier(outer_col), sub2, negated=False)
+
     def _resolve_subqueries(self, stmt: SelectStmt) -> SelectStmt:
         if stmt.explain:
             # EXPLAIN must not execute the subquery scan; substitute
@@ -344,6 +455,8 @@ class Broker:
                     return InList(e.expr, (Literal(0),), e.negated)
                 if isinstance(e, ScalarSubquery):
                     return Literal(0)
+                if isinstance(e, Exists):
+                    return self._TRUE
                 return e
             if stmt.where is not None:
                 stmt.where = map_expr(stmt.where, placeholder)
@@ -352,6 +465,10 @@ class Broker:
             return stmt
 
         def rw(e):
+            if isinstance(e, Exists):
+                # decorrelate/fold, then resolve the InSubquery it may
+                # produce through the same materialization below
+                return rw(self._decorrelate_exists(e, stmt))
             if isinstance(e, InSubquery):
                 # bounded materialization (VERDICT r3 weak #7; the
                 # reference bounds IdSet size the same way): the broker

@@ -171,6 +171,17 @@ class ScalarSubquery:
 
 
 @dataclass(frozen=True)
+class Exists:
+    """EXISTS (SELECT ...). NOT EXISTS arrives as BoolNot(Exists(...)).
+    The broker resolves it before planning: uncorrelated -> run with
+    LIMIT 1 and fold to a constant predicate; equality-correlated ->
+    decorrelate into the IN-subquery (IdSet) machinery. Reference:
+    Calcite's SubQueryRemoveRule semi-join rewrite behind
+    QueryEnvironment.java:126."""
+    stmt: Any  # SelectStmt
+
+
+@dataclass(frozen=True)
 class SelectItem:
     expr: Any
     alias: Optional[str] = None
@@ -269,6 +280,8 @@ KEYWORDS = {
     "join", "on", "left", "right", "inner", "outer", "cross", "full",
     "explain",  # 'plan'/'for' stay contextual: valid column names elsewhere
     "case", "when", "then", "else", "end", "cast",
+    # 'exists' stays contextual (a valid column name); predicate() only
+    # treats it as EXISTS(...) when immediately followed by '('
     "over", "partition", "union", "intersect", "except", "all",
     # frame keywords (rows/range/unbounded/preceding/following/current)
     # stay contextual: they are common column names
@@ -580,6 +593,16 @@ class _Parser:
         return self.predicate()
 
     def predicate(self) -> Any:
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() == "exists" \
+                and self.tokens[self.i + 1].kind == "op" \
+                and self.tokens[self.i + 1].value == "(":
+            self.next()
+            self.expect_op("(")
+            sub = self.select_core()
+            self.trailing_clauses(sub)
+            self.expect_op(")")
+            return Exists(sub)
         # parenthesized boolean vs parenthesized arithmetic: try boolean
         if self.peek().kind == "op" and self.peek().value == "(":
             save = self.i
@@ -588,7 +611,8 @@ class _Parser:
                 inner = self.or_expr()
                 self.expect_op(")")
                 if isinstance(inner, (BoolAnd, BoolOr, BoolNot, Comparison,
-                                      Between, InList, Like, IsNull)):
+                                      Between, InList, Like, IsNull,
+                                      Exists)):
                     return inner
                 # plain value in parens: fall through to comparison tail
                 return self.predicate_tail(inner)
@@ -999,6 +1023,8 @@ def expr_to_sql(e: Any) -> str:
         return f"{expr_to_sql(e.expr)} {n}IN ({to_sql(e.stmt)})"
     if isinstance(e, ScalarSubquery):
         return f"({to_sql(e.stmt)})"
+    if isinstance(e, Exists):
+        return f"EXISTS ({to_sql(e.stmt)})"
     raise SqlError(f"cannot render {type(e).__name__} to SQL")
 
 

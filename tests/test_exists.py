@@ -1,0 +1,175 @@
+"""EXISTS / NOT EXISTS subqueries, uncorrelated and equality-correlated.
+
+Reference parity: Calcite's SubQueryRemoveRule behind
+QueryEnvironment.java:126 rewrites EXISTS to semi/anti-joins; our broker
+folds uncorrelated EXISTS to a constant predicate (LIMIT 1 probe) and
+decorrelates single-equality EXISTS into the IN-subquery (IdSet)
+machinery (broker/broker.py:_decorrelate_exists). Oracles are plain
+Python set logic over the generating arrays.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.query.sql import SqlError, parse_sql, to_sql
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+N_F, N_D = 5000, 800
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    rng = np.random.default_rng(31)
+    fact = {
+        "k": rng.integers(0, 400, N_F).astype(np.int32),
+        "v": rng.integers(0, 1000, N_F).astype(np.int32),
+    }
+    dim = {
+        "k2": rng.integers(0, 300, N_D).astype(np.int32),
+        "w": rng.integers(0, 10, N_D).astype(np.int32),
+    }
+    out = tmp_path_factory.mktemp("exists_tables")
+    b = Broker()
+    for name, cols, fields in (
+            ("fact", fact, [FieldSpec("k", DataType.INT),
+                            FieldSpec("v", DataType.INT, FieldType.METRIC)]),
+            ("dim", dim, [FieldSpec("k2", DataType.INT),
+                          FieldSpec("w", DataType.INT)])):
+        dm = TableDataManager(name)
+        dm.add_segment_dir(SegmentBuilder(
+            Schema(name, fields), TableConfig(name)).build(
+                cols, str(out), f"{name}_s0"))
+        b.register_table(dm)
+    return b, fact, dim
+
+
+def test_parse_roundtrip():
+    stmt = parse_sql("SELECT k FROM fact WHERE EXISTS "
+                     "(SELECT 1 FROM dim WHERE k2 = k)")
+    assert "EXISTS (SELECT" in to_sql(stmt)
+
+
+def test_uncorrelated_exists_true_false(tables):
+    b, fact, dim = tables
+    n = b.query("SELECT COUNT(*) FROM fact WHERE EXISTS "
+                "(SELECT 1 FROM dim WHERE w = 3)").rows[0][0]
+    assert n == N_F
+    n = b.query("SELECT COUNT(*) FROM fact WHERE EXISTS "
+                "(SELECT 1 FROM dim WHERE w = 99)").rows[0][0]
+    assert n == 0
+    n = b.query("SELECT COUNT(*) FROM fact WHERE NOT EXISTS "
+                "(SELECT 1 FROM dim WHERE w = 99)").rows[0][0]
+    assert n == N_F
+
+
+def test_correlated_exists_semi_join(tables):
+    b, fact, dim = tables
+    got = b.query("SELECT COUNT(*) FROM fact WHERE EXISTS "
+                  "(SELECT 1 FROM dim WHERE k2 = k)").rows[0][0]
+    keys = set(dim["k2"].tolist())
+    assert got == int(np.isin(fact["k"], list(keys)).sum())
+
+
+def test_correlated_not_exists_anti_join(tables):
+    b, fact, dim = tables
+    got = b.query("SELECT COUNT(*) FROM fact WHERE NOT EXISTS "
+                  "(SELECT 1 FROM dim WHERE k2 = k)").rows[0][0]
+    keys = set(dim["k2"].tolist())
+    assert got == int((~np.isin(fact["k"], list(keys))).sum())
+
+
+def test_correlated_exists_with_local_predicates(tables):
+    b, fact, dim = tables
+    got = b.query("SELECT COUNT(*) FROM fact WHERE v < 500 AND EXISTS "
+                  "(SELECT 1 FROM dim WHERE k2 = k AND w <= 2)").rows[0][0]
+    keys = set(dim["k2"][dim["w"] <= 2].tolist())
+    expect = int((np.isin(fact["k"], list(keys))
+                  & (fact["v"] < 500)).sum())
+    assert got == expect
+
+
+def test_correlated_exists_qualified_names(tables):
+    b, fact, dim = tables
+    got = b.query(
+        "SELECT COUNT(*) FROM fact WHERE EXISTS "
+        "(SELECT 1 FROM dim WHERE dim.k2 = fact.k AND dim.w = 5)"
+    ).rows[0][0]
+    keys = set(dim["k2"][dim["w"] == 5].tolist())
+    assert got == int(np.isin(fact["k"], list(keys)).sum())
+
+
+def test_correlated_exists_aliased(tables):
+    b, fact, dim = tables
+    got = b.query(
+        "SELECT COUNT(*) FROM fact f WHERE EXISTS "
+        "(SELECT 1 FROM dim d WHERE d.k2 = f.k)").rows[0][0]
+    keys = set(dim["k2"].tolist())
+    assert got == int(np.isin(fact["k"], list(keys)).sum())
+
+
+def test_exists_in_group_by_query(tables):
+    b, fact, dim = tables
+    rows = b.query(
+        "SELECT k, SUM(v) FROM fact WHERE EXISTS "
+        "(SELECT 1 FROM dim WHERE k2 = k AND w = 7) "
+        "GROUP BY k ORDER BY k LIMIT 100000").rows
+    keys = sorted(set(dim["k2"][dim["w"] == 7].tolist())
+                  & set(fact["k"].tolist()))
+    assert [r[0] for r in rows] == keys
+    for r in rows:
+        assert r[1] == int(fact["v"][fact["k"] == r[0]].sum())
+
+
+def test_self_table_correlated_exists_with_alias(tables):
+    """An inner alias REPLACES the table name as a qualifier, so the
+    outer-qualified reference to the same table is a real correlation
+    (not a constant fold)."""
+    b, fact, _ = tables
+    got = b.query(
+        "SELECT COUNT(*) FROM fact WHERE EXISTS "
+        "(SELECT 1 FROM fact f2 WHERE f2.k = fact.k AND f2.v > 900)"
+    ).rows[0][0]
+    keys = set(fact["k"][fact["v"] > 900].tolist())
+    assert got == int(np.isin(fact["k"], list(keys)).sum())
+    assert 0 < got < N_F
+
+
+def test_exists_stays_a_valid_column_name(tmp_path):
+    b2 = Broker()
+    dm = TableDataManager("flags")
+    dm.add_segment_dir(SegmentBuilder(
+        Schema("flags", [FieldSpec("exists", DataType.INT),
+                         FieldSpec("v", DataType.INT)]),
+        TableConfig("flags")).build(
+            {"exists": np.array([0, 1, 1], np.int32),
+             "v": np.array([5, 6, 7], np.int32)}, str(tmp_path), "s0"))
+    b2.register_table(dm)
+    rows = b2.query('SELECT "exists", v FROM flags WHERE "exists" = 1 '
+                    "ORDER BY v").rows
+    assert rows == [(1, 6), (1, 7)]
+    # unquoted works too — 'exists' is contextual, not reserved
+    n = b2.query("SELECT COUNT(*) FROM flags WHERE exists = 0").rows[0][0]
+    assert n == 1
+
+
+def test_unsupported_correlation_shapes_error(tables):
+    b, *_ = tables
+    with pytest.raises(SqlError, match="correlated EXISTS"):
+        b.query("SELECT COUNT(*) FROM fact WHERE EXISTS "
+                "(SELECT 1 FROM dim WHERE k2 = k AND w = k)")
+    with pytest.raises(SqlError, match="correlated EXISTS"):
+        b.query("SELECT COUNT(*) FROM fact WHERE EXISTS "
+                "(SELECT 1 FROM dim WHERE k2 < k)")
+    with pytest.raises(SqlError, match="unknown qualifier"):
+        b.query("SELECT COUNT(*) FROM fact WHERE EXISTS "
+                "(SELECT 1 FROM dim WHERE dim.k2 = zzz.k)")
+
+
+def test_explain_with_exists_does_not_execute(tables):
+    b, *_ = tables
+    rows = b.query("EXPLAIN PLAN FOR SELECT COUNT(*) FROM fact "
+                   "WHERE EXISTS (SELECT 1 FROM dim WHERE k2 = k)").rows
+    assert rows, "explain produced no plan"
