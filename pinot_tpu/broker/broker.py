@@ -360,12 +360,20 @@ class Broker:
         # the outer name as a correlation, not as itself)
         outer_labels = {(stmt.table_alias or stmt.table).lower()}
         inner_labels = {(sub.table_alias or sub.table).lower()}
-        outer_schema = self.table(stmt.table).schema
-        outer_cols = {f.name for f in outer_schema.fields} \
-            if outer_schema else set()
-        inner_schema = self.table(sub.table).schema
-        inner_cols = {f.name for f in inner_schema.fields} \
-            if inner_schema else set()
+
+        def cols_of(table: str) -> set:
+            # tolerant: hybrid logical names (ev -> ev_OFFLINE/_REALTIME)
+            # aren't in _tables; qualified correlation still classifies
+            # by label, and a misjudged bare identifier surfaces as an
+            # unknown-column error at execution, never a wrong result
+            try:
+                schema = self.table(table).schema
+            except SqlError:
+                return set()
+            return {f.name for f in schema.fields} if schema else set()
+
+        outer_cols = cols_of(stmt.table)
+        inner_cols = cols_of(sub.table)
 
         def side(ident: str):
             """'inner' | 'outer' for an identifier in the subquery."""

@@ -1,2 +1,6 @@
-"""Filesystem plugins (pinot-plugins/pinot-file-system analog)."""
+"""Filesystem plugins (pinot-plugins/pinot-file-system analog):
+S3 (SigV4 REST), GCS (JSON API), HDFS (WebHDFS), ADLS Gen2 (dfs)."""
+from .adls import AdlsClient, AdlsPinotFS  # noqa: F401
+from .gcs import GcsClient, GcsPinotFS  # noqa: F401
+from .hdfs import HdfsPinotFS, WebHdfsClient  # noqa: F401
 from .s3 import S3Client, S3PinotFS, sigv4_headers  # noqa: F401

@@ -334,11 +334,8 @@ class S3PinotFS(PinotFS):
 
     @staticmethod
     def _split(path: str) -> Tuple[str, str]:
-        path = path.lstrip("/")
-        bucket, _, key = path.partition("/")
-        if not bucket:
-            raise ValueError(f"s3 path needs a bucket: {path!r}")
-        return bucket, key
+        from .common import split_bucket_path
+        return split_bucket_path(path, "s3")
 
     def exists(self, path: str) -> bool:
         bucket, key = self._split(path)
@@ -412,41 +409,28 @@ class S3PinotFS(PinotFS):
         self.delete(src, force=True)
 
     def copy_from_local(self, local_src: str, dst: str) -> None:
+        from .common import iter_file_chunks, walk_local
         bucket, key = self._split(dst)
         if os.path.isdir(local_src):
-            for root, _dirs, files in os.walk(local_src):
-                for f in files:
-                    full = os.path.join(root, f)
-                    rel = os.path.relpath(full, local_src)
-                    self.copy_from_local(
-                        full, f"{bucket}/{key.rstrip('/')}/"
-                        + rel.replace(os.sep, "/"))
+            for full, rel in walk_local(local_src):
+                self.copy_from_local(
+                    full, f"{bucket}/{key.rstrip('/')}/{rel}")
             return
         size = os.path.getsize(local_src)
-        if size <= self.client.part_size:
-            with open(local_src, "rb") as fh:
+        with open(local_src, "rb") as fh:
+            if size <= self.client.part_size:
                 self.client.put_object(bucket, key, fh.read())
-            return
-
-        def parts() -> Iterator[bytes]:
-            with open(local_src, "rb") as fh:
-                while True:
-                    chunk = fh.read(self.client.part_size)
-                    if not chunk:
-                        return
-                    yield chunk
-
-        self.client.multipart_upload(bucket, key, parts())
+            else:
+                self.client.multipart_upload(
+                    bucket, key,
+                    iter_file_chunks(fh, self.client.part_size))
 
     def copy_to_local(self, src: str, local_dst: str) -> None:
+        from .common import download_ranged
         bucket, key = self._split(src)
         size = self.client.head_object(bucket, key)
         if size is None:
             raise FileNotFoundError(src)
-        os.makedirs(os.path.dirname(local_dst) or ".", exist_ok=True)
-        with open(local_dst, "wb") as fh:
-            pos = 0
-            while pos < size:
-                end = min(pos + self.DOWNLOAD_CHUNK, size) - 1
-                fh.write(self.client.get_object(bucket, key, (pos, end)))
-                pos = end + 1
+        download_ranged(
+            lambda lo, hi: self.client.get_object(bucket, key, (lo, hi)),
+            size, local_dst, self.DOWNLOAD_CHUNK)

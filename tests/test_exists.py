@@ -168,6 +168,41 @@ def test_unsupported_correlation_shapes_error(tables):
                 "(SELECT 1 FROM dim WHERE dim.k2 = zzz.k)")
 
 
+def test_exists_on_hybrid_outer_table(tables, tmp_path):
+    """A hybrid (OFFLINE+REALTIME) outer table has no entry under its
+    logical name; EXISTS resolution must stay tolerant (qualified
+    correlation classifies by label, never by schema lookup)."""
+    b, fact, dim = tables
+    rng = np.random.default_rng(41)
+    hv = rng.integers(0, 400, 600).astype(np.int32)
+    bh = Broker()
+    for side in ("OFFLINE", "REALTIME"):
+        dm = TableDataManager(f"ev_{side}")
+        dm.add_segment_dir(SegmentBuilder(
+            Schema(f"ev_{side}", [FieldSpec("k", DataType.INT),
+                                  FieldSpec("ts", DataType.LONG,
+                                            FieldType.DATE_TIME)]),
+            TableConfig(f"ev_{side}")).build(
+                {"k": hv, "ts": np.arange(600, dtype=np.int64)},
+                str(tmp_path), f"ev_{side.lower()}_s0"))
+        bh.register_table(dm)
+    # reuse the dim table for the subquery side
+    bh.register_table(b.table("dim"))
+    # uncorrelated: needs no outer schema at all. The time boundary
+    # (max offline ts) keeps exactly one copy of each row visible.
+    n = bh.query("SELECT COUNT(*) FROM ev WHERE EXISTS "
+                 "(SELECT 1 FROM dim WHERE w = 3)").rows[0][0]
+    assert n == 600
+    # correlated via qualified names: labels alone classify
+    got = bh.query("SELECT COUNT(*) FROM ev WHERE EXISTS "
+                   "(SELECT 1 FROM dim d WHERE d.k2 = ev.k)").rows[0][0]
+    keys = set()
+    for r in b.query("SELECT k2 FROM dim GROUP BY k2 "
+                     "LIMIT 100000").rows:
+        keys.add(r[0])
+    assert got == int(np.isin(hv, list(keys)).sum())
+
+
 def test_explain_with_exists_does_not_execute(tables):
     b, *_ = tables
     rows = b.query("EXPLAIN PLAN FOR SELECT COUNT(*) FROM fact "
