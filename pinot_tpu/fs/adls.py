@@ -79,10 +79,14 @@ class AdlsClient:
             chunk = fh.read(self.chunk_size)
             if not chunk:
                 break
+            # append is NOT idempotent (a blind transport replay after a
+            # lost response lands at a stale position and 409s); surface
+            # transient failures to the caller instead (rest.py contract:
+            # idempotent requests only)
             st, _h, body = self.rest.request(
                 "PATCH", self._p(fs, path),
                 query={"action": "append", "position": str(pos)},
-                headers=self._auth(), body=chunk)
+                headers=self._auth(), body=chunk, retriable=False)
             self._check(st, body, ok=(202,))
             pos += len(chunk)
         st, _h, body = self.rest.request(

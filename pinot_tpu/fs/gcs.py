@@ -31,6 +31,17 @@ from .common import (TokenSource, bearer_headers, download_ranged,
 from .rest import RestClient, RestError
 
 
+def _committed_end(range_header: Optional[str]) -> int:
+    """Last byte index the service persisted, from a 308 Range header
+    ('bytes=0-N'); -1 when absent (nothing persisted — resend from 0)."""
+    if not range_header:
+        return -1
+    try:
+        return int(range_header.split("-")[-1])
+    except ValueError:
+        return -1
+
+
 class GcsClient:
     def __init__(self, endpoint_url: str, token: TokenSource = None,
                  timeout: float = 30.0, max_retries: int = 3,
@@ -97,9 +108,17 @@ class GcsClient:
                 body=chunk)
             if end + 1 < total:
                 self._check(st, body, ok=(308,))
+                # the 308 Range header reports how much the service
+                # PERSISTED — it may be less than the chunk sent (the
+                # resumable protocol's whole point); resume from there,
+                # never past it
+                committed = _committed_end(h.get("range"))
+                if committed + 1 != end + 1:
+                    fh.seek(committed + 1)
+                pos = committed + 1
             else:
                 self._check(st, body, ok=(200, 201))
-            pos = end + 1
+                pos = end + 1
 
     def download(self, bucket: str, obj: str,
                  rng: Optional[Tuple[int, int]] = None) -> bytes:

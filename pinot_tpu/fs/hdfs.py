@@ -192,11 +192,9 @@ class HdfsPinotFS(PinotFS):
             self.client.create(dst, fh.read())
 
     def copy_to_local(self, src: str, local_dst: str) -> None:
+        from .common import download_ranged
         size = self.length(src)
-        os.makedirs(os.path.dirname(local_dst) or ".", exist_ok=True)
-        with open(local_dst, "wb") as fh:
-            pos = 0
-            while pos < size:
-                n = min(self.DOWNLOAD_CHUNK, size - pos)
-                fh.write(self.client.open(src, offset=pos, length=n))
-                pos += n
+        download_ranged(
+            lambda lo, hi: self.client.open(src, offset=lo,
+                                            length=hi - lo + 1),
+            size, local_dst, self.DOWNLOAD_CHUNK)

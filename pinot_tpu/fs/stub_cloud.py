@@ -207,6 +207,17 @@ class _GcsHandler(_BaseHandler):
         if lo != len(buf):
             return self._err(
                 409, f"out-of-order chunk at {lo}, have {len(buf)}")
+        with self.stub._lock:
+            truncate = self.stub.truncate_next > 0
+            if truncate:
+                self.stub.truncate_next -= 1
+        if truncate and len(body) > 1:
+            # persist only half the chunk: the 308 Range tells the
+            # client where to resume (the resumable protocol contract)
+            body = body[: len(body) // 2]
+            buf.extend(body)
+            return self._respond(
+                308, headers={"Range": f"bytes=0-{len(buf) - 1}"})
         buf.extend(body)
         if hi + 1 == total:
             self.stub.objects[(bucket, name)] = bytes(buf)
@@ -234,7 +245,15 @@ class FakeGcsServer(_BaseServer):
         self.objects: Dict[Tuple[str, str], bytes] = {}
         self.sessions: Dict[str, tuple] = {}
         self.next_session = 0
+        self.truncate_next = 0     # partial-persist injection (308 Range)
         super().__init__(port, token=token, page=page)
+
+    def truncate_chunks(self, n: int) -> None:
+        """Make the next n resumable chunk PUTs persist only half and
+        reply 308 with the committed Range — clients must resume from
+        the reported offset, not their own bookkeeping."""
+        with self._lock:
+            self.truncate_next = n
 
 
 # ---------------------------------------------------------------------------

@@ -70,6 +70,16 @@ class RealtimeTableDataManager(TableDataManager):
 
         self._mutables: Dict[int, MutableSegment] = {}
         self._mutable_age: Dict[int, float] = {}
+        # non-dense stream offsets (Kinesis sequence numbers have gaps):
+        # per partition, the stream offset of EVERY row in the consuming
+        # mutable (MessageBatch.row_offsets), so the offset after any
+        # sealed row count resolves exactly — even when a concurrent
+        # external seal captures a row count mid-batch. In-memory only:
+        # a restart falls back to the committed checkpoint, which
+        # re-consumes the tail exactly like the dense path. Dense streams
+        # (kafka/wirestream/file) publish no row_offsets and keep the
+        # checkpoint+rows arithmetic unchanged.
+        self._row_offsets: Dict[int, List[int]] = {}
         self._state: Dict[str, Dict[str, Any]] = self._load_state()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -196,7 +206,18 @@ class RealtimeTableDataManager(TableDataManager):
         m.start_offset = st["next_offset"]
         self._mutables[p] = m
         self._mutable_age[p] = time.monotonic()
+        self._row_offsets[p] = []
         return m
+
+    def _stream_offset(self, p: int, rows: int) -> int:
+        """Stream offset after `rows` rows of the consuming mutable: the
+        recorded per-row offset when the stream publishes them (gapped
+        Kinesis sequence numbers), else the dense checkpoint+rows
+        arithmetic (kafka-style contiguous offsets)."""
+        offs = self._row_offsets.get(p)
+        if offs and 0 < rows <= len(offs):
+            return offs[rows - 1] + 1
+        return self._partition_state(p)["next_offset"] + rows
 
     def consume_once(self, p: int, consumer=None) -> int:
         """Drain currently-available messages for one partition; returns
@@ -207,17 +228,25 @@ class RealtimeTableDataManager(TableDataManager):
         try:
             total = 0
             while True:
-                st = self._partition_state(p)
                 m = self._mutables[p]
                 # never overshoot the seal threshold inside one batch
                 room = max(1, self.stream_config.flush_threshold_rows
                            - m.n_docs)
-                offset = st["next_offset"] + m.n_docs
+                offset = self._stream_offset(p, m.n_docs)
                 batch: MessageBatch = consumer.fetch(
                     offset, min(FETCH_BATCH, room))
                 if not batch.rows:
                     break
                 self._index_rows(p, m, batch.rows, offset)
+                if batch.row_offsets is not None:
+                    offs = self._row_offsets[p]
+                    if len(offs) + len(batch.row_offsets) == m.n_docs:
+                        offs.extend(batch.row_offsets)
+                    else:
+                        # a stream that mixes offset-bearing and dense
+                        # batches can't be tracked per-row; drop to the
+                        # dense arithmetic (empty list stays empty)
+                        self._row_offsets[p] = []
                 total += len(batch.rows)
                 self._maybe_seal(p)
             return total
@@ -299,10 +328,9 @@ class RealtimeTableDataManager(TableDataManager):
             return
         self._last_report[p] = now
         cc = self.completion_client
-        st = self._partition_state(p)
         m = self._mutables[p]
         name = m.name
-        offset = st["next_offset"] + m.n_docs
+        offset = self._stream_offset(p, m.n_docs)
         try:
             resp = cc.segment_consumed(self.table_name, name, offset)
         except Exception:
@@ -408,7 +436,7 @@ class RealtimeTableDataManager(TableDataManager):
         with open(meta_path) as fh:
             meta = json.load(fh)
         meta["startOffset"] = st["next_offset"]
-        meta["endOffset"] = st["next_offset"] + sealed
+        meta["endOffset"] = self._stream_offset(p, sealed)
         meta["partition"] = p
         with open(meta_path, "w") as fh:
             json.dump(meta, fh, indent=1)
@@ -429,7 +457,7 @@ class RealtimeTableDataManager(TableDataManager):
         if p in self._upsert:
             self._upsert[p].remap_segment(m, seg, sealed)
         self.add_segment(seg)  # atomic swap: queries see it immediately
-        st["next_offset"] += sealed
+        st["next_offset"] = self._stream_offset(p, sealed)
         st["seq"] += 1
         st["segments"].append(m.name)
         self._write_state()

@@ -50,6 +50,11 @@ class StreamConfig:
 class MessageBatch:
     rows: List[Mapping[str, Any]]
     next_offset: int
+    # per-row stream offsets for NON-DENSE streams (Kinesis sequence
+    # numbers have gaps): row_offsets[i] is the offset of rows[i], and
+    # the offset "after" it is row_offsets[i] + 1. None = dense stream
+    # (offset arithmetic is checkpoint + row count).
+    row_offsets: Optional[List[int]] = None
 
     @property
     def message_count(self) -> int:

@@ -119,6 +119,22 @@ def test_gcs_resumable_upload_chunks(tmp_path):
         srv.stop()
 
 
+def test_gcs_resumable_resumes_from_308_range(tmp_path):
+    """The service may persist LESS than a chunk carried; the 308 Range
+    header is authoritative and the client must resume from it."""
+    srv = FakeGcsServer()
+    try:
+        client = GcsClient(srv.endpoint_url, chunk_size=256 << 10)
+        payload = os.urandom(900_000)
+        src = tmp_path / "p.bin"
+        src.write_bytes(payload)
+        srv.truncate_chunks(2)
+        GcsPinotFS(client).copy_from_local(str(src), "bkt/p.bin")
+        assert srv.objects[("bkt", "p.bin")] == payload
+    finally:
+        srv.stop()
+
+
 def test_gcs_bad_token_rejected(tmp_path):
     srv = FakeGcsServer(token="good")
     try:

@@ -1,0 +1,184 @@
+"""Kinesis stream plugin against the fake Kinesis API endpoint.
+
+Reference analog: KinesisConsumer.java:45 / KinesisConsumerFactory /
+KinesisStreamMetadataProvider, tested against localstack in the
+reference; here the fixture is FakeKinesisServer — an in-process HTTP
+endpoint speaking the real Kinesis JSON API (X-Amz-Target dispatch,
+SigV4 verification, opaque one-shot shard iterators, base64 Data,
+NON-DENSE sequence numbers). The realtime-table integration mirrors
+tests/test_kafka.py: consume + seal + crash-restart exactly-once.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.realtime import RealtimeTableDataManager, StreamConfig
+from pinot_tpu.realtime.kinesis import (FakeKinesisServer, KinesisClient,
+                                        KinesisError, KinesisStream)
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+
+@pytest.fixture
+def kinesis():
+    srv = FakeKinesisServer({"events": 2}, access_key="AK",
+                            secret_key="SK")
+    yield srv
+    srv.stop()
+
+
+def _stream(srv, **kw):
+    return KinesisStream("events", srv.endpoint_url, access_key="AK",
+                         secret_key="SK", **kw)
+
+
+def test_list_shards_and_partitions(kinesis):
+    assert _stream(kinesis).num_partitions() == 2
+
+
+def test_unknown_stream_errors(kinesis):
+    s = KinesisStream("missing", kinesis.endpoint_url, access_key="AK",
+                      secret_key="SK")
+    with pytest.raises(KinesisError, match="ResourceNotFound"):
+        s.num_partitions()
+
+
+def test_putrecord_getrecords_roundtrip_nondense_seqs(kinesis):
+    client = KinesisClient(kinesis.endpoint_url, access_key="AK",
+                           secret_key="SK")
+    shard, seq1 = client.put_record("events", b'{"a": 1}', "k1")
+    _, seq2 = client.put_record("events", b'{"a": 2}', "k1")
+    assert int(seq2) > int(seq1) + 1          # gaps are real
+    idx = int(shard.rsplit("-", 1)[-1])
+    consumer = _stream(kinesis).create_consumer(idx)
+    batch = consumer.fetch(0, 100)
+    assert [r["a"] for r in batch.rows] == [1, 2]
+    assert batch.next_offset == int(seq2) + 1
+    # resume AFTER the checkpoint: nothing new -> empty, offset stable
+    again = consumer.fetch(batch.next_offset, 100)
+    assert again.rows == [] and again.next_offset == batch.next_offset
+
+
+def test_resume_mid_stream_no_dups(kinesis):
+    kinesis.put("events", 0, [{"i": i} for i in range(10)])
+    c = _stream(kinesis).create_consumer(0)
+    first = c.fetch(0, 4)
+    assert [r["i"] for r in first.rows] == [0, 1, 2, 3]
+    rest = c.fetch(first.next_offset, 100)
+    assert [r["i"] for r in rest.rows] == list(range(4, 10))
+
+
+def test_iterator_cache_survives_server_side_expiry(kinesis):
+    """Iterators are one-shot in the fake (stricter than AWS's 5 min);
+    a fresh consumer fetch at an arbitrary offset must re-mint via
+    AFTER_SEQUENCE_NUMBER, not reuse a stale token."""
+    kinesis.put("events", 1, [{"x": i} for i in range(6)])
+    c = _stream(kinesis).create_consumer(1)
+    b1 = c.fetch(0, 3)
+    c2 = _stream(kinesis).create_consumer(1)   # no cached iterator
+    b2 = c2.fetch(b1.next_offset, 100)
+    assert [r["x"] for r in b2.rows] == [3, 4, 5]
+
+
+def test_bad_signature_rejected(kinesis):
+    s = KinesisStream("events", kinesis.endpoint_url,
+                      access_key="WRONG", secret_key="nope")
+    with pytest.raises(KinesisError) as ei:
+        s.num_partitions()
+    assert ei.value.status == 403
+
+
+def test_retry_on_injected_500(kinesis):
+    kinesis.put("events", 0, [{"a": 5}])
+    s = _stream(kinesis, backoff=0.01)
+    kinesis.inject_failures(2)
+    assert s.num_partitions() == 2            # retried through the 500s
+
+
+# ---------------------------------------------------------------------------
+# realtime table over the Kinesis API (consume + seal + resume)
+# ---------------------------------------------------------------------------
+
+def _schema():
+    return Schema("kin", [FieldSpec("k", DataType.STRING),
+                          FieldSpec("v", DataType.INT, FieldType.METRIC)])
+
+
+def test_realtime_table_over_kinesis(kinesis, tmp_path):
+    rng = np.random.default_rng(8)
+    rows = [{"k": str(rng.choice(["a", "b"])), "v": int(v)}
+            for v in rng.integers(0, 100, 30)]
+    kinesis.put("events", 0, rows[:15])
+    kinesis.put("events", 1, rows[15:])
+    cfg = StreamConfig("kin", num_partitions=2, flush_threshold_rows=10,
+                       consumer_factory=_stream(kinesis))
+    dm = RealtimeTableDataManager("kin", _schema(), cfg,
+                                  str(tmp_path / "t"))
+    dm.consume_once(0)
+    dm.consume_once(1)
+    b = Broker()
+    b.register_table(dm)
+    got = b.query("SELECT COUNT(*), SUM(v) FROM kin").rows[0]
+    assert got == (len(rows), sum(r["v"] for r in rows))
+    kinesis.put("events", 0, [{"k": "c", "v": 7}])
+    dm.consume_once(0)
+    assert b.query("SELECT COUNT(*) FROM kin").rows[0][0] == len(rows) + 1
+
+
+def test_restart_resumes_exactly_once_from_kinesis(kinesis, tmp_path):
+    kinesis.put("events", 0, [{"k": "a", "v": i} for i in range(150)])
+    cfg = StreamConfig("kin", num_partitions=2, flush_threshold_rows=100,
+                       consumer_factory=_stream(kinesis))
+    dm = RealtimeTableDataManager("kin", _schema(), cfg,
+                                  str(tmp_path / "t"))
+    dm.consume_once(0)
+    assert dm.num_segments == 1               # 100 sealed, 50 consuming
+
+    cfg2 = StreamConfig("kin", num_partitions=2, flush_threshold_rows=100,
+                        consumer_factory=_stream(kinesis))
+    dm2 = RealtimeTableDataManager("kin", _schema(), cfg2,
+                                   str(tmp_path / "t"))
+    kinesis.put("events", 0, [{"k": "a", "v": i}
+                              for i in range(150, 180)])
+    dm2.consume_once(0)
+    b = Broker()
+    b.register_table(dm2)
+    got = b.query("SELECT COUNT(*), SUM(v) FROM kin").rows[0]
+    assert got == (180, sum(range(180)))
+
+
+def test_mid_batch_stream_offsets_exact(kinesis, tmp_path):
+    """Per-row sequence tracking: the offset after ANY row count of the
+    consuming mutable must be the real (gapped) sequence + 1 — the
+    guarantee that keeps an external mid-batch seal exactly-once."""
+    kinesis.put("events", 0, [{"k": "a", "v": i} for i in range(9)])
+    cfg = StreamConfig("kin", num_partitions=2,
+                       flush_threshold_rows=1000,
+                       consumer_factory=_stream(kinesis))
+    dm = RealtimeTableDataManager("kin", _schema(), cfg,
+                                  str(tmp_path / "t"))
+    dm.consume_once(0)
+    seqs = [seq for seq, _pk, _d in kinesis.shards["events"][0]]
+    for rows in range(1, 10):
+        assert dm._stream_offset(0, rows) == seqs[rows - 1] + 1
+    # sealing at the full count commits the REAL sequence checkpoint
+    dm.seal_partition(0)
+    assert dm._partition_state(0)["next_offset"] == seqs[-1] + 1
+
+
+def test_factory_via_plugin_loader(kinesis, tmp_path):
+    kinesis.put("events", 0, [{"k": "z", "v": 1}, {"k": "z", "v": 2}])
+    cfg = StreamConfig(
+        "kp", num_partitions=2,
+        consumer_factory_class="pinot_tpu.realtime.kinesis.KinesisStream",
+        consumer_factory_args={"stream": "events",
+                               "endpoint_url": kinesis.endpoint_url,
+                               "access_key": "AK", "secret_key": "SK"})
+    dm = RealtimeTableDataManager("kp", Schema("kp", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("v", DataType.INT, FieldType.METRIC)]), cfg,
+        str(tmp_path / "t"))
+    dm.consume_once(0)
+    b = Broker()
+    b.register_table(dm)
+    assert b.query("SELECT SUM(v) FROM kp").rows[0][0] == 3
