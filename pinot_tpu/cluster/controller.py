@@ -759,56 +759,47 @@ class Controller:
             self._bump()
         self._delete_artifact(entry.get("location"))
 
-    def ui_page(self) -> str:
-        """Minimal cluster status page (GET /ui) — the controller web
-        app's overview screens (pinot-controller/src/main/resources/app)
-        reduced to one server-rendered HTML table set: instances with
-        liveness, tables with replication, segment assignment."""
-        import html as _h
+    def ui_data(self) -> Dict[str, Any]:
+        """The web app's cluster snapshot (GET /ui/data, and the
+        server-side hydration seed inlined into GET /ui)."""
+        now = time.monotonic()
         with self._lock:
-            # the raw registries, not routing_snapshot(): the snapshot
-            # strips instance tags and table replication, exactly the two
-            # columns a tiering operator reads this page for
-            instances = {i: dict(info)
-                         for i, info in self._instances.items()}
-            tables = {t: {"replication": m.get("replication", 1)}
-                      for t, m in self._state["tables"].items()}
-            segments = {t: sorted(s)
-                        for t, s in self._state["segments"].items()}
-            assignment = {t: {s: list(h) for s, h in a.items()}
-                          for t, a in self._state["assignment"].items()}
+            instances = {
+                i["id"]: {"live": now - i["lastHeartbeat"]
+                          <= self.heartbeat_timeout,
+                          "tags": i.get("tags") or [],
+                          "role": i.get("role"),
+                          "host": (f"{i.get('host')}:{i.get('port')}"
+                                   if i.get("host") else "")}
+                for i in self._instances.values()}
+            tables = {
+                t: {"replication": m.get("replication", 1),
+                    "tenant": (m.get("config") or {}).get("serverTenant"),
+                    "segments": sorted(
+                        self._state["segments"].get(t, {})),
+                    "assignment": {
+                        s: list(h) for s, h in
+                        self._state["assignment"].get(t, {}).items()}}
+                for t, m in self._state["tables"].items()}
             version = self._state["version"]
-            live = set(self.live_servers())
-        snap = {"version": version}
-        rows_i = "".join(
-            f"<tr><td>{_h.escape(i)}</td>"
-            f"<td>{'LIVE' if i in live else 'DEAD'}</td>"
-            f"<td>{_h.escape(','.join(info.get('tags') or []))}"
-            f"</td></tr>"
-            for i, info in sorted(instances.items()))
-        rows_t = "".join(
-            f"<tr><td>{_h.escape(t)}</td>"
-            f"<td>{meta['replication']}</td>"
-            f"<td>{len(segments.get(t) or [])}</td></tr>"
-            for t, meta in sorted(tables.items()))
-        rows_a = "".join(
-            f"<tr><td>{_h.escape(t)}</td><td>{_h.escape(s)}</td>"
-            f"<td>{_h.escape(', '.join(holders))}</td></tr>"
-            for t, segs in sorted(assignment.items())
-            for s, holders in sorted(segs.items()))
-        return (
-            "<!doctype html><html><head><title>pinot-tpu controller"
-            "</title><style>body{font-family:sans-serif;margin:2em}"
-            "table{border-collapse:collapse;margin-bottom:2em}"
-            "td,th{border:1px solid #999;padding:4px 10px}</style></head>"
-            f"<body><h1>pinot-tpu controller</h1>"
-            f"<p>routing version {snap.get('version')}</p>"
-            f"<h2>Instances</h2><table><tr><th>id</th><th>state</th>"
-            f"<th>tags</th></tr>{rows_i}</table>"
-            f"<h2>Tables</h2><table><tr><th>table</th><th>replication"
-            f"</th><th>segments</th></tr>{rows_t}</table>"
-            f"<h2>Assignment</h2><table><tr><th>table</th><th>segment"
-            f"</th><th>servers</th></tr>{rows_a}</table></body></html>")
+        lease = self._read_lease() or {}
+        tasks = {t["name"]: {k: v for k, v in t.items() if k != "name"}
+                 for t in self.scheduler.status()}
+        return {"version": version, "instances": instances,
+                "tables": tables, "tasks": tasks,
+                "instance_id": self.instance_id,
+                "leader": (self.instance_id if self.is_leader
+                           else lease.get("holder")),
+                "lease_holder": lease.get("holder")}
+
+    def ui_page(self) -> str:
+        """The controller web application (GET /ui): the reference's
+        React cluster manager (pinot-controller/src/main/resources/app)
+        as one server-bootstrapped single-page app — cluster/tables/
+        tasks/query-console views hydrated from the inlined snapshot,
+        live-refreshing from /ui/data (cluster/webapp.py)."""
+        from .webapp import render_app
+        return render_app(self.ui_data())
 
     def routing_snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -888,6 +879,7 @@ class Controller:
             routes = {
                 ("GET", "/ui"): lambda h, b: (
                     200, ("text/html", ctrl.ui_page())),
+                ("GET", "/ui/data"): lambda h, b: (200, ctrl.ui_data()),
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
                 ("POST", "/instances"): lambda h, b: (
                     ctrl.register_instance(b) or (200, {"status": "OK"})),

@@ -1,0 +1,245 @@
+"""Controller web application (single-file SPA, no build step).
+
+Reference parity: pinot-controller/src/main/resources/app — the React/TS
+cluster manager. The TPU-native stance replaces the 500-module React
+build with one server-bootstrapped page: the controller renders the
+current cluster snapshot INTO the page (so the first paint needs no
+round trip and the page is meaningful to curl/tests), and the embedded
+vanilla-JS app hydrates from it, then live-refreshes from GET /ui/data
+and drives the admin REST (rebalance, periodic tasks, segment delete)
+and any broker's /query/sql console.
+
+Views (hash-routed): #/cluster (instances + leadership), #/tables
+(list -> per-table detail: segments, assignment, rebalance), #/tasks
+(periodic task status + run), #/query (SQL console with EXPLAIN toggle
+against a configurable broker URL, persisted in localStorage).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8">
+<title>pinot-tpu controller</title>
+<style>
+:root{--fg:#1d2733;--mut:#6b7a90;--line:#d7dee8;--acc:#2458e6;
+--bad:#c0392b;--ok:#1e8e3e;--bg:#f6f8fb}
+*{box-sizing:border-box}
+body{font-family:system-ui,sans-serif;margin:0;color:var(--fg);
+background:var(--bg)}
+header{display:flex;align-items:center;gap:24px;padding:10px 20px;
+background:#fff;border-bottom:1px solid var(--line)}
+header h1{font-size:16px;margin:0}
+nav a{margin-right:14px;text-decoration:none;color:var(--mut);
+font-weight:600;font-size:14px}
+nav a.on{color:var(--acc)}
+main{padding:20px;max-width:1100px}
+table{border-collapse:collapse;background:#fff;width:100%;
+margin:10px 0 24px}
+td,th{border:1px solid var(--line);padding:6px 10px;font-size:13px;
+text-align:left}
+th{background:#eef2f8}
+.badge{padding:1px 8px;border-radius:9px;font-size:12px;color:#fff}
+.live{background:var(--ok)}.dead{background:var(--bad)}
+button{background:var(--acc);border:0;color:#fff;border-radius:4px;
+padding:5px 12px;font-size:13px;cursor:pointer}
+button.sec{background:#fff;color:var(--acc);
+border:1px solid var(--acc)}
+textarea{width:100%;height:90px;font-family:ui-monospace,monospace;
+font-size:13px;padding:8px;border:1px solid var(--line);
+border-radius:4px}
+input[type=text]{padding:5px 8px;border:1px solid var(--line);
+border-radius:4px;font-size:13px;width:320px}
+.err{color:var(--bad);white-space:pre-wrap;font-family:monospace}
+.mut{color:var(--mut);font-size:12px}
+a.tbl{color:var(--acc);cursor:pointer;text-decoration:underline}
+</style></head><body>
+<header><h1>pinot-tpu controller</h1>
+<nav id="nav"></nav>
+<span class="mut" id="meta"></span>
+<label class="mut" style="margin-left:auto">
+<input type="checkbox" id="auto" checked> auto-refresh</label>
+</header>
+<main id="main"></main>
+<script id="bootstrap" type="application/json">__BOOTSTRAP__</script>
+<script>
+"use strict";
+let D = JSON.parse(document.getElementById("bootstrap").textContent);
+const $ = (h) => { const d = document.createElement("div");
+  d.innerHTML = h; return d; };
+const esc = (s) => String(s).replace(/[&<>"'\\\\]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;",
+         "'":"&#39;","\\\\":"&#92;"}[c]));
+const VIEWS = [["#/cluster","Cluster"],["#/tables","Tables"],
+  ["#/tasks","Tasks"],["#/query","Query console"]];
+
+function nav() {
+  const cur = location.hash || "#/cluster";
+  document.getElementById("nav").innerHTML = VIEWS.map(([h, t]) =>
+    `<a href="${h}" class="${cur.startsWith(h) ? "on" : ""}">${t}</a>`
+  ).join("");
+  document.getElementById("meta").textContent =
+    `routing v${D.version} · leader: ${D.leader || "?"}`;
+}
+
+async function refresh() {
+  try {
+    const r = await fetch("/ui/data");
+    if (r.ok) { D = await r.json(); render(); }
+  } catch (e) { /* controller restarting: keep the last snapshot */ }
+}
+
+function table(headers, rows) {
+  return `<table><tr>${headers.map(h => `<th>${h}</th>`).join("")}</tr>`
+    + rows.map(r => `<tr>${r.map(c => `<td>${c}</td>`).join("")}</tr>`)
+      .join("") + "</table>";
+}
+
+function vCluster() {
+  const inst = Object.entries(D.instances).map(([id, i]) =>
+    [esc(id),
+     `<span class="badge ${i.live ? "live" : "dead"}">` +
+       `${i.live ? "LIVE" : "DEAD"}</span>`,
+     esc((i.tags || []).join(", ")), esc(i.host || "")]);
+  return `<h2>Instances</h2>` +
+    table(["id", "state", "tags", "host"], inst) +
+    `<h2>Leadership</h2>` +
+    table(["leader", "lease holder", "this instance"],
+      [[esc(D.leader || "-"), esc(D.lease_holder || "-"),
+        esc(D.instance_id || "-")]]);
+}
+
+function vTables() {
+  const rows = Object.entries(D.tables).map(([t, m]) =>
+    [`<a class="tbl" href="#/tables/${encodeURIComponent(t)}">` +
+       `${esc(t)}</a>`,
+     m.replication, (m.segments || []).length,
+     esc(m.tenant || "default")]);
+  return "<h2>Tables</h2>" +
+    table(["table", "replication", "segments", "tenant"], rows);
+}
+
+function vTable(t) {
+  const m = D.tables[t];
+  if (!m) return `<p class="err">unknown table ${esc(t)}</p>`;
+  const segs = (m.segments || []).map(s =>
+    [esc(s), esc(((m.assignment || {})[s] || []).join(", ")),
+     `<button class="sec" data-act="del" data-t="${esc(t)}"` +
+       ` data-s="${esc(s)}">delete</button>`]);
+  return `<h2>${esc(t)}</h2>
+    <p><button data-act="reb" data-t="${esc(t)}">rebalance</button>
+    <span class="mut" id="actmsg"></span></p>
+    <h3>Segments</h3>` +
+    table(["segment", "servers", ""], segs);
+}
+
+function vTasks() {
+  const rows = Object.entries(D.tasks || {}).map(([n, s]) =>
+    [esc(n), esc(JSON.stringify(s)),
+     `<button class="sec" data-act="task" data-t="${esc(n)}">` +
+       "run</button>"]);
+  return "<h2>Periodic tasks</h2>" + table(["task", "status", ""], rows);
+}
+
+function vQuery() {
+  const broker = localStorage.getItem("brokerUrl") || "";
+  return `<h2>Query console</h2>
+    <p>broker URL: <input type="text" id="broker"
+      value="${esc(broker)}" placeholder="http://host:port">
+      <label class="mut"><input type="checkbox" id="explain">
+      EXPLAIN</label></p>
+    <textarea id="sql">SELECT 1</textarea>
+    <p><button data-act="query">run</button>
+    <span class="mut" id="qtime"></span></p>
+    <div id="qout"></div>`;
+}
+
+async function runQuery() {
+  const broker = document.getElementById("broker").value.trim();
+  localStorage.setItem("brokerUrl", broker);
+  let sql = document.getElementById("sql").value;
+  if (document.getElementById("explain").checked)
+    sql = "EXPLAIN PLAN FOR " + sql;
+  const out = document.getElementById("qout");
+  const t0 = performance.now();
+  try {
+    const r = await fetch(broker + "/query/sql", {method: "POST",
+      headers: {"Content-Type": "application/json"},
+      body: JSON.stringify({sql})});
+    const res = await r.json();
+    const ms = (performance.now() - t0).toFixed(1);
+    if (res.exceptions && res.exceptions.length) {
+      out.innerHTML = `<p class="err">${esc(
+        JSON.stringify(res.exceptions))}</p>`;
+    } else {
+      const rt = res.resultTable || {columns: [], rows: []};
+      out.innerHTML = table(rt.columns.map(esc),
+        rt.rows.map(row => row.map(c => esc(JSON.stringify(c)))));
+      document.getElementById("qtime").textContent =
+        `${rt.rows.length} rows · ${ms} ms (round trip)`;
+    }
+  } catch (e) {
+    out.innerHTML = `<p class="err">${esc(e)}</p>`;
+  }
+}
+
+async function post(path) {
+  const r = await fetch(path, {method: "POST"});
+  return r.ok ? r.json().catch(() => ({})) : {error: r.status};
+}
+async function rebalance(t) {
+  const res = await post("/rebalance/" + encodeURIComponent(t));
+  document.getElementById("actmsg").textContent =
+    "rebalance: " + JSON.stringify(res);
+  refresh();
+}
+async function runTask(n) {
+  await post("/periodictask/run/" + encodeURIComponent(n));
+  refresh();
+}
+async function delSeg(t, s) {
+  if (!confirm(`delete segment ${s} of ${t}?`)) return;
+  await fetch(`/segments/${encodeURIComponent(t)}/` +
+    encodeURIComponent(s), {method: "DELETE"});
+  refresh();
+}
+
+function render() {
+  nav();
+  const h = location.hash || "#/cluster";
+  const main = document.getElementById("main");
+  const mt = h.match(/^#\\/tables\\/(.+)$/);
+  if (mt) main.innerHTML = vTable(decodeURIComponent(mt[1]));
+  else if (h.startsWith("#/tables")) main.innerHTML = vTables();
+  else if (h.startsWith("#/tasks")) main.innerHTML = vTasks();
+  else if (h.startsWith("#/query")) main.innerHTML = vQuery();
+  else main.innerHTML = vCluster();
+}
+// event delegation via data attributes: dataset values arrive
+// entity-DECODED as plain strings, so names with quotes/backslashes
+// can never become executable script (no inline onclick handlers)
+document.addEventListener("click", (ev) => {
+  const b = ev.target.closest("button[data-act]");
+  if (!b) return;
+  const {act, t, s} = b.dataset;
+  if (act === "del") delSeg(t, s);
+  else if (act === "reb") rebalance(t);
+  else if (act === "task") runTask(t);
+  else if (act === "query") runQuery();
+});
+window.addEventListener("hashchange", render);
+setInterval(() => {
+  if (document.getElementById("auto").checked
+      && !(location.hash || "").startsWith("#/query")) refresh();
+}, 3000);
+render();
+</script></body></html>"""
+
+
+def render_app(bootstrap: Dict[str, Any]) -> str:
+    """The SPA page with the cluster snapshot inlined (hydration seed —
+    first paint and curl/tests see real data with zero extra fetches).
+    `</` must not appear un-escaped inside a <script> block."""
+    blob = json.dumps(bootstrap).replace("</", "<\\/")
+    return _PAGE.replace("__BOOTSTRAP__", blob)

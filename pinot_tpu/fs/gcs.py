@@ -106,19 +106,23 @@ class GcsClient:
                 headers={**self._auth(),
                          "Content-Range": f"bytes {pos}-{end}/{total}"},
                 body=chunk)
-            if end + 1 < total:
-                self._check(st, body, ok=(308,))
+            if st == 308:
                 # the 308 Range header reports how much the service
-                # PERSISTED — it may be less than the chunk sent (the
-                # resumable protocol's whole point); resume from there,
-                # never past it
+                # PERSISTED — possibly less than the chunk sent, on ANY
+                # chunk including the final one (the resumable
+                # protocol's whole point); resume from there, never past
                 committed = _committed_end(h.get("range"))
                 if committed + 1 != end + 1:
                     fh.seek(committed + 1)
                 pos = committed + 1
+                continue
+            # non-308: only a completed upload is acceptable, and only
+            # on the final chunk
+            if end + 1 < total:
+                self._check(st, body, ok=(308,))
             else:
                 self._check(st, body, ok=(200, 201))
-                pos = end + 1
+            pos = end + 1
 
     def download(self, bucket: str, obj: str,
                  rng: Optional[Tuple[int, int]] = None) -> bytes:

@@ -152,10 +152,20 @@ class KinesisStream(StreamConsumerFactory):
         self.client = KinesisClient(endpoint_url, access_key, secret_key,
                                     region, **client_kw)
         self.value_decoder = value_decoder
+        self._shard_cache: Optional[List[str]] = None
 
-    def _shard_ids(self) -> List[str]:
-        return sorted(s["ShardId"]
-                      for s in self.client.list_shards(self.stream))
+    def _shard_ids(self, refresh: bool = False) -> List[str]:
+        """Sorted shard ids, cached after the first ListShards (the
+        reference's metadata-provider caching) — steady-state consumption
+        performs zero ListShards calls. refresh_shards() re-lists after
+        a reshard."""
+        if self._shard_cache is None or refresh:
+            self._shard_cache = sorted(
+                s["ShardId"] for s in self.client.list_shards(self.stream))
+        return self._shard_cache
+
+    def refresh_shards(self) -> List[str]:
+        return self._shard_ids(refresh=True)
 
     def num_partitions(self) -> int:
         return len(self._shard_ids())
@@ -198,7 +208,17 @@ class KinesisShardConsumer(PartitionGroupConsumer):
 
     def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
         it = self._iterator_for(start_offset)
-        res = self.client.get_records(it, max_messages)
+        try:
+            res = self.client.get_records(it, max_messages)
+        except KinesisError as e:
+            # a cached iterator can expire (5-minute service TTL);
+            # re-mint once from the sequence number and retry — without
+            # this, a quiet partition wedges permanently on one token
+            self._cached = None
+            if e.type != "ExpiredIteratorException":
+                raise
+            res = self.client.get_records(
+                self._iterator_for(start_offset), max_messages)
         rows: List[Mapping[str, Any]] = []
         row_offsets: List[int] = []
         next_offset = start_offset

@@ -131,6 +131,14 @@ def test_gcs_resumable_resumes_from_308_range(tmp_path):
         srv.truncate_chunks(2)
         GcsPinotFS(client).copy_from_local(str(src), "bkt/p.bin")
         assert srv.objects[("bkt", "p.bin")] == payload
+        # the FINAL chunk can also persist partially (308): every chunk
+        # of this upload gets truncated once, including the last
+        src2 = tmp_path / "p2.bin"
+        payload2 = os.urandom(4 * (256 << 10))
+        src2.write_bytes(payload2)
+        srv.truncate_chunks(4)
+        GcsPinotFS(client).copy_from_local(str(src2), "bkt/p2.bin")
+        assert srv.objects[("bkt", "p2.bin")] == payload2
     finally:
         srv.stop()
 

@@ -56,7 +56,20 @@ def test_controller_ui_page(tmp_path):
             assert "text/html" in r.headers["Content-Type"]
             page = r.read().decode()
         assert "pinot-tpu controller" in page
+        # SPA page: the cluster snapshot is inlined as the hydration
+        # seed, so instances/tables/segments are in the HTML payload
         assert "s1" in page and "seg_0" in page and "u" in page
+        for marker in ("#/cluster", "#/tables", "#/query", "/ui/data",
+                       "Query console"):
+            assert marker in page, marker
+        # the live-refresh endpoint serves the same snapshot as JSON
+        import json as _json
+        with urllib.request.urlopen(f"{ctrl.url}/ui/data",
+                                    timeout=10) as r:
+            data = _json.loads(r.read())
+        assert data["tables"]["u"]["segments"] == ["seg_0"]
+        assert data["instances"]["s1"]["live"] is True
+        assert "RetentionManager" in data["tasks"] or data["tasks"]
     finally:
         srv.stop()
         ctrl.stop()
