@@ -66,8 +66,6 @@ a.tbl{color:var(--acc);cursor:pointer;text-decoration:underline}
 <script>
 "use strict";
 let D = JSON.parse(document.getElementById("bootstrap").textContent);
-const $ = (h) => { const d = document.createElement("div");
-  d.innerHTML = h; return d; };
 const esc = (s) => String(s).replace(/[&<>"'\\\\]/g,
   c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;",
          "'":"&#39;","\\\\":"&#92;"}[c]));
@@ -129,7 +127,7 @@ function vTable(t) {
        ` data-s="${esc(s)}">delete</button>`]);
   return `<h2>${esc(t)}</h2>
     <p><button data-act="reb" data-t="${esc(t)}">rebalance</button>
-    <span class="mut" id="actmsg"></span></p>
+    <span class="mut" id="actmsg">${esc(actMsg)}</span></p>
     <h3>Segments</h3>` +
     table(["segment", "servers", ""], segs);
 }
@@ -169,16 +167,24 @@ async function runQuery() {
       body: JSON.stringify({sql})});
     const res = await r.json();
     const ms = (performance.now() - t0).toFixed(1);
-    if (res.exceptions && res.exceptions.length) {
+    // our broker reports errors as HTTP 4xx {"error": str}; keep the
+    // reference's exceptions[] shape working too
+    if (res.error || (res.exceptions && res.exceptions.length)) {
       out.innerHTML = `<p class="err">${esc(
-        JSON.stringify(res.exceptions))}</p>`;
-    } else {
-      const rt = res.resultTable || {columns: [], rows: []};
-      out.innerHTML = table(rt.columns.map(esc),
-        rt.rows.map(row => row.map(c => esc(JSON.stringify(c)))));
-      document.getElementById("qtime").textContent =
-        `${rt.rows.length} rows · ${ms} ms (round trip)`;
+        res.error || JSON.stringify(res.exceptions))}</p>`;
+      document.getElementById("qtime").textContent = "";
+      return;
     }
+    const rt = res.resultTable || res;
+    const cols = (rt.dataSchema && rt.dataSchema.columnNames)
+      || rt.columns || [];
+    const rows = rt.rows || [];
+    out.innerHTML = table(cols.map(esc),
+      rows.map(row => row.map(c => esc(JSON.stringify(c)))));
+    const srv = res.timeUsedMs !== undefined
+      ? ` · ${Number(res.timeUsedMs).toFixed(1)} ms server` : "";
+    document.getElementById("qtime").textContent =
+      `${rows.length} rows · ${ms} ms round trip${srv}`;
   } catch (e) {
     out.innerHTML = `<p class="err">${esc(e)}</p>`;
   }
@@ -188,11 +194,11 @@ async function post(path) {
   const r = await fetch(path, {method: "POST"});
   return r.ok ? r.json().catch(() => ({})) : {error: r.status};
 }
+let actMsg = "";   // survives the refresh() re-render (vTable reads it)
 async function rebalance(t) {
   const res = await post("/rebalance/" + encodeURIComponent(t));
-  document.getElementById("actmsg").textContent =
-    "rebalance: " + JSON.stringify(res);
-  refresh();
+  actMsg = "rebalance: " + JSON.stringify(res);
+  await refresh();
 }
 async function runTask(n) {
   await post("/periodictask/run/" + encodeURIComponent(n));

@@ -112,6 +112,18 @@ class GcsClient:
                 # chunk including the final one (the resumable
                 # protocol's whole point); resume from there, never past
                 committed = _committed_end(h.get("range"))
+                if committed + 1 >= total:
+                    # every byte persisted but the session didn't
+                    # finalize: a zero-byte status-query PUT
+                    # (Content-Range 'bytes */total') must complete it —
+                    # returning here without a 200/201 would report
+                    # success for an object that may not exist
+                    st, _h2, body = self.rest.request(
+                        "PUT", upath, query=q,
+                        headers={**self._auth(),
+                                 "Content-Range": f"bytes */{total}"})
+                    self._check(st, body, ok=(200, 201))
+                    return
                 if committed + 1 != end + 1:
                     fh.seek(committed + 1)
                 pos = committed + 1

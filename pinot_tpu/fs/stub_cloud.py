@@ -200,6 +200,16 @@ class _GcsHandler(_BaseHandler):
             return self._err(400, "unknown upload session")
         bucket, name, buf = sess
         cr = self.headers.get("Content-Range", "")
+        mq = re.fullmatch(r"bytes \*/(\d+)", cr)
+        if mq:
+            # status query: finalize when complete, else report Range
+            if len(buf) == int(mq.group(1)):
+                self.stub.objects[(bucket, name)] = bytes(buf)
+                del self.stub.sessions[sid]
+                return self._respond(200, json.dumps(
+                    {"name": name, "size": str(len(buf))}).encode())
+            return self._respond(
+                308, headers={"Range": f"bytes=0-{len(buf) - 1}"})
         m = re.fullmatch(r"bytes (\d+)-(\d+)/(\d+)", cr)
         if not m:
             return self._err(400, f"bad Content-Range {cr!r}")
@@ -211,10 +221,21 @@ class _GcsHandler(_BaseHandler):
             truncate = self.stub.truncate_next > 0
             if truncate:
                 self.stub.truncate_next -= 1
+            stall = self.stub.stall_finalize_next > 0
+            if stall and hi + 1 == total:
+                self.stub.stall_finalize_next -= 1
+            else:
+                stall = False
         if truncate and len(body) > 1:
             # persist only half the chunk: the 308 Range tells the
             # client where to resume (the resumable protocol contract)
             body = body[: len(body) // 2]
+            buf.extend(body)
+            return self._respond(
+                308, headers={"Range": f"bytes=0-{len(buf) - 1}"})
+        if stall:
+            # persist everything but DON'T finalize: the client must
+            # issue a 'bytes */total' status query to complete
             buf.extend(body)
             return self._respond(
                 308, headers={"Range": f"bytes=0-{len(buf) - 1}"})
@@ -246,6 +267,7 @@ class FakeGcsServer(_BaseServer):
         self.sessions: Dict[str, tuple] = {}
         self.next_session = 0
         self.truncate_next = 0     # partial-persist injection (308 Range)
+        self.stall_finalize_next = 0
         super().__init__(port, token=token, page=page)
 
     def truncate_chunks(self, n: int) -> None:
@@ -254,6 +276,13 @@ class FakeGcsServer(_BaseServer):
         the reported offset, not their own bookkeeping."""
         with self._lock:
             self.truncate_next = n
+
+    def stall_finalize(self, n: int) -> None:
+        """Make the next n FINAL chunk PUTs persist fully but answer 308
+        (full Range) instead of finalizing — clients must complete the
+        session with a 'bytes */total' status-query PUT."""
+        with self._lock:
+            self.stall_finalize_next = n
 
 
 # ---------------------------------------------------------------------------

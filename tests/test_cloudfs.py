@@ -139,6 +139,15 @@ def test_gcs_resumable_resumes_from_308_range(tmp_path):
         srv.truncate_chunks(4)
         GcsPinotFS(client).copy_from_local(str(src2), "bkt/p2.bin")
         assert srv.objects[("bkt", "p2.bin")] == payload2
+        # full-range 308 on the final chunk: all bytes persisted but the
+        # session not finalized — the client must send the 'bytes
+        # */total' status query and only then report success
+        src3 = tmp_path / "p3.bin"
+        payload3 = os.urandom(3 * (256 << 10))
+        src3.write_bytes(payload3)
+        srv.stall_finalize(1)
+        GcsPinotFS(client).copy_from_local(str(src3), "bkt/p3.bin")
+        assert srv.objects[("bkt", "p3.bin")] == payload3
     finally:
         srv.stop()
 
