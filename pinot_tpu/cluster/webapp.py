@@ -127,7 +127,7 @@ function vTable(t) {
        ` data-s="${esc(s)}">delete</button>`]);
   return `<h2>${esc(t)}</h2>
     <p><button data-act="reb" data-t="${esc(t)}">rebalance</button>
-    <span class="mut" id="actmsg">${esc(actMsg)}</span></p>
+    <span class="mut" id="actmsg">${esc(actMsg[t] || "")}</span></p>
     <h3>Segments</h3>` +
     table(["segment", "servers", ""], segs);
 }
@@ -194,10 +194,10 @@ async function post(path) {
   const r = await fetch(path, {method: "POST"});
   return r.ok ? r.json().catch(() => ({})) : {error: r.status};
 }
-let actMsg = "";   // survives the refresh() re-render (vTable reads it)
-async function rebalance(t) {
+const actMsg = {};  // per-table: survives refresh(), never leaks into
+async function rebalance(t) {       // another table's detail view
   const res = await post("/rebalance/" + encodeURIComponent(t));
-  actMsg = "rebalance: " + JSON.stringify(res);
+  actMsg[t] = "rebalance: " + JSON.stringify(res);
   await refresh();
 }
 async function runTask(n) {

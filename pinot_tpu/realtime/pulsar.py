@@ -50,6 +50,7 @@ SUCCESS, ERROR = 13, 14
 CLOSE_PRODUCER, CLOSE_CONSUMER, PRODUCER_SUCCESS = 15, 16, 17
 PING, PONG = 18, 19
 SEEK = 28
+GET_LAST_MESSAGE_ID, GET_LAST_MESSAGE_ID_RESPONSE = 29, 30
 
 _MAGIC = 0x0E01
 _ENTRY_BITS = 20          # SPI offset = ledgerId << 20 | entryId
@@ -114,12 +115,18 @@ def pb_decode(data: bytes) -> Dict[int, List[Any]]:
             val: Any = varint()
         elif wt == 2:
             n = varint()
+            if pos + n > len(data):
+                raise PulsarError("truncated protobuf")
             val = data[pos:pos + n]
             pos += n
         elif wt == 5:
+            if pos + 4 > len(data):
+                raise PulsarError("truncated protobuf")
             val = struct.unpack_from("<I", data, pos)[0]
             pos += 4
         elif wt == 1:
+            if pos + 8 > len(data):
+                raise PulsarError("truncated protobuf")
             val = struct.unpack_from("<Q", data, pos)[0]
             pos += 8
         else:
@@ -342,12 +349,21 @@ class PulsarReaderConsumer(PartitionGroupConsumer):
         return MessageBatch(rows, next_offset, row_offsets)
 
     def latest_offset(self) -> int:
-        off = 0
-        while True:
-            batch = self.fetch(off, 10_000)
-            if not batch.rows:
-                return off
-            off = batch.next_offset
+        """GET_LAST_MESSAGE_ID — the protocol's metadata round for the
+        topic end (no payload transfer, unlike a scan-to-end)."""
+        req = (_pb_field(1, self.consumer_id)
+               + _pb_field(2, self._next_req()))
+        self._conn.send(encode_frame(_pb_field(1, GET_LAST_MESSAGE_ID)
+                                     + _pb_bytes(32, req)))
+        cmd, _m, _p = self._conn.recv()
+        if _one(cmd, 1) != GET_LAST_MESSAGE_ID_RESPONSE:
+            raise PulsarError(
+                f"expected last-message-id response, got {_one(cmd, 1)}")
+        resp = pb_decode(_one(cmd, 33, b""))
+        ledger, entry = _decode_message_id(_one(resp, 1, b""))
+        if ledger == 0 and entry == 0:
+            return 0                      # empty topic sentinel
+        return pack_offset(ledger, entry) + 1
 
     def close(self) -> None:
         close = (_pb_field(1, self.consumer_id)
@@ -575,6 +591,20 @@ class FakePulsarBroker:
                     _pb_str(1, "p") + _pb_field(2, 1) + _pb_field(3, 0),
                     b""))
             return frames
+        if t == GET_LAST_MESSAGE_ID:
+            g = pb_decode(_one(cmd, 32, b""))
+            cid = _one(g, 1, 0)
+            if cid not in cursors:
+                return [self._error(f"unknown consumer {cid}")]
+            topic = cursors[cid][0]
+            with self._lock:
+                log = self.topics[topic]
+                last = (log[-1][0], log[-1][1]) if log else (0, 0)
+            resp = (_pb_bytes(1, _encode_message_id(*last))
+                    + _pb_field(2, _one(g, 2, 0)))
+            return [encode_frame(
+                _pb_field(1, GET_LAST_MESSAGE_ID_RESPONSE)
+                + _pb_bytes(33, resp))]
         if t == CLOSE_CONSUMER:
             c = pb_decode(_one(cmd, 19, b""))
             cursors.pop(_one(c, 1, 0), None)

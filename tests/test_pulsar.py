@@ -87,6 +87,16 @@ def test_produce_fetch_ledger_rollover(pulsar):
     prod.close()
 
 
+def test_latest_offset_via_get_last_message_id(pulsar):
+    stream = PulsarStream("events", port=pulsar.port, partitions=2)
+    c = stream.create_consumer(0)
+    assert c.latest_offset() == 0                 # empty topic
+    offs = pulsar.append("events-partition-0",
+                         [{"i": i} for i in range(7)])
+    assert c.latest_offset() == offs[-1] + 1
+    c.close()
+
+
 def test_fetch_empty_topic(pulsar):
     stream = PulsarStream("events", port=pulsar.port, partitions=2)
     c = stream.create_consumer(1)

@@ -166,6 +166,8 @@ def main() -> int:
             raise AssertionError(f"{sql!r}: device {dev} vs host {host}")
         out["checks"].append(f"sketch:{sql.split('(')[0].split()[-1]}")
 
+    check_two_pass_ladder(out, broker, seg, srcs, k)
+
     check_device_transforms(out)
     check_string_predicates(out)
     check_kselect(out)
@@ -175,6 +177,54 @@ def main() -> int:
     out["ok"] = True
     print(json.dumps(out))
     return 0
+
+
+def check_two_pass_ladder(out, broker, seg, srcs, k) -> None:
+    """Round-5 compact-path rework on the REAL chip: force the second
+    compaction pass + lax.switch size ladder (they self-enable only at
+    full capacity scale) and require exact agreement with the
+    default-path answer for a sparse and a dense filter."""
+    import os
+
+    import numpy as np
+
+    from pinot_tpu.ops.kernels import jitted_kernel
+
+    saved = {k2: os.environ.get(k2) for k2 in
+             ("PINOT_COMPACT_TWO_PASS", "PINOT_COMPACT_LADDER_MIN")}
+    try:
+        for sql, mask in [
+            ("SELECT k, SUM(i), COUNT(*) FROM t WHERE k = 7 "
+             "GROUP BY k ORDER BY k LIMIT 10", k == 7),       # sparse
+            ("SELECT k, SUM(i) FROM t WHERE k < 900 "
+             "GROUP BY k ORDER BY k LIMIT 1", k < 900),       # dense
+        ]:
+            os.environ.pop("PINOT_COMPACT_TWO_PASS", None)
+            os.environ.pop("PINOT_COMPACT_LADDER_MIN", None)
+            jitted_kernel.cache_clear()
+            base = broker.query(sql + " OPTION(timeoutMs=600000)").rows
+            os.environ["PINOT_COMPACT_TWO_PASS"] = "1"
+            os.environ["PINOT_COMPACT_LADDER_MIN"] = "0"
+            jitted_kernel.cache_clear()
+            forced = broker.query(sql + " OPTION(timeoutMs=600000)").rows
+            if base != forced or not base:
+                raise AssertionError(
+                    f"two-pass/ladder mismatch for {sql!r}: "
+                    f"{forced} vs {base}")
+            g = base[0][0]
+            exp = int(np.asarray(srcs["int"])[np.asarray(mask)
+                                              & (k == g)].sum())
+            if base[0][1] != exp:
+                raise AssertionError(
+                    f"{sql!r}: group {g} sum {base[0][1]} != {exp}")
+        out["checks"].append("compact:two_pass_ladder")
+    finally:
+        jitted_kernel.cache_clear()
+        for k2, v in saved.items():
+            if v is None:
+                os.environ.pop(k2, None)
+            else:
+                os.environ[k2] = v
 
 
 def _mini_table(name, schema_fields, data):
