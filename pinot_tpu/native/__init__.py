@@ -61,12 +61,12 @@ def load() -> Optional[ctypes.CDLL]:
         lib.fixedbit_unpack.restype = None
         lib.fixedbit_unpack.argtypes = [p_u8, c_i64, ctypes.c_int, p_i32]
         for name in ("zlib_compress_chunk", "zstd_compress_chunk",
-                     "lz4_compress_chunk"):
+                     "lz4_compress_chunk", "snappy_compress_chunk"):
             fn = getattr(lib, name)
             fn.restype = c_i64
             fn.argtypes = [p_u8, c_i64, p_u8, c_i64, ctypes.c_int]
         for name in ("zlib_decompress_chunk", "zstd_decompress_chunk",
-                     "lz4_decompress_chunk"):
+                     "lz4_decompress_chunk", "snappy_decompress_chunk"):
             fn = getattr(lib, name)
             fn.restype = c_i64
             fn.argtypes = [p_u8, c_i64, p_u8, c_i64]
@@ -124,7 +124,7 @@ def fixedbit_unpack(buf: np.ndarray, n: int, bits: int) -> np.ndarray:
 # chunk codecs
 # ---------------------------------------------------------------------------
 
-CODECS = ("ZSTD", "ZLIB", "LZ4", "PASS_THROUGH", "DELTA")
+CODECS = ("ZSTD", "ZLIB", "LZ4", "SNAPPY", "PASS_THROUGH", "DELTA")
 
 
 def compress(data: np.ndarray, codec: str = "ZSTD", level: int = 3
@@ -140,7 +140,8 @@ def compress(data: np.ndarray, codec: str = "ZSTD", level: int = 3
         out = np.empty(cap, dtype=np.uint8)
         fn = {"ZSTD": lib.zstd_compress_chunk,
               "ZLIB": lib.zlib_compress_chunk,
-              "LZ4": lib.lz4_compress_chunk}[codec]
+              "LZ4": lib.lz4_compress_chunk,
+              "SNAPPY": lib.snappy_compress_chunk}[codec]
         sz = fn(raw, len(raw), out, cap, level)
         if sz < 0:
             raise RuntimeError(f"{codec} compression failed")
@@ -170,7 +171,8 @@ def decompress(data: np.ndarray, raw_size: int, codec: str = "ZSTD"
         out = np.empty(raw_size, dtype=np.uint8)
         fn = {"ZSTD": lib.zstd_decompress_chunk,
               "ZLIB": lib.zlib_decompress_chunk,
-              "LZ4": lib.lz4_decompress_chunk}[codec]
+              "LZ4": lib.lz4_decompress_chunk,
+              "SNAPPY": lib.snappy_decompress_chunk}[codec]
         sz = fn(buf, len(buf), out, raw_size)
         if sz != raw_size:
             raise RuntimeError(f"{codec} decompression failed ({sz})")

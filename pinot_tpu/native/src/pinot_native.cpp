@@ -100,8 +100,10 @@ int64_t compress_bound(int64_t n) {
     uLong zb = compressBound((uLong)n);
     size_t sb = ZSTD_compressBound((size_t)n);
     int64_t lb = n + n / 255 + 16;  // LZ4 worst case (incompressible)
+    int64_t nb = 32 + n + n / 6;    // snappy documented worst case
     int64_t m = (int64_t)(zb > sb ? zb : sb);
-    return m > lb ? m : lb;
+    if (lb > m) m = lb;
+    return nb > m ? nb : m;
 }
 
 // --------------------------------------------------------------------------
@@ -231,6 +233,173 @@ int64_t lz4_decompress_chunk(const uint8_t* src, int64_t n, uint8_t* dst,
         for (int64_t k = 0; k < mlen; ++k, ++op) dst[op] = dst[op - off];
     }
     return op;
+}
+
+// --------------------------------------------------------------------------
+// Snappy block format (snappy-java analog; spec: varint uncompressed
+// length header, then tagged elements — tag low 2 bits: 00 literal,
+// 01 copy with 1-byte offset tail, 10 copy with 2-byte offset,
+// 11 copy with 4-byte offset). Same stance as LZ4 above: the format is
+// public and simple; a from-scratch implementation beats gating the
+// codec away. The compressor emits literals + 2-byte-offset copies
+// (greedy hash table, minmatch 4); the decompressor accepts every tag
+// form a conforming encoder may produce.
+// --------------------------------------------------------------------------
+
+static inline uint32_t snappy_hash(uint32_t seq) {
+    return (seq * 0x1e35a7bdu) >> 18;  // 14-bit table
+}
+
+static int64_t snappy_emit_literal(uint8_t* dst, int64_t op, int64_t cap,
+                                   const uint8_t* src, int64_t len) {
+    if (len == 0) return op;
+    if (len <= 60) {
+        if (op + 1 + len > cap) return -1;
+        dst[op++] = (uint8_t)((len - 1) << 2);
+    } else if (len - 1 < (1 << 8)) {
+        if (op + 2 + len > cap) return -1;
+        dst[op++] = (uint8_t)(60 << 2);
+        dst[op++] = (uint8_t)(len - 1);
+    } else if (len - 1 < (1 << 16)) {
+        if (op + 3 + len > cap) return -1;
+        dst[op++] = (uint8_t)(61 << 2);
+        dst[op++] = (uint8_t)((len - 1) & 0xff);
+        dst[op++] = (uint8_t)((len - 1) >> 8);
+    } else if (len - 1 < (1 << 24)) {
+        if (op + 4 + len > cap) return -1;
+        dst[op++] = (uint8_t)(62 << 2);
+        uint32_t v = (uint32_t)(len - 1);
+        memcpy(dst + op, &v, 3);  // little-endian, 3 bytes
+        op += 3;
+    } else {
+        if (op + 5 + len > cap) return -1;
+        dst[op++] = (uint8_t)(63 << 2);
+        uint32_t v = (uint32_t)(len - 1);
+        memcpy(dst + op, &v, 4);
+        op += 4;
+    }
+    memcpy(dst + op, src, (size_t)len);
+    return op + len;
+}
+
+static int64_t snappy_emit_copy2(uint8_t* dst, int64_t op, int64_t cap,
+                                 int64_t offset, int64_t len) {
+    // len 4..64 per element; longer matches arrive pre-split
+    if (op + 3 > cap) return -1;
+    dst[op++] = (uint8_t)(((len - 1) << 2) | 2);
+    dst[op++] = (uint8_t)(offset & 0xff);
+    dst[op++] = (uint8_t)(offset >> 8);
+    return op;
+}
+
+int64_t snappy_compress_chunk(const uint8_t* src, int64_t n, uint8_t* dst,
+                              int64_t cap, int /*level*/) {
+    int64_t op = 0;
+    // varint uncompressed length
+    uint64_t v = (uint64_t)n;
+    while (v >= 0x80) {
+        if (op >= cap) return -1;
+        dst[op++] = (uint8_t)(v | 0x80);
+        v >>= 7;
+    }
+    if (op >= cap) return -1;
+    dst[op++] = (uint8_t)v;
+
+    int32_t table[1 << 14];
+    for (int i = 0; i < (1 << 14); ++i) table[i] = -1;
+    int64_t ip = 0, anchor = 0;
+    if (n >= 8) {
+        const int64_t limit = n - 4;
+        while (ip <= limit) {
+            uint32_t seq;
+            memcpy(&seq, src + ip, 4);
+            uint32_t h = snappy_hash(seq);
+            int64_t ref = table[h];
+            table[h] = (int32_t)ip;
+            uint32_t refseq;
+            if (ref < 0 || ip - ref > 65535 ||
+                (memcpy(&refseq, src + ref, 4), refseq != seq)) {
+                ++ip;
+                continue;
+            }
+            int64_t mlen = 4;
+            while (ip + mlen < n && src[ip + mlen] == src[ref + mlen])
+                ++mlen;
+            op = snappy_emit_literal(dst, op, cap, src + anchor,
+                                     ip - anchor);
+            if (op < 0) return -1;
+            int64_t off = ip - ref, rem = mlen;
+            while (rem > 64) {
+                // 60 per element keeps the tail >= 5, always legal
+                op = snappy_emit_copy2(dst, op, cap, off, 60);
+                if (op < 0) return -1;
+                rem -= 60;
+            }
+            op = snappy_emit_copy2(dst, op, cap, off, rem);
+            if (op < 0) return -1;
+            ip += mlen;
+            anchor = ip;
+        }
+    }
+    op = snappy_emit_literal(dst, op, cap, src + anchor, n - anchor);
+    return op;
+}
+
+int64_t snappy_decompress_chunk(const uint8_t* src, int64_t n,
+                                uint8_t* dst, int64_t cap) {
+    int64_t ip = 0;
+    uint64_t expect = 0;
+    int shift = 0;
+    while (true) {
+        if (ip >= n || shift > 63) return -1;
+        uint8_t b = src[ip++];
+        expect |= (uint64_t)(b & 0x7f) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+    }
+    if ((int64_t)expect > cap) return -1;
+    int64_t op = 0;
+    while (ip < n) {
+        uint8_t tag = src[ip++];
+        int t = tag & 3;
+        if (t == 0) {  // literal
+            int64_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                int extra = (int)len - 60;  // 1..4 tail bytes
+                if (ip + extra > n) return -1;
+                uint32_t raw = 0;
+                memcpy(&raw, src + ip, (size_t)extra);
+                ip += extra;
+                len = (int64_t)raw + 1;
+            }
+            if (ip + len > n || op + len > cap) return -1;
+            memcpy(dst + op, src + ip, (size_t)len);
+            ip += len;
+            op += len;
+            continue;
+        }
+        int64_t len, off;
+        if (t == 1) {            // copy, 1-byte offset tail
+            if (ip >= n) return -1;
+            len = ((tag >> 2) & 0x7) + 4;
+            off = ((int64_t)(tag >> 5) << 8) | src[ip++];
+        } else if (t == 2) {     // copy, 2-byte offset
+            if (ip + 2 > n) return -1;
+            len = (tag >> 2) + 1;
+            off = src[ip] | ((int64_t)src[ip + 1] << 8);
+            ip += 2;
+        } else {                 // copy, 4-byte offset
+            if (ip + 4 > n) return -1;
+            uint32_t o;
+            memcpy(&o, src + ip, 4);
+            ip += 4;
+            len = (tag >> 2) + 1;
+            off = (int64_t)o;
+        }
+        if (off == 0 || off > op || op + len > cap) return -1;
+        for (int64_t k = 0; k < len; ++k, ++op) dst[op] = dst[op - off];
+    }
+    return op == (int64_t)expect ? op : -1;
 }
 
 // --------------------------------------------------------------------------

@@ -361,8 +361,11 @@ class PulsarReaderConsumer(PartitionGroupConsumer):
                 f"expected last-message-id response, got {_one(cmd, 1)}")
         resp = pb_decode(_one(cmd, 33, b""))
         ledger, entry = _decode_message_id(_one(resp, 1, b""))
-        if ledger == 0 and entry == 0:
-            return 0                      # empty topic sentinel
+        # a real broker signals an empty topic with entryId = -1
+        # (varint-encoded as 2^64-1); (ledger 0, entry 0) is a REAL
+        # first message, not a sentinel
+        if entry >= 1 << 63 or ledger >= 1 << 63:
+            return 0
         return pack_offset(ledger, entry) + 1
 
     def close(self) -> None:
@@ -597,9 +600,10 @@ class FakePulsarBroker:
             if cid not in cursors:
                 return [self._error(f"unknown consumer {cid}")]
             topic = cursors[cid][0]
+            neg1 = (1 << 64) - 1          # varint encoding of int64 -1
             with self._lock:
                 log = self.topics[topic]
-                last = (log[-1][0], log[-1][1]) if log else (0, 0)
+                last = (log[-1][0], log[-1][1]) if log else (neg1, neg1)
             resp = (_pb_bytes(1, _encode_message_id(*last))
                     + _pb_field(2, _one(g, 2, 0)))
             return [encode_frame(

@@ -379,7 +379,8 @@ class SegmentBuilder:
             if idx_cfg.compression:
                 from .. import native
                 codec = idx_cfg.compression
-                if codec in ("ZSTD", "LZ4") and not native.available():
+                if codec in ("ZSTD", "LZ4", "SNAPPY") \
+                        and not native.available():
                     codec = "ZLIB"  # degrade to the pure-python codec; the
                     # metadata must always name the stream actually written
                 if codec == "DELTA" and (arr.dtype.kind not in "iu"

@@ -35,7 +35,7 @@ class IndexingConfig:
     # storage codecs (native C++ pack/compress; pinot io/compression analog):
     # bit-pack dict ids at ceil(log2(card)) bits instead of byte-aligned
     bit_packed_ids: bool = False
-    # compress raw columns: None | "ZSTD" | "ZLIB" | "LZ4" |
+    # compress raw columns: None | "ZSTD" | "ZLIB" | "LZ4" | "SNAPPY" |
     # "PASS_THROUGH" | "DELTA" (zigzag-delta bitpack, integer columns —
     # the sorted-timestamp specialist; io/compression ChunkCompressionType
     # analog)
