@@ -249,6 +249,19 @@ def finish(out: dict, backend: str, all_ok: bool) -> None:
         # the forced-CPU fallback must be self-describing in EVERY
         # bench's output and ledger entry, not just bench.py's
         out["tpu_outage"] = LAST_OUTAGE
+    if backend != "tpu":
+        # VERDICT r4 next-step #1a: an outage round must still surface
+        # the most recent REAL-chip capture, not just a degraded number —
+        # attach the last-good TPU ledger entry (clearly marked stale)
+        last_tpu = ledger_last(out["metric"], "tpu")
+        if last_tpu is not None:
+            out["last_tpu_capture"] = {
+                "stale": True,
+                "ts": last_tpu.get("ts"),
+                "value": last_tpu.get("value"),
+                "vs_baseline": last_tpu.get("vs_baseline"),
+                "n_rows": last_tpu.get("n_rows"),
+            }
     ledger_append(out, backend, ok=all_ok)
     if not all_ok:
         # keep a more specific error (capture failures) when present

@@ -21,9 +21,9 @@ from ..engine.reduce import ResultTable, reduce_partials
 from ..engine.setops import combine_setop, order_limit_rows
 from ..query.context import build_query_context
 from ..query.planner import SegmentPlanner, _truthy
-from ..query.sql import (Comparison, Exists, InList, InSubquery, Literal,
-                         ScalarSubquery, SelectStmt, SetOpStmt, SqlError,
-                         map_expr, parse_sql)
+from ..query.sql import (Comparison, CteDef, DdlStmt, Exists, InList,
+                         InSubquery, Literal, ScalarSubquery, SelectStmt,
+                         SetOpStmt, SqlError, map_expr, parse_sql)
 from ..server.data_manager import TableDataManager
 from ..utils.metrics import global_metrics
 from ..utils.trace import Tracing
@@ -81,6 +81,9 @@ class Broker:
     def __init__(self):
         from .quota import QueryQuotaManager
         self._tables: Dict[str, TableDataManager] = {}
+        # name -> view body statement (CREATE VIEW ... AS <select>);
+        # expanded into CTEs at reference time (_expand_views)
+        self._views: Dict[str, Any] = {}
         self.quota = QueryQuotaManager()
 
     # -- table registry (ideal-state analog) -------------------------------
@@ -113,7 +116,100 @@ class Broker:
     def _query(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
+        if isinstance(stmt, DdlStmt):
+            return self._execute_ddl(stmt, t0)
         return self._execute_stmt(stmt, t0)
+
+    # -- views (QueryEnvironment view catalog analog) ----------------------
+    def _execute_ddl(self, stmt: DdlStmt, t0: float) -> ResultTable:
+        if stmt.kind == "create_view":
+            if stmt.name in self._tables or self._is_hybrid(stmt.name):
+                raise SqlError(
+                    f"cannot create view {stmt.name!r}: a table with "
+                    "that name exists")
+            if stmt.name in self._views and not stmt.or_replace:
+                raise SqlError(
+                    f"view {stmt.name!r} already exists; use CREATE OR "
+                    "REPLACE VIEW")
+            self._views[stmt.name] = stmt.stmt
+            status = "CREATED"
+        else:
+            if stmt.name not in self._views:
+                if stmt.if_exists:
+                    status = "NOT_FOUND"
+                else:
+                    raise SqlError(f"view {stmt.name!r} not found; "
+                                   f"have {sorted(self._views)}")
+            else:
+                del self._views[stmt.name]
+                status = "DROPPED"
+        result = ResultTable(["view", "status"], [(stmt.name, status)])
+        result.time_ms = (time.perf_counter() - t0) * 1e3
+        return result
+
+    @property
+    def view_names(self) -> List[str]:
+        return sorted(self._views)
+
+    def _referenced_tables(self, stmt, out: set) -> None:
+        """Every table name a statement tree references (main, joins,
+        set-op branches, subqueries, CTE bodies)."""
+        from ..query.sql import ast_children
+
+        if isinstance(stmt, SetOpStmt):
+            self._referenced_tables(stmt.left, out)
+            self._referenced_tables(stmt.right, out)
+            return
+        out.add(stmt.table)
+        for j in stmt.joins:
+            out.add(j.table.name)
+        for cte in getattr(stmt, "ctes", []) or []:
+            self._referenced_tables(cte.stmt, out)
+
+        def walk_expr(e):
+            if isinstance(e, (InSubquery, Exists, ScalarSubquery)):
+                self._referenced_tables(e.stmt, out)
+            for c in ast_children(e):
+                walk_expr(c)
+
+        for e in (stmt.where, stmt.having):
+            if e is not None:
+                walk_expr(e)
+
+    def _expand_views(self, stmt):
+        """Prepend referenced views (transitively, dependencies first) as
+        CTEs — the CTE machinery then materializes and scopes them. Names
+        already registered as tables (including a scoped CTE broker's)
+        or defined as explicit CTEs are never expanded."""
+        if not self._views or isinstance(stmt, DdlStmt):
+            return stmt
+        defined = {c.name for c in getattr(stmt, "ctes", []) or []}
+        order: List[str] = []
+
+        def visit(name: str, stack: tuple) -> None:
+            if name in defined or name in self._tables or name in order \
+                    or name not in self._views:
+                return
+            if name in stack:
+                raise SqlError(
+                    "view cycle: " + " -> ".join(stack + (name,)))
+            refs: set = set()
+            self._referenced_tables(self._views[name], refs)
+            for r in sorted(refs):
+                visit(r, stack + (name,))
+            order.append(name)
+
+        refs: set = set()
+        self._referenced_tables(stmt, refs)
+        for r in sorted(refs):
+            visit(r, ())
+        if not order:
+            return stmt
+        import copy
+        new_ctes = [CteDef(n, None, copy.deepcopy(self._views[n]))
+                    for n in order]
+        stmt.ctes = new_ctes + (stmt.ctes or [])
+        return stmt
 
     def _is_hybrid(self, table: str) -> bool:
         return table not in self._tables and \
@@ -121,6 +217,7 @@ class Broker:
             f"{table}_REALTIME" in self._tables
 
     def _execute_stmt(self, stmt, t0: float) -> ResultTable:
+        stmt = self._expand_views(stmt)
         if getattr(stmt, "ctes", None):
             return self._execute_with_ctes(stmt, t0)
         if isinstance(stmt, SetOpStmt):
@@ -298,7 +395,11 @@ class Broker:
         try:
             cap = int(stmt.options.get("cteLimit", 1_000_000))
             for cte in stmt.ctes:
-                sub = dataclasses.replace(cte.stmt, ctes=[])
+                # keep the body's OWN ctes (a view defined with a WITH
+                # clause): the recursive _execute_stmt materializes them
+                # in a further scope; replace() still copies the node so
+                # option/limit mutations never touch the stored body
+                sub = dataclasses.replace(cte.stmt)
                 if "timeoutMs" in stmt.options:
                     sub.options.setdefault("timeoutMs",
                                            stmt.options["timeoutMs"])

@@ -239,6 +239,18 @@ class CteDef:
 
 
 @dataclass
+class DdlStmt:
+    """CREATE [OR REPLACE] VIEW name AS <select> | DROP VIEW [IF EXISTS]
+    name. Views are named stored queries the broker expands into CTEs at
+    reference time (QueryEnvironment.java:126 view catalog analog)."""
+    kind: str                      # "create_view" | "drop_view"
+    name: str
+    stmt: Any = None               # the view body (create only)
+    or_replace: bool = False
+    if_exists: bool = False
+
+
+@dataclass
 class SetOpStmt:
     """Compound query: left (UNION|INTERSECT|EXCEPT) [ALL] right, with
     compound-level ORDER BY / LIMIT. Mirrors the v2 engine's set
@@ -373,7 +385,15 @@ class _Parser:
                            f"in {self.sql!r}")
 
     # -- grammar -----------------------------------------------------------
-    def parse(self) -> Union[SelectStmt, "SetOpStmt"]:
+    def parse(self) -> Union[SelectStmt, "SetOpStmt", DdlStmt]:
+        ddl = self._view_ddl()
+        if ddl is not None:
+            self.accept_op(";")
+            if self.peek().kind != "eof":
+                t = self.peek()
+                raise SqlError(
+                    f"unexpected trailing token {t.value!r} at {t.pos}")
+            return ddl
         explain = False
         if self.accept_kw("explain"):
             t = self.peek()  # contextual: EXPLAIN [PLAN FOR] SELECT ...
@@ -393,6 +413,64 @@ class _Parser:
             raise SqlError(f"unexpected trailing token {t.value!r} at {t.pos}")
         stmt.explain = explain
         return stmt
+
+    def _view_ddl(self) -> Optional[DdlStmt]:
+        """'create'/'drop' stay contextual column names; only the
+        statement-head position treats them as DDL (the 'with' trick)."""
+        t = self.peek()
+        word = str(t.value).lower() if t.kind == "ident" else ""
+        if word == "create":
+            save = self.i
+            self.next()
+            or_replace = False
+            nt = self.peek()
+            if nt.kind == "kw" and nt.value == "or":
+                self.next()
+                rt = self.next()
+                if not (rt.kind == "ident"
+                        and str(rt.value).lower() == "replace"):
+                    raise SqlError(f"expected REPLACE at {rt.pos}")
+                or_replace = True
+            vt = self.peek()
+            if not (vt.kind == "ident"
+                    and str(vt.value).lower() == "view"):
+                if or_replace:
+                    raise SqlError(f"expected VIEW at {vt.pos}")
+                self.i = save       # CREATE <something else>: not ours
+                return None
+            self.next()
+            name_t = self.next()
+            if name_t.kind != "ident":
+                raise SqlError(f"expected view name at {name_t.pos}")
+            self.expect_kw("as")
+            ctes = self._with_clause()
+            body = self.compound()
+            body.ctes = ctes
+            return DdlStmt("create_view", name_t.value, body,
+                           or_replace=or_replace)
+        if word == "drop":
+            save = self.i
+            self.next()
+            vt = self.peek()
+            if not (vt.kind == "ident"
+                    and str(vt.value).lower() == "view"):
+                self.i = save
+                return None
+            self.next()
+            if_exists = False
+            it = self.peek()
+            if it.kind == "ident" and str(it.value).lower() == "if":
+                self.next()
+                et = self.next()
+                if not (et.kind == "ident"
+                        and str(et.value).lower() == "exists"):
+                    raise SqlError(f"expected EXISTS at {et.pos}")
+                if_exists = True
+            name_t = self.next()
+            if name_t.kind != "ident":
+                raise SqlError(f"expected view name at {name_t.pos}")
+            return DdlStmt("drop_view", name_t.value, if_exists=if_exists)
+        return None
 
     def _with_clause(self) -> List[CteDef]:
         """WITH name [(col, ...)] AS ( stmt ) [, ...] — 'with' stays

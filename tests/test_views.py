@@ -1,0 +1,145 @@
+"""SQL views: CREATE [OR REPLACE] VIEW / DROP VIEW + reference-time
+expansion into the CTE machinery.
+
+Reference parity: the Calcite catalog behind QueryEnvironment.java:126
+resolves views during planning; here the broker stores the parsed body
+and prepends referenced views (transitively, dependencies first) as
+CTEs, so scoping/materialization reuse the WITH path.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.query.sql import SqlError, parse_sql, DdlStmt
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+
+@pytest.fixture()
+def broker(tmp_path):
+    rng = np.random.default_rng(13)
+    n = 4000
+    data = {"city": np.array([f"c{i%8}" for i in rng.integers(0, 8, n)]),
+            "amount": rng.integers(1, 100, n).astype(np.int32)}
+    schema = Schema("orders", [
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("amount", DataType.INT, FieldType.METRIC)])
+    d = SegmentBuilder(schema, TableConfig("orders")).build(
+        data, str(tmp_path), "s0")
+    dm = TableDataManager("orders")
+    dm.add_segment_dir(d)
+    b = Broker()
+    b.register_table(dm)
+    return b, data
+
+
+def test_parse_ddl():
+    s = parse_sql("CREATE VIEW v1 AS SELECT city FROM orders")
+    assert isinstance(s, DdlStmt) and s.kind == "create_view"
+    assert s.name == "v1" and s.stmt.table == "orders"
+    s = parse_sql("CREATE OR REPLACE VIEW v1 AS SELECT city FROM orders")
+    assert s.or_replace
+    s = parse_sql("DROP VIEW IF EXISTS v1")
+    assert s.kind == "drop_view" and s.if_exists
+
+
+def test_create_query_drop(broker):
+    b, data = broker
+    res = b.query("CREATE VIEW big AS SELECT city, SUM(amount) AS total "
+                  "FROM orders GROUP BY city LIMIT 100000")
+    assert res.rows == [("big", "CREATED")]
+    assert b.view_names == ["big"]
+    rows = b.query("SELECT city, total FROM big ORDER BY city "
+                   "LIMIT 100").rows
+    expect = sorted(
+        (c, int(data["amount"][data["city"] == c].sum()))
+        for c in set(data["city"].tolist()))
+    assert rows == expect
+    # aggregate over the view
+    top = b.query("SELECT MAX(total) FROM big").rows[0][0]
+    assert top == max(t for _c, t in expect)
+    assert b.query("DROP VIEW big").rows == [("big", "DROPPED")]
+    with pytest.raises(SqlError, match="not found"):
+        b.query("SELECT * FROM big")
+
+
+def test_view_on_view_dependency_order(broker):
+    b, data = broker
+    b.query("CREATE VIEW v1 AS SELECT city, SUM(amount) AS t FROM orders "
+            "GROUP BY city LIMIT 100000")
+    b.query("CREATE VIEW v2 AS SELECT city, t FROM v1 WHERE t > 0 "
+            "LIMIT 100000")
+    rows = b.query("SELECT COUNT(*) FROM v2").rows
+    assert rows[0][0] == len(set(data["city"].tolist()))
+
+
+def test_view_name_conflicts_and_replace(broker):
+    b, _ = broker
+    with pytest.raises(SqlError, match="table with that name"):
+        b.query("CREATE VIEW orders AS SELECT city FROM orders")
+    b.query("CREATE VIEW v AS SELECT city FROM orders LIMIT 5")
+    with pytest.raises(SqlError, match="already exists"):
+        b.query("CREATE VIEW v AS SELECT city FROM orders LIMIT 1")
+    b.query("CREATE OR REPLACE VIEW v AS SELECT COUNT(*) AS n "
+            "FROM orders")
+    assert b.query("SELECT n FROM v").rows[0][0] == 4000
+    with pytest.raises(SqlError, match="not found"):
+        b.query("DROP VIEW missing")
+    assert b.query("DROP VIEW IF EXISTS missing").rows == [
+        ("missing", "NOT_FOUND")]
+
+
+def test_view_cycle_detected(broker):
+    b, _ = broker
+    b.query("CREATE VIEW a1 AS SELECT city FROM orders LIMIT 10")
+    # replace a1 to reference a2, which references a1 -> cycle
+    b.query("CREATE VIEW a2 AS SELECT city FROM a1 LIMIT 10")
+    b.query("CREATE OR REPLACE VIEW a1 AS SELECT city FROM a2 LIMIT 10")
+    with pytest.raises(SqlError, match="cycle"):
+        b.query("SELECT * FROM a1")
+
+
+def test_explicit_cte_shadows_view(broker):
+    b, _ = broker
+    b.query("CREATE VIEW shadow AS SELECT city FROM orders LIMIT 1")
+    rows = b.query(
+        "WITH shadow AS (SELECT amount AS x FROM orders LIMIT 3) "
+        "SELECT COUNT(*) FROM shadow").rows
+    assert rows == [(3,)]
+
+
+def test_view_in_join_and_subquery(broker):
+    b, data = broker
+    b.query("CREATE VIEW totals AS SELECT city AS vc, SUM(amount) AS t "
+            "FROM orders GROUP BY city LIMIT 100000")
+    rows = b.query(
+        "SELECT o.city, COUNT(*) FROM orders o JOIN totals ON vc = city "
+        "GROUP BY o.city ORDER BY o.city LIMIT 100").rows
+    assert len(rows) == len(set(data["city"].tolist()))
+    n = b.query("SELECT COUNT(*) FROM orders WHERE city IN "
+                "(SELECT vc FROM totals WHERE t > 0 LIMIT 1000)"
+                ).rows[0][0]
+    assert n == 4000
+
+
+def test_view_with_its_own_cte_body(broker):
+    """CREATE VIEW v AS WITH c AS (...) SELECT ... — the body's CTEs
+    materialize in a further scope at query time, and a local CTE name
+    always wins over a same-named global view."""
+    b, _ = broker
+    b.query("CREATE VIEW v AS WITH c AS "
+            "(SELECT city FROM orders LIMIT 5) "
+            "SELECT city FROM c LIMIT 100")
+    assert b.query("SELECT COUNT(*) FROM v").rows == [(5,)]
+    # a global view named 'c' must NOT shadow the body-local CTE
+    b.query("CREATE VIEW c AS SELECT city FROM orders LIMIT 100000")
+    assert b.query("SELECT COUNT(*) FROM v").rows == [(5,)]
+
+
+def test_create_and_drop_stay_valid_column_names(broker):
+    b, _ = broker
+    # 'create'/'drop' are contextual: usable as identifiers elsewhere
+    rows = b.query('SELECT city AS "create" FROM orders LIMIT 1').rows
+    assert len(rows) == 1
