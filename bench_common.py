@@ -252,8 +252,12 @@ def finish(out: dict, backend: str, all_ok: bool) -> None:
     if backend != "tpu":
         # VERDICT r4 next-step #1a: an outage round must still surface
         # the most recent REAL-chip capture, not just a degraded number —
-        # attach the last-good TPU ledger entry (clearly marked stale)
-        last_tpu = ledger_last(out["metric"], "tpu")
+        # attach the last-good TPU ledger entry (clearly marked stale).
+        # Prefer the same scale; fall back to any-scale only when no
+        # comparable capture exists (the scale is in the payload either
+        # way, so a reader can judge comparability).
+        last_tpu = ledger_last(out["metric"], "tpu", out.get("n_rows")) \
+            or ledger_last(out["metric"], "tpu")
         if last_tpu is not None:
             out["last_tpu_capture"] = {
                 "stale": True,

@@ -395,6 +395,18 @@ class Broker:
         try:
             cap = int(stmt.options.get("cteLimit", 1_000_000))
             for cte in stmt.ctes:
+                if stmt.explain:
+                    # EXPLAIN must not execute CTE/view bodies (same
+                    # contract as _resolve_subqueries): register a
+                    # zero-row placeholder carrying the output columns
+                    # so the outer plan still builds. SELECT * bodies
+                    # have no static column list — materialize those.
+                    names = self._static_output_columns(cte.stmt)
+                    if names is not None:
+                        scoped._tables[cte.name] = _cte_table(
+                            cte.name, list(cte.columns or names), [],
+                            tmpdirs)
+                        continue
                 # keep the body's OWN ctes (a view defined with a WITH
                 # clause): the recursive _execute_stmt materializes them
                 # in a further scope; replace() still copies the node so
@@ -434,6 +446,20 @@ class Broker:
         finally:
             for d in tmpdirs:
                 shutil.rmtree(d, ignore_errors=True)
+
+    @staticmethod
+    def _static_output_columns(stmt) -> Optional[List[str]]:
+        """Output column names of a statement WITHOUT executing it, or
+        None when they aren't statically known (SELECT *)."""
+        if isinstance(stmt, SetOpStmt):
+            return Broker._static_output_columns(stmt.left)
+        try:
+            labels = build_query_context(stmt).labels
+        except SqlError:
+            return None
+        if any(lb == "*" for lb in labels):
+            return None
+        return list(labels)
 
     # -- subqueries (IN_SUBQUERY / scalar / EXISTS rewrite at the broker) --
     _TRUE = Comparison("==", Literal(1), Literal(1))

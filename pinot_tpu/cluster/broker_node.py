@@ -160,6 +160,12 @@ class BrokerNode:
     def query(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
+        from ..query.sql import DdlStmt
+        if isinstance(stmt, DdlStmt):
+            raise SqlError(
+                "view DDL runs on the in-process broker (views are "
+                "broker-local state; the networked broker carries no "
+                "catalog yet)")
         if isinstance(stmt, SetOpStmt):
             return self._query_setop(stmt, t0)
         from ..multistage.window import has_window

@@ -181,10 +181,10 @@ class ServerNode:
                  query_id: Optional[str] = None) -> Dict[str, Any]:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
-        from ..query.sql import SetOpStmt
-        if isinstance(stmt, SetOpStmt):
+        from ..query.sql import DdlStmt, SetOpStmt
+        if isinstance(stmt, (SetOpStmt, DdlStmt)):
             raise ValueError("leaf servers execute single-table stages; "
-                             "set operations combine at the broker")
+                             "set operations and DDL belong to the broker")
         from ..multistage.window import has_window
         if has_window(stmt):
             raise ValueError("leaf servers execute single-table stages; "

@@ -459,12 +459,14 @@ class _Parser:
             self.next()
             if_exists = False
             it = self.peek()
-            if it.kind == "ident" and str(it.value).lower() == "if":
+            nx = self.tokens[self.i + 1] if it.kind != "eof" else it
+            # commit to IF EXISTS only on the two-token form, so a view
+            # actually NAMED "if" can still be dropped
+            if it.kind == "ident" and str(it.value).lower() == "if" \
+                    and nx.kind == "ident" \
+                    and str(nx.value).lower() == "exists":
                 self.next()
-                et = self.next()
-                if not (et.kind == "ident"
-                        and str(et.value).lower() == "exists"):
-                    raise SqlError(f"expected EXISTS at {et.pos}")
+                self.next()
                 if_exists = True
             name_t = self.next()
             if name_t.kind != "ident":
@@ -1148,5 +1150,5 @@ def to_sql(stmt: Union[SelectStmt, SetOpStmt]) -> str:
     return " ".join(parts)
 
 
-def parse_sql(sql: str) -> Union[SelectStmt, SetOpStmt]:
+def parse_sql(sql: str) -> Union[SelectStmt, SetOpStmt, DdlStmt]:
     return _Parser(sql).parse()

@@ -92,6 +92,8 @@ def recommend(schema: Schema, workload: List[Tuple[str, float]],
     group_cols = Counter()
     for sql, w in workload:
         stmt = parse_sql(sql)
+        if not hasattr(stmt, "where"):
+            continue               # DDL in a workload carries no scan shape
         filters.append((stmt.where, w))
         for g in getattr(stmt, "group_by", []) or []:
             if isinstance(g, Identifier):

@@ -1,0 +1,73 @@
+"""AOT TPU lowering of the Pallas compact path — no chip required.
+
+The interpret-mode tests (test_compact_pallas.py) validate kernel
+SEMANTICS on CPU but bypass the Mosaic compiler entirely; a kernel edit
+can pass the whole CPU suite and still fail to lower on the real chip
+(layout/op-support rejections happen at lowering, before execution).
+jax.export with platforms=["tpu"] runs the Mosaic frontend on any host,
+so this is the suite's compile-time hardware gate: if these exports
+succeed, the kernels the SSB bench runs (two-pass compaction + size
+ladder, sorted and factorized post-aggregation) are lowerable on TPU.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pinot_tpu.ops import compact as C
+from pinot_tpu.ops.ir import And, AggSpec, Bin, Col, EqId, IdRange, \
+    KernelPlan
+from pinot_tpu.ops.kernels import build_kernel
+
+N = 1 << 24
+
+
+def _export_tpu(fn, *args):
+    from jax import export
+    return export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+def test_compact_kernel_lowers_for_tpu():
+    n = C.K_MAX * C.R * C.LANES * 2
+    cap = C.sorted_default_slots_cap(n)
+    k_sub = C._choose_k(2, n)
+
+    def fn(mask, a, b):
+        return C._compact_pallas(mask, (a, b), n, cap, k_sub, False)
+
+    _export_tpu(fn, jax.ShapeDtypeStruct((n,), jnp.bool_),
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), jnp.int32))
+
+
+@pytest.mark.parametrize("shape", ["sorted_q3", "factorized_q2"])
+def test_full_compact_query_kernel_lowers_for_tpu(monkeypatch, shape):
+    """The whole jitted query program (predicates -> Pallas compaction ->
+    second pass -> lax.switch ladder -> sort/matmul post-aggregation ->
+    transfer compaction) must lower for TPU. lax.switch traces EVERY
+    ladder branch, so one export covers the full ladder."""
+    monkeypatch.setenv("PINOT_COMPACT_LADDER_MIN", str(1 << 20))
+    if shape == "sorted_q3":
+        plan = KernelPlan(
+            pred=And((EqId(0, 0), EqId(1, 1), IdRange(2, 2, 3))),
+            aggs=(AggSpec(kind="sum", value=Col(3), integral=True,
+                          bits=23, signed=False),),
+            group_keys=((0, 250), (1, 250), (2, 7)),   # 437.5k: sort path
+            strategy="compact",
+        )
+        n_cols = 4
+    else:
+        plan = KernelPlan(
+            pred=And((EqId(0, 0), IdRange(1, 1, 2))),
+            aggs=(AggSpec(kind="sum", value=Bin("-", Col(2), Col(3)),
+                          integral=True, bits=24, signed=True),),
+            group_keys=((0, 7), (1, 1000)),            # 7k: factorized
+            strategy="compact",
+        )
+        n_cols = 4
+    fn = build_kernel(plan, N, platform="tpu")
+    cols = tuple(jax.ShapeDtypeStruct((N,), jnp.int32)
+                 for _ in range(n_cols))
+    params = tuple(jax.ShapeDtypeStruct((), jnp.int32) for _ in range(4))
+    _export_tpu(fn, cols, jax.ShapeDtypeStruct((), jnp.int32), params)

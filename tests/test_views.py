@@ -138,6 +138,46 @@ def test_view_with_its_own_cte_body(broker):
     assert b.query("SELECT COUNT(*) FROM v").rows == [(5,)]
 
 
+def test_explain_over_view_does_not_execute_body(broker):
+    """EXPLAIN registers zero-row placeholder CTEs (same contract as the
+    subquery EXPLAIN path) — the view body's scan must never run."""
+    b, _ = broker
+    b.query("CREATE VIEW pv AS SELECT city, SUM(amount) AS t "
+            "FROM orders GROUP BY city LIMIT 100")
+    calls = []
+    orig = Broker._execute_ctx
+
+    def spy(self, ctx, *a, **kw):
+        calls.append(ctx.table)
+        return orig(self, ctx, *a, **kw)
+
+    Broker._execute_ctx = spy
+    try:
+        rows = b.query("EXPLAIN PLAN FOR SELECT city FROM pv").rows
+    finally:
+        Broker._execute_ctx = orig
+    assert rows
+    assert "orders" not in calls, calls
+
+
+def test_view_named_if_drops(broker):
+    b, _ = broker
+    b.query('CREATE VIEW "if" AS SELECT city FROM orders LIMIT 1')
+    assert b.query('DROP VIEW "if"').rows[0][1] == "DROPPED"
+
+
+def test_ddl_rejected_cleanly_by_networked_roles(broker, tmp_path):
+    from pinot_tpu.cluster import BrokerNode, Controller
+    ctrl = Controller(str(tmp_path / "c"), reconcile_interval=0.5)
+    brk = BrokerNode(ctrl.url, routing_refresh=0.5)
+    try:
+        with pytest.raises(SqlError, match="in-process broker"):
+            brk.query("CREATE VIEW nv AS SELECT city FROM orders")
+    finally:
+        brk.stop()
+        ctrl.stop()
+
+
 def test_create_and_drop_stay_valid_column_names(broker):
     b, _ = broker
     # 'create'/'drop' are contextual: usable as identifiers elsewhere
