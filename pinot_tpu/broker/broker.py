@@ -395,14 +395,22 @@ class Broker:
         try:
             cap = int(stmt.options.get("cteLimit", 1_000_000))
             for cte in stmt.ctes:
-                if stmt.explain:
+                if stmt.explain and not stmt.joins:
                     # EXPLAIN must not execute CTE/view bodies (same
                     # contract as _resolve_subqueries): register a
                     # zero-row placeholder carrying the output columns
                     # so the outer plan still builds. SELECT * bodies
-                    # have no static column list — materialize those.
+                    # have no static column list, and the multistage
+                    # join path needs real (typed) segments —
+                    # materialize those the normal way.
                     names = self._static_output_columns(cte.stmt)
                     if names is not None:
+                        if cte.columns and \
+                                len(cte.columns) != len(names):
+                            raise SqlError(
+                                f"CTE {cte.name!r} declares "
+                                f"{len(cte.columns)} columns but its "
+                                f"query produces {len(names)}")
                         scoped._tables[cte.name] = _cte_table(
                             cte.name, list(cte.columns or names), [],
                             tmpdirs)

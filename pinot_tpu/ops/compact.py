@@ -76,8 +76,13 @@ def _choose_k(n_cols: int, n: int) -> int:
     return k
 
 
-# capacity margins must cover the LARGEST staging block any chosen K can
-# write ((K_MAX+1)*R rows) — the kernel's fits check is off+stage<=cap
+# the full-capacity margin must cover the LARGEST staging block any
+# chosen K can write ((K_MAX+1)*R rows) — the kernel's fits check is
+# off+stage<=cap. The DEFAULT caps keep the small K_MIN-based floor:
+# compact() shrinks K until the staging block fits the cap, so a small
+# cap simply runs a smaller grid step — quadrupling the floors would
+# quadruple every small-segment kernel's post-aggregation for nothing
+# (measured ~2x CPU kernel time at 200k rows).
 STAGE_MAX = (K_MAX + 1) * R
 
 
@@ -89,7 +94,7 @@ def default_slots_cap(n: int) -> int:
     Binomial(R, p) over 128 lanes] / R, about 4-5x p for p around a few
     percent. 1/4 covers p <~ 8% without overflow; denser masks trigger the
     executor's full_slots_cap retry (engine/executor.py run_kernel)."""
-    return max(n // (4 * LANES), 2 * STAGE_MAX) + STAGE_MAX
+    return max(n // (4 * LANES), 2 * STAGE) + STAGE
 
 
 def sorted_default_slots_cap(n: int) -> int:
@@ -101,12 +106,13 @@ def sorted_default_slots_cap(n: int) -> int:
     advance floor is ~1 slot row per 32-row subtile with any match
     (~3.2%), so 1/16 (6.25%) keeps headroom; denser masks pay the
     full-capacity retry like everything else."""
-    return max(n // (16 * LANES), 2 * STAGE_MAX) + STAGE_MAX
+    return max(n // (16 * LANES), 2 * STAGE) + STAGE
 
 
 def full_slots_cap(n: int) -> int:
     """Capacity that can never overflow: total slot advance is bounded by
-    one slot row per input row-of-128 plus one pad row per subtile."""
+    one slot row per input row-of-128 plus one pad row per subtile, with
+    margin for the largest staging block any K writes."""
     return n // LANES + n // (R * LANES) + STAGE_MAX
 
 

@@ -197,18 +197,26 @@ class DistributedTable:
 def _distributed_kernel(kernel_plan, bucket: int, mesh: Mesh,
                         n_cols: int, n_params: int,
                         slots_cap: int = None):
-    from ..ops.kernels import cpu_scatter_default
+    from ..ops.kernels import (_ladder_min_elems, _two_pass_mode,
+                               cpu_scatter_default)
 
     platform = mesh.devices.flat[0].platform
+    # the compact-path env knobs resolve HERE so they are part of the
+    # cache key (the jitted_kernel convention) — flipping them between
+    # calls must never hit a stale cached mesh program
     return _distributed_kernel_cached(kernel_plan, bucket, mesh, n_cols,
                                       n_params, slots_cap,
-                                      cpu_scatter_default(platform))
+                                      cpu_scatter_default(platform),
+                                      _two_pass_mode(),
+                                      _ladder_min_elems())
 
 
 @functools.lru_cache(maxsize=512)
 def _distributed_kernel_cached(kernel_plan, bucket: int, mesh: Mesh,
                                n_cols: int, n_params: int,
-                               slots_cap: int, scatter: bool):
+                               slots_cap: int, scatter: bool,
+                               two_pass_mode: str = "auto",
+                               ladder_min: int = 1 << 22):
     """jit(shard_map(kernel + collectives)) cached per plan/mesh."""
     # dense (space,) outputs only: psum/pmin/pmax combine positionally
     # across shards, which device-side transfer compaction would break.
@@ -228,12 +236,16 @@ def _distributed_kernel_cached(kernel_plan, bucket: int, mesh: Mesh,
             kern = build_kernel(kernel_plan, bucket, slots_cap, platform,
                                 xfer_compact=False,
                                 local_segments=local_segs,
-                                scatter=scatter)
+                                scatter=scatter,
+                                two_pass_mode=two_pass_mode,
+                                ladder_min=ladder_min)
             flat = tuple(c.reshape(local_segs * bucket) for c in cols)
             local = kern(flat, n_docs, params)
         else:
             kern = build_kernel(kernel_plan, bucket, slots_cap, platform,
-                                xfer_compact=False, scatter=scatter)
+                                xfer_compact=False, scatter=scatter,
+                                two_pass_mode=two_pass_mode,
+                                ladder_min=ladder_min)
             out = jax.vmap(lambda c, n: kern(c, n, params))(cols, n_docs)
             local = {}
             for k, v in out.items():

@@ -90,14 +90,21 @@ def recommend(schema: Schema, workload: List[Tuple[str, float]],
 
     filters = []
     group_cols = Counter()
-    for sql, w in workload:
-        stmt = parse_sql(sql)
-        if not hasattr(stmt, "where"):
-            continue               # DDL in a workload carries no scan shape
+    def collect(stmt, w):
+        from ..query.sql import DdlStmt, SetOpStmt
+        if isinstance(stmt, DdlStmt):
+            return                 # DDL carries no scan shape
+        if isinstance(stmt, SetOpStmt):
+            collect(stmt.left, w)  # each branch scans: both contribute
+            collect(stmt.right, w)
+            return
         filters.append((stmt.where, w))
-        for g in getattr(stmt, "group_by", []) or []:
+        for g in stmt.group_by or []:
             if isinstance(g, Identifier):
                 group_cols[g.name] += w
+
+    for sql, w in workload:
+        collect(parse_sql(sql), w)
     eq, rng, txt = _filter_stats(filters)
 
     dim_names = {f.name for f in schema.fields
