@@ -45,6 +45,18 @@ STR_POOL = ["alpha", "beta", "gamma", "delta", "epsi"]
 NS_POOL = ["red", "green", "blue", "teal"]
 
 
+def make_dim_data(n: int = 600, seed: int = 11) -> Dict[str, Any]:
+    """The EXISTS-subquery side table (fzd): dk spans 0..5 while fz.ci
+    spans 0..6 — ci == 6 rows have NO dim partner, so even
+    unthresholded [NOT] EXISTS predicates exercise real semi/anti-join
+    misses; dv is the local-filter column."""
+    rng = np.random.default_rng(seed)
+    return {
+        "dk": rng.integers(0, 6, n).astype(np.int64),
+        "dv": rng.integers(0, 100, n).astype(np.int64),
+    }
+
+
 def make_data(n: int, seed: int = 7) -> Dict[str, Any]:
     """Fixture columns (logical view: None = NULL, MV = lists)."""
     rng = np.random.default_rng(seed)
@@ -68,7 +80,9 @@ def make_data(n: int, seed: int = 7) -> Dict[str, Any]:
 @dataclass
 class Pred:
     col: str
-    op: str            # eq neq in between lt gt like is_null not_null
+    op: str    # eq neq in between lt gt like is_null not_null
+    #            exists not_exists (correlated: col = fzd.dk, value =
+    #            optional dv-threshold local predicate)
     value: Any = None
 
 
@@ -89,19 +103,26 @@ class QuerySpec:
     having_gt: Optional[float] = None   # HAVING first_agg > v
     order_by_keys: bool = False
     null_handling: bool = False
-    seed: Tuple[int, int] = (0, 0)      # reproduce: (seed, index)
+    # reproduce: QueryGenerator(seed, with_exists).generate() x (index+1)
+    # — the flag is part of the tuple because it changes the draw stream
+    seed: Tuple[int, int, bool] = (0, 0, False)
 
 
 class QueryGenerator:
     """Seeded random specs over the COLUMNS model."""
 
-    def __init__(self, seed: int):
+    def __init__(self, seed: int, with_exists: bool = False):
         self.seed = seed
         self.rng = np.random.default_rng(seed)
         self.count = 0
+        self.with_exists = with_exists
 
     def _pred(self) -> Pred:
         r = self.rng
+        if self.with_exists and r.random() < 0.12:
+            op = str(r.choice(["exists", "not_exists"]))
+            thresh = int(r.integers(1, 100)) if r.random() < 0.7 else None
+            return Pred("ci", op, thresh)
         col = str(r.choice(["ci", "chi", "cs", "m1", "nm", "ns", "mv"]))
         if col == "cs":
             op = str(r.choice(["eq", "neq", "in", "like"]))
@@ -151,7 +172,8 @@ class QueryGenerator:
         idx = self.count
         self.count += 1
         kind = str(r.choice(["agg", "agg", "agg", "select", "window"]))
-        spec = QuerySpec(kind=kind, seed=(self.seed, idx))
+        spec = QuerySpec(kind=kind,
+                         seed=(self.seed, idx, self.with_exists))
         spec.preds = [self._pred() for _ in range(int(r.integers(0, 4)))]
         spec.null_handling = bool(r.random() < 0.4)
         if kind == "agg":
@@ -207,6 +229,11 @@ def _pred_sql(p: Pred) -> str:
         return f"{p.col} IN (" + ", ".join(_lit(v) for v in p.value) + ")"
     if p.op == "like":
         return f"{p.col} LIKE {_lit(p.value)}"
+    if p.op in ("exists", "not_exists"):
+        neg = "NOT " if p.op == "not_exists" else ""
+        local = f" AND dv < {p.value}" if p.value is not None else ""
+        return (f"{neg}EXISTS (SELECT dv FROM fzd "
+                f"WHERE dk = {p.col}{local})")
     if p.op == "is_null":
         return f"{p.col} IS NULL"
     assert p.op == "not_null"
@@ -253,7 +280,15 @@ def render_sql(spec: QuerySpec) -> str:
 # ---------------------------------------------------------------------------
 
 def _pred_mask(p: Pred, data: Dict[str, Any], n: int,
-               nh: bool) -> np.ndarray:
+               nh: bool, dim: Optional[Dict[str, Any]] = None
+               ) -> np.ndarray:
+    if p.op in ("exists", "not_exists"):
+        assert dim is not None, "exists preds need the fzd fixture"
+        dk = np.asarray(dim["dk"])
+        if p.value is not None:
+            dk = dk[np.asarray(dim["dv"]) < p.value]
+        m = np.isin(np.asarray(data[p.col]), dk)
+        return ~m if p.op == "not_exists" else m
     col = data[p.col]
     if p.col == "mv":
         if p.op != "eq":
@@ -348,11 +383,12 @@ def _agg_value(a: Agg, data, sel: np.ndarray, nh: bool):
 
 
 def oracle_rows(spec: QuerySpec, data: Dict[str, Any],
-                n: int) -> List[tuple]:
+                n: int, dim: Optional[Dict[str, Any]] = None
+                ) -> List[tuple]:
     nh = spec.null_handling
     mask = np.ones(n, dtype=bool)
     for p in spec.preds:
-        mask &= _pred_mask(p, data, n, nh)
+        mask &= _pred_mask(p, data, n, nh, dim)
     sel = np.nonzero(mask)[0]
     if spec.kind == "select":
         return [tuple(data[c][i] for c in spec.select_cols) for i in sel]

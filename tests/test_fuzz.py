@@ -19,7 +19,8 @@ from pinot_tpu.server import TableDataManager
 from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
                            TableConfig)
 from pinot_tpu.tools.fuzzer import (QueryGenerator, digest, make_data,
-                                    oracle_rows, render_sql)
+                                    make_dim_data, oracle_rows,
+                                    render_sql)
 
 N_ROWS = 4000
 N_QUERIES = int(os.environ.get("PINOT_FUZZ_N", 500))
@@ -49,7 +50,17 @@ def setup(tmp_path_factory):
         dm.add_segment_dir(b.build(chunk, str(out), f"s{i}"))
     broker = Broker()
     broker.register_table(dm)
-    return broker, data
+    # the EXISTS-subquery side table (correlated decorrelation fuzzing)
+    dim = make_dim_data()
+    dim_schema = Schema("fzd", [
+        FieldSpec("dk", DataType.LONG),
+        FieldSpec("dv", DataType.LONG, FieldType.METRIC),
+    ])
+    dmd = TableDataManager("fzd")
+    dmd.add_segment_dir(SegmentBuilder(dim_schema, TableConfig("fzd"))
+                        .build(dim, str(out), "d0"))
+    broker.register_table(dmd)
+    return broker, data, dim
 
 
 def _run(broker, sql):
@@ -57,14 +68,14 @@ def _run(broker, sql):
 
 
 def test_fuzz_kernel_host_oracle(setup):
-    broker, data = setup
-    gen = QueryGenerator(SEED)
+    broker, data, dim = setup
+    gen = QueryGenerator(SEED, with_exists=True)
     failures = []
     for _ in range(N_QUERIES):
         spec = gen.generate()
         sql = render_sql(spec)
         try:
-            exp = digest(oracle_rows(spec, data, N_ROWS))
+            exp = digest(oracle_rows(spec, data, N_ROWS, dim))
             got_kernel = digest(_run(broker, sql))
             host_sql = sql.replace("OPTION(",
                                    "OPTION(forceHostExecution=true,")
@@ -90,14 +101,16 @@ def _diff(tag, got, exp):
 
 
 def _report(failures):
-    lines = [f"{len(failures)} fuzz failures (seed,idx reproduce):"]
+    lines = [f"{len(failures)} fuzz failures "
+             "((seed, idx, with_exists) reproduce):"]
     for seed, sql, why in failures[:10]:
         lines.append(f"  seed={seed} sql={sql!r}\n    {why}")
     return "\n".join(lines)
 
 
-def test_fuzz_seed_reproducible():
-    g1 = QueryGenerator(42)
-    g2 = QueryGenerator(42)
+@pytest.mark.parametrize("with_exists", [False, True])
+def test_fuzz_seed_reproducible(with_exists):
+    g1 = QueryGenerator(42, with_exists=with_exists)
+    g2 = QueryGenerator(42, with_exists=with_exists)
     for _ in range(50):
         assert render_sql(g1.generate()) == render_sql(g2.generate())
