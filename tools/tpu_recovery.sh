@@ -1,0 +1,16 @@
+#!/bin/bash
+# One-shot TPU-recovery capture: phase profile of the reworked compact
+# path, then the full SSB suite. Run the moment the axon tunnel answers
+# (see PINOT memory: it wedges for hours; captures must be immediate).
+set -u
+cd "$(dirname "$0")/.."
+echo "== backend probe =="
+if ! timeout 120 python -c "import jax; print(jax.default_backend(), len(jax.devices()))"; then
+    echo "tunnel still wedged; aborting" >&2
+    exit 1
+fi
+echo "== phase profile (q2.1 q3.2 q4.3) =="
+timeout 2400 python tools/profile_compact.py q2.1 q3.2 q4.3 \
+    | tee /tmp/profile_compact_tpu.json
+echo "== full SSB capture =="
+timeout 10800 python bench.py | tee /tmp/bench_tpu_full.json
