@@ -2,15 +2,27 @@
 # One-shot TPU-recovery capture: phase profile of the reworked compact
 # path, then the full SSB suite. Run the moment the axon tunnel answers
 # (see PINOT memory: it wedges for hours; captures must be immediate).
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 echo "== backend probe =="
-if ! timeout 120 python -c "import jax; print(jax.default_backend(), len(jax.devices()))"; then
-    echo "tunnel still wedged; aborting" >&2
+probe=$(timeout 120 python -c \
+    "import jax; print(jax.default_backend())") || probe=""
+echo "backend: ${probe:-<none>}"
+if [ "$probe" != "tpu" ]; then
+    echo "no TPU backend (tunnel wedged or CPU fallback); aborting" >&2
     exit 1
 fi
 echo "== phase profile (q2.1 q3.2 q4.3) =="
-timeout 2400 python tools/profile_compact.py q2.1 q3.2 q4.3 \
-    | tee /tmp/profile_compact_tpu.json
+if ! timeout 2400 python tools/profile_compact.py q2.1 q3.2 q4.3 \
+        | tee /tmp/profile_compact_tpu.json; then
+    echo "profile failed/timed out; continuing to the capture" >&2
+fi
 echo "== full SSB capture =="
-timeout 10800 python bench.py | tee /tmp/bench_tpu_full.json
+# budget > 13 queries x 900s worker timeout + retry headroom, and
+# refuse the CPU fallback: this window exists to get CHIP numbers
+if ! PINOT_BENCH_ALLOW_CPU=0 timeout 14400 python bench.py \
+        | tee /tmp/bench_tpu_full.json; then
+    echo "capture FAILED (see /tmp/bench_tpu_full.json)" >&2
+    exit 1
+fi
+echo "capture complete; ledger updated"
