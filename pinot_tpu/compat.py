@@ -1,0 +1,29 @@
+"""Version shims for jax API drift.
+
+The codebase targets the jax >= 0.6 public surface (jax.shard_map with
+check_vma); the pinned toolchain ships 0.4.x where shard_map lives in
+jax.experimental and the replication-check kwarg is named check_rep.
+Keep ALL drift handling here so kernels read as if on the new API.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def disable_x64():
+    """Context manager suppressing x64 promotion for a trace region (the
+    Pallas compaction kernel is pure 32-bit). jax.enable_x64(False) was
+    removed on the 0.4.x line; the experimental spelling still exists on
+    both sides of the drift."""
+    from jax.experimental import disable_x64 as _dx
+    return _dx()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm_exp
+    return sm_exp(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)

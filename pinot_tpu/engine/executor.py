@@ -17,7 +17,6 @@ import jax
 import numpy as np
 
 from ..ops import aggregations
-from ..ops.kernels import jitted_kernel
 from ..query.context import QueryContext
 from ..query.sql import Star
 from ..query.planner import AggBinding, CompiledPlan, SegmentPlanner
@@ -184,32 +183,54 @@ def resolve_params(plan: CompiledPlan, sharding=None) -> Tuple[jax.Array, ...]:
 
 def run_kernel(plan: CompiledPlan,
                xfer_compact: bool = True) -> Dict[str, np.ndarray]:
-    """xfer_compact=False goes straight to dense (space,) group outputs —
-    used when the caller already knows the transfer compaction spilled
-    (engine/batch.py's vmapped path)."""
+    """Execute the compiled kernel through the keyed plan cache
+    (ops/plan_cache.py): one compiled XLA program + donated accumulator
+    buffers per (plan, bucket, slots_cap, platform, flags), so repeated
+    iterations of the same query never re-trace or re-allocate.
+
+    The compact strategy's compaction capacity comes from the planner's
+    cost model (CompiledPlan.slots_cap — selectivity-estimate-derived and
+    quantized, hence a stable cache key); an underestimate reports
+    overflow and retries once at full_slots_cap. xfer_compact=False goes
+    straight to dense (space,) group outputs — used when the caller
+    already knows the transfer compaction spilled (engine/batch.py's
+    vmapped path)."""
+    from ..ops.plan_cache import global_plan_cache
     seg = plan.segment
     cols = seg.device_cols(plan.col_names)
     params = resolve_params(plan)
     n = np.int32(seg.n_docs)
-    cap = None
-    fn = jitted_kernel(plan.kernel_plan, seg.bucket,
-                       xfer_compact=xfer_compact)
-    host = jax.device_get(fn(cols, n, params))
-    if int(host.pop("overflow", 0)):
-        # compact-strategy capacity exceeded (high selectivity): rerun with
-        # a capacity that cannot overflow (ops/compact.full_slots_cap)
+    cap = plan.slots_cap
+    entry = global_plan_cache.entry(plan.kernel_plan, seg.bucket, cap,
+                                    xfer_compact=xfer_compact)
+    if entry.overflowed:
+        # this capacity already overflowed for this plan: go straight to
+        # the (already compiled) full-capacity kernel instead of paying
+        # the doomed tight kernel plus the retry on every execution
         from ..ops.compact import full_slots_cap
         cap = full_slots_cap(seg.bucket)
-        fn = jitted_kernel(plan.kernel_plan, seg.bucket, cap,
-                           xfer_compact=xfer_compact)
-        host = jax.device_get(fn(cols, n, params))
+        entry = global_plan_cache.entry(plan.kernel_plan, seg.bucket, cap,
+                                        xfer_compact=xfer_compact)
+    host = entry.run(cols, n, params)
+    if "matched" in host:
+        entry.record_measured(np.asarray(host["matched"]).sum(),
+                              seg.n_docs)
+    if int(host.pop("overflow", 0)):
+        # compact-strategy capacity exceeded (the selectivity estimate
+        # undershot): rerun with a capacity that cannot overflow
+        from ..ops.compact import full_slots_cap
+        entry.overflowed = True
+        cap = full_slots_cap(seg.bucket)
+        entry = global_plan_cache.entry(plan.kernel_plan, seg.bucket, cap,
+                                        xfer_compact=xfer_compact)
+        host = entry.run(cols, n, params)
         host.pop("overflow", None)
     if int(host.pop("group_overflow", 0)):
         # more live groups than the transfer-compaction cap: rerun with
         # dense (space,) outputs
-        fn = jitted_kernel(plan.kernel_plan, seg.bucket, cap,
-                           xfer_compact=False)
-        host = jax.device_get(fn(cols, n, params))
+        entry = global_plan_cache.entry(plan.kernel_plan, seg.bucket, cap,
+                                        xfer_compact=False)
+        host = entry.run(cols, n, params)
         host.pop("overflow", None)
     from .accounting import global_accountant
     global_accountant.track_memory(

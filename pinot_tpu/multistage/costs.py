@@ -169,6 +169,225 @@ def join_cardinality(l_rows: float, r_rows: float,
     return max(l_rows * r_rows / ndv, 1.0)
 
 
+# ---------------------------------------------------------------------------
+# Group-by kernel strategy cost model (single-stage engine path)
+#
+# Round-6 tentpole: strategy choice (dense vs compact) and the compact
+# path's compaction capacity are driven by measured selectivity x
+# group-space instead of the old space>DENSE_SMALL_GROUPS heuristic.
+# "Measured" here means computed from the RESOLVED kernel IR: the planner
+# has already translated literals through the sorted dictionaries, so an
+# IdRange's id span over the column cardinality is the exact fraction of
+# the dictionary the predicate admits — far tighter than the AST-level
+# RelMdSelectivity guesses above (which cannot see through string
+# dictionaries). Costs are relative units where 1.0 ~ one streaming pass
+# over one row; constants are calibrated from CPU microbenchmarks
+# (PERF_LEDGER r06) and MXU throughput ratios, and only ever steer
+# physical choices — correctness never depends on them (a wrong capacity
+# estimate triggers the executor's full-capacity overflow retry).
+# ---------------------------------------------------------------------------
+
+# relative per-row cost constants (1.0 = one fused streaming pass)
+COST_SCATTER_ROW = 12.0     # XLA:CPU scatter-add (measured ~40ns vs ~3.5ns)
+COST_COMPACT_PASS = 3.0     # mask + cumsum + searchsorted/gather (XLA) or
+                            # the Pallas placement matmuls (TPU)
+COST_SORT_ROW = 0.5         # per row per log2(rows) per sort operand
+COST_MAC = 1.0 / 256.0      # one int8 MAC on the MXU relative to a pass
+COST_POST_MAC = COST_MAC / 4    # factorized two-sided one-hot after
+                                # compaction: no (rows, space) operand ever
+                                # streams through HBM, so its effective MAC
+                                # rate is ~4x the dense one-hot formulation
+COST_OUT_ROW = 0.5          # dense (space,) output materialization
+CAP_SAFETY_XLA = 4.0        # exact compaction: margin over the estimate
+CAP_SAFETY_PALLAS = 1.5     # loose compaction: margin over slot estimate
+
+
+def ir_selectivity(pred: Any, params: Sequence[Any],
+                   col_cards: Dict[int, int]) -> float:
+    """Selectivity of a resolved kernel-IR predicate tree.
+
+    ``params`` are the planner's raw parameter values (literal dict ids /
+    bounds / presence tables); symbolic markers (device dict values, null
+    masks, ...) degrade to conservative defaults. ``col_cards`` maps the
+    kernel column index to the column's dictionary cardinality (absent or
+    0 = unprofiled)."""
+    from ..ops import ir as _ir
+
+    def val(i):
+        if i is None or i >= len(params):
+            return None
+        p = params[i]
+        if isinstance(p, (bool, np.bool_)):
+            return None
+        if isinstance(p, (int, float, np.integer, np.floating)):
+            return float(p)
+        return None
+
+    def sel(p) -> float:
+        if isinstance(p, _ir.TrueP):
+            return 1.0
+        if isinstance(p, _ir.FalseP):
+            return 0.0
+        if isinstance(p, _ir.And):
+            s = 1.0
+            for c in p.children:
+                s *= sel(c)
+            return max(s, MIN_SEL)
+        if isinstance(p, _ir.Or):
+            s = 1.0
+            for c in p.children:
+                s *= 1.0 - sel(c)
+            return max(1.0 - s, MIN_SEL)
+        if isinstance(p, _ir.Not):
+            return max(1.0 - sel(p.child), MIN_SEL)
+        if isinstance(p, _ir.EqId):
+            card = col_cards.get(p.col)
+            s = 1.0 / card if card else EQ_DEFAULT_SEL
+            return max(1.0 - s, MIN_SEL) if p.negated else max(s, MIN_SEL)
+        if isinstance(p, _ir.IdRange):
+            card = col_cards.get(p.col)
+            if not card:
+                return DEFAULT_SEL
+            lo = val(p.lo_param)
+            hi = val(p.hi_param)
+            lo = 0.0 if lo is None else max(lo, 0.0)
+            hi = float(card - 1) if hi is None else min(hi, card - 1)
+            span = max(hi - lo + 1.0, 0.0)
+            s = min(max(span / card, MIN_SEL), 1.0)
+            return max(1.0 - s, MIN_SEL) if p.negated else s
+        if isinstance(p, _ir.InSet):
+            card = col_cards.get(p.col)
+            s = min(p.n / card, 1.0) if card \
+                else min(p.n * EQ_DEFAULT_SEL, 0.5)
+            s = max(s, MIN_SEL)
+            return max(1.0 - s, MIN_SEL) if p.negated else s
+        if isinstance(p, _ir.InBitmap):
+            card = col_cards.get(p.col)
+            tbl = params[p.param] if p.param < len(params) else None
+            if card and isinstance(tbl, np.ndarray) and \
+                    tbl.dtype == np.bool_:
+                s = max(float(tbl.sum()) / max(card, 1), MIN_SEL)
+            else:
+                s = DEFAULT_SEL
+            return max(1.0 - s, MIN_SEL) if p.negated else s
+        if isinstance(p, _ir.Cmp):
+            return DEFAULT_SEL
+        if isinstance(p, _ir.MaskParam):
+            # null masks / validDocs: usually nearly-all-true; stay
+            # conservative (larger capacity) rather than tight
+            return 1.0
+        return DEFAULT_SEL
+
+    return min(max(sel(pred), MIN_SEL), 1.0)
+
+
+def _pow2_at_least(x: float) -> int:
+    n = max(int(x), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def pallas_slots_estimate(n_rows: int, sel: float) -> int:
+    """Slot rows the loose lane-wise Pallas compaction consumes at the
+    given selectivity: every 32-row subtile with any match advances by
+    the max per-lane count across its 128 lanes (ops/compact.py)."""
+    import math
+
+    from ..ops.compact import LANES, R
+
+    subtiles = max(n_rows / (R * LANES), 1.0)
+    sel = min(max(sel, 0.0), 1.0)
+    p_any = 1.0 - (1.0 - sel) ** (R * LANES)
+    lam = R * sel
+    mhat = min(float(R), lam + 3.0 * math.sqrt(max(lam, 0.0)) + 1.0)
+    return int(subtiles * p_any * mhat) + 1
+
+
+def compact_slots_cap(n_rows: int, sel: float, platform: str,
+                      scatter: bool) -> int:
+    """Cost-model compaction capacity (slot rows of 128 elements) for the
+    compact group-by strategy, quantized to a power of two so nearby
+    selectivity estimates share one kernel cache entry (stable cap =>
+    zero retrace across query iterations).
+
+    The XLA fallback compaction (CPU, or any platform below the Pallas
+    gate) is exact, so capacity tracks matched rows directly with a small
+    floor; the Pallas kernel is loose (see pallas_slots_estimate) and
+    additionally must fit its staging block, so its floor stays at the
+    default-cap level. Underestimates are safe: the kernel reports
+    overflow and the executor retries at full_slots_cap."""
+    from ..ops.compact import (LANES, STAGE, XLA_MIN_SLOTS, _use_pallas,
+                               full_slots_cap)
+
+    full = full_slots_cap(n_rows)
+    est_rows = max(n_rows * min(max(sel, 0.0), 1.0), 1.0)
+    if scatter or not _use_pallas(n_rows, platform):
+        slots = _pow2_at_least(est_rows * CAP_SAFETY_XLA / LANES)
+        return int(min(max(slots, XLA_MIN_SLOTS), full))
+    slots = pallas_slots_estimate(n_rows, sel) * CAP_SAFETY_PALLAS
+    floor = 3 * STAGE  # >= the staging block any chosen K writes
+    return int(min(max(_pow2_at_least(slots), floor), full))
+
+
+def choose_group_strategy(n_rows: int, space: int, sel: float,
+                          platform: str, scatter_fast: bool,
+                          needs_sort: bool, n_payloads: int,
+                          dense_viable: bool, compact_ok: bool,
+                          force: Optional[str] = None
+                          ) -> Tuple[str, Dict[str, Any]]:
+    """Pick 'dense' vs 'compact' for a group-by kernel plan from relative
+    cost estimates; returns (strategy, trace). ``force`` (the
+    groupByStrategy query option) overrides the cost comparison when the
+    forced strategy is structurally possible. Structural gates
+    (dense_viable / compact_ok) always win over costs."""
+    import math
+
+    trace: Dict[str, Any] = {"sel": round(sel, 8), "space": space,
+                             "n_rows": n_rows, "platform": platform,
+                             "scatter_fast": scatter_fast}
+    if force in ("dense", "compact"):
+        allowed = (force == "dense" and dense_viable) or \
+                  (force == "compact" and compact_ok)
+        if allowed:
+            trace["forced"] = force
+            return force, trace
+    if not compact_ok:
+        trace["reason"] = "compact structurally unavailable"
+        return "dense", trace
+    if not dense_viable:
+        trace["reason"] = "dense structurally unavailable"
+        return "compact", trace
+
+    sel = min(max(sel, MIN_SEL), 1.0)
+    est_rows = max(n_rows * sel, 1.0)
+    payloads = max(n_payloads, 1)
+
+    if scatter_fast:
+        # CPU scatter cores: dense = segment ops over every row; compact
+        # pays mask+cumsum+gather then scatters only ~matched rows
+        cost_dense = n_rows * COST_SCATTER_ROW * (1 + payloads) \
+            + space * COST_OUT_ROW
+        cap_rows = compact_slots_cap(n_rows, sel, platform, True) * 128
+        cost_compact = n_rows * COST_COMPACT_PASS \
+            + min(cap_rows, n_rows) * COST_SCATTER_ROW * (1 + payloads) \
+            + space * COST_OUT_ROW
+    else:
+        # MXU cores: dense = one-hot dot_general over every row; compact
+        # = compaction pass + factorized matmul or sort over ~matched
+        cost_dense = n_rows * (1.0 + space * COST_MAC * payloads)
+        post_rows = min(
+            compact_slots_cap(n_rows, sel, platform, False) * 128, n_rows)
+        if needs_sort:
+            post = post_rows * COST_SORT_ROW * \
+                max(math.log2(max(post_rows, 2)), 1.0)
+        else:
+            post = post_rows * space * COST_POST_MAC * payloads
+        cost_compact = n_rows * COST_COMPACT_PASS + post \
+            + space * COST_OUT_ROW
+    trace["cost_dense"] = round(cost_dense)
+    trace["cost_compact"] = round(cost_compact)
+    return ("compact" if cost_compact < cost_dense else "dense"), trace
+
+
 def order_inner_joins(joins: List[Any], base_label: str,
                       table_rows: Dict[str, float],
                       key_ndv_fn, equi_fn) -> Tuple[List[Any], List[Dict]]:

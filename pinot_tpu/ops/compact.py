@@ -85,6 +85,12 @@ def _choose_k(n_cols: int, n: int) -> int:
 # (measured ~2x CPU kernel time at 200k rows).
 STAGE_MAX = (K_MAX + 1) * R
 
+# smallest capacity the XLA fallback compaction accepts: it has no staging
+# block, so the floor is only about keeping the ladder/post shapes sane.
+# The cost model (multistage/costs.compact_slots_cap) clamps here when the
+# selectivity estimate says almost nothing matches.
+XLA_MIN_SLOTS = 8
+
 
 def default_slots_cap(n: int) -> int:
     """Default output capacity (slot rows): 1/4 of the input, padded.
@@ -205,11 +211,23 @@ def _use_pallas(n: int, platform: str = None) -> bool:
 
 
 def _compact_xla(mask, cols, n, slots_cap):
-    """Fallback: dense compaction via nonzero (fast on CPU)."""
+    """Fallback: exact dense compaction via cumsum + searchsorted + gather.
+
+    Replaces the jnp.nonzero(size=...) formulation: XLA:CPU executed that
+    lowering ~12x slower than one running-count cumsum plus a binary
+    search for the k-th matched position (measured 14ms -> 1.2ms on a
+    262k-row mask), and the cost now scales with the CAPACITY, not the
+    input — the cost-model-tightened caps (multistage/costs.
+    compact_slots_cap) make the search+gather nearly free at SSB
+    selectivities."""
     cap = slots_cap * LANES
     size = min(cap, n)
-    idx, = jnp.nonzero(mask, size=size, fill_value=n)
-    valid_small = idx < n
+    cs = jnp.cumsum(mask.astype(jnp.int32))
+    # position of the (k+1)-th matched row = first index with cs == k+1;
+    # k >= matched lands at n and is masked off below
+    idx = jnp.searchsorted(cs, jnp.arange(1, size + 1, dtype=jnp.int32),
+                           method="scan")
+    valid_small = jnp.arange(size, dtype=jnp.int32) < cs[-1]
     outs = [jnp.where(valid_small, c.at[idx].get(mode="clip"), 0)
             for c in cols]
     if cap > size:
@@ -220,7 +238,7 @@ def _compact_xla(mask, cols, n, slots_cap):
                 for o in outs]
     else:
         valid = valid_small
-    matched = jnp.sum(mask, dtype=jnp.int32)
+    matched = cs[-1]
     overflow = (matched > cap).astype(jnp.int32)
     n_slots = jnp.minimum((matched + LANES - 1) // LANES,
                           jnp.int32(slots_cap))
@@ -252,9 +270,12 @@ def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int,
 
     @pl.when(step == 0)
     def _():
-        carry[0] = 0
-        carry[1] = 0
-        oflow[0] = 0
+        # explicit int32 literals: weakly-typed Python ints re-canonicalize
+        # to int64 when interpret mode's state discharge re-traces the
+        # jaxpr under an x64-enabled process (dtype-mismatched ref swap)
+        carry[0] = jnp.int32(0)
+        carry[1] = jnp.int32(0)
+        oflow[0] = jnp.int32(0)
 
     # strict lower triangular (R x R): exclusive in-lane running count
     row_i = jax.lax.broadcasted_iota(jnp.int32, (R, R), 0)
@@ -285,9 +306,11 @@ def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int,
         sl = slice(k * R, (k + 1) * R)
         m = mask_ref[sl, :] != 0                       # (R, 128)
         mf = m.astype(jnp.int32).astype(jnp.float32)
-        cnt = jnp.sum(m.astype(jnp.int32), axis=0,
-                      dtype=jnp.int32)                 # (128,)
-        adv = jnp.max(cnt)
+        # f32 reductions (exact: counts <= R=32): this jax's Mosaic cannot
+        # lower integer sum/max reductions
+        cntf = jnp.sum(mf, axis=0, dtype=jnp.float32)  # (128,)
+        cnt = cntf.astype(jnp.int32)
+        adv = jnp.max(cntf).astype(jnp.int32)
         dest = jax.lax.dot_general(
             stril, mf, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(jnp.int32)
@@ -296,23 +319,27 @@ def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int,
                            .astype(jnp.bfloat16))
         for ci in range(n_cols):
             x = col_refs[ci][sl, :]
-            comp = jnp.sum(jnp.where(scat, x[None, :, :], jnp.int32(0)),
-                           axis=1, dtype=jnp.int32)    # (R, 128) int32
+            # byte-split BEFORE the one-hot gather-sum so the reduction
+            # runs in f32 (exact: one-hot selects a single byte <= 255
+            # per output slot) — this jax's Mosaic cannot lower integer
+            # reductions at all
             for b in range(4):
                 if b < 3:
                     part = jax.lax.bitwise_and(
-                        jax.lax.shift_right_logical(comp, jnp.int32(8 * b)),
+                        jax.lax.shift_right_logical(x, jnp.int32(8 * b)),
                         jnp.int32(0xFF))
                 else:
-                    part = jax.lax.shift_right_arithmetic(comp, jnp.int32(24))
-                part_tiles[ci][b].append(
-                    part.astype(jnp.float32).astype(jnp.bfloat16))
+                    part = jax.lax.shift_right_arithmetic(x, jnp.int32(24))
+                partf = part.astype(jnp.float32)
+                compb = jnp.sum(
+                    jnp.where(scat, partf[None, :, :], jnp.float32(0)),
+                    axis=1, dtype=jnp.float32)         # (R, 128) f32
+                part_tiles[ci][b].append(compb.astype(jnp.bfloat16))
         offs.append(local_off)
         local_off = local_off + adv
         # f32 scalar sum (exact: <= 4096 per step); jnp.sum-to-scalar on
         # int32 sneaks an int64 intermediate past the Mosaic lowering
-        total = total + jnp.sum(cnt.astype(jnp.float32),
-                                dtype=jnp.float32).astype(jnp.int32)
+        total = total + jnp.sum(cntf, dtype=jnp.float32).astype(jnp.int32)
 
     stack_all = jnp.concatenate(
         [(stage_iota == offs[k] + sub_iota).astype(jnp.int32)
@@ -357,7 +384,7 @@ def _kernel(mask_ref, *rest, n_cols: int, slots_cap: int, n_steps: int,
 
     @pl.when(jnp.logical_not(fits))
     def _():
-        oflow[0] = 1
+        oflow[0] = jnp.int32(1)
 
     carry[1] = carry[1] + total
 
@@ -377,7 +404,9 @@ def _compact_pallas(mask, cols, n, slots_cap, k_sub, interp):
     step_rows = k_sub * R
     stage_rows = (k_sub + 1) * R
     n_steps = n // (step_rows * LANES)
-    mask2d = mask.reshape(n // LANES, LANES).astype(jnp.uint8)
+    # int8, not uint8: Mosaic's ir_constant cannot emit uint8 literals in
+    # this jax version, so `mask_ref != 0` failed TPU lowering
+    mask2d = mask.reshape(n // LANES, LANES).astype(jnp.int8)
     cols2d = [c.reshape(n // LANES, LANES) for c in cols]
 
     in_specs = [pl.BlockSpec((step_rows, LANES), lambda i: (i, 0),
@@ -404,7 +433,8 @@ def _compact_pallas(mask, cols, n, slots_cap, k_sub, interp):
         interpret=interp,
     )
     # the kernel is pure 32-bit; keep x64 promotion rules out of the trace
-    with jax.enable_x64(False):
+    from ..compat import disable_x64
+    with disable_x64():
         outs = call(mask2d, *cols2d)
 
     valid2d = outs[0]

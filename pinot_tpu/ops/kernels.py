@@ -887,6 +887,20 @@ def _from_orderable64(o: jax.Array, mode: str, acc_f) -> jax.Array:
     return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(acc_f)
 
 
+def _to_orderable(v: jax.Array, integral: bool, platform: str = None):
+    """_to_orderable64 at the narrowest exact carrier width: 32-bit-or-
+    smaller integers and f32-bijection orderables stay int32 so the
+    compaction kernel moves half the bytes and the sort compares narrower
+    keys. _from_orderable64 accepts either width per mode."""
+    if integral and jnp.issubdtype(v.dtype, jnp.integer) \
+            and v.dtype.itemsize <= 4:
+        return v.astype(jnp.int32), "int"
+    o, mode = _to_orderable64(v, integral, platform)
+    if mode == "f32":
+        return o.astype(jnp.int32), mode
+    return o, mode
+
+
 # post-aggregation size ladder: below this static capacity (elements) the
 # sort/matmul cost is trivial and the extra lax.switch branches only cost
 # compile time (the CPU test suite lives here). Env override for tests.
@@ -900,11 +914,16 @@ def _two_pass_mode() -> str:
     return os.environ.get("PINOT_COMPACT_TWO_PASS", "auto")
 
 
-def _post_sizes(cap_rows: int) -> List[int]:
-    """Geometric /8 ladder of slot-row sizes up to the full capacity."""
+def _post_sizes(cap_rows: int, step: int = 8,
+                min_rows: int = 512) -> List[int]:
+    """Geometric /step ladder of slot-row sizes up to the full capacity.
+    The MXU post keeps the coarse /8 ladder (each branch traces a full
+    sort/matmul program); the scatter post uses /4 down to 8 slot rows —
+    its segment-op branches are cheap to trace and the finer ladder keeps
+    the scatter's input within ~4x of the matched rows."""
     sizes = [cap_rows]
-    while sizes[-1] // 8 >= 512:
-        sizes.append(sizes[-1] // 8)
+    while sizes[-1] // step >= min_rows:
+        sizes.append(sizes[-1] // step)
     return sorted(set(sizes))
 
 
@@ -929,87 +948,152 @@ def _ladder_switch(sizes: List[int], n_valid, make_branch,
     return jax.lax.switch(idx, branches)
 
 
+def _payload_columns(plan: KernelPlan, mask, cols, params,
+                     platform: str = None):
+    """Fused aggregation-input materialization (round-6 tentpole).
+
+    Every aggregation input is evaluated ONCE over the full segment,
+    masked, and narrowed to its smallest exact carrier dtype BEFORE
+    compaction, so the compaction kernel moves [key] + payloads instead
+    of gathering every referenced source column, and the post-aggregation
+    never re-evaluates value expressions over capacity-sized arrays.
+    A 2-key GROUP BY with SUM(a - b) compacts 2 columns (key + int32
+    payload) where the round-5 path compacted 4 and re-ran the key
+    arithmetic and subtraction over the full static capacity.
+
+    Returns (arrays, sum_jobs, mm_jobs, ord_modes):
+      arrays    tuple of (bucket,) payload columns;
+      sum_jobs  [(agg_idx, spec, slot)] for sum/avg — slots deduped by
+                (value expression, integral), so SUM(x) + AVG(x) share
+                one compacted column;
+      mm_jobs   [(agg_idx, spec, slot)] for min/max (orderable slots
+                deduped by value expression);
+      ord_modes {slot: mode} consumed by _from_orderable64.
+    """
+    acc_f = float_acc_dtype()
+    arrays: List[jax.Array] = []
+    sum_slots: Dict[Tuple, int] = {}
+    ord_slots: Dict[object, int] = {}
+    ord_modes: Dict[int, str] = {}
+    sum_jobs: List[Tuple[int, AggSpec, int]] = []
+    mm_jobs: List[Tuple[int, AggSpec, int]] = []
+    for i, spec in enumerate(plan.aggs):
+        if spec.kind == "count":
+            continue
+        if spec.kind in ("sum", "avg"):
+            key = (spec.value, spec.integral)
+            slot = sum_slots.get(key)
+            if slot is None:
+                if spec.integral:
+                    v = _eval_value(spec.value, cols, params, promote=True)
+                    # the planner's interval arithmetic bounds |v| by
+                    # spec.bits: values under 2^31 ride int32 through the
+                    # compaction (half the bytes, no 64-bit split)
+                    dt = jnp.int32 if spec.bits < 32 else int_acc_dtype()
+                    v = jnp.where(mask, v, 0).astype(dt)
+                else:
+                    v = _eval_value(spec.value, cols, params).astype(acc_f)
+                    v = jnp.where(mask, v, jnp.zeros((), acc_f))
+                slot = len(arrays)
+                sum_slots[key] = slot
+                arrays.append(v)
+            sum_jobs.append((i, spec, slot))
+        elif spec.kind in ("min", "max"):
+            slot = ord_slots.get(spec.value)
+            if slot is None:
+                v = _eval_value(spec.value, cols, params)
+                integral = spec.integral and \
+                    jnp.issubdtype(v.dtype, jnp.integer)
+                o, mode = _to_orderable(v, integral, platform)
+                slot = len(arrays)
+                ord_slots[spec.value] = slot
+                ord_modes[slot] = mode
+                arrays.append(o)
+            mm_jobs.append((i, spec, slot))
+        else:
+            raise ValueError(
+                f"compact group-by cannot lower {spec.kind!r}")
+    return tuple(arrays), sum_jobs, mm_jobs, ord_modes
+
+
 def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
                         slots_cap: int, out: Dict[str, jax.Array],
                         platform: str = None,
                         scatter: bool = False,
                         two_pass_mode: Optional[str] = None,
                         ladder_min: Optional[int] = None) -> None:
-    """Group aggregation over compacted matched rows.
+    """Group aggregation over compacted matched rows — the fused
+    compaction -> sort -> segment-sum ladder (round-6 tentpole rewrite).
 
     Reference parity: DocIdSetOperator (docId materialization) +
-    DefaultGroupByExecutor, reshaped for the TPU: the Pallas compaction
-    kernel (ops/compact.py) concentrates the matched rows, then either a
-    factorized two-sided one-hot matmul (sums/counts, space <= 2^14: cost
-    M x space MACs on the MXU with no giant operand) or one sort + chunked
-    cumsum + boundary diffs (any agg, space <= 2^20) finishes the job.
+    DefaultGroupByExecutor, reshaped for the TPU. One fused prefix
+    evaluates the predicate mask, the cartesian dict-id group key, and
+    every aggregation payload (_payload_columns) in a single pass over
+    the segment; ONE compaction call (ops/compact.py) then concentrates
+    [key] + payloads. The post-aggregation core is picked per plan:
+
+    - scatter (CPU execution, cpu_scatter_default): jax.ops.segment_*
+      over the compacted prefix — the exact XLA compaction plus the
+      cost-model-tightened capacity mean the scatter touches ~matched
+      rows, not the static capacity;
+    - sorted (_needs_sort: min/max present or space > the factorized
+      limit): ONE lexicographic key sort carries every sum payload and
+      the first min/max orderable; all aggregations read one
+      searchsorted edges array (sort once, aggregate many);
+    - factorized (small spaces, sums only): two-sided one-hot matmul on
+      the MXU, fed by the precomputed payload limbs.
+
     Outputs are the same dense (space,) arrays as the dense strategy, so
     extraction and broker reduce are strategy-agnostic.
 
     Two refinements keep the post-aggregation cost proportional to the
-    rows actually matched instead of the static capacity (SSB Q2-Q4 are
-    0.001-1% selective, yet the sort/matmul used to run over the full
-    slots_cap every time):
+    rows actually matched instead of the static capacity:
 
     - a SECOND compaction pass over the first pass's output (Pallas path
       only by default): lane-wise compaction is loose — every 32-row
       subtile with any match advances a full slot row, so a sparse mask
       inflates 10-45x; re-compacting the already-small output costs a
-      fraction of pass 1 and lands within ~2-4x of the true matched
-      count. Pass-2 overflow falls back to the pass-1 arrays in-kernel
-      (a lax.switch branch), never to a host retry;
-    - a lax.switch SIZE LADDER: the post-aggregation is traced at a few
-      static sizes (slot rows, /8 apart) and the branch picked on device
-      by the compacted row count, so the sort sees ~the matched rows.
-
-    scatter=True (CPU execution, cpu_scatter_default): the aggregation
-    core after compaction is jax.ops.segment_* instead of the
-    factorized/sorted MXU shapes. Compaction still runs first — the
-    XLA nonzero fallback is cheap on CPU and at low selectivity it
-    shrinks the scatter's input by orders of magnitude (134M-row SSB:
-    q2.x kernels went seconds -> sub-second when the scatter stopped
-    touching unmatched rows).
+      fraction of pass 1. Pass-2 overflow falls back to the pass-1
+      arrays in-kernel (a lax.switch branch), never to a host retry;
+    - a lax.switch SIZE LADDER (now on every core, including scatter):
+      the post-aggregation is traced at a few static sizes (slot rows,
+      /8 apart) and the branch picked on device by the compacted row
+      count, so the post sees ~the matched rows even on the
+      full-capacity overflow retry.
     """
     from .compact import LANES, _use_pallas, compact
 
     space = plan.group_space
-    needed = sorted({ci for ci, _ in plan.group_keys}
-                    | set().union(*[_value_col_indices(s.value)
-                                    for s in plan.aggs if s.value is not None]
-                                  or [set()]))
     needs_sort = _needs_sort(plan)
+    mask, keys_s = _group_keys_sentinel(plan, mask, cols, params)
+    payloads, sum_jobs, mm_jobs, ord_modes = _payload_columns(
+        plan, mask, cols, params, platform)
     valid, comp, n_valid, matched, overflow = compact(
-        mask, tuple(cols[ci] for ci in needed), slots_cap, platform)
+        mask, (keys_s,) + payloads, slots_cap, platform)
     out["overflow"] = overflow
     out["matched"] = matched.astype(int_acc_dtype())
 
-    def assemble(comp_t) -> List[Optional[jax.Array]]:
-        full: List[Optional[jax.Array]] = [None] * len(cols)
-        for i, ci in enumerate(needed):
-            full[ci] = comp_t[i]
-        return full
-
-    if scatter:
-        ccols = assemble(comp)
-        _, keys = _group_keys_sentinel(plan, valid, ccols, params)
-        _scatter_group(plan, valid, keys, ccols, params, space, out)
-        return
-
     def post(valid_a, comp_t, rows: int) -> Dict[str, jax.Array]:
-        cc = assemble(tuple(c[:rows] for c in comp_t))
         v = valid_a[:rows]
-        _, k = _group_keys_sentinel(plan, v, cc, params)
+        # compacted garbage slots were zeroed; re-sentinel their keys so
+        # they can never pollute group 0 (payloads are already 0 there)
+        k = jnp.where(v, comp_t[0][:rows], jnp.int32(space))
+        pls = tuple(c[:rows] for c in comp_t[1:])
         o: Dict[str, jax.Array] = {}
-        if needs_sort:
-            _sorted_group(plan, k, v, cc, params, space, o, platform)
+        if scatter:
+            _scatter_post(sum_jobs, mm_jobs, ord_modes, k, v, pls,
+                          space, o)
+        elif needs_sort:
+            _sorted_post(sum_jobs, mm_jobs, ord_modes, k, v, pls,
+                         space, o)
         else:
-            _factorized_group(plan, k, v, cc, params, space, rows, o)
+            _factorized_post(sum_jobs, k, v, pls, space, rows, o)
         return o
 
     cap_rows = valid.shape[0]          # slots_cap * LANES elements
     mode = two_pass_mode if two_pass_mode is not None else _two_pass_mode()
     min_elems = ladder_min if ladder_min is not None else _ladder_min_elems()
-    two_pass = comp and (
+    two_pass = (not scatter) and (
         mode == "1"
         or (mode == "auto" and _use_pallas(bucket, platform)
             and cap_rows >= min_elems))
@@ -1025,16 +1109,64 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
             extra_when=of2 > 0))
         return
 
-    sizes = (_post_sizes(cap_rows // LANES) if cap_rows >= min_elems
-             else [cap_rows // LANES])
+    if scatter:
+        # the scatter ladder is always on: its branches trace in
+        # milliseconds and the full-capacity overflow retry depends on it
+        # to keep the segment ops near the matched count
+        sizes = _post_sizes(cap_rows // LANES, step=4, min_rows=8)
+    else:
+        sizes = (_post_sizes(cap_rows // LANES) if cap_rows >= min_elems
+                 else [cap_rows // LANES])
     out.update(_ladder_switch(
         sizes, n_valid,
         lambda s: functools.partial(post, valid, comp, s * LANES)))
 
 
-def _factorized_group(plan, keys, valid, ccols, params, space, m, out):
+def _scatter_post(sum_jobs, mm_jobs, ord_modes, keys, valid, payloads,
+                  space: int, out: Dict[str, jax.Array]) -> None:
+    """CPU scatter core over the compacted prefix: one jax.ops.segment_sum
+    per unique payload slot (counts ride the valid column), segment
+    min/max on the orderables. Garbage slots carry the sentinel key ==
+    space; the sentinel segment is sliced off."""
+    nseg = space + 1
+    cnt_dtype = int_acc_dtype()
+    acc_f = float_acc_dtype()
+    counts = jax.ops.segment_sum(valid.astype(cnt_dtype), keys,
+                                 num_segments=nseg)[:space]
+    out["group_count"] = counts
+    done: Dict[int, jax.Array] = {}
+    for i, spec, slot in sum_jobs:
+        name = _agg_name(i, spec)
+        s = done.get(slot)
+        if s is None:
+            acc = int_acc_dtype() if spec.integral else acc_f
+            s = jax.ops.segment_sum(payloads[slot].astype(acc), keys,
+                                    num_segments=nseg)[:space]
+            done[slot] = s
+        if spec.kind == "avg":
+            out[name + "_sum"] = s
+            out[name + "_cnt"] = counts
+        else:
+            out[name] = s
+    for i, spec, slot in mm_jobs:
+        name = _agg_name(i, spec)
+        o = payloads[slot]
+        sign = +1 if spec.kind == "min" else -1
+        filled = jnp.where(valid, o, _extreme(o.dtype, sign))
+        segf = (jax.ops.segment_min if spec.kind == "min"
+                else jax.ops.segment_max)
+        picked = segf(filled, keys, num_segments=nseg)[:space]
+        acc = _acc_dtype(spec)
+        vals = _from_orderable64(picked, ord_modes[slot], acc_f)
+        out[name] = jnp.where(counts > 0, vals.astype(acc),
+                              _extreme(acc, sign))
+
+
+def _factorized_post(sum_jobs, keys, valid, payloads, space, m, out):
     """sums[hi, lo] = (oh_hi . limb)^T @ oh_lo — two fused one-hot operands
     keep the contraction on the MXU without materializing (M, space).
+    Inputs are the precompacted payload columns (_payload_columns), so no
+    value expression is ever re-evaluated here.
 
     The contraction runs as a lax.scan over fixed-size row blocks: the
     (block, n_hi) x (block, 128) one-hot operands are rebuilt per block and
@@ -1048,33 +1180,26 @@ def _factorized_group(plan, keys, valid, ccols, params, space, m, out):
 
     cnt_dtype = int_acc_dtype()
     int_rows: List[jax.Array] = [valid.astype(jnp.int8)]
-    row_meta: List[Tuple[int, List[int], int]] = []
-    float_jobs: List[Tuple[int, AggSpec]] = []
-    deferred: List[Tuple[int, AggSpec, str]] = []
+    int_slot_meta: Dict[int, Tuple[int, List[int], int]] = {}
+    float_slot_idx: Dict[int, int] = {}
+    frows: List[jax.Array] = []
+    deferred: List[Tuple[int, AggSpec, str, int]] = []
 
-    for i, spec in enumerate(plan.aggs):
-        if spec.kind == "count":
-            continue
-        if spec.kind in ("sum", "avg") and spec.integral:
-            vals = _eval_value(spec.value, ccols, params, promote=True)
-            vals = jnp.where(valid, vals, 0)
-            rows, signs, b = _limb_rows(vals, valid, spec.bits, spec.signed,
-                                        m)
-            row_meta.append((len(int_rows), signs, b))
-            int_rows.extend(rows)
-            deferred.append((i, spec, "int_sum"))
-        elif spec.kind in ("sum", "avg"):
-            float_jobs.append((i, spec))
-            deferred.append((i, spec, "float_sum"))
+    for i, spec, slot in sum_jobs:
+        if spec.integral:
+            if slot not in int_slot_meta:
+                rows, signs, b = _limb_rows(payloads[slot], valid,
+                                            spec.bits, spec.signed, m)
+                int_slot_meta[slot] = (len(int_rows), signs, b)
+                int_rows.extend(rows)
+            deferred.append((i, spec, "int_sum", slot))
         else:
-            raise ValueError(
-                f"factorized group-by cannot lower {spec.kind!r}")
+            if slot not in float_slot_idx:
+                float_slot_idx[slot] = len(frows)
+                frows.append(payloads[slot])   # already masked acc_f
+            deferred.append((i, spec, "float_sum", slot))
 
     acc_f = float_acc_dtype()
-    frows = []
-    for i, spec in float_jobs:
-        v = _eval_value(spec.value, ccols, params).astype(acc_f)
-        frows.append(jnp.where(valid, v, 0))
 
     # block size: keep the per-block (R, MB, n_hi) int8 operand ~<=128MB
     n_int = len(int_rows)
@@ -1125,29 +1250,30 @@ def _factorized_group(plan, keys, valid, ccols, params, space, m, out):
     flat = S.reshape(n_int, g_pad)[:, :space]
     counts = flat[0].astype(cnt_dtype)
     out["group_count"] = counts
-    if float_jobs:
+    if frows:
         Fflat = F.reshape(len(frows), g_pad)[:, :space]
 
-    meta_iter = iter(row_meta)
-    fi = 0
-    for i, spec, how in deferred:
+    int_totals: Dict[int, jax.Array] = {}
+    for i, spec, how, slot in deferred:
         name = _agg_name(i, spec)
         if how == "int_sum":
-            start, signs, b = next(meta_iter)
-            total = jnp.zeros((space,), dtype=jnp.int64)
-            nl = signs.count(1)
-            for j, sign in enumerate(signs):
-                w = jnp.int64(1) << jnp.int64(b * (j % nl))
-                total = total + jnp.int64(sign) * w * \
-                    flat[start + j].astype(jnp.int64)
+            total = int_totals.get(slot)
+            if total is None:
+                start, signs, b = int_slot_meta[slot]
+                total = jnp.zeros((space,), dtype=jnp.int64)
+                nl = signs.count(1)
+                for j, sign in enumerate(signs):
+                    w = jnp.int64(1) << jnp.int64(b * (j % nl))
+                    total = total + jnp.int64(sign) * w * \
+                        flat[start + j].astype(jnp.int64)
+                int_totals[slot] = total
             if spec.kind == "avg":
                 out[name + "_sum"] = total
                 out[name + "_cnt"] = counts
             else:
                 out[name] = total
         else:
-            row = Fflat[fi]
-            fi += 1
+            row = Fflat[float_slot_idx[slot]]
             if spec.kind == "avg":
                 out[name + "_sum"] = row
                 out[name + "_cnt"] = counts
@@ -1163,44 +1289,30 @@ def _needs_sort(plan: KernelPlan) -> bool:
             or any(s.kind in ("min", "max") for s in plan.aggs))
 
 
-def _sorted_group(plan, keys, valid, ccols, params, space, out,
-                  platform: str = None):
-    """Sort-based group aggregation: one lexicographic sort of the compacted
-    rows carries every sum payload AND the first min/max orderable as the
-    secondary key (group min = first element of the run, max = last);
-    additional *distinct* min/max value expressions each need one more
-    (key, orderable) sort, but MIN(x)/MAX(x) share an orderable and every
-    sort shares the single searchsorted edges array (sorted keys are the
-    same multiset in all of them)."""
+def _sorted_post(sum_jobs, mm_jobs, ord_modes, keys, valid, payloads,
+                 space: int, out: Dict[str, jax.Array]) -> None:
+    """Sort-once, aggregate-many: ONE lexicographic sort of the compacted
+    prefix carries every sum payload AND the first min/max orderable as
+    the secondary key (group min = first element of the run, max = last);
+    every aggregation then reads the single searchsorted edges array.
+    Additional *distinct* min/max value expressions each need one more
+    (key, orderable) sort over the same prefix. Payloads arrive
+    precomputed (_payload_columns) — no value expression evaluates here."""
     acc_f = float_acc_dtype()
     cnt_dtype = int_acc_dtype()
 
-    sum_payloads: List[Tuple[int, AggSpec, jax.Array]] = []
-    minmax: List[Tuple[int, AggSpec]] = []
-    orderables: Dict[object, Tuple[int, jax.Array, str]] = {}  # value -> slot
-    for i, spec in enumerate(plan.aggs):
-        if spec.kind == "count":
-            continue
-        v = _eval_value(spec.value, ccols, params,
-                        promote=spec.integral)
-        if spec.kind in ("sum", "avg"):
-            if spec.integral:
-                v = jnp.where(valid, v, 0).astype(jnp.int64)
-            else:
-                v = jnp.where(valid, v, 0).astype(acc_f)
-            sum_payloads.append((i, spec, v))
-        else:
-            if spec.value not in orderables:
-                integral = (spec.integral
-                            and jnp.issubdtype(v.dtype, jnp.integer))
-                o, mode = _to_orderable64(v, integral, platform)
-                orderables[spec.value] = (len(orderables), o, mode)
-            minmax.append((i, spec))
+    sum_slots: List[int] = []        # unique payload slots, operand order
+    for _i, _s, slot in sum_jobs:
+        if slot not in sum_slots:
+            sum_slots.append(slot)
+    mm_slots: List[int] = []
+    for _i, _s, slot in mm_jobs:
+        if slot not in mm_slots:
+            mm_slots.append(slot)
 
-    by_slot = list(orderables.values())  # insertion order == slot order
-    first_o = [by_slot[0][1]] if by_slot else []
+    first_o = [payloads[mm_slots[0]]] if mm_slots else []
     operands = [keys] + first_o + [valid.astype(jnp.int32)] \
-        + [p for _, _, p in sum_payloads]
+        + [payloads[s] for s in sum_slots]
     sorted_ops = jax.lax.sort(operands, num_keys=1 + len(first_o))
     sk = sorted_ops[0]
     base = 1 + len(first_o)
@@ -1211,43 +1323,47 @@ def _sorted_group(plan, keys, valid, ccols, params, space, out,
         tot = jnp.concatenate([jnp.zeros(1, dtype), cs])
         return tot[edges[1:]] - tot[edges[:-1]]
 
-    counts = group_sums(sorted_ops[base], jnp.int64).astype(cnt_dtype)
+    counts = group_sums(sorted_ops[base], cnt_dtype).astype(cnt_dtype)
     out["group_count"] = counts
 
-    for oi, (i, spec, _) in enumerate(sum_payloads):
+    sums_done: Dict[Tuple[int, bool], jax.Array] = {}
+    for i, spec, slot in sum_jobs:
         name = _agg_name(i, spec)
-        sv = sorted_ops[base + 1 + oi]
-        s = group_sums(sv, jnp.int64 if spec.integral else acc_f)
+        s = sums_done.get((slot, spec.integral))
+        if s is None:
+            sv = sorted_ops[base + 1 + sum_slots.index(slot)]
+            s = group_sums(sv, int_acc_dtype() if spec.integral else acc_f)
+            sums_done[(slot, spec.integral)] = s
         if spec.kind == "avg":
             out[name + "_sum"] = s
             out[name + "_cnt"] = counts
         else:
             out[name] = s
 
-    # sorted orderables: slot 0 already rode the main sort
-    sorted_orderable: List[jax.Array] = []
-    for slot, o, _mode in by_slot:
-        if slot == 0:
-            sorted_orderable.append(sorted_ops[1])
+    # sorted orderables: the first slot already rode the main sort
+    sorted_orderable: Dict[int, jax.Array] = {}
+    for j, slot in enumerate(mm_slots):
+        if j == 0:
+            sorted_orderable[slot] = sorted_ops[1]
         else:
-            sorted_orderable.append(jax.lax.sort([keys, o], num_keys=2)[1])
+            sorted_orderable[slot] = jax.lax.sort(
+                [keys, payloads[slot]], num_keys=2)[1]
 
     n_rows = keys.shape[0]
     pos_min = jnp.minimum(edges[:-1], n_rows - 1)
     pos_max = jnp.clip(edges[1:] - 1, 0, n_rows - 1)
-    for i, spec in minmax:
+    for i, spec, slot in mm_jobs:
         name = _agg_name(i, spec)
-        slot, _o, mode = orderables[spec.value]
-        o_sorted = sorted_orderable[slot]
         pos = pos_min if spec.kind == "min" else pos_max
-        picked = o_sorted.at[pos].get(mode="clip")
-        vals = _from_orderable64(picked, mode, acc_f)
+        picked = sorted_orderable[slot].at[pos].get(mode="clip")
+        acc = _acc_dtype(spec)
+        vals = _from_orderable64(picked, ord_modes[slot], acc_f).astype(acc)
         # an empty group's edges collapse and pick a neighboring run's
         # row; neutralize to the extreme so cross-device pmin/pmax and
         # partial merges stay correct (dense _group_minmax convention)
         out[name] = jnp.where(
             counts > 0, vals,
-            _extreme(vals.dtype, 1 if spec.kind == "min" else -1))
+            _extreme(acc, 1 if spec.kind == "min" else -1))
 
 
 # ---------------------------------------------------------------------------

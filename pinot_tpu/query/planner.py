@@ -83,6 +83,13 @@ class CompiledPlan:
     # kselect path (device selection/order-by)
     select_plan: Optional[Any] = None
     select_names: List[str] = field(default_factory=list)
+    # cost model (multistage/costs.py): IR-derived selectivity estimate,
+    # the compaction capacity it implies for the compact strategy (None =
+    # kernel-default caps), and the strategy decision trace (EXPLAIN /
+    # profile tooling)
+    est_selectivity: Optional[float] = None
+    slots_cap: Optional[int] = None
+    strategy_trace: Optional[dict] = None
 
 
 @dataclass
@@ -1300,6 +1307,9 @@ class SegmentPlanner:
                     return CompiledPlan("host", seg, ctx)
 
         strategy = "dense"
+        est_sel: Optional[float] = None
+        slots_cap: Optional[int] = None
+        strat_trace: Optional[dict] = None
         key_exprs: List[Any] = []
         group_decoders: List[tuple] = []
         if ctx.is_group_by:
@@ -1366,11 +1376,39 @@ class SegmentPlanner:
                     # no matmul form for min/max; TPU scatter is
                     # pathological (kernels.MINMAX_UNROLL_GROUPS)
                     dense_viable = False
-            if compact_ok and (space > DENSE_SMALL_GROUPS
-                               or not dense_viable):
-                strategy = "compact"
-            elif not dense_viable:
+            if not dense_viable and not compact_ok:
                 return CompiledPlan("host", seg, ctx)
+            # cost-model strategy choice (round-6 tentpole): dense vs
+            # compact driven by IR-measured selectivity x group-space
+            # (multistage/costs.py), not the old space>512 heuristic.
+            # OPTION(groupByStrategy=dense|compact) pins it when a
+            # structurally-possible strategy is forced (hardware gates,
+            # differential tests).
+            from ..multistage import costs as _costs
+            from ..ops.kernels import (FACTORIZED_GROUP_LIMIT,
+                                       cpu_scatter_default)
+            col_cards = {
+                i: int(getattr(seg.columns.get(nm), "cardinality", 0) or 0)
+                for i, nm in enumerate(self.b.cols)}
+            est_sel = _costs.ir_selectivity(pred, self.b.params, col_cards)
+            platform = _jax.default_backend()
+            scatter_fast = cpu_scatter_default(platform)
+            needs_sort_flag = (space > FACTORIZED_GROUP_LIMIT
+                               or any(s.kind in ("min", "max")
+                                      for s in specs))
+            n_payloads = sum(1 for s in specs if s.kind != "count")
+            force = str(ctx.options.get("groupByStrategy", "")).lower() \
+                or None
+            strategy, strat_trace = _costs.choose_group_strategy(
+                seg.n_docs, space, est_sel, platform, scatter_fast,
+                needs_sort_flag, n_payloads, dense_viable, compact_ok,
+                force)
+            if strategy == "compact":
+                # size from the LIVE row count (n_docs), not the padded
+                # bucket — the pad rows are mask-false and consume no
+                # compaction slots
+                slots_cap = _costs.compact_slots_cap(
+                    seg.n_docs, est_sel, platform, scatter_fast)
 
         plan = KernelPlan(pred=pred, aggs=tuple(specs),
                           group_keys=tuple(group_keys),
@@ -1384,7 +1422,10 @@ class SegmentPlanner:
                             params=list(self.b.params),
                             agg_bindings=bindings,
                             group_cols=group_cols,
-                            group_decoders=group_decoders)
+                            group_decoders=group_decoders,
+                            est_selectivity=est_sel,
+                            slots_cap=slots_cap,
+                            strategy_trace=strat_trace)
 
     def _try_fast_path(self) -> Optional[CompiledPlan]:
         """Metadata/dictionary-only answers (AggregationPlanNode.java:98-112

@@ -162,3 +162,121 @@ def test_explain_shows_dynamic_filter(cluster):
         "ON items.item_id = facts.item_id")
     scans = [row[0] for row in r.rows if row[0].startswith("LEAF_SCAN")]
     assert any("dynamic_filter:" in s for s in scans), scans
+
+
+# ---------------------------------------------------------------------------
+# Group-by kernel strategy selector (round-6): the cost model must keep the
+# SSB sub-5x queries on the fast path. A heuristic change that flips q2.x
+# back to a slow strategy fails HERE, not in a hardware capture.
+# ---------------------------------------------------------------------------
+
+from pinot_tpu.multistage.costs import (choose_group_strategy,  # noqa: E402
+                                        compact_slots_cap, ir_selectivity)
+from pinot_tpu.ops.ir import And, Cmp, Col, EqId, IdRange, InSet, \
+    Or, TrueP  # noqa: E402
+
+SSB_ROWS = 1 << 27      # the 134M-row bench scale
+
+
+def _ssb_shape(qid):
+    """(pred, param_values, col_cards, space, needs_sort, n_payloads)
+    mirroring bench.py's SSB query shapes."""
+    if qid == "q2.2":   # p_brand1 BETWEEN (8 of 1000) AND s_region eq
+        pred = And((IdRange(0, 0, 1), EqId(1, 2)))
+        params = [100, 107, 1]
+        cards = {0: 1000, 1: 5}
+        return pred, params, cards, 7 * 1000, True, 1
+    if qid == "q2.3":   # p_brand1 eq AND s_region eq
+        pred = And((EqId(0, 0), EqId(1, 1)))
+        return pred, [5, 2], {0: 1000, 1: 5}, 7 * 1000, True, 1
+    if qid == "q3.2":   # c_nation eq, s_nation eq, d_year between
+        pred = And((EqId(0, 0), EqId(1, 1), IdRange(2, 2, 3)))
+        return pred, [7, 7, 0, 5], {0: 25, 1: 25, 2: 7}, \
+            250 * 250 * 7, True, 1
+    if qid == "q3.4":   # two 2-city IN sets + d_yearmonth eq
+        pred = And((InSet(0, 0, 2), InSet(1, 1, 2), EqId(2, 2)))
+        return pred, [np.array([10, 15]), np.array([10, 15]), 42], \
+            {0: 250, 1: 250, 2: 84}, 250 * 250 * 7, True, 1
+    assert qid == "q4.3"  # c_region eq, s_nation eq, d_year in, p_cat eq
+    pred = And((EqId(0, 0), EqId(1, 1),
+                Or((EqId(2, 2), EqId(2, 3))), EqId(3, 4)))
+    return pred, [1, 7, 5, 6, 13], {0: 5, 1: 25, 2: 7, 3: 25}, \
+        7 * 250 * 1000, True, 1
+
+
+@pytest.mark.parametrize("qid", ["q2.2", "q2.3", "q3.2", "q3.4", "q4.3"])
+@pytest.mark.parametrize("scatter", [False, True])
+def test_ssb_sub5x_queries_stay_compact(qid, scatter):
+    """Every round-5 sub-5x query keeps the compact strategy on both the
+    MXU (TPU-shaped) and scatter (CPU) cores, with a capacity far below
+    the input size (the whole point of the rework)."""
+    pred, params, cards, space, needs_sort, n_pay = _ssb_shape(qid)
+    sel = ir_selectivity(pred, params, cards)
+    assert sel < 0.05, f"{qid} selectivity estimate {sel} implausibly high"
+    strategy, trace = choose_group_strategy(
+        SSB_ROWS, space, sel, "cpu", scatter, needs_sort, n_pay,
+        dense_viable=True, compact_ok=True)
+    assert strategy == "compact", trace
+    cap = compact_slots_cap(SSB_ROWS, sel, "cpu", scatter)
+    # tight capacity: the post-aggregation must not run over the old
+    # n/16 default (65k slot rows at 134M)
+    assert cap * 128 < SSB_ROWS // 8, (qid, cap)
+
+
+def test_small_space_prefers_dense():
+    strategy, trace = choose_group_strategy(
+        SSB_ROWS, 64, 0.05, "cpu", False, False, 1,
+        dense_viable=True, compact_ok=True)
+    assert strategy == "dense", trace
+
+
+def test_all_match_scatter_prefers_dense():
+    """With nothing to filter out, compaction is pure overhead on the
+    scatter core — the selector must not pay it."""
+    strategy, trace = choose_group_strategy(
+        1 << 20, 2000, 1.0, "cpu", True, False, 1,
+        dense_viable=True, compact_ok=True)
+    assert strategy == "dense", trace
+
+
+def test_structural_gates_beat_costs():
+    s, _ = choose_group_strategy(SSB_ROWS, 2000, 1.0, "cpu", True, False,
+                                 1, dense_viable=False, compact_ok=True)
+    assert s == "compact"
+    s, _ = choose_group_strategy(SSB_ROWS, 2000, 0.001, "cpu", True,
+                                 False, 1, dense_viable=True,
+                                 compact_ok=False)
+    assert s == "dense"
+
+
+def test_force_option_overrides_costs():
+    s, t = choose_group_strategy(1 << 20, 2000, 1.0, "cpu", True, False,
+                                 1, dense_viable=True, compact_ok=True,
+                                 force="compact")
+    assert s == "compact" and t.get("forced") == "compact"
+    # a forced strategy that is structurally impossible is ignored
+    s, _ = choose_group_strategy(1 << 20, 2000, 1.0, "cpu", True, False,
+                                 1, dense_viable=True, compact_ok=False,
+                                 force="compact")
+    assert s == "dense"
+
+
+def test_capacity_quantization_is_stable():
+    """Nearby selectivity estimates must share one capacity (stable jit
+    cache key => zero retrace across iterations of similar queries)."""
+    caps = {compact_slots_cap(SSB_ROWS, s, "cpu", True)
+            for s in (0.00100, 0.00104, 0.00108)}
+    assert len(caps) == 1, caps
+
+
+def test_ir_selectivity_resolved_ranges():
+    """IdRange spans over the dictionary cardinality are exact — the
+    advantage over AST-level estimates that cannot see through string
+    dictionaries."""
+    sel = ir_selectivity(IdRange(0, 0, 1), [100, 107], {0: 1000})
+    assert sel == pytest.approx(8 / 1000)
+    sel = ir_selectivity(And((EqId(0, 0), TrueP())), [3], {0: 25})
+    assert sel == pytest.approx(1 / 25)
+    # negation + unprofiled fallbacks stay in (0, 1]
+    assert 0 < ir_selectivity(EqId(0, 0, negated=True), [3], {0: 25}) <= 1
+    assert 0 < ir_selectivity(Cmp(Col(0), "<", 0), [5], {}) <= 1

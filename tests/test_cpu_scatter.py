@@ -26,10 +26,16 @@ CARD_B = 50
 def data():
     rng = np.random.default_rng(23)
     n = N_ROWS
+    # skew: ~99% of rows carry value 0 but the dictionary holds 100
+    # distinct values, so the cost model's 1/NDV equality estimate
+    # undershoots ~100x — the capacity-overflow retry's trigger
+    skew = rng.integers(0, 100, n).astype(np.int32)
+    skew[rng.random(n) < 0.99] = 0
     return {
         "ka": np.array([f"a{i:03d}" for i in rng.integers(0, CARD_A, n)]),
         "kb": np.array([f"b{i:03d}" for i in rng.integers(0, CARD_B, n)]),
         "sel": rng.integers(0, 100, n).astype(np.int32),
+        "skew": skew,
         "v": rng.integers(-1000, 1000, n).astype(np.int32),
         "big": rng.integers(-4_000_000_000, 4_000_000_000,
                             n).astype(np.int64),
@@ -43,6 +49,7 @@ def broker(data, tmp_path_factory):
         FieldSpec("ka", DataType.STRING, FieldType.DIMENSION),
         FieldSpec("kb", DataType.STRING, FieldType.DIMENSION),
         FieldSpec("sel", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("skew", DataType.INT, FieldType.DIMENSION),
         FieldSpec("v", DataType.INT, FieldType.METRIC),
         FieldSpec("big", DataType.LONG, FieldType.METRIC),
         FieldSpec("f", DataType.DOUBLE, FieldType.METRIC),
@@ -54,6 +61,7 @@ def broker(data, tmp_path_factory):
     dm.add_segment_dir(d)
     b = Broker()
     b.register_table(dm)
+    b._seg_dir = d
     orig = b.query
 
     def patient_query(sql):
@@ -140,15 +148,31 @@ def test_scatter_distinctcount_vs_numpy(broker, data, scatter_on):
     assert got == {k: len(v) for k, v in oracle.items()}
 
 
-def test_scatter_all_match_overflow_retry(broker, data, scatter_on):
-    """An all-match query overflows the default compaction capacity;
-    the executor's retry ladder must deliver exact results through the
-    scatter core (compaction now runs before the scatter — the nonzero
-    is cheap on CPU and low selectivity shrinks the scatter input)."""
-    res = broker.query(
-        "SELECT ka, kb, COUNT(*) FROM t GROUP BY ka, kb LIMIT 100000")
+def test_scatter_capacity_overflow_retry(broker, data, scatter_on):
+    """A skewed predicate (99% of rows share one dictionary value) makes
+    the cost model's 1/NDV estimate undershoot ~100x, so the tight
+    estimated capacity overflows; the executor's full-capacity retry
+    must still deliver exact results through the scatter core. (The old
+    no-filter form of this test stopped exercising the retry once the
+    cost model — correctly — routes all-match group-bys to the dense
+    scatter core.)"""
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.planner import SegmentPlanner
+    from pinot_tpu.query.sql import parse_sql
+    from pinot_tpu.segment import ImmutableSegment
+
+    sql = ("SELECT ka, kb, COUNT(*) FROM t WHERE skew = 0 "
+           "GROUP BY ka, kb LIMIT 100000")
+    seg = ImmutableSegment.load(broker._seg_dir)
+    plan = SegmentPlanner(build_query_context(parse_sql(sql)), seg).plan()
+    assert plan.kernel_plan.strategy == "compact"
+    m = data["skew"] == 0
+    # the estimate must genuinely undershoot (else no overflow fires)
+    assert plan.est_selectivity * 20 < m.mean()
+    assert plan.slots_cap * 128 < m.sum()
+    res = broker.query(sql)
     oracle = {}
-    for i in range(N_ROWS):
+    for i in np.nonzero(data["skew"] == 0)[0]:
         k = (data["ka"][i], data["kb"][i])
         oracle[k] = oracle.get(k, 0) + 1
     got = {(r[0], r[1]): r[2] for r in res.rows}

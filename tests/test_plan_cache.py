@@ -1,0 +1,116 @@
+"""Keyed kernel-plan cache (ops/plan_cache.py): zero retrace across
+query iterations, stable cost-model capacities as cache keys, and
+result-stability of the donated-accumulator run path.
+
+The bench's round-6 acceptance gate ("second iteration of each query
+shows zero retrace") asserts exactly the counters covered here."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.ops.ir import AggSpec, Cmp, Col, KernelPlan
+from pinot_tpu.ops.plan_cache import KernelPlanCache, global_plan_cache
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+
+N = 4096
+
+
+def _plan():
+    return KernelPlan(
+        pred=Cmp(Col(1), "<", 0),
+        aggs=(AggSpec(kind="sum", value=Col(2), integral=True,
+                      bits=11, signed=True),),
+        group_keys=((0, 40),),
+        strategy="dense",
+    )
+
+
+def _cols(rng):
+    return (jnp.asarray(rng.integers(0, 40, N).astype(np.int32)),
+            jnp.asarray(rng.integers(0, 100, N).astype(np.int32)),
+            jnp.asarray(rng.integers(-1000, 1000, N).astype(np.int32)))
+
+
+def test_entry_reuse_and_counters():
+    cache = KernelPlanCache()
+    plan = _plan()
+    e1 = cache.entry(plan, N)
+    assert cache.stats() == {"hits": 0, "misses": 1, "entries": 1}
+    e2 = cache.entry(plan, N)
+    assert e2 is e1
+    assert cache.stats()["hits"] == 1
+    # a different capacity is a different compiled program
+    e3 = cache.entry(plan, N, slots_cap=64)
+    assert e3 is not e1
+    assert cache.stats()["misses"] == 2
+
+
+def test_repeated_runs_are_stable_and_traceless():
+    """Back-to-back runs through one entry (the donated-accumulator path
+    on accelerators, plain jit on CPU) return identical results and
+    never create new entries."""
+    rng = np.random.default_rng(3)
+    cache = KernelPlanCache()
+    cols = _cols(rng)
+    params = (jnp.asarray(np.int32(30)),)
+    ent = cache.entry(_plan(), N)
+    first = ent.run(cols, np.int32(N), params)
+    misses = cache.stats()["misses"]
+    for _ in range(3):
+        again = cache.entry(_plan(), N).run(cols, np.int32(N), params)
+        for k in first:
+            assert np.array_equal(first[k], again[k]), k
+    assert cache.stats()["misses"] == misses
+    assert ent.runs == 4
+
+
+def test_measured_selectivity_recorded():
+    cache = KernelPlanCache()
+    ent = cache.entry(_plan(), N)
+    ent.record_measured(123, 4096)
+    assert ent.measured_selectivity == pytest.approx(123 / 4096)
+
+
+@pytest.fixture(scope="module")
+def broker(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    n = 5000
+    data = {
+        "ka": np.array([f"a{i:03d}" for i in rng.integers(0, 40, n)]),
+        "kb": np.array([f"b{i:03d}" for i in rng.integers(0, 50, n)]),
+        "sel": rng.integers(0, 100, n).astype(np.int32),
+        "v": rng.integers(-1000, 1000, n).astype(np.int32),
+    }
+    schema = Schema("pc", [
+        FieldSpec("ka", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("kb", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("sel", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+    d = SegmentBuilder(schema, TableConfig("pc")).build(
+        data, str(tmp_path_factory.mktemp("pc_table")), "seg_0")
+    dm = TableDataManager("pc")
+    dm.add_segment_dir(d)
+    b = Broker()
+    b.register_table(dm)
+    return b
+
+
+def test_second_query_iteration_zero_retrace(broker):
+    """The end-to-end property the bench asserts: repeat executions of
+    the same SQL (compact strategy, cost-model capacity) add ZERO plan
+    cache misses after the first."""
+    sql = ("SELECT ka, kb, SUM(v), COUNT(*) FROM pc WHERE sel < 20 "
+           "GROUP BY ka, kb LIMIT 100000 OPTION(timeoutMs=300000)")
+    first = broker.query(sql)
+    misses = global_plan_cache.snapshot_misses()
+    for _ in range(2):
+        again = broker.query(sql)
+        assert sorted(map(tuple, again.rows)) == \
+            sorted(map(tuple, first.rows))
+    assert global_plan_cache.snapshot_misses() == misses

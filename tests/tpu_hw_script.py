@@ -168,6 +168,11 @@ def main() -> int:
 
     check_two_pass_ladder(out, broker, seg, srcs, k)
 
+    # round-6: the selectivity x group-space grid on the REAL chip — the
+    # q2.x/q3.x/q4.3 shapes must be digest-exact AND >= 5x the
+    # single-threaded numpy oracle per query (the BASELINE.json bar)
+    run_selectivity_grid(1 << 21, require_speedup=5.0, out=out)
+
     check_device_transforms(out)
     check_string_predicates(out)
     check_kselect(out)
@@ -225,6 +230,130 @@ def check_two_pass_ladder(out, broker, seg, srcs, k) -> None:
                 os.environ.pop(k2, None)
             else:
                 os.environ[k2] = v
+
+
+# ---------------------------------------------------------------------------
+# selectivity x group-space grid (round-6 satellite): the q2.2 / q2.3 /
+# q3.2 / q3.4 / q4.3 shapes as a synthetic sweep. Shared surface:
+# tests/test_tpu_hw.py runs it on CPU asserting digest-exactness vs the
+# numpy oracle; main() below runs it on the REAL chip additionally
+# asserting per-query kernel speedup >= 5x over the single-threaded
+# numpy oracle.
+# ---------------------------------------------------------------------------
+
+def grid_cases():
+    """(name, group_cols, sel_permille) mirroring the SSB sub-5x shapes:
+    2-key 7x1000 (q2.x), 3-key 250x250x7 (q3.2/q3.4), 3-key 7x250x1000
+    (q4.3); selectivities from 'almost nothing' through the edges."""
+    return [
+        ("q2.2-ish", ["k7", "k1000"], 2),
+        ("q2.3-ish", ["k7", "k1000"], 16),
+        ("q3.2-ish", ["k250a", "k250b", "k7"], 1),
+        ("q3.4-ish", ["k250a", "k250b", "k7"], 30),
+        ("q4.3-ish", ["k7", "k250a", "k1000"], 1),
+        ("empty",    ["k7", "k1000"], 0),
+        ("all-rows", ["k250a", "k7"], 1000),
+    ]
+
+
+def build_grid_table(n: int, seed: int = 53):
+    """One flat segment with every key cardinality the grid needs plus a
+    selectivity dial column (uniform 0..999)."""
+    import numpy as np
+
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType
+
+    rng = np.random.default_rng(seed)
+    data = {
+        "k7": rng.integers(0, 7, n).astype(np.int32),
+        "k250a": rng.integers(0, 250, n).astype(np.int32),
+        "k250b": rng.integers(0, 250, n).astype(np.int32),
+        "k1000": rng.integers(0, 1000, n).astype(np.int32),
+        "dial": rng.integers(0, 1000, n).astype(np.int32),
+        "v": rng.integers(-100_000, 100_000, n).astype(np.int32),
+    }
+    fields = [FieldSpec(c, DataType.INT,
+                        FieldType.METRIC if c == "v"
+                        else FieldType.DIMENSION) for c in data]
+    b, seg = _mini_table("grid", fields, data)
+    return b, seg, data
+
+
+def _grid_oracle(data, gcols, sel_permille):
+    """Single-threaded numpy group-by; returns ({key: (cnt, sum)}, secs).
+    INT dimension dictionaries are sorted and dense over the value range,
+    so dict ids == values and the broker rows compare directly."""
+    import time as _time
+
+    import numpy as np
+
+    t0 = _time.perf_counter()
+    m = data["dial"] < sel_permille
+    key = np.zeros(m.sum(), dtype=np.int64)
+    cards = []
+    for c in gcols:
+        card = int(data[c].max()) + 1
+        cards.append(card)
+        key = key * card + data[c][m]
+    cnts = np.bincount(key)
+    sums = np.bincount(key, weights=data["v"][m].astype(np.float64))
+    idxs = np.nonzero(cnts)[0]
+    oracle = {}
+    for i in idxs:
+        rem, kv = int(i), []
+        for card in reversed(cards):
+            kv.append(rem % card)
+            rem //= card
+        oracle[tuple(reversed(kv))] = (int(cnts[i]), int(sums[i]))
+    return oracle, _time.perf_counter() - t0
+
+
+def run_selectivity_grid(n: int, require_speedup: float = None,
+                         out: dict = None):
+    """Sweep the grid; assert digest-exactness per case, and (chip mode)
+    per-case kernel speedup >= require_speedup vs the numpy oracle."""
+    import numpy as np  # noqa: F401
+
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.planner import SegmentPlanner
+    from pinot_tpu.query.sql import parse_sql
+
+    broker, seg, data = build_grid_table(n)
+    for name, gcols, sel in grid_cases():
+        sql = (f"SELECT {', '.join(gcols)}, COUNT(*), SUM(v) FROM grid "
+               f"WHERE dial < {sel} GROUP BY {', '.join(gcols)} "
+               "LIMIT 1000000")
+        ctx = build_query_context(parse_sql(sql))
+        plan = SegmentPlanner(ctx, seg).plan()
+        if plan.kind != "kernel" and sel > 0:
+            raise AssertionError(f"grid {name}: planned {plan.kind}, "
+                                 "want kernel")
+        # sel == 0 legitimately folds to a pruned plan (metadata range
+        # pruning); the zero-match KERNEL path is covered by the runtime
+        # sel parameter sweep in tests/test_strategy_differential.py
+        oracle, cpu_s = _grid_oracle(data, gcols, sel)
+        res = broker.query(sql + " OPTION(timeoutMs=600000)")
+        got = {tuple(r[:len(gcols)]): (r[len(gcols)], r[len(gcols) + 1])
+               for r in res.rows}
+        if got != oracle:
+            strat = plan.kernel_plan.strategy if plan.kernel_plan \
+                else plan.kind
+            raise AssertionError(
+                f"grid {name} (sel {sel}/1000, strategy {strat}): "
+                f"{len(got)} groups vs oracle {len(oracle)} — "
+                "digests differ")
+        if require_speedup is not None and sel > 0:
+            from bench import kernel_time  # same timing convention
+            k_t, strategy, _nb = kernel_time(seg, sql, 5)
+            if k_t is None or cpu_s / k_t < require_speedup:
+                k_ms = f"{k_t * 1e3:.1f}ms" if k_t else "n/a"
+                spd = cpu_s / k_t if k_t else 0.0
+                raise AssertionError(
+                    f"grid {name} ({strategy}): kernel {k_ms} "
+                    f"vs cpu {cpu_s * 1e3:.1f}ms — "
+                    f"{spd:.1f}x < {require_speedup}x")
+        if out is not None:
+            out["checks"].append(f"grid:{name}")
 
 
 def _mini_table(name, schema_fields, data):

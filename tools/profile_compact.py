@@ -1,13 +1,19 @@
-"""Phase-level TPU profile of the compact-strategy SSB kernels.
+"""Phase-level profile of the fused compact-strategy SSB kernels.
 
-Decomposes kernel time into mask-eval / compaction / post-aggregation /
-transfer-compaction for the slow compact-path queries so optimization
-targets the real bottleneck (VERDICT r4 next-step #1b). Run standalone on
-the real chip (bounded by the caller):
+Decomposes kernel time into the round-6 pipeline's phases —
+mask / fuse (key + payload materialization) / compact / aggregate /
+transfer — for the slow compact-path queries, so strategy-ladder
+regressions are visible between captures (VERDICT r4 next-step #1b,
+round-6 satellite). Every run APPENDS one record per query to
+PERF_LEDGER.jsonl (metric "compact_phase_profile"), so the ledger keeps
+a phase-attribution history alongside the headline captures.
+
+Run standalone (CPU or chip; bounded by the caller):
 
     python tools/profile_compact.py q2.1 q3.2 q4.3
 
-Prints one JSON line per query with phase times and compaction stats.
+Prints one JSON line per query with phase times, compaction stats, and
+the planner's cost-model trace (estimated vs measured selectivity).
 """
 from __future__ import annotations
 
@@ -40,11 +46,12 @@ def timeit(fn, *args, iters=5):
 def main():
     qids = set(sys.argv[1:]) or {"q2.1", "q3.2", "q4.3"}
     from bench import QUERIES, build_or_load_segment, spec_to_sql
+    from bench_common import ledger_append_raw
     from pinot_tpu.engine.executor import resolve_params
     from pinot_tpu.ops import kernels
-    from pinot_tpu.ops.compact import (default_slots_cap, full_slots_cap,
-                                       sorted_default_slots_cap)
-    from pinot_tpu.ops.kernels import _needs_sort, jitted_kernel
+    from pinot_tpu.ops.compact import compact, full_slots_cap
+    from pinot_tpu.ops.kernels import (_needs_sort, _payload_columns,
+                                       cpu_scatter_default, jitted_kernel)
     from pinot_tpu.query.context import build_query_context
     from pinot_tpu.query.planner import SegmentPlanner
     from pinot_tpu.query.sql import parse_sql
@@ -52,6 +59,7 @@ def main():
     seg = build_or_load_segment()
     bucket = seg.bucket
     n = np.int32(seg.n_docs)
+    backend = jax.default_backend()
 
     for qid, preds, vexpr, gcols in QUERIES:
         if qid not in qids:
@@ -63,54 +71,72 @@ def main():
         cols = seg.device_cols(plan.col_names)
         params = resolve_params(plan)
 
-        res = {"qid": qid, "strategy": kp.strategy,
+        res = {"metric": "compact_phase_profile", "backend": backend,
+               "qid": qid, "n_rows": int(seg.n_docs),
+               "strategy": kp.strategy,
                "space": kp.group_space if kp.is_group_by else 0,
                "n_cols": len(cols),
-               "col_dtypes": [str(c.dtype) for c in cols],
-               "needs_sort": _needs_sort(kp) if kp.is_group_by else None}
+               "est_selectivity": plan.est_selectivity,
+               "cost_trace": plan.strategy_trace,
+               "needs_sort": _needs_sort(kp) if kp.is_group_by else None,
+               "scatter_core": cpu_scatter_default()}
 
-        # phase 1: mask eval only
+        # phase 1: predicate mask only
         def mask_fn(cols, n, params):
             valid = jnp.arange(bucket, dtype=jnp.int32) < n
             return valid & kernels._eval_pred(kp.pred, cols, params, bucket)
 
-        jmask = jax.jit(mask_fn)
-        res["t_mask_ms"] = round(timeit(jmask, cols, n, params) * 1e3, 2)
+        res["t_mask_ms"] = round(
+            timeit(jax.jit(mask_fn), cols, n, params) * 1e3, 2)
 
         if kp.strategy == "compact":
-            from pinot_tpu.ops.compact import compact
-            needed = sorted({ci for ci, _ in kp.group_keys}
-                            | set().union(
-                                *[kernels._value_col_indices(s.value)
-                                  for s in kp.aggs if s.value is not None]
-                                or [set()]))
-            cap = (sorted_default_slots_cap(bucket) if _needs_sort(kp)
-                   else default_slots_cap(bucket))
+            cap = plan.slots_cap or full_slots_cap(bucket)
             res["slots_cap"] = cap
             res["cap_rows"] = cap * 128
 
+            # phase 2: + fused key/payload materialization
+            def fuse_fn(cols, n, params):
+                m = mask_fn(cols, n, params)
+                m, keys = kernels._group_keys_sentinel(kp, m, cols, params)
+                payloads, *_meta = _payload_columns(kp, m, cols, params)
+                return (m, keys) + payloads
+
+            res["t_fuse_ms"] = round(
+                timeit(jax.jit(fuse_fn), cols, n, params) * 1e3, 2)
+
+            # phase 3: + one compaction of [key] + payloads
             def comp_fn(cols, n, params):
                 m = mask_fn(cols, n, params)
-                return compact(m, tuple(cols[ci] for ci in needed), cap)
+                m, keys = kernels._group_keys_sentinel(kp, m, cols, params)
+                payloads, *_meta = _payload_columns(kp, m, cols, params)
+                return compact(m, (keys,) + payloads, cap)
 
             jcomp = jax.jit(comp_fn)
-            res["t_mask_compact_ms"] = round(
+            res["t_compact_ms"] = round(
                 timeit(jcomp, cols, n, params) * 1e3, 2)
-            valid, ccols, n_valid, matched, overflow = jcomp(cols, n, params)
+            _v, _c, n_valid, matched, overflow = jcomp(cols, n, params)
             res["matched"] = int(matched)
+            res["measured_selectivity"] = round(
+                int(matched) / max(int(seg.n_docs), 1), 8)
             res["n_valid_rows"] = int(n_valid)
             res["overflow"] = int(overflow)
             res["inflation"] = round(int(n_valid) / max(int(matched), 1), 2)
 
-            # full kernel without transfer compaction
-            f_noxfer = jitted_kernel(kp, bucket, xfer_compact=False)
-            res["t_kernel_noxfer_ms"] = round(
+            # phase 4: + post-aggregation (full kernel minus transfer
+            # compaction)
+            f_noxfer = jitted_kernel(kp, bucket, plan.slots_cap,
+                                     xfer_compact=False)
+            res["t_aggregate_ms"] = round(
                 timeit(f_noxfer, cols, n, params) * 1e3, 2)
 
-        # full kernel (as shipped)
-        ffull = jitted_kernel(kp, bucket)
+        # phase 5: full kernel (as shipped, with transfer compaction)
+        ffull = jitted_kernel(kp, bucket, plan.slots_cap)
         res["t_kernel_ms"] = round(timeit(ffull, cols, n, params) * 1e3, 2)
+        if "t_aggregate_ms" in res:
+            res["t_transfer_ms"] = round(
+                max(res["t_kernel_ms"] - res["t_aggregate_ms"], 0.0), 2)
         print(json.dumps(res), flush=True)
+        ledger_append_raw(res)
 
 
 if __name__ == "__main__":
