@@ -317,17 +317,22 @@ def engine_e2e(broker, sql, iters):
     plan-cache misses during the POST-warmup iterations — the round-6
     acceptance gate requires it to be 0 (the keyed plan cache plus the
     quantized cost-model capacity make every repeat iteration a pure
-    cache hit)."""
+    cache hit). The in-engine RetraceDetector (round-7) must agree:
+    any divergence means a compile escaped the detector's generation
+    accounting."""
     from pinot_tpu.ops.plan_cache import global_plan_cache
 
     res = broker.query(sql + OPTION)  # warmup: upload + compile
     miss0 = global_plan_cache.snapshot_misses()
+    det0 = global_plan_cache.detector.retraces
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
         res = broker.query(sql + OPTION)
         best = min(best, time.perf_counter() - t0)
-    return res, best, global_plan_cache.snapshot_misses() - miss0
+    misses = global_plan_cache.snapshot_misses() - miss0
+    detected = global_plan_cache.detector.retraces - det0
+    return res, best, max(misses, detected)
 
 
 def kernel_time(seg, sql, iters):

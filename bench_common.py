@@ -185,19 +185,31 @@ def ledger_last(metric: str, backend: str | None = None,
 
 
 def ledger_append(out: dict, backend: str, ok: bool = True) -> None:
-    """Append this capture to PERF_LEDGER.jsonl (append-only history)."""
-    rec = {
-        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    """Append this capture as a validated v2 ``bench_capture`` record
+    (pinot_tpu/utils/ledger.py — the ONE schema every writer shares)."""
+    from pinot_tpu.utils import ledger as uledger
+
+    fields = {
         "backend": backend,
         "ok": ok,
-        "metric": out.get("metric"),
-        "value": out.get("value"),
+        "metric": out.get("metric") or "unknown",
+        "value": out.get("value") if out.get("value") is not None else 0,
         "vs_baseline": out.get("vs_baseline"),
         "n_rows": out.get("n_rows"),
         "queries": out.get("queries"),
     }
-    with open(LEDGER, "a") as f:
-        f.write(json.dumps(rec) + "\n")
+    fields = {k: v for k, v in fields.items() if v is not None
+              or k in ("metric", "value", "backend", "ok")}
+    try:
+        uledger.append_record(uledger.make_record("bench_capture",
+                                                  **fields), LEDGER)
+    except ValueError as e:
+        # the capture tail must never die on a schema bug: fall back to
+        # a legacy (no-"v") line, which check_ledger grandfathers
+        print(f"  ledger: schema fallback ({e})", file=sys.stderr)
+        fields["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(LEDGER, "a") as f:
+            f.write(json.dumps(fields) + "\n")
 
 
 def ledger_deltas(out: dict, prev: dict | None) -> dict | None:
@@ -235,11 +247,17 @@ def ledger_deltas(out: dict, prev: dict | None) -> dict | None:
 
 
 def ledger_append_raw(rec: dict) -> None:
-    """Append an arbitrary record (e.g. a phase-profile decomposition from
-    tools/profile_compact.py) to the ledger with a timestamp."""
+    """Append a record to the ledger with a timestamp. v2 records
+    (carrying "v"/"kind" — see pinot_tpu/utils/ledger.py) are validated;
+    anything else lands as a grandfathered legacy line."""
     rec = dict(rec)
     rec.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                        time.gmtime()))
+    if "v" in rec:
+        from pinot_tpu.utils import ledger as uledger
+
+        uledger.append_record(rec, LEDGER)
+        return
     with open(LEDGER, "a") as f:
         f.write(json.dumps(rec) + "\n")
 

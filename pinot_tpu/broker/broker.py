@@ -118,6 +118,7 @@ class Broker:
         stmt = parse_sql(sql)
         if isinstance(stmt, DdlStmt):
             return self._execute_ddl(stmt, t0)
+        stmt._raw_sql = sql  # for the EXPLAIN ANALYZE ledger record
         return self._execute_stmt(stmt, t0)
 
     # -- views (QueryEnvironment view catalog analog) ----------------------
@@ -217,6 +218,8 @@ class Broker:
             f"{table}_REALTIME" in self._tables
 
     def _execute_stmt(self, stmt, t0: float) -> ResultTable:
+        if getattr(stmt, "analyze", False):
+            return self._execute_analyze(stmt, t0)
         stmt = self._expand_views(stmt)
         if getattr(stmt, "ctes", None):
             return self._execute_with_ctes(stmt, t0)
@@ -265,6 +268,61 @@ class Broker:
             Tracing.unregister()
         if trace_on:
             result.trace = scope.to_dict()
+        return result
+
+    # -- EXPLAIN ANALYZE (round-7 observability tentpole) ------------------
+    def _execute_analyze(self, stmt, t0: float) -> ResultTable:
+        """Execute the statement for real under the span tracer and
+        return the rendered span tree: per-phase wall ms (planning /
+        kernel build / device execute / transfer / reduce), the
+        cost-model strategy trace, plan-cache hit/miss + retrace flags,
+        and estimated vs measured selectivity. OPTION(ledgerTrace=true)
+        additionally appends the tree as a v2 ``query_trace`` ledger
+        record (utils/ledger.py)."""
+        from ..ops.plan_cache import global_plan_cache
+        from ..query.explain import explain_analyze_rows
+        from ..utils.spans import span_tracer
+
+        stmt.analyze = False  # the re-entrant call executes normally
+        cache0 = global_plan_cache.stats()
+        root = span_tracer.start("query",
+                                 table=getattr(stmt, "table", None))
+        try:
+            inner = self._execute_stmt(stmt, t0)
+        finally:
+            root = span_tracer.stop() or root
+        cache1 = global_plan_cache.stats()
+        root.annotate(
+            rows=len(inner.rows),
+            num_segments=inner.num_segments,
+            num_docs_scanned=inner.num_docs_scanned,
+            cache_hits=cache1["hits"] - cache0["hits"],
+            cache_misses=cache1["misses"] - cache0["misses"],
+            retraces=cache1["retraces"] - cache0["retraces"])
+        # explicit self-time child: phase timings must sum to the wall
+        # time of the query, with broker bookkeeping (context build,
+        # quota, accountant registration) attributed, not hidden
+        overhead = root.duration_ms - root.children_ms()
+        if overhead > 0:
+            from ..utils.spans import Span
+            s = Span("broker_overhead")
+            s.duration_ms = overhead
+            root.children.append(s)
+        cols, rows = explain_analyze_rows(root)
+        result = ResultTable(cols, rows,
+                             num_segments=inner.num_segments,
+                             num_docs_scanned=inner.num_docs_scanned)
+        result.trace = {"spans": root.to_dict()}
+        if _truthy(stmt.options.get("ledgerTrace")):
+            import os
+
+            from ..utils import ledger as uledger
+            path = (stmt.options.get("ledgerPath")
+                    or os.environ.get("PINOT_TPU_LEDGER_PATH")
+                    or "PERF_LEDGER.jsonl")
+            uledger.append_record(uledger.trace_record(
+                root, getattr(stmt, "_raw_sql", str(stmt.table))), path)
+        result.time_ms = (time.perf_counter() - t0) * 1e3
         return result
 
     # -- hybrid offline+realtime tables (TimeBoundaryManager analog) -------
@@ -667,9 +725,11 @@ class Broker:
 
         # mesh-resident table: one shard_map program + ICI combine replaces
         # the per-segment scatter-gather entirely
+        from ..utils.spans import span
         if dm.distributed is not None and ctx.is_aggregation \
                 and not stmt.explain:
-            with Tracing.phase("distributed_execute"):
+            with Tracing.phase("distributed_execute"), \
+                    span("distributed_execute"):
                 partial = dm.distributed.try_execute(ctx)
             if partial is not None:
                 result = reduce_partials(ctx, [partial])
@@ -716,7 +776,8 @@ class Broker:
             raise QueryTimeoutError(
                 f"query timed out (>{int((deadline - t0) * 1e3)}ms)")
 
-        with Tracing.phase("reduce"):
+        with Tracing.phase("reduce"), span("reduce",
+                                           partials=len(partials)):
             result = reduce_partials(ctx, partials)
         result.num_segments = len(segments)
         result.num_segments_pruned = ex.pruned

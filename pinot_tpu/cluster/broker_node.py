@@ -186,6 +186,12 @@ class BrokerNode:
 
         self._check_quota(stmt.table, snap)
         ctx = build_query_context(stmt)
+        if getattr(stmt, "analyze", False):
+            # span scopes are per-process; the scatter-gather data plane
+            # would lose the servers' trees — analyze locally instead
+            raise SqlError("EXPLAIN ANALYZE is supported on the "
+                           "in-process broker only (run the query "
+                           "against a local Broker)")
         if stmt.explain:
             return self._explain_remote(sql, ctx.table)
         partials, queried, pruned = self._scatter(sql, ctx, snap)

@@ -118,6 +118,12 @@ class ResourceAccountant:
         with self._lock:
             return self._by_query.get(query_id)
 
+    def current_query_id(self) -> Optional[str]:
+        """The query this thread is executing on behalf of, if any (the
+        retrace detector's generation token)."""
+        with self._lock:
+            return self._by_thread.get(threading.get_ident())
+
     def running(self) -> List[QueryUsage]:
         with self._lock:
             return list(self._by_query.values())
@@ -145,6 +151,8 @@ class ResourceAccountant:
             raise QueryKilledError(
                 f"query {u.query_id} killed: {u.killed_reason}")
         if u.deadline is not None and time.perf_counter() > u.deadline:
+            from ..utils.metrics import global_metrics
+            global_metrics.count("query_deadline_kills")
             raise QueryKilledError(
                 f"query {u.query_id} killed: deadline exceeded",
                 is_deadline=True)
@@ -170,6 +178,8 @@ class ResourceAccountant:
         if u is None:
             return False
         u.killed_reason = reason
+        from ..utils.metrics import global_metrics
+        global_metrics.count("queries_killed")
         return True
 
     def kill_most_expensive(self, reason: str) -> Optional[str]:
@@ -179,6 +189,8 @@ class ResourceAccountant:
             return None
         victim = max(candidates, key=QueryUsage.cost)
         victim.killed_reason = reason
+        from ..utils.metrics import global_metrics
+        global_metrics.count("queries_killed")
         return victim.query_id
 
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import functools
 from collections import OrderedDict
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +23,7 @@ import numpy as np
 
 from ..ops.kernels import build_kernel
 from ..query.planner import CompiledPlan
+from ..utils.spans import annotate, device_fence, span
 from .executor import execute_plan, extract_partial, resolve_params
 
 # stacked-column cache: (segment names, cols, bucket) -> tuple of stacked
@@ -148,21 +149,44 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
             _run_segmented_compact(plans, idxs, plan_struct, bucket,
                                    cols, n_docs, params, results)
             continue
-        fn = _vmapped_kernel(plan_struct, bucket)
-        out = jax.device_get(fn(cols, n_docs, params))
-        global_accountant.track_memory(
-            sum(np.asarray(v).nbytes for v in out.values()))
-        for k, i in enumerate(idxs):
-            per_seg = {name: v[k] for name, v in out.items()}
-            if int(per_seg.pop("group_overflow", 0)):
-                # this segment alone exceeded the transfer-compaction cap;
-                # rerun it solo, straight to dense outputs
-                from .executor import run_kernel
-                dense = run_kernel(plans[i], xfer_compact=False)
-                results[i] = extract_partial(plans[i], dense)
-            else:
-                results[i] = extract_partial(plans[i], per_seg)
+        with span("vmap_dispatch", segments=n_seg, bucket=bucket,
+                  strategy=plan_struct.strategy):
+            _maybe_profile_phases(group_plans[0])
+            fn = _vmapped_kernel(plan_struct, bucket)
+            with span("device_execute"):
+                dev = fn(cols, n_docs, params)
+                device_fence(dev)
+            with span("device_transfer"):
+                out = jax.device_get(dev)
+            global_accountant.track_memory(
+                sum(np.asarray(v).nbytes for v in out.values()))
+            for k, i in enumerate(idxs):
+                per_seg = {name: v[k] for name, v in out.items()}
+                if int(per_seg.pop("group_overflow", 0)):
+                    # this segment alone exceeded the transfer-compaction
+                    # cap; rerun it solo, straight to dense outputs
+                    from .executor import run_kernel
+                    dense = run_kernel(plans[i], xfer_compact=False)
+                    results[i] = extract_partial(plans[i], dense)
+                else:
+                    results[i] = extract_partial(plans[i], per_seg)
     return results
+
+
+def _maybe_profile_phases(plan: CompiledPlan) -> None:
+    """EXPLAIN ANALYZE OPTION(profilePhases=true) on a batched dispatch:
+    attach the phase ladder of ONE representative segment (the group
+    shares plan structure and bucket, so phases scale uniformly) as
+    child spans — the fused paths bypass run_kernel's attach point."""
+    from ..query.planner import _truthy
+    from ..utils.spans import tracing_active
+    if not (tracing_active()
+            and _truthy(plan.ctx.options.get("profilePhases"))):
+        return
+    from ..ops.phase_profile import attach_phase_spans, profile_plan
+    with span("phase_profile", segment=plan.segment.name,
+              representative=True):
+        attach_phase_spans(profile_plan(plan, iters=2))
 
 
 def _run_segmented_compact(plans, idxs, plan_struct, bucket, cols, n_docs,
@@ -174,21 +198,36 @@ def _run_segmented_compact(plans, idxs, plan_struct, bucket, cols, n_docs,
     from .accounting import global_accountant
 
     n_seg = len(idxs)
-    cap = None
-    fn = jitted_segmented_compact(plan_struct, bucket, n_seg)
-    out = jax.device_get(fn(cols, n_docs, params))
-    if int(out.pop("overflow", 0)):
-        cap = full_slots_cap(n_seg * bucket)
+    # cost-model capacity scaled to the combined live rows of the fused
+    # dispatch (ROADMAP: no heuristic default caps on segmented paths)
+    from ..multistage.costs import scaled_compact_cap
+    cap = scaled_compact_cap(plans[idxs[0]],
+                             sum(plans[i].segment.n_docs for i in idxs))
+    with span("segmented_compact_dispatch", segments=n_seg, bucket=bucket,
+              slots_cap=cap, est_sel=plans[idxs[0]].est_selectivity):
+        _maybe_profile_phases(plans[idxs[0]])
         fn = jitted_segmented_compact(plan_struct, bucket, n_seg, cap)
-        out = jax.device_get(fn(cols, n_docs, params))
-        out.pop("overflow", None)
-    if int(out.pop("group_overflow", 0)):
-        fn = jitted_segmented_compact(plan_struct, bucket, n_seg, cap,
-                                      xfer_compact=False)
-        out = jax.device_get(fn(cols, n_docs, params))
-        out.pop("overflow", None)
-    global_accountant.track_memory(
-        sum(np.asarray(v).nbytes for v in out.values()))
+        with span("device_execute"):
+            dev = fn(cols, n_docs, params)
+            device_fence(dev)
+        out = jax.device_get(dev)
+        if int(out.pop("overflow", 0)):
+            cap = full_slots_cap(n_seg * bucket)
+            with span("overflow_retry", slots_cap=cap):
+                fn = jitted_segmented_compact(plan_struct, bucket, n_seg,
+                                              cap)
+                out = jax.device_get(fn(cols, n_docs, params))
+            out.pop("overflow", None)
+            annotate(overflow_retry=True, slots_cap=cap)
+        if int(out.pop("group_overflow", 0)):
+            with span("group_overflow_retry"):
+                fn = jitted_segmented_compact(plan_struct, bucket, n_seg,
+                                              cap, xfer_compact=False)
+                out = jax.device_get(fn(cols, n_docs, params))
+            out.pop("overflow", None)
+            annotate(group_overflow_retry=True)
+        global_accountant.track_memory(
+            sum(np.asarray(v).nbytes for v in out.values()))
     space = plan_struct.group_space
     matched = out.pop("matched")
     gi = out.pop("group_idx", None)

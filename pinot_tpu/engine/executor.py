@@ -21,6 +21,8 @@ from ..query.context import QueryContext
 from ..query.sql import Star
 from ..query.planner import AggBinding, CompiledPlan, SegmentPlanner
 from ..segment.immutable import ImmutableSegment
+from ..utils.metrics import global_metrics
+from ..utils.spans import annotate, span
 from . import host_eval
 
 
@@ -78,39 +80,48 @@ def execute_plan(plan: CompiledPlan):
     if plan.kind == "fast":
         return AggPartial(list(plan.fast_states))
     if plan.kind == "host":
-        if host_eval.null_aware(ctx):
-            mask, _ = host_eval.eval_filter_3vl(ctx.filter, seg)
-        else:
-            mask = host_eval.eval_filter(ctx.filter, seg)
-        vd = getattr(seg, "valid_docs", None)
-        if vd is not None:
-            from ..query.planner import _truthy
-            if not _truthy(ctx.options.get("skipUpsert")):
-                mask = mask & vd[: seg.n_docs]
-        if ctx.is_group_by:
-            return GroupByPartial(host_eval.host_group_by(ctx, seg, mask))
-        if ctx.is_aggregation:
-            return AggPartial(host_eval.host_aggregate(ctx, seg, mask))
-        labels, rows, okeys = host_eval.host_selection(ctx, seg, mask)
-        return SelectionPartial(labels, rows, okeys)
+        with span("segment_host", segment=seg.name):
+            if host_eval.null_aware(ctx):
+                mask, _ = host_eval.eval_filter_3vl(ctx.filter, seg)
+            else:
+                mask = host_eval.eval_filter(ctx.filter, seg)
+            vd = getattr(seg, "valid_docs", None)
+            if vd is not None:
+                from ..query.planner import _truthy
+                if not _truthy(ctx.options.get("skipUpsert")):
+                    mask = mask & vd[: seg.n_docs]
+            if ctx.is_group_by:
+                return GroupByPartial(
+                    host_eval.host_group_by(ctx, seg, mask))
+            if ctx.is_aggregation:
+                return AggPartial(host_eval.host_aggregate(ctx, seg, mask))
+            labels, rows, okeys = host_eval.host_selection(ctx, seg, mask)
+            return SelectionPartial(labels, rows, okeys)
     if plan.kind == "kselect":
         return extract_select(plan, run_select_kernel(plan))
     assert plan.kind == "kernel"
     out = run_kernel(plan)
-    return extract_partial(plan, out)
+    with span("extract_partial", segment=seg.name):
+        return extract_partial(plan, out)
 
 
 def run_select_kernel(plan: CompiledPlan) -> Dict[str, np.ndarray]:
     from ..ops.kernels import jitted_select_kernel
+    from ..utils.spans import device_fence
     seg = plan.segment
-    cols = seg.device_cols(plan.col_names)
-    params = resolve_params(plan)
-    fn = jitted_select_kernel(plan.select_plan, seg.bucket)
-    host = jax.device_get(fn(cols, np.int32(seg.n_docs), params))
-    from .accounting import global_accountant
-    global_accountant.track_memory(
-        sum(np.asarray(v).nbytes for v in host.values()))
-    return host
+    with span("segment_kselect", segment=seg.name, bucket=seg.bucket):
+        cols = seg.device_cols(plan.col_names)
+        params = resolve_params(plan)
+        fn = jitted_select_kernel(plan.select_plan, seg.bucket)
+        with span("device_execute"):
+            out = fn(cols, np.int32(seg.n_docs), params)
+            device_fence(out)
+        with span("device_transfer"):
+            host = jax.device_get(out)
+        from .accounting import global_accountant
+        global_accountant.track_memory(
+            sum(np.asarray(v).nbytes for v in host.values()))
+        return host
 
 
 def extract_select(plan: CompiledPlan, out: Dict[str, np.ndarray]
@@ -197,45 +208,76 @@ def run_kernel(plan: CompiledPlan,
     vmapped path)."""
     from ..ops.plan_cache import global_plan_cache
     seg = plan.segment
-    cols = seg.device_cols(plan.col_names)
-    params = resolve_params(plan)
-    n = np.int32(seg.n_docs)
-    cap = plan.slots_cap
-    entry = global_plan_cache.entry(plan.kernel_plan, seg.bucket, cap,
-                                    xfer_compact=xfer_compact)
-    if entry.overflowed:
-        # this capacity already overflowed for this plan: go straight to
-        # the (already compiled) full-capacity kernel instead of paying
-        # the doomed tight kernel plus the retry on every execution
-        from ..ops.compact import full_slots_cap
-        cap = full_slots_cap(seg.bucket)
+    with span("segment_kernel", segment=seg.name, bucket=seg.bucket,
+              strategy=plan.kernel_plan.strategy,
+              est_sel=plan.est_selectivity, slots_cap=plan.slots_cap):
+        cols = seg.device_cols(plan.col_names)
+        params = resolve_params(plan)
+        n = np.int32(seg.n_docs)
+        cap = plan.slots_cap
         entry = global_plan_cache.entry(plan.kernel_plan, seg.bucket, cap,
                                         xfer_compact=xfer_compact)
-    host = entry.run(cols, n, params)
-    if "matched" in host:
-        entry.record_measured(np.asarray(host["matched"]).sum(),
-                              seg.n_docs)
-    if int(host.pop("overflow", 0)):
-        # compact-strategy capacity exceeded (the selectivity estimate
-        # undershot): rerun with a capacity that cannot overflow
-        from ..ops.compact import full_slots_cap
-        entry.overflowed = True
-        cap = full_slots_cap(seg.bucket)
-        entry = global_plan_cache.entry(plan.kernel_plan, seg.bucket, cap,
-                                        xfer_compact=xfer_compact)
+        if entry.overflowed:
+            # this capacity already overflowed for this plan: go straight
+            # to the (already compiled) full-capacity kernel instead of
+            # paying the doomed tight kernel plus the retry on every
+            # execution
+            from ..ops.compact import full_slots_cap
+            cap = full_slots_cap(seg.bucket)
+            with global_plan_cache.detector.expected():
+                entry = global_plan_cache.entry(
+                    plan.kernel_plan, seg.bucket, cap,
+                    xfer_compact=xfer_compact)
+            annotate(slots_cap=cap, known_overflow=True)
         host = entry.run(cols, n, params)
-        host.pop("overflow", None)
-    if int(host.pop("group_overflow", 0)):
-        # more live groups than the transfer-compaction cap: rerun with
-        # dense (space,) outputs
-        entry = global_plan_cache.entry(plan.kernel_plan, seg.bucket, cap,
-                                        xfer_compact=False)
-        host = entry.run(cols, n, params)
-        host.pop("overflow", None)
-    from .accounting import global_accountant
-    global_accountant.track_memory(
-        sum(np.asarray(v).nbytes for v in host.values()))
-    return host
+        if "matched" in host:
+            matched = int(np.asarray(host["matched"]).sum())
+            entry.record_measured(matched, seg.n_docs)
+            annotate(matched=matched,
+                     meas_sel=matched / max(seg.n_docs, 1))
+        if int(host.pop("overflow", 0)):
+            # compact-strategy capacity exceeded (the selectivity estimate
+            # undershot): rerun with a capacity that cannot overflow
+            from ..ops.compact import full_slots_cap
+            entry.overflowed = True
+            cap = full_slots_cap(seg.bucket)
+            global_metrics.count("compact_overflow_retries")
+            with span("overflow_retry", slots_cap=cap), \
+                    global_plan_cache.detector.expected():
+                entry = global_plan_cache.entry(
+                    plan.kernel_plan, seg.bucket, cap,
+                    xfer_compact=xfer_compact)
+                host = entry.run(cols, n, params)
+            host.pop("overflow", None)
+            annotate(overflow_retry=True, slots_cap=cap)
+        if int(host.pop("group_overflow", 0)):
+            # more live groups than the transfer-compaction cap: rerun
+            # with dense (space,) outputs
+            global_metrics.count("group_xfer_overflow_retries")
+            with span("group_overflow_retry"), \
+                    global_plan_cache.detector.expected():
+                entry = global_plan_cache.entry(
+                    plan.kernel_plan, seg.bucket, cap,
+                    xfer_compact=False)
+                host = entry.run(cols, n, params)
+            host.pop("overflow", None)
+            annotate(group_overflow_retry=True)
+        from ..query.planner import _truthy
+        from ..utils.spans import tracing_active
+        if tracing_active() and _truthy(
+                plan.ctx.options.get("profilePhases")):
+            # EXPLAIN ANALYZE deep mode: re-measure the kernel's internal
+            # mask/fuse/compact/sort/aggregate/transfer ladder and attach
+            # it as child spans (compiles profiling prefixes — opt-in)
+            from ..ops.phase_profile import (attach_phase_spans,
+                                             profile_plan)
+            with span("phase_profile"):
+                prof = profile_plan(plan, iters=2)
+                attach_phase_spans(prof)
+        from .accounting import global_accountant
+        global_accountant.track_memory(
+            sum(np.asarray(v).nbytes for v in host.values()))
+        return host
 
 
 def extract_partial(plan: CompiledPlan, out: Dict[str, np.ndarray]):

@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional
 from ..query.context import QueryContext
 from ..query.planner import CompiledPlan, SegmentPlanner
 from ..startree.query import try_rollup_execute
+from ..utils.spans import annotate, span
 from ..utils.trace import Tracing
 from .batch import execute_plans_batched
 
@@ -36,9 +37,19 @@ class TableExecution:
 
 def plan_segments(ctx: QueryContext, segments: List[Any],
                   use_rollups: bool = True) -> TableExecution:
+    # one query = one plan-cache generation: the retrace detector flags
+    # any kernel compile of a plan structure already warm from an
+    # EARLIER query (ops/plan_cache.RetraceDetector). The accountant's
+    # query id dedupes multi-table executions of one query (hybrid
+    # offline+realtime) into a single warmup generation.
+    from ..ops.plan_cache import global_plan_cache
+    from .accounting import global_accountant
+    global_plan_cache.detector.begin_query(
+        global_accountant.current_query_id())
     plans: List[Optional[CompiledPlan]] = []
     precomputed: Dict[int, Any] = {}
-    with Tracing.phase("planning"):
+    with Tracing.phase("planning"), span("planning",
+                                         segments=len(segments)):
         for i, seg in enumerate(segments):
             partial = (try_rollup_execute(ctx, seg)
                        if use_rollups and hasattr(seg, "metadata") else None)
@@ -47,18 +58,29 @@ def plan_segments(ctx: QueryContext, segments: List[Any],
                 plans.append(None)
             else:
                 plans.append(SegmentPlanner(ctx, seg).plan())
-    ex = TableExecution(plans, [p for p in plans if p is not None],
-                        rollup_segments=len(precomputed))
-    ex._precomputed = precomputed  # type: ignore[attr-defined]
+        ex = TableExecution(plans, [p for p in plans if p is not None],
+                            rollup_segments=len(precomputed))
+        ex._precomputed = precomputed  # type: ignore[attr-defined]
+        if ex.real_plans:
+            p0 = ex.real_plans[0]
+            annotate(kinds=sorted({p.kind for p in ex.real_plans}),
+                     rollup_segments=len(precomputed), pruned=ex.pruned)
+            if p0.kind == "kernel":
+                annotate(strategy=p0.kernel_plan.strategy,
+                         est_sel=p0.est_selectivity,
+                         slots_cap=p0.slots_cap,
+                         cost_trace=p0.strategy_trace)
     return ex
 
 
 def execute_planned(ex: TableExecution) -> List[Any]:
     """Run the batched device dispatch and interleave rollup partials back
     into input order."""
-    with Tracing.phase("execution"):
-        executed = iter(execute_plans_batched(ex.real_plans))
+    with Tracing.phase("execution"), span("execution",
+                                          segments=len(ex.real_plans)):
+        executed = list(execute_plans_batched(ex.real_plans))
     precomputed = getattr(ex, "_precomputed", {})
+    executed = iter(executed)
     ex.partials = [precomputed[i] if p is None else next(executed)
                    for i, p in enumerate(ex.plans)]
     return ex.partials

@@ -328,6 +328,25 @@ def compact_slots_cap(n_rows: int, sel: float, platform: str,
     return int(min(max(_pow2_at_least(slots), floor), full))
 
 
+def scaled_compact_cap(plan, n_rows: int,
+                       platform: Optional[str] = None) -> Optional[int]:
+    """A CompiledPlan's cost-model compaction capacity re-derived for a
+    DIFFERENT row count — the fused multi-segment dispatch
+    (engine/batch.py) and the per-device mesh shard
+    (parallel/distributed.py) share this so the scaling rule cannot
+    fork. Re-quantized through compact_slots_cap, hence still a stable
+    kernel-cache key; None when the planner picked no cost-model cap
+    (kernel defaults apply)."""
+    if plan.slots_cap is None or plan.est_selectivity is None:
+        return None
+    import jax
+
+    from ..ops.kernels import cpu_scatter_default
+    platform = platform or jax.default_backend()
+    return compact_slots_cap(n_rows, plan.est_selectivity, platform,
+                             cpu_scatter_default(platform))
+
+
 def choose_group_strategy(n_rows: int, space: int, sel: float,
                           platform: str, scatter_fast: bool,
                           needs_sort: bool, n_payloads: int,

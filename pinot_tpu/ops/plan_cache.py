@@ -19,15 +19,27 @@ accumulator is only an aliasing source — the kernel never reads it. The
 first call builds a zeroed accumulator from jax.eval_shape (trace-only,
 no extra compile). run() device_gets inside the entry lock, so a buffer
 is never donated while another thread's host copy is in flight.
+
+Round-7 observability: every hit/miss also counts into
+utils.metrics.global_metrics (one snapshot covers the whole engine), a
+RetraceDetector flags any compile of an already-warm plan structure
+after its first query (a retrace: shape change, evicted entry, flipped
+env knob) as a span annotation + counter, and run() splits
+compile-vs-execute-vs-transfer into utils/spans spans when a trace is
+being taken.
 """
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
+from contextlib import contextmanager
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.metrics import global_metrics
+from ..utils.spans import device_fence, span, span_tracer
 
 
 def _donation_supported() -> bool:
@@ -37,6 +49,87 @@ def _donation_supported() -> bool:
         return jax.default_backend() != "cpu"
     except Exception:
         return False
+
+
+class RetraceDetector:
+    """Flags kernel compiles that happen AFTER a plan structure's first
+    (warmup) query — the silent perf killers: a bucket/shape change, an
+    evicted entry, a flipped env knob in the cache key.
+
+    Semantics: ``begin_query()`` (engine/serving.py, once per query)
+    advances a generation. A cache miss whose plan structure was already
+    compiled in an EARLIER generation is a retrace; misses within one
+    generation (a table with mixed segment buckets compiles the same
+    plan at several shapes on its first query) are warmup, not
+    retraces. ``expected()`` brackets deliberate recompiles (the
+    capacity-overflow retry ladder) so they count separately.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gen = 0
+        self._last_token: Any = object()       # never equals a real token
+        self._first_gen: Dict[int, int] = {}   # hash(plan) -> generation
+        self._expected = threading.local()
+        self.retraces = 0
+        self.expected_recompiles = 0
+
+    def begin_query(self, token: Any = None) -> None:
+        """Advance the generation. ``token`` (the accountant's query id)
+        dedupes multi-table executions of ONE query — a hybrid
+        offline+realtime query plans two segment lists but must stay a
+        single warmup generation, or its second half's cold compiles
+        would read as retraces."""
+        with self._lock:
+            if token is not None and token == self._last_token:
+                return
+            self._last_token = token
+            self._gen += 1
+
+    @contextmanager
+    def expected(self):
+        """Bracket a deliberate recompile (overflow retry ladder)."""
+        prev = getattr(self._expected, "on", False)
+        self._expected.on = True
+        try:
+            yield
+        finally:
+            self._expected.on = prev
+
+    def observe_compile(self, plan: Any) -> bool:
+        """Called by the cache on every miss; -> True when it fired."""
+        h = hash(plan)
+        expected = getattr(self._expected, "on", False)
+        with self._lock:
+            last = self._first_gen.get(h)
+            gen = self._gen
+            self._first_gen[h] = gen
+            if last is None or last >= gen:
+                return False
+            # counters mutate under the lock: concurrent server threads
+            # (cluster scatter pool) must not lose increments
+            if expected:
+                self.expected_recompiles += 1
+            else:
+                self.retraces += 1
+        if expected:
+            global_metrics.count("plan_cache_expected_recompiles")
+            return False
+        global_metrics.count("plan_cache_retraces")
+        span_tracer.annotate(retrace=True)
+        return True
+
+    def snapshot(self) -> Dict[str, int]:
+        return {"retraces": self.retraces,
+                "expected_recompiles": self.expected_recompiles}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._first_gen.clear()
+            self._gen = 0
+            self._last_token = object()
+            self.retraces = 0
+            self.expected_recompiles = 0
 
 
 class PlanCacheEntry:
@@ -78,17 +171,30 @@ class PlanCacheEntry:
         parallel exactly as the lru-jitted path always did. Only the
         donation path takes the entry lock: the accumulator swap and the
         device_get must serialize so a buffer is never donated while
-        another thread's host copy is still in flight."""
+        another thread's host copy is still in flight.
+
+        Under an active span trace the first-run (compile) vs execute vs
+        transfer split is fenced with block_until_ready; untraced runs
+        keep async dispatch."""
         if not self.donate:
             with self.lock:
                 self.runs += 1
-            return jax.device_get(self.fn(cols, n_docs, params))
+                first = self.runs == 1
+            with span("device_execute", compiled=first):
+                out = self.fn(cols, n_docs, params)
+                device_fence(out)
+            with span("device_transfer"):
+                return jax.device_get(out)
         with self.lock:
             self.runs += 1
+            first = self.runs == 1
             if self._acc is None:
                 self._acc = self.make_acc(cols, n_docs, params)
-            out = self.fn(cols, n_docs, params, self._acc)
-            host = jax.device_get(out)
+            with span("device_execute", compiled=first, donated=True):
+                out = self.fn(cols, n_docs, params, self._acc)
+                device_fence(out)
+            with span("device_transfer"):
+                host = jax.device_get(out)
             self._acc = out      # next call donates these buffers
             return host
 
@@ -113,6 +219,7 @@ class KernelPlanCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.detector = RetraceDetector()
 
     def entry(self, plan, bucket: int,
               slots_cap: Optional[int] = None,
@@ -131,29 +238,58 @@ class KernelPlanCache:
             if ent is not None:
                 self.hits += 1
                 self._entries.move_to_end(key)
-                return ent
-            self.misses += 1
+                hit = True
+            else:
+                self.misses += 1
+                hit = False
+        global_metrics.count("plan_cache_hits" if hit
+                             else "plan_cache_misses")
+        if hit:
+            span_tracer.annotate(cache="hit")
+            return ent
+        span_tracer.annotate(cache="miss")
+        self.detector.observe_compile(plan)
+        with span("build_kernel", bucket=bucket, slots_cap=slots_cap):
             base = build_kernel(plan, bucket, slots_cap, platform,
                                 xfer_compact, scatter=scatter,
                                 two_pass_mode=key[6], ladder_min=key[7])
             ent = PlanCacheEntry(base, _donation_supported())
-            self._entries[key] = ent
+        with self._lock:
+            # a concurrent miss may have built the same entry; keep the
+            # first one registered so its run stats/accumulator survive
+            ent = self._entries.setdefault(key, ent)
+            self._entries.move_to_end(key)
             while len(self._entries) > self._maxsize:
                 self._entries.popitem(last=False)
-            return ent
+            global_metrics.gauge("plan_cache_entries", len(self._entries))
+        return ent
 
     def stats(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses,
-                "entries": len(self._entries)}
+                "entries": len(self._entries),
+                **self.detector.snapshot()}
 
     def snapshot_misses(self) -> int:
         return self.misses
+
+    def measured_for(self, plan, bucket: int) -> Optional[float]:
+        """Most recently measured selectivity across entries of this plan
+        structure at this bucket (any capacity/flag variant) — the
+        feedback value the cost model's second capture reads."""
+        with self._lock:
+            entries = [e for k, e in self._entries.items()
+                       if k[0] == plan and k[1] == bucket]
+        for e in reversed(entries):
+            if e.measured_selectivity is not None:
+                return e.measured_selectivity
+        return None
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
             self.hits = 0
             self.misses = 0
+        self.detector.clear()
 
 
 global_plan_cache = KernelPlanCache()

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..compat import shard_map as _shard_map
 from ..engine.executor import extract_partial, resolve_params
+from ..utils.spans import annotate, device_fence, span
 from ..ops.kernels import build_kernel
 from ..query.context import QueryContext
 from ..query.planner import CompiledPlan, SegmentPlanner
@@ -173,26 +174,56 @@ class DistributedTable:
         out = self._run(plan)
         return extract_partial(plan, out)
 
+    def _cost_model_cap(self, plan: CompiledPlan) -> Optional[int]:
+        """Scale the planner's cost-model compaction capacity to one
+        device's LOCAL shard (local segment count x bucket) — the mesh
+        kernels must not run at the heuristic default caps (ROADMAP).
+        Shares multistage/costs.scaled_compact_cap with the fused batch
+        dispatch so the scaling rule cannot fork."""
+        if plan.kernel_plan.strategy != "compact":
+            return None
+        from ..multistage.costs import scaled_compact_cap
+        local = self.n_slots // self.n_dev
+        return scaled_compact_cap(plan, local * self.bucket,
+                                  self.mesh.devices.flat[0].platform)
+
     def _run(self, plan: CompiledPlan) -> Dict[str, np.ndarray]:
         cols = tuple(self.device_col(n) for n in plan.col_names)
         # replicated placement on THIS mesh's devices — never the default
         # backend (the driver's dryrun runs a CPU mesh under a TPU default)
         params = resolve_params(plan, sharding=self._sharding(P()))
-        fn = _distributed_kernel(plan.kernel_plan, self.bucket, self.mesh,
-                                 len(cols), len(params))
-        host = jax.device_get(fn(cols, self._n_docs, params))
-        if int(host.pop("overflow", 0)):
-            # compact capacity exceeded on some device: rerun at the
-            # cannot-overflow capacity of a full local shard
-            from ..ops.compact import full_slots_cap
-            local = self.n_slots // self.n_dev
-            fn = _distributed_kernel(
-                plan.kernel_plan, self.bucket, self.mesh,
-                len(cols), len(params),
-                slots_cap=full_slots_cap(local * self.bucket))
-            host = jax.device_get(fn(cols, self._n_docs, params))
-            host.pop("overflow", None)
-        return host
+        cap = self._cost_model_cap(plan)
+        local = self.n_slots // self.n_dev
+        with span("mesh_dispatch", devices=self.n_dev,
+                  local_segments=local, bucket=self.bucket,
+                  strategy=plan.kernel_plan.strategy, slots_cap=cap,
+                  est_sel=plan.est_selectivity):
+            fn = _distributed_kernel(plan.kernel_plan, self.bucket,
+                                     self.mesh, len(cols), len(params),
+                                     slots_cap=cap)
+            with span("device_execute"):
+                dev = fn(cols, self._n_docs, params)
+                device_fence(dev)
+            with span("device_transfer"):
+                host = jax.device_get(dev)
+            if int(host.pop("overflow", 0)):
+                # compact capacity exceeded on some device: rerun at the
+                # cannot-overflow capacity of a full local shard
+                from ..ops.compact import full_slots_cap
+                full = full_slots_cap(local * self.bucket)
+                with span("overflow_retry", slots_cap=full):
+                    fn = _distributed_kernel(
+                        plan.kernel_plan, self.bucket, self.mesh,
+                        len(cols), len(params), slots_cap=full)
+                    host = jax.device_get(fn(cols, self._n_docs, params))
+                host.pop("overflow", None)
+                annotate(overflow_retry=True, slots_cap=full)
+            if "matched" in host:
+                matched = int(np.asarray(host["matched"]).sum())
+                annotate(matched=matched,
+                         meas_sel=matched / max(
+                             sum(s.n_docs for s in self.segments), 1))
+            return host
 
 
 def _distributed_kernel(kernel_plan, bucket: int, mesh: Mesh,

@@ -1202,6 +1202,22 @@ class SegmentPlanner:
 
     # -- top-level ---------------------------------------------------------
     def plan(self) -> CompiledPlan:
+        """Plan this segment, recording the outcome (plan kind, strategy,
+        cost-model trace) as a child span of the query's planning span
+        when a trace is active (utils/spans.py — no-op otherwise)."""
+        from ..utils.spans import span
+        with span("plan_segment", segment=self.seg.name) as sp:
+            plan = self._plan()
+            if sp is not None:
+                sp.annotate(kind=plan.kind)
+                if plan.kind == "kernel":
+                    sp.annotate(strategy=plan.kernel_plan.strategy,
+                                est_sel=plan.est_selectivity,
+                                slots_cap=plan.slots_cap,
+                                cost_trace=plan.strategy_trace)
+            return plan
+
+    def _plan(self) -> CompiledPlan:
         ctx, seg = self.ctx, self.seg
         self._validate_columns()
         if _truthy(ctx.options.get("forceHostExecution")):

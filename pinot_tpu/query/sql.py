@@ -225,6 +225,9 @@ class SelectStmt:
     offset: int = 0
     options: dict = field(default_factory=dict)
     explain: bool = False
+    # EXPLAIN ANALYZE: execute for real and render the span tree
+    # (utils/spans.py) instead of the static operator tree
+    analyze: bool = False
     # WITH name [(cols)] AS (stmt), ... — materialized by the broker
     # before the main statement runs (QueryEnvironment.java:126 CTE
     # support analog)
@@ -264,6 +267,7 @@ class SetOpStmt:
     offset: int = 0
     options: dict = field(default_factory=dict)
     explain: bool = False
+    analyze: bool = False
     ctes: List[CteDef] = field(default_factory=list)
 
 
@@ -394,16 +398,22 @@ class _Parser:
                 raise SqlError(
                     f"unexpected trailing token {t.value!r} at {t.pos}")
             return ddl
-        explain = False
+        explain = analyze = False
         if self.accept_kw("explain"):
-            t = self.peek()  # contextual: EXPLAIN [PLAN FOR] SELECT ...
+            # contextual: EXPLAIN [PLAN FOR | ANALYZE] SELECT ...
+            t = self.peek()
             if t.kind == "ident" and t.value.lower() == "plan":
                 self.next()
                 t2 = self.next()
                 if not (t2.kind == "ident" and t2.value.lower() == "for"):
                     raise SqlError(f"expected FOR after EXPLAIN PLAN "
                                    f"at {t2.pos}")
-            explain = True
+                explain = True
+            elif t.kind == "ident" and t.value.lower() == "analyze":
+                self.next()
+                analyze = True  # executes the query; renders the span tree
+            else:
+                explain = True
         ctes = self._with_clause()
         stmt = self.compound()
         stmt.ctes = ctes
@@ -412,6 +422,7 @@ class _Parser:
             t = self.peek()
             raise SqlError(f"unexpected trailing token {t.value!r} at {t.pos}")
         stmt.explain = explain
+        stmt.analyze = analyze
         return stmt
 
     def _view_ddl(self) -> Optional[DdlStmt]:

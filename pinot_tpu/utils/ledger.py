@@ -1,0 +1,169 @@
+"""Unified perf ledger: ONE versioned JSONL schema for every writer.
+
+Before round 7 three writers appended ad-hoc shapes to PERF_LEDGER.jsonl
+(bench_common.ledger_append, bench_common.ledger_append_raw for
+tools/profile_compact.py, and bench_vector/bench_taxi through finish()),
+so nothing could validate the history or diff captures field-for-field.
+Now every line is a **v2 record**: common envelope
+``{"v": 2, "ts": ..., "kind": ...}`` plus a per-kind field contract
+below. tools/check_ledger.py validates the whole file (tier-1 runs it);
+lines WITHOUT a ``v`` field are grandfathered pre-v2 history and only
+parse-checked.
+
+Kinds:
+- ``bench_capture``    — bench.py / bench_vector.py / bench_taxi.py
+  headline summaries (metric, value, vs_baseline, per-query detail).
+- ``phase_profile``    — tools/profile_compact.py (ops/phase_profile.py)
+  kernel phase decompositions (mask/fuse/compact/sort/aggregate/
+  transfer) with the cost-model trace.
+- ``query_trace``      — utils/spans.py span trees (EXPLAIN ANALYZE /
+  OPTION(ledgerTrace=true)); the span fields are designed to be diffed
+  across CPU-smoke and TPU hardware rounds.
+- ``metrics_snapshot`` — utils/metrics_sinks.LedgerSink periodic
+  global_metrics snapshots.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 2
+
+# per-kind field contract: required/optional TOP-LEVEL fields. The
+# validator fails unknown fields (a typo'd field name must never
+# silently fork the schema) and missing required ones.
+KINDS: Dict[str, Dict[str, set]] = {
+    "bench_capture": {
+        "required": {"metric", "backend", "ok", "value"},
+        "optional": {"unit", "vs_baseline", "n_rows", "queries", "qid",
+                     "tpu_outage", "last_tpu_capture", "error", "errors",
+                     "partial", "delta_vs_last", "n_vectors", "dim",
+                     "extra"},
+    },
+    "phase_profile": {
+        "required": {"metric", "backend", "qid", "strategy"},
+        "optional": {"n_rows", "space", "n_cols", "est_selectivity",
+                     "cost_trace", "needs_sort", "scatter_core",
+                     "slots_cap", "cap_rows", "matched",
+                     "measured_selectivity", "n_valid_rows", "overflow",
+                     "inflation", "t_mask_ms", "t_fuse_ms",
+                     "t_compact_ms", "t_sort_ms", "t_aggregate_ms",
+                     "t_kernel_ms", "t_transfer_ms"},
+    },
+    "query_trace": {
+        "required": {"backend", "sql", "root"},
+        "optional": {"metric", "qid", "counters", "n_rows"},
+    },
+    "metrics_snapshot": {
+        "required": {"counters"},
+        "optional": {"gauges", "timers", "backend"},
+    },
+}
+
+_ENVELOPE = {"v", "ts", "kind"}
+
+
+def make_record(kind: str, **fields: Any) -> Dict[str, Any]:
+    """Build + validate one v2 record. Raises ValueError on a schema
+    violation so a writer can never append an invalid line."""
+    rec: Dict[str, Any] = {
+        "v": SCHEMA_VERSION,
+        "ts": fields.pop("ts", None) or time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "kind": kind,
+    }
+    rec.update(fields)
+    errs = validate_record(rec)
+    if errs:
+        raise ValueError(f"invalid ledger record ({kind}): "
+                         + "; ".join(errs))
+    return rec
+
+
+def validate_record(rec: Any) -> List[str]:
+    """-> list of violations (empty = valid). Records without ``v`` are
+    grandfathered pre-v2 history: only the dict shape is checked."""
+    if not isinstance(rec, dict):
+        return ["record is not a JSON object"]
+    if "v" not in rec:
+        return []  # legacy line: parse-checked only
+    errs: List[str] = []
+    if rec["v"] != SCHEMA_VERSION:
+        errs.append(f"unknown schema version {rec['v']!r}")
+        return errs
+    kind = rec.get("kind")
+    if kind not in KINDS:
+        errs.append(f"unknown kind {kind!r} (have {sorted(KINDS)})")
+        return errs
+    if not isinstance(rec.get("ts"), str):
+        errs.append("missing/invalid ts")
+    contract = KINDS[kind]
+    fields = set(rec) - _ENVELOPE
+    missing = contract["required"] - fields
+    unknown = fields - contract["required"] - contract["optional"]
+    if missing:
+        errs.append(f"missing required fields {sorted(missing)}")
+    if unknown:
+        errs.append(f"unknown fields {sorted(unknown)}")
+    return errs
+
+
+def append_record(rec: Dict[str, Any], path: str) -> None:
+    """Validated append (one JSON line). The validation here is the
+    writer-side enforcement of the check_ledger.py contract."""
+    errs = validate_record(rec)
+    if errs:
+        raise ValueError("refusing to append invalid ledger record: "
+                         + "; ".join(errs))
+    with open(path, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+
+
+def validate_file(path: str) -> Dict[str, Any]:
+    """Validate every line of a ledger file.
+
+    -> {"lines": N, "v2": N, "legacy": N, "errors": [(lineno, msg)...]}
+    """
+    out: Dict[str, Any] = {"lines": 0, "v2": 0, "legacy": 0, "errors": []}
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            out["lines"] += 1
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                out["errors"].append((i, f"unparseable JSON: {e}"))
+                continue
+            errs = validate_record(rec)
+            if errs:
+                out["errors"].append((i, "; ".join(errs)))
+            elif isinstance(rec, dict) and "v" in rec:
+                out["v2"] += 1
+            else:
+                out["legacy"] += 1
+    return out
+
+
+def trace_record(root: Any, sql: str, backend: Optional[str] = None,
+                 counters: Optional[Dict[str, int]] = None,
+                 **fields: Any) -> Dict[str, Any]:
+    """A ``query_trace`` record from a utils/spans.Span tree."""
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = "unknown"
+    root_d = root.to_dict() if hasattr(root, "to_dict") else root
+    rec: Dict[str, Any] = {"backend": backend, "sql": sql, "root": root_d}
+    if counters:
+        rec["counters"] = counters
+    rec.update(fields)
+    return make_record("query_trace", **rec)

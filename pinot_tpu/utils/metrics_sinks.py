@@ -94,6 +94,24 @@ class CallbackSink(MetricsSink):
         self.fn(snapshot)
 
 
+class LedgerSink(MetricsSink):
+    """Appends each snapshot as a v2 ``metrics_snapshot`` record to the
+    unified perf ledger (utils/ledger.py) — engine counters land in the
+    same validated JSONL history the bench and phase profiles use."""
+
+    def __init__(self, path: str = "PERF_LEDGER.jsonl"):
+        self.path = path
+
+    def emit(self, snapshot: Dict[str, Any]) -> None:
+        from . import ledger as uledger
+        uledger.append_record(
+            uledger.make_record("metrics_snapshot",
+                                counters=snapshot.get("counters", {}),
+                                gauges=snapshot.get("gauges", {}),
+                                timers=snapshot.get("timers", {})),
+            self.path)
+
+
 class MetricsFlushTask(BasePeriodicTask):
     """Periodic emitter: snapshot once, fan out to every sink
     (the metrics factory's scheduled reporters analog)."""
@@ -131,6 +149,7 @@ def _register() -> None:
     register_plugin("statsd", StatsdSink)
     register_plugin("prometheus_file", PrometheusFileSink)
     register_plugin("callback", CallbackSink)
+    register_plugin("ledger", LedgerSink)
 
 
 _register()

@@ -1,0 +1,171 @@
+"""Span tracer: one tree of timed, annotated spans per query.
+
+Reference parity: Pinot's per-request ``Tracing``/``ServerQueryPhase``
+timers (pinot-spi trace SPI), generalized the way "Query Processing on
+Tensor Computation Runtimes" attributes tensor-runtime query time —
+plan -> compile -> phase -> transfer — so the engine is tunable without
+hand-running tools/profile_compact.py.
+
+Unlike utils/trace.py (flat phase wall-ms for the response envelope,
+kept for API parity), spans form a TREE: each span has a name, wall-ms
+duration, free-form attributes, and children. The planner annotates the
+plan span with its cost-model decision trace; the plan cache annotates
+hit/miss and compile-vs-execute; the executor fences device execution
+vs host transfer with block_until_ready and records estimated vs
+measured selectivity; batch/mesh paths record per-dispatch fan-out and
+the compaction capacity they actually ran with.
+
+Zero cost when inactive: ``span()`` yields immediately unless a root
+was started on this thread, so the instrumentation can live on hot
+paths (per-segment launches) permanently. EXPLAIN ANALYZE
+(query/explain.py) renders the tree; utils/ledger.py emits it as a
+versioned ``query_trace`` ledger record so CPU-smoke and TPU hardware
+rounds diff span-for-span.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+class Span:
+    """One timed node: name, wall duration, attributes, children."""
+
+    __slots__ = ("name", "attrs", "children", "_t0", "duration_ms")
+
+    def __init__(self, name: str, **attrs: Any):
+        self.name = name
+        self.attrs: Dict[str, Any] = dict(attrs)
+        self.children: List["Span"] = []
+        self._t0 = time.perf_counter()
+        self.duration_ms = 0.0
+
+    def finish(self) -> "Span":
+        self.duration_ms = (time.perf_counter() - self._t0) * 1e3
+        return self
+
+    def annotate(self, **kv: Any) -> None:
+        self.attrs.update(kv)
+
+    def child(self, name: str) -> Optional["Span"]:
+        """First child with this name (depth 1), or None."""
+        for c in self.children:
+            if c.name == name:
+                return c
+        return None
+
+    def find(self, name: str) -> List["Span"]:
+        """All descendants (including self) with this name, pre-order."""
+        out = [self] if self.name == name else []
+        for c in self.children:
+            out.extend(c.find(name))
+        return out
+
+    def children_ms(self) -> float:
+        return sum(c.duration_ms for c in self.children)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "ms": round(self.duration_ms, 3),
+            "attrs": dict(self.attrs),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+class SpanTracer:
+    """Thread-local span stack. start()/stop() bracket one traced query;
+    span()/annotate() are permanent no-ops outside that bracket."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, name: str, **attrs: Any) -> Span:
+        root = Span(name, **attrs)
+        self._local.stack = [root]
+        return root
+
+    def stop(self) -> Optional[Span]:
+        stack = getattr(self._local, "stack", None)
+        self._local.stack = None
+        if not stack:
+            return None
+        root = stack[0]
+        # close anything left open (an exception mid-query must still
+        # yield a renderable tree)
+        for s in reversed(stack):
+            if s.duration_ms == 0.0:
+                s.finish()
+        return root
+
+    def active(self) -> bool:
+        return bool(getattr(self._local, "stack", None))
+
+    def current(self) -> Optional[Span]:
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else None
+
+    # -- recording ---------------------------------------------------------
+    @contextmanager
+    def span(self, name: str, **attrs: Any):
+        stack = getattr(self._local, "stack", None)
+        if not stack:
+            yield None
+            return
+        s = Span(name, **attrs)
+        stack[-1].children.append(s)
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.finish()
+            if stack and stack[-1] is s:
+                stack.pop()
+
+    def annotate(self, **kv: Any) -> None:
+        cur = self.current()
+        if cur is not None:
+            cur.annotate(**kv)
+
+    def add_event(self, name: str, duration_ms: float,
+                  **attrs: Any) -> None:
+        """Attach a pre-measured child span (a re-measured kernel phase
+        from ops/phase_profile.py) under the current span."""
+        cur = self.current()
+        if cur is not None:
+            s = Span(name, **attrs)
+            s.duration_ms = float(duration_ms)
+            cur.children.append(s)
+
+
+span_tracer = SpanTracer()
+
+
+# module-level conveniences (the form hot paths import)
+def span(name: str, **attrs: Any):
+    return span_tracer.span(name, **attrs)
+
+
+def annotate(**kv: Any) -> None:
+    span_tracer.annotate(**kv)
+
+
+def add_event(name: str, duration_ms: float, **attrs: Any) -> None:
+    span_tracer.add_event(name, duration_ms, **attrs)
+
+
+def tracing_active() -> bool:
+    return span_tracer.active()
+
+
+def device_fence(out: Any) -> None:
+    """block_until_ready fence separating device execution from host
+    transfer in the span tree — only when a trace is being taken, so the
+    untraced path keeps XLA's async dispatch pipelining."""
+    if span_tracer.active():
+        import jax
+
+        jax.block_until_ready(out)

@@ -40,7 +40,8 @@ def test_entry_reuse_and_counters():
     cache = KernelPlanCache()
     plan = _plan()
     e1 = cache.entry(plan, N)
-    assert cache.stats() == {"hits": 0, "misses": 1, "entries": 1}
+    stats = cache.stats()
+    assert (stats["hits"], stats["misses"], stats["entries"]) == (0, 1, 1)
     e2 = cache.entry(plan, N)
     assert e2 is e1
     assert cache.stats()["hits"] == 1

@@ -1,12 +1,15 @@
 """Phase-level profile of the fused compact-strategy SSB kernels.
 
 Decomposes kernel time into the round-6 pipeline's phases —
-mask / fuse (key + payload materialization) / compact / aggregate /
-transfer — for the slow compact-path queries, so strategy-ladder
-regressions are visible between captures (VERDICT r4 next-step #1b,
-round-6 satellite). Every run APPENDS one record per query to
-PERF_LEDGER.jsonl (metric "compact_phase_profile"), so the ledger keeps
-a phase-attribution history alongside the headline captures.
+mask / fuse (key + payload materialization) / compact / sort /
+aggregate / transfer — for the slow compact-path queries, so
+strategy-ladder regressions are visible between captures (VERDICT r4
+next-step #1b, round-6 satellite). The decomposition itself lives in
+pinot_tpu/ops/phase_profile.py (EXPLAIN ANALYZE's
+OPTION(profilePhases=true) shares it); this CLI appends one validated
+v2 ``phase_profile`` record per query to PERF_LEDGER.jsonl
+(pinot_tpu/utils/ledger.py), so the ledger keeps a phase-attribution
+history alongside the headline captures.
 
 Run standalone (CPU or chip; bounded by the caller):
 
@@ -20,45 +23,23 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np  # noqa: E402
-
-
-def timeit(fn, *args, iters=5):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t_one = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    outs = [fn(*args) for _ in range(iters + 1)]
-    jax.block_until_ready(outs)
-    t_k = time.perf_counter() - t0
-    # pipelined launches amortize the tunneled-dispatch floor: per-call
-    # device time ~= (t_{k+1} - t_1) / k (bench.kernel_time convention)
-    return max((t_k - t_one) / iters, 1e-9)
-
 
 def main():
+    import jax
+
     qids = set(sys.argv[1:]) or {"q2.1", "q3.2", "q4.3"}
     from bench import QUERIES, build_or_load_segment, spec_to_sql
-    from bench_common import ledger_append_raw
-    from pinot_tpu.engine.executor import resolve_params
-    from pinot_tpu.ops import kernels
-    from pinot_tpu.ops.compact import compact, full_slots_cap
-    from pinot_tpu.ops.kernels import (_needs_sort, _payload_columns,
-                                       cpu_scatter_default, jitted_kernel)
+    from bench_common import LEDGER
+    from pinot_tpu.ops.phase_profile import profile_plan
     from pinot_tpu.query.context import build_query_context
     from pinot_tpu.query.planner import SegmentPlanner
     from pinot_tpu.query.sql import parse_sql
+    from pinot_tpu.utils import ledger as uledger
 
     seg = build_or_load_segment()
-    bucket = seg.bucket
-    n = np.int32(seg.n_docs)
     backend = jax.default_backend()
 
     for qid, preds, vexpr, gcols in QUERIES:
@@ -67,79 +48,13 @@ def main():
         sql = spec_to_sql(preds, vexpr, gcols)
         ctx = build_query_context(parse_sql(sql))
         plan = SegmentPlanner(ctx, seg).plan()
-        kp = plan.kernel_plan
-        cols = seg.device_cols(plan.col_names)
-        params = resolve_params(plan)
-
-        res = {"metric": "compact_phase_profile", "backend": backend,
-               "qid": qid, "n_rows": int(seg.n_docs),
-               "strategy": kp.strategy,
-               "space": kp.group_space if kp.is_group_by else 0,
-               "n_cols": len(cols),
-               "est_selectivity": plan.est_selectivity,
-               "cost_trace": plan.strategy_trace,
-               "needs_sort": _needs_sort(kp) if kp.is_group_by else None,
-               "scatter_core": cpu_scatter_default()}
-
-        # phase 1: predicate mask only
-        def mask_fn(cols, n, params):
-            valid = jnp.arange(bucket, dtype=jnp.int32) < n
-            return valid & kernels._eval_pred(kp.pred, cols, params, bucket)
-
-        res["t_mask_ms"] = round(
-            timeit(jax.jit(mask_fn), cols, n, params) * 1e3, 2)
-
-        if kp.strategy == "compact":
-            cap = plan.slots_cap or full_slots_cap(bucket)
-            res["slots_cap"] = cap
-            res["cap_rows"] = cap * 128
-
-            # phase 2: + fused key/payload materialization
-            def fuse_fn(cols, n, params):
-                m = mask_fn(cols, n, params)
-                m, keys = kernels._group_keys_sentinel(kp, m, cols, params)
-                payloads, *_meta = _payload_columns(kp, m, cols, params)
-                return (m, keys) + payloads
-
-            res["t_fuse_ms"] = round(
-                timeit(jax.jit(fuse_fn), cols, n, params) * 1e3, 2)
-
-            # phase 3: + one compaction of [key] + payloads
-            def comp_fn(cols, n, params):
-                m = mask_fn(cols, n, params)
-                m, keys = kernels._group_keys_sentinel(kp, m, cols, params)
-                payloads, *_meta = _payload_columns(kp, m, cols, params)
-                return compact(m, (keys,) + payloads, cap)
-
-            jcomp = jax.jit(comp_fn)
-            res["t_compact_ms"] = round(
-                timeit(jcomp, cols, n, params) * 1e3, 2)
-            _v, _c, n_valid, matched, overflow = jcomp(cols, n, params)
-            res["matched"] = int(matched)
-            res["measured_selectivity"] = round(
-                int(matched) / max(int(seg.n_docs), 1), 8)
-            res["n_valid_rows"] = int(n_valid)
-            res["overflow"] = int(overflow)
-            res["inflation"] = round(int(n_valid) / max(int(matched), 1), 2)
-
-            # phase 4: + post-aggregation (full kernel minus transfer
-            # compaction)
-            f_noxfer = jitted_kernel(kp, bucket, plan.slots_cap,
-                                     xfer_compact=False)
-            res["t_aggregate_ms"] = round(
-                timeit(f_noxfer, cols, n, params) * 1e3, 2)
-
-        # phase 5: full kernel (as shipped, with transfer compaction)
-        ffull = jitted_kernel(kp, bucket, plan.slots_cap)
-        res["t_kernel_ms"] = round(timeit(ffull, cols, n, params) * 1e3, 2)
-        if "t_aggregate_ms" in res:
-            res["t_transfer_ms"] = round(
-                max(res["t_kernel_ms"] - res["t_aggregate_ms"], 0.0), 2)
-        print(json.dumps(res), flush=True)
-        ledger_append_raw(res)
+        rec = uledger.make_record(
+            "phase_profile",
+            metric="compact_phase_profile", backend=backend, qid=qid,
+            n_rows=int(seg.n_docs), **profile_plan(plan))
+        print(json.dumps(rec), flush=True)
+        uledger.append_record(rec, LEDGER)
 
 
 if __name__ == "__main__":
-    import jax
-    import jax.numpy as jnp
     main()
