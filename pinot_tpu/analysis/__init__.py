@@ -1,0 +1,28 @@
+"""Static analysis over the engine's two contract surfaces.
+
+Two passes, one goal: hazards that today corrupt results or retrace
+silently at RUN time must fail loudly at PLAN / LINT time, before a TPU
+is ever attached ("Query Processing on Tensor Computation Runtimes":
+relational-on-tensor stacks live or die by static shape/dtype contracts).
+
+- plan_verify: abstract shape/dtype inference over the ops/ir.py kernel
+  plan tree — index bounds, plan-cache hashability, lossless carrier
+  narrowing, SUM accumulator width, compaction-capacity invariants,
+  strategy gates. Wired into query/planner.py as a fail-fast post-plan
+  step and into ops/plan_cache.py as a debug assertion.
+- jaxlint: AST rules over the package source — host syncs in device hot
+  paths, jax.jit constructed inside loops, non-static Python state read
+  under trace, unlocked mutation of shared registries. Allowlists plus a
+  checked-in ratchet baseline (tools/jaxlint_baseline.json) grandfather
+  the legitimate host-side sites.
+
+`tools/check_static.py` runs both passes (the linter over the tree, the
+verifier over every plan the planner produces for the SSB + taxi +
+fuzzer query corpus) and gates tier-1 alongside tools/check_ledger.py.
+"""
+from .plan_verify import (Diagnostic, PlanVerificationError,  # noqa: F401
+                          RULES, check_compiled_plan, format_diagnostics,
+                          verify_compiled_plan, verify_kernel_plan)
+from .jaxlint import (Finding, LINT_RULES, compare_baseline,  # noqa: F401
+                      lint_source, lint_tree, load_baseline,
+                      write_baseline)

@@ -1,0 +1,423 @@
+"""JAX hazard linter: AST rules over the package source.
+
+The runtime observability layer (PR 2's RetraceDetector, span tracer)
+catches hot-path hazards only AFTER they burn a query. These rules catch
+the same hazard classes at lint time:
+
+- ``host-sync``: ``.item()`` / ``np.asarray`` / ``jax.device_get`` /
+  ``int(...)``/``float(...)`` over call results in the device hot paths
+  (ops/, engine/, multistage/, parallel/). Each forces a device→host
+  round trip when applied to a device value; a stray one inside a
+  dispatch loop serializes the pipeline. Most existing occurrences are
+  legitimately host-side (post-``device_get`` extraction, host_eval) —
+  those live in per-module allowlists, inline suppressions, or the
+  checked-in ratchet baseline (tools/jaxlint_baseline.json).
+- ``jit-in-loop``: ``jax.jit(...)`` constructed inside a ``for``/
+  ``while`` body — a fresh jit wrapper per iteration defeats the trace
+  cache and retraces per query/row.
+- ``nonstatic-trace``: reads of non-static Python state (``os.environ``,
+  ``time.*``, ``random``) inside functions that are jitted in the same
+  module — the value bakes into the compiled program at trace time and
+  silently goes stale.
+- ``unlocked-mutation``: in classes that guard state with a lock
+  attribute, a mutation of lock-guarded shared state (metrics counters,
+  plan-cache registries, retrace counters) outside a ``with self.<lock>``
+  block — increments race and observability counters drift.
+
+Suppression: append ``# jaxlint: ok <rule>`` (comma-separated rules or
+``all``) to the offending line. Grandfathered sites are counted per
+``file::scope::rule`` in the baseline — new findings above the baseline
+count fail ``tools/check_static.py``; counts that DROP fail too until
+the baseline is ratcheted down with ``--update-baseline``.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+LINT_RULES = {
+    "host-sync": "device→host sync in a device hot path",
+    "jit-in-loop": "jax.jit constructed inside a loop (retrace hazard)",
+    "nonstatic-trace": "non-static Python state read under jit trace",
+    "unlocked-mutation": "lock-guarded shared state mutated without "
+                         "the lock",
+    # never baselined (write_baseline drops it): a module that stops
+    # parsing must fail the gate no matter what was grandfathered
+    "parse-error": "module failed to parse",
+}
+
+# host-sync applies only inside the device hot paths
+HOT_PACKAGES = ("ops", "engine", "multistage", "parallel")
+# modules that ARE the host path by design: every value they touch is
+# host numpy (oracle/merge/cost code), so the host-sync rule is noise
+HOST_SYNC_ALLOW = (
+    "pinot_tpu/engine/host_eval.py",     # host evaluation by definition
+    "pinot_tpu/ops/aggregations.py",     # host partial-state registry
+    "pinot_tpu/ops/sketches.py",         # host sketch implementations
+    "pinot_tpu/multistage/costs.py",     # pure host cost model
+)
+
+_NUMPY_NAMES = ("np", "numpy", "_np")
+_SYNC_ATTRS = {"asarray", "array", "device_get"}
+_MUTATING_METHODS = {"append", "extend", "add", "update", "setdefault",
+                     "pop", "popitem", "clear", "remove", "discard",
+                     "insert", "move_to_end"}
+_NONSTATIC_CALLS = {("os", "getenv"), ("time", "time"),
+                    ("time", "perf_counter"), ("time", "thread_time"),
+                    ("time", "monotonic")}
+
+_SUPPRESS_RE = re.compile(r"jaxlint:\s*ok\s+([\w,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # repo-relative, posix separators
+    line: int
+    scope: str      # enclosing qualname, e.g. "KernelPlanCache.entry"
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline key: line numbers drift, (file, scope, rule) don't."""
+        return f"{self.path}::{self.scope}::{self.rule}"
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.scope}: "
+                f"{self.message}")
+
+
+def _suppressions(src: str) -> Dict[int, set]:
+    out: Dict[int, set] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _call_name(func: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """('np', 'asarray') for np.asarray(...); (None, 'int') for int(...)."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return func.value.id, func.attr
+    if isinstance(func, ast.Name):
+        return None, func.id
+    return None, None
+
+
+def _is_jax_jit(func: ast.AST) -> bool:
+    base, attr = _call_name(func)
+    return (base == "jax" and attr == "jit") or \
+        (base is None and attr == "jit")
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, src: str, hot: bool):
+        self.path = path
+        self.hot = hot
+        self.suppress = _suppressions(src)
+        self.scope: List[str] = []
+        self.loop_depth = 0
+        self.jitted_fns: set = set()
+        self.findings: List[Finding] = []
+
+    # -- plumbing ----------------------------------------------------------
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        sup = self.suppress.get(line, ())
+        if rule in sup or "all" in sup:
+            return
+        self.findings.append(Finding(
+            rule, self.path, line,
+            ".".join(self.scope) or "<module>", message))
+
+    def _walk_scope(self, name: str, node: ast.AST) -> None:
+        self.scope.append(name)
+        outer_loops = self.loop_depth
+        self.loop_depth = 0      # a new function resets loop context
+        self.generic_visit(node)
+        self.loop_depth = outer_loops
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._function(node)
+
+    def _function(self, node: Any) -> None:
+        for dec in node.decorator_list:
+            if _is_jax_jit(dec) or (
+                    isinstance(dec, ast.Call) and (
+                        _is_jax_jit(dec.func)
+                        or any(_is_jax_jit(a) for a in dec.args))):
+                self.jitted_fns.add(node.name)
+        self._walk_scope(node.name, node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._lint_lock_discipline(node)
+        self._walk_scope(node.name, node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._loop(node)
+
+    def visit_AsyncFor(self, node: ast.AsyncFor) -> None:
+        self._loop(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop(node)
+
+    def _loop(self, node: Any) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    # -- host-sync + jit-in-loop ------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        base, attr = _call_name(node.func)
+        if _is_jax_jit(node.func) and self.loop_depth > 0:
+            self.emit("jit-in-loop", node,
+                      "jax.jit constructed inside a loop body retraces "
+                      "every iteration; hoist it (or functools.lru_cache "
+                      "the builder) so the trace cache can hit")
+        if self.hot:
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                self.emit("host-sync", node,
+                          ".item() blocks on the device; fence once "
+                          "after execute instead")
+            elif attr in _SYNC_ATTRS and (
+                    base in _NUMPY_NAMES or (base == "jax"
+                                             and attr == "device_get")):
+                self.emit("host-sync", node,
+                          f"{base}.{attr}() on a device value forces a "
+                          "transfer; do it once behind the post-execute "
+                          "fence")
+            elif base is None and attr in ("int", "float", "bool") \
+                    and len(node.args) == 1 \
+                    and isinstance(node.args[0], (ast.Call, ast.Subscript)):
+                self.emit("host-sync", node,
+                          f"{attr}() over a computed value syncs if the "
+                          "value lives on device; hoist past the fence")
+        self.generic_visit(node)
+
+    # -- nonstatic-trace ---------------------------------------------------
+    @staticmethod
+    def _dotted(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            base = _Linter._dotted(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.scope and self.scope[-1] in self.jitted_fns:
+            dotted = self._dotted(node)
+            nonstatic = dotted is not None and (
+                dotted == "os.environ"
+                or dotted in {f"{m}.{a}" for m, a in _NONSTATIC_CALLS}
+                # exact match on the submodule node so np.random.uniform
+                # fires once (on the inner np.random attribute)
+                or dotted in ("np.random", "numpy.random")
+                or (isinstance(node.value, ast.Name)
+                    and node.value.id == "random" and node.attr != "seed"))
+            if nonstatic:
+                self.emit("nonstatic-trace", node,
+                          f"{dotted} read inside a jitted function "
+                          "bakes into the compiled program at trace "
+                          "time")
+        self.generic_visit(node)
+
+    # -- unlocked-mutation -------------------------------------------------
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _mutations(self, body: Iterable[ast.AST]):
+        """Yield (attr, node) for every mutation of a self attribute in
+        the statement list (assign/augassign/subscript/del/mutating
+        method call)."""
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        a = self._self_attr(t)
+                        if a is not None:
+                            yield a, node
+                        if isinstance(t, ast.Subscript):
+                            a = self._self_attr(t.value)
+                            if a is not None:
+                                yield a, node
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript):
+                            a = self._self_attr(t.value)
+                            if a is not None:
+                                yield a, node
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATING_METHODS:
+                    a = self._self_attr(node.func.value)
+                    if a is not None:
+                        yield a, node
+
+    def _lint_lock_discipline(self, cls: ast.ClassDef) -> None:
+        methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)]
+        lock_attrs: set = set()
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    _b, a = _call_name(node.value.func)
+                    if a in ("Lock", "RLock"):
+                        for t in node.targets:
+                            la = self._self_attr(t)
+                            if la is not None:
+                                lock_attrs.add(la)
+        if not lock_attrs:
+            return
+
+        def with_lock_bodies(m: ast.FunctionDef):
+            for node in ast.walk(m):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        ctx = item.context_expr
+                        a = self._self_attr(ctx)
+                        if a is None and isinstance(ctx, ast.Call):
+                            a = self._self_attr(ctx.func)  # lock() style
+                        if a in lock_attrs:
+                            yield node.body
+                            break
+
+        guarded: set = set()
+        locked_nodes: set = set()
+        for m in methods:
+            for body in with_lock_bodies(m):
+                for a, node in self._mutations(body):
+                    if a not in lock_attrs:
+                        guarded.add(a)
+                    locked_nodes.add(id(node))
+        if not guarded:
+            return
+        for m in methods:
+            if m.name == "__init__":   # construction precedes sharing
+                continue
+            self.scope.append(f"{cls.name}.{m.name}")
+            for a, node in self._mutations([m]):
+                if a in guarded and id(node) not in locked_nodes:
+                    self.emit("unlocked-mutation", node,
+                              f"self.{a} is mutated under "
+                              f"{'/'.join(sorted(lock_attrs))} elsewhere "
+                              "but not here; concurrent increments race")
+            self.scope.pop()
+
+
+def lint_source(src: str, path: str) -> List[Finding]:
+    """Lint one module's source. ``path`` must be repo-relative."""
+    path = path.replace(os.sep, "/")
+    hot = path.startswith(
+        tuple(f"pinot_tpu/{p}/" for p in HOT_PACKAGES)) \
+        and path not in HOST_SYNC_ALLOW
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("parse-error", path, e.lineno or 0, "<module>",
+                        f"unparseable: {e.msg}")]
+    # pre-pass: names jitted at module level (jax.jit(f), jax.jit(vmap(f)))
+    linter = _Linter(path, src, hot)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+            for arg in node.args:
+                inner = arg
+                while isinstance(inner, ast.Call) and inner.args:
+                    inner = inner.args[0]
+                if isinstance(inner, ast.Name):
+                    linter.jitted_fns.add(inner.id)
+    linter.visit(tree)
+    return linter.findings
+
+
+def lint_tree(root: str, package: str = "pinot_tpu") -> List[Finding]:
+    """Lint every .py file under <root>/<package>."""
+    findings: List[Finding] = []
+    pkg_dir = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py") or fn.endswith("_pb2.py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            with open(full, "r", encoding="utf-8") as fh:
+                findings.extend(lint_source(fh.read(), rel))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# ratchet baseline
+# ---------------------------------------------------------------------------
+
+def counts_of(findings: Sequence[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.key] = out.get(f.key, 0) + 1
+    return out
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return dict(data.get("counts", {}))
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    # parse-error can never be grandfathered: a module that stops
+    # parsing must fail the gate even right after --update-baseline
+    findings = [f for f in findings if f.rule != "parse-error"]
+    data = {
+        "comment": "jaxlint ratchet baseline — grandfathered findings "
+                   "per file::scope::rule. Regenerate with "
+                   "`python tools/check_static.py --update-baseline`; "
+                   "new findings above these counts fail check_static, "
+                   "and counts that drop must be ratcheted down here.",
+        "version": 1,
+        "counts": dict(sorted(counts_of(findings).items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+def compare_baseline(findings: Sequence[Finding], baseline: Dict[str, int]
+                     ) -> Tuple[List[Finding], List[Tuple[str, int, int]]]:
+    """-> (new_findings, stale_entries).
+
+    new_findings: findings in keys whose count exceeds the baseline
+    (the whole key's findings are reported so the offender is visible).
+    stale_entries: (key, baseline_count, actual_count) where the actual
+    count dropped below the baseline — ratchet the baseline down.
+    """
+    actual = counts_of(findings)
+    new: List[Finding] = []
+    for key, n in sorted(actual.items()):
+        allowed = baseline.get(key, 0)
+        if n > allowed:
+            new.extend(sorted((f for f in findings if f.key == key),
+                              key=lambda f: f.line))
+    stale = [(key, allowed, actual.get(key, 0))
+             for key, allowed in sorted(baseline.items())
+             if actual.get(key, 0) < allowed]
+    return new, stale

@@ -60,12 +60,13 @@ class SegmentCompletionManager:
         self._fsm: Dict[Tuple[str, str], Dict[str, Any]] = {}
 
     def _purge_locked(self) -> None:
+        # _locked suffix contract: every caller already holds self._lock
         now = time.monotonic()
         dead = [k for k, e in self._fsm.items()
                 if e["state"] == "COMMITTED" and e.get("commit_ts")
                 and now - e["commit_ts"] > self.committed_ttl_s]
         for k in dead:
-            del self._fsm[k]
+            del self._fsm[k]  # jaxlint: ok unlocked-mutation
 
     def drop_table(self, table: str) -> None:
         with self._lock:
@@ -73,12 +74,14 @@ class SegmentCompletionManager:
                 del self._fsm[k]
 
     def _entry(self, table: str, segment: str) -> Dict[str, Any]:
+        # called only from the FSM transitions, which hold self._lock
         key = (table, segment)
         if key not in self._fsm:
-            self._fsm[key] = {"state": "HOLDING", "offsets": {},
-                              "first_ts": time.monotonic(),
-                              "winner": None, "target": None,
-                              "download_uri": None, "commit_ts": None}
+            self._fsm[key] = {  # jaxlint: ok unlocked-mutation
+                "state": "HOLDING", "offsets": {},
+                "first_ts": time.monotonic(),
+                "winner": None, "target": None,
+                "download_uri": None, "commit_ts": None}
         return self._fsm[key]
 
     def segment_consumed(self, table: str, segment: str, server: str,

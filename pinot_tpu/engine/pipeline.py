@@ -102,13 +102,14 @@ def execute_kernel_plans_pipelined(plans: List[CompiledPlan],
         if k >= 1:
             # bound in-flight work to the double buffer: resolve the
             # previous segment's output before enqueueing more
+            # double-buffer resolution point — host-sync [jaxlint baseline]
             outs[k - 1] = jax.device_get(outs[k - 1])
-    outs[-1] = jax.device_get(outs[-1])
+    outs[-1] = jax.device_get(outs[-1])  # jaxlint: ok host-sync
     dense_fn = None
     for k, (plan, out) in enumerate(zip(group, outs)):
-        out = {name: np.asarray(v) for name, v in out.items()}
-        global_accountant.track_memory(
-            sum(v.nbytes for v in out.values()))
+        out = {name: np.asarray(v)  # jaxlint: ok host-sync — host already
+               for name, v in out.items()}
+        global_accountant.track_result(out)
         if int(out.pop("group_overflow", 0)):
             # rerun this segment dense (no transfer compaction) WITHOUT
             # run_kernel: that path populates the persistent device cache,
@@ -120,12 +121,11 @@ def execute_kernel_plans_pipelined(plans: List[CompiledPlan],
             seg = plan.segment
             cols = tuple(jax.device_put(seg.host_col_padded(c, bucket))
                          for c in plan.col_names)
-            dense = jax.device_get(dense_fn(
+            dense = jax.device_get(dense_fn(  # jaxlint: ok host-sync
                 cols, jnp.int32(seg.n_docs), resolved_params[idxs[k]]))
             del cols
             dense.pop("group_overflow", None)
-            global_accountant.track_memory(
-                sum(np.asarray(v).nbytes for v in dense.values()))
+            global_accountant.track_result(dense)
             results.append(extract_partial(plan, dense))
         else:
             results.append(extract_partial(plan, out))

@@ -88,6 +88,20 @@ def int_acc_dtype() -> jnp.dtype:
     return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
 
 
+def sum_carrier_dtype(bits: int):
+    """Narrowest EXACT carrier for a compact-path integral sum payload
+    whose magnitude the planner bounded at ``bits`` (_payload_columns
+    narrows through this; analysis/plan_verify.py checks against it, so
+    the narrowing rule cannot fork). Values under 2^31 ride int32 — half
+    the compaction bytes, no 64-bit split. Returns None when no exact
+    integer carrier of the claimed width exists (jax_enable_x64 off and
+    bits >= 32): narrowing would silently truncate, so callers must fail
+    loudly instead (PV104)."""
+    if bits < 32:
+        return jnp.int32
+    return jnp.int64 if jax.config.jax_enable_x64 else None
+
+
 def _limb_base_bits(bucket: int) -> int:
     """Largest b <= 7 with (2^b - 1) * bucket <= int32max: per-group int8
     dot products then can't overflow the MXU's int32 accumulator."""
@@ -905,7 +919,9 @@ def _to_orderable(v: jax.Array, integral: bool, platform: str = None):
 # sort/matmul cost is trivial and the extra lax.switch branches only cost
 # compile time (the CPU test suite lives here). Env override for tests.
 def _ladder_min_elems() -> int:
-    return int(os.environ.get("PINOT_COMPACT_LADDER_MIN", 1 << 22))
+    # host env read resolved at jit-cache-key time, never under trace
+    return int(os.environ.get("PINOT_COMPACT_LADDER_MIN",  # jaxlint: ok host-sync
+                              1 << 22))
 
 
 def _two_pass_mode() -> str:
@@ -989,7 +1005,14 @@ def _payload_columns(plan: KernelPlan, mask, cols, params,
                     # the planner's interval arithmetic bounds |v| by
                     # spec.bits: values under 2^31 ride int32 through the
                     # compaction (half the bytes, no 64-bit split)
-                    dt = jnp.int32 if spec.bits < 32 else int_acc_dtype()
+                    dt = sum_carrier_dtype(spec.bits)
+                    if dt is None:
+                        # pre-fix this truncated silently through
+                        # int_acc_dtype(); exactness is unprovable here
+                        raise ValueError(
+                            f"no exact {spec.bits}-bit sum carrier with "
+                            "jax_enable_x64 off; plan the host path or "
+                            "demote the aggregation to float")
                     v = jnp.where(mask, v, 0).astype(dt)
                 else:
                     v = _eval_value(spec.value, cols, params).astype(acc_f)

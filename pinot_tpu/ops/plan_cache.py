@@ -148,7 +148,10 @@ class PlanCacheEntry:
         self._acc: Any = None
         self.lock = threading.Lock()
         self.runs = 0
-        # measured selectivity feedback: what the kernel actually matched
+        # measured selectivity feedback: what the kernel actually matched.
+        # Mutated through record_measured/mark_overflowed ONLY — the
+        # entry lock guards them, and analysis/jaxlint's
+        # unlocked-mutation rule holds every other mutation site to that.
         self.last_matched: Optional[int] = None
         self.last_rows: Optional[int] = None
         # set once this entry's capacity has overflowed: the executor
@@ -184,7 +187,8 @@ class PlanCacheEntry:
                 out = self.fn(cols, n_docs, params)
                 device_fence(out)
             with span("device_transfer"):
-                return jax.device_get(out)
+                # THE transfer fence for undonated entries
+                return jax.device_get(out)  # jaxlint: ok host-sync
         with self.lock:
             self.runs += 1
             first = self.runs == 1
@@ -194,13 +198,23 @@ class PlanCacheEntry:
                 out = self.fn(cols, n_docs, params, self._acc)
                 device_fence(out)
             with span("device_transfer"):
-                host = jax.device_get(out)
+                # THE transfer fence for donated entries (must complete
+                # inside the lock, before the buffers are re-donated)
+                host = jax.device_get(out)  # jaxlint: ok host-sync
             self._acc = out      # next call donates these buffers
             return host
 
     def record_measured(self, matched: int, rows: int) -> None:
-        self.last_matched = int(matched)
-        self.last_rows = int(rows)
+        with self.lock:
+            self.last_matched = int(matched)
+            self.last_rows = int(rows)
+
+    def mark_overflowed(self) -> None:
+        """Capacity overflow observed (engine/executor.py retry ladder);
+        taken under the entry lock so concurrent same-plan queries can't
+        lose the flag."""
+        with self.lock:
+            self.overflowed = True
 
     @property
     def measured_selectivity(self) -> Optional[float]:
@@ -249,6 +263,14 @@ class KernelPlanCache:
             return ent
         span_tracer.annotate(cache="miss")
         self.detector.observe_compile(plan)
+        if __debug__:
+            # debug assertion (analysis/plan_verify): every structure
+            # entering the cache must honor the hashable-frozen key
+            # contract and the strategy gates — a violation here means a
+            # caller synthesized a plan behind the planner's back.
+            # Stripped under python -O; PINOT_PLAN_VERIFY=0 disables.
+            from ..analysis.plan_verify import debug_check_cache_plan
+            debug_check_cache_plan(plan, bucket)
         with span("build_kernel", bucket=bucket, slots_cap=slots_cap):
             base = build_kernel(plan, bucket, slots_cap, platform,
                                 xfer_compact, scatter=scatter,

@@ -1208,6 +1208,16 @@ class SegmentPlanner:
         from ..utils.spans import span
         with span("plan_segment", segment=self.seg.name) as sp:
             plan = self._plan()
+            if plan.kind in ("kernel", "kselect"):
+                # fail-fast static verification (analysis/plan_verify):
+                # a plan violating a kernel invariant must die HERE with
+                # a rule id, not corrupt results or retrace downstream.
+                # Deliberately outside the PlanError host-fallback nets —
+                # a broken plan is a bug, not a host-path candidate.
+                # PINOT_PLAN_VERIFY=0 disables (tools/check_static.py
+                # collects diagnostics instead of raising).
+                from ..analysis.plan_verify import check_compiled_plan
+                check_compiled_plan(plan)
             if sp is not None:
                 sp.annotate(kind=plan.kind)
                 if plan.kind == "kernel":

@@ -410,9 +410,10 @@ class FakeKinesisServer:
                          "message": str(e)}
 
     def _mint(self, stream: str, idx: int, pos: int) -> str:
-        self.next_iter += 1
+        # called only from the API dispatch, which already holds _lock
+        self.next_iter += 1  # jaxlint: ok unlocked-mutation
         it = f"it-{self.next_iter}"
-        self.iterators[it] = (stream, idx, pos)
+        self.iterators[it] = (stream, idx, pos)  # jaxlint: ok unlocked-mutation
         return it
 
     # -- test hooks -------------------------------------------------------

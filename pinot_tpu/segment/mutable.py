@@ -116,8 +116,13 @@ class MutableSegment:
             return i
 
     def invalidate_doc(self, doc_id: int) -> None:
-        """Upsert: an earlier row for this PK was superseded."""
-        self._valid[doc_id] = False
+        """Upsert: an earlier row for this PK was superseded. Takes the
+        segment lock: a concurrent index_row may be swapping _valid for
+        the doubled array, and an unlocked store to the old buffer would
+        silently resurrect the superseded row (found by analysis/jaxlint
+        unlocked-mutation)."""
+        with self._lock:
+            self._valid[doc_id] = False
 
     def get_row(self, doc_id: int) -> Dict[str, Any]:
         """One indexed row in value space (None for nulls) — the

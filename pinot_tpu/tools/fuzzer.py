@@ -77,6 +77,35 @@ def make_data(n: int, seed: int = 7) -> Dict[str, Any]:
     }
 
 
+def fuzz_schema():
+    """The Schema matching COLUMNS/make_data — the ONE definition the
+    fuzz test fixtures (tests/test_fuzz.py, tests/test_static_analysis
+    .py) and the tools/check_static.py plan-corpus gate all build from,
+    so their coverage cannot silently diverge."""
+    from ..spi import DataType, FieldSpec, FieldType, Schema
+    return Schema("fz", [
+        FieldSpec("ci", DataType.INT),
+        FieldSpec("chi", DataType.INT),
+        FieldSpec("cs", DataType.STRING),
+        FieldSpec("m1", DataType.LONG, FieldType.METRIC),
+        FieldSpec("m2", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("nm", DataType.LONG, FieldType.METRIC),
+        FieldSpec("ns", DataType.STRING),
+        FieldSpec("mv", DataType.INT, single_value=False),
+    ])
+
+
+def build_fuzz_segment(n: int, out_dir: str, name: str = "fz0",
+                       seed: int = 7):
+    """Build + load a one-segment 'fz' fixture over make_data(n)."""
+    from ..segment import SegmentBuilder
+    from ..segment.immutable import ImmutableSegment
+    from ..spi import TableConfig
+    d = SegmentBuilder(fuzz_schema(), TableConfig("fz")).build(
+        make_data(n, seed), out_dir, name)
+    return ImmutableSegment.load(d)
+
+
 @dataclass
 class Pred:
     col: str

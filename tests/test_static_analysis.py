@@ -1,0 +1,533 @@
+"""Static analysis gate (pinot_tpu/analysis + tools/check_static.py).
+
+Three surfaces, mirroring the tier-1 contract:
+
+- the plan-IR verifier runs CLEAN over every plan the planner produces
+  for the full SSB + taxi + fuzzer query corpus (zero diagnostics);
+- each verifier rule id demonstrably FIRES on a targeted negative plan
+  (out-of-range col index, unhashable node, overflowing SUM carrier,
+  misaligned slots_cap, sketch-on-compact, ...);
+- the JAX hazard linter's repo findings exactly match the checked-in
+  ratchet baseline (tools/jaxlint_baseline.json) — new findings or
+  stale counts fail loudly, and the check_static CLI exits non-zero.
+"""
+import dataclasses
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench  # noqa: E402
+import bench_taxi  # noqa: E402
+
+from pinot_tpu.analysis import jaxlint  # noqa: E402
+from pinot_tpu.analysis.plan_verify import (  # noqa: E402
+    PlanVerificationError, verify_compiled_plan, verify_kernel_plan,
+    verify_select_plan)
+from pinot_tpu.ops.ir import (AggSpec, Col, EqId, InSet,  # noqa: E402
+                              KernelPlan, Lit, SelectPlan, TrueP)
+from pinot_tpu.query.context import build_query_context  # noqa: E402
+from pinot_tpu.query.planner import SegmentPlanner  # noqa: E402
+from pinot_tpu.query.sql import parse_sql  # noqa: E402
+
+
+def _rules(diags):
+    return {d.rule for d in diags}
+
+
+def _plan(seg, sql):
+    return SegmentPlanner(build_query_context(parse_sql(sql)), seg).plan()
+
+
+# ---------------------------------------------------------------------------
+# corpus regression: plan -> verify with zero diagnostics
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ssb_segment(tmp_path_factory):
+    return bench.build_segment(1 << 12,
+                               str(tmp_path_factory.mktemp("sa_ssb")))
+
+
+@pytest.fixture(scope="module")
+def taxi_segment(tmp_path_factory):
+    return bench_taxi.build_segment(1 << 12,
+                                    str(tmp_path_factory.mktemp("sa_taxi")))
+
+
+@pytest.mark.parametrize("qid,preds,vexpr,gcols", bench.QUERIES,
+                         ids=[q[0] for q in bench.QUERIES])
+def test_ssb_plans_verify_clean(ssb_segment, qid, preds, vexpr, gcols):
+    sql = bench.spec_to_sql(preds, vexpr, gcols) + bench.OPTION
+    plan = _plan(ssb_segment, sql)   # plan() itself fail-fasts too
+    assert verify_compiled_plan(plan) == []
+
+
+@pytest.mark.parametrize("qid,key,where", bench_taxi.QUERIES,
+                         ids=[q[0] for q in bench_taxi.QUERIES])
+def test_taxi_plans_verify_clean(taxi_segment, qid, key, where):
+    sql = bench_taxi._sql(key, where) + bench_taxi.OPTION
+    plan = _plan(taxi_segment, sql)
+    assert verify_compiled_plan(plan) == []
+
+
+def test_fuzzer_plans_verify_clean(tmp_path):
+    from pinot_tpu.tools.fuzzer import (QueryGenerator,
+                                        build_fuzz_segment, render_sql)
+    seg = build_fuzz_segment(1500, str(tmp_path))
+    gen = QueryGenerator(4242, with_exists=False)
+    kernels = 0
+    for _ in range(80):
+        sql = render_sql(gen.generate())
+        plan = _plan(seg, sql)
+        assert verify_compiled_plan(plan) == [], sql
+        kernels += plan.kind in ("kernel", "kselect")
+    assert kernels > 10   # the corpus must actually exercise the verifier
+
+
+# ---------------------------------------------------------------------------
+# negative tests: each rule id fires on a targeted bad plan
+# ---------------------------------------------------------------------------
+
+def test_pv101_col_index_out_of_range():
+    p = KernelPlan(pred=EqId(col=5, param=0),
+                   aggs=(AggSpec("count", None, True),))
+    diags = verify_kernel_plan(p, n_cols=2, n_params=1)
+    assert "PV101" in _rules(diags)
+
+
+def test_pv102_param_index_out_of_range():
+    p = KernelPlan(pred=TrueP(),
+                   aggs=(AggSpec("sum", Lit(7), True),))
+    diags = verify_kernel_plan(p, n_cols=1, n_params=1)
+    assert "PV102" in _rules(diags)
+
+
+def test_pv103_unhashable_plan_node():
+    # a list where the frozen-tuple contract demands a tuple poisons the
+    # plan-cache key (hash() raises at runtime, on every query)
+    p = KernelPlan(pred=TrueP(), aggs=(AggSpec("count", None, True),),
+                   group_keys=[(0, 4)])
+    diags = verify_kernel_plan(p)
+    assert "PV103" in _rules(diags)
+
+
+def test_pv104_lossy_bits_claim(ssb_segment):
+    sql = ("SELECT SUM(lo_extendedprice) FROM lineorder "
+           "WHERE lo_discount BETWEEN 1 AND 3")
+    cp = _plan(ssb_segment, sql)
+    assert cp.kind == "kernel"
+    assert verify_compiled_plan(cp) == []
+    spec = cp.kernel_plan.aggs[0]
+    assert spec.kind == "sum" and spec.integral
+    # corrupt the claimed magnitude bound below what column metadata
+    # proves: the int32 carrier / limb decomposition would truncate
+    cp.kernel_plan = dataclasses.replace(
+        cp.kernel_plan, aggs=(dataclasses.replace(spec, bits=2),))
+    assert "PV104" in _rules(verify_compiled_plan(cp))
+
+
+def test_pv104_carrier_scope(monkeypatch):
+    """The carrier-existence check only covers the compact path (the
+    one that narrows through sum_carrier_dtype) and keeps the bits=63
+    unprofiled-sentinel exemption — dense plans must not hard-fail on
+    platforms without a 64-bit carrier."""
+    import pinot_tpu.ops.kernels as K
+    monkeypatch.setattr(K, "sum_carrier_dtype", lambda bits: None)
+    dense = KernelPlan(pred=TrueP(),
+                       aggs=(AggSpec("sum", Col(1), True, bits=40),),
+                       group_keys=((0, 8),), strategy="dense")
+    assert "PV104" not in _rules(verify_kernel_plan(dense, n_cols=2,
+                                                    n_params=0))
+    compact = dataclasses.replace(dense, strategy="compact")
+    assert "PV104" in _rules(verify_kernel_plan(compact, n_cols=2,
+                                                n_params=0))
+    # the bits=63 sentinel fires too: _payload_columns refuses to build
+    # a carrier-less compact sum (ValueError), so the verifier must
+    # catch the identical set at plan time
+    sentinel = dataclasses.replace(
+        compact, aggs=(AggSpec("sum", Col(1), True, bits=63),))
+    assert "PV104" in _rules(verify_kernel_plan(sentinel, n_cols=2,
+                                                n_params=0))
+
+
+def test_pv105_sum_accumulator_overflow():
+    # a PROVEN 45-bit value summed over 2^20 rows needs 65 bits > int64
+    p = KernelPlan(pred=TrueP(),
+                   aggs=(AggSpec("sum", Col(0), True, bits=45),))
+    diags = verify_kernel_plan(p, n_cols=1, n_params=0, n_docs=1 << 20)
+    assert "PV105" in _rules(diags)
+    # advisory severity: the overflow wraps in lockstep with the numpy
+    # oracle, so PV105 warns (check_static reports it) but must never
+    # kill a query through the planner fail-fast
+    assert all(d.severity == "warn" for d in diags if d.rule == "PV105")
+    # the unprofiled sentinel (bits=63) wraps like the numpy oracle and
+    # is exempt by design
+    p63 = KernelPlan(pred=TrueP(),
+                     aggs=(AggSpec("sum", Col(0), True, bits=63),))
+    assert "PV105" not in _rules(
+        verify_kernel_plan(p63, n_cols=1, n_params=0, n_docs=1 << 20))
+
+
+def _compact_plan():
+    return KernelPlan(pred=TrueP(),
+                      aggs=(AggSpec("sum", Col(1), True, bits=20),),
+                      group_keys=((0, 64),), strategy="compact")
+
+
+def test_pv106_misaligned_slots_cap():
+    p = _compact_plan()
+    ok = verify_kernel_plan(p, n_cols=2, n_params=0, bucket=1 << 16,
+                            n_docs=1 << 16, slots_cap=64)
+    assert "PV106" not in _rules(ok)
+    # 384 is neither a power of two, the Pallas staging floor, nor
+    # full_slots_cap: off the quantization ladder -> retrace hazard
+    diags = verify_kernel_plan(p, n_cols=2, n_params=0, bucket=1 << 16,
+                               n_docs=1 << 16, slots_cap=384)
+    assert "PV106" in _rules(diags)
+    # capacity past the can't-overflow bound is pure waste
+    diags = verify_kernel_plan(p, n_cols=2, n_params=0, bucket=1 << 16,
+                               n_docs=1 << 16, slots_cap=1 << 20)
+    assert "PV106" in _rules(diags)
+    # slots_cap on the dense strategy is meaningless
+    dense = dataclasses.replace(p, strategy="dense")
+    diags = verify_kernel_plan(dense, n_cols=2, n_params=0,
+                               bucket=1 << 16, slots_cap=64)
+    assert "PV106" in _rules(diags)
+
+
+def test_pv106_cost_model_consistency():
+    from pinot_tpu.multistage.costs import compact_slots_cap
+    from pinot_tpu.ops.kernels import cpu_scatter_default
+    import jax
+    plat = jax.default_backend()
+    p = _compact_plan()
+    good = compact_slots_cap(1 << 16, 0.05, plat, cpu_scatter_default(plat))
+    assert "PV106" not in _rules(verify_kernel_plan(
+        p, n_cols=2, n_params=0, bucket=1 << 16, n_docs=1 << 16,
+        slots_cap=good, est_selectivity=0.05))
+    # a capacity the cost model would never emit for this estimate
+    bad = good * 4
+    diags = verify_kernel_plan(
+        p, n_cols=2, n_params=0, bucket=1 << 16, n_docs=1 << 16,
+        slots_cap=bad, est_selectivity=0.05)
+    assert "PV106" in _rules(diags)
+
+
+def test_pv107_sketch_never_reaches_compact():
+    p = KernelPlan(
+        pred=TrueP(),
+        aggs=(AggSpec("distinct_count_hll", Col(1), False, card=11),),
+        group_keys=((0, 64),), strategy="compact")
+    diags = verify_kernel_plan(p, n_cols=2, n_params=0)
+    assert "PV107" in _rules(diags)
+
+
+def test_pv107_dense_space_cap():
+    from pinot_tpu.query.planner import MAX_DENSE_GROUPS
+    p = KernelPlan(pred=TrueP(), aggs=(AggSpec("count", None, True),),
+                   group_keys=((0, MAX_DENSE_GROUPS + 1),),
+                   strategy="dense")
+    assert "PV107" in _rules(verify_kernel_plan(p, n_cols=1, n_params=0))
+
+
+def test_pv108_bad_agg_spec():
+    p = KernelPlan(pred=TrueP(),
+                   aggs=(AggSpec("median", Col(0), False),))
+    assert "PV108" in _rules(verify_kernel_plan(p, n_cols=1, n_params=0))
+    p = KernelPlan(pred=TrueP(),
+                   aggs=(AggSpec("distinct_count_hll", Col(0), False,
+                                 card=27),))
+    assert "PV108" in _rules(verify_kernel_plan(p, n_cols=1, n_params=0))
+
+
+def test_pv109_inset_not_pow2():
+    p = KernelPlan(pred=InSet(col=0, param=0, n=3),
+                   aggs=(AggSpec("count", None, True),))
+    assert "PV109" in _rules(verify_kernel_plan(p, n_cols=1, n_params=1))
+
+
+def test_pv110_zero_cardinality_key():
+    p = KernelPlan(pred=TrueP(), aggs=(AggSpec("count", None, True),),
+                   group_keys=((0, 0),))
+    assert "PV110" in _rules(verify_kernel_plan(p, n_cols=1, n_params=0))
+
+
+def test_pv111_inset_param_unsorted():
+    p = KernelPlan(pred=InSet(col=0, param=0, n=4),
+                   aggs=(AggSpec("count", None, True),))
+    diags = verify_kernel_plan(
+        p, n_cols=1, n_params=1,
+        params=[np.asarray([4, 1, 3, 9], dtype=np.int32)])
+    assert "PV111" in _rules(diags)
+
+
+def test_pv112_select_plan():
+    sp = SelectPlan(pred=TrueP(), select_cols=(0,), order=(), k=0)
+    assert "PV112" in _rules(verify_select_plan(sp, n_cols=1, n_params=0))
+    sp = SelectPlan(pred=TrueP(), select_cols=(0,),
+                    order=((0, False, 1 << 40), (1, False, 1 << 40)),
+                    k=10)
+    assert "PV112" in _rules(
+        verify_select_plan(sp, n_cols=2, n_params=0, bucket=1 << 14))
+
+
+# ---------------------------------------------------------------------------
+# wiring: planner fail-fast + plan-cache debug assertion
+# ---------------------------------------------------------------------------
+
+def test_planner_fail_fast(ssb_segment, monkeypatch):
+    sql = "SELECT COUNT(*) FROM lineorder WHERE lo_discount = 1"
+    ctx = build_query_context(parse_sql(sql))
+    planner = SegmentPlanner(ctx, ssb_segment)
+    good = planner._plan()
+    assert good.kind == "kernel"
+    bad = dataclasses.replace(
+        good.kernel_plan,
+        pred=EqId(col=99, param=0))      # out-of-bounds column
+    monkeypatch.setattr(SegmentPlanner, "_plan",
+                        lambda self: good)
+    good.kernel_plan = bad
+    with pytest.raises(PlanVerificationError) as ei:
+        SegmentPlanner(ctx, ssb_segment).plan()
+    assert "PV101" in str(ei.value)
+    # kill switch: PINOT_PLAN_VERIFY=0 must disable the gate
+    monkeypatch.setenv("PINOT_PLAN_VERIFY", "0")
+    assert SegmentPlanner(ctx, ssb_segment).plan() is good
+
+
+def test_warn_severity_never_fails_fast(monkeypatch):
+    from pinot_tpu.analysis import plan_verify as PV
+    monkeypatch.setattr(
+        PV, "verify_compiled_plan",
+        lambda cp: [PV.Diagnostic("PV105", "aggs[0]", "advisory",
+                                  severity="warn")])
+    PV.check_compiled_plan(object())   # warn-only: must not raise
+    monkeypatch.setattr(
+        PV, "verify_compiled_plan",
+        lambda cp: [PV.Diagnostic("PV101", "pred", "broken")])
+    with pytest.raises(PlanVerificationError):
+        PV.check_compiled_plan(object())
+
+
+def test_ir_range_mirrors_planner_range(ssb_segment, tmp_path):
+    """Drift tripwire (PV104b): the verifier's IR interval arithmetic
+    must derive exactly the bits/sign the planner claimed from the SQL
+    AST over real segment metadata — if planner._range_of ever tightens
+    without _ir_range following, PV104 would start killing valid
+    plans. Covers Col, Lit, Bin(+/-/*), and the MvReduce modes."""
+    from pinot_tpu.analysis import plan_verify as PV
+    from pinot_tpu.tools.fuzzer import build_fuzz_segment
+    fz = build_fuzz_segment(800, str(tmp_path))
+    cases = [
+        (ssb_segment, "SELECT SUM(lo_extendedprice) FROM lineorder"),
+        (ssb_segment,
+         "SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder"),
+        (ssb_segment,
+         "SELECT SUM(lo_extendedprice - lo_quantity) FROM lineorder"),
+        (ssb_segment, "SELECT SUM(lo_quantity + 7) FROM lineorder"),
+        (fz, "SELECT SUMMV(mv) FROM fz"),
+        (fz, "SELECT COUNTMV(mv) FROM fz"),
+        (fz, "SELECT AVG(m1) FROM fz WHERE ci = 3"),
+    ]
+    checked = 0
+    for seg, sql in cases:
+        cp = _plan(seg, sql)
+        assert cp.kind == "kernel", sql
+        for spec in cp.kernel_plan.aggs:
+            if spec.kind not in ("sum", "avg") or not spec.integral:
+                continue
+            ctx = PV._Ctx(len(cp.col_names), len(cp.params), cp.params,
+                          cp.col_names, cp.segment)
+            rng = PV._ir_range(spec.value, ctx)
+            bits, signed = SegmentPlanner._bits_for(rng)
+            assert (bits, signed) == (spec.bits, spec.signed), sql
+            checked += 1
+    assert checked >= 6
+
+
+def test_plan_cache_debug_assertion():
+    from pinot_tpu.ops.plan_cache import KernelPlanCache
+    cache = KernelPlanCache(maxsize=4)
+    bad = KernelPlan(
+        pred=TrueP(),
+        aggs=(AggSpec("distinct_count_hll", Col(0), False, card=11),),
+        group_keys=((0, 8),), strategy="compact")
+    with pytest.raises(AssertionError) as ei:
+        cache.entry(bad, bucket=1 << 10)
+    assert "PV107" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# linter rules (synthetic sources) + repo baseline pin
+# ---------------------------------------------------------------------------
+
+HOT = "pinot_tpu/engine/somehot.py"
+
+
+def _keys(findings):
+    return {(f.rule, f.line) for f in findings}
+
+
+def test_lint_host_sync_rule():
+    src = ("import numpy as np\n"
+           "def f(dev):\n"
+           "    a = dev.item()\n"
+           "    b = np.asarray(dev)\n"
+           "    c = int(dev['x'])\n"
+           "    d = int(n_docs)\n")
+    fs = jaxlint.lint_source(src, HOT)
+    assert {f.line for f in fs if f.rule == "host-sync"} == {3, 4, 5}
+    # cold paths (broker, cluster, ...) are out of rule scope
+    assert jaxlint.lint_source(src, "pinot_tpu/broker/x.py") == []
+    # allowlisted host modules too
+    assert jaxlint.lint_source(src, jaxlint.HOST_SYNC_ALLOW[0]) == []
+
+
+def test_lint_suppression_comment():
+    src = ("import numpy as np\n"
+           "def f(host):\n"
+           "    return np.asarray(host)  # jaxlint: ok host-sync\n")
+    assert jaxlint.lint_source(src, HOT) == []
+
+
+def test_lint_jit_in_loop():
+    src = ("import jax\n"
+           "def g(fns, x):\n"
+           "    for fn in fns:\n"
+           "        y = jax.jit(fn)(x)\n"
+           "    return jax.jit(fns[0])\n")
+    fs = jaxlint.lint_source(src, "pinot_tpu/broker/b.py")
+    assert [(f.rule, f.line) for f in fs] == [("jit-in-loop", 4)]
+
+
+def test_lint_nonstatic_trace():
+    src = ("import jax, os\n"
+           "@jax.jit\n"
+           "def k(x):\n"
+           "    flag = os.environ.get('KNOB')\n"
+           "    return x\n"
+           "def host():\n"
+           "    return os.environ.get('KNOB')\n")
+    fs = jaxlint.lint_source(src, "pinot_tpu/broker/b.py")
+    assert [(f.rule, f.line) for f in fs] == [("nonstatic-trace", 4)]
+    # np.random.* under trace fires exactly once (on the submodule node)
+    src = ("import jax\nimport numpy as np\n"
+           "@jax.jit\n"
+           "def k(x):\n"
+           "    return x + np.random.uniform()\n")
+    fs = jaxlint.lint_source(src, "pinot_tpu/broker/b.py")
+    assert [(f.rule, f.line) for f in fs] == [("nonstatic-trace", 5)]
+
+
+def test_lint_parse_error_never_baselined(tmp_path):
+    fs = jaxlint.lint_source("def broken(:\n", "pinot_tpu/broker/b.py")
+    assert [f.rule for f in fs] == ["parse-error"]
+    # --update-baseline must NOT grandfather it: the gate stays red
+    path = str(tmp_path / "base.json")
+    jaxlint.write_baseline(fs, path)
+    new, _stale = jaxlint.compare_baseline(fs, jaxlint.load_baseline(path))
+    assert [f.rule for f in new] == ["parse-error"]
+
+
+def test_lint_unlocked_mutation():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.hits = 0\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self.hits += 1\n"
+           "    def b(self):\n"
+           "        self.hits += 1\n")
+    fs = jaxlint.lint_source(src, "pinot_tpu/broker/b.py")
+    assert [(f.rule, f.line, f.scope) for f in fs] == \
+        [("unlocked-mutation", 10, "C.b")]
+
+
+def test_lint_clean_on_shared_registries():
+    """Satellite: the unlocked-mutation rule passes on the metrics and
+    plan-cache counters (every mutation is under its lock)."""
+    for mod in ("pinot_tpu/utils/metrics.py", "pinot_tpu/ops/plan_cache.py"):
+        with open(os.path.join(REPO, mod)) as fh:
+            src = fh.read()
+        bad = [f for f in jaxlint.lint_source(src, mod)
+               if f.rule == "unlocked-mutation"]
+        assert bad == [], bad
+
+
+def test_baseline_pinned():
+    """Repo findings must exactly match the checked-in ratchet baseline:
+    new findings fail (fix or consciously re-baseline), and counts that
+    drop fail too (ratchet the baseline down so wins stick)."""
+    findings = jaxlint.lint_tree(REPO)
+    baseline = jaxlint.load_baseline(
+        os.path.join(REPO, "tools", "jaxlint_baseline.json"))
+    new, stale = jaxlint.compare_baseline(findings, baseline)
+    assert new == [], "\n".join(str(f) for f in new)
+    assert stale == [], stale
+
+
+def test_baseline_compare_semantics():
+    fs = jaxlint.lint_source(
+        "import numpy as np\ndef f(d):\n    return np.asarray(d)\n", HOT)
+    assert len(fs) == 1
+    key = fs[0].key
+    new, stale = jaxlint.compare_baseline(fs, {})
+    assert [f.key for f in new] == [key] and stale == []
+    new, stale = jaxlint.compare_baseline(fs, {key: 1})
+    assert new == [] and stale == []
+    new, stale = jaxlint.compare_baseline([], {key: 1})
+    assert new == [] and stale == [(key, 1, 0)]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 CLI gate
+# ---------------------------------------------------------------------------
+
+def test_check_static_cli_runs_clean(capsys):
+    import check_static
+    assert check_static.main(["--fuzz", "40"]) == 0
+    out = capsys.readouterr().out
+    # the zero-diagnostic verdict must not be vacuous: every SSB+taxi
+    # query planned onto the device path and was verified
+    import json
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["verify"]["coverage_failures"] == 0
+    assert summary["verify"]["device_plans"] >= \
+        len(bench.QUERIES) + len(bench_taxi.QUERIES)
+
+
+def test_check_static_update_baseline_keeps_parse_errors_red(
+        monkeypatch, tmp_path, capsys):
+    import check_static
+    broken = jaxlint.lint_source("def broken(:\n", "pinot_tpu/x.py")
+    monkeypatch.setattr(check_static, "BASELINE",
+                        str(tmp_path / "base.json"))
+    monkeypatch.setattr(jaxlint, "lint_tree", lambda root: broken)
+    # the re-ratchet run itself must stay red on an unparseable module
+    assert check_static.main(["--lint-only", "--update-baseline"]) == 1
+    assert "parse-error" in capsys.readouterr().out
+
+
+def test_check_static_env_restored(monkeypatch):
+    import check_static
+    monkeypatch.setenv("PINOT_PLAN_VERIFY", "0")
+    check_static.run_verify(fuzz_n=3)
+    assert os.environ.get("PINOT_PLAN_VERIFY") == "0"
+
+
+def test_check_static_cli_fails_on_drift(monkeypatch, tmp_path, capsys):
+    import check_static
+    # an empty baseline turns every grandfathered finding into a NEW one
+    empty = tmp_path / "baseline.json"
+    empty.write_text('{"version": 1, "counts": {}}')
+    monkeypatch.setattr(check_static, "BASELINE", str(empty))
+    assert check_static.main(["--lint-only"]) == 1
+    assert "NEW" in capsys.readouterr().out

@@ -1,0 +1,209 @@
+"""Static analysis gate: JAX hazard linter + plan-IR verifier.
+
+Runs both passes of pinot_tpu/analysis and exits non-zero on anything
+new (tier-1 runs this through tests/test_static_analysis.py, alongside
+tools/check_ledger.py):
+
+1. **Linter** (analysis/jaxlint.py) over the whole pinot_tpu tree.
+   Findings are ratcheted against tools/jaxlint_baseline.json: new
+   findings above a ``file::scope::rule`` count fail; counts that DROP
+   also fail until the baseline is ratcheted down (run with
+   ``--update-baseline`` after fixing sites).
+2. **Verifier** (analysis/plan_verify.py) over every plan the planner
+   produces for the full SSB query set (bench.QUERIES), the NYC-taxi
+   set (bench_taxi.QUERIES), and ``--fuzz N`` seeded fuzzer-generated
+   queries (pinot_tpu/tools/fuzzer.py) — all at CI scale, plan-only
+   (no kernels execute). Any diagnostic fails.
+
+    python tools/check_static.py [--lint-only|--verify-only]
+                                 [--update-baseline] [--fuzz N]
+
+Prints one summary JSON line last, check_ledger-style.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# match the test environment: CPU backend before jax initializes (the
+# sitecustomize may force a TPU platform otherwise)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+BASELINE = os.path.join(REPO, "tools", "jaxlint_baseline.json")
+FUZZ_SEED = 20260804
+
+
+def run_lint(update_baseline: bool = False) -> dict:
+    from pinot_tpu.analysis import jaxlint
+
+    findings = jaxlint.lint_tree(REPO)
+    if update_baseline:
+        jaxlint.write_baseline(findings, BASELINE)
+        # re-compare against the freshly written baseline: parse-error
+        # findings are never written into it, so an unparseable module
+        # keeps the gate red even on the re-ratchet run itself
+        baseline = jaxlint.load_baseline(BASELINE)
+        new, stale = jaxlint.compare_baseline(findings, baseline)
+        for f in new:
+            print(f"NEW {f}")
+        return {"findings": len(findings), "new": len(new),
+                "stale": len(stale), "updated": True}
+    baseline = jaxlint.load_baseline(BASELINE)
+    new, stale = jaxlint.compare_baseline(findings, baseline)
+    for f in new:
+        print(f"NEW {f}")
+    for key, allowed, actual in stale:
+        print(f"STALE {key}: baseline {allowed}, found {actual} — "
+              "ratchet down with --update-baseline")
+    return {"findings": len(findings), "new": len(new),
+            "stale": len(stale)}
+
+
+def _verify_corpus(label: str, segment, sqls, counts: dict,
+                   diags: list) -> None:
+    from pinot_tpu.analysis.plan_verify import verify_compiled_plan
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.planner import PlanError, SegmentPlanner
+    from pinot_tpu.query.sql import SqlError, parse_sql
+
+    for sql in sqls:
+        counts["queries"] += 1
+        try:
+            ctx = build_query_context(parse_sql(sql))
+            plan = SegmentPlanner(ctx, segment).plan()
+        except (PlanError, SqlError) as e:
+            # multi-table / window shapes that never reach the segment
+            # planner — not this gate's surface, but printed so a
+            # planner regression demoting whole corpora is visible
+            counts["skipped"] += 1
+            print(f"SKIP [{label}] {type(e).__name__}: {e}\n"
+                  f"  query: {sql}")
+            continue
+        counts["plans"] += 1
+        if plan.kind in ("kernel", "kselect"):
+            counts["device_plans"] = counts.get("device_plans", 0) + 1
+            counts[plan.kind] = counts.get(plan.kind, 0) + 1
+        for d in verify_compiled_plan(plan):
+            diags.append((label, sql, d))
+
+
+def run_verify(fuzz_n: int) -> dict:
+    # collect diagnostics instead of letting the planner raise; restore
+    # whatever the caller had set (an embedding host may deliberately
+    # run with verification off)
+    prior = os.environ.get("PINOT_PLAN_VERIFY")
+    os.environ["PINOT_PLAN_VERIFY"] = "0"
+    try:
+        return _run_verify(fuzz_n)
+    finally:
+        if prior is None:
+            os.environ.pop("PINOT_PLAN_VERIFY", None)
+        else:
+            os.environ["PINOT_PLAN_VERIFY"] = prior
+
+
+def _run_verify(fuzz_n: int) -> dict:
+    import bench
+    import bench_taxi
+    from pinot_tpu.tools.fuzzer import (QueryGenerator,
+                                        build_fuzz_segment, render_sql)
+
+    corpora: dict = {}
+    diags: list = []
+    with tempfile.TemporaryDirectory() as tmp:
+        seg = bench.build_segment(1 << 12, os.path.join(tmp, "ssb"))
+        corpora["ssb"] = {"queries": 0, "plans": 0, "skipped": 0}
+        _verify_corpus(
+            "ssb", seg,
+            [bench.spec_to_sql(p, v, g) + bench.OPTION
+             for _q, p, v, g in bench.QUERIES],
+            corpora["ssb"], diags)
+
+        seg_t = bench_taxi.build_segment(1 << 12, os.path.join(tmp, "taxi"))
+        corpora["taxi"] = {"queries": 0, "plans": 0, "skipped": 0}
+        _verify_corpus(
+            "taxi", seg_t,
+            [bench_taxi._sql(k, w) + bench_taxi.OPTION
+             for _q, k, w in bench_taxi.QUERIES],
+            corpora["taxi"], diags)
+
+        seg_f = build_fuzz_segment(2000, tmp)
+        gen = QueryGenerator(FUZZ_SEED, with_exists=False)
+        corpora["fuzz"] = {"queries": 0, "plans": 0, "skipped": 0}
+        _verify_corpus(
+            "fuzz", seg_f,
+            [render_sql(gen.generate()) for _ in range(fuzz_n)],
+            corpora["fuzz"], diags)
+
+    warns = [(lb, s, d) for lb, s, d in diags if d.severity != "error"]
+    diags = [(lb, s, d) for lb, s, d in diags if d.severity == "error"]
+    for label, sql, d in diags:
+        print(f"DIAG [{label}] {d}\n  query: {sql}")
+    for label, sql, d in warns:
+        print(f"WARN [{label}] {d}\n  query: {sql}")
+
+    # anti-vacuous-pass floors: zero diagnostics only counts if the
+    # verifier actually saw the plans it claims to cover. Every SSB and
+    # taxi query must reach a device (kernel/kselect) plan — exactly
+    # the bar tests/test_ssb.py and test_taxi.py hold the planner to —
+    # and the fuzzer corpus must surface a healthy device-plan share.
+    coverage: list = []
+    for label in ("ssb", "taxi"):
+        c = corpora[label]
+        if c["skipped"] or c.get("device_plans", 0) != c["queries"]:
+            coverage.append(
+                f"{label}: {c.get('device_plans', 0)}/{c['queries']} "
+                f"device plans ({c['skipped']} skipped) — the corpus "
+                "regressed off the kernel path, verifier coverage lost")
+    if corpora["fuzz"]["queries"] and \
+            corpora["fuzz"].get("device_plans", 0) < max(
+                corpora["fuzz"]["queries"] // 10, 1):
+        coverage.append(
+            f"fuzz: only {corpora['fuzz'].get('device_plans', 0)} of "
+            f"{corpora['fuzz']['queries']} queries reached a device "
+            "plan — generator or planner drift gutted coverage")
+    for msg in coverage:
+        print(f"COVERAGE {msg}")
+
+    out = {"queries": 0, "plans": 0, "skipped": 0, "device_plans": 0}
+    for c in corpora.values():
+        for k, v in c.items():
+            out[k] = out.get(k, 0) + v
+    out["diagnostics"] = len(diags)
+    out["warnings"] = len(warns)
+    out["coverage_failures"] = len(coverage)
+    return out
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    update = "--update-baseline" in args
+    lint_only = "--lint-only" in args
+    verify_only = "--verify-only" in args
+    fuzz_n = 150
+    if "--fuzz" in args:
+        fuzz_n = int(args[args.index("--fuzz") + 1])
+
+    summary: dict = {}
+    rc = 0
+    if not verify_only:
+        summary["lint"] = run_lint(update)
+        if summary["lint"].get("new") or summary["lint"].get("stale"):
+            rc = 1
+    if not lint_only:
+        summary["verify"] = run_verify(fuzz_n)
+        if summary["verify"]["diagnostics"] or \
+                summary["verify"]["coverage_failures"]:
+            rc = 1
+    summary["ok"] = rc == 0
+    print(json.dumps(summary))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
