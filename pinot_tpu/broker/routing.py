@@ -242,6 +242,12 @@ class AdaptiveServerSelector:
             return self._lat.get(server, 1.0) * \
                 (1 + self._inflight.get(server, 0))
 
+    def estimate_ms(self, server: str) -> Optional[float]:
+        """Latency EWMA for hedging decisions (None until the first
+        completed call establishes an estimate)."""
+        with self._lock:
+            return self._lat.get(server)
+
     def select(self, assignment: Dict[str, List[str]],
                healthy) -> Dict[str, Optional[str]]:
         out: Dict[str, Optional[str]] = {}

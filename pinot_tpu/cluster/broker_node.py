@@ -12,17 +12,69 @@ in-process broker uses.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 import urllib.error
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..engine.reduce import ResultTable, reduce_partials
 
 from ..query.context import build_query_context
 from ..query.sql import SetOpStmt, SqlError, parse_sql, to_sql
+from ..utils.metrics import global_metrics
 from .http_util import JsonHandler, http_json, http_raw, start_http
+
+# pinot-common QueryException error-code analogs (the exceptions[] wire
+# contract the webapp/console already renders)
+ERR_QUERY_EXECUTION = 200      # server answered with an application error
+ERR_BROKER_TIMEOUT = 250       # query deadline exhausted mid-scatter
+ERR_SERVER_NOT_RESPONDED = 427  # transport failure / no replica left
+
+
+class ScatterTimeoutError(SqlError):
+    """The query's timeoutMs budget ran out while scattering."""
+
+
+def _parse_timeout_ms(options: Dict[str, Any]) -> int:
+    """Validate OPTION(timeoutMs=...) up front: a bad value must be a
+    400-class SqlError, never a ValueError escaping as a 500."""
+    from ..broker.broker import DEFAULT_TIMEOUT_MS
+    raw = options.get("timeoutMs", DEFAULT_TIMEOUT_MS)
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise SqlError(f"invalid timeoutMs value {raw!r}; "
+                       "expected an integer of milliseconds") from None
+
+
+class ReplicaExhaustedError(SqlError):
+    """No healthy replica left for a segment — an availability failure
+    (exceptions[] code 427), not a query-execution error."""
+
+
+class _SegmentShortfall(Exception):
+    """A server answered 200 but ran fewer segments than asked — it is
+    mid-(re)load after a heartbeat loss / reassignment and silently
+    skips segments it doesn't hold yet. Classified with the transport
+    failures so the caller fails over instead of reducing over a
+    silent subset (found by the chaos soak: heartbeat churn under CPU
+    starvation produced exact-looking partial answers)."""
+
+
+@dataclass
+class ScatterResult:
+    """One scatter-gather's partials + the health metadata the response
+    envelope carries (BrokerResponseNative analog)."""
+    partials: List[Any] = field(default_factory=list)
+    segments_queried: int = 0
+    pruned: int = 0
+    servers_queried: int = 0
+    servers_responded: int = 0
+    exceptions: List[Dict[str, Any]] = field(default_factory=list)
+    partial: bool = False
 
 
 class FailureDetector:
@@ -52,6 +104,17 @@ class FailureDetector:
             self._fails.pop(server, None)
             self._until.pop(server, None)
 
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-server consecutive-failure state for /metrics and the UI."""
+        now = time.monotonic()
+        with self._lock:
+            servers = set(self._fails) | set(self._until)
+            return {s: {
+                "consecutiveFailures": self._fails.get(s, 0),
+                "backoffRemainingS": round(
+                    max(self._until.get(s, 0.0) - now, 0.0), 3),
+            } for s in sorted(servers)}
+
 
 class BrokerNode:
     def __init__(self, controller_url: str, port: int = 0,
@@ -62,7 +125,11 @@ class BrokerNode:
         self.controller_url = controller_url
         self.routing_refresh = routing_refresh
         self._routing: Dict[str, Any] = {"version": -1}
-        self._rr = 0  # round-robin cursor for explain/failover re-picks
+        # round-robin cursor for explain/failover re-picks. An itertools
+        # counter, not an int += 1: _pick_replica runs on pool threads
+        # during failover, and the unlocked read-modify-write lost
+        # increments (next() is a single atomic step under the GIL)
+        self._rr = itertools.count(1)
         self._failures = FailureDetector()
         self._selector = make_selector(instance_selector)
         self._quota = QueryQuotaManager()
@@ -129,8 +196,7 @@ class BrokerNode:
             candidates = [h for h in holders if self._server_url(h)]
         if not candidates:
             return None
-        self._rr += 1
-        return candidates[self._rr % len(candidates)]
+        return candidates[next(self._rr) % len(candidates)]
 
     # -- query path --------------------------------------------------------
     def _snapshot(self) -> Dict[str, Any]:
@@ -178,11 +244,16 @@ class BrokerNode:
         # boundary, pruning, and scatter must agree on routing state (the
         # refresh thread swaps self._routing underneath)
         snap = self._snapshot()
+        # the query's timeoutMs is a BUDGET for the whole scatter: every
+        # server call gets the remaining slice, and servers receive it as
+        # deadlineMs so their accountant deadline is min(own, remaining)
+        timeout_ms = _parse_timeout_ms(stmt.options)
+        deadline = t0 + timeout_ms / 1e3
         snap_tables = snap.get("tables", {})
         if stmt.table not in snap_tables and \
                 f"{stmt.table}_OFFLINE" in snap_tables and \
                 f"{stmt.table}_REALTIME" in snap_tables:
-            return self._query_hybrid(stmt, t0, snap)
+            return self._query_hybrid(stmt, t0, snap, deadline)
 
         self._check_quota(stmt.table, snap)
         ctx = build_query_context(stmt)
@@ -193,16 +264,30 @@ class BrokerNode:
                            "in-process broker only (run the query "
                            "against a local Broker)")
         if stmt.explain:
-            return self._explain_remote(sql, ctx.table)
-        partials, queried, pruned = self._scatter(sql, ctx, snap)
-        result = reduce_partials(ctx, partials)
-        result.num_segments = queried
-        result.num_segments_pruned = pruned
+            return self._explain_remote(sql, ctx.table, deadline)
+        sc = self._scatter(sql, ctx, snap, deadline)
+        result = reduce_partials(ctx, sc.partials)
+        result.num_segments = sc.segments_queried
+        result.num_segments_pruned = sc.pruned
+        self._attach_scatter_meta(result, [sc])
         result.time_ms = (time.perf_counter() - t0) * 1e3
         return result
 
-    def _query_hybrid(self, stmt, t0: float,
-                      snap: Dict[str, Any]) -> ResultTable:
+    @staticmethod
+    def _attach_scatter_meta(result: ResultTable,
+                             scatters: List[ScatterResult]) -> None:
+        result.num_servers_queried = sum(s.servers_queried
+                                         for s in scatters)
+        result.num_servers_responded = sum(s.servers_responded
+                                           for s in scatters)
+        for s in scatters:
+            result.exceptions.extend(s.exceptions)
+        result.partial_result = any(s.partial for s in scatters)
+        if result.partial_result:
+            global_metrics.count("scatter_partial_responses")
+
+    def _query_hybrid(self, stmt, t0: float, snap: Dict[str, Any],
+                      deadline: Optional[float] = None) -> ResultTable:
         from ..broker.routing import (resolve_time_column, split_hybrid,
                                       time_boundary)
         logical = stmt.table
@@ -222,24 +307,26 @@ class BrokerNode:
                            f"lack {time_col!r} metadata for the boundary")
         off, rt = split_hybrid(stmt, time_col, boundary)
         if stmt.explain:
-            return self._explain_remote("EXPLAIN " + to_sql(off), off.table)
-        partials: List[Any] = []
-        queried = pruned = 0
+            return self._explain_remote("EXPLAIN " + to_sql(off),
+                                        off.table, deadline)
+        scatters: List[ScatterResult] = []
         for part_stmt in (off, rt):
             ctx_p = build_query_context(part_stmt)
-            p, q, pr = self._scatter(to_sql(part_stmt), ctx_p, snap)
-            partials.extend(p)
-            queried += q
-            pruned += pr
-        result = reduce_partials(build_query_context(off), partials)
-        result.num_segments = queried
-        result.num_segments_pruned = pruned
+            scatters.append(
+                self._scatter(to_sql(part_stmt), ctx_p, snap, deadline))
+        result = reduce_partials(build_query_context(off),
+                                 [p for s in scatters for p in s.partials])
+        result.num_segments = sum(s.segments_queried for s in scatters)
+        result.num_segments_pruned = sum(s.pruned for s in scatters)
+        self._attach_scatter_meta(result, scatters)
         result.time_ms = (time.perf_counter() - t0) * 1e3
         return result
 
-    def _explain_remote(self, sql: str, table: str) -> ResultTable:
+    def _explain_remote(self, sql: str, table: str,
+                        deadline: Optional[float] = None) -> ResultTable:
         # plan shape is identical across servers: ask any holder, with the
-        # same failover + failure-detector recording as the data path
+        # same failover + failure-detector recording and the same
+        # remaining-deadline budget as the data path
         assignment = self._route(table)
         for seg, holders in assignment.items():
             tried: set = set()
@@ -248,22 +335,72 @@ class BrokerNode:
                     [h for h in holders if h not in tried])
                 if pick is None:
                     break
+                rem = None if deadline is None \
+                    else deadline - time.perf_counter()
+                if rem is not None and rem <= 0:
+                    raise ScatterTimeoutError(
+                        "query deadline exhausted while explaining")
                 try:
                     resp = http_json(
                         "POST", f"{self._server_url(pick)}/query",
-                        {"sql": sql})
+                        {"sql": sql},
+                        timeout=10.0 if rem is None else max(rem, 0.05))
+                except urllib.error.HTTPError as e:
+                    # application error: surface it, keep health intact
+                    self._failures.record_success(pick)
+                    try:
+                        detail = e.read().decode()[:200]
+                    except Exception:
+                        detail = str(e)
+                    raise SqlError(f"server {pick} rejected explain: "
+                                   f"{detail}") from None
                 except Exception:
                     tried.add(pick)
                     self._failures.record_failure(pick)
                     continue
+                self._failures.record_success(pick)
                 exp = resp.get("explain", {})
                 return ResultTable(exp.get("columns", []),
                                    [tuple(r) for r in exp.get("rows", [])])
         raise SqlError("no live replica to explain against")
 
+    @staticmethod
+    def _parse_hedge_option(ctx) -> Optional[float]:
+        """Validate OPTION(hedgeMs=...) once, BEFORE dispatch: a bad
+        value must be a 400-class SqlError, not a ValueError escaping
+        mid-gather with futures in flight. None = option absent;
+        0.0 = explicitly disabled."""
+        raw = ctx.options.get("hedgeMs")
+        if raw is None:
+            return None
+        try:
+            v = float(raw)
+        except (TypeError, ValueError):
+            raise SqlError(f"invalid hedgeMs value {raw!r}; "
+                           "expected a number of milliseconds") from None
+        return max(v, 0.0)
+
+    def _hedge_threshold_ms(self, hedge_opt: Optional[float],
+                            server: str) -> Optional[float]:
+        """When to re-dispatch a straggling server's segments elsewhere:
+        a validated OPTION(hedgeMs=...) wins (0 disables); otherwise 3x
+        the adaptive selector's latency EWMA for that server, floored at
+        150 ms — the EWMA mixes query shapes, so a low floor would hedge
+        every legitimately-heavy query after a stream of cheap ones
+        (duplicated dispatch exactly when the cluster is loaded). A
+        hedge fires at most once per group either way."""
+        if hedge_opt is not None:
+            return hedge_opt if hedge_opt > 0 else None
+        est = getattr(self._selector, "estimate_ms", None)
+        if est is not None:
+            e = est(server)
+            if e is not None:
+                return max(3.0 * e, 150.0)
+        return None
+
     def _scatter(self, sql: str, ctx,
-                 snap: Optional[Dict[str, Any]] = None
-                 ) -> Tuple[List[Any], int, int]:
+                 snap: Optional[Dict[str, Any]] = None,
+                 deadline: Optional[float] = None) -> ScatterResult:
         # one snapshot for assignment + segment metadata: the refresh
         # thread swaps self._routing, and mixing two snapshots could
         # silently drop segments assigned in one but absent in the other
@@ -274,12 +411,17 @@ class BrokerNode:
             raise SqlError(f"table {ctx.table!r} not found in routing")
         seg_entries = snap.get("segments", {}).get(ctx.table) or {}
 
+        from ..query.planner import _truthy
+        allow_partial = _truthy(ctx.options.get("allowPartialResults"))
+        hedge_opt = self._parse_hedge_option(ctx)
+        res = ScatterResult()
+
         # broker-side pruning over controller-held segment metadata; an
         # assigned segment with no metadata entry is never pruned
         from ..broker.routing import prune_segments
         meta = {s: (seg_entries.get(s) or {}).get("meta")
                 for s in assignment}
-        keep, pruned = prune_segments(
+        keep, res.pruned = prune_segments(
             meta, ctx.filter,
             (snap.get("tables", {}).get(ctx.table) or {}).get("config"))
         keep_set = set(keep)
@@ -298,27 +440,56 @@ class BrokerNode:
         picks = self._selector.select(assignment, healthy)
         unserved = [s for s, p in picks.items() if p is None]
         if unserved:
-            raise SqlError(f"no live replica for segments {unserved[:3]}"
-                           f"{'...' if len(unserved) > 3 else ''}")
+            msg = (f"no live replica for segments {unserved[:3]}"
+                   f"{'...' if len(unserved) > 3 else ''}")
+            if not allow_partial:
+                raise SqlError(msg)
+            res.exceptions.append({"errorCode": ERR_SERVER_NOT_RESPONDED,
+                                   "message": msg})
+            res.partial = True
         by_server: Dict[str, List[str]] = {}
         for seg, pick in picks.items():
-            by_server.setdefault(pick, []).append(seg)
+            if pick is not None:
+                by_server.setdefault(pick, []).append(seg)
 
         adaptive = getattr(self._selector, "record_start", None)
 
+        def remaining() -> Optional[float]:
+            return None if deadline is None \
+                else deadline - time.perf_counter()
+
         def call(server: str, segs: List[str], retry: bool = True):
             url = self._server_url(server)
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                raise ScatterTimeoutError(
+                    f"query deadline exhausted before dispatch to "
+                    f"{server}")
             if adaptive:
                 self._selector.record_start(server)
             tcall = time.perf_counter()
             try:
                 from ..engine.datablock import decode_wire_frame
-                raw = http_raw("POST", f"{url}/query/bin",
-                               {"sql": sql, "segments": segs})
+                from ..utils.faults import corrupt_bytes
+                body = {"sql": sql, "segments": segs}
+                if rem is not None:
+                    # the server clamps its accountant deadline to
+                    # min(its own timeoutMs, this remaining budget)
+                    body["deadlineMs"] = int(rem * 1e3)
+                raw = http_raw("POST", f"{url}/query/bin", body,
+                               timeout=10.0 if rem is None
+                               else max(rem, 0.05))
+                raw = corrupt_bytes("wire.corrupt", server, raw)
                 header, decoded = decode_wire_frame(raw)
+                n_run = int(header.get("segmentsQueried", 0))
+                if n_run < len(segs):
+                    raise _SegmentShortfall(
+                        f"server {server} ran {n_run} of {len(segs)} "
+                        f"requested segments (still loading after a "
+                        f"reassignment?)")
                 self._failures.record_success(server)
-                return {"partials": decoded,
-                        "segmentsQueried": header.get("segmentsQueried", 0)}
+                return {"partials": decoded, "segmentsQueried": n_run,
+                        "dispatched": [server], "responders": [server]}
             except urllib.error.HTTPError as e:
                 # the server answered: an application error, not a health
                 # signal — surface it, don't poison the failure detector
@@ -329,46 +500,230 @@ class BrokerNode:
                     detail = str(e)
                 raise SqlError(f"server {server} rejected query: "
                                f"{detail}") from None
+            except (ScatterTimeoutError, SqlError):
+                raise
             except Exception:
                 self._failures.record_failure(server)
                 if not retry:
                     raise
                 # failover: re-pick replicas per segment, one retry
+                global_metrics.count("scatter_failovers")
                 regrouped: Dict[str, List[str]] = {}
                 for seg in segs:
                     holders = [h for h in assignment.get(seg, [])
                                if h != server]
                     pick = self._pick_replica(holders)
                     if pick is None:
-                        raise SqlError(f"no replica left for {seg!r}")
+                        raise ReplicaExhaustedError(
+                            f"no replica left for {seg!r}")
                     regrouped.setdefault(pick, []).append(seg)
-                out = {"partials": [], "segmentsQueried": 0}
+                # dispatched/responders surface the failover in the
+                # response health metadata: the dead primary stays in
+                # "queried", the replica that actually answered joins
+                # "responded" — a hidden failover is invisible otherwise
+                out = {"partials": [], "segmentsQueried": 0,
+                       "dispatched": [server], "responders": []}
                 for srv, ss in regrouped.items():
                     r = call(srv, ss, retry=False)
                     out["partials"].extend(r["partials"])
                     out["segmentsQueried"] += r["segmentsQueried"]
+                    out["dispatched"].extend(r["dispatched"])
+                    out["responders"].extend(r["responders"])
                 return out
             finally:
                 if adaptive:
                     self._selector.record_end(
                         server, (time.perf_counter() - tcall) * 1e3)
 
-        futures = [self._pool.submit(call, srv, segs)
-                   for srv, segs in by_server.items()]
-        partials: List[Any] = []
-        queried = 0
-        for f in futures:
-            resp = f.result()
-            partials.extend(resp["partials"])
-            queried += resp["segmentsQueried"]
-        return partials, queried, pruned
+        self._gather(hedge_opt, assignment, by_server, call, res,
+                     remaining, allow_partial)
+        global_metrics.gauge(
+            "scatter_unhealthy_servers",
+            sum(1 for s in snap.get("instances", {})
+                if not self._failures.healthy(s)))
+        return res
+
+    def _gather(self, hedge_opt: Optional[float],
+                assignment: Dict[str, List[str]],
+                by_server: Dict[str, List[str]], call,
+                res: ScatterResult, remaining, allow_partial: bool
+                ) -> None:
+        """Gather that collects per-server errors instead of letting the
+        first f.result() abandon the rest, with deadline-aware waiting
+        and hedged re-dispatch of stragglers.
+
+        One 'group' per primary server dispatch. A group resolves when
+        its primary attempt (internal failover included) succeeds, or
+        when ALL parts of one hedge attempt succeed — whichever lands
+        first; the loser is ignored (replica partials are byte-identical
+        by construction, so either is correct, never both)."""
+        groups: Dict[int, Dict[str, Any]] = {}
+        fut_info: Dict[Any, Tuple[int, str, bool]] = {}
+        for gid, (srv, segs) in enumerate(sorted(by_server.items())):
+            groups[gid] = {"server": srv, "segs": segs, "done": False,
+                           "errors": [], "t0": time.perf_counter(),
+                           "hedged": False, "hedge_parts": 0,
+                           "hedge_partials": [], "hedge_segments": 0,
+                           "hedge_servers": [], "primary_failed": False}
+            f = self._pool.submit(call, srv, segs)
+            fut_info[f] = (gid, srv, False)
+
+        responded: set = set()
+        # every server an attempt was dispatched to: primaries up front,
+        # hedge targets as they launch — so numServersResponded (a
+        # subset of attempt targets) can never exceed numServersQueried
+        queried: set = set(by_server)
+        timed_out = False
+        pending = set(fut_info)
+
+        def abandon(futs) -> None:
+            # consume late results/exceptions so the executor never logs
+            # "exception was never retrieved" for attempts we no longer
+            # care about (a hedged-out straggler, a post-deadline call)
+            for f in futs:
+                f.add_done_callback(lambda fut: fut.exception())
+
+        while pending:
+            if all(g["done"] for g in groups.values()):
+                abandon(pending)  # every group resolved (hedges won):
+                break             # don't wait out the stragglers
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                timed_out = True
+                abandon(pending)
+                for g in groups.values():
+                    # only groups with NO recorded failure get the
+                    # still-waiting entry — a server that already
+                    # answered with an error must not also be reported
+                    # as "did not respond"
+                    if not g["done"] and not g["errors"]:
+                        g["errors"].append({
+                            "errorCode": ERR_BROKER_TIMEOUT,
+                            "message": f"server {g['server']} did not "
+                                       "respond within the query "
+                                       "deadline"})
+                break
+            # poll fast only while some group could still hedge;
+            # otherwise block the full remaining budget (or until a
+            # completion) instead of 50 wakeups/s per scatter
+            hedgeable = any(
+                not g["done"] and not g["hedged"]
+                and not g["primary_failed"]
+                and self._hedge_threshold_ms(hedge_opt,
+                                             g["server"]) is not None
+                for g in groups.values())
+            if hedgeable:
+                tick = 0.02 if rem is None else min(0.02, rem)
+            else:
+                tick = rem  # None = block until a completion
+            done, pending = wait(pending, timeout=tick,
+                                 return_when=FIRST_COMPLETED)
+            for f in done:
+                gid, server, is_hedge = fut_info[f]
+                g = groups[gid]
+                try:
+                    resp = f.result()
+                except Exception as e:
+                    if isinstance(e, ScatterTimeoutError):
+                        code = ERR_BROKER_TIMEOUT
+                    elif isinstance(e, ReplicaExhaustedError):
+                        code = ERR_SERVER_NOT_RESPONDED
+                    elif isinstance(e, SqlError):
+                        code = ERR_QUERY_EXECUTION
+                    else:
+                        code = ERR_SERVER_NOT_RESPONDED
+                    if not is_hedge:
+                        g["primary_failed"] = True
+                    g["errors"].append({"errorCode": code,
+                                        "message": str(e),
+                                        "server": server})
+                    continue
+                if g["done"]:
+                    continue  # the other attempt already resolved it
+                if not is_hedge:
+                    g["done"] = True
+                    res.partials.extend(resp["partials"])
+                    res.segments_queried += resp["segmentsQueried"]
+                    queried.update(resp["dispatched"])
+                    responded.update(resp["responders"])
+                else:
+                    g["hedge_partials"].extend(resp["partials"])
+                    g["hedge_segments"] += resp["segmentsQueried"]
+                    g["hedge_servers"].extend(resp["responders"])
+                    g["hedge_parts"] -= 1
+                    if g["hedge_parts"] == 0:
+                        # every part of the hedge landed: commit it
+                        g["done"] = True
+                        res.partials.extend(g["hedge_partials"])
+                        res.segments_queried += g["hedge_segments"]
+                        responded.update(g["hedge_servers"])
+            # hedge pass: a primary past its latency threshold gets its
+            # segments re-dispatched to other healthy replicas, once
+            now = time.perf_counter()
+            for gid, g in groups.items():
+                if g["done"] or g["hedged"] or g["primary_failed"]:
+                    continue
+                thr = self._hedge_threshold_ms(hedge_opt, g["server"])
+                if thr is None or (now - g["t0"]) * 1e3 < thr:
+                    continue
+                g["hedged"] = True
+                regrouped: Dict[str, List[str]] = {}
+                ok = True
+                for seg in g["segs"]:
+                    holders = [h for h in assignment.get(seg, [])
+                               if h != g["server"]
+                               and self._failures.healthy(h)]
+                    pick = self._pick_replica(holders)
+                    if pick is None:
+                        ok = False  # nowhere to hedge this segment
+                        break
+                    regrouped.setdefault(pick, []).append(seg)
+                if not ok:
+                    continue
+                global_metrics.count("scatter_hedges", len(regrouped))
+                g["hedge_parts"] = len(regrouped)
+                for srv2, ss in regrouped.items():
+                    f2 = self._pool.submit(call, srv2, ss, False)
+                    fut_info[f2] = (gid, srv2, True)
+                    queried.add(srv2)
+                    pending.add(f2)
+
+        failed = [g for g in groups.values() if not g["done"]]
+        for g in failed:
+            res.exceptions.extend(g["errors"])
+        if res.exceptions:
+            global_metrics.count("scatter_server_errors",
+                                 len(res.exceptions))
+        res.servers_queried = len(queried)
+        res.servers_responded = len(responded)
+        if failed:
+            res.partial = True
+            if not allow_partial:
+                if timed_out:
+                    raise ScatterTimeoutError(
+                        f"query timed out: {len(failed)} of "
+                        f"{len(groups)} servers unanswered when the "
+                        f"timeoutMs budget ran out "
+                        f"(set allowPartialResults=true for a partial "
+                        f"answer); exceptions: "
+                        f"{[e['message'] for e in res.exceptions][:3]}")
+                first = (failed[0]["errors"] or
+                         [{"message": "server failed"}])[0]
+                raise SqlError(first["message"])
 
     def _query_setop(self, stmt: SetOpStmt, t0: float) -> ResultTable:
         """Set operations over the remote data plane: run each branch as
         its own scatter-gather (rendered back to SQL), combine at this
-        broker — the same multiset merge the in-process broker uses."""
+        broker — the same multiset merge the in-process broker uses.
+        The compound's timeoutMs is ONE budget: each branch gets the
+        remaining slice, not a fresh full allowance."""
         from ..engine.reduce import DEFAULT_LIMIT
         from ..engine.setops import combine_setop, order_limit_rows
+
+        timeout_ms = _parse_timeout_ms(stmt.options)
+        deadline = t0 + timeout_ms / 1e3
+        branches: List[ResultTable] = []  # leaf results carry the
+        # scatter metadata combine_setop's fresh tables would drop
 
         def run(node) -> ResultTable:
             if isinstance(node, SetOpStmt):
@@ -376,16 +731,50 @@ class BrokerNode:
                                      run(node.left), run(node.right))
             if stmt.options:
                 node.options = {**stmt.options, **node.options}
+            remaining_ms = int((deadline - time.perf_counter()) * 1e3)
+            node.options["timeoutMs"] = min(
+                int(node.options.get("timeoutMs", timeout_ms)),
+                max(remaining_ms, 1))
             if node.limit is None:
                 node.limit = 1 << 31
-            return self.query(to_sql(node))
+            out = self.query(to_sql(node))
+            branches.append(out)
+            return out
 
         result = combine_setop(stmt.op, stmt.all,
                                run(stmt.left), run(stmt.right))
         limit = stmt.limit if stmt.limit is not None else DEFAULT_LIMIT
         result = order_limit_rows(result, stmt.order_by, limit, stmt.offset)
+        # a partial branch must not present the compound as complete
+        result.num_servers_queried = sum(b.num_servers_queried
+                                         for b in branches)
+        result.num_servers_responded = sum(b.num_servers_responded
+                                           for b in branches)
+        for b in branches:
+            result.exceptions.extend(b.exceptions)
+        result.partial_result = any(b.partial_result for b in branches)
         result.time_ms = (time.perf_counter() - t0) * 1e3
         return result
+
+    # -- scatter health (satellite: FailureDetector + counters exported) --
+    def scatter_health(self) -> Dict[str, Any]:
+        """Scatter-gather health: per-server consecutive-failure state
+        from the FailureDetector plus the scatter counters — served at
+        GET /metrics and rendered on the /ui console."""
+        snap = global_metrics.snapshot()
+        c = snap["counters"]
+        fd = self._failures.snapshot()
+        instances = self._snapshot().get("instances", {})
+        return {
+            "servers": fd,
+            "unhealthyServers": sum(
+                1 for s in instances if not self._failures.healthy(s)),
+            "knownServers": len(instances),
+            "counters": {k: c.get(k, 0) for k in (
+                "scatter_failovers", "scatter_hedges",
+                "scatter_partial_responses", "scatter_server_errors",
+                "faults_fired")},
+        }
 
     # -- REST --------------------------------------------------------------
     def _make_handler(self):
@@ -403,6 +792,10 @@ class BrokerNode:
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
+                ("GET", "/metrics/prometheus"): lambda h, b: (
+                    200, ("text/plain", global_metrics.prometheus())),
+                ("GET", "/metrics"): lambda h, b: (
+                    200, node.scatter_health()),
                 ("GET", "/ui"): lambda h, b: (
                     200, ("text/html", node.ui_page())),
                 ("POST", "/query/sql"): q,
@@ -426,11 +819,15 @@ class BrokerNode:
  th{background:#222}
  #stats{color:#8a8;margin-top:.5em}
  #err{color:#e66;white-space:pre-wrap}
+ #warn{color:#ea3;white-space:pre-wrap}
+ #scatter{color:#789;margin-top:1.5em;font-size:.85em;
+   border-top:1px solid #333;padding-top:.5em}
 </style></head><body>
 <h2>pinot-tpu query console</h2>
 <textarea id=sql>SELECT * FROM mytable LIMIT 10</textarea><br>
 <button onclick=run()>Run (Ctrl-Enter)</button>
-<div id=stats></div><div id=err></div><div id=out></div>
+<div id=stats></div><div id=warn></div><div id=err></div><div id=out></div>
+<div id=scatter></div>
 <script>
 const esc=s=>String(s).replace(/[&<>"']/g,
   c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
@@ -440,6 +837,7 @@ sqlEl.addEventListener('keydown',e=>{
 async function run(){
   const t0=performance.now();
   document.getElementById('err').textContent='';
+  document.getElementById('warn').textContent='';
   document.getElementById('out').innerHTML='';
   let j;
   try{
@@ -449,6 +847,11 @@ async function run(){
     j=await r.json();
   }catch(e){document.getElementById('err').textContent=e;return;}
   if(j.error){document.getElementById('err').textContent=j.error;return;}
+  if(j.partialResult)
+    document.getElementById('warn').textContent=
+      'PARTIAL RESULT: '+j.numServersResponded+'/'+j.numServersQueried+
+      ' servers responded — '+
+      (j.exceptions||[]).map(e=>e.message).join('; ');
   const rt=j.resultTable||j;
   const cols=(rt.dataSchema&&rt.dataSchema.columnNames)||rt.columns||[];
   const rows=rt.rows||[];
@@ -464,6 +867,24 @@ async function run(){
     srvMs.toFixed(1):'?')+' ms | wall '+ms+' ms | docs scanned '+
     (j.numDocsScanned!==undefined?j.numDocsScanned:'?');
 }
+async function health(){
+  try{
+    const m=await (await fetch('/metrics')).json();
+    const c=m.counters||{};
+    const srv=Object.entries(m.servers||{}).map(([id,s])=>
+      esc(id)+': '+s.consecutiveFailures+' consecutive failures'+
+      (s.backoffRemainingS>0?' (backoff '+s.backoffRemainingS+'s)':''))
+      .join(' | ')||'all healthy';
+    document.getElementById('scatter').textContent=
+      'scatter health: '+m.unhealthyServers+'/'+m.knownServers+
+      ' unhealthy | failovers '+(c.scatter_failovers||0)+
+      ' | hedges '+(c.scatter_hedges||0)+
+      ' | partial responses '+(c.scatter_partial_responses||0)+
+      ' | server errors '+(c.scatter_server_errors||0)+
+      ' — '+srv;
+  }catch(e){}
+}
+health();setInterval(health,3000);
 </script></body></html>"""
 
     def stop(self) -> None:

@@ -59,7 +59,8 @@ class _Handlers(grpc.GenericRpcHandler):
         HTTP plane lacks."""
         from ..engine.datablock import encode_partial
         req = json.loads(request)
-        resp = self.node.execute(req["sql"], req.get("segments"))
+        resp = self.node.execute(req["sql"], req.get("segments"),
+                                 deadline_ms=req.get("deadlineMs"))
         partials = resp.pop("partials_raw", [])
         for p in partials:
             yield encode_partial(p)
@@ -88,17 +89,21 @@ def start_grpc(node, port: int = 0) -> Tuple[grpc.Server, int]:
 
 def submit_stream(target: str, sql: str,
                   segments: Optional[List[str]] = None,
-                  timeout: float = 60.0):
+                  timeout: float = 60.0,
+                  deadline_ms: Optional[float] = None):
     """-> (header dict, [decoded partials]); partials decode as chunks
     arrive (GrpcBrokerRequestHandler analog)."""
     from ..engine.datablock import decode_partial
+    from ..utils.faults import rpc_faults
+    rpc_faults(f"GRPC {target}/Submit")
     partials: List[Any] = []
     header: Dict[str, Any] = {}
     with grpc.insecure_channel(target) as channel:
         call = channel.unary_stream(
             f"/{SERVICE}/Submit", request_serializer=_wrap,
             response_deserializer=_unwrap)
-        req = json.dumps({"sql": sql, "segments": segments}).encode()
+        req = json.dumps({"sql": sql, "segments": segments,
+                          "deadlineMs": deadline_ms}).encode()
         for chunk in call(req, timeout=timeout):
             if chunk[:4] == _META:
                 header = json.loads(chunk[4:])
@@ -109,6 +114,8 @@ def submit_stream(target: str, sql: str,
 
 def mailbox_send(target: str, frames: List[bytes],
                  timeout: float = 60.0) -> int:
+    from ..utils.faults import rpc_faults
+    rpc_faults(f"GRPC {target}/Mailbox")
     with grpc.insecure_channel(target) as channel:
         call = channel.stream_unary(
             f"/{SERVICE}/Mailbox", request_serializer=_wrap,

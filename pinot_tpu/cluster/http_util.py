@@ -99,6 +99,8 @@ def http_raw(method: str, url: str, body: Any = None,
              timeout: float = 10.0) -> bytes:
     """Raw-bytes response; body may be JSON-able or raw bytes (the latter
     POSTs as octet-stream — the binary data plane both ways)."""
+    from ..utils.faults import rpc_faults
+    rpc_faults(f"{method} {url}")
     if isinstance(body, (bytes, bytearray)):
         data = bytes(body)
         ctype = "application/octet-stream"

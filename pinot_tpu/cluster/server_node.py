@@ -68,6 +68,16 @@ class ServerNode:
         # scheduler_config["query.killer.rss_limit_bytes"] (0 disables)
         from ..engine.accounting import HeapWatcher, system_memory_bytes
         cfg = scheduler_config or {}
+        # deterministic chaos: a node config can arm the process-global
+        # fault plan (PINOT_FAULTS grammar — utils/faults.py); the env
+        # var is the container path, this is the embedded-cluster path.
+        # The plan is PROCESS-global: last installer wins, and stop()
+        # disarms it again (only if still ours) so a stopped chaos node
+        # doesn't keep injecting into the rest of the process
+        self._fault_plan = None
+        if cfg.get("fault.plan"):
+            from ..utils import faults
+            self._fault_plan = faults.install(cfg["fault.plan"])
         rss_limit = int(cfg.get("query.killer.rss_limit_bytes",
                                 int(system_memory_bytes() * 0.9)))
         self.heap_watcher = (HeapWatcher(global_accountant, rss_limit).start()
@@ -165,20 +175,31 @@ class ServerNode:
 
     # -- data plane --------------------------------------------------------
     def execute(self, sql: str, segment_names: Optional[List[str]] = None,
-                priority: int = 0) -> Dict[str, Any]:
+                priority: int = 0,
+                deadline_ms: Optional[float] = None) -> Dict[str, Any]:
         """Admit through the scheduler (QueryScheduler.submit analog) and
-        account the query so the watcher can kill it under pressure."""
+        account the query so the watcher can kill it under pressure.
+        ``deadline_ms`` is the dispatching broker's REMAINING budget; the
+        accountant deadline becomes min(own timeoutMs, broker remaining)
+        so a server never works past the point the broker stops
+        listening."""
         query_id = uuid.uuid4().hex[:12]
+        # the deadline anchors at ARRIVAL, before scheduler admission:
+        # queue time is inside the broker's budget, not in addition to it
+        t_arrive = time.perf_counter()
         global_accountant.register(query_id)
         try:
             return self.scheduler.execute(
-                lambda: self._execute(sql, segment_names, query_id),
+                lambda: self._execute(sql, segment_names, query_id,
+                                      deadline_ms, t_arrive),
                 query_id, priority=priority)
         finally:
             global_accountant.unregister(query_id)
 
     def _execute(self, sql: str, segment_names: Optional[List[str]] = None,
-                 query_id: Optional[str] = None) -> Dict[str, Any]:
+                 query_id: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
+                 t_arrive: Optional[float] = None) -> Dict[str, Any]:
         t0 = time.perf_counter()
         stmt = parse_sql(sql)
         from ..query.sql import DdlStmt, SetOpStmt
@@ -192,13 +213,20 @@ class ServerNode:
         if query_id is not None:
             # enforce the query's timeoutMs where the work actually runs
             # (the broker-side deadline lives in a different process in
-            # cluster mode)
+            # cluster mode), clamped to the broker's forwarded remaining
+            # budget so a re-dispatched straggler cannot outlive the
+            # scatter that asked for it
             from ..broker.broker import DEFAULT_TIMEOUT_MS
             timeout_ms = int(stmt.options.get("timeoutMs",
                                               DEFAULT_TIMEOUT_MS))
-            global_accountant.set_deadline(query_id, t0 + timeout_ms / 1e3)
+            if deadline_ms is not None:
+                timeout_ms = min(timeout_ms, int(deadline_ms))
+            global_accountant.set_deadline(
+                query_id, (t_arrive or t0) + timeout_ms / 1e3)
         if stmt.joins:
             raise ValueError("leaf servers execute single-table stages")
+        from ..utils.faults import fault_point
+        fault_point("segment.slow", key=self.instance_id)
         ctx = build_query_context(stmt)
         dm = self._tables.get(ctx.table)
         if dm is None:
@@ -221,20 +249,22 @@ class ServerNode:
                 "segmentsQueried": len(segments)}
 
     def execute_json(self, sql: str,
-                     segment_names: Optional[List[str]] = None
+                     segment_names: Optional[List[str]] = None,
+                     deadline_ms: Optional[float] = None
                      ) -> Dict[str, Any]:
         """Legacy/debuggable JSON wire (also serves EXPLAIN)."""
-        resp = self.execute(sql, segment_names)
+        resp = self.execute(sql, segment_names, deadline_ms=deadline_ms)
         raw = resp.pop("partials_raw", None)
         if raw is not None:
             resp["partials"] = [partial_to_wire(p) for p in raw]
         return resp
 
     def execute_bin(self, sql: str,
-                    segment_names: Optional[List[str]] = None) -> bytes:
+                    segment_names: Optional[List[str]] = None,
+                    deadline_ms: Optional[float] = None) -> bytes:
         """Binary data plane: columnar DataBlock partials in one frame."""
         from ..engine.datablock import encode_wire_frame
-        resp = self.execute(sql, segment_names)
+        resp = self.execute(sql, segment_names, deadline_ms=deadline_ms)
         raw = resp.pop("partials_raw", [])
         return encode_wire_frame(resp, raw)
 
@@ -276,9 +306,11 @@ class ServerNode:
             routes = {
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
                 ("POST", "/query/bin"): lambda h, b: (
-                    200, node.execute_bin(b["sql"], b.get("segments"))),
+                    200, node.execute_bin(b["sql"], b.get("segments"),
+                                          b.get("deadlineMs"))),
                 ("POST", "/query"): lambda h, b: (
-                    200, node.execute_json(b["sql"], b.get("segments"))),
+                    200, node.execute_json(b["sql"], b.get("segments"),
+                                           b.get("deadlineMs"))),
                 # multi-stage data plane (mailbox.proto analog) + stage
                 # dispatch (worker.proto Submit analog)
                 ("POST", "/mailbox"): lambda h, b: (
@@ -292,6 +324,11 @@ class ServerNode:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._fault_plan is not None:
+            from ..utils import faults
+            if faults.current_plan() is self._fault_plan:
+                faults.clear()
+            self._fault_plan = None
         self.scheduler.stop()
         if self.heap_watcher is not None:
             self.heap_watcher.stop()

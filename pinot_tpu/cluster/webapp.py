@@ -168,8 +168,11 @@ async function runQuery() {
     const res = await r.json();
     const ms = (performance.now() - t0).toFixed(1);
     // our broker reports errors as HTTP 4xx {"error": str}; keep the
-    // reference's exceptions[] shape working too
-    if (res.error || (res.exceptions && res.exceptions.length)) {
+    // reference's exceptions[] shape working too — but a PARTIAL
+    // result (allowPartialResults=true) carries both exceptions and
+    // surviving rows: render the rows under a warning, not an error
+    if (res.error || (res.exceptions && res.exceptions.length
+        && !res.partialResult)) {
       out.innerHTML = `<p class="err">${esc(
         res.error || JSON.stringify(res.exceptions))}</p>`;
       document.getElementById("qtime").textContent = "";
@@ -179,7 +182,13 @@ async function runQuery() {
     const cols = (rt.dataSchema && rt.dataSchema.columnNames)
       || rt.columns || [];
     const rows = rt.rows || [];
-    out.innerHTML = table(cols.map(esc),
+    const warn = res.partialResult
+      ? `<p class="err">PARTIAL RESULT: ${res.numServersResponded}` +
+        `/${res.numServersQueried} servers responded — ` +
+        `${esc((res.exceptions || []).map(e => e.message).join("; "))}` +
+        `</p>`
+      : "";
+    out.innerHTML = warn + table(cols.map(esc),
       rows.map(row => row.map(c => esc(JSON.stringify(c)))));
     const srv = res.timeUsedMs !== undefined
       ? ` · ${Number(res.timeUsedMs).toFixed(1)} ms server` : "";

@@ -147,6 +147,20 @@ class ResourceAccountant:
                 u._thread_cpu0[tid] = t
         if u is None:
             return
+        if u.killed_reason is None:
+            # deterministic chaos hook: behave exactly as the HeapWatcher
+            # would under heap pressure — flag the query, count the kill,
+            # raise at this (the query's own) sample point. Decides on
+            # the process-global "" stream (query ids are random, so
+            # keying by them would break same-seed determinism); the id
+            # rides along as the logged detail only
+            from ..utils.faults import fault_fires
+            if fault_fires("accounting.oom_kill", detail=u.query_id):
+                u.killed_reason = ("injected heap pressure "
+                                   "(fault accounting.oom_kill)")
+                from ..utils.metrics import global_metrics
+                global_metrics.count("queries_killed")
+                global_metrics.count("queries_killed_oom")
         if u.killed_reason is not None:
             raise QueryKilledError(
                 f"query {u.query_id} killed: {u.killed_reason}")

@@ -26,10 +26,23 @@ from ..query.planner import CompiledPlan
 from ..utils.spans import annotate, device_fence, span
 from .executor import execute_plan, extract_partial, resolve_params
 
-# stacked-column cache: (segment names, cols, bucket) -> tuple of stacked
-# device arrays; bounded LRU since segment sets change under realtime
+# stacked-column cache: ((segment uid, name) pairs, cols, bucket) -> tuple
+# of stacked device arrays; bounded LRU since segment sets change under
+# realtime. Keyed by the segments' process-unique LOAD uid, not the name:
+# segment names recur across tables and across reloads at the same bucket,
+# and a name-only key served the PREVIOUS table's device data to exact-
+# looking queries (round-9 chaos-soak find). The name rides along only for
+# evict_stacks_containing.
 _STACK_CACHE: "OrderedDict[Tuple, Tuple[jax.Array, ...]]" = OrderedDict()
 _STACK_CACHE_MAX = 32
+
+
+def _seg_key(seg) -> Tuple[int, str]:
+    # the uid is REQUIRED: an id() fallback would reintroduce the same
+    # stale-data class via recycled addresses, because _STACK_CACHE
+    # outlives the segment object (only ImmutableSegment reaches the
+    # batched kernel path today — give any new segment type a uid)
+    return (seg.uid, seg.name)
 
 
 @functools.lru_cache(maxsize=512)
@@ -51,7 +64,7 @@ def _param_sig(params: Tuple[jax.Array, ...]) -> Tuple:
 
 def _stacked_cols(plans: List[CompiledPlan], bucket: int
                   ) -> Tuple[jax.Array, ...]:
-    key = (tuple(p.segment.name for p in plans),
+    key = (tuple(_seg_key(p.segment) for p in plans),
            tuple(plans[0].col_names), bucket)
     hit = _STACK_CACHE.get(key)
     if hit is not None:
@@ -60,6 +73,10 @@ def _stacked_cols(plans: List[CompiledPlan], bucket: int
     cols = tuple(
         jnp.stack([p.segment.device_col(c, bucket) for p in plans])
         for c in plans[0].col_names)
+    # a reload's superseded entry (same names, older uids) is left to
+    # the 32-entry LRU: proactively deleting same-name entries would
+    # make two LIVE tables with generic segment names evict each other's
+    # stacks on every alternation
     _STACK_CACHE[key] = cols
     if len(_STACK_CACHE) > _STACK_CACHE_MAX:
         _STACK_CACHE.popitem(last=False)
@@ -69,7 +86,8 @@ def _stacked_cols(plans: List[CompiledPlan], bucket: int
 def evict_stacks_containing(segment_name: str) -> None:
     """Drop stacked copies that include a segment (called from
     ImmutableSegment.evict_device so eviction actually frees HBM)."""
-    for key in [k for k in _STACK_CACHE if segment_name in k[0]]:
+    for key in [k for k in _STACK_CACHE
+                if any(n == segment_name for _, n in k[0])]:
         del _STACK_CACHE[key]
 
 

@@ -239,7 +239,14 @@ def run_kernel(plan: CompiledPlan,
             entry.record_measured(matched, seg.n_docs)
             annotate(matched=matched,
                      meas_sel=matched / max(seg.n_docs, 1))
-        if int(host.pop("overflow", 0)):
+        # chaos hook: force the overflow retry ladder on kernels that
+        # report overflow (result-identical — the full-capacity rerun
+        # recomputes the same answer; exercises the retry path + retrace
+        # bracketing under test)
+        from ..utils.faults import fault_fires
+        forced_overflow = "overflow" in host and \
+            fault_fires("device.overflow", key=seg.name)
+        if int(host.pop("overflow", 0)) or forced_overflow:
             # compact-strategy capacity exceeded (the selectivity estimate
             # undershot): rerun with a capacity that cannot overflow
             from ..ops.compact import full_slots_cap

@@ -34,9 +34,16 @@ class ResultTable:
     num_segments_pruned: int = 0
     time_ms: float = 0.0
     trace: Optional[dict] = None
+    # scatter-gather health (Pinot BrokerResponseNative metadata):
+    # populated by the networked broker's gather; the in-process broker
+    # leaves them zero and to_dict omits them (response shape unchanged)
+    partial_result: bool = False
+    num_servers_queried: int = 0
+    num_servers_responded: int = 0
+    exceptions: List[Dict[str, Any]] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "resultTable": {
                 "dataSchema": {"columnNames": self.columns},
                 "rows": [list(r) for r in self.rows],
@@ -46,6 +53,13 @@ class ResultTable:
             "numDocsScanned": self.num_docs_scanned,
             "timeUsedMs": self.time_ms,
         }
+        if self.num_servers_queried or self.exceptions \
+                or self.partial_result:
+            out["numServersQueried"] = self.num_servers_queried
+            out["numServersResponded"] = self.num_servers_responded
+            out["partialResult"] = self.partial_result
+            out["exceptions"] = list(self.exceptions)
+        return out
 
     def __repr__(self) -> str:
         return f"ResultTable({self.columns}, {len(self.rows)} rows)"

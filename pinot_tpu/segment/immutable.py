@@ -11,6 +11,7 @@ device as iota < n_docs (masks replace RoaringBitmap docId sets).
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
@@ -25,6 +26,9 @@ from .builder import METADATA_FILE
 from .dictionary import Dictionary
 
 MIN_BUCKET = 1 << 10
+
+# monotonically unique id per loaded segment (never reused, unlike id())
+_SEG_UID = itertools.count(1)
 
 
 def bucket_for(n_docs: int, min_bucket: int = MIN_BUCKET) -> int:
@@ -77,6 +81,12 @@ class ImmutableSegment:
             name: ColumnMeta(name, d)
             for name, d in self.metadata["columns"].items()}
         self._read_mode = read_mode
+        # process-unique load identity: caches that outlive the segment
+        # object (engine/batch._STACK_CACHE) must key on THIS, not the
+        # segment name — names recur across tables/reloads with the same
+        # bucket, and a name-keyed device cache silently serves the old
+        # table's data (found by the round-9 chaos soak)
+        self.uid: int = next(_SEG_UID)
         self._index_readers: Dict[Tuple[str, str], Any] = {}
         self._fwd: Dict[str, np.ndarray] = {}
         self._dicts: Dict[str, Dictionary] = {}

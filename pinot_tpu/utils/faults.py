@@ -1,0 +1,303 @@
+"""Deterministic, seedable fault injection for the cluster plane.
+
+Reference parity: the reference exercises its ConnectionFailureDetector,
+deadline budgets, and partial-response paths with Netty-level chaos in
+integration tests; here the same failure classes are first-class *named
+injection points* compiled into the hot paths, modeled on the span
+tracer (utils/spans.py): a single ``is None`` check when no plan is
+installed, so the hooks live permanently in http_util / server_node /
+grpc_plane / accounting / executor at zero cost.
+
+Named points (the registry contract — tests and tools/chaos_smoke.py
+target these):
+
+==================== ======================================================
+``rpc.drop``         client-side connection failure (URLError) before the
+                     request is sent (http_util.http_raw, grpc client)
+``rpc.delay``        sleep ``delay_ms`` before the request is sent
+``rpc.http_error``   synthesized HTTPError(``http_status``) without
+                     reaching the server (application-error path)
+``wire.corrupt``     flip the magic/header bytes of a binary response
+                     frame before decode (broker gather path)
+``segment.slow``     server-side straggler: sleep ``delay_ms`` before
+                     executing (cluster/server_node.py)
+``accounting.oom_kill`` the accountant kills the sampling query as the
+                     HeapWatcher would under heap pressure
+``device.overflow``  force the kernel's compact-overflow retry ladder
+                     (engine/executor.run_kernel) — result-identical
+==================== ======================================================
+
+Activation: ``PINOT_FAULTS`` env var at process start, or
+``install(plan)`` from code / the server's scheduler config
+(``{"fault.plan": "..."}``). Plan grammar (``;``-separated)::
+
+    seed=42; rpc.drop: match=/query/bin, p=0.5, times=1;
+             segment.slow: delay_ms=200, after=1
+
+Per-spec fields: ``p`` fire probability, ``match`` substring filter on
+the site key (server URL, instance id, segment name), ``times`` max
+fires **per site key** (-1 unlimited), ``after`` skip the first N
+matching hits (per key), ``delay_ms``, ``http_status``.
+
+Determinism: a decision is a pure function of
+``hash(seed, point, key, hit_index)`` — per-(spec, key) hit AND fire
+counters mean background traffic (heartbeats, routing polls) and
+thread interleaving across servers cannot perturb another key's
+decision stream (a shared ``times`` budget would let whichever thread
+reaches the lock first consume it), so the same seed over the same
+per-key call sequence fires the same faults. ``accounting.oom_kill``
+is the one point with no natural stable key: it decides on the
+process-global ``""`` stream (``match`` does not apply; sequential
+queries are deterministic, concurrent ones interleave their sample
+counts). Every fired fault is appended to ``plan.fired`` (under the
+plan lock), annotated onto the active span, and counted in
+``global_metrics`` (``faults_fired`` + ``fault_<point>``).
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import threading
+import time
+import urllib.error
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+FAULT_POINTS = (
+    "rpc.drop", "rpc.delay", "rpc.http_error", "wire.corrupt",
+    "segment.slow", "accounting.oom_kill", "device.overflow",
+)
+
+
+class FaultInjected(Exception):
+    """Marker base so call sites/tests can distinguish injected failures
+    that are NOT shaped like a real transport error (transport-shaped
+    faults raise the real urllib exceptions on purpose — the code under
+    test must not be able to tell them apart)."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    point: str
+    prob: float = 1.0
+    match: str = ""          # substring of the site key; "" matches all
+    times: int = -1          # max fires per site key; -1 = unlimited
+    after: int = 0           # skip the first N matching hits (per key)
+    delay_ms: float = 0.0
+    http_status: int = 503
+
+    @staticmethod
+    def parse(text: str) -> "FaultSpec":
+        """``point: k=v, k=v`` (the PINOT_FAULTS per-spec grammar)."""
+        head, _, rest = text.partition(":")
+        point = head.strip()
+        if point not in FAULT_POINTS:
+            raise ValueError(f"unknown fault point {point!r}; "
+                             f"have {list(FAULT_POINTS)}")
+        kw: Dict[str, Any] = {}
+        for item in filter(None, (p.strip() for p in rest.split(","))):
+            k, _, v = item.partition("=")
+            k = k.strip()
+            v = v.strip()
+            if k == "p":
+                kw["prob"] = float(v)
+            elif k == "match":
+                kw["match"] = v
+            elif k in ("times", "after", "http_status"):
+                kw[k] = int(v)
+            elif k == "delay_ms":
+                kw[k] = float(v)
+            else:
+                raise ValueError(f"unknown fault field {k!r} in {text!r}")
+        return FaultSpec(point, **kw)
+
+
+def _unit(seed: int, point: str, key: str, hit: int) -> float:
+    """Deterministic uniform [0, 1) — stable across processes/threads."""
+    h = hashlib.sha256(f"{seed}|{point}|{key}|{hit}".encode()).digest()
+    return int.from_bytes(h[:8], "big") / 2.0 ** 64
+
+
+class FaultPlan:
+    """One installed chaos plan: specs + seed + per-(spec, key) hit
+    counters + the fired-fault log."""
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._hits: Dict[Tuple[int, str], int] = {}
+        self._fires: Dict[Tuple[int, str], int] = {}
+        self.fired: List[Dict[str, Any]] = []
+
+    @staticmethod
+    def parse(text: str) -> "FaultPlan":
+        """Full PINOT_FAULTS grammar: ``seed=N; spec; spec; ...``."""
+        seed = 0
+        specs: List[FaultSpec] = []
+        for part in filter(None, (p.strip() for p in text.split(";"))):
+            if part.startswith("seed="):
+                seed = int(part[5:])
+            else:
+                specs.append(FaultSpec.parse(part))
+        return FaultPlan(specs, seed)
+
+    def decide(self, point: str, key: str) -> Optional[FaultSpec]:
+        """First matching spec that fires for this hit, or None. Pure in
+        (seed, point, key, per-key hit index) — see module doc."""
+        fired: Optional[FaultSpec] = None
+        with self._lock:
+            for i, spec in enumerate(self.specs):
+                if spec.point != point:
+                    continue
+                if spec.match and spec.match not in key:
+                    continue
+                hit = self._hits.get((i, key), 0)
+                self._hits[(i, key)] = hit + 1
+                if hit < spec.after:
+                    continue
+                # fire budget is per (spec, key) like the hit counter: a
+                # shared budget would be consumed by whichever thread
+                # reaches the lock first, breaking same-seed determinism
+                if spec.times >= 0 and \
+                        self._fires.get((i, key), 0) >= spec.times:
+                    continue
+                if spec.prob < 1.0 and \
+                        _unit(self.seed, point, key, hit) >= spec.prob:
+                    continue
+                self._fires[(i, key)] = self._fires.get((i, key), 0) + 1
+                self.fired.append({"point": point, "key": key, "hit": hit})
+                fired = spec
+                break
+        return fired
+
+    def fired_summary(self) -> List[Tuple[str, str, int]]:
+        """Order-independent view of the fired log (threads race on
+        append order; (point, key, hit) triples do not)."""
+        with self._lock:
+            return sorted((f["point"], f["key"], f["hit"])
+                          for f in self.fired)
+
+
+_plan: Optional[FaultPlan] = None
+_plan_lock = threading.Lock()
+
+
+def install(plan: Any, seed: Optional[int] = None) -> FaultPlan:
+    """Install a process-global plan: a FaultPlan, a PINOT_FAULTS-grammar
+    string, or a list of FaultSpecs (+ seed)."""
+    global _plan
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    elif isinstance(plan, (list, tuple)):
+        plan = FaultPlan(list(plan), seed or 0)
+    if seed is not None:
+        plan.seed = int(seed)
+    with _plan_lock:
+        _plan = plan
+    return plan
+
+
+def clear() -> None:
+    global _plan
+    with _plan_lock:
+        _plan = None
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def install_from_env(environ: Optional[Dict[str, str]] = None
+                     ) -> Optional[FaultPlan]:
+    import os
+    text = (environ if environ is not None else os.environ) \
+        .get("PINOT_FAULTS")
+    return install(text) if text else None
+
+
+def _record(point: str, key: str, spec: FaultSpec,
+            detail: Optional[str] = None) -> None:
+    from .metrics import global_metrics
+    global_metrics.count("faults_fired")
+    global_metrics.count("fault_" + point.replace(".", "_"))
+    from .spans import add_event, tracing_active
+    if tracing_active():
+        add_event(f"fault:{point}", spec.delay_ms, key=key,
+                  **({"detail": detail} if detail else {}))
+
+
+def fault_fires(point: str, key: str = "",
+                detail: Optional[str] = None) -> bool:
+    """Pure decision hook for sites that implement the effect themselves
+    (device.overflow, accounting.oom_kill)."""
+    plan = _plan
+    if plan is None:
+        return False
+    spec = plan.decide(point, key)
+    if spec is None:
+        return False
+    _record(point, key, spec, detail)
+    return True
+
+
+def fault_point(point: str, key: str = "") -> None:
+    """Raise/sleep per the installed plan at a named point; no-op (one
+    attribute read) when no plan is installed."""
+    plan = _plan
+    if plan is None:
+        return
+    spec = plan.decide(point, key)
+    if spec is None:
+        return
+    _record(point, key, spec)
+    if point in ("rpc.delay", "segment.slow"):
+        time.sleep(spec.delay_ms / 1e3)
+        return
+    if point == "rpc.drop":
+        # shaped like a real connection failure: callers must take the
+        # genuine failover path, not a special injected one
+        raise urllib.error.URLError(
+            OSError(f"injected fault rpc.drop ({key})"))
+    if point == "rpc.http_error":
+        raise urllib.error.HTTPError(
+            key or "http://injected", spec.http_status,
+            "injected fault rpc.http_error", None,
+            io.BytesIO(b"injected fault rpc.http_error"))
+    raise FaultInjected(f"fault point {point} has no inline effect; "
+                        "use fault_fires()/corrupt_bytes()")
+
+
+def rpc_faults(key: str) -> None:
+    """The standard client-side RPC trio in deterministic order (delay
+    first so a delayed call can still be dropped)."""
+    if _plan is None:
+        return
+    fault_point("rpc.delay", key)
+    fault_point("rpc.drop", key)
+    fault_point("rpc.http_error", key)
+
+
+def corrupt_bytes(point: str, key: str, data: bytes) -> bytes:
+    """wire.corrupt effect: XOR the frame magic + header-length prefix so
+    decode fails loudly (never silently wrong — decode_wire_frame checks
+    the magic before trusting anything else)."""
+    plan = _plan
+    if plan is None:
+        return data
+    spec = plan.decide(point, key)
+    if spec is None:
+        return data
+    _record(point, key, spec)
+    head = bytes(b ^ 0xFF for b in data[:8])
+    return head + bytes(data[8:])
+
+
+# activate from the environment at import, like the span tracer's
+# permanently-compiled-in stance: cluster roles import this module, so a
+# PINOT_FAULTS-bearing process is armed before any node starts
+install_from_env()

@@ -90,3 +90,37 @@ def test_segmented_compact_overflow_retry(setup):
         oracle[k] = oracle.get(k, 0) + 1
     got = {(r[0], r[1]): r[2] for r in res.rows}
     assert got == oracle
+
+
+def test_stack_cache_not_fooled_by_recurring_segment_names(tmp_path):
+    """Two tables whose segments share names, column names, and bucket
+    must not share stacked device columns: the batch stack cache keys on
+    the segments' load uid, not the name (a name-only key served the
+    FIRST table's device data to the second table's queries — found by
+    the round-9 chaos soak, where two in-process clusters both named
+    their segments seg_0..seg_3)."""
+    rng = np.random.default_rng(7)
+    results = []
+    for tbl, scale in (("t_first", 1), ("t_second", 1000)):
+        schema = Schema(tbl, [
+            FieldSpec("k", DataType.STRING, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC),
+        ])
+        builder = SegmentBuilder(schema, TableConfig(tbl))
+        dm = TableDataManager(tbl)
+        total = 0
+        for i in range(3):
+            vals = (rng.integers(0, 10, 600) * scale).astype(np.int32)
+            total += int(vals.sum())
+            d = builder.build(
+                {"k": np.array(["x", "y"] * 300), "v": vals},
+                str(tmp_path / tbl), f"seg_{i}")  # same names both tables
+            dm.add_segment_dir(d)
+        b = Broker()
+        b.register_table(dm)
+        res = b.query(f"SELECT k, SUM(v) FROM {tbl} GROUP BY k "
+                      "ORDER BY k OPTION(timeoutMs=300000)")
+        assert sum(r[1] for r in res.rows) == total, \
+            f"{tbl}: stacked columns served another table's data"
+        results.append(res.rows)
+    assert results[0] != results[1]

@@ -1,0 +1,257 @@
+"""Chaos smoke: seeded fault plans over a live 2-server cluster.
+
+The fault-tolerance acceptance gate (tier-1 runs this through
+tests/test_faults.py, alongside check_ledger/check_static): build a
+2-server in-process cluster hosting an SSB-lite ``lineorder`` table
+(4 segments, replication 2) plus a replication-1 twin, capture
+fault-free digests for a small SSB query set, then re-run under seeded
+``PINOT_FAULTS``-grammar plans (utils/faults.py) and assert:
+
+1. ``rpc.drop`` of server_0's first /query/bin dispatch: the broker
+   fails over and every digest is byte-identical to the fault-free run.
+2. ``wire.corrupt`` of server_0's first response frame: decode fails
+   loudly, failover, digests byte-identical.
+3. Sustained ``rpc.drop`` of server_0 against the replication-1 twin:
+   ``allowPartialResults=true`` answers with ``partialResult=true``,
+   populated ``exceptions[]`` and ``numServersResponded <
+   numServersQueried``; the default mode fails whole-query.
+
+Prints one summary JSON line last, check_ledger-style; exit 0 when all
+assertions hold.
+
+    python tools/chaos_smoke.py [--rows N] [--seed N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SMOKE_QUERY_IDS = ("q1.1", "q2.1", "q3.2", "q4.1")
+OPTION = " OPTION(timeoutMs=300000)"
+
+
+def smoke_queries(qids=SMOKE_QUERY_IDS):
+    """(qid, sql) for the smoke subset of the SSB suite."""
+    import bench
+    by_id = {q[0]: q for q in bench.QUERIES}
+    out = []
+    for qid in qids:
+        _, preds, vexpr, gcols = by_id[qid]
+        out.append((qid, bench.spec_to_sql(preds, vexpr, gcols)))
+    return out
+
+
+def build_ssb_cluster(tmp: str, rows: int = 4096, n_segments: int = 4,
+                      poll: float = 0.1):
+    """Controller + 2 servers + broker over an SSB-lite ``lineorder``
+    (replication 2) and a ``lineorder_r1`` twin (replication 1) built
+    from the same segment directories. Returns (ctrl, servers, broker,
+    stop)."""
+    import numpy as np
+
+    import bench
+    from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.segment.builder import Categorical
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    cols = bench.gen_columns(rows)
+    fields = []
+    for name, v in cols.items():
+        if name.startswith("lo_") and name not in ("lo_quantity",
+                                                   "lo_discount"):
+            fields.append(FieldSpec(name, DataType.INT, FieldType.METRIC))
+        elif isinstance(v, np.ndarray):
+            fields.append(FieldSpec(name, DataType.INT,
+                                    FieldType.DIMENSION))
+        else:
+            fields.append(FieldSpec(name, DataType.STRING,
+                                    FieldType.DIMENSION))
+
+    ctrl = Controller(os.path.join(tmp, "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=0.2)
+    servers = [ServerNode(f"server_{i}", ctrl.url, poll_interval=poll)
+               for i in range(2)]
+    broker = BrokerNode(ctrl.url, routing_refresh=poll)
+
+    for table, replication in (("lineorder", 2), ("lineorder_r1", 1)):
+        schema = Schema(table, fields)
+        builder = SegmentBuilder(schema, TableConfig(table))
+        ctrl.add_table(table, schema.to_dict(), replication=replication)
+        step = rows // n_segments
+        for i in range(n_segments):
+            lo, hi = i * step, rows if i == n_segments - 1 \
+                else (i + 1) * step
+            part = {n: (Categorical(v.codes[lo:hi], v.values)
+                        if isinstance(v, Categorical) else v[lo:hi])
+                    for n, v in cols.items()}
+            d = builder.build(part, os.path.join(tmp, table), f"seg_{i}")
+            ctrl.add_segment(table, f"seg_{i}", d)
+
+    v = ctrl.routing_snapshot()["version"]
+    for s in servers:
+        assert s.wait_for_version(v, timeout=30.0), "server never synced"
+    assert broker.wait_for_version(v, timeout=30.0), "broker never synced"
+
+    def stop():
+        broker.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        ctrl.stop()
+
+    return ctrl, servers, broker, stop
+
+
+def digest(resp: dict):
+    import bench
+    return bench._digest([tuple(r) for r in resp["resultTable"]["rows"]])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--seed", type=int, default=20260804)
+    ap.add_argument("--queries", default=",".join(SMOKE_QUERY_IDS),
+                    help="comma-separated SSB qids (tier-1 runs a "
+                         "2-query subset to protect the suite budget)")
+    args = ap.parse_args(argv)
+
+    from pinot_tpu.cluster.http_util import http_json
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils.metrics import global_metrics
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_chaos_")
+    failures = []
+    summary = {"rows": args.rows, "seed": args.seed, "plans": 0,
+               "queries": 0, "faults_fired": 0}
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+            print(f"FAIL {name}: {detail}")
+
+    faults.clear()
+    ctrl, servers, broker, stop = build_ssb_cluster(tmp, args.rows)
+    try:
+        queries = smoke_queries(tuple(args.queries.split(",")))
+
+        def run_all():
+            out = {}
+            for qid, sql in queries:
+                # generous CLIENT timeout: the first query pays the XLA
+                # compile (the broker-side budget is OPTION(timeoutMs))
+                resp = http_json("POST", f"{broker.url}/query/sql",
+                                 {"sql": sql + OPTION}, timeout=120.0)
+                out[qid] = digest(resp)
+            return out
+
+        baseline = run_all()
+        summary["queries"] = len(baseline)
+        p0 = servers[0].port
+
+        # plan 1: drop server_0's first data-plane dispatch per key
+        for plan_name, plan_text in (
+                ("rpc.drop",
+                 f"seed={args.seed}; "
+                 f"rpc.drop: match=:{p0}/query/bin, times=1"),
+                ("wire.corrupt",
+                 f"seed={args.seed}; wire.corrupt: match=server_0, "
+                 "times=1")):
+            # clear the previous plan's failure backoff so the selector
+            # dials server_0 again and this plan's fault actually fires
+            for s in servers:
+                broker._failures.record_success(s.instance_id)
+            c0 = global_metrics.snapshot()["counters"]
+            plan = faults.install(plan_text)
+            try:
+                got = run_all()
+            finally:
+                faults.clear()
+            summary["plans"] += 1
+            summary["faults_fired"] += len(plan.fired)
+            check(f"{plan_name}.fired", len(plan.fired) >= 1,
+                  "fault never fired")
+            c1 = global_metrics.snapshot()["counters"]
+            check(f"{plan_name}.failover",
+                  c1.get("scatter_failovers", 0)
+                  > c0.get("scatter_failovers", 0),
+                  "no failover recorded")
+            for qid in baseline:
+                check(f"{plan_name}.{qid}", got[qid] == baseline[qid],
+                      "digest mismatch after failover")
+
+        # plan 3: replication-1 twin, server_0 permanently dropped —
+        # the partial-result metadata contract
+        plan = faults.install(
+            f"seed={args.seed}; rpc.drop: match=:{p0}/query/bin")
+        try:
+            sql = ("SELECT d_year, SUM(lo_revenue) FROM lineorder_r1 "
+                   "GROUP BY d_year ORDER BY d_year LIMIT 100 "
+                   "OPTION(timeoutMs=300000,allowPartialResults=true)")
+            resp = http_json("POST", f"{broker.url}/query/sql",
+                             {"sql": sql}, timeout=120.0)
+            summary["plans"] += 1
+            summary["faults_fired"] += len(plan.fired)
+            check("partial.flag", resp.get("partialResult") is True,
+                  f"partialResult={resp.get('partialResult')}")
+            check("partial.exceptions",
+                  len(resp.get("exceptions", [])) >= 1, "no exceptions[]")
+            check("partial.servers",
+                  resp.get("numServersResponded", 0)
+                  < resp.get("numServersQueried", 0),
+                  f"{resp.get('numServersResponded')} !< "
+                  f"{resp.get('numServersQueried')}")
+            # default mode: whole-query failure
+            import urllib.error
+            try:
+                http_json("POST", f"{broker.url}/query/sql", {
+                    "sql": "SELECT SUM(lo_revenue) FROM lineorder_r1 "
+                           "OPTION(timeoutMs=300000)"}, timeout=120.0)
+                check("partial.default_fails", False,
+                      "default mode returned despite dead replica")
+            except urllib.error.HTTPError:
+                pass
+        finally:
+            faults.clear()
+
+        # recovery: fault-free digests once more (detector backoffs heal).
+        # Any failure mode must land in the summary JSON, never a raw
+        # traceback past the last print
+        import time
+        recovered = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not recovered:
+            try:
+                recovered = run_all() == baseline
+            except urllib.error.HTTPError:
+                pass
+            if not recovered:
+                time.sleep(0.5)
+        check("recovery", recovered,
+              "cluster did not recover fault-free digests within 30s")
+    finally:
+        faults.clear()
+        stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
