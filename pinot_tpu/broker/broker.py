@@ -25,6 +25,7 @@ from ..query.sql import (Comparison, CteDef, DdlStmt, Exists, InList,
                          InSubquery, Literal, ScalarSubquery, SelectStmt,
                          SetOpStmt, SqlError, map_expr, parse_sql)
 from ..server.data_manager import TableDataManager
+from ..utils import phases as ph
 from ..utils.metrics import global_metrics
 from ..utils.trace import Tracing
 
@@ -280,12 +281,12 @@ class Broker:
         additionally appends the tree as a v2 ``query_trace`` ledger
         record (utils/ledger.py)."""
         from ..ops.plan_cache import global_plan_cache
-        from ..query.explain import explain_analyze_rows
+        from ..query.explain import finalize_analyze
         from ..utils.spans import span_tracer
 
         stmt.analyze = False  # the re-entrant call executes normally
         cache0 = global_plan_cache.stats()
-        root = span_tracer.start("query",
+        root = span_tracer.start(ph.QUERY,
                                  table=getattr(stmt, "table", None))
         try:
             inner = self._execute_stmt(stmt, t0)
@@ -299,20 +300,15 @@ class Broker:
             cache_hits=cache1["hits"] - cache0["hits"],
             cache_misses=cache1["misses"] - cache0["misses"],
             retraces=cache1["retraces"] - cache0["retraces"])
-        # explicit self-time child: phase timings must sum to the wall
-        # time of the query, with broker bookkeeping (context build,
-        # quota, accountant registration) attributed, not hidden
-        overhead = root.duration_ms - root.children_ms()
-        if overhead > 0:
-            from ..utils.spans import Span
-            s = Span("broker_overhead")
-            s.duration_ms = overhead
-            root.children.append(s)
-        cols, rows = explain_analyze_rows(root)
+        # finalize_analyze attaches the explicit broker_overhead
+        # self-time child (context build, quota, accountant
+        # registration) so phase timings sum to the query's wall time —
+        # shared with the cluster broker's _query_analyze
+        cols, rows, trace = finalize_analyze(root)
         result = ResultTable(cols, rows,
                              num_segments=inner.num_segments,
                              num_docs_scanned=inner.num_docs_scanned)
-        result.trace = {"spans": root.to_dict()}
+        result.trace = trace
         if _truthy(stmt.options.get("ledgerTrace")):
             import os
 
@@ -728,8 +724,8 @@ class Broker:
         from ..utils.spans import span
         if dm.distributed is not None and ctx.is_aggregation \
                 and not stmt.explain:
-            with Tracing.phase("distributed_execute"), \
-                    span("distributed_execute"):
+            with Tracing.phase(ph.DISTRIBUTED_EXECUTE), \
+                    span(ph.DISTRIBUTED_EXECUTE):
                 partial = dm.distributed.try_execute(ctx)
             if partial is not None:
                 result = reduce_partials(ctx, [partial])
@@ -776,8 +772,8 @@ class Broker:
             raise QueryTimeoutError(
                 f"query timed out (>{int((deadline - t0) * 1e3)}ms)")
 
-        with Tracing.phase("reduce"), span("reduce",
-                                           partials=len(partials)):
+        with Tracing.phase(ph.REDUCE), span(ph.REDUCE,
+                                          partials=len(partials)):
             result = reduce_partials(ctx, partials)
         result.num_segments = len(segments)
         result.num_segments_pruned = ex.pruned

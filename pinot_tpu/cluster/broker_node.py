@@ -16,6 +16,7 @@ import itertools
 import threading
 import time
 import urllib.error
+import uuid
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -24,8 +25,12 @@ from ..engine.reduce import ResultTable, reduce_partials
 
 from ..query.context import build_query_context
 from ..query.sql import SetOpStmt, SqlError, parse_sql, to_sql
+from ..utils import phases as ph
 from ..utils.metrics import global_metrics
-from .http_util import JsonHandler, http_json, http_raw, start_http
+from ..utils.spans import Span, span, span_tracer
+from .forensics import QueryForensics, parse_slow_query_ms
+from .http_util import (JsonHandler, http_json, http_raw,
+                        inject_trace_context, start_http)
 
 # pinot-common QueryException error-code analogs (the exceptions[] wire
 # contract the webapp/console already renders)
@@ -67,7 +72,9 @@ class _SegmentShortfall(Exception):
 @dataclass
 class ScatterResult:
     """One scatter-gather's partials + the health metadata the response
-    envelope carries (BrokerResponseNative analog)."""
+    envelope carries (BrokerResponseNative analog). failovers/hedges are
+    the PER-QUERY counts (global_metrics keeps the process-wide totals)
+    so the forensics plane can write per-query trend lines."""
     partials: List[Any] = field(default_factory=list)
     segments_queried: int = 0
     pruned: int = 0
@@ -75,6 +82,13 @@ class ScatterResult:
     servers_responded: int = 0
     exceptions: List[Dict[str, Any]] = field(default_factory=list)
     partial: bool = False
+    failovers: int = 0
+    hedges: int = 0
+    # failovers increments from call() on POOL threads — int += is a
+    # non-atomic read-modify-write (the same race _rr hit before its
+    # itertools.count fix), so it mutates under this lock
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
 
 class FailureDetector:
@@ -119,11 +133,17 @@ class FailureDetector:
 class BrokerNode:
     def __init__(self, controller_url: str, port: int = 0,
                  routing_refresh: float = 0.3,
-                 instance_selector: str = "balanced"):
+                 instance_selector: str = "balanced",
+                 slow_query_ms: Optional[float] = None,
+                 query_stats_path: Optional[str] = None):
         from ..broker.quota import QueryQuotaManager
         from ..broker.routing import make_selector
         self.controller_url = controller_url
         self.routing_refresh = routing_refresh
+        # forensics plane: slow-query ring (GET /debug/queries) + the
+        # optional per-query query_stats ledger (chaos soak trend lines)
+        self.forensics = QueryForensics(slow_query_ms=slow_query_ms,
+                                        ledger_path=query_stats_path)
         self._routing: Dict[str, Any] = {"version": -1}
         # round-robin cursor for explain/failover re-picks. An itertools
         # counter, not an int += 1: _pick_replica runs on pool threads
@@ -232,8 +252,32 @@ class BrokerNode:
                 "view DDL runs on the in-process broker (views are "
                 "broker-local state; the networked broker carries no "
                 "catalog yet)")
+        # validate the forensics option up front (400-class, pre-dispatch)
+        slow_ms = parse_slow_query_ms(getattr(stmt, "options", {}) or {},
+                                      self.forensics.default_slow_ms)
+        if getattr(stmt, "analyze", False):
+            return self._query_analyze(stmt, sql, t0, slow_ms)
+        qid = uuid.uuid4().hex[:12]
+        scatters: List[ScatterResult] = []
+        table = getattr(stmt, "table", None)
+        try:
+            result = self._query_stmt(stmt, sql, t0, qid, scatters)
+        except SqlError as e:
+            self.forensics.record(qid, table, sql, t0, None, scatters,
+                                  slow_ms, error=e)
+            raise
+        self.forensics.record(qid, table, sql, t0, result, scatters,
+                              slow_ms)
+        return result
+
+    def _query_stmt(self, stmt, sql: str, t0: float, qid: str,
+                    scatters: List["ScatterResult"]) -> ResultTable:
+        """One statement through routing/scatter/reduce. ``scatters``
+        collects every ScatterResult this statement dispatched so the
+        caller (forensics, EXPLAIN ANALYZE) sees per-query hedge and
+        failover counts."""
         if isinstance(stmt, SetOpStmt):
-            return self._query_setop(stmt, t0)
+            return self._query_setop(stmt, t0, qid, scatters)
         from ..multistage.window import has_window
         if stmt.joins or has_window(stmt):
             raise SqlError("multi-stage joins/windows over the remote data "
@@ -253,24 +297,69 @@ class BrokerNode:
         if stmt.table not in snap_tables and \
                 f"{stmt.table}_OFFLINE" in snap_tables and \
                 f"{stmt.table}_REALTIME" in snap_tables:
-            return self._query_hybrid(stmt, t0, snap, deadline)
+            return self._query_hybrid(stmt, t0, snap, deadline, qid,
+                                      scatters)
 
         self._check_quota(stmt.table, snap)
         ctx = build_query_context(stmt)
-        if getattr(stmt, "analyze", False):
-            # span scopes are per-process; the scatter-gather data plane
-            # would lose the servers' trees — analyze locally instead
-            raise SqlError("EXPLAIN ANALYZE is supported on the "
-                           "in-process broker only (run the query "
-                           "against a local Broker)")
         if stmt.explain:
             return self._explain_remote(sql, ctx.table, deadline)
-        sc = self._scatter(sql, ctx, snap, deadline)
-        result = reduce_partials(ctx, sc.partials)
+        sc = self._scatter(sql, ctx, snap, deadline, qid)
+        scatters.append(sc)
+        with span(ph.REDUCE, partials=len(sc.partials)):
+            result = reduce_partials(ctx, sc.partials)
         result.num_segments = sc.segments_queried
         result.num_segments_pruned = sc.pruned
         self._attach_scatter_meta(result, [sc])
         result.time_ms = (time.perf_counter() - t0) * 1e3
+        return result
+
+    # -- EXPLAIN ANALYZE over the cluster plane (round-10 tentpole) --------
+    def _query_analyze(self, stmt, sql: str, t0: float,
+                       slow_ms: float) -> ResultTable:
+        """Execute the statement for real under the span tracer, with
+        cross-node propagation: every scatter call carries a sampled
+        trace context, each server roots a remote span tree around its
+        executor, and the broker stitches the trees — hedges, failovers
+        and error branches included — under the scatter_call spans that
+        dispatched them. Renders the same Node/Id/Parent/Time_Ms rows
+        as the in-process broker (query/explain.py); the gap between a
+        call span and its server_query child is the network +
+        serialization cost (``net_ms``)."""
+        from ..query.explain import finalize_analyze
+        stmt.analyze = False  # the re-entrant path executes normally
+        qid = uuid.uuid4().hex[:12]
+        table = getattr(stmt, "table", None)
+        scatters: List[ScatterResult] = []
+        root = span_tracer.start(ph.QUERY, table=table, query_id=qid)
+        err: Optional[SqlError] = None
+        inner: Optional[ResultTable] = None
+        try:
+            inner = self._query_stmt(stmt, sql, t0, qid, scatters)
+        except SqlError as e:
+            err = e
+        finally:
+            root = span_tracer.stop() or root
+        if err is not None:
+            # the partial tree still reaches the forensics ring: a failed
+            # analyze is exactly when the spans are wanted
+            self.forensics.record(qid, table, sql, t0, None, scatters,
+                                  slow_ms, trace=root, error=err)
+            raise err
+        root.annotate(rows=len(inner.rows),
+                      servers_queried=inner.num_servers_queried,
+                      servers_responded=inner.num_servers_responded,
+                      partial=inner.partial_result or None)
+        cols, rows, trace = finalize_analyze(root)
+        result = ResultTable(cols, rows, num_segments=inner.num_segments)
+        result.trace = trace
+        result.partial_result = inner.partial_result
+        result.num_servers_queried = inner.num_servers_queried
+        result.num_servers_responded = inner.num_servers_responded
+        result.exceptions = list(inner.exceptions)
+        result.time_ms = (time.perf_counter() - t0) * 1e3
+        self.forensics.record(qid, table, sql, t0, result, scatters,
+                              slow_ms, trace=root)
         return result
 
     @staticmethod
@@ -287,7 +376,10 @@ class BrokerNode:
             global_metrics.count("scatter_partial_responses")
 
     def _query_hybrid(self, stmt, t0: float, snap: Dict[str, Any],
-                      deadline: Optional[float] = None) -> ResultTable:
+                      deadline: Optional[float] = None,
+                      qid: Optional[str] = None,
+                      scatters_out: Optional[List["ScatterResult"]] = None
+                      ) -> ResultTable:
         from ..broker.routing import (resolve_time_column, split_hybrid,
                                       time_boundary)
         logical = stmt.table
@@ -313,9 +405,15 @@ class BrokerNode:
         for part_stmt in (off, rt):
             ctx_p = build_query_context(part_stmt)
             scatters.append(
-                self._scatter(to_sql(part_stmt), ctx_p, snap, deadline))
-        result = reduce_partials(build_query_context(off),
-                                 [p for s in scatters for p in s.partials])
+                self._scatter(to_sql(part_stmt), ctx_p, snap, deadline,
+                              qid))
+        if scatters_out is not None:
+            scatters_out.extend(scatters)
+        with span(ph.REDUCE,
+                  partials=sum(len(s.partials) for s in scatters)):
+            result = reduce_partials(
+                build_query_context(off),
+                [p for s in scatters for p in s.partials])
         result.num_segments = sum(s.segments_queried for s in scatters)
         result.num_segments_pruned = sum(s.pruned for s in scatters)
         self._attach_scatter_meta(result, scatters)
@@ -400,12 +498,21 @@ class BrokerNode:
 
     def _scatter(self, sql: str, ctx,
                  snap: Optional[Dict[str, Any]] = None,
-                 deadline: Optional[float] = None) -> ScatterResult:
+                 deadline: Optional[float] = None,
+                 qid: Optional[str] = None) -> ScatterResult:
         # one snapshot for assignment + segment metadata: the refresh
         # thread swaps self._routing, and mixing two snapshots could
         # silently drop segments assigned in one but absent in the other
         if snap is None:
             snap = self._snapshot()
+        # tracing: when this query runs under the span tracer (EXPLAIN
+        # ANALYZE rooted a tree on THIS thread), every dispatch attempt
+        # gets a scatter_call span. call() runs on pool threads, so the
+        # spans are built explicitly and collected here (list.append is
+        # GIL-atomic), then stitched under the scatter span start-ordered
+        collect: Optional[List[Span]] = \
+            [] if span_tracer.active() else None
+        sampled = collect is not None
         assignment = snap.get("assignment", {}).get(ctx.table)
         if assignment is None:
             raise SqlError(f"table {ctx.table!r} not found in routing")
@@ -458,10 +565,30 @@ class BrokerNode:
             return None if deadline is None \
                 else deadline - time.perf_counter()
 
-        def call(server: str, segs: List[str], retry: bool = True):
+        def attempt_span(server: str, segs: List[str],
+                         attempt: str) -> Optional[Span]:
+            if collect is None:
+                return None
+            # every later-written key is pre-seeded (None renders as
+            # absent): an ABANDONED straggler may annotate from its pool
+            # thread while the broker thread renders the tree, and value
+            # overwrites of existing keys never resize the attrs dict
+            # under that iteration (a fresh key insertion could)
+            s = Span(ph.SCATTER_CALL, server=server, segments=len(segs),
+                     attempt=attempt, span_id=uuid.uuid4().hex[:8],
+                     status=None, error=None, net_ms=None)
+            collect.append(s)
+            return s
+
+        def call(server: str, segs: List[str], retry: bool = True,
+                 attempt: str = "primary"):
             url = self._server_url(server)
+            sp = attempt_span(server, segs, attempt)
             rem = remaining()
             if rem is not None and rem <= 0:
+                if sp is not None:
+                    sp.finish()
+                    sp.annotate(status="deadline")
                 raise ScatterTimeoutError(
                     f"query deadline exhausted before dispatch to "
                     f"{server}")
@@ -472,6 +599,15 @@ class BrokerNode:
                 from ..engine.datablock import decode_wire_frame
                 from ..utils.faults import corrupt_bytes
                 body = {"sql": sql, "segments": segs}
+                if qid is not None or sampled:
+                    # cross-node trace context: query id + sampled flag
+                    # + the dispatching span, so the server's remote
+                    # tree stitches back under THIS attempt
+                    inject_trace_context(
+                        body, query_id=qid, sampled=sampled,
+                        parent_span_id=None if sp is None
+                        else sp.attrs["span_id"],
+                        remaining_ms=None if rem is None else rem * 1e3)
                 if rem is not None:
                     # the server clamps its accountant deadline to
                     # min(its own timeoutMs, this remaining budget)
@@ -488,6 +624,18 @@ class BrokerNode:
                         f"requested segments (still loading after a "
                         f"reassignment?)")
                 self._failures.record_success(server)
+                if sp is not None:
+                    sp.finish()
+                    remote = header.get("trace")
+                    if remote:
+                        rt = Span.from_dict(remote)
+                        sp.children.append(rt)
+                        # the gap between this call span and the remote
+                        # root is network + serialization time
+                        sp.annotate(net_ms=round(
+                            max(sp.duration_ms - rt.duration_ms, 0.0),
+                            3))
+                    sp.annotate(status="ok")
                 return {"partials": decoded, "segmentsQueried": n_run,
                         "dispatched": [server], "responders": [server]}
             except urllib.error.HTTPError as e:
@@ -498,16 +646,29 @@ class BrokerNode:
                     detail = e.read().decode()[:200]
                 except Exception:
                     detail = str(e)
+                if sp is not None:
+                    sp.finish()
+                    sp.annotate(status="rejected", error=detail)
                 raise SqlError(f"server {server} rejected query: "
                                f"{detail}") from None
             except (ScatterTimeoutError, SqlError):
+                if sp is not None and sp.duration_ms == 0.0:
+                    sp.finish()
                 raise
-            except Exception:
+            except Exception as e:
                 self._failures.record_failure(server)
+                # finish the attempt span NOW: the failover recursion
+                # below gets its own spans, not this one's tail
+                if sp is not None:
+                    sp.finish()
+                    sp.annotate(status="failed",
+                                error=f"{type(e).__name__}: {e}"[:200])
                 if not retry:
                     raise
                 # failover: re-pick replicas per segment, one retry
                 global_metrics.count("scatter_failovers")
+                with res._lock:
+                    res.failovers += 1
                 regrouped: Dict[str, List[str]] = {}
                 for seg in segs:
                     holders = [h for h in assignment.get(seg, [])
@@ -524,7 +685,7 @@ class BrokerNode:
                 out = {"partials": [], "segmentsQueried": 0,
                        "dispatched": [server], "responders": []}
                 for srv, ss in regrouped.items():
-                    r = call(srv, ss, retry=False)
+                    r = call(srv, ss, retry=False, attempt="failover")
                     out["partials"].extend(r["partials"])
                     out["segmentsQueried"] += r["segmentsQueried"]
                     out["dispatched"].extend(r["dispatched"])
@@ -535,8 +696,22 @@ class BrokerNode:
                     self._selector.record_end(
                         server, (time.perf_counter() - tcall) * 1e3)
 
-        self._gather(hedge_opt, assignment, by_server, call, res,
-                     remaining, allow_partial)
+        with span(ph.SCATTER, table=ctx.table, servers=len(by_server),
+                  segments=sum(len(s) for s in by_server.values())
+                  ) as sc_span:
+            try:
+                self._gather(hedge_opt, assignment, by_server, call, res,
+                             remaining, allow_partial)
+            finally:
+                # attach even when the gather raises: a failed analyze
+                # still shows WHICH attempts failed (forensics ring).
+                # Snapshot first — an abandoned straggler can still be
+                # appending its failover attempt from a pool thread, and
+                # list.sort() raises if the list mutates mid-sort
+                if sc_span is not None and collect:
+                    done = list(collect)
+                    done.sort(key=lambda s: s._t0)
+                    sc_span.children.extend(done)
         global_metrics.gauge(
             "scatter_unhealthy_servers",
             sum(1 for s in snap.get("instances", {})
@@ -681,9 +856,11 @@ class BrokerNode:
                 if not ok:
                     continue
                 global_metrics.count("scatter_hedges", len(regrouped))
+                res.hedges += len(regrouped)
                 g["hedge_parts"] = len(regrouped)
                 for srv2, ss in regrouped.items():
-                    f2 = self._pool.submit(call, srv2, ss, False)
+                    f2 = self._pool.submit(call, srv2, ss, False,
+                                           "hedge")
                     fut_info[f2] = (gid, srv2, True)
                     queried.add(srv2)
                     pending.add(f2)
@@ -711,17 +888,25 @@ class BrokerNode:
                          [{"message": "server failed"}])[0]
                 raise SqlError(first["message"])
 
-    def _query_setop(self, stmt: SetOpStmt, t0: float) -> ResultTable:
+    def _query_setop(self, stmt: SetOpStmt, t0: float,
+                     qid: Optional[str] = None,
+                     scatters: Optional[List["ScatterResult"]] = None
+                     ) -> ResultTable:
         """Set operations over the remote data plane: run each branch as
         its own scatter-gather (rendered back to SQL), combine at this
         broker — the same multiset merge the in-process broker uses.
         The compound's timeoutMs is ONE budget: each branch gets the
-        remaining slice, not a fresh full allowance."""
+        remaining slice, not a fresh full allowance. Branches run
+        through _query_stmt, NOT self.query: the compound is ONE user
+        query and writes ONE query_stats record — with the branch
+        scatters' hedge/failover counts — not one per branch."""
         from ..engine.reduce import DEFAULT_LIMIT
         from ..engine.setops import combine_setop, order_limit_rows
 
         timeout_ms = _parse_timeout_ms(stmt.options)
         deadline = t0 + timeout_ms / 1e3
+        qid = qid or uuid.uuid4().hex[:12]
+        scatters = scatters if scatters is not None else []
         branches: List[ResultTable] = []  # leaf results carry the
         # scatter metadata combine_setop's fresh tables would drop
 
@@ -737,7 +922,9 @@ class BrokerNode:
                 max(remaining_ms, 1))
             if node.limit is None:
                 node.limit = 1 << 31
-            out = self.query(to_sql(node))
+            branch_sql = to_sql(node)
+            out = self._query_stmt(parse_sql(branch_sql), branch_sql,
+                                   time.perf_counter(), qid, scatters)
             branches.append(out)
             return out
 
@@ -789,6 +976,15 @@ class BrokerNode:
             except SqlError as e:
                 return 400, {"error": str(e)}
 
+        def debug_queries(h, b):
+            # GET /debug/queries[?n=K]: the slow-query/forensics ring
+            from urllib.parse import parse_qs, urlparse
+            try:
+                limit = int(parse_qs(urlparse(h.path).query)["n"][0])
+            except (KeyError, ValueError, IndexError):
+                limit = None
+            return 200, node.forensics.snapshot(limit)
+
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
@@ -796,6 +992,7 @@ class BrokerNode:
                     200, ("text/plain", global_metrics.prometheus())),
                 ("GET", "/metrics"): lambda h, b: (
                     200, node.scatter_health()),
+                ("GET", "/debug/queries"): debug_queries,
                 ("GET", "/ui"): lambda h, b: (
                     200, ("text/html", node.ui_page())),
                 ("POST", "/query/sql"): q,
@@ -822,12 +1019,16 @@ class BrokerNode:
  #warn{color:#ea3;white-space:pre-wrap}
  #scatter{color:#789;margin-top:1.5em;font-size:.85em;
    border-top:1px solid #333;padding-top:.5em}
+ #slowq{color:#a96;margin-top:.5em;font-size:.85em;
+   border-top:1px solid #333;padding-top:.5em}
+ #slowq td{border:1px solid #333;font-size:1em}
 </style></head><body>
 <h2>pinot-tpu query console</h2>
 <textarea id=sql>SELECT * FROM mytable LIMIT 10</textarea><br>
 <button onclick=run()>Run (Ctrl-Enter)</button>
 <div id=stats></div><div id=warn></div><div id=err></div><div id=out></div>
 <div id=scatter></div>
+<div id=slowq></div>
 <script>
 const esc=s=>String(s).replace(/[&<>"']/g,
   c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
@@ -884,7 +1085,25 @@ async function health(){
       ' — '+srv;
   }catch(e){}
 }
-health();setInterval(health,3000);
+async function slowq(){
+  try{
+    const d=await (await fetch('/debug/queries?n=5')).json();
+    if(!d.count){
+      document.getElementById('slowq').textContent=
+        'forensics: no slow queries (threshold '+d.slowQueryMs+' ms)';
+      return;
+    }
+    let h='forensics (slowest-recent, threshold '+d.slowQueryMs+
+      ' ms):<table><tr><th>qid</th><th>wall ms</th><th>table</th>'+
+      '<th>partial</th><th>sql</th></tr>';
+    for(const e of d.queries)
+      h+='<tr><td>'+esc(e.qid)+'</td><td>'+e.wall_ms+'</td><td>'+
+        esc(e.table)+'</td><td>'+(e.partial?'YES':'no')+'</td><td>'+
+        esc((e.sql||'').slice(0,120))+'</td></tr>';
+    document.getElementById('slowq').innerHTML=h+'</table>';
+  }catch(e){}
+}
+health();slowq();setInterval(health,3000);setInterval(slowq,3000);
 </script></body></html>"""
 
     def stop(self) -> None:

@@ -1,0 +1,143 @@
+"""Query forensics plane: slow-query ring + per-query stats ledger.
+
+Reference parity: the reference's broker query log (BaseBrokerRequest
+Handler logs table/timeMs/exceptions per request, rate-limited) and the
+/debug/... admin endpoints, collapsed to one broker-side object:
+
+- every completed cluster query builds a VALIDATED ``query_stats``
+  ledger record (utils/ledger.py kind: wall ms, partialResult,
+  exceptions[] codes, hedge/failover counts, servers queried vs
+  responded) and appends it to the configured stats ledger, so chaos
+  soaks (tools/chaos_smoke.py) produce per-query trend lines instead of
+  only aggregate counters;
+- queries that were slow (``OPTION(slowQueryMs=...)`` or the broker
+  default), errored, or carried a stitched trace (EXPLAIN ANALYZE) also
+  enter a bounded ring buffer served at ``GET /debug/queries`` and
+  rendered by the /ui console + controller webapp.
+
+The ring is the one deliberately host-synchronous piece (a deque under
+a lock, mutated per query) — it lives on the broker's HTTP path, never
+inside kernels.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..query.sql import SqlError
+from ..utils import ledger as uledger
+from ..utils.metrics import global_metrics
+
+DEFAULT_SLOW_QUERY_MS = 500.0
+RING_CAPACITY = 128
+
+
+def parse_slow_query_ms(options: Dict[str, Any],
+                        default_ms: float) -> float:
+    """Validate OPTION(slowQueryMs=...) up front — a bad value must be a
+    400-class SqlError BEFORE any work is dispatched, not a ValueError
+    after the scatter already ran."""
+    raw = options.get("slowQueryMs")
+    if raw is None:
+        return default_ms
+    try:
+        return max(float(raw), 0.0)
+    except (TypeError, ValueError):
+        raise SqlError(f"invalid slowQueryMs value {raw!r}; "
+                       "expected a number of milliseconds") from None
+
+
+class QueryForensics:
+    """Per-broker forensics state: the slow-query ring and the optional
+    query_stats ledger sink."""
+
+    def __init__(self, slow_query_ms: Optional[float] = None,
+                 ledger_path: Optional[str] = None,
+                 capacity: int = RING_CAPACITY):
+        env_slow = os.environ.get("PINOT_SLOW_QUERY_MS")
+        self.default_slow_ms = float(
+            slow_query_ms if slow_query_ms is not None
+            else env_slow if env_slow is not None
+            else DEFAULT_SLOW_QUERY_MS)
+        self.ledger_path = (ledger_path
+                            or os.environ.get("PINOT_QUERY_STATS_LEDGER")
+                            or None)
+        self.stats_written = 0
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    # -- recording ---------------------------------------------------------
+    def record(self, qid: str, table: Optional[str], sql: str, t0: float,
+               result: Optional[Any], scatters: List[Any],
+               slow_ms: Optional[float] = None,
+               trace: Optional[Any] = None,
+               error: Optional[BaseException] = None) -> Dict[str, Any]:
+        """Build + validate the query_stats record for one completed (or
+        failed) cluster query; append it to the stats ledger when one is
+        configured, and admit slow/errored/traced queries to the ring.
+        Returns the validated record."""
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        threshold = self.default_slow_ms if slow_ms is None else slow_ms
+        slow = wall_ms >= threshold
+        fields: Dict[str, Any] = {
+            "qid": qid,
+            "table": table or "<compound>",
+            "wall_ms": round(wall_ms, 3),
+            "partial": bool(getattr(result, "partial_result", False)),
+            "servers_queried": int(
+                getattr(result, "num_servers_queried", 0) or 0),
+            "servers_responded": int(
+                getattr(result, "num_servers_responded", 0) or 0),
+            "exception_codes": sorted({
+                int(e.get("errorCode", 0))
+                for e in getattr(result, "exceptions", []) or []}),
+            "sql": sql,
+            "hedges": sum(getattr(s, "hedges", 0) for s in scatters),
+            "failovers": sum(getattr(s, "failovers", 0)
+                             for s in scatters),
+        }
+        if result is not None:
+            fields["rows"] = len(result.rows)
+            fields["segments_queried"] = result.num_segments
+            fields["segments_pruned"] = result.num_segments_pruned
+        if slow:
+            fields["slow"] = True
+        if error is not None:
+            fields["error"] = str(error)[:300]
+        rec = uledger.make_record("query_stats", **fields)
+        if self.ledger_path:
+            try:
+                uledger.append_record(rec, self.ledger_path)
+                with self._lock:
+                    self.stats_written += 1
+            except OSError:
+                # observability must never fail the data path: a full
+                # disk / missing directory drops the record, counted so
+                # the loss is visible (the record itself was VALIDATED
+                # above — schema bugs still surface loudly)
+                global_metrics.count("query_stats_write_errors")
+        if slow or error is not None or trace is not None:
+            entry = dict(rec)
+            if trace is not None:
+                entry["trace"] = (trace.to_dict()
+                                  if hasattr(trace, "to_dict") else trace)
+            with self._lock:
+                self._ring.append(entry)
+        return rec
+
+    # -- serving -----------------------------------------------------------
+    def snapshot(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """GET /debug/queries payload: newest first."""
+        with self._lock:
+            entries = list(self._ring)
+        entries.reverse()
+        if limit is not None:
+            entries = entries[:max(limit, 0)]
+        return {"slowQueryMs": self.default_slow_ms,
+                "statsLedger": self.ledger_path,
+                "statsWritten": self.stats_written,
+                "count": len(entries),
+                "queries": entries}

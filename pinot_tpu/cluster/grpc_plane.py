@@ -60,7 +60,8 @@ class _Handlers(grpc.GenericRpcHandler):
         from ..engine.datablock import encode_partial
         req = json.loads(request)
         resp = self.node.execute(req["sql"], req.get("segments"),
-                                 deadline_ms=req.get("deadlineMs"))
+                                 deadline_ms=req.get("deadlineMs"),
+                                 trace_ctx=req.get("traceContext"))
         partials = resp.pop("partials_raw", [])
         for p in partials:
             yield encode_partial(p)
@@ -90,9 +91,12 @@ def start_grpc(node, port: int = 0) -> Tuple[grpc.Server, int]:
 def submit_stream(target: str, sql: str,
                   segments: Optional[List[str]] = None,
                   timeout: float = 60.0,
-                  deadline_ms: Optional[float] = None):
+                  deadline_ms: Optional[float] = None,
+                  trace_ctx: Optional[Dict[str, Any]] = None):
     """-> (header dict, [decoded partials]); partials decode as chunks
-    arrive (GrpcBrokerRequestHandler analog)."""
+    arrive (GrpcBrokerRequestHandler analog). A sampled ``trace_ctx``
+    (http_util.inject_trace_context shape) makes the server root a span
+    tree; it arrives on the META trailer header as ``trace``."""
     from ..engine.datablock import decode_partial
     from ..utils.faults import rpc_faults
     rpc_faults(f"GRPC {target}/Submit")
@@ -103,7 +107,8 @@ def submit_stream(target: str, sql: str,
             f"/{SERVICE}/Submit", request_serializer=_wrap,
             response_deserializer=_unwrap)
         req = json.dumps({"sql": sql, "segments": segments,
-                          "deadlineMs": deadline_ms}).encode()
+                          "deadlineMs": deadline_ms,
+                          "traceContext": trace_ctx}).encode()
         for chunk in call(req, timeout=timeout):
             if chunk[:4] == _META:
                 header = json.loads(chunk[4:])

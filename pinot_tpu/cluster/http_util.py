@@ -95,6 +95,28 @@ def start_http(handler_cls, port: int = 0) -> Tuple[ThreadingHTTPServer,
     return srv, srv.server_address[1], t
 
 
+def inject_trace_context(body: Dict[str, Any],
+                         query_id: Optional[str] = None,
+                         sampled: bool = False,
+                         parent_span_id: Optional[str] = None,
+                         remaining_ms: Optional[float] = None
+                         ) -> Dict[str, Any]:
+    """Cross-node trace-context wire format: the broker stamps every
+    scatter call (HTTP and gRPC) with ``traceContext`` so the server can
+    root a remote span tree that stitches back under the dispatching
+    call span. ``sampled`` gates the server-side tree (zero cost when
+    false); ``parentSpanId`` is the dispatching scatter_call span;
+    ``remainingMs`` mirrors the deadlineMs budget for span annotation
+    (deadlineMs stays the accountant-authoritative field)."""
+    ctx: Dict[str, Any] = {"queryId": query_id, "sampled": bool(sampled)}
+    if parent_span_id is not None:
+        ctx["parentSpanId"] = parent_span_id
+    if remaining_ms is not None:
+        ctx["remainingMs"] = int(remaining_ms)
+    body["traceContext"] = ctx
+    return body
+
+
 def http_raw(method: str, url: str, body: Any = None,
              timeout: float = 10.0) -> bytes:
     """Raw-bytes response; body may be JSON-able or raw bytes (the latter

@@ -176,23 +176,54 @@ class ServerNode:
     # -- data plane --------------------------------------------------------
     def execute(self, sql: str, segment_names: Optional[List[str]] = None,
                 priority: int = 0,
-                deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+                deadline_ms: Optional[float] = None,
+                trace_ctx: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
         """Admit through the scheduler (QueryScheduler.submit analog) and
         account the query so the watcher can kill it under pressure.
         ``deadline_ms`` is the dispatching broker's REMAINING budget; the
         accountant deadline becomes min(own timeoutMs, broker remaining)
         so a server never works past the point the broker stops
-        listening."""
+        listening. A sampled ``trace_ctx`` (http_util.
+        inject_trace_context wire shape) activates a remote-rooted span
+        tree around the executor and ships it back in the response
+        envelope for the broker to stitch."""
+        # accountant id stays server-local: in-process clusters share ONE
+        # global accountant, and registering the broker's query id from
+        # two server nodes (hybrid halves, hedged duplicates) would
+        # collide; the broker id rides the span tree instead
         query_id = uuid.uuid4().hex[:12]
         # the deadline anchors at ARRIVAL, before scheduler admission:
         # queue time is inside the broker's budget, not in addition to it
         t_arrive = time.perf_counter()
+        sampled = bool((trace_ctx or {}).get("sampled"))
+
+        def run() -> Dict[str, Any]:
+            # the scheduler runs this on a worker thread — the span
+            # tracer is thread-local, so the tree must root HERE, not in
+            # the HTTP handler thread that admitted the query
+            if not sampled:
+                return self._execute(sql, segment_names, query_id,
+                                     deadline_ms, t_arrive)
+            from ..utils import phases as ph
+            from ..utils.spans import span_tracer
+            root = span_tracer.start(
+                ph.SERVER_QUERY, server=self.instance_id,
+                query_id=trace_ctx.get("queryId") or query_id,
+                parent_span_id=trace_ctx.get("parentSpanId"))
+            try:
+                resp = self._execute(sql, segment_names, query_id,
+                                     deadline_ms, t_arrive)
+            finally:
+                root = span_tracer.stop() or root
+            root.annotate(segments=resp.get("segmentsQueried", 0))
+            resp["trace"] = root.to_dict()
+            return resp
+
         global_accountant.register(query_id)
         try:
-            return self.scheduler.execute(
-                lambda: self._execute(sql, segment_names, query_id,
-                                      deadline_ms, t_arrive),
-                query_id, priority=priority)
+            return self.scheduler.execute(run, query_id,
+                                          priority=priority)
         finally:
             global_accountant.unregister(query_id)
 
@@ -250,10 +281,12 @@ class ServerNode:
 
     def execute_json(self, sql: str,
                      segment_names: Optional[List[str]] = None,
-                     deadline_ms: Optional[float] = None
+                     deadline_ms: Optional[float] = None,
+                     trace_ctx: Optional[Dict[str, Any]] = None
                      ) -> Dict[str, Any]:
         """Legacy/debuggable JSON wire (also serves EXPLAIN)."""
-        resp = self.execute(sql, segment_names, deadline_ms=deadline_ms)
+        resp = self.execute(sql, segment_names, deadline_ms=deadline_ms,
+                            trace_ctx=trace_ctx)
         raw = resp.pop("partials_raw", None)
         if raw is not None:
             resp["partials"] = [partial_to_wire(p) for p in raw]
@@ -261,10 +294,13 @@ class ServerNode:
 
     def execute_bin(self, sql: str,
                     segment_names: Optional[List[str]] = None,
-                    deadline_ms: Optional[float] = None) -> bytes:
-        """Binary data plane: columnar DataBlock partials in one frame."""
+                    deadline_ms: Optional[float] = None,
+                    trace_ctx: Optional[Dict[str, Any]] = None) -> bytes:
+        """Binary data plane: columnar DataBlock partials in one frame.
+        The span tree (when sampled) rides the JSON frame header."""
         from ..engine.datablock import encode_wire_frame
-        resp = self.execute(sql, segment_names, deadline_ms=deadline_ms)
+        resp = self.execute(sql, segment_names, deadline_ms=deadline_ms,
+                            trace_ctx=trace_ctx)
         raw = resp.pop("partials_raw", [])
         return encode_wire_frame(resp, raw)
 
@@ -307,10 +343,12 @@ class ServerNode:
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
                 ("POST", "/query/bin"): lambda h, b: (
                     200, node.execute_bin(b["sql"], b.get("segments"),
-                                          b.get("deadlineMs"))),
+                                          b.get("deadlineMs"),
+                                          b.get("traceContext"))),
                 ("POST", "/query"): lambda h, b: (
                     200, node.execute_json(b["sql"], b.get("segments"),
-                                           b.get("deadlineMs"))),
+                                           b.get("deadlineMs"),
+                                           b.get("traceContext"))),
                 # multi-stage data plane (mailbox.proto analog) + stage
                 # dispatch (worker.proto Submit analog)
                 ("POST", "/mailbox"): lambda h, b: (

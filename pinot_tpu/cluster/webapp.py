@@ -146,18 +146,47 @@ function vQuery() {
     <p>broker URL: <input type="text" id="broker"
       value="${esc(broker)}" placeholder="http://host:port">
       <label class="mut"><input type="checkbox" id="explain">
-      EXPLAIN</label></p>
+      EXPLAIN</label>
+      <label class="mut"><input type="checkbox" id="analyze">
+      ANALYZE</label></p>
     <textarea id="sql">SELECT 1</textarea>
     <p><button data-act="query">run</button>
+    <button class="sec" data-act="forensics">slow queries</button>
     <span class="mut" id="qtime"></span></p>
-    <div id="qout"></div>`;
+    <div id="qout"></div><div id="forout"></div>`;
+}
+
+async function showForensics() {
+  // the broker-side query-forensics ring (GET /debug/queries)
+  const broker = document.getElementById("broker").value.trim();
+  localStorage.setItem("brokerUrl", broker);
+  const out = document.getElementById("forout");
+  try {
+    const d = await (await fetch(broker + "/debug/queries?n=20")).json();
+    if (!d.count) {
+      out.innerHTML = `<p class="mut">no slow queries recorded ` +
+        `(threshold ${d.slowQueryMs} ms)</p>`;
+      return;
+    }
+    out.innerHTML = `<h3>Slow queries ` +
+      `<span class="mut">(threshold ${d.slowQueryMs} ms)</span></h3>` +
+      table(["qid", "wall ms", "table", "partial", "failovers",
+             "hedges", "sql"],
+        d.queries.map(e => [esc(e.qid), e.wall_ms, esc(e.table),
+          e.partial ? "YES" : "no", e.failovers || 0, e.hedges || 0,
+          esc((e.sql || "").slice(0, 120))]));
+  } catch (e) {
+    out.innerHTML = `<p class="err">${esc(e)}</p>`;
+  }
 }
 
 async function runQuery() {
   const broker = document.getElementById("broker").value.trim();
   localStorage.setItem("brokerUrl", broker);
   let sql = document.getElementById("sql").value;
-  if (document.getElementById("explain").checked)
+  if (document.getElementById("analyze").checked)
+    sql = "EXPLAIN ANALYZE " + sql;
+  else if (document.getElementById("explain").checked)
     sql = "EXPLAIN PLAN FOR " + sql;
   const out = document.getElementById("qout");
   const t0 = performance.now();
@@ -242,6 +271,7 @@ document.addEventListener("click", (ev) => {
   else if (act === "reb") rebalance(t);
   else if (act === "task") runTask(t);
   else if (act === "query") runQuery();
+  else if (act === "forensics") showForensics();
 });
 window.addEventListener("hashchange", render);
 setInterval(() => {

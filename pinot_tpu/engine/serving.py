@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional
 from ..query.context import QueryContext
 from ..query.planner import CompiledPlan, SegmentPlanner
 from ..startree.query import try_rollup_execute
+from ..utils import phases as ph
 from ..utils.spans import annotate, span
 from ..utils.trace import Tracing
 from .batch import execute_plans_batched
@@ -48,8 +49,8 @@ def plan_segments(ctx: QueryContext, segments: List[Any],
         global_accountant.current_query_id())
     plans: List[Optional[CompiledPlan]] = []
     precomputed: Dict[int, Any] = {}
-    with Tracing.phase("planning"), span("planning",
-                                         segments=len(segments)):
+    with Tracing.phase(ph.PLANNING), span(ph.PLANNING,
+                                        segments=len(segments)):
         for i, seg in enumerate(segments):
             partial = (try_rollup_execute(ctx, seg)
                        if use_rollups and hasattr(seg, "metadata") else None)
@@ -76,7 +77,7 @@ def plan_segments(ctx: QueryContext, segments: List[Any],
 def execute_planned(ex: TableExecution) -> List[Any]:
     """Run the batched device dispatch and interleave rollup partials back
     into input order."""
-    with Tracing.phase("execution"), span("execution",
+    with Tracing.phase(ph.EXECUTION), span(ph.EXECUTION,
                                           segments=len(ex.real_plans)):
         executed = list(execute_plans_batched(ex.real_plans))
     precomputed = getattr(ex, "_precomputed", {})

@@ -121,7 +121,9 @@ ANALYZE_COLUMNS = ["Node", "Node_Id", "Parent_Id", "Time_Ms", "Detail"]
 # cost model estimated vs what the kernel measured), everything else
 # alphabetical after
 _ATTR_ORDER = ["strategy", "cache", "est_sel", "meas_sel", "slots_cap",
-               "matched", "retrace", "compiled"]
+               "matched", "retrace", "compiled",
+               # cluster plane (scatter_call / server_query spans)
+               "server", "attempt", "status", "net_ms", "error"]
 
 
 def _fmt_val(v: Any) -> str:
@@ -154,3 +156,23 @@ def explain_analyze_rows(root) -> Tuple[List[str], List[tuple]]:
 
     walk(root, -1)
     return list(ANALYZE_COLUMNS), rows
+
+
+def finalize_analyze(root) -> Tuple[List[str], List[tuple], dict]:
+    """The shared EXPLAIN ANALYZE render tail: attach the explicit
+    ``broker_overhead`` self-time child (so root-child timings sum to
+    the query's wall time — the 10% gate both brokers share), render
+    the rows, and build the trace envelope. ONE implementation for the
+    in-process broker (broker/broker.py) and the cluster broker
+    (cluster/broker_node.py): a change here changes what the timing
+    gate means everywhere at once."""
+    from ..utils import phases as ph
+    from ..utils.spans import Span
+
+    overhead = root.duration_ms - root.children_ms()
+    if overhead > 0:
+        s = Span(ph.BROKER_OVERHEAD)
+        s.duration_ms = overhead
+        root.children.append(s)
+    cols, rows = explain_analyze_rows(root)
+    return cols, rows, {"spans": root.to_dict()}

@@ -59,7 +59,9 @@ def cmd_start_server(args) -> int:
 def cmd_start_broker(args) -> int:
     from ..cluster import BrokerNode
     b = BrokerNode(args.controller, port=args.port,
-                   instance_selector=args.selector)
+                   instance_selector=args.selector,
+                   slow_query_ms=args.slow_query_ms,
+                   query_stats_path=args.query_stats)
     try:
         _wait_forever("broker", b.url)
     finally:
@@ -256,6 +258,14 @@ def build_parser() -> argparse.ArgumentParser:
     sb.add_argument("--controller", required=True)
     sb.add_argument("--port", type=int, default=0)
     sb.add_argument("--selector", default="balanced")
+    sb.add_argument("--slow-query-ms", type=float, default=None,
+                    help="slow-query ring threshold (default 500 or "
+                    "PINOT_SLOW_QUERY_MS; per-query override "
+                    "OPTION(slowQueryMs=...))")
+    sb.add_argument("--query-stats", default=None,
+                    help="append a validated query_stats ledger record "
+                    "per query to this JSONL path (default "
+                    "PINOT_QUERY_STATS_LEDGER)")
     sb.set_defaults(fn=cmd_start_broker)
 
     at = sub.add_parser("AddTable")

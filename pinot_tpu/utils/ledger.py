@@ -21,6 +21,10 @@ Kinds:
   across CPU-smoke and TPU hardware rounds.
 - ``metrics_snapshot`` — utils/metrics_sinks.LedgerSink periodic
   global_metrics snapshots.
+- ``query_stats``      — cluster/forensics.py per-query scatter-gather
+  health (wall ms, partialResult, exceptions[] codes, hedge/failover
+  counts, servers queried/responded), one record per cluster query when
+  the broker has a stats ledger configured — chaos soaks trend these.
 """
 from __future__ import annotations
 
@@ -59,6 +63,14 @@ KINDS: Dict[str, Dict[str, set]] = {
     "metrics_snapshot": {
         "required": {"counters"},
         "optional": {"gauges", "timers", "backend"},
+    },
+    "query_stats": {
+        "required": {"qid", "table", "wall_ms", "partial",
+                     "servers_queried", "servers_responded",
+                     "exception_codes"},
+        "optional": {"sql", "rows", "segments_queried",
+                     "segments_pruned", "hedges", "failovers", "slow",
+                     "error", "backend"},
     },
 }
 
@@ -124,9 +136,11 @@ def append_record(rec: Dict[str, Any], path: str) -> None:
 def validate_file(path: str) -> Dict[str, Any]:
     """Validate every line of a ledger file.
 
-    -> {"lines": N, "v2": N, "legacy": N, "errors": [(lineno, msg)...]}
+    -> {"lines": N, "v2": N, "legacy": N, "kinds": {kind: N},
+        "errors": [(lineno, msg)...]}
     """
-    out: Dict[str, Any] = {"lines": 0, "v2": 0, "legacy": 0, "errors": []}
+    out: Dict[str, Any] = {"lines": 0, "v2": 0, "legacy": 0,
+                           "kinds": {}, "errors": []}
     if not os.path.exists(path):
         return out
     with open(path) as fh:
@@ -145,6 +159,8 @@ def validate_file(path: str) -> Dict[str, Any]:
                 out["errors"].append((i, "; ".join(errs)))
             elif isinstance(rec, dict) and "v" in rec:
                 out["v2"] += 1
+                k = rec["kind"]
+                out["kinds"][k] = out["kinds"].get(k, 0) + 1
             else:
                 out["legacy"] += 1
     return out

@@ -1,0 +1,43 @@
+"""One shared vocabulary for phase and span names.
+
+utils/trace.py (``Tracing.phase`` — flat wall-ms per phase in the
+response envelope when ``OPTION(trace=true)``) and utils/spans.py (the
+span TREE that EXPLAIN ANALYZE renders) time the same code regions, and
+before round 10 each site named its region with its own string literal.
+The two vocabularies agreed only by luck; one drifted rename would have
+made the envelope and the analyze rows disagree about what "planning"
+means. Every instrumentation site now imports its name from here, and
+tests/test_span_tracer.py pins envelope keys == span names for the
+shared phases.
+
+The cluster plane (round 10) extends the set: the broker roots a
+``query`` span, each scatter-gather is a ``scatter`` span whose
+``scatter_call`` children are the per-server attempts (primary /
+failover / hedge), and each server activates a remote-rooted
+``server_query`` tree that the broker stitches under the call span that
+dispatched it.
+"""
+from __future__ import annotations
+
+# broker/engine phases (Tracing.phase AND span names — must stay one set)
+QUERY = "query"
+PLANNING = "planning"
+EXECUTION = "execution"
+REDUCE = "reduce"
+DISTRIBUTED_EXECUTE = "distributed_execute"
+BROKER_OVERHEAD = "broker_overhead"
+
+# cluster plane span names (span-tree only: the flat envelope has no
+# cross-process children to hang them on)
+SCATTER = "scatter"
+SCATTER_CALL = "scatter_call"
+SERVER_QUERY = "server_query"
+
+# names Tracing.phase may emit into the flat trace envelope
+TRACED_PHASES = frozenset(
+    {PLANNING, EXECUTION, REDUCE, DISTRIBUTED_EXECUTE})
+
+# every name above (the span tree uses these plus dynamic kernel-level
+# names like segment_kernel/device_execute owned by their emit sites)
+SPAN_NAMES = TRACED_PHASES | frozenset(
+    {QUERY, BROKER_OVERHEAD, SCATTER, SCATTER_CALL, SERVER_QUERY})

@@ -74,6 +74,19 @@ class Span:
             "children": [c.to_dict() for c in self.children],
         }
 
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Span":
+        """Rebuild a tree serialized by to_dict() — the cluster broker
+        stitches each server's remote-rooted tree (shipped in the
+        response envelope) back under the scatter call span that
+        dispatched it. Durations are trusted as measured by the remote
+        process; only the gap to the enclosing call span (network +
+        serde) is attributed broker-side."""
+        s = cls(d.get("name", "?"), **dict(d.get("attrs") or {}))
+        s.duration_ms = float(d.get("ms", 0.0))
+        s.children = [cls.from_dict(c) for c in d.get("children") or []]
+        return s
+
 
 class SpanTracer:
     """Thread-local span stack. start()/stop() bracket one traced query;

@@ -1,0 +1,315 @@
+"""Round-10 observability: cluster-wide distributed tracing + the
+query-forensics plane.
+
+Contract under test (ISSUE 5 acceptance):
+- EXPLAIN ANALYZE on a 2-server cluster (replication 2) returns a
+  stitched trace: broker-rooted ``query`` span, ``scatter`` span,
+  per-server ``scatter_call`` spans each carrying the server's
+  remote-rooted ``server_query`` tree, network/serde time as the
+  ``net_ms`` gap, and root-child timings summing to wall within 10%;
+- under seeded faults the stitched trace contains the failed primary
+  attempt, the failover attempt, and (with hedgeMs) the hedge attempt
+  as annotated spans;
+- GET /debug/queries serves the slow-query ring
+  (OPTION(slowQueryMs=...) overrides the broker default);
+- every cluster query appends a check_ledger-valid ``query_stats``
+  record to the broker's stats ledger.
+"""
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pinot_tpu.broker.routing import make_selector  # noqa: E402
+from pinot_tpu.cluster import (BrokerNode, Controller,  # noqa: E402
+                               ServerNode)
+from pinot_tpu.cluster.broker_node import FailureDetector  # noqa: E402
+from pinot_tpu.cluster.http_util import http_json  # noqa: E402
+from pinot_tpu.query.explain import ANALYZE_COLUMNS  # noqa: E402
+from pinot_tpu.segment import SegmentBuilder  # noqa: E402
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType,  # noqa: E402
+                           Schema, TableConfig)
+from pinot_tpu.utils import faults  # noqa: E402
+from pinot_tpu.utils import ledger as uledger  # noqa: E402
+from pinot_tpu.utils import phases as ph  # noqa: E402
+
+N_SEGMENTS = 4
+ROWS = 400
+
+GROUP_SQL = ("SELECT region, SUM(amount), COUNT(*) FROM sales "
+             "GROUP BY region ORDER BY region")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ctrace")
+    ctrl = Controller(str(tmp / "ctrl"), heartbeat_timeout=30.0,
+                      reconcile_interval=0.2)
+    servers = [ServerNode(f"server_{i}", ctrl.url, poll_interval=0.1)
+               for i in range(2)]
+    stats_path = str(tmp / "query_stats.jsonl")
+    broker = BrokerNode(ctrl.url, routing_refresh=0.1,
+                        query_stats_path=stats_path)
+
+    # same schema/rows as test_faults so warm kernel plans dedupe
+    # across the two modules (suite-budget guard)
+    rng = np.random.default_rng(11)
+    for table, replication in (("sales", 2), ("sales_r1", 1)):
+        schema = Schema(table, [
+            FieldSpec("region", DataType.STRING),
+            FieldSpec("amount", DataType.INT, FieldType.METRIC),
+        ])
+        builder = SegmentBuilder(schema, TableConfig(table))
+        ctrl.add_table(table, schema.to_dict(), replication=replication)
+        for i in range(N_SEGMENTS):
+            cols = {
+                "region": rng.choice(["east", "west", "north"], ROWS),
+                "amount": rng.integers(0, 1000, ROWS).astype(np.int32),
+            }
+            d = builder.build(cols, str(tmp / "segments" / table),
+                              f"{table}_seg_{i}")
+            ctrl.add_segment(table, f"{table}_seg_{i}", d)
+    v = ctrl.routing_snapshot()["version"]
+    for s in servers:
+        assert s.wait_for_version(v)
+    assert broker.wait_for_version(v)
+    yield ctrl, servers, broker, stats_path
+    broker.stop()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    ctrl.stop()
+
+
+def _reset_broker(broker):
+    broker._failures = FailureDetector()
+    broker._selector = make_selector("balanced")
+    broker._rr = itertools.count(1)
+
+
+def _q(broker, sql, timeout=120.0):
+    return http_json("POST", f"{broker.url}/query/sql", {"sql": sql},
+                     timeout=timeout)
+
+
+def _rows_named(rows, name):
+    return [r for r in rows if r[0] == name]
+
+
+def _tree_ok(rows):
+    ids = {r[1] for r in rows}
+    assert all(r[2] == -1 or r[2] in ids for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# stitched EXPLAIN ANALYZE on the healthy cluster
+# ---------------------------------------------------------------------------
+
+def test_cluster_explain_analyze_stitched(cluster):
+    ctrl, servers, broker, _ = cluster
+    _reset_broker(broker)
+    _q(broker, GROUP_SQL)                      # warm: compile outside
+    resp = _q(broker, "EXPLAIN ANALYZE " + GROUP_SQL)
+    rows = [tuple(r) for r in resp["resultTable"]["rows"]]
+    assert resp["resultTable"]["dataSchema"]["columnNames"] == \
+        ANALYZE_COLUMNS
+    _tree_ok(rows)
+    root = rows[0]
+    assert root[0] == ph.QUERY
+
+    scatter = _rows_named(rows, ph.SCATTER)
+    assert len(scatter) == 1 and scatter[0][2] == root[1]
+    calls = _rows_named(rows, ph.SCATTER_CALL)
+    assert len(calls) == 2                     # one per server
+    assert all(c[2] == scatter[0][1] for c in calls)
+    assert {f"server=server_{i}" for i in range(2)} <= \
+        {d.split()[0] for c in calls for d in [c[4]]}
+
+    # each call span carries the server's remote-rooted tree, and the
+    # gap between them (network + serde) is attributed as net_ms >= 0
+    remotes = _rows_named(rows, ph.SERVER_QUERY)
+    assert len(remotes) == 2
+    call_ids = {c[1]: c for c in calls}
+    for r in remotes:
+        assert r[2] in call_ids
+        assert r[3] <= call_ids[r[2]][3] + 1e-6
+    assert all("net_ms=" in c[4] for c in calls)
+    # the remote trees contain the engine spans (round-7 vocabulary)
+    names = [r[0] for r in rows]
+    for expect in (ph.PLANNING, ph.EXECUTION, ph.REDUCE):
+        assert expect in names, f"missing {expect!r} in {names}"
+
+    # acceptance gate: root-child timings sum to wall within 10%
+    children = [r for r in rows if r[2] == root[1]]
+    total = sum(r[3] for r in children)
+    assert abs(total - root[3]) <= 0.10 * root[3]
+
+
+# ---------------------------------------------------------------------------
+# trace propagation under faults: failover + hedge spans
+# ---------------------------------------------------------------------------
+
+def test_trace_contains_failed_attempt_and_failover(cluster):
+    ctrl, servers, broker, _ = cluster
+    _reset_broker(broker)
+    _q(broker, GROUP_SQL)                      # warm + heal detector
+    faults.install(f"seed=9; rpc.drop: match=:{servers[0].port}"
+                   "/query/bin, times=1")
+    resp = _q(broker, "EXPLAIN ANALYZE " + GROUP_SQL)
+    faults.clear()
+    rows = [tuple(r) for r in resp["resultTable"]["rows"]]
+    _tree_ok(rows)
+    calls = _rows_named(rows, ph.SCATTER_CALL)
+    failed = [c for c in calls if "attempt=primary" in c[4]
+              and "status=failed" in c[4]]
+    failover = [c for c in calls if "attempt=failover" in c[4]]
+    assert failed, f"no failed primary span in {[c[4] for c in calls]}"
+    assert "error=" in failed[0][4]
+    assert failover and any("status=ok" in c[4] for c in failover)
+    # the failover's remote tree still stitched in
+    remotes = _rows_named(rows, ph.SERVER_QUERY)
+    ok_ids = {c[1] for c in calls if "status=ok" in c[4]}
+    assert {r[2] for r in remotes} <= ok_ids
+    # timing gate holds under failover too
+    root = rows[0]
+    children = [r for r in rows if r[2] == root[1]]
+    assert abs(sum(r[3] for r in children) - root[3]) <= 0.10 * root[3]
+
+
+def test_trace_contains_hedge(cluster):
+    ctrl, servers, broker, _ = cluster
+    _reset_broker(broker)
+    _q(broker, GROUP_SQL)
+    faults.install("seed=5; segment.slow: match=server_0, delay_ms=900")
+    resp = _q(broker, "EXPLAIN ANALYZE " + GROUP_SQL +
+              " OPTION(hedgeMs=80,timeoutMs=300000)")
+    faults.clear()
+    rows = [tuple(r) for r in resp["resultTable"]["rows"]]
+    calls = _rows_named(rows, ph.SCATTER_CALL)
+    hedges = [c for c in calls if "attempt=hedge" in c[4]]
+    assert hedges, f"no hedge span in {[c[4] for c in calls]}"
+    assert any("status=ok" in c[4] for c in hedges)
+    time.sleep(1.0)  # drain the abandoned straggler call
+
+
+# ---------------------------------------------------------------------------
+# forensics plane: /debug/queries ring + query_stats ledger
+# ---------------------------------------------------------------------------
+
+def test_slow_query_ring_and_debug_endpoint(cluster):
+    ctrl, servers, broker, _ = cluster
+    _reset_broker(broker)
+    # slowQueryMs=0: every query qualifies as slow
+    _q(broker, "SELECT COUNT(*) FROM sales OPTION(slowQueryMs=0)")
+    dbg = http_json("GET", f"{broker.url}/debug/queries")
+    assert dbg["count"] >= 1
+    newest = dbg["queries"][0]
+    assert newest["sql"].startswith("SELECT COUNT(*)")
+    assert newest["wall_ms"] > 0 and newest["partial"] is False
+    assert newest["table"] == "sales"
+    # ?n= caps the page
+    dbg1 = http_json("GET", f"{broker.url}/debug/queries?n=1")
+    assert dbg1["count"] == 1
+    # an invalid threshold is a 400, before any dispatch
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _q(broker, "SELECT COUNT(*) FROM sales OPTION(slowQueryMs=abc)")
+    assert ei.value.code == 400
+    assert "invalid slowQueryMs" in ei.value.read().decode()
+    # the analyze traces recorded earlier ride the ring entries
+    traced = [e for e in dbg["queries"] if "trace" in e]
+    assert traced and traced[0]["trace"]["name"] == ph.QUERY
+
+
+def test_query_stats_ledger_every_query(cluster):
+    ctrl, servers, broker, stats_path = cluster
+    _reset_broker(broker)
+    res0 = uledger.validate_file(stats_path)
+    n0 = res0["kinds"].get("query_stats", 0)
+    _q(broker, "SELECT COUNT(*) FROM sales")
+    res1 = uledger.validate_file(stats_path)
+    assert not res1["errors"], res1["errors"][:3]
+    assert res1["kinds"]["query_stats"] == n0 + 1
+    rec = [json.loads(line) for line in open(stats_path)][-1]
+    assert rec["kind"] == "query_stats"
+    assert rec["table"] == "sales" and rec["partial"] is False
+    assert rec["servers_queried"] >= 1
+    assert rec["exception_codes"] == []
+    assert rec["failovers"] == 0 and rec["hedges"] == 0
+
+
+def test_query_stats_partial_and_failover_counts(cluster):
+    ctrl, servers, broker, stats_path = cluster
+    from pinot_tpu.cluster.broker_node import ERR_SERVER_NOT_RESPONDED
+    _reset_broker(broker)
+    faults.install(f"seed=2; rpc.drop: match=:{servers[0].port}"
+                   "/query/bin")
+    resp = _q(broker, "SELECT COUNT(*) FROM sales_r1 "
+              "OPTION(allowPartialResults=true)")
+    faults.clear()
+    assert resp["partialResult"] is True
+    rec = [json.loads(line) for line in open(stats_path)][-1]
+    assert rec["partial"] is True
+    assert ERR_SERVER_NOT_RESPONDED in rec["exception_codes"]
+    assert rec["servers_responded"] < rec["servers_queried"]
+
+    # a failover against the replicated table lands in the counts
+    _reset_broker(broker)
+    faults.install(f"seed=9; rpc.drop: match=:{servers[0].port}"
+                   "/query/bin, times=1")
+    _q(broker, GROUP_SQL)
+    faults.clear()
+    rec = [json.loads(line) for line in open(stats_path)][-1]
+    assert rec["failovers"] >= 1 and rec["partial"] is False
+
+
+def test_query_stats_records_errors(cluster):
+    ctrl, servers, broker, stats_path = cluster
+    import urllib.error
+    _reset_broker(broker)
+    with pytest.raises(urllib.error.HTTPError):
+        _q(broker, "SELECT COUNT(*) FROM no_such_table")
+    rec = [json.loads(line) for line in open(stats_path)][-1]
+    assert rec["table"] == "no_such_table"
+    assert "not found" in rec["error"]
+
+
+# ---------------------------------------------------------------------------
+# gRPC plane: trace context propagates on Submit
+# ---------------------------------------------------------------------------
+
+def test_grpc_submit_trace_propagation(cluster):
+    ctrl, servers, broker, _ = cluster
+    srv = servers[0]
+    if srv.grpc_port is None:
+        pytest.skip("grpcio not available")
+    from pinot_tpu.cluster.grpc_plane import submit_stream
+    header, partials = submit_stream(
+        f"127.0.0.1:{srv.grpc_port}",
+        "SELECT COUNT(*) FROM sales",
+        trace_ctx={"queryId": "qg1", "sampled": True,
+                   "parentSpanId": "ab12cd34"})
+    tree = header.get("trace")
+    assert tree and tree["name"] == ph.SERVER_QUERY
+    assert tree["attrs"]["query_id"] == "qg1"
+    assert tree["attrs"]["parent_span_id"] == "ab12cd34"
+    # unsampled: zero-cost, no tree in the envelope
+    header2, _ = submit_stream(f"127.0.0.1:{srv.grpc_port}",
+                               "SELECT COUNT(*) FROM sales")
+    assert "trace" not in header2

@@ -67,6 +67,10 @@ def _run(broker, sql):
     return broker.query(sql).rows
 
 
+# ~98s randomized soak: slow-marked in round 10 to protect the
+# tier-1 870s budget (tests/test_ssb.py + test_compact*.py keep the
+# kernel-vs-oracle gate); runs in the nightly `-m slow` lane
+@pytest.mark.slow
 def test_fuzz_kernel_host_oracle(setup):
     broker, data, dim = setup
     gen = QueryGenerator(SEED, with_exists=True)

@@ -150,6 +150,26 @@ def test_span_host_and_kselect(broker):
     assert "segment_kselect" in [r[0] for r in res.rows]
 
 
+def test_phase_vocabulary_shared(broker):
+    """utils/phases.py is the ONE phase-name vocabulary: the flat trace
+    envelope (utils/trace.py Tracing.phase) and the EXPLAIN ANALYZE span
+    tree (utils/spans.py) must agree — no drifted strings."""
+    from pinot_tpu.utils import phases as ph
+    res = broker.query("SELECT k, SUM(v) FROM obs GROUP BY k "
+                       "OPTION(trace=true)")
+    assert res.trace is not None
+    envelope_phases = set(res.trace["phases"])
+    assert envelope_phases <= ph.TRACED_PHASES, envelope_phases
+    res2 = broker.query("EXPLAIN ANALYZE SELECT k, SUM(v) FROM obs "
+                        "GROUP BY k")
+    names = {r[0] for r in res2.rows}
+    assert res2.rows[0][0] == ph.QUERY
+    # every envelope phase appears as a span of the SAME name
+    assert envelope_phases <= names
+    for const in (ph.PLANNING, ph.EXECUTION, ph.REDUCE):
+        assert const in names
+
+
 def test_plain_queries_untouched(broker):
     res = broker.query("SELECT COUNT(*) FROM obs")
     assert res.trace is None
@@ -305,7 +325,8 @@ def test_ledger_file_validation(tmp_path):
     with open(path, "a") as fh:
         fh.write(json.dumps({"metric": "legacy_line", "value": 3}) + "\n")
     res = uledger.validate_file(path)
-    assert res == {"lines": 2, "v2": 1, "legacy": 1, "errors": []}
+    assert res == {"lines": 2, "v2": 1, "legacy": 1,
+                   "kinds": {"phase_profile": 1}, "errors": []}
     with open(path, "a") as fh:
         fh.write(json.dumps({"v": 2, "ts": "t", "kind": "phase_profile",
                              "metric": "m", "backend": "cpu",

@@ -15,6 +15,10 @@ fault-free digests for a small SSB query set, then re-run under seeded
    ``allowPartialResults=true`` answers with ``partialResult=true``,
    populated ``exceptions[]`` and ``numServersResponded <
    numServersQueried``; the default mode fails whole-query.
+4. Every cluster query appended a validated ``query_stats`` record to
+   the broker's stats ledger (per-query wall/partial/exception-code/
+   hedge/failover trend lines — ROADMAP round-9 item d), including at
+   least one ``partial=true`` record from the replication-1 plan.
 
 Prints one summary JSON line last, check_ledger-style; exit 0 when all
 assertions hold.
@@ -82,7 +86,12 @@ def build_ssb_cluster(tmp: str, rows: int = 4096, n_segments: int = 4,
                       reconcile_interval=0.2)
     servers = [ServerNode(f"server_{i}", ctrl.url, poll_interval=poll)
                for i in range(2)]
-    broker = BrokerNode(ctrl.url, routing_refresh=poll)
+    # per-query query_stats ledger: the soak's trend-line output (and
+    # the assertion target — every cluster query must append a
+    # check_ledger-valid record)
+    broker = BrokerNode(ctrl.url, routing_refresh=poll,
+                        query_stats_path=os.path.join(
+                            tmp, "query_stats.jsonl"))
 
     for table, replication in (("lineorder", 2), ("lineorder_r1", 1)):
         schema = Schema(table, fields)
@@ -118,6 +127,22 @@ def build_ssb_cluster(tmp: str, rows: int = 4096, n_segments: int = 4,
 def digest(resp: dict):
     import bench
     return bench._digest([tuple(r) for r in resp["resultTable"]["rows"]])
+
+
+def _iter_stats(path: str, partial=None):
+    """query_stats records from a stats ledger, optionally filtered by
+    the partialResult flag."""
+    with open(path) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") != "query_stats":
+                continue
+            if partial is not None and rec.get("partial") != partial:
+                continue
+            yield rec
 
 
 def main(argv=None) -> int:
@@ -242,6 +267,26 @@ def main(argv=None) -> int:
                 time.sleep(0.5)
         check("recovery", recovered,
               "cluster did not recover fault-free digests within 30s")
+
+        # forensics plane: the soak must have emitted one validated
+        # query_stats record per cluster query (ROADMAP round-9 item d)
+        from pinot_tpu.utils import ledger as uledger
+        stats = uledger.validate_file(broker.forensics.ledger_path)
+        n_stats = stats["kinds"].get("query_stats", 0)
+        summary["query_stats"] = n_stats
+        check("query_stats.valid", not stats["errors"],
+              f"invalid records: {stats['errors'][:3]}")
+        # baseline + two failover plans + the partial-contract plan +
+        # recovery all route through BrokerNode.query: at minimum the
+        # three full run_all passes must be on record
+        check("query_stats.count", n_stats >= 3 * len(queries) + 1,
+              f"only {n_stats} query_stats records for "
+              f"{len(queries)} queries")
+        check("query_stats.partial_flagged",
+              any(True for _ in _iter_stats(
+                  broker.forensics.ledger_path, partial=True)),
+              "no partialResult=true query_stats record from the "
+              "replication-1 plan")
     finally:
         faults.clear()
         stop()

@@ -35,6 +35,7 @@ def check(path: str, strict: bool = False) -> int:
         rc = 1
     print(json.dumps({"path": path, "lines": res["lines"],
                       "v2": res["v2"], "legacy": res["legacy"],
+                      "kinds": res["kinds"],
                       "errors": len(res["errors"]),
                       "ok": rc == 0}))
     return rc
