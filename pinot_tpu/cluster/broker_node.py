@@ -26,7 +26,7 @@ from ..engine.reduce import ResultTable, reduce_partials
 from ..query.context import build_query_context
 from ..query.sql import SetOpStmt, SqlError, parse_sql, to_sql
 from ..utils import phases as ph
-from ..utils.metrics import global_metrics
+from ..utils.metrics import global_metrics, ingest_health
 from ..utils.spans import Span, span, span_tracer
 from .forensics import QueryForensics, parse_slow_query_ms
 from .http_util import (JsonHandler, http_json, http_raw,
@@ -947,7 +947,10 @@ class BrokerNode:
     def scatter_health(self) -> Dict[str, Any]:
         """Scatter-gather health: per-server consecutive-failure state
         from the FailureDetector plus the scatter counters — served at
-        GET /metrics and rendered on the /ui console."""
+        GET /metrics and rendered on the /ui console. ``ingest`` carries
+        the realtime-plane recovery counters + freshness gauge next to
+        the round-9 scatter counters (in-process roles share
+        global_metrics; a standalone broker reports zeros)."""
         snap = global_metrics.snapshot()
         c = snap["counters"]
         fd = self._failures.snapshot()
@@ -961,6 +964,7 @@ class BrokerNode:
                 "scatter_failovers", "scatter_hedges",
                 "scatter_partial_responses", "scatter_server_errors",
                 "faults_fired")},
+            "ingest": ingest_health(snap),
         }
 
     # -- REST --------------------------------------------------------------
@@ -1018,7 +1022,7 @@ class BrokerNode:
  #err{color:#e66;white-space:pre-wrap}
  #warn{color:#ea3;white-space:pre-wrap}
  #scatter{color:#789;margin-top:1.5em;font-size:.85em;
-   border-top:1px solid #333;padding-top:.5em}
+   border-top:1px solid #333;padding-top:.5em;white-space:pre-wrap}
  #slowq{color:#a96;margin-top:.5em;font-size:.85em;
    border-top:1px solid #333;padding-top:.5em}
  #slowq td{border:1px solid #333;font-size:1em}
@@ -1076,13 +1080,21 @@ async function health(){
       esc(id)+': '+s.consecutiveFailures+' consecutive failures'+
       (s.backoffRemainingS>0?' (backoff '+s.backoffRemainingS+'s)':''))
       .join(' | ')||'all healthy';
+    const i=m.ingest||{};
     document.getElementById('scatter').textContent=
       'scatter health: '+m.unhealthyServers+'/'+m.knownServers+
       ' unhealthy | failovers '+(c.scatter_failovers||0)+
       ' | hedges '+(c.scatter_hedges||0)+
       ' | partial responses '+(c.scatter_partial_responses||0)+
       ' | server errors '+(c.scatter_server_errors||0)+
-      ' — '+srv;
+      ' — '+srv+
+      '\\ningest: rows '+(i.ingest_rows||0)+
+      ' | freshness '+(i.freshness_ms!=null?
+        i.freshness_ms.toFixed(1)+' ms':'n/a')+
+      ' | commit retries '+(i.ingest_commit_retries||0)+
+      ' | rebalance resets '+(i.ingest_rebalance_resets||0)+
+      ' | upsert replays '+(i.ingest_upsert_replays||0)+
+      ' | orphans cleaned '+(i.ingest_orphans_cleaned||0);
   }catch(e){}
 }
 async function slowq(){

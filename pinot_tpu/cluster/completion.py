@@ -178,6 +178,20 @@ class SegmentCompletionManager:
             return dict(e) if e else None
 
 
+def _commit_fault(server_id: str, op: str, segment: str) -> None:
+    """Named ingest fault hook (``commit.http_error``): the commit RPC
+    fails mid-protocol before reaching the controller. One hook per
+    protocol boundary so a seeded plan can kill exactly the
+    segmentConsumed / commitStart / commitEnd leg it targets (the
+    generic rpc.* trio in http_util still applies underneath). Shared
+    by BOTH clients — HTTP and protocol-local chaos plans must see
+    identical boundaries and site keys."""
+    from ..utils import faults
+    if faults.active():
+        faults.fault_point("commit.http_error",
+                           f"{server_id}/{op}/{segment}")
+
+
 class CompletionClient:
     """Server-side protocol client: reports thresholds and runs the
     split-commit against the controller REST API (the server half of
@@ -190,9 +204,13 @@ class CompletionClient:
         self.server_id = server_id
         self.deepstore_uri = deepstore_uri
 
+    def _commit_fault(self, op: str, segment: str) -> None:
+        _commit_fault(self.server_id, op, segment)
+
     def segment_consumed(self, table: str, segment: str, offset: int
                          ) -> Dict[str, Any]:
         from .http_util import http_json
+        self._commit_fault("segmentConsumed", segment)
         return http_json("POST", f"{self.controller_url}/segmentConsumed",
                          {"table": table, "segment": segment,
                           "server": self.server_id, "offset": offset})
@@ -203,6 +221,7 @@ class CompletionClient:
         on COMMIT_SUCCESS."""
         from .deepstore import upload_segment
         from .http_util import http_json
+        self._commit_fault("segmentCommitStart", segment)
         start = http_json("POST",
                           f"{self.controller_url}/segmentCommitStart",
                           {"table": table, "segment": segment,
@@ -211,8 +230,67 @@ class CompletionClient:
             return False
         uri = upload_segment(seg_dir,
                              self.deepstore_uri.rstrip("/") + "/" + table)
+        self._commit_fault("segmentCommitEnd", segment)
         end = http_json("POST", f"{self.controller_url}/segmentCommitEnd",
                         {"table": table, "segment": segment,
                          "server": self.server_id, "downloadURI": uri,
                          "metadata": metadata})
+        return end.get("status") == COMMIT_SUCCESS
+
+
+class LocalCompletionClient:
+    """In-process CompletionClient: the same two-call surface the
+    realtime manager speaks (segment_consumed / split_commit), driving a
+    SegmentCompletionManager directly instead of the controller REST
+    API. Commits upload through the real deep-store pack/upload path and
+    register into a shared ``registry`` dict (the controller's
+    segment-metadata analog) that doubles as the FSM's
+    ``registered_segment`` fallback — so peer replicas and restarted
+    processes resolve COMMITTED downloads exactly like the HTTP flow.
+
+    Exists for the ingest-vs-oracle fuzzer and standalone protocol
+    soaks: every protocol boundary still passes the
+    ``commit.http_error`` fault hook, and downloads still pass
+    ``handoff.stall`` (deepstore), so chaos plans behave identically to
+    the clustered path without HTTP servers in the loop."""
+
+    def __init__(self, completion: SegmentCompletionManager,
+                 server_id: str, deepstore_uri: str,
+                 registry: Optional[Dict[Tuple[str, str],
+                                         Dict[str, Any]]] = None):
+        self.completion = completion
+        self.server_id = server_id
+        self.deepstore_uri = deepstore_uri
+        self.registry = registry if registry is not None else {}
+
+    def _commit_fault(self, op: str, segment: str) -> None:
+        _commit_fault(self.server_id, op, segment)
+
+    def segment_consumed(self, table: str, segment: str, offset: int
+                         ) -> Dict[str, Any]:
+        self._commit_fault("segmentConsumed", segment)
+        return self.completion.segment_consumed(table, segment,
+                                                self.server_id, offset)
+
+    def split_commit(self, table: str, segment: str, seg_dir: str,
+                     metadata: Optional[Dict[str, Any]] = None) -> bool:
+        from .deepstore import upload_segment
+        self._commit_fault("segmentCommitStart", segment)
+        start = self.completion.segment_commit_start(table, segment,
+                                                     self.server_id)
+        if start.get("status") != COMMIT_CONTINUE:
+            return False
+        uri = upload_segment(seg_dir,
+                             self.deepstore_uri.rstrip("/") + "/" + table)
+        self._commit_fault("segmentCommitEnd", segment)
+
+        def register() -> None:
+            # runs under the FSM lock, like the controller's add_segment
+            self.registry[(table, segment)] = {  # jaxlint: ok unlocked-mutation
+                "downloadURI": uri,
+                "offset": (metadata or {}).get("endOffset")}
+
+        end = self.completion.segment_commit_end(table, segment,
+                                                 self.server_id, uri,
+                                                 register=register)
         return end.get("status") == COMMIT_SUCCESS

@@ -790,12 +790,16 @@ class Controller:
         lease = self._read_lease() or {}
         tasks = {t["name"]: {k: v for k, v in t.items() if k != "name"}
                  for t in self.scheduler.status()}
+        from ..utils.metrics import global_metrics, ingest_health
         return {"version": version, "instances": instances,
                 "tables": tables, "tasks": tasks,
                 "instance_id": self.instance_id,
                 "leader": (self.instance_id if self.is_leader
                            else lease.get("holder")),
-                "lease_holder": lease.get("holder")}
+                "lease_holder": lease.get("holder"),
+                # realtime-plane health next to the cluster view (shared
+                # global_metrics for in-process roles)
+                "ingest": ingest_health(global_metrics.snapshot())}
 
     def ui_page(self) -> str:
         """The controller web application (GET /ui): the reference's

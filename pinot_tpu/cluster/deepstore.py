@@ -56,6 +56,15 @@ def upload_segment(seg_dir: str, deepstore_uri: str) -> str:
 def download_segment(download_uri: str, dest_root: str) -> str:
     """Fetch + untar a deep-store segment; returns the local segment
     dir."""
+    from ..utils import faults
+    if faults.active():
+        # handoff.stall: the COMMITTED-replica artifact fetch stalls
+        # (delay_ms) then breaks — the adopter retries on its next poll.
+        # Site key = archive basename, NOT the full URI: decision purity
+        # in (seed, point, key) must survive run-scoped store roots
+        # (tmp dirs would perturb the stream between identical runs)
+        faults.fault_point("handoff.stall",
+                           os.path.basename(download_uri.rstrip("/")))
     fs, path = fs_for_uri(download_uri)
     with tempfile.TemporaryDirectory(prefix="ptpu_dl_") as tmp:
         local = os.path.join(tmp, os.path.basename(path))

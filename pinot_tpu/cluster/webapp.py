@@ -100,8 +100,18 @@ function vCluster() {
      `<span class="badge ${i.live ? "live" : "dead"}">` +
        `${i.live ? "LIVE" : "DEAD"}</span>`,
      esc((i.tags || []).join(", ")), esc(i.host || "")]);
+  const ing = D.ingest || {};
+  const ingest = `<p class="mut">realtime ingest: rows ` +
+    `${ing.ingest_rows || 0} | freshness ` +
+    `${ing.freshness_ms != null ?
+        ing.freshness_ms.toFixed(1) + " ms" : "n/a"} | commits ` +
+    `${ing.ingest_commits || 0} | commit retries ` +
+    `${ing.ingest_commit_retries || 0} | rebalance resets ` +
+    `${ing.ingest_rebalance_resets || 0} | upsert replays ` +
+    `${ing.ingest_upsert_replays || 0} | orphans cleaned ` +
+    `${ing.ingest_orphans_cleaned || 0}</p>`;
   return `<h2>Instances</h2>` +
-    table(["id", "state", "tags", "host"], inst) +
+    table(["id", "state", "tags", "host"], inst) + ingest +
     `<h2>Leadership</h2>` +
     table(["leader", "lease holder", "this instance"],
       [[esc(D.leader || "-"), esc(D.lease_holder || "-"),

@@ -1,3 +1,4 @@
-from .stream import (InMemoryStream, MessageBatch, PartitionGroupConsumer,
-                     StreamConfig, StreamConsumerFactory)  # noqa: F401
+from .stream import (InMemoryStream, MessageBatch,  # noqa: F401
+                     OffsetOutOfRange, PartitionGroupConsumer,
+                     StreamConfig, StreamConsumerFactory)
 from .manager import RealtimeTableDataManager  # noqa: F401

@@ -28,7 +28,7 @@ import os
 from typing import Any, Callable, Mapping, Optional
 
 from .stream import MessageBatch, PartitionGroupConsumer, \
-    StreamConsumerFactory
+    StreamConsumerFactory, consume_faults
 
 META_FILE = "stream.json"
 
@@ -123,6 +123,7 @@ class FileLogConsumer(PartitionGroupConsumer):
         self._row, self._byte = row, pos
 
     def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
+        consume_faults(f"file/{os.path.basename(self._path)}")
         if not os.path.exists(self._path):
             return MessageBatch([], start_offset)
         rows = []

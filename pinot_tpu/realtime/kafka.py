@@ -41,8 +41,8 @@ import threading
 import time
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
-from .stream import MessageBatch, PartitionGroupConsumer, \
-    StreamConsumerFactory
+from .stream import MessageBatch, OffsetOutOfRange, \
+    PartitionGroupConsumer, StreamConsumerFactory, consume_faults
 
 API_PRODUCE, API_FETCH, API_LIST_OFFSETS, API_METADATA = 0, 1, 2, 3
 API_VERSIONS = 18
@@ -56,6 +56,13 @@ _MAX_FRAME = 64 << 20
 
 class KafkaError(Exception):
     """Protocol-level error (broker error code or malformed bytes)."""
+
+
+class KafkaOffsetOutOfRange(KafkaError, OffsetOutOfRange):
+    """ERR_OFFSET_OUT_OF_RANGE from the broker: the requested offset is
+    gone (log truncation/retention). Subclasses the stream SPI's
+    OffsetOutOfRange so the realtime manager snaps the partition back to
+    its checkpoint instead of retrying a fetch that can never succeed."""
 
 
 # ---------------------------------------------------------------------------
@@ -673,6 +680,7 @@ class KafkaPartitionConsumer(PartitionGroupConsumer):
         self._conn = _KafkaConn(host, port, timeout)
 
     def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
+        consume_faults(f"kafka/{self.topic}/{self.partition}")
         self._conn.handshake()
         body = (_i32(-1)                     # replica_id
                 + _i32(100)                  # max_wait_ms
@@ -701,7 +709,7 @@ class KafkaPartitionConsumer(PartitionGroupConsumer):
                     r.i64()
                 record_set = r.bytes_() or b""
                 if err == ERR_OFFSET_OUT_OF_RANGE:
-                    raise KafkaError(
+                    raise KafkaOffsetOutOfRange(
                         f"offset {start_offset} out of range for "
                         f"{self.topic}/{self.partition}")
                 if err != ERR_NONE:

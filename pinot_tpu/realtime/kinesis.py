@@ -34,8 +34,8 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from ..fs.rest import RestClient
 from ..fs.s3 import sigv4_headers
-from .stream import MessageBatch, PartitionGroupConsumer, \
-    StreamConsumerFactory
+from .stream import MessageBatch, OffsetOutOfRange, \
+    PartitionGroupConsumer, StreamConsumerFactory, consume_faults
 
 _TARGET_PREFIX = "Kinesis_20131202."
 _CT = "application/x-amz-json-1.1"
@@ -46,6 +46,20 @@ class KinesisError(Exception):
         super().__init__(f"Kinesis {status} {type_}: {message}")
         self.status = status
         self.type = type_
+
+
+class KinesisOffsetOutOfRange(KinesisError, OffsetOutOfRange):
+    """The shard position can't be resumed: the sequence number aged out
+    past retention (InvalidArgumentException) or the shard is gone after
+    a reshard (ResourceNotFoundException). Subclasses the stream SPI's
+    OffsetOutOfRange so the realtime manager snaps the partition back to
+    its checkpoint instead of retrying an iterator mint that can never
+    succeed."""
+
+
+# GetShardIterator error types that mean "this position is gone", not
+# "try again" — the snap-back classification above
+_GONE_TYPES = ("InvalidArgumentException", "ResourceNotFoundException")
 
 
 class KinesisClient:
@@ -202,11 +216,20 @@ class KinesisShardConsumer(PartitionGroupConsumer):
         if start_offset <= 0:
             return self.client.get_shard_iterator(
                 self.stream, self.shard_id, "TRIM_HORIZON")
-        return self.client.get_shard_iterator(
-            self.stream, self.shard_id, "AFTER_SEQUENCE_NUMBER",
-            str(start_offset - 1))
+        try:
+            return self.client.get_shard_iterator(
+                self.stream, self.shard_id, "AFTER_SEQUENCE_NUMBER",
+                str(start_offset - 1))
+        except KinesisError as e:
+            if e.type in _GONE_TYPES:
+                raise KinesisOffsetOutOfRange(
+                    e.status, e.type,
+                    f"cannot resume {self.stream}/{self.shard_id} at "
+                    f"{start_offset}: {e}") from e
+            raise
 
     def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
+        consume_faults(f"kinesis/{self.stream}/{self.shard_id}")
         it = self._iterator_for(start_offset)
         try:
             res = self.client.get_records(it, max_messages)

@@ -26,6 +26,17 @@ Lifecycle per partition (CONSUMING segment):
               committed offset
     restart: load committed segment dirs from state, resume consuming at
              next_offset.
+
+Chaos hardening (utils/faults.py ingest family): consumer reads run
+under bounded retry-with-backoff (``stream.error``), an injected
+rebalance (``stream.rebalance``) snaps the partition back to its
+checkpoint exactly like a restart, ``commit.crash`` /
+``upsert.compact_crash`` raise IngestCrash (abandon + restart — the
+orphan-artifact cleanup at construction makes the restart idempotent),
+and completion-protocol RPC failures (``commit.http_error``) re-enter
+the HOLD/CATCHUP loop on the next poll. Every recovery event lands in
+the per-table ingest stats (write_ingest_stats -> ``ingest_stats``
+ledger records) and the ``ingest_*`` global_metrics counters.
 """
 from __future__ import annotations
 
@@ -33,7 +44,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -42,10 +53,18 @@ from ..segment.mutable import MutableSegment
 from ..server.data_manager import TableDataManager
 from ..spi.config import TableConfig
 from ..spi.schema import Schema
-from .stream import MessageBatch, StreamConfig
+from ..utils import faults
+from ..utils.metrics import global_metrics
+from .stream import MessageBatch, OffsetOutOfRange, StreamConfig
 
 STATE_FILE = "state.json"
 FETCH_BATCH = 10_000
+
+# gauge name -> id(manager) of the last writer: several managers of the
+# SAME table in one process (replicas) last-writer-wins the shared
+# per-table freshness gauge, so stop() must only remove it when this
+# manager was the latest writer — never a live replica's reading
+_FRESHNESS_OWNERS: Dict[str, int] = {}
 
 
 class RealtimeTableDataManager(TableDataManager):
@@ -84,6 +103,17 @@ class RealtimeTableDataManager(TableDataManager):
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._seal_lock = threading.Lock()
+
+        # ingest stats (freshness ledger writer side + ingest_* counters)
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, int] = {
+            "rows": 0, "commits": 0, "commit_retries": 0,
+            "commit_failures": 0, "rebalance_resets": 0,
+            "stream_retries": 0, "upsert_replays": 0,
+            "orphans_cleaned": 0, "handoff_retries": 0}
+        self._ingest_t0: Optional[float] = None
+        self._freshness_ms: Optional[float] = None
+        self._clean_orphans()
 
         # upsert/dedup metadata, per partition (PKs are partition-local,
         # same contract as the reference's partition managers)
@@ -124,7 +154,7 @@ class RealtimeTableDataManager(TableDataManager):
             from ..upsert import PartitionUpsertMetadataManager
             for p in range(n_parts):
                 self._upsert[p] = PartitionUpsertMetadataManager(
-                    upsert_config)
+                    upsert_config, site_key=f"{table_name}/{p}")
         if dedup_config is not None:
             from ..upsert import PartitionDedupMetadataManager
             for p in range(n_parts):
@@ -146,8 +176,35 @@ class RealtimeTableDataManager(TableDataManager):
             self._partition_state(p)
             self._new_mutable(p)
 
+    def _count_stat(self, name: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[name] += n
+        global_metrics.count("ingest_" + name, n)
+
+    def _clean_orphans(self) -> None:
+        """Idempotent-restart hygiene: a crash between the segment build
+        and the checkpoint ``os.replace`` (the commit.crash window)
+        leaves a built artifact directory the durable state never
+        adopted. Remove it — its rows re-consume from the checkpoint,
+        and the next seal reuses the same directory name — plus any torn
+        ``state.json.tmp`` whose rename never happened."""
+        import shutil
+        committed = {s for pstate in self._state.values()
+                     for s in pstate["segments"]}
+        prefix = f"{self.table_name}__"
+        for entry in sorted(os.listdir(self.data_dir)):
+            path = os.path.join(self.data_dir, entry)
+            if entry.startswith(prefix) and entry not in committed \
+                    and os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+                self._count_stat("orphans_cleaned")
+        tmp = self._state_path() + ".tmp"
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
     def _replay_metadata(self, p: int, seg: ImmutableSegment) -> None:
         if p in self._upsert:
+            self._count_stat("upsert_replays")
             cfg = self.upsert_config
             pks = self._segment_pks(seg, cfg.pk_columns)
             if cfg.comparison_column is not None:
@@ -227,14 +284,31 @@ class RealtimeTableDataManager(TableDataManager):
             consumer = self.stream_config.consumer_factory.create_consumer(p)
         try:
             total = 0
+            snapped_back = False
             while True:
+                if faults.active() and faults.fault_fires(
+                        "stream.rebalance", f"{self.table_name}/{p}"):
+                    self._rebalance_reset(p)
                 m = self._mutables[p]
                 # never overshoot the seal threshold inside one batch
                 room = max(1, self.stream_config.flush_threshold_rows
                            - m.n_docs)
                 offset = self._stream_offset(p, m.n_docs)
-                batch: MessageBatch = consumer.fetch(
-                    offset, min(FETCH_BATCH, room))
+                t_fetch = time.monotonic()
+                try:
+                    batch: MessageBatch = self._fetch_with_retry(
+                        consumer, offset, min(FETCH_BATCH, room))
+                except OffsetOutOfRange:
+                    # a REAL offset snap-back (log truncation, expired
+                    # iterator): same recovery as the injected
+                    # stream.rebalance — resume from the checkpoint. One
+                    # reset per drain; if the checkpoint offset is gone
+                    # too, propagate to the consume loop's poll backoff
+                    if snapped_back:
+                        raise
+                    snapped_back = True
+                    self._rebalance_reset(p)
+                    continue
                 if not batch.rows:
                     break
                 self._index_rows(p, m, batch.rows, offset)
@@ -248,11 +322,82 @@ class RealtimeTableDataManager(TableDataManager):
                         # dense arithmetic (empty list stays empty)
                         self._row_offsets[p] = []
                 total += len(batch.rows)
+                self._note_batch(len(batch.rows), t_fetch)
                 self._maybe_seal(p)
             return total
         finally:
             if own:
                 consumer.close()
+
+    def _retry_bounded(self, call: Callable[[], Any], stat: str) -> Any:
+        """Bounded retry-with-backoff (StreamConfig.fetch_retries /
+        fetch_backoff_s — one tuning pair for the whole ingest plane):
+        a transient failure (injected or real) must neither kill the
+        consumer thread nor skip work. Each retry bumps ``stat``;
+        exhaustion re-raises and the caller falls back to its
+        poll-again path. IngestCrash is never retried — it IS the
+        process dying."""
+        cfg = self.stream_config
+        for attempt in range(cfg.fetch_retries + 1):
+            try:
+                return call()
+            except faults.IngestCrash:
+                raise
+            except OffsetOutOfRange:
+                raise  # the offset is gone: retrying can never succeed
+            except Exception:
+                if attempt == cfg.fetch_retries:
+                    raise
+                self._count_stat(stat)
+                time.sleep(cfg.fetch_backoff_s * (2 ** attempt))
+        raise AssertionError("unreachable")
+
+    def _fetch_with_retry(self, consumer, offset: int, limit: int
+                          ) -> MessageBatch:
+        """One consumer read under bounded retry: the checkpoint
+        guarantees an exact re-read after exhaustion."""
+        return self._retry_bounded(
+            lambda: consumer.fetch(offset, limit), "stream_retries")
+
+    def _note_batch(self, rows: int, t_fetch: float) -> None:
+        """Freshness accounting per indexed batch: fetch-start ->
+        queryable latency EWMA (rows are queryable the moment they are
+        indexed — snapshot views include them) + the rows/sec inputs."""
+        self._count_stat("rows", rows)
+        lat_ms = (time.monotonic() - t_fetch) * 1e3
+        with self._stats_lock:
+            if self._ingest_t0 is None:
+                self._ingest_t0 = t_fetch
+            f = self._freshness_ms
+            self._freshness_ms = lat_ms if f is None \
+                else 0.8 * f + 0.2 * lat_ms
+            # per-table gauge: two TABLES in one process must not
+            # last-writer-wins each other's freshness on the consoles
+            # (replicas of the same table still share one gauge —
+            # _FRESHNESS_OWNERS guards removal, not the readings)
+            gname = "ingest_freshness_ms_" + self.table_name
+            global_metrics.gauge(gname, round(self._freshness_ms, 3))
+            _FRESHNESS_OWNERS[gname] = id(self)
+
+    def _rebalance_reset(self, p: int) -> None:
+        """Partition offsets snapped back (consumer-group rebalance /
+        OffsetOutOfRange): drop the consuming mutable and resume from
+        the durable checkpoint — the restart path minus the process
+        death. Upsert/dedup PK state polluted by the discarded rows is
+        rebuilt from committed segments only (phantom-duplicate rule,
+        same as _adopt_committed)."""
+        with self._seal_lock:
+            discarded = self._mutables[p].n_docs
+            self._new_mutable(p)
+            self._rebuild_partition_metadata(p)
+        if discarded:
+            # the discarded consuming rows re-consume from the
+            # checkpoint and would be counted again: back them out so
+            # rows / rows_per_s mean DELIVERED rows — the freshness
+            # ledger must not overstate throughput exactly on the chaos
+            # runs it exists to measure
+            self._count_stat("rows", -discarded)
+        self._count_stat("rebalance_resets")
 
     def _index_rows(self, p: int, m: MutableSegment, rows, offset: int
                     ) -> None:
@@ -332,10 +477,15 @@ class RealtimeTableDataManager(TableDataManager):
         name = m.name
         offset = self._stream_offset(p, m.n_docs)
         try:
-            resp = cc.segment_consumed(self.table_name, name, offset)
+            resp = self._completion_rpc(
+                lambda: cc.segment_consumed(self.table_name, name,
+                                            offset))
+        except faults.IngestCrash:
+            raise
         except Exception:
-            return  # controller unreachable: report again next poll;
-            # a network blip must never kill the consumer thread
+            return  # controller unreachable past the bounded retries:
+            # report again next poll (HOLD/CATCHUP re-entry); a network
+            # blip must never kill the consumer thread
         status = resp.get("status")
         if status == "COMMIT":
             # build-then-commit-then-adopt: local durable state advances
@@ -343,21 +493,35 @@ class RealtimeTableDataManager(TableDataManager):
             # a failed commit leaves the mutable live for retry/takeover
             with self._seal_lock:
                 built = self._build_artifact(p)
-                if built is None:
-                    return
-                mm, seg, sealed = built
+            if built is None:
+                return
+            mm, seg, sealed = built
+            ok = False
+            try:
+                from ..cluster.deepstore import pruning_metadata
+                # the RPC (and its retry-backoff ladder) runs OUTSIDE
+                # the table-wide seal lock: a flaky controller must not
+                # stall other partitions' seal/adopt. Partition p's
+                # state can't move underneath us — only p's own
+                # consumer thread seals/adopts/resets p
+                ok = self._completion_rpc(
+                    lambda: cc.split_commit(self.table_name, name,
+                                            seg.dir,
+                                            pruning_metadata(seg.dir)))
+            except faults.IngestCrash:
+                raise
+            except Exception:
                 ok = False
-                try:
-                    from ..cluster.deepstore import pruning_metadata
-                    ok = cc.split_commit(self.table_name, name, seg.dir,
-                                         pruning_metadata(seg.dir))
-                except Exception:
-                    ok = False
-                if ok:
+            if ok:
+                with self._seal_lock:
                     self._commit_local(p, mm, seg, sealed)
-                else:
-                    import shutil
-                    shutil.rmtree(seg.dir, ignore_errors=True)
+            else:
+                # the mutable stays live: the next poll re-reports,
+                # the controller re-elects/continues, and the build
+                # runs again (split-commit re-entry)
+                self._count_stat("commit_failures")
+                import shutil
+                shutil.rmtree(seg.dir, ignore_errors=True)
         elif status == "COMMITTED":
             uri = resp.get("downloadURI")
             if uri is None:
@@ -369,9 +533,19 @@ class RealtimeTableDataManager(TableDataManager):
                 # endOffset metadata, so the replica never stalls forever
                 self._adopt_committed(
                     p, name, uri, None if off is None else int(off))
+            except faults.IngestCrash:
+                raise
             except Exception:
-                pass  # deep store unreachable: retry on the next poll
+                # deep store stalled/corrupt (handoff.stall) or
+                # unreachable: retry on the next poll
+                self._count_stat("handoff_retries")
         # CATCHUP / HOLD: keep consuming / report again next poll
+
+    def _completion_rpc(self, call: Callable[[], Any]) -> Any:
+        """A completion-protocol RPC (injected commit.http_error or a
+        real controller blip) under bounded retry; exhaustion falls back
+        to report-again-next-poll at the caller."""
+        return self._retry_bounded(call, "commit_retries")
 
     def _adopt_committed(self, p: int, name: str, download_uri: str,
                          end_offset: Optional[int]) -> None:
@@ -381,14 +555,30 @@ class RealtimeTableDataManager(TableDataManager):
         download)."""
         from ..cluster.deepstore import download_segment
         with self._seal_lock:
+            if name in self._partition_state(p)["segments"]:
+                return
+        # the download (and any handoff stall, injected or real) runs
+        # OUTSIDE the table-wide seal lock — same rule as the
+        # split-commit RPC: one wedged deep store must not freeze other
+        # partitions' seal/adopt. Only p's own consumer thread adopts p,
+        # so p's state can't move underneath us
+        seg_dir = download_segment(download_uri, self.data_dir)
+        seg = ImmutableSegment.load(seg_dir)
+        recount = 0
+        with self._seal_lock:
             st = self._partition_state(p)
             if name in st["segments"]:
                 return
-            seg_dir = download_segment(download_uri, self.data_dir)
-            seg = ImmutableSegment.load(seg_dir)
             if end_offset is None:
                 end_offset = seg.metadata.get(
                     "endOffset", st["next_offset"] + seg.n_docs)
+            # the consuming tail past the adopted artifact's end will be
+            # fetched (and counted) again: back it out below so
+            # rows/rows_per_s keep meaning DELIVERED rows (approximate
+            # under gapped kinesis sequence numbers, exact for dense)
+            m = self._mutables[p]
+            recount = max(0, self._stream_offset(p, m.n_docs)
+                          - int(end_offset))
             self.add_segment(seg)
             st["next_offset"] = end_offset
             st["seq"] += 1
@@ -400,12 +590,14 @@ class RealtimeTableDataManager(TableDataManager):
             # the partition's PK state from committed segments only, or
             # re-consumed rows would be dropped as phantom duplicates
             self._rebuild_partition_metadata(p)
+        if recount:
+            self._count_stat("rows", -recount)
 
     def _rebuild_partition_metadata(self, p: int) -> None:
         if p in self._upsert:
             from ..upsert import PartitionUpsertMetadataManager
             self._upsert[p] = PartitionUpsertMetadataManager(
-                self.upsert_config)
+                self.upsert_config, site_key=f"{self.table_name}/{p}")
         elif p in self._dedup:
             from ..upsert import PartitionDedupMetadataManager
             self._dedup[p] = PartitionDedupMetadataManager(
@@ -453,6 +645,13 @@ class RealtimeTableDataManager(TableDataManager):
     def _commit_local(self, p: int, m, seg: ImmutableSegment,
                       sealed: int) -> None:
         """Second half of the seal: swap + checkpoint + fresh mutable."""
+        if faults.active() and faults.fault_fires("commit.crash", m.name):
+            # the commit.crash window: artifact built (and, on the
+            # protocol path, split-committed) but the checkpoint
+            # os.replace never ran — restart must re-consume the tail
+            # exactly once (orphan cleanup + checkpoint replay)
+            raise faults.IngestCrash(
+                f"injected commit.crash before checkpoint ({m.name})")
         st = self._partition_state(p)
         if p in self._upsert:
             self._upsert[p].remap_segment(m, seg, sealed)
@@ -462,6 +661,7 @@ class RealtimeTableDataManager(TableDataManager):
         st["segments"].append(m.name)
         self._write_state()
         self._new_mutable(p)
+        self._count_stat("commits")
 
     def seal_partition(self, p: int) -> Optional[ImmutableSegment]:
         """CONSUMING -> ONLINE: build, swap, checkpoint (standalone
@@ -488,8 +688,16 @@ class RealtimeTableDataManager(TableDataManager):
         consumer = self.stream_config.consumer_factory.create_consumer(p)
         try:
             while not self._stop.is_set():
-                n = self.consume_once(p, consumer)
-                self._maybe_seal(p)
+                try:
+                    n = self.consume_once(p, consumer)
+                    self._maybe_seal(p)
+                except faults.IngestCrash:
+                    raise  # simulated process death: the loop dies too
+                except Exception:
+                    # transient trouble past the bounded retries: back
+                    # off one poll interval, keep the consumer alive
+                    global_metrics.count("ingest_consume_errors")
+                    n = 0
                 if n == 0:
                     self._stop.wait(self.poll_interval)
         finally:
@@ -500,6 +708,14 @@ class RealtimeTableDataManager(TableDataManager):
         for t in self._threads:
             t.join(timeout)
         self._threads.clear()
+        # drop this table's freshness gauge: ingest_health rolls up the
+        # WORST table, and a dead table's last EWMA would pin it
+        # forever. Owner-guarded: a stopped replica must not delete a
+        # live replica's reading
+        gname = "ingest_freshness_ms_" + self.table_name
+        if _FRESHNESS_OWNERS.get(gname) == id(self):
+            global_metrics.remove_gauge(gname)
+            _FRESHNESS_OWNERS.pop(gname, None)
 
     # -- query integration --------------------------------------------------
     def acquire_segments(self):
@@ -514,3 +730,46 @@ class RealtimeTableDataManager(TableDataManager):
     @property
     def consuming_docs(self) -> int:
         return sum(m.n_docs for m in self._mutables.values())
+
+    # -- freshness ledger ---------------------------------------------------
+    def ingest_stats(self) -> Dict[str, Any]:
+        """The freshness ledger's writer-side view: rows/sec since the
+        first consume, end-to-end freshness (fetch-start -> queryable
+        EWMA, ms), commit/retry/recovery counters, and the faults fired
+        by the installed plan (0 when none). ``faults_fired`` is the
+        plan's PROCESS-WIDE total — a fault plan has no per-table
+        attribution, so multi-table processes see the same number in
+        every table's record; single-table chaos runs that need the
+        per-run count pass it explicitly (tools/chaos_smoke.py)."""
+        with self._stats_lock:
+            stats = dict(self._stats)
+            t0 = self._ingest_t0
+            fresh = self._freshness_ms
+        elapsed = (time.monotonic() - t0) if t0 is not None else 0.0
+        plan = faults.current_plan()
+        # every counter in _stats ships under its own name; a new stat
+        # must only be added to the _stats initializer + the ledger
+        # contract (writer-side validation catches a missed contract)
+        return {
+            "table": self.table_name,
+            **stats,
+            "rows_per_s": round(stats["rows"] / elapsed, 3)
+            if elapsed > 0 else 0.0,
+            "freshness_ms": round(fresh, 3) if fresh is not None else None,
+            "segments": self.num_segments,
+            "consuming_docs": self.consuming_docs,
+            "partitions": len(self._mutables),
+            "faults_fired": len(plan.fired) if plan is not None else 0,
+        }
+
+    def write_ingest_stats(self, path: str, **extra: Any
+                           ) -> Dict[str, Any]:
+        """Append one validated ``ingest_stats`` v2 record (the
+        freshness ledger — utils/ledger.py field contract, enforced
+        writer-side like every other kind; tools/check_ledger.py reports
+        its per-kind count)."""
+        from ..utils import ledger as uledger
+        rec = uledger.make_record("ingest_stats",
+                                  **{**self.ingest_stats(), **extra})
+        uledger.append_record(rec, path)
+        return rec

@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from .kafka import crc32c
 from .stream import MessageBatch, PartitionGroupConsumer, \
-    StreamConsumerFactory
+    StreamConsumerFactory, consume_faults
 
 # BaseCommand.Type values (PulsarApi.proto enum)
 CONNECT, CONNECTED = 2, 3
@@ -310,6 +310,7 @@ class PulsarReaderConsumer(PartitionGroupConsumer):
         return self._req
 
     def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
+        consume_faults(f"pulsar/{self.topic}")
         ledger, entry = unpack_offset(start_offset)
         seek = (_pb_field(1, self.consumer_id)
                 + _pb_field(2, self._next_req())

@@ -20,6 +20,26 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 
 
+class OffsetOutOfRange(Exception):
+    """A consumer's start offset no longer exists on the stream (log
+    truncation/retention, shard reshard, consumer-group rebalance):
+    retrying the same fetch can never succeed. Consumers raise this (or
+    a subclass) instead of their generic transport error so the realtime
+    manager can snap the partition back to its durable checkpoint
+    (manager._rebalance_reset) rather than retry forever."""
+
+
+def consume_faults(key: str) -> None:
+    """The one named ingest-read fault hook (``stream.error``): every
+    consumer's fetch() passes through here before touching its
+    transport, so a seeded plan can fail kafka/kinesis/pulsar/in-memory
+    reads identically. Zero-cost ``is None`` check when no plan is
+    installed (utils/faults.py contract)."""
+    from ..utils import faults
+    if faults.active():
+        faults.fault_point("stream.error", key)
+
+
 @dataclass
 class StreamConfig:
     topic: str
@@ -27,6 +47,10 @@ class StreamConfig:
     # segment sealing thresholds (realtime.segment.flush.threshold.* analog)
     flush_threshold_rows: int = 100_000
     flush_threshold_seconds: float = 3600.0
+    # bounded retry-with-backoff around consumer reads (the manager's
+    # recovery muscle for stream.error-class transport failures)
+    fetch_retries: int = 3
+    fetch_backoff_s: float = 0.02
     consumer_factory: Optional["StreamConsumerFactory"] = None
     # config-named factory (stream.<type>.consumer.factory.class.name
     # analog): resolved via the plugin loader (spi/plugin.py) when no
@@ -97,9 +121,14 @@ class _Partition:
 class InMemoryStream(StreamConsumerFactory):
     def __init__(self, num_partitions: int = 1,
                  partitioner: Optional[Callable[[Mapping[str, Any]], int]]
-                 = None):
+                 = None, name: str = "mem"):
+        """``name`` scopes the stream.error fault site key
+        (``<name>/<partition>``) — give distinct streams distinct names
+        when several consume concurrently in one process, or they share
+        one per-key decision stream (faults.py purity contract)."""
         self._partitions = [_Partition() for _ in range(num_partitions)]
         self._partitioner = partitioner
+        self.name = name
 
     def num_partitions(self) -> int:
         return len(self._partitions)
@@ -122,14 +151,18 @@ class InMemoryStream(StreamConsumerFactory):
             self.produce(r, partition)
 
     def create_consumer(self, partition: int) -> "_InMemoryConsumer":
-        return _InMemoryConsumer(self._partitions[partition])
+        return _InMemoryConsumer(self._partitions[partition], partition,
+                                 self.name)
 
 
 class _InMemoryConsumer(PartitionGroupConsumer):
-    def __init__(self, partition: _Partition):
+    def __init__(self, partition: _Partition, index: int = 0,
+                 name: str = "mem"):
         self._p = partition
+        self._key = f"{name}/{index}"
 
     def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
+        consume_faults(self._key)
         with self._p.lock:
             rows = self._p.rows[start_offset: start_offset + max_messages]
             return MessageBatch(list(rows), start_offset + len(rows))

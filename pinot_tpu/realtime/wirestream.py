@@ -38,7 +38,7 @@ import threading
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 from .stream import MessageBatch, PartitionGroupConsumer, \
-    StreamConsumerFactory
+    StreamConsumerFactory, consume_faults
 
 OP_METADATA, OP_PRODUCE, OP_FETCH, OP_LATEST = 0, 1, 2, 3
 _MAX_FRAME = 64 << 20
@@ -321,9 +321,11 @@ class WireStreamConsumer(PartitionGroupConsumer):
     def __init__(self, host: str, port: int, partition: int,
                  timeout: float):
         self.partition = partition
+        self._key = f"wire/{host}:{port}/{partition}"
         self._conn = _Conn(host, port, timeout)
 
     def fetch(self, start_offset: int, max_messages: int) -> MessageBatch:
+        consume_faults(self._key)
         body = self._conn.call(OP_FETCH, struct.pack(
             ">IQI", self.partition, start_offset, max_messages))
         nxt, n = struct.unpack(">QI", body[:12])

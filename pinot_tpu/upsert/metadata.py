@@ -20,6 +20,19 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 
+def _compact_crash(key: str) -> None:
+    """Named ingest fault hook (``upsert.compact_crash``): simulated
+    process death mid metadata replay / TTL eviction. Raises IngestCrash
+    — the owning realtime manager must be abandoned and restarted; the
+    restart replay rebuilds this manager from committed segments, which
+    is what makes the crash recoverable."""
+    from ..utils import faults
+    if faults.active() and faults.fault_fires("upsert.compact_crash",
+                                              key):
+        raise faults.IngestCrash(
+            f"injected upsert.compact_crash ({key})")
+
+
 @dataclass
 class UpsertConfig:
     pk_columns: List[str]
@@ -46,10 +59,16 @@ class DedupConfig:
 
 
 class PartitionUpsertMetadataManager:
-    """Tracks PK -> (segment_object, doc_id, comparison_value)."""
+    """Tracks PK -> (segment_object, doc_id, comparison_value).
 
-    def __init__(self, config: UpsertConfig):
+    ``site_key`` carries table/partition identity into the
+    upsert.compact_crash fault decisions (faults.py purity contract:
+    per-key streams must not be shared across partitions, or
+    same-seed fault assignment becomes thread-interleaving-dependent)."""
+
+    def __init__(self, config: UpsertConfig, site_key: str = ""):
         self.config = config
+        self.site_key = site_key
         self._map: Dict[Tuple, Tuple[Any, int, Any]] = {}
         self._lock = threading.Lock()
         self._largest_cmp: Any = None   # TTL watermark (reference:
@@ -93,6 +112,7 @@ class PartitionUpsertMetadataManager:
             return 0
         if self._largest_cmp == self._last_evict_watermark:
             return 0   # watermark unchanged: the O(keys) scan is skipped
+        _compact_crash(f"evict/{self.site_key}")
         self._last_evict_watermark = self._largest_cmp
         horizon = self._largest_cmp - ttl
         with self._lock:
@@ -135,6 +155,7 @@ class PartitionUpsertMetadataManager:
                        cmp_vals: List[Any]) -> None:
         """Restart rehydration: replay a committed segment's keys in doc
         order; builds this segment's valid mask and supersedes older ones."""
+        _compact_crash(getattr(segment, "name", "replay"))
         valid = np.ones(len(rows_pk), dtype=bool)
         for c in cmp_vals:
             self._note_cmp(c)

@@ -25,6 +25,29 @@ target these):
                      HeapWatcher would under heap pressure
 ``device.overflow``  force the kernel's compact-overflow retry ladder
                      (engine/executor.run_kernel) — result-identical
+``stream.error``     a consumer read fails (ConnectionError) before the
+                     fetch reaches the stream (realtime/stream.py
+                     ``consume_faults`` — kafka/kinesis/pulsar/in-memory
+                     consumers all pass through it)
+``stream.rebalance`` decision hook: partition offsets snap back — the
+                     realtime manager drops its consuming state and
+                     resumes from the durable checkpoint
+                     (realtime/manager.py)
+``commit.crash``     decision hook: simulated process death between the
+                     segment build and the checkpoint ``os.replace`` —
+                     the site raises ``IngestCrash`` and the manager
+                     must be abandoned and restarted
+``commit.http_error`` the controller-arbitrated commit RPC fails
+                     mid-protocol (HTTPError, cluster/completion.py —
+                     segmentConsumed / commitStart / commitEnd
+                     boundaries)
+``handoff.stall``    a COMMITTED-replica artifact download stalls
+                     (sleep ``delay_ms``) then fails (OSError) —
+                     cluster/deepstore.download_segment; the adopter
+                     retries on its next poll
+``upsert.compact_crash`` decision hook: crash mid upsert-metadata
+                     replay / TTL eviction (upsert/metadata.py) — the
+                     site raises ``IngestCrash``
 ==================== ======================================================
 
 Activation: ``PINOT_FAULTS`` env var at process start, or
@@ -66,6 +89,9 @@ from typing import Any, Dict, List, Optional, Tuple
 FAULT_POINTS = (
     "rpc.drop", "rpc.delay", "rpc.http_error", "wire.corrupt",
     "segment.slow", "accounting.oom_kill", "device.overflow",
+    # ingest fault family (realtime consume -> seal -> commit -> handoff)
+    "stream.error", "stream.rebalance", "commit.crash",
+    "commit.http_error", "handoff.stall", "upsert.compact_crash",
 )
 
 
@@ -74,6 +100,14 @@ class FaultInjected(Exception):
     that are NOT shaped like a real transport error (transport-shaped
     faults raise the real urllib exceptions on purpose — the code under
     test must not be able to tell them apart)."""
+
+
+class IngestCrash(FaultInjected):
+    """Simulated process death inside the ingest plane (commit.crash /
+    upsert.compact_crash). Never caught-and-continued: the realtime
+    manager that raised it must be abandoned and a fresh one restarted
+    from the durable checkpoint — exactly the recovery path a real
+    kill -9 would force."""
 
 
 @dataclass(frozen=True)
@@ -263,11 +297,20 @@ def fault_point(point: str, key: str = "") -> None:
         # genuine failover path, not a special injected one
         raise urllib.error.URLError(
             OSError(f"injected fault rpc.drop ({key})"))
-    if point == "rpc.http_error":
+    if point in ("rpc.http_error", "commit.http_error"):
         raise urllib.error.HTTPError(
             key or "http://injected", spec.http_status,
-            "injected fault rpc.http_error", None,
-            io.BytesIO(b"injected fault rpc.http_error"))
+            f"injected fault {point}", None,
+            io.BytesIO(f"injected fault {point}".encode()))
+    if point == "stream.error":
+        # shaped like a real consumer-transport failure: the manager's
+        # bounded retry-with-backoff must not be able to tell them apart
+        raise ConnectionError(f"injected fault stream.error ({key})")
+    if point == "handoff.stall":
+        # artifact download stalls, then breaks: the adopting replica
+        # retries from its next completion poll
+        time.sleep(spec.delay_ms / 1e3)
+        raise OSError(f"injected fault handoff.stall ({key})")
     raise FaultInjected(f"fault point {point} has no inline effect; "
                         "use fault_fires()/corrupt_bytes()")
 

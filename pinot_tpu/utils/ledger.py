@@ -25,6 +25,10 @@ Kinds:
   health (wall ms, partialResult, exceptions[] codes, hedge/failover
   counts, servers queried/responded), one record per cluster query when
   the broker has a stats ledger configured — chaos soaks trend these.
+- ``ingest_stats``     — realtime/manager.py write_ingest_stats()
+  freshness ledger (rows/sec, end-to-end freshness ms, commit retries,
+  rebalance/replay/orphan recovery counts, faults fired) — the ingest
+  plane's first-class counterpart to query latency.
 """
 from __future__ import annotations
 
@@ -71,6 +75,21 @@ KINDS: Dict[str, Dict[str, set]] = {
         "optional": {"sql", "rows", "segments_queried",
                      "segments_pruned", "hedges", "failovers", "slow",
                      "error", "backend"},
+    },
+    "ingest_stats": {
+        # the freshness ledger (realtime/manager.write_ingest_stats):
+        # rows/sec, end-to-end freshness ms (fetch-start -> queryable
+        # EWMA), commit retries and faults fired — chaos soaks trend
+        # these the way query_stats trends the scatter plane.
+        # faults_fired is the installed plan's PROCESS-WIDE total (no
+        # per-table attribution); chaos runs override it per run
+        "required": {"table", "rows", "rows_per_s", "freshness_ms",
+                     "commits", "commit_retries", "faults_fired"},
+        "optional": {"commit_failures", "rebalance_resets",
+                     "stream_retries", "upsert_replays",
+                     "orphans_cleaned", "handoff_retries", "segments",
+                     "consuming_docs", "partitions", "restarts", "seed",
+                     "backend", "extra"},
     },
 }
 

@@ -7,6 +7,7 @@ text formatter is a render method away.
 """
 from __future__ import annotations
 
+import re
 import threading
 import time
 from contextlib import contextmanager
@@ -27,6 +28,13 @@ class MetricsRegistry:
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = value
+
+    def remove_gauge(self, name: str) -> None:
+        """Drop a gauge (no-op when absent): a stopped table's last
+        freshness EWMA must not pin console rollups forever, and table
+        churn must not grow the gauge set without bound."""
+        with self._lock:
+            self._gauges.pop(name, None)
 
     @contextmanager
     def timer(self, name: str):
@@ -61,6 +69,42 @@ class MetricsRegistry:
         return render_prometheus(self.snapshot())
 
 
+INGEST_COUNTERS = (
+    "ingest_rows", "ingest_commits", "ingest_commit_retries",
+    "ingest_commit_failures", "ingest_rebalance_resets",
+    "ingest_stream_retries", "ingest_upsert_replays",
+    "ingest_orphans_cleaned", "ingest_handoff_retries",
+    # a consumer thread surviving errors past its bounded retries: the
+    # wedged-consumer signal must surface where operators look
+    "ingest_consume_errors",
+)
+
+
+def ingest_health(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """The realtime-plane health block the broker /metrics endpoint and
+    both consoles render next to the round-9 scatter counters: recovery
+    counters (realtime/manager.py ``ingest_*``) + the end-to-end
+    freshness gauges (per table; ``freshness_ms`` is the WORST table —
+    the operationally interesting number when several share a
+    process)."""
+    c = snapshot["counters"]
+    out: Dict[str, Any] = {k: c.get(k, 0) for k in INGEST_COUNTERS}
+    prefix = "ingest_freshness_ms_"
+    by_table = {k[len(prefix):]: v for k, v in snapshot["gauges"].items()
+                if k.startswith(prefix)}
+    out["freshness_by_table"] = by_table
+    out["freshness_ms"] = max(by_table.values()) if by_table else None
+    return out
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize to the Prometheus metric-name alphabet: registry names
+    may embed user-supplied strings (ingest_freshness_ms_<table>), and
+    one illegal character would make Prometheus reject the whole
+    scrape."""
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
 def render_prometheus(snapshot: Dict[str, Any],
                       prefix: str = "pinot_tpu") -> str:
     """Prometheus exposition text from a snapshot — the ONE place the
@@ -68,12 +112,12 @@ def render_prometheus(snapshot: Dict[str, Any],
     both render through here)."""
     lines = []
     for k, v in snapshot["counters"].items():
-        lines.append(f"{prefix}_{k}_total {v}")
+        lines.append(f"{prefix}_{_prom_name(k)}_total {v}")
     for k, v in snapshot["gauges"].items():
-        lines.append(f"{prefix}_{k} {v}")
+        lines.append(f"{prefix}_{_prom_name(k)} {v}")
     for k, t in snapshot["timers"].items():
-        lines.append(f"{prefix}_{k}_ms_p50 {t['p50']:.3f}")
-        lines.append(f"{prefix}_{k}_ms_p99 {t['p99']:.3f}")
+        lines.append(f"{prefix}_{_prom_name(k)}_ms_p50 {t['p50']:.3f}")
+        lines.append(f"{prefix}_{_prom_name(k)}_ms_p99 {t['p99']:.3f}")
     return "\n".join(lines) + "\n"
 
 

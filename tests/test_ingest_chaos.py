@@ -1,0 +1,568 @@
+"""Chaos-hardened realtime ingest (ISSUE 6): the ingest fault family
+(utils/faults.py), the recovery muscle it exercises
+(realtime/manager.py retry/rebalance/restart paths), the
+ingest-vs-oracle fuzzer (pinot_tpu/tools/ingest_fuzz.py), and the
+``ingest_stats`` freshness ledger.
+
+Contract under test (acceptance):
+- new fault points parse, fire deterministically (pure in (seed, point,
+  site key, hit)) and are zero-cost no-ops with no plan installed;
+- a seeded ``commit.crash`` + restart produces exactly-once committed
+  rows (orphan artifact cleaned, checkpoint replay exact);
+- upsert latest-wins survives ``upsert.compact_crash`` mid-replay;
+- for >= 3 seeds with ALL ingest points armed, the post-recovery
+  queryable state is byte-identical to the fault-free oracle, append
+  AND upsert tables, standalone AND completion-protocol modes;
+- every run appends a validated ``ingest_stats`` v2 ledger record and
+  the ingest counters land in global_metrics / the consoles.
+"""
+import os
+import sys
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from pinot_tpu.broker import Broker  # noqa: E402
+from pinot_tpu.realtime import (InMemoryStream,  # noqa: E402
+                                OffsetOutOfRange,
+                                RealtimeTableDataManager, StreamConfig)
+from pinot_tpu.tools import ingest_fuzz as IF  # noqa: E402
+from pinot_tpu.upsert import UpsertConfig  # noqa: E402
+from pinot_tpu.upsert.metadata import (  # noqa: E402
+    PartitionUpsertMetadataManager)
+from pinot_tpu.utils import faults  # noqa: E402
+from pinot_tpu.utils import ledger as uledger  # noqa: E402
+from pinot_tpu.utils.metrics import (global_metrics,  # noqa: E402
+                                     ingest_health)
+
+INGEST_POINTS = ("stream.error", "stream.rebalance", "commit.crash",
+                 "commit.http_error", "handoff.stall",
+                 "upsert.compact_crash")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _counter(name):
+    return global_metrics.snapshot()["counters"].get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# registry: grammar, inline effects, decision purity, zero-cost no-plan
+# ---------------------------------------------------------------------------
+
+def test_ingest_points_registered_and_parse():
+    for pt in INGEST_POINTS:
+        assert pt in faults.FAULT_POINTS
+    p = faults.FaultPlan.parse(IF.ingest_plan(7, protocol=True))
+    assert {s.point for s in p.specs} == set(INGEST_POINTS)
+    assert p.seed == 7
+
+
+def test_ingest_fault_inline_effects():
+    faults.install("stream.error: match=reads; "
+                   "commit.http_error: match=rpcs, http_status=503; "
+                   "handoff.stall: match=dl, delay_ms=20")
+    with pytest.raises(ConnectionError):
+        faults.fault_point("stream.error", "reads")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        faults.fault_point("commit.http_error", "rpcs")
+    assert ei.value.code == 503
+    t0 = time.perf_counter()
+    with pytest.raises(OSError):
+        faults.fault_point("handoff.stall", "dl")
+    assert time.perf_counter() - t0 >= 0.02  # stalls, then breaks
+    # decision hooks for the crash-class points
+    faults.install("commit.crash: times=1; upsert.compact_crash: times=1")
+    assert faults.fault_fires("commit.crash", "seg") is True
+    assert faults.fault_fires("commit.crash", "seg") is False  # spent
+    assert faults.fault_fires("upsert.compact_crash", "k") is True
+
+
+def test_ingest_points_zero_cost_without_plan():
+    assert not faults.active()
+    for pt in ("stream.error", "commit.http_error", "handoff.stall"):
+        faults.fault_point(pt, "anything")          # must not raise
+    assert faults.fault_fires("commit.crash", "seg") is False
+    assert faults.fault_fires("stream.rebalance", "t/0") is False
+    from pinot_tpu.realtime.stream import consume_faults
+    consume_faults("mem/0")                         # no-op passthrough
+
+
+def test_same_seed_identical_ingest_decision_streams():
+    """Purity in (seed, point, site key, hit) for the new points."""
+    def stream(seed):
+        p = faults.FaultPlan.parse(IF.ingest_plan(seed, protocol=True))
+        out = []
+        for pt in INGEST_POINTS:
+            for key in ("a", "b"):
+                out.append([p.decide(pt, key) is not None
+                            for _ in range(40)])
+        return out
+    a, b = stream(5), stream(5)
+    assert a == b
+    assert stream(6) != a
+    # interleaving across keys cannot perturb a key's stream
+    p1 = faults.FaultPlan.parse("seed=3; stream.error: p=0.5")
+    p2 = faults.FaultPlan.parse("seed=3; stream.error: p=0.5")
+    inter = [p1.decide("stream.error", k) is not None
+             for k in ["a", "b"] * 20]
+    block = [p2.decide("stream.error", "a") is not None
+             for _ in range(20)] + \
+            [p2.decide("stream.error", "b") is not None
+             for _ in range(20)]
+    assert [inter[i] for i in range(0, 40, 2)] == block[:20]
+    assert [inter[i] for i in range(1, 40, 2)] == block[20:]
+
+
+# ---------------------------------------------------------------------------
+# recovery muscle units
+# ---------------------------------------------------------------------------
+
+def _manager(tmp_path, stream, threshold=50, upsert=False):
+    cfg = StreamConfig(IF.TABLE, num_partitions=1,
+                       flush_threshold_rows=threshold,
+                       consumer_factory=stream, fetch_backoff_s=0.001)
+    ucfg = UpsertConfig(["pk"], comparison_column="ts") if upsert else None
+    return RealtimeTableDataManager(IF.TABLE, IF.fuzz_schema(), cfg,
+                                    str(tmp_path), upsert_config=ucfg)
+
+
+def test_stream_error_bounded_retry_recovers(tmp_path):
+    """Two injected read failures are absorbed by the bounded
+    retry-with-backoff; no rows lost, no consumer death."""
+    stream = InMemoryStream(1)
+    stream.produce_many(IF.gen_rows(1, 120))
+    dm = _manager(tmp_path, stream, threshold=1000)
+    faults.install("seed=1; stream.error: times=2")
+    r0 = _counter("ingest_stream_retries")
+    n = dm.consume_once(0)
+    faults.clear()
+    assert n == 120 and dm.consuming_docs == 120
+    assert dm.ingest_stats()["stream_retries"] == 2
+    assert _counter("ingest_stream_retries") == r0 + 2
+
+
+def test_rebalance_reset_resumes_from_checkpoint(tmp_path):
+    """Offsets snap back mid-consume: the partition drops its consuming
+    state, resumes from the checkpoint, and the final state is exact."""
+    rows = IF.gen_rows(2, 130)
+    stream = InMemoryStream(1)
+    stream.produce_many(rows)
+    dm = _manager(tmp_path, stream, threshold=50)
+    # fire on the 3rd consume-loop tick: one sealed segment is already
+    # checkpointed, the consuming tail gets discarded and re-read
+    faults.install("seed=2; stream.rebalance: after=2, times=1")
+    dm.consume_once(0)
+    faults.clear()
+    stats = dm.ingest_stats()
+    assert stats["rebalance_resets"] == 1
+    # discarded consuming rows are backed out of the delivered count:
+    # re-consumption must not double-count throughput
+    assert stats["rows"] == 130
+    got = IF.digest(IF.queryable_rows(dm))
+    assert got == IF.digest(IF.oracle_rows(rows, False))
+
+
+def test_real_offset_out_of_range_snaps_to_checkpoint(tmp_path):
+    """A REAL offset snap-back (no fault plan installed): the consumer
+    raises OffsetOutOfRange, which must route to the same checkpoint
+    recovery as the injected stream.rebalance — never a blind retry of
+    a fetch that can never succeed."""
+    rows = IF.gen_rows(7, 80)
+    stream = InMemoryStream(1)
+    stream.produce_many(rows)
+    dm = _manager(tmp_path, stream, threshold=1000)
+    real = stream.create_consumer(0)
+
+    class _Truncated:
+        calls = 0
+
+        def fetch(self, offset, limit):
+            _Truncated.calls += 1
+            if _Truncated.calls == 1:
+                raise OffsetOutOfRange(f"offset {offset} truncated")
+            return real.fetch(offset, limit)
+
+        def close(self):
+            real.close()
+
+    n = dm.consume_once(0, _Truncated())
+    stats = dm.ingest_stats()
+    assert n == 80 and dm.consuming_docs == 80
+    assert stats["rebalance_resets"] == 1
+    assert stats["stream_retries"] == 0  # classified, not blind-retried
+    got = IF.digest(IF.queryable_rows(dm))
+    assert got == IF.digest(IF.oracle_rows(rows, False))
+    # the kafka consumer's out-of-range error takes the same route
+    from pinot_tpu.realtime.kafka import (KafkaError,
+                                          KafkaOffsetOutOfRange)
+    assert issubclass(KafkaOffsetOutOfRange, KafkaError)
+    assert issubclass(KafkaOffsetOutOfRange, OffsetOutOfRange)
+    # ... and so does kinesis: a trimmed/resharded position is
+    # classified at the iterator mint, not blind-retried
+    from pinot_tpu.realtime.kinesis import (KinesisError,
+                                            KinesisOffsetOutOfRange,
+                                            KinesisShardConsumer)
+    assert issubclass(KinesisOffsetOutOfRange, KinesisError)
+    assert issubclass(KinesisOffsetOutOfRange, OffsetOutOfRange)
+
+    class _TrimmedClient:
+        def get_shard_iterator(self, stream, shard, typ, seq=None):
+            raise KinesisError(400, "InvalidArgumentException",
+                               f"sequence {seq} past trim horizon")
+
+    c = KinesisShardConsumer(_TrimmedClient(), "s", "shardId-0")
+    with pytest.raises(KinesisOffsetOutOfRange):
+        c._iterator_for(5)
+
+
+def test_stopped_manager_drops_freshness_gauge(tmp_path):
+    """stop() removes the per-table freshness gauge: a dead table's
+    last EWMA must not pin ingest_health's worst-table rollup. Removal
+    is owner-guarded — a stopped replica never deletes the reading a
+    LIVE replica of the same table wrote last."""
+    stream = InMemoryStream(1)
+    stream.produce_many(IF.gen_rows(9, 30))
+    gname = "ingest_freshness_ms_" + IF.TABLE
+    a = _manager(tmp_path / "a", stream, threshold=1000)
+    a.consume_once(0)
+    assert gname in global_metrics.snapshot()["gauges"]
+    # replica b of the same table writes the gauge after a
+    b = _manager(tmp_path / "b", stream, threshold=1000)
+    b.consume_once(0)
+    a.stop()  # not the latest writer: b's reading must survive
+    assert gname in global_metrics.snapshot()["gauges"]
+    b.stop()
+    assert gname not in global_metrics.snapshot()["gauges"]
+    assert ingest_health(global_metrics.snapshot())[
+        "freshness_by_table"].get(IF.TABLE) is None
+
+
+def test_stream_error_fires_on_every_consumer_backend(tmp_path):
+    """stream.py's contract — EVERY consumer fetch passes through the
+    stream.error hook — holds for the file-log and wire consumers too,
+    not just kafka/kinesis/pulsar/in-memory."""
+    from pinot_tpu.realtime.filestream import FileLogConsumer
+    from pinot_tpu.realtime.wirestream import WireStreamConsumer
+    import inspect
+    faults.install("seed=1; stream.error: p=1")
+    with pytest.raises(ConnectionError):
+        FileLogConsumer(str(tmp_path / "p0.log")).fetch(0, 10)
+    faults.clear()
+    # the wire consumer needs a live socket to construct; the hook call
+    # is pinned structurally instead
+    src = inspect.getsource(WireStreamConsumer.fetch)
+    assert "consume_faults" in src.splitlines()[1]
+
+
+def test_commit_crash_restart_exactly_once(tmp_path):
+    """The acceptance scenario: seeded commit.crash between the segment
+    build and the checkpoint; restart cleans the orphan artifact and
+    re-consumes the tail exactly once."""
+    rows = IF.gen_rows(3, 120)
+    stream = InMemoryStream(1)
+    stream.produce_many(rows)
+    dm = _manager(tmp_path, stream, threshold=50)
+    # budget is per site key (= segment name): match pins the crash to
+    # the FIRST seal only, later segments commit cleanly
+    faults.install("seed=3; commit.crash: match=__0__0, times=1")
+    with pytest.raises(faults.IngestCrash):
+        dm.consume_once(0)  # dies at the first seal's checkpoint window
+    # the artifact was built but never checkpointed: orphan dir on disk,
+    # durable state still at offset 0
+    orphan = os.path.join(str(tmp_path), f"{IF.TABLE}__0__0")
+    assert os.path.isdir(orphan)
+    assert dm._load_state().get("0", {}).get("next_offset", 0) == 0
+
+    dm2 = _manager(tmp_path, stream, threshold=50)  # restart
+    assert not os.path.isdir(orphan)                # orphan cleaned
+    assert dm2.ingest_stats()["orphans_cleaned"] == 1
+    dm2.consume_once(0)
+    faults.clear()
+    # exactly-once: 2 committed segments of 50 + 20 consuming, digests
+    # byte-identical to the fault-free oracle
+    assert dm2.num_segments == 2 and dm2.consuming_docs == 20
+    assert dm2.ingest_stats()["commits"] == 2
+    got = IF.digest(IF.queryable_rows(dm2))
+    assert got == IF.digest(IF.oracle_rows(rows, False))
+    b = Broker()
+    b.register_table(dm2)
+    res = b.query(f"SELECT COUNT(*), SUM(val) FROM {IF.TABLE}")
+    assert [tuple(r) for r in res.rows] == \
+        [(120, sum(r["val"] for r in rows))]
+
+
+def test_upsert_latest_wins_under_compact_crash(tmp_path):
+    """upsert.compact_crash mid metadata replay: the restart that hits
+    it is abandoned, the next one succeeds, and latest-wins is exactly
+    preserved."""
+    rows = IF.gen_rows(4, 150)
+    stream = InMemoryStream(1)
+    stream.produce_many(rows)
+    dm = _manager(tmp_path, stream, threshold=40, upsert=True)
+    dm.consume_once(0)  # 3 committed segments + consuming tail
+    assert dm.num_segments == 3
+    del dm  # process death after a clean checkpoint
+
+    # per-key budget: pin the crash to one committed segment's replay so
+    # exactly one restart attempt dies
+    faults.install("seed=4; upsert.compact_crash: match=__0__1, times=1")
+    with pytest.raises(faults.IngestCrash):
+        _manager(tmp_path, stream, threshold=40, upsert=True)  # replay dies
+    dm2 = _manager(tmp_path, stream, threshold=40, upsert=True)
+    dm2.consume_once(0)  # re-consume the unsealed tail
+    faults.clear()
+    assert dm2.ingest_stats()["upsert_replays"] >= 3
+    got = IF.digest(IF.queryable_rows(dm2))
+    assert got == IF.digest(IF.oracle_rows(rows, True))
+
+
+def test_upsert_evict_crash_is_recoverable():
+    """The TTL-eviction site of upsert.compact_crash: the crash aborts
+    the eviction scan before any state mutates; the retry evicts."""
+    cfg = UpsertConfig(["pk"], comparison_column="ts", metadata_ttl=10)
+    mgr = PartitionUpsertMetadataManager(cfg)
+
+    class _Seg:
+        def invalidate_doc(self, doc):
+            pass
+    s = _Seg()
+    for i, ts in enumerate((1, 2, 30)):
+        mgr.add_row(s, i, {"pk": i, "ts": ts}, i)
+    faults.install("upsert.compact_crash: match=evict, times=1")
+    with pytest.raises(faults.IngestCrash):
+        mgr.evict_expired()
+    assert mgr.num_keys == 3        # crash BEFORE any mutation
+    assert mgr.evict_expired() == 2  # retry: ts 1,2 fell behind 30-10
+    faults.clear()
+    assert mgr.num_keys == 1
+
+
+def test_commit_http_error_reenters_hold_catchup(tmp_path):
+    """Injected completion-RPC failures: bounded retries, then
+    report-again-next-poll — the segment still commits, exactly once."""
+    rows = IF.gen_rows(5, 90)
+    run = IF.IngestRun(str(tmp_path), rows, upsert=False, protocol=True,
+                      threshold=40)
+    faults.install("seed=5; commit.http_error: times=2")
+    m = run.drive()
+    stats = m.ingest_stats()
+    faults.clear()
+    assert stats["commits"] >= 1
+    assert stats["commit_retries"] >= 1
+    assert IF.digest(IF.queryable_rows(m)) == \
+        IF.digest(IF.oracle_rows(rows, False))
+
+
+def test_handoff_stall_download_retries(tmp_path):
+    """handoff.stall breaks the COMMITTED-replica artifact download; the
+    adopter retries on the next poll and converges."""
+    from pinot_tpu.cluster.completion import (LocalCompletionClient,
+                                              SegmentCompletionManager)
+    registry = {}
+    completion = SegmentCompletionManager(
+        lambda t: 2, decision_window_s=0.05,
+        registered_segment=lambda t, s: registry.get((t, s)))
+    stream = InMemoryStream(1)
+    rows = IF.gen_rows(6, 40)
+    stream.produce_many(rows)
+    managers = []
+    for sid in ("rt_a", "rt_b"):
+        cfg = StreamConfig(IF.TABLE, num_partitions=1,
+                           flush_threshold_rows=40,
+                           consumer_factory=stream,
+                           fetch_backoff_s=0.001)
+        cc = LocalCompletionClient(completion, sid,
+                                   f"file://{tmp_path}/deep", registry)
+        m = RealtimeTableDataManager(IF.TABLE, IF.fuzz_schema(), cfg,
+                                     str(tmp_path / sid),
+                                     completion_client=cc)
+        m.report_interval_s = 0.0
+        managers.append(m)
+    for m in managers:
+        m.consume_once(0)
+    faults.install("seed=6; handoff.stall: times=1, delay_ms=1")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        for m in managers:
+            m._maybe_seal(0)
+        if all(m._partition_state(0)["segments"] == [f"{IF.TABLE}__0__0"]
+               for m in managers):
+            break
+        time.sleep(0.02)
+    faults.clear()
+    # the loser's first download stalled+broke (handoff_retries), yet
+    # both replicas converged on the committed artifact
+    assert all(m._partition_state(0)["next_offset"] == 40
+               for m in managers)
+    assert sum(m.ingest_stats()["handoff_retries"]
+               for m in managers) >= 1
+    for m in managers:
+        assert sum(s.n_docs for s in m.acquire_segments()) == 40
+
+
+# ---------------------------------------------------------------------------
+# ingest-vs-oracle fuzz gate (the slow soak widens seeds and rows)
+# ---------------------------------------------------------------------------
+
+def _fuzz_case(tmp, seed, rows, upsert, protocol):
+    m, plan, restarts = IF.run_one(
+        os.path.join(tmp, f"s{seed}_{upsert}_{protocol}"), seed, rows,
+        upsert=upsert, protocol=protocol)
+    got = IF.digest(IF.queryable_rows(m))
+    exp = IF.digest(IF.oracle_rows(IF.gen_rows(seed, rows), upsert))
+    assert got == exp, (f"seed={seed} upsert={upsert} "
+                        f"protocol={protocol}: {len(got)} rows vs "
+                        f"oracle {len(exp)} after {restarts} restarts")
+    return plan, restarts, m
+
+
+def test_ingest_vs_oracle_fuzz_gate(tmp_path):
+    """Acceptance: >= 3 seeds, ALL ingest fault points armed, append +
+    upsert tables, standalone + protocol modes — post-recovery state
+    byte-identical to the fault-free oracle, with real injected
+    crash/restarts along the way."""
+    fired, restarts_total = set(), 0
+    for seed in (40, 50, 57):
+        for upsert, protocol in ((False, False), (True, True)):
+            plan, restarts, _m = _fuzz_case(str(tmp_path), seed, 300,
+                                            upsert, protocol)
+            fired |= {f["point"] for f in plan.fired}
+            restarts_total += restarts
+    assert fired >= set(INGEST_POINTS), f"missed {set(INGEST_POINTS) - fired}"
+    assert restarts_total >= 3  # the gate actually crash/restarted
+
+
+def test_same_seed_identical_ingest_runs(tmp_path):
+    """Determinism end-to-end: one seed, two full chaos runs over fresh
+    dirs => identical fired-fault streams AND identical final digests."""
+    outs = []
+    for tag in ("a", "b"):
+        m, plan, restarts = IF.run_one(str(tmp_path / tag), 51, 300,
+                                       upsert=True, protocol=True)
+        outs.append((plan.fired_summary(), restarts,
+                     IF.digest(IF.queryable_rows(m))))
+    assert outs[0] == outs[1]
+    assert len(outs[0][0]) > 0
+
+
+@pytest.mark.slow
+def test_ingest_chaos_soak(tmp_path):
+    """Randomized (seeded) wide soak: many seeds, bigger row counts,
+    every table kind/mode — nightly `-m slow` lane."""
+    for seed in range(60, 70):
+        for upsert, protocol in ((False, False), (False, True),
+                                 (True, False), (True, True)):
+            _fuzz_case(str(tmp_path), seed, 800, upsert, protocol)
+
+
+# ---------------------------------------------------------------------------
+# freshness ledger + counters + consoles + CLI
+# ---------------------------------------------------------------------------
+
+def test_ingest_stats_ledger_contract(tmp_path):
+    rec = uledger.make_record(
+        "ingest_stats", table="t", rows=10, rows_per_s=5.0,
+        freshness_ms=1.2, commits=1, commit_retries=0, faults_fired=3)
+    assert not uledger.validate_record(rec)
+    with pytest.raises(ValueError, match="missing required"):
+        uledger.make_record("ingest_stats", table="t", rows=10)
+    with pytest.raises(ValueError, match="unknown fields"):
+        uledger.make_record(
+            "ingest_stats", table="t", rows=1, rows_per_s=1.0,
+            freshness_ms=None, commits=0, commit_retries=0,
+            faults_fired=0, typo_field=1)
+
+
+def test_manager_writes_validated_ingest_stats(tmp_path):
+    rows = IF.gen_rows(7, 80)
+    stream = InMemoryStream(1)
+    stream.produce_many(rows)
+    dm = _manager(tmp_path / "srv", stream, threshold=30)
+    dm.consume_once(0)
+    path = str(tmp_path / "ledger.jsonl")
+    rec = dm.write_ingest_stats(path, seed=7, restarts=0)
+    assert rec["kind"] == "ingest_stats" and rec["rows"] == 80
+    assert rec["commits"] == 2 and rec["freshness_ms"] is not None
+    res = uledger.validate_file(path)
+    assert not res["errors"] and res["kinds"] == {"ingest_stats": 1}
+    # tools/check_ledger.py reports the per-kind count
+    import check_ledger
+    assert check_ledger.check(path) == 0
+
+
+def test_ingest_counters_exported(tmp_path):
+    base = {k: _counter(k) for k in ("ingest_rows", "ingest_commits",
+                                     "ingest_commit_retries",
+                                     "ingest_rebalance_resets",
+                                     "ingest_upsert_replays",
+                                     "ingest_orphans_cleaned")}
+    IF.run_one(str(tmp_path), 40, 300, upsert=True, protocol=True)
+    snap = global_metrics.snapshot()
+    c = snap["counters"]
+    assert c.get("ingest_rows", 0) > base["ingest_rows"]
+    assert c.get("ingest_commits", 0) > base["ingest_commits"]
+    assert c.get("ingest_upsert_replays", 0) > \
+        base["ingest_upsert_replays"]
+    # the console block both UIs render (broker /metrics "ingest" and
+    # controller /ui/data "ingest" route through ingest_health)
+    block = ingest_health(snap)
+    for k in ("ingest_rows", "ingest_commit_retries",
+              "ingest_rebalance_resets", "ingest_upsert_replays",
+              "ingest_orphans_cleaned", "freshness_ms"):
+        assert k in block
+    assert block["freshness_ms"] is not None
+
+
+def test_prometheus_sanitizes_user_supplied_metric_names():
+    """ingest_freshness_ms_<table> embeds a user-supplied table name:
+    the Prometheus renderer must map it into the legal metric-name
+    alphabet or one oddly-named table kills the whole scrape."""
+    from pinot_tpu.utils.metrics import MetricsRegistry
+    r = MetricsRegistry()
+    r.gauge("ingest_freshness_ms_web-events.v2", 3.2)
+    r.count("ingest_rows", 1)
+    text = r.prometheus()
+    assert "pinot_tpu_ingest_freshness_ms_web_events_v2 3.2" in text
+    assert "web-events" not in text and ".v2" not in text
+    assert "pinot_tpu_ingest_rows_total 1" in text
+
+
+def test_controller_ui_data_carries_ingest_block(tmp_path):
+    from pinot_tpu.cluster import Controller
+    ctrl = Controller(str(tmp_path / "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=5.0)
+    try:
+        data = ctrl.ui_data()
+        assert "ingest" in data
+        assert "freshness_ms" in data["ingest"]
+        assert "realtime ingest" in ctrl.ui_page()
+    finally:
+        ctrl.stop()
+
+
+def test_chaos_smoke_ingest_cli(capsys):
+    """CLI wiring at a non-default --rows: recovery + ledger still gate,
+    while the all-points check (calibrated for the default --seeds/--rows
+    only, and pinned at those values by test_ingest_vs_oracle_fuzz_gate)
+    reports itself skipped instead of failing spuriously."""
+    import chaos_smoke
+    assert chaos_smoke.main(["--ingest", "--rows", "200"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = __import__("json").loads(out[-1])
+    assert summary["ok"] and summary["mode"] == "ingest"
+    assert summary["runs"] == 6
+    assert summary["ingest_stats"] >= summary["runs"]
+    assert "skipped" in summary["points_gate"]
+    assert set(summary["points"]) <= set(INGEST_POINTS)

@@ -1,4 +1,5 @@
-"""Chaos smoke: seeded fault plans over a live 2-server cluster.
+"""Chaos smoke: seeded fault plans over a live 2-server cluster, plus a
+seeded ingest chaos mode (``--ingest``).
 
 The fault-tolerance acceptance gate (tier-1 runs this through
 tests/test_faults.py, alongside check_ledger/check_static): build a
@@ -20,10 +21,23 @@ fault-free digests for a small SSB query set, then re-run under seeded
    hedge/failover trend lines — ROADMAP round-9 item d), including at
    least one ``partial=true`` record from the replication-1 plan.
 
+``--ingest`` runs the realtime-plane gate instead
+(pinot_tpu/tools/ingest_fuzz.py harness, tier-1 via
+tests/test_ingest_chaos.py): for each seed, drive seeded row sequences
+through an append table (standalone seal) AND an upsert table (full
+completion protocol + deep store) with every ingest fault point armed
+— stream.error / stream.rebalance / commit.crash / commit.http_error /
+handoff.stall / upsert.compact_crash — restarting from the checkpoint
+on each injected crash, and assert (a) the final queryable state is
+digest-exact vs the fault-free oracle (exactly-once across
+crash/restart, upsert latest-wins preserved) and (b) every run
+appended a validated ``ingest_stats`` freshness-ledger record.
+
 Prints one summary JSON line last, check_ledger-style; exit 0 when all
 assertions hold.
 
     python tools/chaos_smoke.py [--rows N] [--seed N]
+    python tools/chaos_smoke.py --ingest [--rows N] [--seeds 40,50,57]
 """
 from __future__ import annotations
 
@@ -145,14 +159,114 @@ def _iter_stats(path: str, partial=None):
             yield rec
 
 
+# seeds/rows whose decision streams fire EVERY ingest fault point
+# (verified by test_ingest_chaos's all-points gate; re-scan if the plan
+# changes). The all-points check is calibrated for exactly these values
+# — other --seeds/--rows still gate digest-exact recovery + the ledger,
+# but fire whatever subset of points their decision streams produce
+INGEST_SEEDS = (40, 50, 57)
+INGEST_ROWS = 300
+
+
+def main_ingest(args) -> int:
+    """--ingest: seeded chaos over the realtime plane, digest-exact
+    recovery + a validated ingest_stats freshness-ledger record per
+    run."""
+    from pinot_tpu.tools import ingest_fuzz as IF
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils import ledger as uledger
+
+    seeds = tuple(int(s) for s in args.seeds.split(","))
+    tmp = tempfile.mkdtemp(prefix="ptpu_ingest_chaos_")
+    ledger_path = os.path.join(tmp, "ingest_stats.jsonl")
+    failures = []
+    summary = {"mode": "ingest", "rows": args.rows, "seeds": list(seeds),
+               "runs": 0, "faults_fired": 0, "restarts": 0,
+               "points": []}
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+            print(f"FAIL {name}: {detail}")
+
+    faults.clear()
+    points = set()
+    try:
+        for seed in seeds:
+            for upsert, protocol in ((False, False), (True, True)):
+                tag = (f"seed{seed}."
+                       + ("upsert" if upsert else "append")
+                       + (".protocol" if protocol else ""))
+                run_dir = os.path.join(tmp, tag)
+                try:
+                    m, plan, restarts = IF.run_one(
+                        run_dir, seed, args.rows, upsert=upsert,
+                        protocol=protocol)
+                except Exception as e:  # noqa: BLE001 — into the summary
+                    check(tag, False, f"EXC {type(e).__name__}: {e}")
+                    continue
+                summary["runs"] += 1
+                summary["faults_fired"] += len(plan.fired)
+                summary["restarts"] += restarts
+                points |= {f["point"] for f in plan.fired}
+                got = IF.digest(IF.queryable_rows(m))
+                exp = IF.digest(IF.oracle_rows(
+                    IF.gen_rows(seed, args.rows), upsert))
+                check(f"{tag}.digest", got == exp,
+                      f"{len(got)} rows vs oracle {len(exp)} after "
+                      f"{restarts} restarts")
+                m.write_ingest_stats(ledger_path, seed=seed,
+                                     restarts=restarts,
+                                     faults_fired=len(plan.fired))
+        summary["points"] = sorted(points)
+        if seeds == INGEST_SEEDS and args.rows == INGEST_ROWS:
+            check("points.all_fired",
+                  points >= {"stream.error", "stream.rebalance",
+                             "commit.crash", "commit.http_error",
+                             "handoff.stall", "upsert.compact_crash"},
+                  f"only {sorted(points)} fired across seeds {seeds}")
+        else:
+            summary["points_gate"] = \
+                "skipped: all-points check is calibrated for the " \
+                "default --seeds/--rows only"
+        # the freshness ledger: one VALIDATED ingest_stats record per run
+        res = uledger.validate_file(ledger_path)
+        n_stats = res["kinds"].get("ingest_stats", 0)
+        summary["ingest_stats"] = n_stats
+        check("ingest_stats.valid", not res["errors"],
+              f"invalid records: {res['errors'][:3]}")
+        check("ingest_stats.count", n_stats >= summary["runs"]
+              and n_stats >= 1,
+              f"{n_stats} records for {summary['runs']} runs")
+    finally:
+        faults.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=4096)
+    ap.add_argument("--rows", type=int, default=None,
+                    help="table rows (default: 4096 cluster mode, "
+                         "300/seeded-run ingest mode)")
     ap.add_argument("--seed", type=int, default=20260804)
     ap.add_argument("--queries", default=",".join(SMOKE_QUERY_IDS),
                     help="comma-separated SSB qids (tier-1 runs a "
                          "2-query subset to protect the suite budget)")
+    ap.add_argument("--ingest", action="store_true",
+                    help="run the realtime ingest chaos gate instead "
+                         "of the cluster query gate")
+    ap.add_argument("--seeds", default=",".join(map(str, INGEST_SEEDS)),
+                    help="--ingest mode seeds (comma-separated)")
     args = ap.parse_args(argv)
+    if args.rows is None:
+        args.rows = INGEST_ROWS if args.ingest else 4096
+    if args.ingest:
+        return main_ingest(args)
 
     from pinot_tpu.cluster.http_util import http_json
     from pinot_tpu.utils import faults
