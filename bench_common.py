@@ -21,8 +21,10 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -342,9 +344,76 @@ def disarm_capture_guard() -> None:
     _GUARD["armed"] = False
 
 
+def span_regression_gate(ledger_path: str | None = None,
+                         capture_if_empty: bool = True) -> dict | None:
+    """tools/span_diff.py check vs the checked-in
+    tools/span_baseline.json — the per-phase regression gate, run at
+    bench time so a phase regression fails THIS capture instead of
+    waiting for a human to diff the next round. Checks ``ledger_path``'s
+    query_trace records when they overlap the baseline corpus; bench
+    ledgers normally carry none (bench_capture records only), so the
+    gate then captures a fresh corpus run (span_diff capture, the same
+    seeded queries the baseline was built from) and checks that —
+    otherwise the gate would be a structurally vacuous green. Returns
+    the check summary (ok flag included), or None when there is no
+    baseline (vacuous pass)."""
+    baseline = os.path.join(REPO, "tools", "span_baseline.json")
+    ledger_path = ledger_path or LEDGER
+    if not os.path.exists(baseline):
+        return None
+    span_diff = os.path.join(REPO, "tools", "span_diff.py")
+
+    def run_check(path: str) -> dict:
+        proc = subprocess.run(
+            [sys.executable, span_diff, "check", path,
+             "--baseline", baseline],
+            capture_output=True, text=True, timeout=120)
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        summary["ok"] = proc.returncode == 0
+        return summary
+
+    try:
+        summary = None
+        if os.path.exists(ledger_path):
+            summary = run_check(ledger_path)
+            summary["source"] = "ledger"
+        if capture_if_empty and (
+                summary is None or not summary.get("shapes_checked")):
+            tmp = os.path.join(
+                tempfile.mkdtemp(prefix="ptpu_span_gate_"),
+                "trace.jsonl")
+            try:
+                proc = subprocess.run(
+                    [sys.executable, span_diff, "capture",
+                     "--out", tmp, "--iters", "3"],
+                    capture_output=True, text=True, timeout=300)
+                if proc.returncode != 0:
+                    return {"ok": True, "skipped":
+                            "capture failed: " + proc.stderr[-200:]}
+                summary = run_check(tmp)
+                summary["source"] = "capture"
+            finally:
+                shutil.rmtree(os.path.dirname(tmp), ignore_errors=True)
+        return summary
+    except Exception as e:  # the gate must never lose a capture
+        return {"ok": True, "skipped": f"{type(e).__name__}: {e}"}
+
+
 def finish(out: dict, backend: str, all_ok: bool) -> None:
-    """Shared tail: ledger compare+append, print the ONE JSON line, exit."""
+    """Shared tail: ledger compare+append, span-diff regression gate,
+    print the ONE JSON line, exit."""
     disarm_capture_guard()
+    gate = span_regression_gate()
+    if gate is not None:
+        # ALWAYS surfaced, including skips — a gate silently disabled
+        # by a broken checker must be visible in the bench summary
+        out["span_gate"] = gate
+        if not gate.get("ok", True):
+            all_ok = False
+            n_reg = len(gate.get("regressions") or [])
+            out.setdefault(
+                "error", "span_diff phase-regression gate failed "
+                         f"({n_reg} regression(s))")
     prev = ledger_last(out["metric"], backend, out.get("n_rows"))
     d = ledger_deltas(out, prev)
     if d is not None:

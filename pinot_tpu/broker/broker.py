@@ -79,13 +79,22 @@ class QueryTimeoutError(SqlError):
 
 
 class Broker:
-    def __init__(self):
+    def __init__(self, trace_ratio: Optional[float] = None,
+                 trace_ledger_path: Optional[str] = None):
         from .quota import QueryQuotaManager
         self._tables: Dict[str, TableDataManager] = {}
         # name -> view body statement (CREATE VIEW ... AS <select>);
         # expanded into CTEs at reference time (_expand_views)
         self._views: Dict[str, Any] = {}
         self.quota = QueryQuotaManager()
+        # traceRatio production sampling (round 12): constructor wins,
+        # then PINOT_TRACE_RATIO, then off (the shared
+        # forensics.default_trace_ratio chain). OPTION(traceRatio=...)
+        # overrides per query; sampled queries land validated
+        # query_trace ledger records without EXPLAIN ANALYZE.
+        from ..cluster.forensics import default_trace_ratio
+        self._trace_ratio = default_trace_ratio(trace_ratio)
+        self._trace_ledger_path = trace_ledger_path
 
     # -- table registry (ideal-state analog) -------------------------------
     def register_table(self, dm: TableDataManager) -> None:
@@ -120,7 +129,75 @@ class Broker:
         if isinstance(stmt, DdlStmt):
             return self._execute_ddl(stmt, t0)
         stmt._raw_sql = sql  # for the EXPLAIN ANALYZE ledger record
+        # traceRatio production sampling: plan-only (EXPLAIN) and
+        # analyze statements never sample; the decision is
+        # deterministic in the query id (utils/spans.sample_decision)
+        # and costs nothing when it comes up unsampled.
+        if not getattr(stmt, "analyze", False) and \
+                not getattr(stmt, "explain", False):
+            from ..cluster.forensics import parse_trace_ratio
+            ratio = parse_trace_ratio(
+                getattr(stmt, "options", {}) or {}, self._trace_ratio)
+            if ratio > 0:
+                from ..utils.spans import sample_decision
+                # OPTION(queryId=...) lets replicas/retries of the same
+                # logical query agree on the decision; otherwise a
+                # fresh uuid draws independently per broker
+                opts = getattr(stmt, "options", {}) or {}
+                qid = str(opts.get("queryId")
+                          or uuid.uuid4().hex[:12])[:64]
+                if sample_decision(qid, ratio):
+                    return self._execute_sampled(stmt, sql, t0, qid)
         return self._execute_stmt(stmt, t0)
+
+    def _execute_sampled(self, stmt, sql: str, t0: float,
+                         qid: str) -> ResultTable:
+        """A traceRatio-sampled production query: execute under the span
+        tracer (the EXPLAIN ANALYZE machinery, minus the rendered rows)
+        and append a validated ``query_trace`` ledger record cross-linked
+        by qid. Subqueries/CTEs/set-op branches recurse through
+        _execute_stmt, so the whole statement lands in ONE tree."""
+        from ..utils.spans import span_tracer
+        root = span_tracer.start(ph.QUERY,
+                                 table=getattr(stmt, "table", None),
+                                 query_id=qid, sampled=True)
+        try:
+            try:
+                result = self._execute_stmt(stmt, t0)
+            finally:
+                root = span_tracer.stop() or root
+        except SqlError as e:
+            # a failed sampled query still lands its (partial) tree —
+            # those are exactly the spans forensics wants
+            root.annotate(error=str(e)[:200])
+            self._append_trace(root, stmt, sql, qid)
+            raise
+        root.annotate(rows=len(result.rows))
+        self._append_trace(root, stmt, sql, qid)
+        return result
+
+    def _append_trace(self, root, stmt, sql: str, qid: str) -> None:
+        global_metrics.count("sampled_traces")
+        import os
+
+        from ..utils import ledger as uledger
+        # explicit-ledger-only, like QueryForensics.record_trace: no
+        # configured path means the trace is counted but not persisted —
+        # an implicit CWD PERF_LEDGER.jsonl write would pollute the repo
+        # bench ledger (and the span-diff gate reading it) with traces
+        # from whatever code version happens to be running
+        path = (getattr(stmt, "options", {}).get("ledgerPath")
+                or self._trace_ledger_path
+                or os.environ.get("PINOT_TPU_LEDGER_PATH"))
+        if not path:
+            return
+        try:
+            uledger.append_record(
+                uledger.trace_record(root, sql, qid=qid, sampled=True),
+                path)
+        except OSError:
+            # observability must never fail the data path
+            global_metrics.count("query_trace_write_errors")
 
     # -- views (QueryEnvironment view catalog analog) ----------------------
     def _execute_ddl(self, stmt: DdlStmt, t0: float) -> ResultTable:

@@ -27,8 +27,9 @@ from ..query.context import build_query_context
 from ..query.sql import SetOpStmt, SqlError, parse_sql, to_sql
 from ..utils import phases as ph
 from ..utils.metrics import global_metrics, ingest_health
-from ..utils.spans import Span, span, span_tracer
-from .forensics import QueryForensics, parse_slow_query_ms
+from ..utils.spans import Span, sample_decision, span, span_tracer
+from .forensics import (QueryForensics, parse_slow_query_ms,
+                        parse_trace_ratio)
 from .http_util import (JsonHandler, http_json, http_raw,
                         inject_trace_context, start_http)
 
@@ -84,11 +85,34 @@ class ScatterResult:
     partial: bool = False
     failovers: int = 0
     hedges: int = 0
-    # failovers increments from call() on POOL threads — int += is a
-    # non-atomic read-modify-write (the same race _rr hit before its
-    # itertools.count fix), so it mutates under this lock
+    # serde vs true-network split of the round-10 net gap, summed over
+    # this scatter's calls: serde_ms = server-side frame encode +
+    # broker-side decode; net_ms = call wall - remote tree - serde
+    # (only measured on sampled/traced calls, where the remote tree
+    # exists to subtract)
+    serde_ms: float = 0.0
+    net_ms: float = 0.0
+    # failovers/serde/net increment from call() on POOL threads —
+    # float/int += is a non-atomic read-modify-write (the same race _rr
+    # hit before its itertools.count fix), so they mutate under this lock
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
+    # set when the gather returns: an ABANDONED hedge straggler's late
+    # response must not add its serde/net to a query_stats record that
+    # is being (or has been) written — the span plane snapshots
+    # `collect` for the same reason
+    _closed: bool = field(default=False, repr=False, compare=False)
+
+    def add_wire_times(self, serde: float, net: float = 0.0) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self.serde_ms += serde
+            self.net_ms += net
+
+    def close_wire_times(self) -> None:
+        with self._lock:
+            self._closed = True
 
 
 class FailureDetector:
@@ -135,15 +159,18 @@ class BrokerNode:
                  routing_refresh: float = 0.3,
                  instance_selector: str = "balanced",
                  slow_query_ms: Optional[float] = None,
-                 query_stats_path: Optional[str] = None):
+                 query_stats_path: Optional[str] = None,
+                 trace_ratio: Optional[float] = None):
         from ..broker.quota import QueryQuotaManager
         from ..broker.routing import make_selector
         self.controller_url = controller_url
         self.routing_refresh = routing_refresh
         # forensics plane: slow-query ring (GET /debug/queries) + the
         # optional per-query query_stats ledger (chaos soak trend lines)
+        # + the traceRatio production-sampling default (round 12)
         self.forensics = QueryForensics(slow_query_ms=slow_query_ms,
-                                        ledger_path=query_stats_path)
+                                        ledger_path=query_stats_path,
+                                        trace_ratio=trace_ratio)
         self._routing: Dict[str, Any] = {"version": -1}
         # round-robin cursor for explain/failover re-picks. An itertools
         # counter, not an int += 1: _pick_replica runs on pool threads
@@ -252,22 +279,61 @@ class BrokerNode:
                 "view DDL runs on the in-process broker (views are "
                 "broker-local state; the networked broker carries no "
                 "catalog yet)")
-        # validate the forensics option up front (400-class, pre-dispatch)
-        slow_ms = parse_slow_query_ms(getattr(stmt, "options", {}) or {},
+        # validate the forensics options up front (400-class, pre-dispatch)
+        options = getattr(stmt, "options", {}) or {}
+        slow_ms = parse_slow_query_ms(options,
                                       self.forensics.default_slow_ms)
+        ratio = parse_trace_ratio(options, self.forensics.trace_ratio)
         if getattr(stmt, "analyze", False):
             return self._query_analyze(stmt, sql, t0, slow_ms)
-        qid = uuid.uuid4().hex[:12]
+        # a client-supplied OPTION(queryId=...) is what makes the
+        # deterministic sampling decision hold ACROSS broker replicas
+        # and client retries — without it each broker draws a fresh
+        # uuid and only same-broker machinery (failover/hedge attempts,
+        # which share this qid via traceContext) agrees
+        qid = str(options.get("queryId") or uuid.uuid4().hex[:12])[:64]
+        # traceRatio production sampling: deterministic in the qid so
+        # replicas/retries agree when the client names the query; a
+        # sampled query roots the SAME span tree EXPLAIN ANALYZE uses
+        # (the scatter then propagates sampled=true traceContext to
+        # every server), zero spans when unsampled. EXPLAIN (plan-only)
+        # queries never sample.
+        sampled = (not getattr(stmt, "explain", False)
+                   and sample_decision(qid, ratio))
         scatters: List[ScatterResult] = []
         table = getattr(stmt, "table", None)
+        root: Optional[Span] = None
+        if sampled:
+            root = span_tracer.start(ph.QUERY, table=table, query_id=qid,
+                                     sampled=True)
         try:
-            result = self._query_stmt(stmt, sql, t0, qid, scatters)
+            try:
+                result = self._query_stmt(stmt, sql, t0, qid, scatters)
+            finally:
+                if sampled:
+                    # stop on EVERY exit: a leaked thread-local stack
+                    # would silently trace the next query on this
+                    # HTTP worker thread
+                    root = span_tracer.stop() or root
         except SqlError as e:
+            if sampled and root is not None:
+                # the stats record below is flagged traced=true, so the
+                # trace record must exist for the qid join to hold —
+                # a failed query's spans are exactly the wanted ones
+                root.annotate(error=str(e)[:200])
+                self.forensics.record_trace(root, sql, qid)
             self.forensics.record(qid, table, sql, t0, None, scatters,
-                                  slow_ms, error=e)
+                                  slow_ms, trace=root, error=e,
+                                  traced=sampled)
             raise
+        if sampled:
+            root.annotate(rows=len(result.rows),
+                          servers_queried=result.num_servers_queried,
+                          servers_responded=result.num_servers_responded)
+            global_metrics.count("sampled_traces")
+            self.forensics.record_trace(root, sql, qid)
         self.forensics.record(qid, table, sql, t0, result, scatters,
-                              slow_ms)
+                              slow_ms, trace=root, traced=sampled)
         return result
 
     def _query_stmt(self, stmt, sql: str, t0: float, qid: str,
@@ -344,7 +410,8 @@ class BrokerNode:
             # the partial tree still reaches the forensics ring: a failed
             # analyze is exactly when the spans are wanted
             self.forensics.record(qid, table, sql, t0, None, scatters,
-                                  slow_ms, trace=root, error=err)
+                                  slow_ms, trace=root, error=err,
+                                  traced=True)
             raise err
         root.annotate(rows=len(inner.rows),
                       servers_queried=inner.num_servers_queried,
@@ -359,7 +426,7 @@ class BrokerNode:
         result.exceptions = list(inner.exceptions)
         result.time_ms = (time.perf_counter() - t0) * 1e3
         self.forensics.record(qid, table, sql, t0, result, scatters,
-                              slow_ms, trace=root)
+                              slow_ms, trace=root, traced=True)
         return result
 
     @staticmethod
@@ -576,7 +643,7 @@ class BrokerNode:
             # under that iteration (a fresh key insertion could)
             s = Span(ph.SCATTER_CALL, server=server, segments=len(segs),
                      attempt=attempt, span_id=uuid.uuid4().hex[:8],
-                     status=None, error=None, net_ms=None)
+                     status=None, error=None, net_ms=None, serde_ms=None)
             collect.append(s)
             return s
 
@@ -616,7 +683,9 @@ class BrokerNode:
                                timeout=10.0 if rem is None
                                else max(rem, 0.05))
                 raw = corrupt_bytes("wire.corrupt", server, raw)
+                t_dec = time.perf_counter()
                 header, decoded = decode_wire_frame(raw)
+                dec_ms = (time.perf_counter() - t_dec) * 1e3
                 n_run = int(header.get("segmentsQueried", 0))
                 if n_run < len(segs):
                     raise _SegmentShortfall(
@@ -624,18 +693,25 @@ class BrokerNode:
                         f"requested segments (still loading after a "
                         f"reassignment?)")
                 self._failures.record_success(server)
+                # serde vs network split of the round-10 net gap: the
+                # server timed its frame encode (serdeEncodeMs in the
+                # header), the decode was timed above
+                serde = dec_ms + float(header.get("serdeEncodeMs")
+                                       or 0.0)
+                net = 0.0
                 if sp is not None:
                     sp.finish()
                     remote = header.get("trace")
                     if remote:
                         rt = Span.from_dict(remote)
                         sp.children.append(rt)
-                        # the gap between this call span and the remote
-                        # root is network + serialization time
-                        sp.annotate(net_ms=round(
-                            max(sp.duration_ms - rt.duration_ms, 0.0),
-                            3))
-                    sp.annotate(status="ok")
+                        # call span - remote tree - serde = true
+                        # network time
+                        net = max(sp.duration_ms - rt.duration_ms
+                                  - serde, 0.0)
+                        sp.annotate(net_ms=round(net, 3))
+                    sp.annotate(status="ok", serde_ms=round(serde, 3))
+                res.add_wire_times(serde, net)
                 return {"partials": decoded, "segmentsQueried": n_run,
                         "dispatched": [server], "responders": [server]}
             except urllib.error.HTTPError as e:
@@ -708,6 +784,7 @@ class BrokerNode:
                 # Snapshot first — an abandoned straggler can still be
                 # appending its failover attempt from a pool thread, and
                 # list.sort() raises if the list mutates mid-sort
+                res.close_wire_times()
                 if sc_span is not None and collect:
                     done = list(collect)
                     done.sort(key=lambda s: s._t0)

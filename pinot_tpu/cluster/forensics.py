@@ -32,6 +32,7 @@ from ..utils import ledger as uledger
 from ..utils.metrics import global_metrics
 
 DEFAULT_SLOW_QUERY_MS = 500.0
+DEFAULT_TRACE_RATIO = 0.0
 RING_CAPACITY = 128
 
 
@@ -50,13 +51,52 @@ def parse_slow_query_ms(options: Dict[str, Any],
                        "expected a number of milliseconds") from None
 
 
+def ratio_value(raw: Any, what: str = "traceRatio") -> float:
+    """A sampling ratio in [0, 1] or a 400-class SqlError — shared by
+    the per-query option and the broker-default / env configuration so
+    a bad PINOT_TRACE_RATIO fails at startup, not per query."""
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        raise SqlError(f"invalid {what} value {raw!r}; "
+                       "expected a fraction in [0, 1]") from None
+    if not 0.0 <= v <= 1.0:
+        raise SqlError(f"invalid {what} value {raw!r}; "
+                       "expected a fraction in [0, 1]")
+    return v
+
+
+def parse_trace_ratio(options: Dict[str, Any], default: float) -> float:
+    """Validate OPTION(traceRatio=...) pre-dispatch (400-class on a bad
+    value); absent option -> the broker default."""
+    raw = options.get("traceRatio")
+    if raw is None:
+        return default
+    return ratio_value(raw)
+
+
+def default_trace_ratio(override: Optional[float] = None) -> float:
+    """The broker-default sampling ratio, shared by the in-process
+    Broker and BrokerNode/QueryForensics so their precedence can't
+    diverge: constructor override wins, then PINOT_TRACE_RATIO, then
+    off — validated either way, so a bad env value fails at broker
+    startup rather than per query."""
+    if override is not None:
+        return ratio_value(override)
+    env_ratio = os.environ.get("PINOT_TRACE_RATIO")
+    if env_ratio is not None:
+        return ratio_value(env_ratio)
+    return DEFAULT_TRACE_RATIO
+
+
 class QueryForensics:
     """Per-broker forensics state: the slow-query ring and the optional
     query_stats ledger sink."""
 
     def __init__(self, slow_query_ms: Optional[float] = None,
                  ledger_path: Optional[str] = None,
-                 capacity: int = RING_CAPACITY):
+                 capacity: int = RING_CAPACITY,
+                 trace_ratio: Optional[float] = None):
         env_slow = os.environ.get("PINOT_SLOW_QUERY_MS")
         self.default_slow_ms = float(
             slow_query_ms if slow_query_ms is not None
@@ -65,7 +105,11 @@ class QueryForensics:
         self.ledger_path = (ledger_path
                             or os.environ.get("PINOT_QUERY_STATS_LEDGER")
                             or None)
+        # traceRatio production sampling default (OPTION(traceRatio=...)
+        # overrides per query)
+        self.trace_ratio = default_trace_ratio(trace_ratio)
         self.stats_written = 0
+        self.traces_written = 0
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
 
@@ -74,7 +118,8 @@ class QueryForensics:
                result: Optional[Any], scatters: List[Any],
                slow_ms: Optional[float] = None,
                trace: Optional[Any] = None,
-               error: Optional[BaseException] = None) -> Dict[str, Any]:
+               error: Optional[BaseException] = None,
+               traced: bool = False) -> Dict[str, Any]:
         """Build + validate the query_stats record for one completed (or
         failed) cluster query; append it to the stats ledger when one is
         configured, and admit slow/errored/traced queries to the ring.
@@ -107,6 +152,16 @@ class QueryForensics:
             fields["slow"] = True
         if error is not None:
             fields["error"] = str(error)[:300]
+        if traced or trace is not None:
+            # stats<->trace join key: the query_trace record in this
+            # ledger carries the same qid
+            fields["traced"] = True
+        serde = sum(getattr(s, "serde_ms", 0.0) for s in scatters)
+        net = sum(getattr(s, "net_ms", 0.0) for s in scatters)
+        if serde:
+            fields["serde_ms"] = round(serde, 3)
+        if net:
+            fields["net_ms"] = round(net, 3)
         rec = uledger.make_record("query_stats", **fields)
         if self.ledger_path:
             try:
@@ -128,6 +183,26 @@ class QueryForensics:
                 self._ring.append(entry)
         return rec
 
+    def record_trace(self, root: Any, sql: str, qid: str
+                     ) -> Optional[Dict[str, Any]]:
+        """A sampled production query's span tree -> validated
+        ``query_trace`` record in the SAME ledger the query_stats
+        records land in, cross-linked by qid (the stats record carries
+        ``traced: true``). Returns the validated record (None only when
+        no ledger is configured)."""
+        rec = uledger.trace_record(root, sql, qid=qid, sampled=True)
+        if not self.ledger_path:
+            return rec
+        try:
+            uledger.append_record(rec, self.ledger_path)
+            with self._lock:
+                self.traces_written += 1
+        except OSError:
+            # observability must never fail the data path (same policy
+            # as the stats record above)
+            global_metrics.count("query_trace_write_errors")
+        return rec
+
     # -- serving -----------------------------------------------------------
     def snapshot(self, limit: Optional[int] = None) -> Dict[str, Any]:
         """GET /debug/queries payload: newest first."""
@@ -137,7 +212,9 @@ class QueryForensics:
         if limit is not None:
             entries = entries[:max(limit, 0)]
         return {"slowQueryMs": self.default_slow_ms,
+                "traceRatio": self.trace_ratio,
                 "statsLedger": self.ledger_path,
                 "statsWritten": self.stats_written,
+                "tracesWritten": self.traces_written,
                 "count": len(entries),
                 "queries": entries}

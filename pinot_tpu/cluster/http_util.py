@@ -118,9 +118,12 @@ def inject_trace_context(body: Dict[str, Any],
 
 
 def http_raw(method: str, url: str, body: Any = None,
-             timeout: float = 10.0) -> bytes:
+             timeout: float = 10.0,
+             headers: Optional[Dict[str, str]] = None) -> bytes:
     """Raw-bytes response; body may be JSON-able or raw bytes (the latter
-    POSTs as octet-stream — the binary data plane both ways)."""
+    POSTs as octet-stream — the binary data plane both ways). ``headers``
+    adds/overrides request headers (the trace-context side channel for
+    binary-body planes, where the payload is opaque proto bytes)."""
     from ..utils.faults import rpc_faults
     rpc_faults(f"{method} {url}")
     if isinstance(body, (bytes, bytearray)):
@@ -129,13 +132,44 @@ def http_raw(method: str, url: str, body: Any = None,
     else:
         data = json.dumps(body).encode() if body is not None else None
         ctype = "application/json"
+    hdrs = {"Content-Type": ctype}
+    if headers:
+        hdrs.update(headers)
     req = urllib.request.Request(url, data=data, method=method,
-                                 headers={"Content-Type": ctype})
+                                 headers=hdrs)
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return resp.read()
 
 
 def http_json(method: str, url: str, body: Any = None,
-              timeout: float = 10.0) -> Any:
-    payload = http_raw(method, url, body, timeout)
+              timeout: float = 10.0,
+              headers: Optional[Dict[str, str]] = None) -> Any:
+    payload = http_raw(method, url, body, timeout, headers)
     return json.loads(payload) if payload else None
+
+
+# binary-body planes (POST /stage ships StagePlan proto bytes) cannot
+# carry traceContext in the payload; it rides this header instead
+TRACE_HEADER = "X-Pinot-Trace-Context"
+
+
+def trace_context_header(ctx: Optional[Dict[str, Any]]
+                         ) -> Optional[Dict[str, str]]:
+    """traceContext dict -> request-headers dict (None when no ctx)."""
+    if not ctx:
+        return None
+    return {TRACE_HEADER: json.dumps(ctx)}
+
+
+def trace_context_from(headers: Any) -> Optional[Dict[str, Any]]:
+    """Parse the trace-context header off an incoming request; a missing
+    or malformed header is simply an unsampled request — tracing must
+    never fail the data path."""
+    raw = headers.get(TRACE_HEADER) if headers is not None else None
+    if not raw:
+        return None
+    try:
+        ctx = json.loads(raw)
+    except ValueError:
+        return None
+    return ctx if isinstance(ctx, dict) else None

@@ -26,7 +26,8 @@ from ..query.context import build_query_context
 from ..query.sql import parse_sql
 from ..segment.immutable import ImmutableSegment
 from ..server.data_manager import TableDataManager
-from .http_util import JsonHandler, http_json, start_http
+from .http_util import (JsonHandler, http_json, start_http,
+                        trace_context_from)
 
 
 class ServerNode:
@@ -297,12 +298,21 @@ class ServerNode:
                     deadline_ms: Optional[float] = None,
                     trace_ctx: Optional[Dict[str, Any]] = None) -> bytes:
         """Binary data plane: columnar DataBlock partials in one frame.
-        The span tree (when sampled) rides the JSON frame header."""
-        from ..engine.datablock import encode_wire_frame
+        The span tree (when sampled) rides the JSON frame header, along
+        with ``serdeEncodeMs`` — the partial-encode time this side of
+        the wire, so the broker can split its call-span gap into serde
+        vs true network time (the encode is timed BEFORE the header is
+        assembled; header serialization itself is negligible)."""
+        from ..engine.datablock import (encode_partial,
+                                        encode_wire_frame_blocks)
         resp = self.execute(sql, segment_names, deadline_ms=deadline_ms,
                             trace_ctx=trace_ctx)
         raw = resp.pop("partials_raw", [])
-        return encode_wire_frame(resp, raw)
+        t_enc = time.perf_counter()
+        blocks = [encode_partial(p) for p in raw]
+        resp["serdeEncodeMs"] = round(
+            (time.perf_counter() - t_enc) * 1e3, 3)
+        return encode_wire_frame_blocks(resp, blocks)
 
     def handle_reload(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """Reload a hosted table's segments against a (new) table config
@@ -331,9 +341,10 @@ class ServerNode:
         deliver_mailbox_frame(self.mailboxes, data)
         return {"status": "OK"}
 
-    def handle_stage(self, spec: Dict[str, Any]):
+    def handle_stage(self, spec: Dict[str, Any],
+                     trace_ctx: Optional[Dict[str, Any]] = None):
         from ..multistage.dispatch import execute_stage
-        return execute_stage(self, spec)
+        return execute_stage(self, spec, trace_ctx=trace_ctx)
 
     def _make_handler(self):
         node = self
@@ -350,13 +361,16 @@ class ServerNode:
                                            b.get("deadlineMs"),
                                            b.get("traceContext"))),
                 # multi-stage data plane (mailbox.proto analog) + stage
-                # dispatch (worker.proto Submit analog)
+                # dispatch (worker.proto Submit analog; the trace
+                # context rides an HTTP header because the StagePlan
+                # proto body is opaque bytes)
                 ("POST", "/mailbox"): lambda h, b: (
                     200, node.handle_mailbox(b)),
                 ("POST", "/reload"): lambda h, b: (
                     200, node.handle_reload(b)),
                 ("POST", "/stage"): lambda h, b: (
-                    200, node.handle_stage(b)),
+                    200, node.handle_stage(b, trace_context_from(
+                        h.headers))),
             }
         return Handler
 

@@ -266,13 +266,21 @@ def encode_wire_frame(header: Any, partials: List[Any]) -> bytes:
     """Length-prefixed response frame: JSON header + N partial blocks
     (the InstanceResponseBlock -> DataTable-bytes serialization at
     QueryScheduler.java:134, minus the thrift envelope)."""
+    return encode_wire_frame_blocks(header,
+                                    [encode_partial(p) for p in partials])
+
+
+def encode_wire_frame_blocks(header: Any, blocks: List[bytes]) -> bytes:
+    """Frame assembly over ALREADY-encoded partial blocks — the server
+    times the block encode separately (serde vs network split) and the
+    header must carry that measurement, so encode and assembly are two
+    steps."""
     out = bytearray(_FRAME_MAGIC)
     h = json.dumps(header).encode()
     out += struct.pack("<I", len(h))
     out += h
-    out += struct.pack("<I", len(partials))
-    for p in partials:
-        b = encode_partial(p)
+    out += struct.pack("<I", len(blocks))
+    for b in blocks:
         out += struct.pack("<I", len(b))
         out += b
     return bytes(out)

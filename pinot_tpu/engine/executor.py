@@ -217,8 +217,16 @@ def run_kernel(plan: CompiledPlan,
         params = resolve_params(plan)
         n = np.int32(seg.n_docs)
         cap = plan.slots_cap
-        entry = global_plan_cache.entry(plan.kernel_plan, seg.bucket, cap,
-                                        xfer_compact=xfer_compact)
+        # drift_requantized: the compile at the measured-selectivity
+        # capacity is a deliberate, counted recompile — never a retrace.
+        # The cache brackets only the actual miss, so the warm
+        # re-plannings of a drifted shape (hits) stay outside expected()
+        # and genuine retraces remain visible.
+        entry = global_plan_cache.entry(
+            plan.kernel_plan, seg.bucket, cap, xfer_compact=xfer_compact,
+            expected_compile=plan.drift_requantized)
+        if plan.drift_requantized:
+            annotate(drift_requantized=True)
         if entry.overflowed:
             # this capacity already overflowed for this plan: go straight
             # to the (already compiled) full-capacity kernel instead of
@@ -236,7 +244,9 @@ def run_kernel(plan: CompiledPlan,
         host = entry.run(cols, n, params)
         if "matched" in host:
             matched = int(np.asarray(host["matched"]).sum())
-            entry.record_measured(matched, seg.n_docs)
+            global_plan_cache.record_measured(
+                plan.kernel_plan, seg.bucket, entry, matched, seg.n_docs,
+                segment=seg, params=plan.params)
             annotate(matched=matched,
                      meas_sel=matched / max(seg.n_docs, 1))
         # chaos hook: force the overflow retry ladder on kernels that

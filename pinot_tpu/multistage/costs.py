@@ -281,6 +281,48 @@ def ir_selectivity(pred: Any, params: Sequence[Any],
     return min(max(sel(pred), MIN_SEL), 1.0)
 
 
+# est-vs-measured selectivity drift factor past which a warm plan's
+# compact capacity is re-quantized from the MEASURED fraction (query/
+# planner.py reads KernelPlanCache.measured_for and triggers a counted,
+# RetraceDetector-expected() recompile). 4x matches CAP_SAFETY_XLA: a
+# smaller drift is already absorbed by the capacity safety margin +
+# pow2 quantization, so re-quantizing under it would churn kernel cache
+# entries for no capacity change. PINOT_DRIFT_RATIO overrides.
+SELECTIVITY_DRIFT_RATIO = 4.0
+_DRIFT_RATIO_DEFAULT: Optional[float] = None
+
+
+def _drift_ratio_default() -> float:
+    """PINOT_DRIFT_RATIO parsed ONCE (selectivity_drift sits on the
+    planner hot path); a malformed value falls back to the default
+    rather than raising per query."""
+    global _DRIFT_RATIO_DEFAULT
+    if _DRIFT_RATIO_DEFAULT is None:
+        import os
+
+        raw = os.environ.get("PINOT_DRIFT_RATIO")
+        try:
+            _DRIFT_RATIO_DEFAULT = float(raw) if raw is not None \
+                else SELECTIVITY_DRIFT_RATIO
+        except ValueError:
+            _DRIFT_RATIO_DEFAULT = SELECTIVITY_DRIFT_RATIO
+    return _DRIFT_RATIO_DEFAULT
+
+
+def selectivity_drift(est: Optional[float], meas: Optional[float],
+                      ratio: Optional[float] = None) -> bool:
+    """True when the estimated and measured selectivity disagree by more
+    than the drift factor (either direction). Both sides floor at
+    MIN_SEL so a zero-matched run keeps the ratio finite."""
+    if est is None or meas is None:
+        return False
+    if ratio is None:
+        ratio = _drift_ratio_default()
+    e = max(est, MIN_SEL)
+    m = max(meas, MIN_SEL)
+    return e / m > ratio or m / e > ratio
+
+
 def _pow2_at_least(x: float) -> int:
     n = max(int(x), 1)
     return 1 << (n - 1).bit_length()

@@ -20,14 +20,17 @@ meet at the same worker, so the concatenation IS the join result.
 """
 from __future__ import annotations
 
+import json
 import struct
 import uuid
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..engine.datablock import decode_relation, encode_relation
+from ..utils import phases as ph
+from ..utils.spans import Span, span, span_tracer
 from .exchange import EOS, MailboxService, hash_partition_codes
 from .join import hash_join
 from .relation import Relation
@@ -139,9 +142,43 @@ def deliver_mailbox_frame(service: MailboxService, data: bytes) -> None:
 def _send_block(url: str, query_id: str, stage: int, worker: int,
                 rel: Optional[Relation], timeout: float = 30.0) -> None:
     from ..cluster.http_util import http_raw
-    http_raw("POST", f"{url}/mailbox",
-             encode_mailbox_frame(query_id, stage, worker, rel),
-             timeout=timeout)
+    with span(ph.EXCHANGE, target=url, stage=stage, worker=worker,
+              rows=None if rel is None else rel.n_rows,
+              eos=rel is None):
+        http_raw("POST", f"{url}/mailbox",
+                 encode_mailbox_frame(query_id, stage, worker, rel),
+                 timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# trace plumbing: the /stage request body is opaque StagePlan proto
+# bytes, so the traceContext rides the X-Pinot-Trace-Context header
+# (cluster/http_util) and a sampled worker roots a ``stage`` span tree
+# the driver stitches back under its per-submission ``stage_call`` span.
+# Leaf responses are JSON (the tree is a "trace" key); join responses
+# are raw relation bytes, so a sampled join response is wrapped in a
+# magic-guarded trace envelope the driver strips.
+# ---------------------------------------------------------------------------
+
+_TRACE_MAGIC = b"PTRC"
+
+
+def wrap_trace(payload: bytes, trace: Dict[str, Any]) -> bytes:
+    h = json.dumps(trace).encode()
+    return _TRACE_MAGIC + struct.pack("<I", len(h)) + h + payload
+
+
+def unwrap_trace(data: bytes) -> Tuple[bytes, Optional[Dict[str, Any]]]:
+    """-> (payload, trace-or-None); non-enveloped payloads pass through
+    untouched (magic-guarded, so the wire stays backward compatible)."""
+    if bytes(data[:4]) != _TRACE_MAGIC:
+        return data, None
+    (hn,) = struct.unpack("<I", bytes(data[4:8]))
+    try:
+        trace = json.loads(bytes(data[8:8 + hn]))
+    except ValueError:
+        return data, None
+    return bytes(data[8 + hn:]), trace
 
 
 # ---------------------------------------------------------------------------
@@ -189,16 +226,39 @@ def _leaf_relation(node, spec: Dict[str, Any]) -> Relation:
     return Relation(data, {}, alias)
 
 
-def execute_stage(node, spec):
+def execute_stage(node, spec, trace_ctx: Optional[Dict[str, Any]] = None):
     """-> JSON dict (leaf summary) or bytes (root join's relation).
     spec: StagePlan proto bytes (the wire contract) or the decoded
-    dict (in-process callers)."""
+    dict (in-process callers). A sampled ``trace_ctx`` roots a
+    ``stage`` span tree around the stage's work (exchange sends
+    included) and ships it back — "trace" key on the leaf's JSON
+    summary, trace envelope (wrap_trace) on the join's binary
+    relation — for the driver to stitch under its stage_call span."""
     if isinstance(spec, (bytes, bytearray)):
         spec = decode_stage_plan(bytes(spec))
+    if trace_ctx and trace_ctx.get("sampled"):
+        root = span_tracer.start(
+            ph.STAGE, kind=spec["kind"], query_id=spec["queryId"],
+            parent_span_id=trace_ctx.get("parentSpanId"))
+        try:
+            out = _execute_stage(node, spec)
+        finally:
+            root = span_tracer.stop() or root
+        if isinstance(out, (bytes, bytearray)):
+            return wrap_trace(bytes(out), root.to_dict())
+        out["trace"] = root.to_dict()
+        return out
+    return _execute_stage(node, spec)
+
+
+def _execute_stage(node, spec):
     kind = spec["kind"]
     query_id = spec["queryId"]
     if kind == "leaf":
-        rel = _leaf_relation(node, spec)
+        with span(ph.LEAF_SCAN, sql=spec["sql"][:120]) as sp:
+            rel = _leaf_relation(node, spec)
+            if sp is not None:
+                sp.annotate(rows=rel.n_rows)
         ex = spec["exchange"]
         targets = ex["targets"]  # [{url, worker}], stage = ex["stage"]
         stage = ex["stage"]
@@ -220,15 +280,22 @@ def execute_stage(node, spec):
     rbox = node.mailboxes.mailbox(query_id, spec["rightStage"], worker)
     timeout = spec.get("timeoutSec", 60.0)
     try:
-        left = _concat(lbox.drain(timeout, n_eos=spec["nLeftSenders"]))
-        right = _concat(rbox.drain(timeout, n_eos=spec["nRightSenders"]))
+        with span("mailbox_drain", worker=worker) as sp:
+            left = _concat(lbox.drain(timeout,
+                                      n_eos=spec["nLeftSenders"]))
+            right = _concat(rbox.drain(timeout,
+                                       n_eos=spec["nRightSenders"]))
+            if sp is not None:
+                sp.annotate(left_rows=left.n_rows,
+                            right_rows=right.n_rows)
     finally:
         # per-worker cleanup, even on drain timeout (a dead leaf must not
         # leak queued blocks); co-located workers keep their own boxes
         node.mailboxes.release_one(query_id, spec["leftStage"], worker)
         node.mailboxes.release_one(query_id, spec["rightStage"], worker)
-    out = hash_join(left, right, spec["leftKeys"], spec["rightKeys"],
-                    spec.get("how", "inner"))
+    with span(ph.JOIN_STAGE, worker=worker, how=spec.get("how", "inner")):
+        out = hash_join(left, right, spec["leftKeys"], spec["rightKeys"],
+                        spec.get("how", "inner"))
     return encode_relation(out)
 
 
@@ -248,11 +315,56 @@ def distributed_join(left_leaves: List[Dict[str, str]],
     leaf stage on its server (where the table's segments live) and hash-
     exchanges on the join keys; join_workers: server URLs, one join
     partition each. Returns the concatenated join relation.
+
+    When the calling thread has an active span trace (EXPLAIN ANALYZE /
+    a sampled query), every /stage submission carries a sampled
+    traceContext header, gets a ``stage_call`` span, and the worker's
+    remote-rooted ``stage`` tree is stitched under it — the multistage
+    dispatch analog of the round-10 scatter_call stitching.
     """
-    from ..cluster.http_util import http_raw
+    from ..cluster.http_util import http_raw, trace_context_header
 
     query_id = uuid.uuid4().hex[:12]
     l_stage, r_stage = 1, 2
+    sampled = span_tracer.active()
+    collect: Optional[List[Span]] = [] if sampled else None
+
+    def post_stage(url: str, data: bytes, timeout: float, kind: str,
+                   worker: Optional[int] = None
+                   ) -> Tuple[bytes, Optional[Span]]:
+        """One traced /stage submission (runs on pool threads: spans are
+        built explicitly and collected GIL-atomically, round-10 style)."""
+        sp = None
+        headers = None
+        if collect is not None:
+            sp = Span(ph.STAGE_CALL, url=url, kind=kind, worker=worker,
+                      span_id=uuid.uuid4().hex[:8], status=None,
+                      error=None, net_ms=None)
+            collect.append(sp)
+            headers = trace_context_header(
+                {"queryId": query_id, "sampled": True,
+                 "parentSpanId": sp.attrs["span_id"]})
+        try:
+            raw = http_raw("POST", f"{url}/stage", data, timeout,
+                           headers=headers)
+        except Exception as e:
+            if sp is not None:
+                sp.finish()
+                sp.annotate(status="failed",
+                            error=f"{type(e).__name__}: {e}"[:200])
+            raise
+        if sp is not None:
+            sp.finish()
+            sp.annotate(status="ok")
+        return raw, sp
+
+    def stitch(sp: Optional[Span], tree: Optional[Dict[str, Any]]) -> None:
+        if sp is None or not tree:
+            return
+        rt = Span.from_dict(tree)
+        sp.children.append(rt)
+        sp.annotate(net_ms=round(
+            max(sp.duration_ms - rt.duration_ms, 0.0), 3))
 
     def targets(keys):
         return {"type": "hash", "keys": keys, "stage": None,
@@ -274,26 +386,44 @@ def distributed_join(left_leaves: List[Dict[str, str]],
         return {"kind": "leaf", "queryId": query_id, "sql": leaf["sql"],
                 "alias": leaf.get("alias"), "exchange": ex}
 
-    import json as _json
-
-    with ThreadPoolExecutor(max_workers=len(join_specs)
-                            + len(left_leaves) + len(right_leaves)) as pool:
-        # join stages first: they block on their mailboxes. Every /stage
-        # submission ships as a typed StagePlan proto (plan.proto), not
-        # a JSON blob.
-        join_futs = [pool.submit(http_raw, "POST",
-                                 f"{join_workers[w]}/stage",
-                                 encode_stage_plan(spec), timeout)
-                     for w, spec in enumerate(join_specs)]
-        leaf_futs = [pool.submit(
-            http_raw, "POST", f"{leaf['url']}/stage",
-            encode_stage_plan(leaf_spec(leaf, l_stage, left_keys)),
-            timeout) for leaf in left_leaves]
-        leaf_futs += [pool.submit(
-            http_raw, "POST", f"{leaf['url']}/stage",
-            encode_stage_plan(leaf_spec(leaf, r_stage, right_keys)),
-            timeout) for leaf in right_leaves]
-        for f in leaf_futs:
-            _json.loads(f.result())     # leaf summaries are JSON dicts
-        parts = [decode_relation(f.result()) for f in join_futs]
+    with span(ph.STAGE_DISPATCH, workers=len(join_workers),
+              leaves=len(left_leaves) + len(right_leaves)) as dsp:
+        try:
+            with ThreadPoolExecutor(
+                    max_workers=len(join_specs) + len(left_leaves)
+                    + len(right_leaves)) as pool:
+                # join stages first: they block on their mailboxes.
+                # Every /stage submission ships as a typed StagePlan
+                # proto (plan.proto), not a JSON blob.
+                join_futs = [pool.submit(post_stage, join_workers[w],
+                                         encode_stage_plan(spec),
+                                         timeout, "join", w)
+                             for w, spec in enumerate(join_specs)]
+                leaf_futs = [pool.submit(
+                    post_stage, leaf["url"],
+                    encode_stage_plan(leaf_spec(leaf, l_stage,
+                                                left_keys)),
+                    timeout, "leaf") for leaf in left_leaves]
+                leaf_futs += [pool.submit(
+                    post_stage, leaf["url"],
+                    encode_stage_plan(leaf_spec(leaf, r_stage,
+                                                right_keys)),
+                    timeout, "leaf") for leaf in right_leaves]
+                for f in leaf_futs:
+                    raw, sp = f.result()  # leaf summaries are JSON
+                    stitch(sp, json.loads(raw).get("trace"))
+                parts = []
+                for f in join_futs:
+                    raw, sp = f.result()
+                    payload, tree = unwrap_trace(raw)
+                    stitch(sp, tree)
+                    parts.append(decode_relation(payload))
+        finally:
+            # attach even when a stage raises (a failed analyze still
+            # shows WHICH submissions failed); snapshot first — a pool
+            # thread may still be appending
+            if dsp is not None and collect:
+                done = list(collect)
+                done.sort(key=lambda s: s._t0)
+                dsp.children.extend(done)
     return _concat(parts)

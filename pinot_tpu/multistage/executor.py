@@ -26,6 +26,8 @@ from ..engine import host_eval
 from ..engine.executor import AggPartial, GroupByPartial, SelectionPartial
 from ..engine.reduce import ResultTable, reduce_partials
 from ..query.context import build_query_context
+from ..utils import phases as ph
+from ..utils.spans import span
 from ..query.sql import (Between, BinaryOp, BoolAnd, BoolNot, BoolOr,
                          Comparison, FuncCall, Identifier, InList, IsNull,
                          Like, Literal, SelectStmt, SqlError, Star, TableRef)
@@ -344,14 +346,18 @@ class MultiStageExecutor:
             return rel
         device_join.bump("numpy_joins")
         self.join_backends.append("numpy_shuffle")
-        lex = HashExchange(self.mailboxes, query_id, stage, SHUFFLE_PARTITIONS,
-                           lkeys)
-        rex = HashExchange(self.mailboxes, query_id, stage + 1000,
-                           SHUFFLE_PARTITIONS, rkeys)
-        lex.send(left)
-        lex.close()
-        rex.send(right)
-        rex.close()
+        # the mailbox exchange plane is span-visible (round 12): a
+        # sampled/analyzed multistage query attributes its shuffle time
+        with span(ph.EXCHANGE, partitions=SHUFFLE_PARTITIONS,
+                  rows=left.n_rows + right.n_rows):
+            lex = HashExchange(self.mailboxes, query_id, stage,
+                               SHUFFLE_PARTITIONS, lkeys)
+            rex = HashExchange(self.mailboxes, query_id, stage + 1000,
+                               SHUFFLE_PARTITIONS, rkeys)
+            lex.send(left)
+            lex.close()
+            rex.send(right)
+            rex.close()
         parts: List[Relation] = []
         for w in range(SHUFFLE_PARTITIONS):
             lparts = self.mailboxes.mailbox(query_id, stage, w).drain()
@@ -367,6 +373,63 @@ class MultiStageExecutor:
                              lkeys, rkeys, how)
         return Relation.concat(parts)
 
+    def _join_step(self, j, si: int, needed, pushed,
+                   joined_labels: Set[str], current: Relation,
+                   query_id: str) -> Relation:
+        """One join of the stage loop: scan the right leaf (with any
+        dynamic semi-join filter) and join it into ``current``."""
+        label = j.table.label
+        equi, rest = self._split_on(j.on, joined_labels, label)
+        dyn = self._dynamic_filter(j, equi, current)
+        with span(ph.LEAF_SCAN, table=label) as sp:
+            right = self.leaf_scan(
+                j.table, needed[label],
+                _and(pushed[label] + ([dyn] if dyn is not None else [])))
+            if sp is not None:
+                sp.annotate(rows=right.n_rows,
+                            dynamic_filter=dyn is not None or None)
+        if j.join_type == "cross" or not equi:
+            if j.join_type != "cross":
+                raise SqlError(
+                    f"join with {label!r} has no equi condition; "
+                    "use CROSS JOIN for a cartesian product")
+            # parser guarantees CROSS has no ON, so rest is empty
+            self.join_backends.append("numpy(cross)")
+            device_join.bump("numpy_joins")
+            return cross_join(current, right)
+        lkeys = [p[0] for p in equi]
+        rkeys = [p[1] for p in equi]
+        if j.join_type in ("left", "right", "full") and rest:
+            # OUTER JOIN with non-equi ON conjuncts: pairs failing
+            # the conjunct are NON-matches — preserved-side rows
+            # null-extend, never drop (HashJoinOperator join-clause
+            # semantics; a post-join filter would wrongly drop them)
+            device_join.bump("numpy_joins")
+            self.join_backends.append(f"numpy(non_equi_{j.join_type})")
+            inner, l_idx, r_idx, _m = hash_join(
+                current, right, lkeys, rkeys, "inner",
+                return_idx=True)
+            m = np.ones(inner.n_rows, dtype=bool)
+            for conj in rest:
+                m &= host_eval.eval_filter(conj, inner)
+            keep = np.nonzero(m)[0]
+            parts = [inner.take(keep)]
+            if j.join_type in ("left", "full"):
+                un_l = np.setdiff1d(np.arange(current.n_rows),
+                                    np.unique(l_idx[keep]))
+                parts.append(null_extend(current.take(un_l), right))
+            if j.join_type in ("right", "full"):
+                un_r = np.setdiff1d(np.arange(right.n_rows),
+                                    np.unique(r_idx[keep]))
+                parts.append(null_extend(right.take(un_r), current))
+            return Relation.concat(parts)
+        current = self._join(current, right, lkeys, rkeys,
+                             j.join_type, query_id, si + 2)
+        for conj in rest:
+            m = host_eval.eval_filter(conj, current)
+            current = current.take(np.nonzero(m)[0])
+        return current
+
     # -- top level ---------------------------------------------------------
     def execute(self) -> ResultTable:
         t0 = time.perf_counter()
@@ -375,64 +438,31 @@ class MultiStageExecutor:
         needed = self._collect_needed()
         pushed, post_where = self._split_where()
 
-        # leaf stages
+        # leaf stages (span-visible: a sampled or EXPLAIN ANALYZE
+        # multistage query attributes scan/join/window/final time the
+        # way single-stage queries attribute their engine phases)
         base = self.tables[0]
-        current = self.leaf_scan(base, needed[base.label],
-                                 _and(pushed[base.label]))
+        with span(ph.LEAF_SCAN, table=base.label) as sp:
+            current = self.leaf_scan(base, needed[base.label],
+                                     _and(pushed[base.label]))
+            if sp is not None:
+                sp.annotate(rows=current.n_rows)
         joined_labels = {base.label}
         # stats collection only pays off when an order choice exists
         ordered_joins = stmt.joins if len(stmt.joins) < 2 \
             else self.plan_join_order(pushed)[0]
         for si, j in enumerate(ordered_joins):
             label = j.table.label
-            equi, rest = self._split_on(j.on, joined_labels, label)
-            dyn = self._dynamic_filter(j, equi, current)
-            right = self.leaf_scan(
-                j.table, needed[label],
-                _and(pushed[label] + ([dyn] if dyn is not None else [])))
-            if j.join_type == "cross" or not equi:
-                if j.join_type != "cross":
-                    raise SqlError(
-                        f"join with {label!r} has no equi condition; "
-                        "use CROSS JOIN for a cartesian product")
-                # parser guarantees CROSS has no ON, so rest is empty
-                self.join_backends.append("numpy(cross)")
-                device_join.bump("numpy_joins")
-                current = cross_join(current, right)
-                joined_labels.add(label)
-                continue
-            lkeys = [p[0] for p in equi]
-            rkeys = [p[1] for p in equi]
-            if j.join_type in ("left", "right", "full") and rest:
-                # OUTER JOIN with non-equi ON conjuncts: pairs failing
-                # the conjunct are NON-matches — preserved-side rows
-                # null-extend, never drop (HashJoinOperator join-clause
-                # semantics; a post-join filter would wrongly drop them)
-                device_join.bump("numpy_joins")
-                self.join_backends.append(f"numpy(non_equi_{j.join_type})")
-                inner, l_idx, r_idx, _m = hash_join(
-                    current, right, lkeys, rkeys, "inner",
-                    return_idx=True)
-                m = np.ones(inner.n_rows, dtype=bool)
-                for conj in rest:
-                    m &= host_eval.eval_filter(conj, inner)
-                keep = np.nonzero(m)[0]
-                parts = [inner.take(keep)]
-                if j.join_type in ("left", "full"):
-                    un_l = np.setdiff1d(np.arange(current.n_rows),
-                                        np.unique(l_idx[keep]))
-                    parts.append(null_extend(current.take(un_l), right))
-                if j.join_type in ("right", "full"):
-                    un_r = np.setdiff1d(np.arange(right.n_rows),
-                                        np.unique(r_idx[keep]))
-                    parts.append(null_extend(right.take(un_r), current))
-                current = Relation.concat(parts)
-            else:
-                current = self._join(current, right, lkeys, rkeys,
-                                     j.join_type, query_id, si + 2)
-                for conj in rest:
-                    m = host_eval.eval_filter(conj, current)
-                    current = current.take(np.nonzero(m)[0])
+            with span(ph.JOIN_STAGE, table=label,
+                      how=j.join_type) as jsp:
+                current = self._join_step(
+                    j, si, needed, pushed, joined_labels, current,
+                    query_id)
+                if jsp is not None:
+                    jsp.annotate(rows=current.n_rows,
+                                 backend=(self.join_backends[-1]
+                                          if self.join_backends
+                                          else None))
             joined_labels.add(label)
 
         for conj in post_where:
@@ -452,23 +482,29 @@ class MultiStageExecutor:
             if stmt.group_by:
                 raise SqlError("window functions cannot be combined with "
                                "GROUP BY in one stage yet")
-            names = {wf: f"__w{i}" for i, wf in enumerate(wfs)}
-            current = current.with_columns(
-                {names[wf]: compute_window(current, wf) for wf in wfs})
-            stmt = rewrite_windows(stmt, names)
+            with span(ph.WINDOW_STAGE, funcs=len(wfs),
+                      rows=current.n_rows):
+                names = {wf: f"__w{i}" for i, wf in enumerate(wfs)}
+                current = current.with_columns(
+                    {names[wf]: compute_window(current, wf)
+                     for wf in wfs})
+                stmt = rewrite_windows(stmt, names)
 
         # final stage: aggregation / selection over the joined relation
         ctx = build_query_context(stmt)
-        mask = np.ones(current.n_rows, dtype=bool)
-        if ctx.is_group_by:
-            partial: Any = GroupByPartial(
-                host_eval.host_group_by(ctx, current, mask))
-        elif ctx.is_aggregation:
-            partial = AggPartial(host_eval.host_aggregate(ctx, current, mask))
-        else:
-            labels, rows, okeys = host_eval.host_selection(ctx, current, mask)
-            partial = SelectionPartial(labels, rows, okeys)
-        result = reduce_partials(ctx, [partial])
+        with span(ph.FINAL_STAGE, rows=current.n_rows):
+            mask = np.ones(current.n_rows, dtype=bool)
+            if ctx.is_group_by:
+                partial: Any = GroupByPartial(
+                    host_eval.host_group_by(ctx, current, mask))
+            elif ctx.is_aggregation:
+                partial = AggPartial(
+                    host_eval.host_aggregate(ctx, current, mask))
+            else:
+                labels, rows, okeys = host_eval.host_selection(
+                    ctx, current, mask)
+                partial = SelectionPartial(labels, rows, okeys)
+            result = reduce_partials(ctx, [partial])
         result.num_docs_scanned = current.n_rows
         result.time_ms = (time.perf_counter() - t0) * 1e3
         return result

@@ -229,6 +229,13 @@ class KernelPlanCache:
 
     def __init__(self, maxsize: int = 512):
         self._entries: "OrderedDict[Tuple, PlanCacheEntry]" = OrderedDict()
+        # (plan, bucket) -> last measured selectivity: the O(1) index
+        # measured_for reads on the planning hot path (a lock-held scan
+        # of every entry per planned segment would serialize planners)
+        self._measured: "OrderedDict[Tuple, float]" = OrderedDict()
+        # (plan, bucket, cap) combinations whose drift-requantize
+        # expected-compile bracket has been consumed (_note_requantize)
+        self._requantized: "OrderedDict[Tuple, bool]" = OrderedDict()
         self._maxsize = maxsize
         self._lock = threading.Lock()
         self.hits = 0
@@ -239,7 +246,8 @@ class KernelPlanCache:
               slots_cap: Optional[int] = None,
               platform: Optional[str] = None,
               xfer_compact: bool = True,
-              scatter: Optional[bool] = None) -> PlanCacheEntry:
+              scatter: Optional[bool] = None,
+              expected_compile: bool = False) -> PlanCacheEntry:
         from .kernels import (_ladder_min_elems, _two_pass_mode,
                               build_kernel, cpu_scatter_default)
 
@@ -262,7 +270,21 @@ class KernelPlanCache:
             span_tracer.annotate(cache="hit")
             return ent
         span_tracer.annotate(cache="miss")
-        self.detector.observe_compile(plan)
+        if expected_compile and self._note_requantize(plan, bucket,
+                                                      slots_cap):
+            # a deliberate recompile (the planner's selectivity-drift
+            # re-quantize): bracketed HERE, on the actual miss, so warm
+            # re-plannings of a drifted shape (cache hits) never run
+            # under expected() and the counter counts recompile events,
+            # not planned queries. The bracket is consumed ONCE per
+            # (plan, bucket, cap): a LATER miss of the same combination
+            # (LRU eviction churn, a mode flip) is a genuine recompile
+            # and must stay visible to the retrace detector.
+            global_metrics.count("selectivity_drift_recompiles")
+            with self.detector.expected():
+                self.detector.observe_compile(plan)
+        else:
+            self.detector.observe_compile(plan)
         if __debug__:
             # debug assertion (analysis/plan_verify): every structure
             # entering the cache must honor the hashable-frozen key
@@ -294,21 +316,77 @@ class KernelPlanCache:
     def snapshot_misses(self) -> int:
         return self.misses
 
-    def measured_for(self, plan, bucket: int) -> Optional[float]:
-        """Most recently measured selectivity across entries of this plan
-        structure at this bucket (any capacity/flag variant) — the
-        feedback value the cost model's second capture reads."""
+    def _note_requantize(self, plan, bucket: int,
+                         slots_cap: Optional[int]) -> bool:
+        """True exactly once per (plan, bucket, cap): whether this miss
+        is the drift re-quantize's own compile (bracket it) or a
+        rebuild of a combination already compiled before (don't)."""
+        key = (plan, bucket, slots_cap)
         with self._lock:
-            entries = [e for k, e in self._entries.items()
-                       if k[0] == plan and k[1] == bucket]
-        for e in reversed(entries):
-            if e.measured_selectivity is not None:
-                return e.measured_selectivity
-        return None
+            if key in self._requantized:
+                return False
+            self._requantized[key] = True
+            self._requantized.move_to_end(key)
+            while len(self._requantized) > self._maxsize:
+                self._requantized.popitem(last=False)
+            return True
+
+    @staticmethod
+    def _measured_key(plan, bucket: int, segment, params) -> Tuple:
+        """KernelPlan hoists literals into params, so two queries
+        differing only in a literal value (WHERE f<=1 vs f<=99) — or
+        structurally identical plans on different tables — share the
+        plan object. The measurement key therefore carries segment
+        identity and a params fingerprint: one query's measured
+        selectivity must never set another query's capacity."""
+        import numpy as np
+        seg_id = getattr(segment, "uid", None) \
+            or getattr(segment, "name", None)
+        fp = []
+        for p in params or ():
+            if isinstance(p, np.ndarray):
+                fp.append((str(p.dtype), p.shape, p.tobytes()))
+            else:
+                fp.append(repr(p))  # scalars + ("dictvals", col) markers
+        return (plan, bucket, seg_id, tuple(fp))
+
+    def record_measured(self, plan, bucket: int, entry: PlanCacheEntry,
+                        matched: int, rows: int,
+                        segment=None, params=None) -> None:
+        """Record a run's measured selectivity on the entry AND the
+        index measured_for reads — the engine executor's post-run
+        feedback write."""
+        entry.record_measured(matched, rows)
+        sel = entry.measured_selectivity
+        if sel is None:
+            return
+        key = self._measured_key(plan, bucket, segment, params)
+        with self._lock:
+            self._measured[key] = sel
+            self._measured.move_to_end(key)
+            while len(self._measured) > self._maxsize:
+                self._measured.popitem(last=False)
+
+    def measured_for(self, plan, bucket: int,
+                     segment=None, params=None) -> Optional[float]:
+        """Most recently measured selectivity for this exact
+        (plan, bucket, segment, literal-params) combination — the
+        feedback value query/planner.py's selectivity-drift re-quantize
+        consumes (round 12): when it disagrees with the IR estimate past
+        multistage/costs.SELECTIVITY_DRIFT_RATIO, the planner re-derives
+        the compact capacity from this measurement and the resulting
+        compile runs as an expected_compile (counted, never a retrace).
+        Measurements only exist after a run of the same query on the
+        same segment, so a hit here implies that shape has been warm."""
+        key = self._measured_key(plan, bucket, segment, params)
+        with self._lock:
+            return self._measured.get(key)
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._measured.clear()
+            self._requantized.clear()
             self.hits = 0
             self.misses = 0
         self.detector.clear()

@@ -90,6 +90,12 @@ class CompiledPlan:
     est_selectivity: Optional[float] = None
     slots_cap: Optional[int] = None
     strategy_trace: Optional[dict] = None
+    # round-12 feedback loop: the plan cache's measured selectivity
+    # drifted past the threshold and slots_cap was re-quantized from the
+    # measurement — the executor brackets the resulting kernel compile
+    # with RetraceDetector.expected() (a deliberate recompile, not a
+    # retrace)
+    drift_requantized: bool = False
 
 
 @dataclass
@@ -1225,6 +1231,8 @@ class SegmentPlanner:
                                 est_sel=plan.est_selectivity,
                                 slots_cap=plan.slots_cap,
                                 cost_trace=plan.strategy_trace)
+                    if plan.drift_requantized:
+                        sp.annotate(drift_requantized=True)
             return plan
 
     def _plan(self) -> CompiledPlan:
@@ -1429,12 +1437,6 @@ class SegmentPlanner:
                 seg.n_docs, space, est_sel, platform, scatter_fast,
                 needs_sort_flag, n_payloads, dense_viable, compact_ok,
                 force)
-            if strategy == "compact":
-                # size from the LIVE row count (n_docs), not the padded
-                # bucket — the pad rows are mask-false and consume no
-                # compaction slots
-                slots_cap = _costs.compact_slots_cap(
-                    seg.n_docs, est_sel, platform, scatter_fast)
 
         plan = KernelPlan(pred=pred, aggs=tuple(specs),
                           group_keys=tuple(group_keys),
@@ -1442,6 +1444,45 @@ class SegmentPlanner:
                           key_exprs=(tuple(key_exprs)
                                      if any(e is not None
                                             for e in key_exprs) else ()))
+        drift_requant = False
+        if strategy == "compact":
+            # size from the LIVE row count (n_docs), not the padded
+            # bucket — the pad rows are mask-false and consume no
+            # compaction slots
+            from ..multistage import costs as _costs
+            slots_cap = _costs.compact_slots_cap(
+                seg.n_docs, est_sel, platform, scatter_fast)
+            # selectivity-drift self-tuning (round-12 feedback loop):
+            # when the warm plan-cache entry's MEASURED matched fraction
+            # drifts past the threshold from the IR estimate, re-derive
+            # the capacity from the measurement. The plan cache brackets
+            # the resulting compile (the actual miss, not warm hits)
+            # with expected() so it counts as a deliberate recompile;
+            # the re-quantized cap is itself a stable cache key, so the
+            # recompile happens exactly once.
+            from ..ops.plan_cache import global_plan_cache
+            meas = global_plan_cache.measured_for(
+                plan, seg.bucket, segment=seg, params=self.b.params)
+            if meas is not None and _costs.selectivity_drift(est_sel,
+                                                             meas):
+                from ..utils.metrics import global_metrics
+                global_metrics.count("selectivity_drift_detected")
+                meas_f = max(meas, _costs.MIN_SEL)
+                new_cap = _costs.compact_slots_cap(
+                    seg.n_docs, meas_f, platform, scatter_fast)
+                if strat_trace is not None:
+                    strat_trace["drift"] = {
+                        "est_sel": round(est_sel, 8),
+                        "meas_sel": round(meas_f, 8),
+                        "slots_cap": slots_cap, "new_cap": new_cap}
+                if new_cap != slots_cap:
+                    global_metrics.count("selectivity_drift_requantized")
+                    slots_cap = new_cap
+                    drift_requant = True
+                # the measurement replaces the estimate either way so
+                # every derived capacity (PV106 consistency, the fused/
+                # mesh scaled_compact_cap) agrees with the cap in force
+                est_sel = meas_f
         return CompiledPlan("kernel", seg, ctx,
                             col_names=list(self.b.cols),
                             kernel_plan=plan,
@@ -1451,7 +1492,8 @@ class SegmentPlanner:
                             group_decoders=group_decoders,
                             est_selectivity=est_sel,
                             slots_cap=slots_cap,
-                            strategy_trace=strat_trace)
+                            strategy_trace=strat_trace,
+                            drift_requantized=drift_requant)
 
     def _try_fast_path(self) -> Optional[CompiledPlan]:
         """Metadata/dictionary-only answers (AggregationPlanNode.java:98-112

@@ -61,7 +61,8 @@ def cmd_start_broker(args) -> int:
     b = BrokerNode(args.controller, port=args.port,
                    instance_selector=args.selector,
                    slow_query_ms=args.slow_query_ms,
-                   query_stats_path=args.query_stats)
+                   query_stats_path=args.query_stats,
+                   trace_ratio=args.trace_ratio)
     try:
         _wait_forever("broker", b.url)
     finally:
@@ -266,6 +267,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="append a validated query_stats ledger record "
                     "per query to this JSONL path (default "
                     "PINOT_QUERY_STATS_LEDGER)")
+    sb.add_argument("--trace-ratio", type=float, default=None,
+                    help="production-sample this fraction of queries "
+                    "into query_trace ledger records (default 0 or "
+                    "PINOT_TRACE_RATIO; per-query override "
+                    "OPTION(traceRatio=...))")
     sb.set_defaults(fn=cmd_start_broker)
 
     at = sub.add_parser("AddTable")

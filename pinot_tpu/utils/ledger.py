@@ -61,20 +61,30 @@ KINDS: Dict[str, Dict[str, set]] = {
                      "t_kernel_ms", "t_transfer_ms"},
     },
     "query_trace": {
+        # ``sampled``: the record came from traceRatio production
+        # sampling (broker/forensics record_trace) rather than an
+        # explicit EXPLAIN ANALYZE / ledgerTrace run; ``qid`` cross-links
+        # it to the query_stats record of the same query
         "required": {"backend", "sql", "root"},
-        "optional": {"metric", "qid", "counters", "n_rows"},
+        "optional": {"metric", "qid", "counters", "n_rows", "sampled"},
     },
     "metrics_snapshot": {
         "required": {"counters"},
         "optional": {"gauges", "timers", "backend"},
     },
     "query_stats": {
+        # ``traced``: a span tree exists for this query (EXPLAIN ANALYZE
+        # or traceRatio sampling) — the query_trace record in the same
+        # ledger carries the same qid, so forensics tooling can join
+        # stats<->trace. ``serde_ms``/``net_ms``: the round-10 net gap
+        # split into frame encode+decode time vs true network time,
+        # summed over the query's scatter calls.
         "required": {"qid", "table", "wall_ms", "partial",
                      "servers_queried", "servers_responded",
                      "exception_codes"},
         "optional": {"sql", "rows", "segments_queried",
                      "segments_pruned", "hedges", "failovers", "slow",
-                     "error", "backend"},
+                     "error", "backend", "traced", "serde_ms", "net_ms"},
     },
     "ingest_stats": {
         # the freshness ledger (realtime/manager.write_ingest_stats):

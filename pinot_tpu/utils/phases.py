@@ -33,6 +33,19 @@ SCATTER = "scatter"
 SCATTER_CALL = "scatter_call"
 SERVER_QUERY = "server_query"
 
+# multistage plane (round 12): stage spans inside the QUERY tree so
+# EXPLAIN ANALYZE and sampled traces cover shuffle-join/window/set-op
+# queries, plus the networked dispatch plane's per-submission spans
+# (multistage/dispatch.py — the scatter_call/server_query analogs)
+LEAF_SCAN = "leaf_scan"
+JOIN_STAGE = "join_stage"
+EXCHANGE = "exchange"
+WINDOW_STAGE = "window_stage"
+FINAL_STAGE = "final_stage"
+STAGE = "stage"                    # remote /stage worker-rooted tree
+STAGE_CALL = "stage_call"          # driver-side per-submission attempt
+STAGE_DISPATCH = "stage_dispatch"  # driver-side fan-out parent
+
 # names Tracing.phase may emit into the flat trace envelope
 TRACED_PHASES = frozenset(
     {PLANNING, EXECUTION, REDUCE, DISTRIBUTED_EXECUTE})
@@ -40,4 +53,6 @@ TRACED_PHASES = frozenset(
 # every name above (the span tree uses these plus dynamic kernel-level
 # names like segment_kernel/device_execute owned by their emit sites)
 SPAN_NAMES = TRACED_PHASES | frozenset(
-    {QUERY, BROKER_OVERHEAD, SCATTER, SCATTER_CALL, SERVER_QUERY})
+    {QUERY, BROKER_OVERHEAD, SCATTER, SCATTER_CALL, SERVER_QUERY,
+     LEAF_SCAN, JOIN_STAGE, EXCHANGE, WINDOW_STAGE, FINAL_STAGE,
+     STAGE, STAGE_CALL, STAGE_DISPATCH})

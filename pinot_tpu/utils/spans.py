@@ -157,6 +157,24 @@ class SpanTracer:
 span_tracer = SpanTracer()
 
 
+def sample_decision(query_id: str, ratio: float) -> bool:
+    """traceRatio production-sampling decision, deterministic in the
+    query id: md5(queryId) maps to a uniform fraction in [0, 1) and the
+    query is sampled when that fraction is below ``ratio``. Pure in the
+    qid so broker replicas and retried dispatches of the SAME query
+    agree on the decision without coordination (the round-10
+    traceContext then carries the flag to every server the scatter
+    touches). ratio<=0 never samples, ratio>=1 always samples."""
+    if ratio <= 0.0:
+        return False
+    if ratio >= 1.0:
+        return True
+    import hashlib
+
+    h = int(hashlib.md5(str(query_id).encode()).hexdigest()[:8], 16)
+    return (h / float(1 << 32)) < ratio
+
+
 # module-level conveniences (the form hot paths import)
 def span(name: str, **attrs: Any):
     return span_tracer.span(name, **attrs)

@@ -291,6 +291,83 @@ def test_query_stats_records_errors(cluster):
 
 
 # ---------------------------------------------------------------------------
+# round-12: traceRatio production sampling over the cluster plane +
+# the serde-vs-network split of the net gap
+# ---------------------------------------------------------------------------
+
+def test_cluster_sampled_query_lands_trace_and_stats(cluster):
+    ctrl, servers, broker, stats_path = cluster
+    _reset_broker(broker)
+    res0 = uledger.validate_file(stats_path)
+    t0 = res0["kinds"].get("query_trace", 0)
+    _q(broker, GROUP_SQL + " OPTION(traceRatio=1.0)")
+    res1 = uledger.validate_file(stats_path)
+    assert not res1["errors"], res1["errors"][:3]
+    assert res1["kinds"].get("query_trace", 0) == t0 + 1
+    recs = [json.loads(line) for line in open(stats_path)]
+    trace = [r for r in recs if r.get("kind") == "query_trace"][-1]
+    stats = [r for r in recs if r.get("kind") == "query_stats"][-1]
+    # stats<->trace join: same qid, stats flagged traced, serde split
+    # present (every scatter call measured encode+decode)
+    assert trace["sampled"] is True
+    assert stats["qid"] == trace["qid"]
+    assert stats["traced"] is True
+    assert stats["serde_ms"] > 0
+    # the sampled tree covers the scatter plane: remote server trees
+    # stitched under the per-attempt call spans, serde annotated
+    root = trace["root"]
+    assert root["name"] == ph.QUERY and root["attrs"]["sampled"] is True
+    scatter = [c for c in root["children"] if c["name"] == ph.SCATTER]
+    assert scatter
+    calls = [c for c in scatter[0]["children"]
+             if c["name"] == ph.SCATTER_CALL]
+    assert len(calls) == 2
+    for c in calls:
+        assert c["attrs"]["serde_ms"] is not None
+        assert c["attrs"]["net_ms"] is not None
+        assert any(ch["name"] == ph.SERVER_QUERY
+                   for ch in c["children"])
+    # the sampled trace also enters the forensics ring, joined to its
+    # stats entry
+    dbg = http_json("GET", f"{broker.url}/debug/queries?n=3")
+    ring_traced = [e for e in dbg["queries"]
+                   if e.get("qid") == trace["qid"]]
+    assert ring_traced and ring_traced[0]["trace"]["name"] == ph.QUERY
+
+
+def test_cluster_trace_ratio_zero_writes_no_trace(cluster):
+    ctrl, servers, broker, stats_path = cluster
+    _reset_broker(broker)
+    res0 = uledger.validate_file(stats_path)
+    t0 = res0["kinds"].get("query_trace", 0)
+    _q(broker, GROUP_SQL + " OPTION(traceRatio=0)")
+    res1 = uledger.validate_file(stats_path)
+    assert res1["kinds"].get("query_trace", 0) == t0
+    rec = [json.loads(line) for line in open(stats_path)][-1]
+    assert rec["kind"] == "query_stats" and "traced" not in rec
+
+
+def test_cluster_invalid_trace_ratio_is_400(cluster):
+    import urllib.error
+    ctrl, servers, broker, _ = cluster
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _q(broker, GROUP_SQL + " OPTION(traceRatio=abc)")
+    assert ei.value.code == 400
+    assert "traceRatio" in ei.value.read().decode()
+
+
+def test_analyze_serde_split_annotated(cluster):
+    ctrl, servers, broker, _ = cluster
+    _reset_broker(broker)
+    _q(broker, GROUP_SQL)
+    resp = _q(broker, "EXPLAIN ANALYZE " + GROUP_SQL)
+    rows = [tuple(r) for r in resp["resultTable"]["rows"]]
+    calls = _rows_named(rows, ph.SCATTER_CALL)
+    assert calls and all("serde_ms=" in c[4] and "net_ms=" in c[4]
+                         for c in calls)
+
+
+# ---------------------------------------------------------------------------
 # gRPC plane: trace context propagates on Submit
 # ---------------------------------------------------------------------------
 

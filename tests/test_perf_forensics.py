@@ -1,0 +1,599 @@
+"""Round-12 observability: the continuous perf-forensics loop.
+
+Contract under test (ISSUE 7 acceptance):
+- traceRatio production sampling: deterministic hash-of-queryId
+  decision (same qid => same decision on every broker replica; 0/1
+  edge cases), sampled queries land VALIDATED ``query_trace`` ledger
+  records without EXPLAIN ANALYZE, traceRatio=0 starts zero span trees,
+  and a traceRatio=1.0 pass over the SSB corpus emits one record per
+  query with <10% wall overhead vs traceRatio=0;
+- selectivity-drift self-tuning: a warm compact plan whose measured
+  selectivity drifts past the threshold re-quantizes its compaction cap
+  from the measurement and recompiles exactly once, digest-exact,
+  counted as an expected recompile (never a retrace);
+- tools/span_diff.py: the current tree passes clean against the
+  checked-in tools/span_baseline.json and an injected 2x phase slowdown
+  fails the gate (bench_common.span_regression_gate wires the same
+  check into every bench capture);
+- multistage trace propagation: EXPLAIN ANALYZE over shuffle-join /
+  window / set-op queries contains the stage spans and holds the 10%
+  wall-sum gate; the networked dispatch plane stitches remote ``stage``
+  trees under driver-side ``stage_call`` spans.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from pinot_tpu.broker import Broker  # noqa: E402
+from pinot_tpu.query.sql import SqlError  # noqa: E402
+from pinot_tpu.segment import SegmentBuilder  # noqa: E402
+from pinot_tpu.server import TableDataManager  # noqa: E402
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType,  # noqa: E402
+                           Schema, TableConfig)
+from pinot_tpu.utils import ledger as uledger  # noqa: E402
+from pinot_tpu.utils import phases as ph  # noqa: E402
+from pinot_tpu.utils.spans import sample_decision, span_tracer  # noqa: E402
+
+import span_diff  # noqa: E402  (tools/ on sys.path, chaos_smoke-style)
+
+
+# ---------------------------------------------------------------------------
+# deterministic sampling decision
+# ---------------------------------------------------------------------------
+
+def test_sample_decision_deterministic_across_replicas():
+    # pure in (qid, ratio): two broker replicas — two CALLS — agree
+    for qid in ("a1b2", "deadbeef0123", "x"):
+        for ratio in (0.1, 0.5, 0.9):
+            assert sample_decision(qid, ratio) == \
+                sample_decision(qid, ratio)
+
+
+def test_sample_decision_edge_ratios():
+    qids = [f"q{i:05d}" for i in range(500)]
+    assert not any(sample_decision(q, 0.0) for q in qids)
+    assert all(sample_decision(q, 1.0) for q in qids)
+    # negative/overfull ratios clamp to never/always
+    assert not sample_decision("abc", -1.0)
+    assert sample_decision("abc", 2.0)
+
+
+def test_sample_decision_distribution():
+    qids = [f"q{i:05d}" for i in range(4000)]
+    frac = sum(sample_decision(q, 0.3) for q in qids) / len(qids)
+    assert 0.25 < frac < 0.35, frac
+
+
+def test_parse_trace_ratio_validation():
+    from pinot_tpu.cluster.forensics import parse_trace_ratio
+    assert parse_trace_ratio({}, 0.25) == 0.25
+    assert parse_trace_ratio({"traceRatio": "0.5"}, 0.0) == 0.5
+    for bad in ("abc", "1.5", "-0.1"):
+        with pytest.raises(SqlError):
+            parse_trace_ratio({"traceRatio": bad}, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# in-process broker sampling + drift feedback fixture
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def skew_segment_dir(tmp_path_factory):
+    """One segment whose filter column is heavily skewed: the uniform
+    id-span estimate for ``f <= 50`` is ~0.85 while the measured match
+    fraction is ~0.02 — drift factor ~40x, far past the threshold."""
+    rng = np.random.default_rng(7)
+    n = 20000
+    f = np.where(rng.random(n) < 0.02, rng.integers(0, 50, n),
+                 rng.integers(90, 100, n)).astype(np.int32)
+    cols = {
+        "k": rng.choice([f"g{i:04d}" for i in range(2000)], n),
+        "f": f,
+        "v": rng.integers(0, 1000, n).astype(np.int32),
+    }
+    schema = Schema("drifty", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("f", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+    return SegmentBuilder(schema, TableConfig("drifty")).build(
+        cols, str(tmp_path_factory.mktemp("drifty")), "s0")
+
+
+def _broker_for(seg_dir, **kw) -> Broker:
+    dm = TableDataManager("drifty")
+    dm.add_segment_dir(seg_dir)
+    b = Broker(**kw)
+    b.register_table(dm)
+    return b
+
+
+SAMPLE_SQL = "SELECT COUNT(*), SUM(v) FROM drifty WHERE f > 10"
+
+
+def test_sampled_query_emits_validated_trace(skew_segment_dir, tmp_path):
+    led = str(tmp_path / "trace.jsonl")
+    b = _broker_for(skew_segment_dir, trace_ratio=1.0,
+                    trace_ledger_path=led)
+    r = b.query(SAMPLE_SQL)
+    assert len(r.rows) == 1
+    res = uledger.validate_file(led)
+    assert not res["errors"], res["errors"][:3]
+    assert res["kinds"] == {"query_trace": 1}
+    rec = json.loads(open(led).read())
+    assert rec["sampled"] is True
+    assert rec["qid"] and rec["sql"] == SAMPLE_SQL
+    root = rec["root"]
+    assert root["name"] == ph.QUERY
+    assert root["attrs"]["query_id"] == rec["qid"]
+    names = {c["name"] for c in root["children"]}
+    assert {ph.PLANNING, ph.EXECUTION, ph.REDUCE} <= names
+
+
+def test_trace_ratio_zero_starts_zero_spans(skew_segment_dir, tmp_path,
+                                            monkeypatch):
+    led = str(tmp_path / "trace.jsonl")
+    b = _broker_for(skew_segment_dir, trace_ratio=0.0,
+                    trace_ledger_path=led)
+    starts = []
+    orig = span_tracer.start
+
+    def counting_start(*a, **kw):
+        starts.append(a)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(span_tracer, "start", counting_start)
+    b.query(SAMPLE_SQL)
+    assert starts == []                 # zero cost when unsampled
+    assert not os.path.exists(led)
+    # per-query override wins over the broker default
+    b.query(SAMPLE_SQL + " OPTION(traceRatio=1.0)")
+    assert len(starts) == 1
+    assert uledger.validate_file(led)["kinds"] == {"query_trace": 1}
+
+
+def test_invalid_trace_ratio_is_sql_error(skew_segment_dir):
+    b = _broker_for(skew_segment_dir)
+    with pytest.raises(SqlError, match="traceRatio"):
+        b.query(SAMPLE_SQL + " OPTION(traceRatio=nope)")
+    with pytest.raises(SqlError, match="traceRatio"):
+        b.query(SAMPLE_SQL + " OPTION(traceRatio=3)")
+
+
+# ---------------------------------------------------------------------------
+# selectivity-drift self-tuning (tentpole leg 3)
+# ---------------------------------------------------------------------------
+
+DRIFT_SQL = ("SELECT k, SUM(v) FROM drifty WHERE f <= 50 "
+             "GROUP BY k ORDER BY k LIMIT 3000")
+
+
+def test_drift_requantizes_cap_and_recompiles_once(skew_segment_dir):
+    from pinot_tpu.ops.plan_cache import global_plan_cache
+    from pinot_tpu.query.context import build_query_context
+    from pinot_tpu.query.planner import SegmentPlanner
+    from pinot_tpu.query.sql import parse_sql
+    from pinot_tpu.utils.metrics import global_metrics
+
+    b = _broker_for(skew_segment_dir)
+    dm_seg = b.table("drifty").acquire_segments()[0]
+
+    def plan():
+        return SegmentPlanner(
+            build_query_context(parse_sql(DRIFT_SQL)), dm_seg).plan()
+
+    p1 = plan()
+    assert p1.kind == "kernel" and p1.kernel_plan.strategy == "compact"
+    assert not p1.drift_requantized
+    cap_est = p1.slots_cap
+    assert p1.est_selectivity > 0.5          # the bad uniform estimate
+
+    s0 = global_plan_cache.stats()
+    c0 = global_metrics.snapshot()["counters"]
+    r1 = b.query(DRIFT_SQL)                  # warm run records measured
+    meas = global_plan_cache.measured_for(
+        p1.kernel_plan, dm_seg.bucket, segment=dm_seg, params=p1.params)
+    assert meas is not None and meas < 0.05
+    # a query differing only in its literal shares the KernelPlan
+    # (literals hoist into params) but must NOT see this measurement —
+    # one query's selectivity never sets another query's capacity
+    p_other = SegmentPlanner(
+        build_query_context(parse_sql(DRIFT_SQL.replace("50", "95"))),
+        dm_seg).plan()
+    assert p_other.kernel_plan == p1.kernel_plan
+    assert global_plan_cache.measured_for(
+        p_other.kernel_plan, dm_seg.bucket, segment=dm_seg,
+        params=p_other.params) is None
+    assert not p_other.drift_requantized
+
+    # second planning sees the drift: cap re-quantized DOWN from the
+    # measurement, est_selectivity replaced so every derived capacity
+    # (PV106 consistency, scaled caps) agrees
+    p2 = plan()
+    assert p2.drift_requantized
+    assert p2.slots_cap < cap_est
+    assert p2.est_selectivity == pytest.approx(meas)
+    assert p2.strategy_trace["drift"]["new_cap"] == p2.slots_cap
+
+    r2 = b.query(DRIFT_SQL)                  # pays the ONE recompile
+    s2 = global_plan_cache.stats()
+    r3 = b.query(DRIFT_SQL)                  # hits the re-quantized entry
+    s3 = global_plan_cache.stats()
+
+    assert sorted(r1.rows) == sorted(r2.rows) == sorted(r3.rows)
+    assert s2["retraces"] == s0["retraces"]            # never a retrace
+    assert s2["expected_recompiles"] == s0["expected_recompiles"] + 1
+    assert s3["misses"] == s2["misses"]                # exactly once
+    c3 = global_metrics.snapshot()["counters"]
+    assert c3.get("selectivity_drift_detected", 0) > \
+        c0.get("selectivity_drift_detected", 0)
+    assert c3.get("selectivity_drift_requantized", 0) > \
+        c0.get("selectivity_drift_requantized", 0)
+    assert c3.get("plan_cache_retraces", 0) == \
+        c0.get("plan_cache_retraces", 0)
+    # the expected-compile bracket is consumed: a LATER rebuild of the
+    # same (plan, bucket, cap) — LRU eviction churn, a mode flip — is
+    # a genuine recompile and must stay visible to the detector
+    assert not global_plan_cache._note_requantize(
+        p2.kernel_plan, dm_seg.bucket, p2.slots_cap)
+
+
+def test_drift_annotated_on_analyze_span(skew_segment_dir):
+    b = _broker_for(skew_segment_dir)
+    b.query(DRIFT_SQL)                       # warm + record measured
+    res = b.query("EXPLAIN ANALYZE " + DRIFT_SQL)
+    details = " ".join(r[4] for r in res.rows)
+    assert "drift_requantized=True" in details
+
+
+def test_selectivity_drift_threshold():
+    from pinot_tpu.multistage.costs import selectivity_drift
+    assert not selectivity_drift(0.5, 0.2)          # within 4x
+    assert selectivity_drift(0.8, 0.01)             # way under-matched
+    assert selectivity_drift(0.01, 0.8)             # way over-matched
+    assert not selectivity_drift(None, 0.5)
+    assert not selectivity_drift(0.5, None)
+    assert selectivity_drift(0.5, 0.0)              # floors at MIN_SEL
+    assert not selectivity_drift(0.3, 0.1, ratio=10.0)
+
+
+# ---------------------------------------------------------------------------
+# span-diff regression gate (tentpole leg 2)
+# ---------------------------------------------------------------------------
+
+def test_span_diff_shape_key_normalizes():
+    a = span_diff.shape_key("SELECT  x FROM t\n WHERE y=1")
+    b = span_diff.shape_key("select x from t where y=1")
+    assert a == b
+    assert a != span_diff.shape_key("SELECT x FROM t WHERE y=2")
+
+
+@pytest.fixture(scope="module")
+def corpus_capture(tmp_path_factory):
+    """One fresh capture of the span_diff corpus (shared by the clean
+    and injected-slowdown tests; ~3s)."""
+    tmp = tmp_path_factory.mktemp("span_corpus")
+    led = str(tmp / "trace.jsonl")
+    n = span_diff.capture(led, iters=5, tmpdir=str(tmp))
+    assert n == 5 * len(span_diff.CORPUS_SQL)
+    return led
+
+
+def test_span_diff_current_tree_passes_checked_in_baseline(
+        corpus_capture, capsys):
+    # the tier-1 wiring: current tree vs tools/span_baseline.json
+    rc = span_diff.main(["check", corpus_capture])
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    cal = summary.get("calibration", 1.0)
+    if cal >= 4.9 or cal <= 0.21:
+        # the speed-calibration clamp saturated: this environment is
+        # >5x off the baseline machine and every per-phase comparison
+        # is meaningless — re-capture the baseline here instead of
+        # treating the mismatch as a code regression
+        pytest.skip(f"environment speed out of calibration range "
+                    f"(cal={cal}); re-capture tools/span_baseline.json")
+    assert rc == 0, summary
+    assert summary["checked_phases"] >= 4
+    assert not summary["new_shapes"], \
+        "corpus changed without re-capturing the baseline"
+    # capture emitted schema-valid records
+    res = uledger.validate_file(corpus_capture)
+    assert not res["errors"] and res["kinds"]["query_trace"] == 25
+
+
+def test_span_diff_fails_on_injected_2x_slowdown(corpus_capture,
+                                                 tmp_path, capsys):
+    slowed = str(tmp_path / "slowed.jsonl")
+    target = span_diff.shape_key(span_diff.CORPUS_SQL[0][1])
+    with open(corpus_capture) as fin, open(slowed, "w") as fout:
+        for line in fin:
+            rec = json.loads(line)
+            if span_diff.shape_key(rec["sql"]) == target:
+                root = rec["root"]
+                for c in root["children"]:
+                    if c["name"] == ph.EXECUTION:
+                        root["ms"] += c["ms"]     # 2x THIS phase only
+                        c["ms"] *= 2
+            fout.write(json.dumps(rec) + "\n")
+    rc = span_diff.main(["check", slowed])
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert rc == 1, summary
+    assert any(r["phase"] == ph.EXECUTION and r["shape"] == target
+               for r in summary["regressions"])
+
+
+def test_span_diff_recency_cutoff_beats_history(corpus_capture,
+                                                tmp_path, capsys):
+    # an append-only ledger accumulates history: four old fast captures
+    # must not out-vote a fresh 2x-slow one (aggregate keeps only the
+    # newest --last records per shape)
+    diluted = str(tmp_path / "diluted.jsonl")
+    target = span_diff.shape_key(span_diff.CORPUS_SQL[0][1])
+    lines = open(corpus_capture).read().splitlines()
+    with open(diluted, "w") as fout:
+        for _ in range(4):                      # historical fast runs
+            fout.write("\n".join(lines) + "\n")
+        for line in lines:                      # the fresh (slow) run
+            rec = json.loads(line)
+            if span_diff.shape_key(rec["sql"]) == target:
+                root = rec["root"]
+                for c in root["children"]:
+                    if c["name"] == ph.EXECUTION:
+                        root["ms"] += c["ms"]
+                        c["ms"] *= 2
+            fout.write(json.dumps(rec) + "\n")
+    rc = span_diff.main(["check", diluted])
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert rc == 1, summary
+    assert any(r["phase"] == ph.EXECUTION and r["shape"] == target
+               for r in summary["regressions"])
+
+
+def test_bench_common_span_gate_wiring(corpus_capture):
+    import bench_common
+    gate = bench_common.span_regression_gate(corpus_capture)
+    assert gate is not None and gate["ok"] is True
+    assert gate.get("regressions") == []
+
+
+def test_span_diff_calibration_absorbs_uniform_slowdown(corpus_capture):
+    # a machine running uniformly 2x slower must NOT trip the gate
+    records = span_diff.load_trace_records([corpus_capture])
+    for rec in records:
+        def scale(node):
+            node["ms"] = float(node["ms"]) * 2
+            for c in node.get("children") or []:
+                scale(c)
+        scale(rec["root"])
+    cand = span_diff.aggregate(records)
+    baseline = span_diff.load_baseline(span_diff.DEFAULT_BASELINE)
+    res = span_diff.diff_shapes(baseline, cand, span_diff.DEFAULT_BAR,
+                                span_diff.DEFAULT_MIN_MS)
+    assert res["regressions"] == [], res
+    assert res["calibration"] > 1.5
+
+
+# ---------------------------------------------------------------------------
+# multistage trace propagation (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def join_broker(tmp_path_factory):
+    rng = np.random.default_rng(3)
+    tmp = tmp_path_factory.mktemp("msjoin")
+    b = Broker()
+    for t, n in (("facts", 800), ("dims", 60)):
+        cols = {"k": rng.integers(0, 60, n).astype(np.int32),
+                "v": rng.integers(0, 100, n).astype(np.int32)}
+        sch = Schema(t, [FieldSpec("k", DataType.INT),
+                         FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        d = SegmentBuilder(sch, TableConfig(t)).build(
+            cols, str(tmp), f"{t}_0")
+        dm = TableDataManager(t)
+        dm.add_segment_dir(d)
+        b.register_table(dm)
+    return b
+
+
+def _wall_gate(rows):
+    root = rows[0]
+    children = [r for r in rows if r[2] == root[1]]
+    assert abs(sum(r[3] for r in children) - root[3]) <= 0.10 * root[3]
+
+
+def test_multistage_join_analyze_spans(join_broker):
+    res = join_broker.query(
+        "EXPLAIN ANALYZE SELECT facts.k, SUM(facts.v) FROM facts "
+        "JOIN dims ON facts.k = dims.k GROUP BY facts.k "
+        "ORDER BY facts.k LIMIT 10")
+    names = [r[0] for r in res.rows]
+    assert names[0] == ph.QUERY
+    assert names.count(ph.LEAF_SCAN) == 2
+    assert ph.JOIN_STAGE in names and ph.FINAL_STAGE in names
+    join_row = next(r for r in res.rows if r[0] == ph.JOIN_STAGE)
+    assert "backend=" in join_row[4] and "rows=" in join_row[4]
+    _wall_gate([tuple(r) for r in res.rows])
+
+
+def test_multistage_window_analyze_spans(join_broker):
+    res = join_broker.query(
+        "EXPLAIN ANALYZE SELECT k, v, SUM(v) OVER (PARTITION BY k) "
+        "FROM facts LIMIT 10")
+    names = [r[0] for r in res.rows]
+    assert ph.WINDOW_STAGE in names and ph.FINAL_STAGE in names
+    _wall_gate([tuple(r) for r in res.rows])
+
+
+def test_setop_analyze_wall_gate(join_broker):
+    res = join_broker.query(
+        "EXPLAIN ANALYZE SELECT k FROM facts WHERE v < 50 "
+        "UNION SELECT k FROM dims LIMIT 200")
+    rows = [tuple(r) for r in res.rows]
+    names = [r[0] for r in rows]
+    assert names.count(ph.EXECUTION) >= 2      # one per branch
+    _wall_gate(rows)
+
+
+def test_distributed_join_stitches_stage_trees(tmp_path):
+    from pinot_tpu.cluster import Controller, ServerNode
+    from pinot_tpu.multistage.dispatch import distributed_join
+
+    ctrl = Controller(str(tmp_path / "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=0.2)
+    servers = [ServerNode(f"server_{i}", ctrl.url, poll_interval=0.1)
+               for i in range(2)]
+    try:
+        sch_l = Schema("lt", [FieldSpec("k", DataType.INT),
+                              FieldSpec("v", DataType.INT,
+                                        FieldType.METRIC)])
+        sch_r = Schema("rt", [FieldSpec("k", DataType.INT),
+                              FieldSpec("w", DataType.INT,
+                                        FieldType.METRIC)])
+        ctrl.add_table("lt", sch_l.to_dict(), replication=1)
+        ctrl.add_table("rt", sch_r.to_dict(), replication=1)
+        d = SegmentBuilder(sch_l, TableConfig("lt")).build(
+            {"k": np.arange(8, dtype=np.int32),
+             "v": (np.arange(8) * 2).astype(np.int32)},
+            str(tmp_path / "seg"), "lt_0")
+        ctrl.add_segment("lt", "lt_0", d)
+        d = SegmentBuilder(sch_r, TableConfig("rt")).build(
+            {"k": np.asarray([0, 2, 4], dtype=np.int32),
+             "w": np.asarray([5, 6, 7], dtype=np.int32)},
+            str(tmp_path / "seg"), "rt_0")
+        ctrl.add_segment("rt", "rt_0", d)
+
+        def hosted(s, t):
+            dm = s._tables.get(t)
+            return dm is not None and dm.acquire_segments()
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(hosted(s, "lt") for s in servers) and \
+                    any(hosted(s, "rt") for s in servers):
+                break
+            time.sleep(0.05)
+
+        def owner(t):
+            return next(s.url for s in servers if hosted(s, t))
+
+        root = span_tracer.start(ph.QUERY, table="lt")
+        try:
+            rel = distributed_join(
+                [{"url": owner("lt"),
+                  "sql": "SELECT k, v FROM lt LIMIT 100", "alias": "l"}],
+                [{"url": owner("rt"),
+                  "sql": "SELECT k, w FROM rt LIMIT 100", "alias": "r"}],
+                [s.url for s in servers], ["l.k"], ["r.k"])
+        finally:
+            root = span_tracer.stop() or root
+        assert rel.n_rows == 3
+
+        dispatch = root.child(ph.STAGE_DISPATCH)
+        assert dispatch is not None
+        calls = [c for c in dispatch.children
+                 if c.name == ph.STAGE_CALL]
+        assert len(calls) == 4               # 2 join workers + 2 leaves
+        assert all(c.attrs["status"] == "ok" for c in calls)
+        # every call stitched its worker's remote stage tree + net_ms
+        for c in calls:
+            stage = c.child(ph.STAGE)
+            assert stage is not None, c.attrs
+            assert c.attrs["net_ms"] is not None
+            if c.attrs["kind"] == "leaf":
+                assert stage.find(ph.LEAF_SCAN)
+                assert stage.find(ph.EXCHANGE)   # mailbox sends traced
+            else:
+                assert stage.find(ph.JOIN_STAGE)
+        # unsampled runs stay trace-free on the worker wire
+        rel2 = distributed_join(
+            [{"url": owner("lt"),
+              "sql": "SELECT k, v FROM lt LIMIT 100", "alias": "l"}],
+            [{"url": owner("rt"),
+              "sql": "SELECT k, w FROM rt LIMIT 100", "alias": "r"}],
+            [s.url for s in servers], ["l.k"], ["r.k"])
+        assert rel2.n_rows == 3
+    finally:
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# traceRatio over the SSB corpus: record-per-query + overhead gate
+# ---------------------------------------------------------------------------
+
+# the cheap-warm SSB subset (the q2.x/q3.1/q4.2 compact-path queries run
+# 1.5-2s each warm on CPU — the full 13 run in the slow-marked variant)
+SSB_FAST_QIDS = ("q1.1", "q1.2", "q1.3", "q3.2", "q3.3", "q3.4",
+                 "q4.1", "q4.3")
+
+
+def _ssb_broker(tmp_path, led, rows=1 << 13):
+    import bench
+    seg = bench.build_segment(rows, str(tmp_path))
+    dm = TableDataManager("lineorder")
+    dm.add_segment(seg)
+    b = Broker(trace_ledger_path=led)
+    b.register_table(dm)
+    by_id = {q[0]: q for q in bench.QUERIES}
+    return b, by_id
+
+
+def _ssb_overhead(b, sqls, passes=3):
+    def one_pass(ratio):
+        t = time.perf_counter()
+        for s in sqls:
+            b.query(s + f" OPTION(timeoutMs=300000,traceRatio={ratio})")
+        return time.perf_counter() - t
+    r0 = min(one_pass(0) for _ in range(passes))
+    r1 = min(one_pass(1.0) for _ in range(passes))
+    return r1 / r0
+
+
+def test_ssb_trace_ratio_one_records_every_query(tmp_path):
+    import bench
+    led = str(tmp_path / "trace.jsonl")
+    b, by_id = _ssb_broker(tmp_path, led)
+    sqls = [bench.spec_to_sql(*by_id[qid][1:]) for qid in SSB_FAST_QIDS]
+    for s in sqls:                           # warmup pays the compiles
+        b.query(s + " OPTION(timeoutMs=300000,traceRatio=0)")
+    overhead = _ssb_overhead(b, sqls)
+    res = uledger.validate_file(led)
+    assert not res["errors"], res["errors"][:3]
+    # one validated record per query per traced pass
+    assert res["kinds"]["query_trace"] == 3 * len(sqls)
+    traced_sqls = {json.loads(line)["sql"].split(" OPTION")[0]
+                   for line in open(led)}
+    assert traced_sqls == set(sqls)          # EVERY query emitted one
+    # acceptance: <10% wall overhead at traceRatio=1.0 (min-of-3 per
+    # mode absorbs scheduler jitter; measured ~0.7% at full scale)
+    assert overhead < 1.10, f"sampling overhead {overhead:.3f}"
+
+
+@pytest.mark.slow
+def test_ssb_trace_ratio_full_corpus(tmp_path):
+    import bench
+    led = str(tmp_path / "trace.jsonl")
+    b, by_id = _ssb_broker(tmp_path, led, rows=1 << 14)
+    sqls = [bench.spec_to_sql(p, v, g) for _, p, v, g in bench.QUERIES]
+    for s in sqls:
+        b.query(s + " OPTION(timeoutMs=300000,traceRatio=0)")
+    overhead = _ssb_overhead(b, sqls, passes=2)
+    res = uledger.validate_file(led)
+    assert not res["errors"]
+    assert res["kinds"]["query_trace"] == 2 * len(bench.QUERIES)
+    assert overhead < 1.10, f"sampling overhead {overhead:.3f}"

@@ -102,10 +102,14 @@ def build_ssb_cluster(tmp: str, rows: int = 4096, n_segments: int = 4,
                for i in range(2)]
     # per-query query_stats ledger: the soak's trend-line output (and
     # the assertion target — every cluster query must append a
-    # check_ledger-valid record)
+    # check_ledger-valid record). trace_ratio=1.0: every soak query is
+    # production-sampled, so the chaos plans also exercise the sampled
+    # span plane (failover/hedge spans under injected faults) and every
+    # run must land validated query_trace records beside the stats.
     broker = BrokerNode(ctrl.url, routing_refresh=poll,
                         query_stats_path=os.path.join(
-                            tmp, "query_stats.jsonl"))
+                            tmp, "query_stats.jsonl"),
+                        trace_ratio=1.0)
 
     for table, replication in (("lineorder", 2), ("lineorder_r1", 1)):
         schema = Schema(table, fields)
@@ -143,20 +147,34 @@ def digest(resp: dict):
     return bench._digest([tuple(r) for r in resp["resultTable"]["rows"]])
 
 
-def _iter_stats(path: str, partial=None):
-    """query_stats records from a stats ledger, optionally filtered by
-    the partialResult flag."""
+def _iter_kind(path: str, kind: str):
+    """v2 records of one kind from a ledger file."""
     with open(path) as fh:
         for line in fh:
             try:
                 rec = json.loads(line)
             except ValueError:
                 continue
-            if rec.get("kind") != "query_stats":
-                continue
-            if partial is not None and rec.get("partial") != partial:
-                continue
-            yield rec
+            if isinstance(rec, dict) and rec.get("kind") == kind:
+                yield rec
+
+
+def _iter_stats(path: str, partial=None):
+    """query_stats records from a stats ledger, optionally filtered by
+    the partialResult flag."""
+    for rec in _iter_kind(path, "query_stats"):
+        if partial is not None and rec.get("partial") != partial:
+            continue
+        yield rec
+
+
+SPAN_BASELINE = os.path.join(REPO, "tools", "span_baseline.json")
+
+
+def _file_hash(path: str):
+    import hashlib
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
 
 
 # seeds/rows whose decision streams fire EVERY ingest fault point
@@ -283,6 +301,8 @@ def main(argv=None) -> int:
             print(f"FAIL {name}: {detail}")
 
     faults.clear()
+    baseline_hash = _file_hash(SPAN_BASELINE) \
+        if os.path.exists(SPAN_BASELINE) else None
     ctrl, servers, broker, stop = build_ssb_cluster(tmp, args.rows)
     try:
         queries = smoke_queries(tuple(args.queries.split(",")))
@@ -401,6 +421,28 @@ def main(argv=None) -> int:
                   broker.forensics.ledger_path, partial=True)),
               "no partialResult=true query_stats record from the "
               "replication-1 plan")
+        # traceRatio=1.0 sampling: every soak query must also have
+        # landed a VALIDATED query_trace record (validate_file above
+        # already schema-checked them), qid-joinable to its stats row
+        n_traces = stats["kinds"].get("query_trace", 0)
+        summary["query_trace"] = n_traces
+        check("query_trace.count", n_traces >= 3 * len(queries),
+              f"only {n_traces} query_trace records for "
+              f"{len(queries)} queries x 3 full passes")
+        trace_qids = {r.get("qid") for r in _iter_kind(
+            broker.forensics.ledger_path, "query_trace")}
+        stats_qids = {r.get("qid") for r in _iter_stats(
+            broker.forensics.ledger_path) if r.get("traced")}
+        check("trace_stats_join", bool(trace_qids)
+              and trace_qids <= stats_qids,
+              f"{len(trace_qids - stats_qids)} trace qids without a "
+              "traced query_stats row")
+        # the chaos run must not have corrupted the checked-in span
+        # baseline (nothing may write it outside `span_diff.py update`)
+        if baseline_hash is not None:
+            check("span_baseline.intact",
+                  _file_hash(SPAN_BASELINE) == baseline_hash,
+                  "tools/span_baseline.json changed during the soak")
     finally:
         faults.clear()
         stop()
