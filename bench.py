@@ -31,7 +31,7 @@ import math
 import os
 import sys
 import time
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -124,13 +124,15 @@ def build_segment(n: int, out_dir: str):
     return ImmutableSegment.load(seg_dir)
 
 
-def build_or_load_segment():
+def build_or_load_segment(n_rows: Optional[int] = None):
     from pinot_tpu.segment import ImmutableSegment
 
-    seg_dir = os.path.join(CACHE, f"ssb_flat_{N_ROWS}", "seg_0")
+    n_rows = N_ROWS if n_rows is None else n_rows
+    seg_dir = os.path.join(CACHE, f"ssb_flat_{n_rows}", "seg_0")
     if os.path.exists(os.path.join(seg_dir, "metadata.json")):
         return ImmutableSegment.load(seg_dir)
-    return build_segment(N_ROWS, os.path.join(CACHE, f"ssb_flat_{N_ROWS}"))
+    return build_segment(n_rows, os.path.join(CACHE,
+                                              f"ssb_flat_{n_rows}"))
 
 
 # ---------------------------------------------------------------------------
@@ -381,6 +383,219 @@ def kernel_time(seg, sql, iters):
 
 
 METRIC = "ssb_q1.1-q4.3_geomean_rows_per_sec_per_chip"
+QPS_METRIC = "ssb_concurrent_qps"
+
+# ---------------------------------------------------------------------------
+# concurrent-QPS mode (--concurrency N, PR 8): N simultaneous
+# plan-shape-sharing SSB queries through the broker, cross-query
+# micro-batching fused vs the serial per-query dispatch path
+# ---------------------------------------------------------------------------
+
+# literal-variant generators per SSB shape: each variant KEEPS the plan
+# structure (eq stays eq, BETWEEN keeps both bounds, OR-of-equals keeps
+# its width) and varies only literal values, so concurrent variants
+# share the exact KernelPlan the plan cache / ragged batcher key on
+QPS_SHAPES = [
+    ("q1.1", lambda i:
+        f"SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder "
+        f"WHERE d_year = {1992 + i % 7} "
+        f"AND lo_discount BETWEEN {i % 4} AND {i % 4 + 2} "
+        f"AND lo_quantity < {20 + i % 15}"),
+    ("q1.2", lambda i:
+        f"SELECT SUM(lo_extendedprice * lo_discount) FROM lineorder "
+        f"WHERE d_yearmonthnum = {199201 + (i % 7) * 100 + i % 12} "
+        f"AND lo_discount BETWEEN {1 + i % 4} AND {3 + i % 4} "
+        f"AND lo_quantity BETWEEN {10 + i % 10} AND {30 + i % 10}"),
+    ("q3.1", lambda i:
+        f"SELECT c_nation, s_nation, d_year, SUM(lo_revenue) "
+        f"FROM lineorder WHERE c_region = '{REGIONS[i % 5]}' "
+        f"AND s_region = '{REGIONS[(i // 5) % 5]}' "
+        f"AND d_year BETWEEN {1992 + i % 2} AND {1996 + i % 3} "
+        f"GROUP BY c_nation, s_nation, d_year "
+        f"ORDER BY c_nation, s_nation, d_year LIMIT 100000"),
+    ("q4.1", lambda i:
+        f"SELECT d_year, c_nation, "
+        f"SUM(lo_revenue - lo_supplycost) FROM lineorder "
+        f"WHERE c_region = '{REGIONS[i % 5]}' "
+        f"AND s_region = '{REGIONS[(i // 5) % 5]}' "
+        f"AND (p_mfgr = 'MFGR#{1 + i % 4}' OR p_mfgr = 'MFGR#{2 + i % 4}')"
+        f" GROUP BY d_year, c_nation ORDER BY d_year, c_nation "
+        f"LIMIT 100000"),
+]
+
+QPS_ROUNDS = int(os.environ.get("PINOT_BENCH_QPS_ROUNDS", 6))
+QPS_WINDOW_MS = float(os.environ.get("PINOT_BENCH_QPS_WINDOW_MS", 8.0))
+
+
+def _qps_broker(n_rows: int):
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.server import TableDataManager
+
+    dm = TableDataManager("lineorder")
+    dm.add_segment(build_or_load_segment(n_rows))
+    broker = Broker()
+    broker.register_table(dm)
+    return broker
+
+
+def _drive_round(broker, sqls, out_rows, latencies, errors):
+    """One synchronized wave: len(sqls) threads fire simultaneously."""
+    import threading
+
+    barrier = threading.Barrier(len(sqls))
+
+    def worker(k):
+        try:
+            barrier.wait(30)
+            t0 = time.perf_counter()
+            res = broker.query(sqls[k])
+            latencies.append((time.perf_counter() - t0) * 1e3)
+            out_rows[k] = res.rows
+        except Exception as e:  # noqa: BLE001 — collected, fails the run
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(len(sqls))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0
+
+
+def _drive(broker, concurrency, rounds, latencies, errors):
+    """-> (total wall s, digests {shape: [per-variant digest]}, n)."""
+    digests: dict = {}
+    wall = 0.0
+    n = 0
+    for shape, make in QPS_SHAPES:
+        rows_out = [None] * concurrency
+        sqls = [make(k) + OPTION for k in range(concurrency)]
+        for _r in range(rounds):
+            wall += _drive_round(broker, sqls, rows_out, latencies,
+                                 errors)
+            n += concurrency
+        digests[shape] = [None if r is None else _digest(r)
+                          for r in rows_out]
+    return wall, digests, n
+
+
+def run_concurrent_qps(concurrency: int) -> None:
+    """The PR 8 acceptance benchmark: queries/sec through the broker at
+    ``concurrency`` simultaneous plan-shape-sharing SSB queries, fused
+    (cross-query micro-batching) vs the serial per-query dispatch path,
+    with byte-identical digests and zero post-warmup retraces gated."""
+    from bench_common import (attach_capture_context, finish,
+                              install_capture_guard, require_backend)
+    from pinot_tpu.engine.ragged import global_batcher
+    from pinot_tpu.ops.plan_cache import global_plan_cache
+
+    backend = require_backend(QPS_METRIC)
+    n_rows = (N_ROWS if "PINOT_BENCH_ROWS" in os.environ
+              else 1 << 20)
+    out: dict = {"metric": QPS_METRIC, "value": 0, "unit": "queries/s",
+                 "concurrency": concurrency, "n_rows": n_rows}
+    install_capture_guard(lambda: attach_capture_context(dict(out),
+                                                         backend))
+    broker = _qps_broker(n_rows)
+    errors: list = []
+
+    # warmup both paths: compiles (solo kernels, cube builders, the
+    # ragged pow2 ladder) happen here, outside every measured window.
+    # Every pow2 rung <= concurrency is visited explicitly: measured
+    # waves can split on arrival timing (e.g. 23+9), and a rung first
+    # compiled mid-measurement would stall that wave — warmup, not a
+    # retrace, by the detector's first-visit rule, but wall time the
+    # measured rounds must not pay
+    global_batcher.configure(enabled=False)
+    _drive(broker, concurrency, 1, [], errors)
+    global_batcher.configure(enabled=True, window_ms=QPS_WINDOW_MS,
+                             max_batch=concurrency)
+    _drive(broker, concurrency, 2, [], errors)
+    rung = 2
+    while rung < concurrency:
+        _drive(broker, rung, 1, [], errors)
+        rung *= 2
+    if errors:
+        out["error"] = f"warmup failed: {errors[0]}"
+        print(json.dumps(attach_capture_context(out, backend)))
+        sys.exit(1)
+
+    # measured: fused first (zero-retrace gate brackets it), then serial
+    miss0 = global_plan_cache.snapshot_misses()
+    det0 = global_plan_cache.detector.retraces
+    fused_lat: list = []
+    snap0 = _batching_counters()
+    fused_wall, fused_digests, n_fused = _drive(
+        broker, concurrency, QPS_ROUNDS, fused_lat, errors)
+    snap1 = _batching_counters()
+    retraces = max(global_plan_cache.snapshot_misses() - miss0,
+                   global_plan_cache.detector.retraces - det0)
+
+    global_batcher.configure(enabled=False)
+    serial_lat: list = []
+    serial_wall, serial_digests, n_serial = _drive(
+        broker, concurrency, QPS_ROUNDS, serial_lat, errors)
+
+    # solo-dispatch latency for a lone query: batching on must not
+    # regress the no-peers path (<5% gate)
+    solo_sql = QPS_SHAPES[0][1](0) + OPTION
+    def solo_median(enabled: bool) -> float:
+        global_batcher.configure(enabled=enabled)
+        ts = []
+        for _ in range(9):
+            t0 = time.perf_counter()
+            broker.query(solo_sql)
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2] * 1e3
+    solo_off = solo_median(False)
+    solo_on = solo_median(True)
+    global_batcher.configure(enabled=False)
+
+    digests_ok = fused_digests == serial_digests and not errors
+    qps = n_fused / fused_wall if fused_wall else 0.0
+    qps_serial = n_serial / serial_wall if serial_wall else 0.0
+    fused_q = snap1["batched_queries"] - snap0["batched_queries"]
+    sl = sorted(fused_lat) or [0.0]
+    out.update({
+        "value": round(qps, 1),
+        "qps": round(qps, 1),
+        "qps_serial": round(qps_serial, 1),
+        "qps_ratio": round(qps / qps_serial, 2) if qps_serial else 0.0,
+        "p50_ms": round(sl[len(sl) // 2], 2),
+        "p99_ms": round(sl[min(len(sl) - 1, int(len(sl) * 0.99))], 2),
+        "fused_ratio": round(fused_q / max(n_fused, 1), 3),
+        "solo_latency_ratio": round(solo_on / solo_off, 3)
+        if solo_off else 0.0,
+        "extra": {
+            "retraces_post_warmup": retraces,
+            "digests_byte_identical": digests_ok,
+            "batched_dispatches": snap1["batched_dispatches"]
+            - snap0["batched_dispatches"],
+            "queries_per_mode": n_fused,
+            "rounds": QPS_ROUNDS,
+            "window_ms": QPS_WINDOW_MS,
+        },
+    })
+    if errors:
+        out["error"] = errors[0]
+    all_ok = (digests_ok and retraces == 0
+              and out["qps_ratio"] >= 2.0
+              and out["solo_latency_ratio"] <= 1.05)
+    if not all_ok and "error" not in out:
+        out["error"] = ("concurrent-QPS acceptance gate failed "
+                        f"(ratio {out['qps_ratio']}, retraces "
+                        f"{retraces}, digests_ok {digests_ok}, solo "
+                        f"{out['solo_latency_ratio']})")
+    finish(out, backend, all_ok)
+
+
+def _batching_counters() -> dict:
+    from pinot_tpu.utils.metrics import global_metrics
+    c = global_metrics.snapshot()["counters"]
+    return {"batched_queries": c.get("batched_queries", 0),
+            "batched_dispatches": c.get("batched_dispatches", 0)}
 
 # per-query worker budget: full-scale compile + warm + iters is minutes,
 # never hours — a wedged tunnel mid-capture loses ONE query, not the
@@ -541,6 +756,11 @@ def main() -> None:
     worker = os.environ.get("PINOT_BENCH_WORKER")
     if worker:
         _worker_main(worker)
+        return
+
+    if "--concurrency" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--concurrency") + 1])
+        run_concurrent_qps(n)
         return
 
     backend = require_backend(METRIC)  # never hang on a wedged tunnel

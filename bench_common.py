@@ -383,10 +383,20 @@ def span_regression_gate(ledger_path: str | None = None,
                 tempfile.mkdtemp(prefix="ptpu_span_gate_"),
                 "trace.jsonl")
             try:
+                # the corpus must run in the SAME engine configuration
+                # the checked-in baseline was captured under (the
+                # span_diff docstring contract): tier-1 pins the CPU
+                # scatter-core hedge OFF, while a bare bench shell
+                # defaults it on — without the pin every group-by
+                # shape's execution diffs core-vs-core, not
+                # code-vs-code. Harmless on TPU backends, where
+                # cpu_scatter_default is false either way.
+                env = dict(os.environ)
+                env["PINOT_CPU_FAST_GROUPBY"] = "0"
                 proc = subprocess.run(
                     [sys.executable, span_diff, "capture",
                      "--out", tmp, "--iters", "3"],
-                    capture_output=True, text=True, timeout=300)
+                    env=env, capture_output=True, text=True, timeout=300)
                 if proc.returncode != 0:
                     return {"ok": True, "skipped":
                             "capture failed: " + proc.stderr[-200:]}

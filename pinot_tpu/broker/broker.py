@@ -80,9 +80,20 @@ class QueryTimeoutError(SqlError):
 
 class Broker:
     def __init__(self, trace_ratio: Optional[float] = None,
-                 trace_ledger_path: Optional[str] = None):
+                 trace_ledger_path: Optional[str] = None,
+                 micro_batch: Optional[bool] = None,
+                 micro_batch_window_ms: Optional[float] = None):
         from .quota import QueryQuotaManager
         self._tables: Dict[str, TableDataManager] = {}
+        # cross-query micro-batching (PR 8): concurrent queries sharing
+        # a plan structure fuse into one ragged device dispatch
+        # (engine/ragged.py). The dispatcher is engine-global (fusion
+        # happens below the broker), so the flag configures the shared
+        # batcher; None leaves the PINOT_MICROBATCH env default alone.
+        if micro_batch is not None or micro_batch_window_ms is not None:
+            from ..engine.ragged import global_batcher
+            global_batcher.configure(enabled=micro_batch,
+                                     window_ms=micro_batch_window_ms)
         # name -> view body statement (CREATE VIEW ... AS <select>);
         # expanded into CTEs at reference time (_expand_views)
         self._views: Dict[str, Any] = {}

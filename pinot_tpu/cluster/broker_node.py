@@ -92,6 +92,11 @@ class ScatterResult:
     # exists to subtract)
     serde_ms: float = 0.0
     net_ms: float = 0.0
+    # cross-query micro-batching participation (engine/ragged.py via
+    # the server wire header): fused dispatches this query's server
+    # executions rode, and the largest batch any of them shared
+    batched_dispatches: int = 0
+    batch_size_max: int = 0
     # failovers/serde/net increment from call() on POOL threads —
     # float/int += is a non-atomic read-modify-write (the same race _rr
     # hit before its itertools.count fix), so they mutate under this lock
@@ -109,6 +114,14 @@ class ScatterResult:
                 return
             self.serde_ms += serde
             self.net_ms += net
+
+    def add_batching(self, dispatches: int, batch_size: int) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self.batched_dispatches += int(dispatches)
+            self.batch_size_max = max(self.batch_size_max,
+                                      int(batch_size))
 
     def close_wire_times(self) -> None:
         with self._lock:
@@ -712,6 +725,9 @@ class BrokerNode:
                         sp.annotate(net_ms=round(net, 3))
                     sp.annotate(status="ok", serde_ms=round(serde, 3))
                 res.add_wire_times(serde, net)
+                if header.get("batched"):
+                    res.add_batching(header.get("batched", 0),
+                                     header.get("batchSize", 0))
                 return {"partials": decoded, "segmentsQueried": n_run,
                         "dispatched": [server], "responders": [server]}
             except urllib.error.HTTPError as e:
@@ -1028,6 +1044,7 @@ class BrokerNode:
         the realtime-plane recovery counters + freshness gauge next to
         the round-9 scatter counters (in-process roles share
         global_metrics; a standalone broker reports zeros)."""
+        from ..engine.ragged import batching_health
         snap = global_metrics.snapshot()
         c = snap["counters"]
         fd = self._failures.snapshot()
@@ -1042,6 +1059,9 @@ class BrokerNode:
                 "scatter_partial_responses", "scatter_server_errors",
                 "faults_fired")},
             "ingest": ingest_health(snap),
+            # cross-query micro-batching counters (PR 8) — rendered on
+            # the /ui console next to the scatter block
+            "batching": batching_health(snap),
         }
 
     # -- REST --------------------------------------------------------------
@@ -1158,6 +1178,7 @@ async function health(){
       (s.backoffRemainingS>0?' (backoff '+s.backoffRemainingS+'s)':''))
       .join(' | ')||'all healthy';
     const i=m.ingest||{};
+    const b=m.batching||{};const sf=b.solo_fallbacks||{};
     document.getElementById('scatter').textContent=
       'scatter health: '+m.unhealthyServers+'/'+m.knownServers+
       ' unhealthy | failovers '+(c.scatter_failovers||0)+
@@ -1171,7 +1192,21 @@ async function health(){
       ' | commit retries '+(i.ingest_commit_retries||0)+
       ' | rebalance resets '+(i.ingest_rebalance_resets||0)+
       ' | upsert replays '+(i.ingest_upsert_replays||0)+
-      ' | orphans cleaned '+(i.ingest_orphans_cleaned||0);
+      ' | orphans cleaned '+(i.ingest_orphans_cleaned||0)+
+      '\\nbatching ('+(b.enabled?'on':'off')+'): fused dispatches '+
+      (b.batched_dispatches||0)+
+      ' | fused queries '+(b.batched_queries||0)+
+      ' | queue depth '+(b.batch_queue_depth||0)+
+      ' | cube cache '+(b.cube_cache_hits||0)+'/'+
+      ((b.cube_cache_hits||0)+(b.cube_cache_misses||0))+
+      ' | solo: deadline '+(sf.deadline||0)+
+      ', incompatible '+(sf.incompatible||0)+
+      ', window-expired '+(sf.window_expired||0)+
+      ', no-peers '+(sf.no_peers||0)+
+      ', timeout '+(sf.timeout||0)+
+      ', leader-error '+(sf.leader_error||0)+
+      ' | errors '+(b.fused_dispatch_errors||0)+
+      ' | sizes '+JSON.stringify(b.batch_size_histogram||{});
   }catch(e){}
 }
 async function slowq(){

@@ -162,6 +162,15 @@ class QueryForensics:
             fields["serde_ms"] = round(serde, 3)
         if net:
             fields["net_ms"] = round(net, 3)
+        # cross-query micro-batching (PR 8): fused dispatches this
+        # query's server executions participated in + the largest
+        # batch shared — the throughput plane's query_stats trend line
+        batched = sum(getattr(s, "batched_dispatches", 0)
+                      for s in scatters)
+        if batched:
+            fields["batched"] = batched
+            fields["batch_size"] = max(
+                getattr(s, "batch_size_max", 0) for s in scatters)
         rec = uledger.make_record("query_stats", **fields)
         if self.ledger_path:
             try:

@@ -223,10 +223,17 @@ class ServerNode:
 
         global_accountant.register(query_id)
         try:
-            return self.scheduler.execute(run, query_id,
+            resp = self.scheduler.execute(run, query_id,
                                           priority=priority)
         finally:
-            global_accountant.unregister(query_id)
+            usage = global_accountant.unregister(query_id)
+        if usage is not None and usage.batched_dispatches:
+            # cross-query micro-batching participation (engine/ragged):
+            # rides the wire header so the broker's query_stats records
+            # carry batched/batch_size per query
+            resp["batched"] = usage.batched_dispatches
+            resp["batchSize"] = usage.max_batch_size
+        return resp
 
     def _execute(self, sql: str, segment_names: Optional[List[str]] = None,
                  query_id: Optional[str] = None,

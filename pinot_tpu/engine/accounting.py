@@ -64,6 +64,12 @@ class QueryUsage:
     cpu_s: float = 0.0
     mem_bytes: int = 0
     killed_reason: Optional[str] = None
+    # cross-query micro-batching (engine/ragged.py): how many fused
+    # dispatches this query rode and the largest batch it shared — the
+    # server ships them in the wire header and the broker's forensics
+    # plane lands them as query_stats batched/batch_size fields
+    batched_dispatches: int = 0
+    max_batch_size: int = 0
     _thread_cpu0: Dict[int, float] = field(default_factory=dict)
 
     @property
@@ -171,11 +177,32 @@ class ResourceAccountant:
                 f"query {u.query_id} killed: deadline exceeded",
                 is_deadline=True)
 
+    def note_batched(self, query_id: str, batch_size: int) -> None:
+        """A fused ragged dispatch included this query (engine/ragged.py
+        leader thread) — counters mutate under the lock because the
+        leader annotates every participant, not just its own query."""
+        with self._lock:
+            u = self._by_query.get(query_id)
+            if u is not None:
+                u.batched_dispatches += 1
+                u.max_batch_size = max(u.max_batch_size, int(batch_size))
+
     def track_memory(self, nbytes: int) -> None:
         tid = threading.get_ident()
         with self._lock:
             qid = self._by_thread.get(tid)
             u = self._by_query.get(qid) if qid else None
+            if u is not None:
+                u.mem_bytes += max(int(nbytes), 0)
+
+    def track_memory_for(self, query_id: str, nbytes: int) -> None:
+        """Attribute bytes to a named query regardless of the calling
+        thread — the fused ragged dispatch's leader apportions the
+        batch's host outputs per participant so the heap watcher's
+        kill ordering sees each query's real footprint, not the whole
+        batch piled onto the leader."""
+        with self._lock:
+            u = self._by_query.get(query_id)
             if u is not None:
                 u.mem_bytes += max(int(nbytes), 0)
 

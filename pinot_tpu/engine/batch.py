@@ -136,8 +136,21 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
                _param_sig(params) + shape_sig)
         groups.setdefault(key, []).append(i)
 
-    for (kind, plan_struct, bucket, _sig), idxs in groups.items():
+    from .ragged import global_batcher
+    for (kind, plan_struct, bucket, sig), idxs in groups.items():
         global_accountant.sample()
+        if global_batcher.enabled:
+            # cross-query micro-batching (PR 8): offer this group to the
+            # ragged admission queue — concurrent queries sharing the
+            # plan structure fuse into one cube-contraction launch.
+            # None means dispatch solo (reason counted/annotated).
+            fused = global_batcher.submit(
+                [plans[i] for i in idxs], [resolved[i] for i in idxs],
+                bucket, (kind,) + sig)
+            if fused is not None:
+                for k, i in enumerate(idxs):
+                    results[i] = fused[k]
+                continue
         n_seg = len(idxs)
         if n_seg == 1 or (kind == "segc" and n_seg * plan_struct.group_space
                           > COMPACT_GROUP_LIMIT):

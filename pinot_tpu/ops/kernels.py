@@ -838,10 +838,25 @@ COMPACT_GROUP_LIMIT = 1 << 22
 
 
 def _value_col_indices(ve) -> set:
+    """EVERY stored-column index a value expression references —
+    including through Func args and Case branches (whose WHEN
+    predicates can reference columns too). Completeness matters: the
+    segmented kernel picks its synthetic segment-index column past the
+    max referenced index, and the ragged batcher's cube eligibility
+    turns every predicate column into a cube dimension — a missed
+    column would silently corrupt either."""
     if isinstance(ve, (Col, MvReduce)):
         return {ve.col}
     if isinstance(ve, Bin):
         return _value_col_indices(ve.lhs) | _value_col_indices(ve.rhs)
+    if isinstance(ve, Func):
+        return set().union(set(), *[_value_col_indices(a)
+                                    for a in ve.args])
+    if isinstance(ve, Case):
+        out = _value_col_indices(ve.else_)
+        for pred, val in ve.whens:
+            out |= _pred_col_indices(pred) | _value_col_indices(val)
+        return out
     return set()
 
 
@@ -1044,7 +1059,8 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
                         platform: str = None,
                         scatter: bool = False,
                         two_pass_mode: Optional[str] = None,
-                        ladder_min: Optional[int] = None) -> None:
+                        ladder_min: Optional[int] = None,
+                        xfer_sparse: bool = False) -> None:
     """Group aggregation over compacted matched rows — the fused
     compaction -> sort -> segment-sum ladder (round-6 tentpole rewrite).
 
@@ -1106,6 +1122,12 @@ def _compact_group_aggs(plan: KernelPlan, mask, cols, params, bucket: int,
         if scatter:
             _scatter_post(sum_jobs, mm_jobs, ord_modes, k, v, pls,
                           space, o)
+        elif needs_sort and xfer_sparse:
+            # q4.3 sparse-output contract: (group_idx, value) pairs
+            # straight from the one sorted pass — no dense (space,)
+            # arrays are ever materialized for big spaces
+            _sorted_post_sparse(sum_jobs, mm_jobs, ord_modes, k, v, pls,
+                                space, GROUP_XFER_CAP, o)
         elif needs_sort:
             _sorted_post(sum_jobs, mm_jobs, ord_modes, k, v, pls,
                          space, o)
@@ -1312,6 +1334,41 @@ def _needs_sort(plan: KernelPlan) -> bool:
             or any(s.kind in ("min", "max") for s in plan.aggs))
 
 
+def _sorted_post_common(sum_jobs, mm_jobs, keys, payloads, extra=()):
+    """The slot dedup + ONE lexicographic sort both sorted posts share
+    (dense and sparse must never diverge here — digest parity between
+    them is pinned by test). Returns (sorted_ops, sum_slots, mm_slots,
+    base): sum payload slots deduped in operand order, min/max
+    orderable slots likewise (the first rides the sort as the
+    secondary key), and ``base`` indexing the first ``extra`` operand
+    (or the first sum payload when no extras ride along)."""
+    sum_slots: List[int] = []        # unique payload slots, operand order
+    for _i, _s, slot in sum_jobs:
+        if slot not in sum_slots:
+            sum_slots.append(slot)
+    mm_slots: List[int] = []
+    for _i, _s, slot in mm_jobs:
+        if slot not in mm_slots:
+            mm_slots.append(slot)
+    first_o = [payloads[mm_slots[0]]] if mm_slots else []
+    operands = [keys] + first_o + list(extra) \
+        + [payloads[s] for s in sum_slots]
+    sorted_ops = jax.lax.sort(operands, num_keys=1 + len(first_o))
+    return sorted_ops, sum_slots, mm_slots, 1 + len(first_o)
+
+
+def _sorted_orderables(keys, payloads, mm_slots, sorted_ops
+                       ) -> Dict[int, jax.Array]:
+    """Per-slot key-sorted orderables: the first slot already rode the
+    main sort as the secondary key; each additional distinct min/max
+    expression needs one more (key, orderable) sort of the prefix."""
+    out: Dict[int, jax.Array] = {}
+    for j, slot in enumerate(mm_slots):
+        out[slot] = sorted_ops[1] if j == 0 else jax.lax.sort(
+            [keys, payloads[slot]], num_keys=2)[1]
+    return out
+
+
 def _sorted_post(sum_jobs, mm_jobs, ord_modes, keys, valid, payloads,
                  space: int, out: Dict[str, jax.Array]) -> None:
     """Sort-once, aggregate-many: ONE lexicographic sort of the compacted
@@ -1324,21 +1381,10 @@ def _sorted_post(sum_jobs, mm_jobs, ord_modes, keys, valid, payloads,
     acc_f = float_acc_dtype()
     cnt_dtype = int_acc_dtype()
 
-    sum_slots: List[int] = []        # unique payload slots, operand order
-    for _i, _s, slot in sum_jobs:
-        if slot not in sum_slots:
-            sum_slots.append(slot)
-    mm_slots: List[int] = []
-    for _i, _s, slot in mm_jobs:
-        if slot not in mm_slots:
-            mm_slots.append(slot)
-
-    first_o = [payloads[mm_slots[0]]] if mm_slots else []
-    operands = [keys] + first_o + [valid.astype(jnp.int32)] \
-        + [payloads[s] for s in sum_slots]
-    sorted_ops = jax.lax.sort(operands, num_keys=1 + len(first_o))
+    sorted_ops, sum_slots, mm_slots, base = _sorted_post_common(
+        sum_jobs, mm_jobs, keys, payloads,
+        extra=(valid.astype(jnp.int32),))
     sk = sorted_ops[0]
-    base = 1 + len(first_o)
     edges = jnp.searchsorted(sk, jnp.arange(space + 1, dtype=jnp.int32))
 
     def group_sums(sorted_vals, dtype):
@@ -1363,15 +1409,8 @@ def _sorted_post(sum_jobs, mm_jobs, ord_modes, keys, valid, payloads,
         else:
             out[name] = s
 
-    # sorted orderables: the first slot already rode the main sort
-    sorted_orderable: Dict[int, jax.Array] = {}
-    for j, slot in enumerate(mm_slots):
-        if j == 0:
-            sorted_orderable[slot] = sorted_ops[1]
-        else:
-            sorted_orderable[slot] = jax.lax.sort(
-                [keys, payloads[slot]], num_keys=2)[1]
-
+    sorted_orderable = _sorted_orderables(keys, payloads, mm_slots,
+                                          sorted_ops)
     n_rows = keys.shape[0]
     pos_min = jnp.minimum(edges[:-1], n_rows - 1)
     pos_max = jnp.clip(edges[1:] - 1, 0, n_rows - 1)
@@ -1384,6 +1423,85 @@ def _sorted_post(sum_jobs, mm_jobs, ord_modes, keys, valid, payloads,
         # an empty group's edges collapse and pick a neighboring run's
         # row; neutralize to the extreme so cross-device pmin/pmax and
         # partial merges stay correct (dense _group_minmax convention)
+        out[name] = jnp.where(
+            counts > 0, vals,
+            _extreme(acc, 1 if spec.kind == "min" else -1))
+
+
+def _sorted_post_sparse(sum_jobs, mm_jobs, ord_modes, keys, valid, payloads,
+                        space: int, cap: int,
+                        out: Dict[str, jax.Array]) -> None:
+    """Sparse sorted post (q4.3 contract): emit (group_idx, value) pairs
+    straight from the ONE lexicographic sort instead of densifying to
+    (space,) arrays and compacting them afterwards.
+
+    At SSB q4.3's 1.75M group space the dense outputs dominated the
+    kernel (space-sized searchsorted probes + several (space,) arrays
+    for ~13 live groups). Here run boundaries come from the sorted
+    keys themselves: first-occurrence flags -> unique ranks -> one
+    searchsorted of cap probes over the rank vector, so every output
+    is (cap,) and cost scales with the compacted rows, not the space.
+    Output contract matches _compact_group_xfer exactly (group_idx
+    holds dense space ids, sentinel rows carry count 0, group_overflow
+    flags >cap live groups for the dense retry), so extraction and the
+    batched dispatch are oblivious to which path produced it."""
+    acc_f = float_acc_dtype()
+    cnt_dtype = int_acc_dtype()
+
+    sorted_ops, sum_slots, mm_slots, base = _sorted_post_common(
+        sum_jobs, mm_jobs, keys, payloads)
+    sk = sorted_ops[0]
+    n_rows = sk.shape[0]
+
+    # every live-key row is valid by construction (garbage slots were
+    # re-sentineled to space before the sort), so run lengths ARE the
+    # group counts and the valid column never needs to ride the sort
+    live = sk < jnp.int32(space)
+    uniq = live & jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), sk[1:] != sk[:-1]])
+    ranks = chunked_cumsum(uniq.astype(jnp.int32)).astype(jnp.int32)
+    n_live = ranks[-1]
+    n_matched = jnp.searchsorted(sk, jnp.int32(space)).astype(jnp.int32)
+    rids = jnp.arange(1, cap + 1, dtype=jnp.int32)
+    starts = jnp.searchsorted(ranks, rids, side="left").astype(jnp.int32)
+    ends = jnp.minimum(
+        jnp.searchsorted(ranks, rids, side="right").astype(jnp.int32),
+        n_matched)
+    alive = rids <= n_live
+    out["group_idx"] = jnp.where(
+        alive, sk.at[jnp.minimum(starts, n_rows - 1)].get(mode="clip"),
+        jnp.int32(space))
+    counts = jnp.where(alive, (ends - starts).astype(cnt_dtype), 0)
+    out["group_count"] = counts
+    out["group_overflow"] = (n_live > cap).astype(jnp.int32)
+
+    sums_done: Dict[Tuple[int, bool], jax.Array] = {}
+    for i, spec, slot in sum_jobs:
+        name = _agg_name(i, spec)
+        s = sums_done.get((slot, spec.integral))
+        if s is None:
+            dtype = int_acc_dtype() if spec.integral else acc_f
+            sv = sorted_ops[base + sum_slots.index(slot)]
+            cs = jnp.concatenate(
+                [jnp.zeros(1, dtype), chunked_cumsum(sv.astype(dtype))])
+            s = cs[ends] - cs[starts]
+            sums_done[(slot, spec.integral)] = s
+        if spec.kind == "avg":
+            out[name + "_sum"] = s
+            out[name + "_cnt"] = counts
+        else:
+            out[name] = s
+
+    sorted_orderable = _sorted_orderables(keys, payloads, mm_slots,
+                                          sorted_ops)
+    pos_min = jnp.minimum(starts, n_rows - 1)
+    pos_max = jnp.clip(ends - 1, 0, n_rows - 1)
+    for i, spec, slot in mm_jobs:
+        name = _agg_name(i, spec)
+        pos = pos_min if spec.kind == "min" else pos_max
+        picked = sorted_orderable[slot].at[pos].get(mode="clip")
+        acc = _acc_dtype(spec)
+        vals = _from_orderable64(picked, ord_modes[slot], acc_f).astype(acc)
         out[name] = jnp.where(
             counts > 0, vals,
             _extreme(acc, 1 if spec.kind == "min" else -1))
@@ -1437,13 +1555,18 @@ def build_kernel(plan: KernelPlan, bucket: int,
             cap = slots_cap or (sorted_default_slots_cap(total)
                                 if _needs_sort(plan) or scatter
                                 else default_slots_cap(total))
+            # sparse sorted post (q4.3): the sorted core emits
+            # (group_idx, value) pairs directly at big spaces, so the
+            # densify-then-compact _compact_group_xfer never runs there
+            sparse = (xfer_compact and not scatter and _needs_sort(plan)
+                      and plan.group_space >= GROUP_XFER_SPACE)
             _compact_group_aggs(plan, mask, cols, params, total, cap, out,
                                 platform, scatter, two_pass_mode,
-                                ladder_min)
+                                ladder_min, xfer_sparse=sparse)
             # scatter implies CPU execution, where the "transfer" the
             # device-side live-group compaction optimizes is free — the
             # nonzero over a big space only adds kernel time there
-            if xfer_compact and not scatter:
+            if xfer_compact and not scatter and not sparse:
                 _compact_group_xfer(plan, out)
             return out
         out["matched"] = jnp.sum(mask, dtype=int_acc_dtype())
@@ -1650,11 +1773,13 @@ def build_segmented_compact_kernel(plan: KernelPlan, bucket: int,
                             if _needs_sort(plan2)
                             else default_slots_cap(total))
         out: Dict[str, jax.Array] = {}
+        sparse = (xfer_compact and not scatter and _needs_sort(plan2)
+                  and plan2.group_space >= GROUP_XFER_SPACE)
         _compact_group_aggs(plan2, masks.reshape(total), tuple(flat_cols),
                             vparams, total, cap, out, platform, scatter,
-                            two_pass_mode, ladder_min)
+                            two_pass_mode, ladder_min, xfer_sparse=sparse)
         out["matched"] = masks.sum(axis=1, dtype=int_acc_dtype())  # (S,)
-        if xfer_compact and not scatter:
+        if xfer_compact and not scatter and not sparse:
             # live-group gather over the combined S*space — the executor
             # splits segments host-side via group_idx // space
             _compact_group_xfer(plan2, out)

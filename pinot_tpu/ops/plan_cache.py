@@ -33,7 +33,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 from contextlib import contextmanager
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -392,4 +392,123 @@ class KernelPlanCache:
         self.detector.clear()
 
 
+class CubeCache:
+    """(cube spec, segment uid) -> device-resident literal-free cube
+    (engine/ragged.py) — the piece that turns the plan cache from a
+    compile-amortizer into a throughput engine (PR 8): queries sharing
+    a plan STRUCTURE differ only in hoisted literal params, so one
+    unmasked group-by over the union of predicate + group dimensions
+    answers every one of them by contraction. The cube is keyed by the
+    segment's process-unique load uid (the round-9 _STACK_CACHE rule:
+    names recur across tables and reloads; uids never do) so a reload
+    can never serve stale cells, and the name rides along only for
+    evict_cubes_containing."""
+
+    def __init__(self, maxsize: int = 16):
+        self._entries: "OrderedDict[Tuple, Dict[str, Any]]" = OrderedDict()
+        # (spec, uid tuple) -> {name: [S, ...]} stacked device arrays:
+        # the warm fused path would otherwise re-copy every per-segment
+        # cube through jnp.stack on every dispatch
+        self._stacked: "OrderedDict[Tuple, Dict[str, Any]]" = OrderedDict()
+        # key -> Event while a build is in flight: concurrent fused
+        # leaders missing the same key must not each run the full
+        # unmasked segment scan (cold-path dedup)
+        self._building: Dict[Tuple, threading.Event] = {}
+        self._maxsize = maxsize
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def entry(self, spec, segment, build_fn) -> Dict[str, Any]:
+        key = (spec, segment.uid, segment.name)
+        while True:
+            with self._lock:
+                hit = self._entries.get(key)
+                if hit is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    waiting = None
+                else:
+                    waiting = self._building.get(key)
+                    if waiting is None:
+                        self._building[key] = threading.Event()
+                        self.misses += 1
+            if hit is not None:
+                global_metrics.count("cube_cache_hits")
+                return hit
+            if waiting is None:
+                break               # this thread builds
+            # another leader is scanning this segment right now: wait
+            # for its result instead of duplicating the scan (on its
+            # failure the loop re-enters and this thread builds)
+            waiting.wait(timeout=600)
+        global_metrics.count("cube_cache_misses")
+        try:
+            built = build_fn()
+        except BaseException:
+            # failed build: release waiters (they re-enter and build)
+            with self._lock:
+                ev = self._building.pop(key, None)
+            if ev is not None:
+                ev.set()
+            raise
+        with self._lock:
+            # publish BEFORE signaling: a waiter woken by the event
+            # must find the entry, or it would re-run the very scan
+            # the event deduplicates
+            built = self._entries.setdefault(key, built)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._maxsize:
+                self._entries.popitem(last=False)
+            global_metrics.gauge("cube_cache_entries", len(self._entries))
+            ev = self._building.pop(key, None)
+        if ev is not None:
+            ev.set()
+        return built
+
+    def stacked(self, spec, segments, per_segment: List[Dict[str, Any]]
+                ) -> Dict[str, Any]:
+        """{name: [S, ...]} stack of the given segments' cubes, cached
+        by (spec, uid tuple) so a warm fused dispatch pays zero device
+        copies. ``per_segment`` must be the entry() results for the
+        same segments, in order."""
+        key = (spec, tuple(s.uid for s in segments),
+               tuple(s.name for s in segments))
+        with self._lock:
+            hit = self._stacked.get(key)
+            if hit is not None:
+                self._stacked.move_to_end(key)
+                return hit
+        stacked = {name: jnp.stack([c[name] for c in per_segment])
+                   for name in per_segment[0]}
+        with self._lock:
+            stacked = self._stacked.setdefault(key, stacked)
+            self._stacked.move_to_end(key)
+            while len(self._stacked) > self._maxsize:
+                self._stacked.popitem(last=False)
+            return stacked
+
+    def evict_containing(self, segment_name: str) -> None:
+        with self._lock:
+            for key in [k for k in self._entries if k[2] == segment_name]:
+                del self._entries[key]
+            for key in [k for k in self._stacked
+                        if segment_name in k[2]]:
+                del self._stacked[key]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "entries": len(self._entries),
+                    "stacked": len(self._stacked)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._stacked.clear()
+            self.hits = 0
+            self.misses = 0
+
+
 global_plan_cache = KernelPlanCache()
+global_cube_cache = CubeCache()

@@ -307,6 +307,8 @@ class ImmutableSegment:
         self._device.clear()
         from ..engine.batch import evict_stacks_containing
         evict_stacks_containing(self.name)
+        from ..ops.plan_cache import global_cube_cache
+        global_cube_cache.evict_containing(self.name)
 
     def __repr__(self) -> str:
         return (f"ImmutableSegment({self.name!r}, docs={self.n_docs}, "

@@ -44,11 +44,18 @@ SCHEMA_VERSION = 2
 # silently fork the schema) and missing required ones.
 KINDS: Dict[str, Dict[str, set]] = {
     "bench_capture": {
+        # concurrency/qps*/p50_ms/p99_ms/fused_ratio/solo_latency_ratio:
+        # the concurrent-QPS mode (bench.py --concurrency N, PR 8) —
+        # queries/sec through the broker with cross-query micro-batching
+        # fused vs the serial per-query dispatch path, so throughput
+        # trends in this ledger the way latency always has
         "required": {"metric", "backend", "ok", "value"},
         "optional": {"unit", "vs_baseline", "n_rows", "queries", "qid",
                      "tpu_outage", "last_tpu_capture", "error", "errors",
                      "partial", "delta_vs_last", "n_vectors", "dim",
-                     "extra"},
+                     "extra", "concurrency", "qps", "qps_serial",
+                     "qps_ratio", "p50_ms", "p99_ms", "fused_ratio",
+                     "solo_latency_ratio"},
     },
     "phase_profile": {
         "required": {"metric", "backend", "qid", "strategy"},
@@ -82,9 +89,13 @@ KINDS: Dict[str, Dict[str, set]] = {
         "required": {"qid", "table", "wall_ms", "partial",
                      "servers_queried", "servers_responded",
                      "exception_codes"},
+        # ``batched``/``batch_size``: cross-query micro-batching (PR 8)
+        # — fused ragged dispatches this query's server executions rode
+        # and the largest batch any of them shared
         "optional": {"sql", "rows", "segments_queried",
                      "segments_pruned", "hedges", "failovers", "slow",
-                     "error", "backend", "traced", "serde_ms", "net_ms"},
+                     "error", "backend", "traced", "serde_ms", "net_ms",
+                     "batched", "batch_size"},
     },
     "ingest_stats": {
         # the freshness ledger (realtime/manager.write_ingest_stats):

@@ -46,6 +46,15 @@ STAGE = "stage"                    # remote /stage worker-rooted tree
 STAGE_CALL = "stage_call"          # driver-side per-submission attempt
 STAGE_DISPATCH = "stage_dispatch"  # driver-side fan-out parent
 
+# cross-query micro-batching (PR 8): every query that passes through the
+# ragged admission queue wraps its wait + fused dispatch in ONE
+# ragged_dispatch span on its own thread (queue_wait_ms annotated), so
+# per-query wall attribution survives the fusion; the leader's span
+# additionally parents the cube_build/fused_execute children.
+RAGGED_DISPATCH = "ragged_dispatch"
+CUBE_BUILD = "cube_build"
+FUSED_EXECUTE = "fused_execute"
+
 # names Tracing.phase may emit into the flat trace envelope
 TRACED_PHASES = frozenset(
     {PLANNING, EXECUTION, REDUCE, DISTRIBUTED_EXECUTE})
@@ -55,4 +64,5 @@ TRACED_PHASES = frozenset(
 SPAN_NAMES = TRACED_PHASES | frozenset(
     {QUERY, BROKER_OVERHEAD, SCATTER, SCATTER_CALL, SERVER_QUERY,
      LEAF_SCAN, JOIN_STAGE, EXCHANGE, WINDOW_STAGE, FINAL_STAGE,
-     STAGE, STAGE_CALL, STAGE_DISPATCH})
+     STAGE, STAGE_CALL, STAGE_DISPATCH,
+     RAGGED_DISPATCH, CUBE_BUILD, FUSED_EXECUTE})
