@@ -28,8 +28,9 @@ from ..query.sql import SetOpStmt, SqlError, parse_sql, to_sql
 from ..utils import phases as ph
 from ..utils.metrics import global_metrics, ingest_health
 from ..utils.spans import Span, sample_decision, span, span_tracer
-from .forensics import (QueryForensics, parse_slow_query_ms,
-                        parse_trace_ratio)
+from .forensics import (QueryForensics, ledger_debug_payload,
+                        memory_debug_payload, parse_since,
+                        parse_slow_query_ms, parse_trace_ratio)
 from .http_util import (JsonHandler, http_json, http_raw,
                         inject_trace_context, start_http)
 
@@ -173,11 +174,20 @@ class BrokerNode:
                  instance_selector: str = "balanced",
                  slow_query_ms: Optional[float] = None,
                  query_stats_path: Optional[str] = None,
-                 trace_ratio: Optional[float] = None):
+                 trace_ratio: Optional[float] = None,
+                 instance_id: Optional[str] = None):
+        import os
         from ..broker.quota import QueryQuotaManager
         from ..broker.routing import make_selector
         self.controller_url = controller_url
         self.routing_refresh = routing_refresh
+        # fleet identity (round 14): brokers register with the controller
+        # like servers do (role "broker"), so the ForensicsRollupTask can
+        # discover and pull their ledgers; live_servers() filters on role,
+        # so broker registration never perturbs segment assignment
+        self._instance_id = instance_id   # default derived after bind
+        self.advertise_host = (os.environ.get("PINOT_ADVERTISE_HOST")
+                               or "127.0.0.1")
         # forensics plane: slow-query ring (GET /debug/queries) + the
         # optional per-query query_stats ledger (chaos soak trend lines)
         # + the traceRatio production-sampling default (round 12)
@@ -197,13 +207,43 @@ class BrokerNode:
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(max_workers=16)
         self._httpd, self.port, _ = start_http(self._make_handler(), port)
+        # the default identity is STABLE across restarts (host + bound
+        # port, like operator-named servers), not a fresh random token:
+        # the controller's rollup cursors key on this id, and a restart
+        # under a new id would re-ship the broker's whole ledger into
+        # the fleet ledger as duplicates
+        self.instance_id = (self._instance_id
+                            or f"broker_{self.advertise_host}_{self.port}")
+        try:
+            # best-effort: the controller may be an HA standby (503) or
+            # briefly down — the loop below retries via the 404 path
+            self._register()
+        except Exception:
+            pass
         self._refresh_routing()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    def _register(self) -> None:
+        http_json("POST", f"{self.controller_url}/instances", {
+            "id": self.instance_id, "host": self.advertise_host,
+            "port": self.port, "role": "broker"})
+
     # -- routing -----------------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.wait(self.routing_refresh):
+            try:
+                try:
+                    http_json("POST", f"{self.controller_url}/heartbeat/"
+                                      f"{self.instance_id}")
+                except urllib.error.HTTPError as e:
+                    if e.code != 404:
+                        raise
+                    # restarted controller with empty ephemeral state:
+                    # re-announce (same rule as ServerNode._loop)
+                    self._register()
+            except Exception:
+                pass
             try:
                 self._refresh_routing()
             except Exception:
@@ -1094,6 +1134,16 @@ class BrokerNode:
                 ("GET", "/metrics"): lambda h, b: (
                     200, node.scatter_health()),
                 ("GET", "/debug/queries"): debug_queries,
+                # ledger shipping (round 14): the controller's
+                # ForensicsRollupTask pulls validated stats/trace deltas
+                # + node telemetry blocks from here
+                ("GET", "/debug/ledger"): lambda h, b: (
+                    200, ledger_debug_payload(
+                        node.instance_id, "broker",
+                        node.forensics.ledger_path,
+                        parse_since(h.path))),
+                ("GET", "/debug/memory"): lambda h, b: (
+                    200, memory_debug_payload(node.instance_id)),
                 ("GET", "/ui"): lambda h, b: (
                     200, ("text/html", node.ui_page())),
                 ("POST", "/query/sql"): q,

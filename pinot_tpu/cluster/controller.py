@@ -79,6 +79,18 @@ class Controller:
         self.scheduler.register(BasePeriodicTask(
             "SegmentStatusChecker", interval_s=30.0,
             fn=self._leader_gated(self.run_status_check)))
+        # fleet forensics rollup (round 14): pull per-node ledgers,
+        # aggregate cluster-wide, serve at GET /debug/fleet + the
+        # webapp Fleet view. Leader-gated like every periodic task and
+        # REST-triggerable (POST /periodictask/run/ForensicsRollup);
+        # the initial delay keeps short-lived test controllers from
+        # auto-pulling mid-setup
+        from .rollup import ForensicsRollupTask
+        self.rollup = ForensicsRollupTask(self)
+        self.scheduler.register(BasePeriodicTask(
+            ForensicsRollupTask.NAME, interval_s=30.0,
+            initial_delay_s=30.0,
+            fn=self._leader_gated(self.rollup.run)))
         # realtime commit arbitration (SegmentCompletionManager FSM); the
         # registry fallback keeps restarts/purges from re-electing a
         # committer for an already-registered segment
@@ -799,7 +811,10 @@ class Controller:
                 "lease_holder": lease.get("holder"),
                 # realtime-plane health next to the cluster view (shared
                 # global_metrics for in-process roles)
-                "ingest": ingest_health(global_metrics.snapshot())}
+                "ingest": ingest_health(global_metrics.snapshot()),
+                # fleet forensics rollup (webapp Fleet view): the latest
+                # ForensicsRollup pass, None until one has run
+                "fleet": self.rollup.snapshot()}
 
     def ui_page(self) -> str:
         """The controller web application (GET /ui): the reference's
@@ -936,6 +951,9 @@ class Controller:
                     else (404, {"error": "unknown task"})),
                 ("GET", "/periodictask/status"): lambda h, b: (
                     200, {"tasks": ctrl.scheduler.status()}),
+                # fleet forensics rollup plane (round 14)
+                ("GET", "/debug/fleet"): lambda h, b: (
+                    200, ctrl.rollup.snapshot()),
                 ("POST", "/segmentConsumed"): lambda h, b: (
                     200, ctrl.completion.segment_consumed(
                         b["table"], b["segment"], b["server"],

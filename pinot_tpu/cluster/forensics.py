@@ -21,11 +21,13 @@ inside kernels.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..query.sql import SqlError
 from ..utils import ledger as uledger
@@ -34,6 +36,12 @@ from ..utils.metrics import global_metrics
 DEFAULT_SLOW_QUERY_MS = 500.0
 DEFAULT_TRACE_RATIO = 0.0
 RING_CAPACITY = 128
+
+# process identity for fleet rollups: in-process clusters run several
+# node roles in ONE interpreter sharing global_metrics / heat / devmem —
+# the controller's rollup dedupes those per-node blocks by this token so
+# fleet totals never multiply-count a shared registry
+PROC_TOKEN = f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
 
 
 def parse_slow_query_ms(options: Dict[str, Any],
@@ -227,3 +235,85 @@ class QueryForensics:
                 "tracesWritten": self.traces_written,
                 "count": len(entries),
                 "queries": entries}
+
+
+# ---------------------------------------------------------------------------
+# ledger shipping (round 14): incremental per-node /debug endpoints the
+# controller's ForensicsRollupTask pulls (cluster/rollup.py)
+# ---------------------------------------------------------------------------
+
+def parse_since(path: str) -> int:
+    """``?since=N`` off a /debug/ledger request path (0 when absent or
+    malformed — the puller then re-reads from the start, which is safe:
+    the controller advances its cursor from the response's nextSeq)."""
+    from urllib.parse import parse_qs, urlparse
+    try:
+        return max(int(parse_qs(urlparse(path).query)["since"][0]), 0)
+    except (KeyError, ValueError, IndexError):
+        return 0
+
+
+def read_ledger_since(path: Optional[str], since: int
+                      ) -> Tuple[List[Dict[str, Any]], int]:
+    """-> (records after line ``since``, nextSeq = total line count).
+
+    The sequence is the ledger's LINE number (ledgers are append-only
+    JSONL, so line order is stable); unparseable lines advance the
+    sequence but ship nothing — the controller re-validates every
+    record against the utils/ledger contracts anyway. A final line
+    WITHOUT a newline terminator is an append still in flight: it must
+    not advance the sequence, or the puller's cursor would step past
+    the record and permanently drop it once the write completes."""
+    records: List[Dict[str, Any]] = []
+    seq = 0
+    if path and os.path.exists(path):
+        with open(path) as fh:
+            for i, line in enumerate(fh):
+                if not line.endswith("\n"):
+                    break   # torn tail: ship it complete, next pull
+                seq = i + 1
+                if i < since:
+                    continue
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                records.append(rec)
+    return records, seq
+
+
+def ledger_debug_payload(node_id: str, role: str, path: Optional[str],
+                         since: int, heat_top: int = 64
+                         ) -> Dict[str, Any]:
+    """GET /debug/ledger payload (brokers AND servers): the incremental
+    ledger delta plus the node-local telemetry blocks the rollup carries
+    per node — metrics counters/gauges (drift, retraces, batching),
+    device-memory pools and the segment-heat table — so one pull per
+    node gathers everything the fleet view needs."""
+    from ..engine.ragged import batching_health
+    from ..utils.devmem import global_device_memory
+    from ..utils.heat import global_segment_heat
+    records, next_seq = read_ledger_since(path, since)
+    snap = global_metrics.snapshot()
+    return {"node": node_id, "role": role, "proc": PROC_TOKEN,
+            "ledger": path, "since": since, "nextSeq": next_seq,
+            "records": records,
+            "counters": snap["counters"], "gauges": snap["gauges"],
+            "batching": batching_health(snap),
+            "memory": global_device_memory.snapshot(),
+            "heat": global_segment_heat.snapshot(top=heat_top)}
+
+
+def memory_debug_payload(node_id: str) -> Dict[str, Any]:
+    """GET /debug/memory payload: what lives in HBM on this node right
+    now — per-pool live bytes / entries / evictions (utils/devmem) and
+    the hottest segments (utils/heat). The admission/eviction signal
+    the future HBM-tiered segment cache consumes (ROADMAP direction 3)."""
+    from ..utils.devmem import global_device_memory
+    from ..utils.heat import global_segment_heat
+    return {"node": node_id, "proc": PROC_TOKEN,
+            "pools": global_device_memory.snapshot(),
+            "heat": global_segment_heat.snapshot(top=50)}

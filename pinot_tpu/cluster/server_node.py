@@ -35,10 +35,16 @@ class ServerNode:
                  poll_interval: float = 0.3,
                  scheduler_config: Optional[Dict[str, Any]] = None,
                  tags: Optional[List[str]] = None,
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None,
+                 ledger_path: Optional[str] = None):
         self.instance_id = instance_id
         self.controller_url = controller_url
         self.poll_interval = poll_interval
+        # optional node-local perf ledger (ingest_stats writers etc.)
+        # served incrementally at GET /debug/ledger for the controller's
+        # fleet rollup; None still serves the telemetry blocks
+        # (heat / device memory / counters) with zero records
+        self.ledger_path = ledger_path
         # the host OTHER nodes dial (containers/k8s must advertise their
         # service-reachable name, not loopback); env override for
         # image-based deployments (deploy/)
@@ -354,11 +360,24 @@ class ServerNode:
         return execute_stage(self, spec, trace_ctx=trace_ctx)
 
     def _make_handler(self):
+        from .forensics import (ledger_debug_payload, memory_debug_payload,
+                                parse_since)
         node = self
 
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
+                # ledger shipping + device-memory telemetry (round 14):
+                # the controller's ForensicsRollupTask pulls the ledger
+                # delta + heat/devmem/counters blocks; /debug/memory is
+                # the HBM residency view the future tiered segment
+                # cache will admit/evict on
+                ("GET", "/debug/ledger"): lambda h, b: (
+                    200, ledger_debug_payload(
+                        node.instance_id, "server", node.ledger_path,
+                        parse_since(h.path))),
+                ("GET", "/debug/memory"): lambda h, b: (
+                    200, memory_debug_payload(node.instance_id)),
                 ("POST", "/query/bin"): lambda h, b: (
                     200, node.execute_bin(b["sql"], b.get("segments"),
                                           b.get("deadlineMs"),

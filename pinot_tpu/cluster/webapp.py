@@ -10,9 +10,12 @@ and drives the admin REST (rebalance, periodic tasks, segment delete)
 and any broker's /query/sql console.
 
 Views (hash-routed): #/cluster (instances + leadership), #/tables
-(list -> per-table detail: segments, assignment, rebalance), #/tasks
-(periodic task status + run), #/query (SQL console with EXPLAIN toggle
-against a configurable broker URL, persisted in localStorage).
+(list -> per-table detail: segments, assignment, rebalance), #/fleet
+(the ForensicsRollup panels: per-table fleet stats, slowest queries,
+drift/requantize + batching health per node, top-N hot segments with
+device-memory bytes), #/tasks (periodic task status + run), #/query
+(SQL console with EXPLAIN toggle against a configurable broker URL,
+persisted in localStorage).
 """
 from __future__ import annotations
 
@@ -70,7 +73,7 @@ const esc = (s) => String(s).replace(/[&<>"'\\\\]/g,
   c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;",
          "'":"&#39;","\\\\":"&#92;"}[c]));
 const VIEWS = [["#/cluster","Cluster"],["#/tables","Tables"],
-  ["#/tasks","Tasks"],["#/query","Query console"]];
+  ["#/fleet","Fleet"],["#/tasks","Tasks"],["#/query","Query console"]];
 
 function nav() {
   const cur = location.hash || "#/cluster";
@@ -140,6 +143,55 @@ function vTable(t) {
     <span class="mut" id="actmsg">${esc(actMsg[t] || "")}</span></p>
     <h3>Segments</h3>` +
     table(["segment", "servers", ""], segs);
+}
+
+function vFleet() {
+  // the ForensicsRollup panels (GET /debug/fleet via D.fleet)
+  const f = D.fleet || {};
+  const r = f.rollup;
+  if (!r) return `<h2>Fleet forensics</h2>
+    <p class="mut">no rollup yet — run the ForensicsRollup task
+    (Tasks view) once brokers/servers have ledgers to pull.</p>`;
+  const pull = `<p class="mut">pulls ${f.pulls || 0} ·
+    nodes ${r.nodes_polled - r.nodes_skipped}/${r.nodes_polled} ok
+    (${(r.skipped_nodes || []).map(esc).join(", ") || "none skipped"}) ·
+    ${r.fleet_records || 0} fleet records · ledger ${esc(f.ledger
+    || "")}</p>`;
+  const tbl = table(["table", "queries", "qps", "p50 ms", "p99 ms",
+      "partial", "failovers", "hedges", "batched", "slow",
+      "freshness ms"],
+    Object.entries(r.tables || {}).map(([t, s]) =>
+      [esc(t), s.queries || 0, s.qps || 0, s.p50_ms || 0,
+       s.p99_ms || 0, s.partial || 0, s.failovers || 0, s.hedges || 0,
+       s.batched_queries || 0, s.slow || 0,
+       s.freshness_ms != null ? s.freshness_ms : "-"]));
+  const slow = table(["qid", "node", "table", "wall ms", "partial",
+      "sql"],
+    (r.slow_queries || []).map(q => [esc(q.qid || ""),
+      esc(q.node || ""), esc(q.table || ""), q.wall_ms,
+      q.partial ? "YES" : "no", esc(q.sql || "")]));
+  const heat = table(["table", "segment", "touches", "rows scanned",
+      "device hit ratio"],
+    (r.heat || []).map(h => [esc(h.table), esc(h.segment), h.touches,
+      h.rows_scanned,
+      h.device_hit_ratio != null ? h.device_hit_ratio : "-"]));
+  const nodes = table(["node", "role", "drift det/req/rec",
+      "retraces", "batched", "cube hit/miss", "device bytes"],
+    Object.entries(r.nodes || {}).map(([n, b]) => {
+      const c = b.counters || {};
+      const mem = ((b.memory || {}).total || {}).bytes || 0;
+      return [esc(n), esc(b.role || ""),
+        `${c.selectivity_drift_detected || 0}/` +
+          `${c.selectivity_drift_requantized || 0}/` +
+          `${c.selectivity_drift_recompiles || 0}`,
+        c.plan_cache_retraces || 0, c.batched_dispatches || 0,
+        `${c.cube_cache_hits || 0}/${c.cube_cache_misses || 0}`, mem];
+    }));
+  return `<h2>Fleet forensics</h2>${pull}
+    <h3>Per-table fleet stats</h3>${tbl}
+    <h3>Slowest queries</h3>${slow}
+    <h3>Hot segments</h3>${heat}
+    <h3>Drift / batching / device memory per node</h3>${nodes}`;
 }
 
 function vTasks() {
@@ -266,6 +318,7 @@ function render() {
   const mt = h.match(/^#\\/tables\\/(.+)$/);
   if (mt) main.innerHTML = vTable(decodeURIComponent(mt[1]));
   else if (h.startsWith("#/tables")) main.innerHTML = vTables();
+  else if (h.startsWith("#/fleet")) main.innerHTML = vFleet();
   else if (h.startsWith("#/tasks")) main.innerHTML = vTasks();
   else if (h.startsWith("#/query")) main.innerHTML = vQuery();
   else main.innerHTML = vCluster();

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..ops.kernels import build_kernel
 from ..query.planner import CompiledPlan
+from ..utils.devmem import global_device_memory
 from ..utils.spans import annotate, device_fence, span
 from .executor import execute_plan, extract_partial, resolve_params
 
@@ -78,8 +79,13 @@ def _stacked_cols(plans: List[CompiledPlan], bucket: int
     # make two LIVE tables with generic segment names evict each other's
     # stacks on every alternation
     _STACK_CACHE[key] = cols
+    # device-memory telemetry: the stack cache is an HBM resident the
+    # future tiered store must see (utils/devmem, GET /debug/memory)
+    global_device_memory.add("stack_cache", key,
+                             sum(int(c.nbytes) for c in cols))
     if len(_STACK_CACHE) > _STACK_CACHE_MAX:
-        _STACK_CACHE.popitem(last=False)
+        old_key, _old = _STACK_CACHE.popitem(last=False)
+        global_device_memory.remove("stack_cache", old_key)
     return cols
 
 
@@ -89,6 +95,7 @@ def evict_stacks_containing(segment_name: str) -> None:
     for key in [k for k in _STACK_CACHE
                 if any(n == segment_name for _, n in k[0])]:
         del _STACK_CACHE[key]
+        global_device_memory.remove("stack_cache", key)
 
 
 def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:

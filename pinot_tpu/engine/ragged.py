@@ -768,5 +768,11 @@ def batching_health(snapshot: Dict[str, Any]) -> Dict[str, Any]:
         "fused_batch_size_gt_32", 0)
     out["batch_queue_depth"] = snapshot["gauges"].get(
         "batch_queue_depth", 0)
+    # live device bytes the fusion plane holds resident (utils/devmem
+    # gauges mirrored by the cube cache) — rendered on /ui next to the
+    # hit counters so cache pressure is visible where batching is tuned
+    g = snapshot["gauges"]
+    out["cube_cache_bytes"] = int(g.get("device_bytes_cube_cache", 0)
+                                  + g.get("device_bytes_cube_stacked", 0))
     out["enabled"] = global_batcher.enabled
     return out

@@ -62,6 +62,14 @@ def plan_segments(ctx: QueryContext, segments: List[Any],
         ex = TableExecution(plans, [p for p in plans if p is not None],
                             rollup_segments=len(precomputed))
         ex._precomputed = precomputed  # type: ignore[attr-defined]
+        # segment-heat telemetry (utils/heat): one touch per executed
+        # segment — the access signal the fleet rollup ranks hot
+        # segments by and the future HBM tier admits on
+        from ..utils.heat import global_segment_heat
+        for p in ex.real_plans:
+            if p.kind in ("kernel", "host"):
+                global_segment_heat.touch(p.segment, ctx.table,
+                                          p.segment.n_docs)
         if ex.real_plans:
             p0 = ex.real_plans[0]
             annotate(kinds=sorted({p.kind for p in ex.real_plans}),

@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..utils.devmem import global_device_memory, nbytes_of
 from ..utils.metrics import global_metrics
 from ..utils.spans import device_fence, span, span_tracer
 
@@ -148,6 +149,10 @@ class PlanCacheEntry:
         self._acc: Any = None
         self.lock = threading.Lock()
         self.runs = 0
+        # set by the cache's LRU eviction: an entry evicted BEFORE its
+        # first run completes must not leave phantom accumulator bytes
+        # in the device-memory registry (run() re-checks after adding)
+        self.devmem_evicted = False
         # measured selectivity feedback: what the kernel actually matched.
         # Mutated through record_measured/mark_overflowed ONLY — the
         # entry lock guards them, and analysis/jaxlint's
@@ -202,6 +207,19 @@ class PlanCacheEntry:
                 # inside the lock, before the buffers are re-donated)
                 host = jax.device_get(out)  # jaxlint: ok host-sync
             self._acc = out      # next call donates these buffers
+            if first:
+                # device-memory telemetry: the donated accumulator is a
+                # live HBM resident; shapes are fixed per entry so one
+                # report per entry suffices (re-registered on eviction
+                # rebuilds because the entry object is new). Re-check
+                # the eviction flag AFTER adding: an entry LRU-evicted
+                # between build and first run would otherwise register
+                # bytes nothing ever removes.
+                global_device_memory.add("plan_cache_acc", id(self),
+                                         nbytes_of(out))
+                if self.devmem_evicted:
+                    global_device_memory.remove("plan_cache_acc",
+                                                id(self), evicted=False)
             return host
 
     def record_measured(self, matched: int, rows: int) -> None:
@@ -304,7 +322,9 @@ class KernelPlanCache:
             ent = self._entries.setdefault(key, ent)
             self._entries.move_to_end(key)
             while len(self._entries) > self._maxsize:
-                self._entries.popitem(last=False)
+                _, old = self._entries.popitem(last=False)
+                old.devmem_evicted = True  # before remove: run() rechecks
+                global_device_memory.remove("plan_cache_acc", id(old))
             global_metrics.gauge("plan_cache_entries", len(self._entries))
         return ent
 
@@ -389,6 +409,7 @@ class KernelPlanCache:
             self._requantized.clear()
             self.hits = 0
             self.misses = 0
+        global_device_memory.drop_pool("plan_cache_acc")
         self.detector.clear()
 
 
@@ -457,9 +478,11 @@ class CubeCache:
             # must find the entry, or it would re-run the very scan
             # the event deduplicates
             built = self._entries.setdefault(key, built)
+            global_device_memory.add("cube_cache", key, nbytes_of(built))
             self._entries.move_to_end(key)
             while len(self._entries) > self._maxsize:
-                self._entries.popitem(last=False)
+                old_key, _old = self._entries.popitem(last=False)
+                global_device_memory.remove("cube_cache", old_key)
             global_metrics.gauge("cube_cache_entries", len(self._entries))
             ev = self._building.pop(key, None)
         if ev is not None:
@@ -483,18 +506,23 @@ class CubeCache:
                    for name in per_segment[0]}
         with self._lock:
             stacked = self._stacked.setdefault(key, stacked)
+            global_device_memory.add("cube_stacked", key,
+                                     nbytes_of(stacked))
             self._stacked.move_to_end(key)
             while len(self._stacked) > self._maxsize:
-                self._stacked.popitem(last=False)
+                old_key, _old = self._stacked.popitem(last=False)
+                global_device_memory.remove("cube_stacked", old_key)
             return stacked
 
     def evict_containing(self, segment_name: str) -> None:
         with self._lock:
             for key in [k for k in self._entries if k[2] == segment_name]:
                 del self._entries[key]
+                global_device_memory.remove("cube_cache", key)
             for key in [k for k in self._stacked
                         if segment_name in k[2]]:
                 del self._stacked[key]
+                global_device_memory.remove("cube_stacked", key)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -508,6 +536,8 @@ class CubeCache:
             self._stacked.clear()
             self.hits = 0
             self.misses = 0
+        global_device_memory.drop_pool("cube_cache")
+        global_device_memory.drop_pool("cube_stacked")
 
 
 global_plan_cache = KernelPlanCache()

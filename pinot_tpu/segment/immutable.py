@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..spi.schema import DataType, Schema
+from ..utils.devmem import global_device_memory
+from ..utils.heat import global_segment_heat
 from . import segdir
 from .builder import METADATA_FILE
 from .dictionary import Dictionary
@@ -210,6 +212,14 @@ class ImmutableSegment:
         query runs on a CPU mesh under a TPU default."""
         return jax.device_put(host, sharding)
 
+    def _cache_device(self, key, arr: jax.Array) -> jax.Array:
+        """Every _device insert routes through here so the device-memory
+        registry's live-byte gauges always reconcile with the cache."""
+        self._device[key] = arr
+        global_device_memory.add("segment_cols", (self.uid, key),
+                                 int(arr.nbytes))
+        return arr
+
     def device_col(self, col: str, bucket: Optional[int] = None,
                    sharding=None) -> jax.Array:
         """Padded device array for a column's stored representation.
@@ -220,10 +230,15 @@ class ImmutableSegment:
         """
         bucket = bucket or self.bucket
         key = (col, bucket, sharding)
-        if key not in self._device:
-            self._device[key] = self._put(
-                self.host_col_padded(col, bucket), sharding)
-        return self._device[key]
+        hit = self._device.get(key)
+        # observed device-cache hit ratio feeds the segment-heat table
+        # (the admission signal for the future HBM tier)
+        global_segment_heat.device_access(self, hit is not None)
+        if hit is None:
+            hit = self._cache_device(
+                key, self._put(self.host_col_padded(col, bucket),
+                               sharding))
+        return hit
 
     def host_col_padded(self, col: str, bucket: Optional[int] = None
                         ) -> np.ndarray:
@@ -258,7 +273,7 @@ class ImmutableSegment:
             m = self.columns[col]
             vals = np.asarray(self.dictionary(col).values,
                               dtype=m.data_type.np_dtype)
-            self._device[key] = self._put(vals, sharding)
+            self._cache_device(key, self._put(vals, sharding))
         return self._device[key]
 
     def device_null_mask(self, col: str, bucket: Optional[int] = None,
@@ -270,7 +285,7 @@ class ImmutableSegment:
             padded = np.zeros(bucket, dtype=bool)
             if nm is not None:
                 padded[: len(nm)] = nm
-            self._device[key] = self._put(padded, sharding)
+            self._cache_device(key, self._put(padded, sharding))
         return self._device[key]
 
     def set_valid_docs(self, mask: Optional[np.ndarray]) -> None:
@@ -279,6 +294,7 @@ class ImmutableSegment:
         # drop stale device copies
         for key in [k for k in self._device if k[0].startswith("__valid__")]:
             del self._device[key]
+            global_device_memory.remove("segment_cols", (self.uid, key))
 
     def persist_valid_docs(self) -> None:
         """Snapshot validDocIds next to the segment (upsert snapshot analog,
@@ -300,10 +316,12 @@ class ImmutableSegment:
                 padded[: self.n_docs] = self.valid_docs
             else:
                 padded[: self.n_docs] = True
-            self._device[key] = self._put(padded, sharding)
+            self._cache_device(key, self._put(padded, sharding))
         return self._device[key]
 
     def evict_device(self) -> None:
+        for key in self._device:
+            global_device_memory.remove("segment_cols", (self.uid, key))
         self._device.clear()
         from ..engine.batch import evict_stacks_containing
         evict_stacks_containing(self.name)

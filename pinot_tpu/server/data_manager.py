@@ -54,6 +54,14 @@ class TableDataManager:
             segs = dict(self._segments)
             seg = segs.pop(name, None)
             self._segments = segs
+        if seg is not None and hasattr(seg, "evict_device"):
+            # release the device residency NOW (padded columns + stacks
+            # + cubes) instead of waiting for GC/LRU: a dropped segment
+            # must also leave the device-memory registry, or the
+            # /debug/memory live-byte gauges would count dead buffers
+            # forever (in-flight queries keep their own array refs —
+            # clearing the cache never invalidates them)
+            seg.evict_device()
         if seg is not None and getattr(seg, "dir", None):
             # drop any pinned v3 packed-file mmap so unlinked segment
             # files release their disk blocks (segdir LRU backstops this)

@@ -1,0 +1,110 @@
+"""Per-segment access heat: which segments queries actually touch.
+
+The forensics plane (rounds 7/10/12) trends queries; nothing trended
+SEGMENTS — yet segment heat (query touches, rows scanned, device-cache
+hit ratio) is the admission signal ROADMAP direction 3's HBM-tiered
+segment cache will consume, and the per-table stats the controller's
+fleet rollup ranks "hot segments" by.
+
+Two recording sites, both host-side per-query (never inside kernels):
+
+- ``touch()`` — engine/serving.plan_segments, once per (query, executed
+  segment): touches + rows scanned;
+- ``device_access()`` — segment/immutable.ImmutableSegment.device_col,
+  per column read: whether the padded device array was already resident
+  (hit) or had to be uploaded (miss) — the observed device-cache hit
+  ratio per segment.
+
+Entries key on the segment's process-unique load uid (the round-9 rule:
+names recur across tables and reloads) with the name/table carried for
+display; the table is bounded LRU so realtime segment churn cannot grow
+it without bound. Served per node in the ``GET /debug/ledger`` /
+``GET /debug/memory`` payloads (cluster/forensics.py) and aggregated
+fleet-wide by cluster/rollup.py.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+MAX_ENTRIES = 2048
+
+
+class SegmentHeat:
+    def __init__(self, max_entries: int = MAX_ENTRIES):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Any, Dict[str, Any]]" = OrderedDict()
+        self._max = max_entries
+
+    @staticmethod
+    def _key(segment) -> Any:
+        # immutable segments carry the process-unique load uid; mutable
+        # (consuming) segments key by name — they are table-local and
+        # short-lived, so name collisions across tables only merge heat
+        # until the seal replaces them with a uid-keyed immutable
+        uid = getattr(segment, "uid", None)
+        return uid if uid is not None else f"m:{segment.name}"
+
+    def _entry(self, segment) -> Dict[str, Any]:
+        # caller (touch / device_access) holds self._lock — the public
+        # mutators are the only entry points
+        key = self._key(segment)
+        e = self._entries.get(key)
+        if e is None:
+            e = {"segment": segment.name, "table": None, "touches": 0,
+                 "rows_scanned": 0, "device_hits": 0, "device_misses": 0,
+                 "last_touch": 0.0}
+            self._entries[key] = e  # jaxlint: ok unlocked-mutation
+        self._entries.move_to_end(key)  # jaxlint: ok unlocked-mutation
+        while len(self._entries) > self._max:
+            self._entries.popitem(last=False)  # jaxlint: ok unlocked-mutation
+        return e
+
+    def touch(self, segment, table: Optional[str], rows: int) -> None:
+        """One query executed (kernel or host plan) over this segment."""
+        with self._lock:
+            e = self._entry(segment)
+            if table:
+                e["table"] = table
+            e["touches"] += 1
+            e["rows_scanned"] += int(rows)
+            e["last_touch"] = time.time()
+
+    def device_access(self, segment, hit: bool) -> None:
+        """One padded-column device read: resident (hit) or uploaded.
+
+        This is the hottest recording site (per column per query on the
+        serving path), so the warm case skips the LRU bookkeeping — a
+        bare dict get + int increment under the lock; recency is
+        refreshed by the per-query touch() instead."""
+        key = self._key(segment)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = self._entry(segment)
+            e["device_hits" if hit else "device_misses"] += 1
+
+    def snapshot(self, top: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Heat table sorted hottest-first (touches, then rows scanned),
+        each row carrying the derived device-cache hit ratio."""
+        with self._lock:
+            rows = [dict(e) for e in self._entries.values()]
+        rows.sort(key=lambda e: (-e["touches"], -e["rows_scanned"],
+                                 e["segment"]))
+        if top is not None:
+            rows = rows[: max(top, 0)]
+        for e in rows:
+            acc = e["device_hits"] + e["device_misses"]
+            e["device_hit_ratio"] = round(e["device_hits"] / acc, 4) \
+                if acc else None
+            e["last_touch"] = round(e["last_touch"], 3)
+        return rows
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+global_segment_heat = SegmentHeat()

@@ -29,6 +29,16 @@ Kinds:
   freshness ledger (rows/sec, end-to-end freshness ms, commit retries,
   rebalance/replay/orphan recovery counts, faults fired) — the ingest
   plane's first-class counterpart to query latency.
+- ``fleet_rollup``     — cluster/rollup.py ForensicsRollupTask: the
+  controller's cluster-wide aggregation over the per-node ledgers it
+  pulls (per-table fleet stats, hot-segment heat ranking, per-node
+  drift/batching/device-memory blocks), one record per rollup pass in
+  the controller-side fleet ledger.
+
+Fleet provenance: the controller's rollup puller stamps every record it
+ships into the fleet ledger with ``node`` (the source instance id) so
+tools (span_diff --fleet) can calibrate per node; ``node`` is part of
+the envelope — any kind may carry it.
 """
 from __future__ import annotations
 
@@ -112,9 +122,29 @@ KINDS: Dict[str, Dict[str, set]] = {
                      "consuming_docs", "partitions", "restarts", "seed",
                      "backend", "extra"},
     },
+    "fleet_rollup": {
+        # one controller rollup pass (cluster/rollup.py): pull health
+        # (every live node attempted; dead/partitioned nodes skipped
+        # and counted, never wedging the pull), per-table fleet stats
+        # aggregated from the pulled query_stats/ingest_stats corpus,
+        # the hot-segment heat ranking, per-node drift/batching/memory
+        # blocks and the unique-process fleet totals (in-process
+        # clusters share one metrics registry per process — summing
+        # per NODE would multiply-count, so totals dedupe by the
+        # nodes' process tokens)
+        "required": {"nodes_polled", "nodes_skipped", "records_pulled",
+                     "tables"},
+        "optional": {"skipped_nodes", "invalid_records", "heat",
+                     "slow_queries", "nodes", "fleet", "ingest",
+                     "backend", "cursors", "fleet_records",
+                     "window_clipped"},
+    },
 }
 
-_ENVELOPE = {"v", "ts", "kind"}
+# ``node`` is fleet provenance (stamped by the controller's rollup
+# puller on records it ships into the fleet ledger) — envelope-level so
+# every kind may carry it without forking each contract
+_ENVELOPE = {"v", "ts", "kind", "node"}
 
 
 def make_record(kind: str, **fields: Any) -> Dict[str, Any]:

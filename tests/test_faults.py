@@ -521,7 +521,10 @@ def test_chaos_smoke_cli(capsys):
                              "--queries", "q1.1,q4.1"]) == 0
     out = capsys.readouterr().out.strip().splitlines()
     summary = __import__("json").loads(out[-1])
-    assert summary["ok"] and summary["plans"] == 3
+    # 3 query-plane fault plans + the round-14 fleet-rollup pull kill
+    assert summary["ok"] and summary["plans"] == 4
+    assert summary["rollup_faults_fired"] >= 1
+    assert summary["fleet_ledger_kinds"].get("fleet_rollup", 0) >= 1
 
 
 @pytest.mark.slow

@@ -50,6 +50,7 @@ import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -266,6 +267,79 @@ def main_ingest(args) -> int:
     return 0 if not failures else 1
 
 
+def _rollup_gate(ctrl, broker, tmp, queries, seed, check) -> dict:
+    """The round-14 fleet-rollup chaos gate (satellite): fault-kill one
+    broker's ledger pull mid-rollup, then assert skip-count + exact
+    per-table totals + a valid fleet ledger + the --fleet span check."""
+    import span_diff
+    from pinot_tpu.cluster import BrokerNode
+    from pinot_tpu.cluster.http_util import http_json
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils import ledger as uledger
+
+    out: dict = {}
+    b2 = BrokerNode(ctrl.url, routing_refresh=0.1,
+                    query_stats_path=os.path.join(tmp, "qs_broker2.jsonl"))
+    # the fault arms BEFORE broker2 serves any ledger pull: every pull
+    # of it — including an auto-fired periodic pass — dies, so its rows
+    # can never leak into the fleet ledger and the exactness assert
+    # below is airtight
+    plan = faults.install(
+        f"seed={seed}; rpc.drop: match=:{b2.port}/debug/ledger")
+    try:
+        assert b2.wait_for_version(
+            ctrl.routing_snapshot()["version"], timeout=30.0)
+        qid, sql = queries[0]
+        http_json("POST", f"{b2.url}/query/sql", {"sql": sql + OPTION},
+                  timeout=120.0)
+        rollup = None
+        try:
+            rollup = ctrl.rollup.run()
+        except Exception as e:  # noqa: BLE001 — into the summary
+            check("rollup.run", False, f"EXC {type(e).__name__}: {e}")
+        out["rollup_faults_fired"] = len(plan.fired)
+        check("rollup.pull_fault_fired", len(plan.fired) >= 1,
+              "the /debug/ledger rpc.drop never fired")
+        if rollup is not None:
+            check("rollup.valid",
+                  not uledger.validate_record(rollup),
+                  f"{uledger.validate_record(rollup)}")
+            check("rollup.dead_broker_counted",
+                  rollup["nodes_skipped"] >= 1
+                  and b2.instance_id in rollup.get("skipped_nodes", []),
+                  f"skipped={rollup.get('skipped_nodes')}")
+            # exactness: fleet per-table query counts == sum over the
+            # brokers whose pulls SURVIVED of their own ledger rows
+            expected: dict = {}
+            for rec in _iter_stats(broker.forensics.ledger_path):
+                t = rec.get("table")
+                expected[t] = expected.get(t, 0) + 1
+            got = {t: s.get("queries", 0)
+                   for t, s in rollup["tables"].items()}
+            check("rollup.table_totals_exact", got == expected,
+                  f"rollup {got} != surviving brokers {expected}")
+            out["rollup_tables"] = got
+        # the whole fleet ledger must be contract-valid, rollup
+        # records included (check_ledger reports the new kind)
+        res = uledger.validate_file(ctrl.rollup.ledger_path)
+        check("fleet_ledger.valid", not res["errors"],
+              f"invalid records: {res['errors'][:3]}")
+        check("fleet_ledger.kinds",
+              res["kinds"].get("fleet_rollup", 0) >= 1
+              and res["kinds"].get("query_stats", 0) >= 1,
+              f"kinds={res['kinds']}")
+        out["fleet_ledger_kinds"] = res["kinds"]
+        # fleet span-diff over the aggregated (node-stamped) trace
+        # corpus: per-node calibration, same env as the baseline
+        rc = span_diff.main(["check", "--fleet",
+                             ctrl.rollup.ledger_path])
+        check("fleet_span_diff", rc == 0, f"exit {rc}")
+    finally:
+        faults.clear()
+        b2.stop()
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=None,
@@ -443,6 +517,16 @@ def main(argv=None) -> int:
             check("span_baseline.intact",
                   _file_hash(SPAN_BASELINE) == baseline_hash,
                   "tools/span_baseline.json changed during the soak")
+
+        # fleet forensics rollup under chaos (round 14): a second
+        # broker joins the fleet, then its ledger pull is fault-killed
+        # MID-ROLLUP (rpc.drop on its /debug/ledger endpoint) — the
+        # controller rollup must stay contract-valid, skip + count the
+        # dead node, and per-table query totals must equal the sum of
+        # the SURVIVING brokers' query_stats rows exactly
+        summary.update(_rollup_gate(ctrl, broker, tmp, queries,
+                                    args.seed, check))
+        summary["plans"] += 1
     finally:
         faults.clear()
         stop()

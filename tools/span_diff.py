@@ -35,6 +35,22 @@ re-capture with ``update`` — in the same environment tier-1 runs in.
     python tools/span_diff.py capture --out /tmp/trace.jsonl [--iters 5]
     python tools/span_diff.py update  /tmp/trace.jsonl
     python tools/span_diff.py check   /tmp/trace.jsonl [--bar 1.7]
+    python tools/span_diff.py check --fleet fleet_ledger.jsonl
+
+Environment pinning (round 14): ``update`` stamps the capture
+environment (JAX_PLATFORMS, jax_enable_x64, backend) into the baseline
+header, and ``check`` FAILS LOUDLY (exit 3) when the current
+environment differs — baselines captured outside the tier-1 env
+(JAX_PLATFORMS=cpu, x64 on) silently miscalibrated every phase before.
+bench_common.span_regression_gate surfaces exit 3 as an explicit
+"environment mismatch" skip rather than a phase regression.
+
+Fleet mode (round 14): ``check --fleet`` groups a fleet ledger's
+``query_trace`` records by their ``node`` provenance stamp
+(cluster/rollup.py) and runs the diff PER NODE, each with its own speed
+calibration — a heterogeneous fleet (one node 3x slower across the
+board) must not false-trip the ratchet, while a single node's single
+phase regressing still does.
 
 Exit 0 when no phase regresses; one summary JSON line last,
 check_ledger-style. tier-1 runs capture+check through
@@ -61,6 +77,62 @@ DEFAULT_MIN_MS = 1.0       # sub-ms phases are timing noise, not signal
 # the explicit self-time filler (query/explain.finalize_analyze) and the
 # sampled-root gap are residuals, not phases a kernel change regresses
 EXCLUDE_PHASES = {"broker_overhead"}
+EXIT_ENV_MISMATCH = 3      # distinct from a phase regression (exit 1)
+
+
+def capture_env(include_backend: bool = True) -> Dict[str, Any]:
+    """The calibration-relevant capture environment, recorded into the
+    baseline header by ``update`` and enforced by ``check``. Imports
+    pinot_tpu first so the flags reflect the ENGINE's configuration
+    (it enables x64 at import), not a bare interpreter's defaults.
+    ``include_backend=False`` skips backend init — jax.default_backend()
+    against a wedged device tunnel hangs indefinitely, so the mismatch
+    check only initializes a backend once the cheap fields agree."""
+    env: Dict[str, Any] = {
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "x64": None, "backend": "unknown"}
+    try:
+        import pinot_tpu  # noqa: F401 — configures jax as the engine runs
+
+        import jax
+
+        env["x64"] = bool(jax.config.jax_enable_x64)
+        if include_backend:
+            env["backend"] = jax.default_backend()
+    except Exception:
+        pass
+    return env
+
+
+def env_mismatch(baseline_env: Optional[Dict[str, Any]]
+                 ) -> Optional[Dict[str, Any]]:
+    """None when the current environment matches the baseline header
+    (or the header predates env pinning — legacy baselines stay
+    checkable); otherwise {field: [baseline, current]}. Checked
+    cheapest-first: JAX_PLATFORMS / x64 need no backend init, so a
+    baseline pinned to cpu fails fast on a device machine instead of
+    hanging in device-tunnel init just to report the mismatch."""
+    if not baseline_env:
+        return None
+    cur = capture_env(include_backend=False)
+    diffs = {k: [baseline_env.get(k), cur.get(k)]
+             for k in ("jax_platforms", "x64")
+             if baseline_env.get(k) != cur.get(k)}
+    # an UNSET JAX_PLATFORMS is not a platform statement — plenty of
+    # valid cpu environments never export it (sitecustomize may force
+    # the platform config regardless). Only a conflict between two
+    # explicit values fails fast; otherwise the backend comparison
+    # below is the authority.
+    jp = diffs.get("jax_platforms")
+    if jp is not None and not (jp[0] and jp[1]):
+        del diffs["jax_platforms"]
+    if diffs:
+        return diffs
+    cur = capture_env()
+    if baseline_env.get("backend") != cur.get("backend"):
+        return {"backend": [baseline_env.get("backend"),
+                            cur.get("backend")]}
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -307,9 +379,17 @@ def load_baseline(path: str) -> Dict[str, Any]:
     return data.get("shapes", {})
 
 
-def write_baseline(path: str, shapes: Dict[str, Any]) -> None:
+def load_baseline_env(path: str) -> Optional[Dict[str, Any]]:
+    with open(path) as fh:
+        data = json.load(fh)
+    return data.get("env")
+
+
+def write_baseline(path: str, shapes: Dict[str, Any],
+                   env: Optional[Dict[str, Any]] = None) -> None:
     with open(path, "w") as fh:
         json.dump({"v": 1, "bar": DEFAULT_BAR, "min_ms": DEFAULT_MIN_MS,
+                   "env": env if env is not None else capture_env(),
                    "shapes": shapes}, fh, indent=1, sort_keys=True)
         fh.write("\n")
 
@@ -332,7 +412,13 @@ def main(argv=None) -> int:
                     help="capture mode: the trace ledger to append to")
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--rows", type=int, default=8192)
-    args = ap.parse_args(argv)
+    ap.add_argument("--fleet", action="store_true",
+                    help="check mode: group records by their `node` "
+                         "provenance stamp (fleet ledger) and diff "
+                         "each node with its own speed calibration")
+    # intermixed: `check --fleet <ledger>` must parse (plain parse_args
+    # cannot interleave an nargs="*" positional with flags)
+    args = ap.parse_intermixed_args(argv)
 
     if args.mode == "capture":
         if not args.out:
@@ -345,12 +431,24 @@ def main(argv=None) -> int:
 
     ledgers = args.ledgers or [os.path.join(REPO, "PERF_LEDGER.jsonl")]
     records = load_trace_records(ledgers)
-    shapes = aggregate(records, last=args.last or None)
 
     if args.mode == "update":
-        write_baseline(args.baseline, shapes)
+        shapes = aggregate(records, last=args.last or None)
+        env = capture_env()
+        rec_backends = {r.get("backend") for r in records} - {None}
+        if rec_backends and rec_backends != {env["backend"]}:
+            # the header must describe the RECORDS' environment; mixed
+            # or foreign-backend records would stamp a lie into the
+            # ratchet and re-introduce exactly the silent drift noise
+            # the pin exists to stop
+            print(f"refusing to update: records captured on backend(s) "
+                  f"{sorted(rec_backends)} but the current environment "
+                  f"is {env['backend']!r} — re-run capture+update in "
+                  f"one environment", file=sys.stderr)
+            return 2
+        write_baseline(args.baseline, shapes, env)
         print(json.dumps({"mode": "update", "baseline": args.baseline,
-                          "records": len(records),
+                          "records": len(records), "env": env,
                           "shapes": len(shapes), "ok": True}))
         return 0
 
@@ -359,6 +457,28 @@ def main(argv=None) -> int:
                           "skipped": f"no baseline at {args.baseline}"}))
         return 0
     baseline = load_baseline(args.baseline)
+    mismatch = env_mismatch(load_baseline_env(args.baseline))
+    if mismatch:
+        # fail LOUDLY instead of silently miscalibrating: a cpu-captured
+        # baseline checked on a tpu backend (or x64 flipped) makes every
+        # per-phase ratio meaningless. Distinct exit code so callers
+        # (bench_common.span_regression_gate) can surface the skip
+        # without reading it as a phase regression.
+        print("ENVIRONMENT MISMATCH vs baseline "
+              f"{os.path.basename(args.baseline)}: "
+              + "; ".join(f"{k}: baseline={b!r} current={c!r}"
+                          for k, (b, c) in sorted(mismatch.items()))
+              + " — re-capture the baseline in this environment "
+                "(capture + update), or run check in the baseline's",
+              file=sys.stderr)
+        print(json.dumps({"mode": "check", "ok": False,
+                          "env_mismatch": mismatch}))
+        return EXIT_ENV_MISMATCH
+
+    if args.fleet:
+        return _check_fleet(records, baseline, args)
+
+    shapes = aggregate(records, last=args.last or None)
     res = diff_shapes(baseline, shapes, args.bar, args.min_ms)
     for r in res["regressions"]:
         print(f"REGRESSION {r['shape']} phase={r['phase']}: "
@@ -371,6 +491,39 @@ def main(argv=None) -> int:
                       "shapes_checked": len(
                           set(shapes) & set(baseline)),
                       **res, "ok": ok}))
+    return 0 if ok else 1
+
+
+def _check_fleet(records: List[Dict[str, Any]],
+                 baseline: Dict[str, Any], args) -> int:
+    """check --fleet: per-node aggregation + per-node speed calibration
+    (cluster/rollup.py stamps `node` onto every pulled record), so a
+    heterogeneous fleet never false-trips the ratchet while one node's
+    one-phase regression still does."""
+    by_node: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        by_node.setdefault(str(rec.get("node") or "<local>"),
+                           []).append(rec)
+    nodes: Dict[str, Any] = {}
+    regressions: List[Dict[str, Any]] = []
+    for node, recs in sorted(by_node.items()):
+        shapes = aggregate(recs, last=args.last or None)
+        res = diff_shapes(baseline, shapes, args.bar, args.min_ms)
+        for r in res["regressions"]:
+            r = dict(r, node=node)
+            regressions.append(r)
+            print(f"REGRESSION node={node} {r['shape']} "
+                  f"phase={r['phase']}: ms {r['base_ms']} -> "
+                  f"{r['cand_ms']} (calibrated {r['calibrated_ms']}, "
+                  f"{r['ratio']}x > bar {args.bar})  [{r['sql']}]")
+        nodes[node] = {"records": len(recs),
+                       "calibration": res["calibration"],
+                       "checked_phases": res["checked_phases"],
+                       "regressions": len(res["regressions"])}
+    ok = not regressions
+    print(json.dumps({"mode": "check", "fleet": True, "bar": args.bar,
+                      "records": len(records), "nodes": nodes,
+                      "regressions": regressions, "ok": ok}))
     return 0 if ok else 1
 
 
