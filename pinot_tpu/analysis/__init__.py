@@ -1,9 +1,10 @@
-"""Static analysis over the engine's two contract surfaces.
+"""Static analysis over the engine's contract surfaces.
 
-Two passes, one goal: hazards that today corrupt results or retrace
-silently at RUN time must fail loudly at PLAN / LINT time, before a TPU
-is ever attached ("Query Processing on Tensor Computation Runtimes":
-relational-on-tensor stacks live or die by static shape/dtype contracts).
+Three passes, one goal: hazards that today corrupt results, retrace or
+race silently at RUN time must fail loudly at PLAN / LINT time, before
+a TPU is ever attached ("Query Processing on Tensor Computation
+Runtimes": relational-on-tensor stacks live or die by static
+shape/dtype contracts).
 
 - plan_verify: abstract shape/dtype inference over the ops/ir.py kernel
   plan tree — index bounds, plan-cache hashability, lossless carrier
@@ -15,10 +16,16 @@ relational-on-tensor stacks live or die by static shape/dtype contracts).
   under trace, unlocked mutation of shared registries. Allowlists plus a
   checked-in ratchet baseline (tools/jaxlint_baseline.json) grandfather
   the legitimate host-side sites.
+- concur: whole-program concurrency verifier (CC201–CC205) — lock
+  guard-map inference (incl. caller-holds-lock), blocking calls under
+  held locks, lock-order cycles over the resolved call graph,
+  thread-local state escaping into pool closures, check-then-act.
+  Ratcheted at tools/concur_baseline.json.
 
-`tools/check_static.py` runs both passes (the linter over the tree, the
-verifier over every plan the planner produces for the SSB + taxi +
-fuzzer query corpus) and gates tier-1 alongside tools/check_ledger.py.
+`tools/check_static.py` runs all three passes (the linter and the
+concurrency verifier over the tree, the plan verifier over every plan
+the planner produces for the SSB + taxi + fuzzer query corpus) and
+gates tier-1 alongside tools/check_ledger.py.
 """
 from .plan_verify import (Diagnostic, PlanVerificationError,  # noqa: F401
                           RULES, check_compiled_plan, format_diagnostics,
@@ -26,3 +33,5 @@ from .plan_verify import (Diagnostic, PlanVerificationError,  # noqa: F401
 from .jaxlint import (Finding, LINT_RULES, compare_baseline,  # noqa: F401
                       lint_source, lint_tree, load_baseline,
                       write_baseline)
+from .concur import (CONCUR_RULES, Program,  # noqa: F401
+                     analyze_source, analyze_tree)

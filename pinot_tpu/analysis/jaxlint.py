@@ -124,16 +124,18 @@ class _Linter(ast.NodeVisitor):
         self.loop_depth = 0
         self.jitted_fns: set = set()
         self.findings: List[Finding] = []
+        self.suppressed: List[Finding] = []
 
     # -- plumbing ----------------------------------------------------------
     def emit(self, rule: str, node: ast.AST, message: str) -> None:
         line = getattr(node, "lineno", 0)
+        f = Finding(rule, self.path, line,
+                    ".".join(self.scope) or "<module>", message)
         sup = self.suppress.get(line, ())
         if rule in sup or "all" in sup:
+            self.suppressed.append(f)
             return
-        self.findings.append(Finding(
-            rule, self.path, line,
-            ".".join(self.scope) or "<module>", message))
+        self.findings.append(f)
 
     def _walk_scope(self, name: str, node: ast.AST) -> None:
         self.scope.append(name)
@@ -323,8 +325,10 @@ class _Linter(ast.NodeVisitor):
             self.scope.pop()
 
 
-def lint_source(src: str, path: str) -> List[Finding]:
-    """Lint one module's source. ``path`` must be repo-relative."""
+def lint_source_ex(src: str, path: str
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """Lint one module's source -> (findings, suppressed). ``path``
+    must be repo-relative."""
     path = path.replace(os.sep, "/")
     hot = path.startswith(
         tuple(f"pinot_tpu/{p}/" for p in HOT_PACKAGES)) \
@@ -333,7 +337,7 @@ def lint_source(src: str, path: str) -> List[Finding]:
         tree = ast.parse(src)
     except SyntaxError as e:
         return [Finding("parse-error", path, e.lineno or 0, "<module>",
-                        f"unparseable: {e.msg}")]
+                        f"unparseable: {e.msg}")], []
     # pre-pass: names jitted at module level (jax.jit(f), jax.jit(vmap(f)))
     linter = _Linter(path, src, hot)
     for node in ast.walk(tree):
@@ -345,12 +349,20 @@ def lint_source(src: str, path: str) -> List[Finding]:
                 if isinstance(inner, ast.Name):
                     linter.jitted_fns.add(inner.id)
     linter.visit(tree)
-    return linter.findings
+    return linter.findings, linter.suppressed
 
 
-def lint_tree(root: str, package: str = "pinot_tpu") -> List[Finding]:
-    """Lint every .py file under <root>/<package>."""
+def lint_source(src: str, path: str) -> List[Finding]:
+    """Lint one module's source. ``path`` must be repo-relative."""
+    return lint_source_ex(src, path)[0]
+
+
+def lint_tree_ex(root: str, package: str = "pinot_tpu"
+                 ) -> Tuple[List[Finding], List[Finding]]:
+    """Lint every .py file under <root>/<package> -> (findings,
+    suppressed)."""
     findings: List[Finding] = []
+    suppressed: List[Finding] = []
     pkg_dir = os.path.join(root, package)
     for dirpath, dirnames, filenames in os.walk(pkg_dir):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
@@ -360,8 +372,15 @@ def lint_tree(root: str, package: str = "pinot_tpu") -> List[Finding]:
             full = os.path.join(dirpath, fn)
             rel = os.path.relpath(full, root).replace(os.sep, "/")
             with open(full, "r", encoding="utf-8") as fh:
-                findings.extend(lint_source(fh.read(), rel))
-    return findings
+                fs, sup = lint_source_ex(fh.read(), rel)
+            findings.extend(fs)
+            suppressed.extend(sup)
+    return findings, suppressed
+
+
+def lint_tree(root: str, package: str = "pinot_tpu") -> List[Finding]:
+    """Lint every .py file under <root>/<package>."""
+    return lint_tree_ex(root, package)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -383,16 +402,18 @@ def load_baseline(path: str) -> Dict[str, int]:
     return dict(data.get("counts", {}))
 
 
-def write_baseline(findings: Sequence[Finding], path: str) -> None:
+def write_baseline(findings: Sequence[Finding], path: str,
+                   comment: Optional[str] = None) -> None:
     # parse-error can never be grandfathered: a module that stops
     # parsing must fail the gate even right after --update-baseline
     findings = [f for f in findings if f.rule != "parse-error"]
     data = {
-        "comment": "jaxlint ratchet baseline — grandfathered findings "
-                   "per file::scope::rule. Regenerate with "
-                   "`python tools/check_static.py --update-baseline`; "
-                   "new findings above these counts fail check_static, "
-                   "and counts that drop must be ratcheted down here.",
+        "comment": comment or (
+            "jaxlint ratchet baseline — grandfathered findings "
+            "per file::scope::rule. Regenerate with "
+            "`python tools/check_static.py --update-baseline`; "
+            "new findings above these counts fail check_static, "
+            "and counts that drop must be ratcheted down here."),
         "version": 1,
         "counts": dict(sorted(counts_of(findings).items())),
     }

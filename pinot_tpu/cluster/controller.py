@@ -51,7 +51,10 @@ class Controller:
         self.reconcile_interval = reconcile_interval
         self.lease_ttl = lease_ttl
         self.instance_id = instance_id or f"controller_{_uuid.uuid4().hex[:8]}"
-        self.is_leader = False
+        # single-writer atomic bool BY DESIGN: the lease loop abdicates
+        # without _lock (taking it would deadlock through
+        # _bump->_persist) and GIL-atomic bool stores need no guard
+        self.is_leader = False  # guarded-by: none
         self._recon: Optional[threading.Thread] = None
         self._state: Dict[str, Any] = self._load() or {
             "version": 0,

@@ -324,7 +324,13 @@ class ForensicsRollupTask:
                 self._window.append(stamped)
                 self._total_records += 1
                 pulled += 1
-            self._cursors[node_id] = int(resp.get("nextSeq", since))
+            # cursor updates publish under _lock: snapshot() copies
+            # _cursors for GET /debug/fleet while a pass is mid-pull,
+            # and a dict resize during that copy raises (CC201
+            # mixed-guard — _run_lock serializes passes, _lock guards
+            # the served state)
+            with self._lock:
+                self._cursors[node_id] = int(resp.get("nextSeq", since))
             node_blocks[node_id] = {
                 "role": resp.get("role"),
                 "proc": resp.get("proc"),

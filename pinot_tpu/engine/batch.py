@@ -14,6 +14,7 @@ dictionaries in exchange for on-device psum combine).
 from __future__ import annotations
 
 import functools
+import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -36,6 +37,18 @@ from .executor import execute_plan, extract_partial, resolve_params
 # evict_stacks_containing.
 _STACK_CACHE: "OrderedDict[Tuple, Tuple[jax.Array, ...]]" = OrderedDict()
 _STACK_CACHE_MAX = 32
+# _stacked_cols runs on broker pool / scheduler worker threads and
+# evict_stacks_containing on the reload path: OrderedDict LRU mutation
+# (move_to_end/popitem) is a multi-step linked-list relink that is NOT
+# GIL-atomic (the segdir._CACHE_LOCK lesson; surfaced by concur CC201).
+# The device-side stack BUILD stays outside the lock — a rare double
+# build is benign (last insert wins), a corrupted LRU is not. The
+# eviction epoch closes the build window: a stack built while an
+# eviction ran may contain a just-evicted segment, and inserting it
+# would resurrect device buffers the eviction claimed to free — such a
+# build is returned uncached instead.
+_STACK_LOCK = threading.Lock()
+_EVICT_EPOCH = 0
 
 
 def _seg_key(seg) -> Tuple[int, str]:
@@ -67,10 +80,12 @@ def _stacked_cols(plans: List[CompiledPlan], bucket: int
                   ) -> Tuple[jax.Array, ...]:
     key = (tuple(_seg_key(p.segment) for p in plans),
            tuple(plans[0].col_names), bucket)
-    hit = _STACK_CACHE.get(key)
-    if hit is not None:
-        _STACK_CACHE.move_to_end(key)
-        return hit
+    with _STACK_LOCK:
+        hit = _STACK_CACHE.get(key)
+        if hit is not None:
+            _STACK_CACHE.move_to_end(key)
+            return hit
+        epoch = _EVICT_EPOCH
     cols = tuple(
         jnp.stack([p.segment.device_col(c, bucket) for p in plans])
         for c in plans[0].col_names)
@@ -78,24 +93,42 @@ def _stacked_cols(plans: List[CompiledPlan], bucket: int
     # the 32-entry LRU: proactively deleting same-name entries would
     # make two LIVE tables with generic segment names evict each other's
     # stacks on every alternation
-    _STACK_CACHE[key] = cols
-    # device-memory telemetry: the stack cache is an HBM resident the
-    # future tiered store must see (utils/devmem, GET /debug/memory)
-    global_device_memory.add("stack_cache", key,
-                             sum(int(c.nbytes) for c in cols))
-    if len(_STACK_CACHE) > _STACK_CACHE_MAX:
-        old_key, _old = _STACK_CACHE.popitem(last=False)
-        global_device_memory.remove("stack_cache", old_key)
+    with _STACK_LOCK:
+        if _EVICT_EPOCH != epoch:
+            # an eviction ran mid-build: this stack may include the
+            # evicted segment — serve it to THIS query but never cache
+            return cols
+        _STACK_CACHE[key] = cols
+        # device-memory telemetry: the stack cache is an HBM resident
+        # the future tiered store must see (utils/devmem, /debug/memory)
+        global_device_memory.add("stack_cache", key,
+                                 sum(int(c.nbytes) for c in cols))
+        while len(_STACK_CACHE) > _STACK_CACHE_MAX:
+            old_key, _old = _STACK_CACHE.popitem(last=False)
+            global_device_memory.remove("stack_cache", old_key)
     return cols
 
 
 def evict_stacks_containing(segment_name: str) -> None:
     """Drop stacked copies that include a segment (called from
     ImmutableSegment.evict_device so eviction actually frees HBM)."""
-    for key in [k for k in _STACK_CACHE
-                if any(n == segment_name for _, n in k[0])]:
-        del _STACK_CACHE[key]
-        global_device_memory.remove("stack_cache", key)
+    global _EVICT_EPOCH
+    with _STACK_LOCK:
+        _EVICT_EPOCH += 1
+        for key in [k for k in _STACK_CACHE
+                    if any(n == segment_name for _, n in k[0])]:
+            del _STACK_CACHE[key]
+            global_device_memory.remove("stack_cache", key)
+
+
+def clear_stack_cache() -> None:
+    """Drop every stacked entry AND its device-memory accounting in
+    one locked step (test isolation; not an eviction — no counters)."""
+    global _EVICT_EPOCH
+    with _STACK_LOCK:
+        _EVICT_EPOCH += 1
+        _STACK_CACHE.clear()
+        global_device_memory.drop_pool("stack_cache")
 
 
 def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:

@@ -63,8 +63,14 @@ FETCH_BATCH = 10_000
 # gauge name -> id(manager) of the last writer: several managers of the
 # SAME table in one process (replicas) last-writer-wins the shared
 # per-table freshness gauge, so stop() must only remove it when this
-# manager was the latest writer — never a live replica's reading
+# manager was the latest writer — never a live replica's reading.
+# Module-global state needs a MODULE lock (concur CC201/CC205): each
+# replica's _stats_lock is a different object, so it excludes nothing
+# across replicas, and stop()'s owner check-then-act raced a live
+# replica's write — the stopping manager could still delete the gauge
+# the live one had just refreshed.
 _FRESHNESS_OWNERS: Dict[str, int] = {}
+_FRESHNESS_LOCK = threading.Lock()
 
 
 class RealtimeTableDataManager(TableDataManager):
@@ -245,9 +251,14 @@ class RealtimeTableDataManager(TableDataManager):
         os.replace(tmp, self._state_path())  # atomic commit point
 
     def _partition_state(self, p: int) -> Dict[str, Any]:
+        # single-writer per partition key: only p's consume thread (or
+        # the seal/rebalance paths, which hold _seal_lock AND quiesce
+        # the partition first) touches str(p)'s entry, and dict element
+        # stores are GIL-atomic — no lock needed on the per-row path
         key = str(p)
-        if key not in self._state:
-            self._state[key] = {"seq": 0, "next_offset": 0, "segments": []}
+        if key not in self._state:  # concur: ok CC205
+            st = {"seq": 0, "next_offset": 0, "segments": []}
+            self._state[key] = st  # concur: ok CC201
         return self._state[key]
 
     # -- consuming segment lifecycle ---------------------------------------
@@ -261,9 +272,10 @@ class RealtimeTableDataManager(TableDataManager):
                            self._segment_name(p, st["seq"]),
                            self.table_config)
         m.start_offset = st["next_offset"]
-        self._mutables[p] = m
-        self._mutable_age[p] = time.monotonic()
-        self._row_offsets[p] = []
+        # same single-writer-per-partition rule as _partition_state
+        self._mutables[p] = m  # concur: ok CC201
+        self._mutable_age[p] = time.monotonic()  # concur: ok CC201
+        self._row_offsets[p] = []  # concur: ok CC201
         return m
 
     def _stream_offset(self, p: int, rows: int) -> int:
@@ -319,8 +331,10 @@ class RealtimeTableDataManager(TableDataManager):
                     else:
                         # a stream that mixes offset-bearing and dense
                         # batches can't be tracked per-row; drop to the
-                        # dense arithmetic (empty list stays empty)
-                        self._row_offsets[p] = []
+                        # dense arithmetic (empty list stays empty);
+                        # single-writer per partition key (see
+                        # _partition_state)
+                        self._row_offsets[p] = []  # concur: ok CC201
                 total += len(batch.rows)
                 self._note_batch(len(batch.rows), t_fetch)
                 self._maybe_seal(p)
@@ -376,8 +390,14 @@ class RealtimeTableDataManager(TableDataManager):
             # (replicas of the same table still share one gauge —
             # _FRESHNESS_OWNERS guards removal, not the readings)
             gname = "ingest_freshness_ms_" + self.table_name
-            global_metrics.gauge(gname, round(self._freshness_ms, 3))
-            _FRESHNESS_OWNERS[gname] = id(self)
+            with _FRESHNESS_LOCK:
+                # gauge write + ownership record are atomic vs stop():
+                # a stopping replica either sees this manager as owner
+                # (and this gauge survives via its next write) or
+                # removes strictly older state
+                global_metrics.gauge(gname,
+                                     round(self._freshness_ms, 3))
+                _FRESHNESS_OWNERS[gname] = id(self)
 
     def _rebalance_reset(self, p: int) -> None:
         """Partition offsets snapped back (consumer-group rebalance /
@@ -713,9 +733,10 @@ class RealtimeTableDataManager(TableDataManager):
         # forever. Owner-guarded: a stopped replica must not delete a
         # live replica's reading
         gname = "ingest_freshness_ms_" + self.table_name
-        if _FRESHNESS_OWNERS.get(gname) == id(self):
-            global_metrics.remove_gauge(gname)
-            _FRESHNESS_OWNERS.pop(gname, None)
+        with _FRESHNESS_LOCK:
+            if _FRESHNESS_OWNERS.get(gname) == id(self):
+                global_metrics.remove_gauge(gname)
+                _FRESHNESS_OWNERS.pop(gname, None)
 
     # -- query integration --------------------------------------------------
     def acquire_segments(self):

@@ -47,9 +47,11 @@ class SegmentHeat:
         uid = getattr(segment, "uid", None)
         return uid if uid is not None else f"m:{segment.name}"
 
-    def _entry(self, segment) -> Dict[str, Any]:
+    def _entry(self, segment) -> Dict[str, Any]:  # holds-lock: _lock
         # caller (touch / device_access) holds self._lock — the public
-        # mutators are the only entry points
+        # mutators are the only entry points (concur verifies: the
+        # annotation plus caller-holds inference keep this body
+        # analyzed as locked)
         key = self._key(segment)
         e = self._entries.get(key)
         if e is None:

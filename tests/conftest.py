@@ -39,3 +39,28 @@ def pytest_configure(config):
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry_registries():
+    """The heat and device-memory registries are process-global (round
+    14): a test that queries a table leaves segment heat and HBM-pool
+    accounting behind, and the top-N heat-ranking tests had to clear by
+    hand — cross-test pollution waiting to recur. Reset both after
+    every test so each starts from an empty telemetry slate.
+
+    The stack cache is dropped THROUGH its devmem-synced clear so its
+    pool accounting stays reconciled (rebuild is one jnp.stack per
+    group, cheap). The long-lived caches (plan cache + donated
+    accumulators, cube cache, segment device columns) are deliberately
+    NOT evicted — they are the suite's compile/upload warmth — so their
+    accounting restarts at zero each test; devmem.remove tolerates
+    untracked keys by design, and reconciliation tests build their own
+    entries."""
+    yield
+    from pinot_tpu.engine.batch import clear_stack_cache
+    from pinot_tpu.utils.devmem import global_device_memory
+    from pinot_tpu.utils.heat import global_segment_heat
+    global_segment_heat.clear()
+    clear_stack_cache()
+    global_device_memory.clear()

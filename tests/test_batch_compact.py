@@ -124,3 +124,67 @@ def test_stack_cache_not_fooled_by_recurring_segment_names(tmp_path):
             f"{tbl}: stacked columns served another table's data"
         results.append(res.rows)
     assert results[0] != results[1]
+
+
+def test_stack_cache_lru_mutation_holds_lock():
+    """The stacked-column cache is hit from broker pool / scheduler
+    worker threads while evict_stacks_containing runs on the reload
+    path; OrderedDict LRU mutation (move_to_end/popitem) is a
+    multi-step linked-list relink that is NOT GIL-atomic (the
+    segdir._CACHE_LOCK lesson, resurfaced by concur CC201). Pinned by
+    lock-assertion: every cache mutation must hold _STACK_LOCK."""
+    from collections import OrderedDict
+
+    import jax.numpy as jnp
+
+    from pinot_tpu.engine import batch as eb
+
+    class _Seg:
+        def __init__(self, uid, name):
+            self.uid, self.name = uid, name
+
+        def device_col(self, col, bucket):
+            return jnp.zeros((bucket,), jnp.int32)
+
+    class _Plan:
+        col_names = ("c0",)
+
+        def __init__(self, uid, name):
+            self.segment = _Seg(uid, name)
+
+    class _Guarded(OrderedDict):
+        def _check(self):
+            assert eb._STACK_LOCK.locked(), \
+                "stack-cache LRU mutated without _STACK_LOCK"
+
+        def __setitem__(self, k, v):
+            self._check()
+            OrderedDict.__setitem__(self, k, v)
+
+        def __delitem__(self, k):
+            self._check()
+            OrderedDict.__delitem__(self, k)
+
+        def move_to_end(self, k, last=True):
+            self._check()
+            OrderedDict.move_to_end(self, k, last)
+
+        def popitem(self, last=True):
+            self._check()
+            return OrderedDict.popitem(self, last)
+
+    saved = eb._STACK_CACHE
+    eb._STACK_CACHE = _Guarded()
+    try:
+        plans = [_Plan(990001, "seg_lockpin")]
+        cols = eb._stacked_cols(plans, 8)
+        assert eb._stacked_cols(plans, 8) is cols   # hit: move_to_end
+        # overflow the LRU so the popitem eviction path runs too
+        for i in range(eb._STACK_CACHE_MAX + 2):
+            eb._stacked_cols([_Plan(990100 + i, f"s{i}")], 8)
+        assert len(eb._STACK_CACHE) <= eb._STACK_CACHE_MAX
+        eb.evict_stacks_containing("seg_lockpin")   # reload-path delete
+        assert all(n != "seg_lockpin"
+                   for k in eb._STACK_CACHE for _u, n in k[0])
+    finally:
+        eb._STACK_CACHE = saved

@@ -223,10 +223,9 @@ def test_check_ledger_reports_fleet_rollup_kind(tmp_path, capsys):
 
 @pytest.fixture()
 def fleet(tmp_path):
-    # the heat registry is process-global: after a long suite run it
-    # holds hundreds of hotter segments from earlier tests that would
-    # crowd "ft" out of the top-N rankings this smoke asserts on
-    global_segment_heat.clear()
+    # (the autouse conftest fixture resets the process-global heat
+    # registry between tests, so earlier tests' hotter segments can't
+    # crowd "ft" out of the top-N rankings this smoke asserts on)
     schema = Schema("ft", [
         FieldSpec("k", DataType.INT, FieldType.DIMENSION),
         FieldSpec("v", DataType.INT, FieldType.METRIC)])
@@ -440,7 +439,6 @@ def test_cube_cache_pool_tracks_bytes():
 # ---------------------------------------------------------------------------
 
 def test_segment_heat_touches_and_device_hit_ratio(tmp_path):
-    global_segment_heat.clear()
     schema = Schema("hot", [FieldSpec("k", DataType.INT),
                             FieldSpec("v", DataType.INT,
                                       FieldType.METRIC)])
@@ -598,3 +596,49 @@ def test_update_stamps_env_header(tmp_path, capsys):
     rc = span_diff.main(["update", led, "--baseline", out_baseline])
     capsys.readouterr()
     assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# round-15 concurrency fix pin (concur CC201): rollup cursor guard
+# ---------------------------------------------------------------------------
+
+def test_rollup_cursor_mutation_holds_serving_lock(tmp_path, monkeypatch):
+    """The per-node pull cursors are SERVED by snapshot() (GET
+    /debug/fleet copies the dict under ``_lock``) while ``_run_locked``
+    advances them mid-pass under ``_run_lock`` only — two different
+    locks guarding one dict (concur CC201 mixed-guard), so a /debug/
+    fleet hit during a pull could observe a resizing dict and raise.
+    Pinned by lock-assertion: every cursor mutation must hold the
+    serving lock."""
+    import threading
+    import time as _time
+
+    from pinot_tpu.cluster import rollup as R
+
+    class _Ctrl:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self.heartbeat_timeout = 60.0
+            self._instances = {
+                "b1": {"id": "b1", "role": "broker", "host": "h",
+                       "port": 12345,
+                       "lastHeartbeat": _time.monotonic()}}
+
+    task = R.ForensicsRollupTask(
+        _Ctrl(), ledger_path=str(tmp_path / "fleet_ledger.jsonl"))
+
+    class _Guarded(dict):
+        def __setitem__(self, key, value):
+            assert task._lock.locked(), \
+                "rollup cursor mutated without the serving lock"
+            dict.__setitem__(self, key, value)
+
+    task._cursors = _Guarded()
+    monkeypatch.setattr(
+        R, "http_json",
+        lambda *a, **k: {"records": [], "nextSeq": 7, "role": "broker",
+                        "proc": "p1"})
+    task.run()
+    assert dict(task._cursors) == {"b1": 7}
+    # the served copy agrees and is taken under the same lock
+    assert task.snapshot()["cursors"] == {"b1": 7}

@@ -162,3 +162,58 @@ def test_time_threshold_seal(schema, tmp_path):
     dm._maybe_seal(0)
     assert dm.num_segments == 1
     assert dm.consuming_docs == 0
+
+
+def test_freshness_owner_registry_excludes_replicas():
+    """_FRESHNESS_OWNERS is PROCESS-global, but its writes were
+    'guarded' by each replica's own _stats_lock — two replicas hold two
+    different locks, which excludes nothing — and stop()'s owner
+    check-then-act ran with no lock at all (concur CC201/CC205): a
+    stopping replica racing a live replica's write could delete the
+    gauge the live one had just refreshed. Pinned two ways: every
+    owner-registry access must hold the module _FRESHNESS_LOCK, and the
+    stop()-vs-write interleaving keeps the live replica's gauge."""
+    import threading
+
+    from pinot_tpu.realtime import manager as M
+    from pinot_tpu.utils.metrics import global_metrics
+
+    def bare(table):
+        m = object.__new__(RealtimeTableDataManager)
+        m.table_name = table
+        m._stats_lock = threading.Lock()
+        m._stats = {"rows": 0}
+        m._freshness_ms = None
+        m._ingest_t0 = None
+        m._stop = threading.Event()
+        m._threads = []
+        return m
+
+    class _Guarded(dict):
+        def _check(self):
+            assert M._FRESHNESS_LOCK.locked(), \
+                "_FRESHNESS_OWNERS accessed without _FRESHNESS_LOCK"
+
+        def __setitem__(self, k, v):
+            self._check()
+            dict.__setitem__(self, k, v)
+
+        def pop(self, k, *d):
+            self._check()
+            return dict.pop(self, k, *d)
+
+    saved = M._FRESHNESS_OWNERS
+    M._FRESHNESS_OWNERS = _Guarded()
+    gname = "ingest_freshness_ms_t_owner_pin"
+    try:
+        a, b = bare("t_owner_pin"), bare("t_owner_pin")
+        a._note_batch(1, time.monotonic())
+        b._note_batch(1, time.monotonic())   # B is now the owner
+        a.stop(timeout=0.1)                  # stale replica stops
+        # the live replica's gauge survived A's owner-guarded removal
+        assert gname in global_metrics.snapshot()["gauges"]
+        b.stop(timeout=0.1)                  # the owner stops
+        assert gname not in global_metrics.snapshot()["gauges"]
+        assert gname not in M._FRESHNESS_OWNERS
+    finally:
+        M._FRESHNESS_OWNERS = saved

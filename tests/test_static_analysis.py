@@ -510,7 +510,8 @@ def test_check_static_update_baseline_keeps_parse_errors_red(
     broken = jaxlint.lint_source("def broken(:\n", "pinot_tpu/x.py")
     monkeypatch.setattr(check_static, "BASELINE",
                         str(tmp_path / "base.json"))
-    monkeypatch.setattr(jaxlint, "lint_tree", lambda root: broken)
+    monkeypatch.setattr(jaxlint, "lint_tree_ex",
+                        lambda root: (broken, []))
     # the re-ratchet run itself must stay red on an unparseable module
     assert check_static.main(["--lint-only", "--update-baseline"]) == 1
     assert "parse-error" in capsys.readouterr().out
@@ -531,3 +532,544 @@ def test_check_static_cli_fails_on_drift(monkeypatch, tmp_path, capsys):
     monkeypatch.setattr(check_static, "BASELINE", str(empty))
     assert check_static.main(["--lint-only"]) == 1
     assert "NEW" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# concurrency verifier (analysis/concur.py, CC201-CC205)
+# ---------------------------------------------------------------------------
+
+from pinot_tpu.analysis import concur  # noqa: E402
+
+CMOD = "pinot_tpu/cluster/somemod.py"
+
+
+def _concur(src, path=CMOD):
+    findings, _sup = concur.analyze_source(src, path)
+    return findings
+
+
+def _crules(findings):
+    return {f.rule for f in findings}
+
+
+def test_cc201_unlocked_mutation_site():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.hits = 0\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self.hits += 1\n"
+           "    def b(self):\n"
+           "        self.hits += 1\n")
+    fs = _concur(src)
+    assert [(f.rule, f.line, f.scope) for f in fs] == \
+        [("CC201", 10, "C.b")]
+    # __init__ is exempt: construction precedes sharing
+    assert all(f.line != 5 for f in fs)
+
+
+def test_cc201_read_under_different_lock():
+    """The rollup-cursor shape: state mutated under lock A, served
+    under lock B — neither lock excludes the other."""
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._a = threading.Lock()\n"
+           "        self._b = threading.Lock()\n"
+           "        self._d = {}\n"
+           "    def writer(self, k, v):\n"
+           "        with self._a:\n"
+           "            self._d[k] = v\n"
+           "    def reader(self):\n"
+           "        with self._b:\n"
+           "            return dict(self._d)\n")
+    fs = _concur(src)
+    assert [(f.rule, f.line, f.scope) for f in fs] == \
+        [("CC201", 12, "C.reader")]
+    assert "read under" in fs[0].message
+
+
+def test_cc201_unguarded_ordereddict_lru():
+    """The engine/batch._STACK_CACHE shape: a shared OrderedDict whose
+    LRU ops (multi-step linked-list relinks, not GIL-atomic) run with
+    no lock anywhere in sight."""
+    src = ("from collections import OrderedDict\n"
+           "_CACHE = OrderedDict()\n"
+           "def get(key):\n"
+           "    hit = _CACHE.get(key)\n"
+           "    if hit is not None:\n"
+           "        _CACHE.move_to_end(key)\n"
+           "    return hit\n"
+           "def put(key, v):\n"
+           "    _CACHE[key] = v\n"
+           "    while len(_CACHE) > 4:\n"
+           "        _CACHE.popitem(last=False)\n")
+    fs = _concur(src)
+    assert [(f.rule, f.line) for f in fs] == \
+        [("CC201", 6), ("CC201", 11)]
+    assert "not GIL-atomic" in fs[0].message
+    # the same LRU fully under a module lock is clean
+    clean = ("from collections import OrderedDict\n"
+             "import threading\n"
+             "_CACHE = OrderedDict()\n"
+             "_L = threading.Lock()\n"
+             "def get(key):\n"
+             "    with _L:\n"
+             "        hit = _CACHE.get(key)\n"
+             "        if hit is not None:\n"
+             "            _CACHE.move_to_end(key)\n"
+             "    return hit\n"
+             "def put(key, v):\n"
+             "    with _L:\n"
+             "        _CACHE[key] = v\n"
+             "        while len(_CACHE) > 4:\n"
+             "            _CACHE.popitem(last=False)\n")
+    assert _concur(clean) == []
+
+
+def test_cc201_module_global_mixed_guard():
+    """The manager._FRESHNESS_OWNERS shape: a module-global dict
+    mutated under a lock at one site and without it at another."""
+    src = ("import threading\n"
+           "_OWNERS = {}\n"
+           "class M:\n"
+           "    def __init__(self):\n"
+           "        self._stats_lock = threading.Lock()\n"
+           "    def write(self, g):\n"
+           "        with self._stats_lock:\n"
+           "            _OWNERS[g] = id(self)\n"
+           "    def stop(self, g):\n"
+           "        if _OWNERS.get(g) == id(self):\n"
+           "            _OWNERS.pop(g, None)\n")
+    fs = _concur(src)
+    assert ("CC205", 10) in {(f.rule, f.line) for f in fs}
+    assert ("CC201", 11) in {(f.rule, f.line) for f in fs}
+
+
+def test_cc202_blocking_under_lock_direct_and_transitive():
+    src = ("import threading, time\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def direct(self):\n"
+           "        with self._lock:\n"
+           "            time.sleep(0.1)\n"
+           "    def _slow_rpc(self):\n"
+           "        return http_json('GET', 'http://x')\n"
+           "    def indirect(self):\n"
+           "        with self._lock:\n"
+           "            self._slow_rpc()\n")
+    fs = _concur(src)
+    got = {(f.rule, f.line) for f in fs}
+    assert ("CC202", 7) in got, fs       # time.sleep under lock
+    assert ("CC202", 12) in got, fs      # transitive via _slow_rpc
+    assert any("_slow_rpc" in f.message for f in fs)
+    # the same calls outside the lock are clean
+    clean = ("import threading, time\n"
+             "class C:\n"
+             "    def __init__(self):\n"
+             "        self._lock = threading.Lock()\n"
+             "    def ok(self):\n"
+             "        time.sleep(0.1)\n"
+             "        return http_json('GET', 'http://x')\n")
+    assert _concur(clean) == []
+
+
+def test_cc202_future_result_and_device_sync():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def bad(self, fut, arr):\n"
+           "        with self._lock:\n"
+           "            x = fut.result()\n"
+           "            arr.block_until_ready()\n"
+           "            return x\n")
+    fs = _concur(src)
+    assert {(f.rule, f.line) for f in fs} == \
+        {("CC202", 7), ("CC202", 8)}
+
+
+def test_cc203_lock_order_cycle():
+    """A takes its lock then B's; B takes its lock then A's — the
+    classic ABBA deadlock, resolved through corpus-unique method
+    names."""
+    src = ("import threading\n"
+           "class A:\n"
+           "    def __init__(self, other):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.other = other\n"
+           "    def azap(self):\n"
+           "        with self._lock:\n"
+           "            return 1\n"
+           "    def cross_a(self, b):\n"
+           "        with self._lock:\n"
+           "            b.bzap()\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def bzap(self):\n"
+           "        with self._lock:\n"
+           "            return 1\n"
+           "    def cross_b(self, a):\n"
+           "        with self._lock:\n"
+           "            a.azap()\n")
+    fs = _concur(src)
+    assert [f.rule for f in fs] == ["CC203"]
+    assert "A._lock" in fs[0].message and "B._lock" in fs[0].message
+    # one direction only is clean
+    one_way = src.replace("    def cross_b(self, a):\n"
+                          "        with self._lock:\n"
+                          "            a.azap()\n", "")
+    assert _concur(one_way) == []
+
+
+def test_cc203_self_deadlock_through_call():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def outer(self):\n"
+           "        with self._lock:\n"
+           "            self.inner()\n"
+           "    def inner(self):\n"
+           "        with self._lock:\n"
+           "            return 1\n")
+    fs = _concur(src)
+    assert [f.rule for f in fs] == ["CC203"]
+    assert "self-deadlock" in fs[0].message
+    # an RLock is reentrant: same shape, no finding
+    assert _concur(src.replace("threading.Lock()",
+                               "threading.RLock()")) == []
+
+
+def test_cc204_thread_local_escape_and_handoff():
+    src = ("from ..utils.spans import span, span_tracer\n"
+           "class C:\n"
+           "    def scatter(self, pool, srv):\n"
+           "        def call():\n"
+           "            with span('scatter_call', server=srv):\n"
+           "                return 1\n"
+           "        return pool.submit(call)\n")
+    fs = _concur(src)
+    assert [(f.rule, f.line) for f in fs] == [("CC204", 7)]
+    assert "span()" in fs[0].message
+    # rooting its own tree on the pool thread is the explicit handoff
+    handed = ("from ..utils.spans import span, span_tracer\n"
+              "class C:\n"
+              "    def scatter(self, pool, srv):\n"
+              "        def call():\n"
+              "            span_tracer.start('remote')\n"
+              "            with span('scatter_call', server=srv):\n"
+              "                return 1\n"
+              "        return pool.submit(call)\n")
+    assert _concur(handed) == []
+    # threading.Thread(target=...) is a capture site too
+    thr = ("from ..utils.spans import annotate\n"
+           "import threading\n"
+           "class C:\n"
+           "    def go(self):\n"
+           "        def work():\n"
+           "            annotate(x=1)\n"
+           "        t = threading.Thread(target=work)\n"
+           "        t.start()\n")
+    fs = _concur(thr)
+    assert [(f.rule, f.line) for f in fs] == [("CC204", 7)]
+
+
+def test_cc205_check_then_act():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._d = {}\n"
+           "    def locked_put(self, k):\n"
+           "        with self._lock:\n"
+           "            self._d[k] = 1\n"
+           "    def racy_put(self, k):\n"
+           "        if k not in self._d:\n"
+           "            self._d[k] = 1\n")
+    fs = _concur(src)
+    got = {(f.rule, f.line) for f in fs}
+    assert ("CC205", 10) in got
+    # under the inferred guard the same shape is fine; setdefault is
+    # GIL-atomic and exempt by design
+    clean = ("import threading\n"
+             "class C:\n"
+             "    def __init__(self):\n"
+             "        self._lock = threading.Lock()\n"
+             "        self._d = {}\n"
+             "    def locked_put(self, k):\n"
+             "        with self._lock:\n"
+             "            if k not in self._d:\n"
+             "                self._d[k] = 1\n"
+             "    def atomic_put(self, k):\n"
+             "        self._d.setdefault(k, 1)\n")
+    assert _crules(_concur(clean)) <= {"CC201"} and \
+        all(f.rule != "CC205" for f in _concur(clean))
+
+
+def test_concur_caller_holds_lock_inference():
+    """A private method whose every same-class call site holds the lock
+    is analyzed as holding it (the _run_locked idiom) — no annotation
+    required; a second UNLOCKED call site voids the inference."""
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.n = 0\n"
+           "    def bump(self):\n"
+           "        with self._lock:\n"
+           "            self._bump_locked()\n"
+           "    def _bump_locked(self):\n"
+           "        self.n += 1\n")
+    assert _concur(src) == []
+    leaky = src + ("    def oops(self):\n"
+                   "        self._bump_locked()\n")
+    fs = _concur(leaky)
+    assert [(f.rule, f.scope) for f in fs] == \
+        [("CC201", "C._bump_locked")]
+
+
+def test_concur_holds_lock_annotation():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.n = 0\n"
+           "    def bump(self):\n"
+           "        with self._lock:\n"
+           "            self.n += 1\n"
+           "    def entry(self):  # holds-lock: _lock\n"
+           "        self.n += 1\n")
+    assert _concur(src) == []
+    # without the annotation the same source is a CC201
+    bare = src.replace("  # holds-lock: _lock", "")
+    assert [(f.rule, f.scope) for f in _concur(bare)] == \
+        [("CC201", "C.entry")]
+
+
+def test_concur_guarded_by_annotation():
+    # guarded-by: none — single-writer atomic by design, exempt
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.flag = False  # guarded-by: none\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self.flag = True\n"
+           "    def b(self):\n"
+           "        self.flag = False\n")
+    assert _concur(src) == []
+    # guarded-by: <lock> — pins the guard even when inference can't
+    # see a locked mutation site
+    pinned = ("import threading\n"
+              "class C:\n"
+              "    def __init__(self):\n"
+              "        self._lock = threading.Lock()\n"
+              "        self.n = 0  # guarded-by: _lock\n"
+              "    def bump(self):\n"
+              "        self.n += 1\n")
+    fs = _concur(pinned)
+    assert [(f.rule, f.scope) for f in fs] == [("CC201", "C.bump")]
+
+
+def test_concur_suppression_roundtrip():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.hits = 0\n"
+           "    def a(self):\n"
+           "        with self._lock:\n"
+           "            self.hits += 1\n"
+           "    def b(self):\n"
+           "        self.hits += 1  # concur: ok CC201\n")
+    findings, suppressed = concur.analyze_source(src, CMOD)
+    assert findings == []
+    assert [(f.rule, f.line) for f in suppressed] == [("CC201", 10)]
+    # 'all' suppresses every rule on the line
+    src_all = src.replace("# concur: ok CC201", "# concur: ok all")
+    findings, suppressed = concur.analyze_source(src_all, CMOD)
+    assert findings == [] and len(suppressed) == 1
+
+
+def test_concur_parse_error_never_baselined(tmp_path):
+    findings, _sup = concur.analyze_source("def broken(:\n", CMOD)
+    assert [f.rule for f in findings] == ["parse-error"]
+    path = str(tmp_path / "base.json")
+    concur.write_baseline(findings, path)
+    new, _stale = concur.compare_baseline(
+        findings, concur.load_baseline(path))
+    assert [f.rule for f in new] == ["parse-error"]
+
+
+def test_concur_corpus_clean_and_baseline_pinned():
+    """Repo findings must exactly match the checked-in ratchet baseline
+    (tools/concur_baseline.json): new findings fail (fix or
+    consciously re-baseline), counts that drop fail too (ratchet the
+    baseline down so wins stick)."""
+    import time
+    t0 = time.perf_counter()
+    findings, _sup = concur.analyze_tree(REPO)
+    assert time.perf_counter() - t0 < 10.0, \
+        "concur must stay under the 10s tier-1 budget"
+    assert all(f.rule != "parse-error" for f in findings)
+    baseline = concur.load_baseline(
+        os.path.join(REPO, "tools", "concur_baseline.json"))
+    new, stale = concur.compare_baseline(findings, baseline)
+    assert new == [], "\n".join(str(f) for f in new)
+    assert stale == [], stale
+    # the fixed defects stay fixed: no CC201/CC205 anywhere, and the
+    # audited round-14/15 surfaces are completely clean
+    assert all(f.rule == "CC202" for f in findings), \
+        [str(f) for f in findings if f.rule != "CC202"]
+    clean_files = {"pinot_tpu/utils/heat.py", "pinot_tpu/utils/devmem.py",
+                   "pinot_tpu/engine/scheduler.py",
+                   "pinot_tpu/engine/batch.py"}
+    assert not [f for f in findings if f.path in clean_files]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 CLI gate: concur section + --json contract
+# ---------------------------------------------------------------------------
+
+def test_check_static_concur_cli_clean_and_json(capsys):
+    import json as _json
+
+    import check_static
+    assert check_static.main(["--concur-only"]) == 0
+    out = capsys.readouterr().out
+    summary = _json.loads(out.strip().splitlines()[-1])
+    assert summary["ok"] is True
+    assert summary["concur"]["new"] == 0
+    assert summary["concur"]["stale"] == 0
+    # --json: exactly one JSON document with the per-finding detail
+    assert check_static.main(["--concur-only", "--json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    c = doc["concur"]
+    assert set(c["rules"]) <= set(concur.CONCUR_RULES)
+    assert c["baselined"] == c["findings"] - c["new"]
+    assert isinstance(c["detail"]["findings"], list)
+    for f in c["detail"]["findings"]:
+        assert {"rule", "file", "line", "scope",
+                "message", "baselined"} <= set(f)
+    assert isinstance(c["detail"]["suppressed"], list)
+    assert isinstance(c["detail"]["stale"], list)
+
+
+def test_check_static_concur_fails_on_drift(monkeypatch, tmp_path,
+                                            capsys):
+    import check_static
+    empty = tmp_path / "concur_baseline.json"
+    empty.write_text('{"version": 1, "counts": {}}')
+    monkeypatch.setattr(check_static, "CONCUR_BASELINE", str(empty))
+    assert check_static.main(["--concur-only"]) == 1
+    assert "NEW [concur]" in capsys.readouterr().out
+
+
+def test_cc205_ignores_mutation_inside_deferred_closure():
+    """A check whose mutation happens only inside a nested closure
+    (which runs later, typically under its own locking) is not THIS
+    site's check-then-act — the body scan prunes nested defs."""
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._d = {}\n"
+           "    def locked_put(self, k):\n"
+           "        with self._lock:\n"
+           "            self._d[k] = 1\n"
+           "    def maybe_schedule(self, pool, k):\n"
+           "        if k not in self._d:\n"
+           "            def cb():\n"
+           "                self.locked_put(k)\n"
+           "            pool.submit(cb)\n")
+    assert all(f.rule != "CC205" for f in _concur(src))
+
+
+def test_concur_namesake_classes_stay_distinct():
+    """Guard inference, lock nodes and self-call resolution are all
+    module-qualified: an unrelated same-named class's locked mutations
+    must not poison this class's guard map (the corpus has duplicate
+    class names — _Conn, Pred, S)."""
+    prog = concur.Program()
+    prog.add_source(
+        "import threading\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n", "pinot_tpu/a.py")
+    prog.add_source(
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n", "pinot_tpu/b.py")
+    findings, _sup = prog.analyze()
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_cc204_unrelated_bare_helper_is_no_handoff():
+    """Only the real handoff APIs (span_tracer.start, Tracing.register,
+    attach_thread) exempt a closure — a bare call to some unrelated
+    start()/register() helper must not silence the rule."""
+    src = ("from ..utils.spans import span\n"
+           "class C:\n"
+           "    def go(self, pool, srv):\n"
+           "        def call():\n"
+           "            register(srv)\n"
+           "            with span('scatter_call'):\n"
+           "                return 1\n"
+           "        return pool.submit(call)\n")
+    fs = _concur(src)
+    assert [(f.rule, f.line) for f in fs] == [("CC204", 8)]
+
+
+def test_cc203_multi_item_with_orders_like_nested():
+    """`with a, b:` acquires left-to-right while holding a — the ABBA
+    deadlock against a nested `with b: with a:` must be found exactly
+    like the two-statement spelling."""
+    src = ("import threading\n"
+           "_LA = threading.Lock()\n"
+           "_LB = threading.Lock()\n"
+           "def one():\n"
+           "    with _LA, _LB:\n"
+           "        return 1\n"
+           "def two():\n"
+           "    with _LB:\n"
+           "        with _LA:\n"
+           "            return 1\n")
+    fs = _concur(src)
+    assert [f.rule for f in fs] == ["CC203"]
+    assert "_LA" in fs[0].message and "_LB" in fs[0].message
+
+
+def test_concur_inference_converges_on_deep_chains():
+    """Caller-holds inference iterates to the true fixpoint: a chain of
+    private helpers deeper than any fixed round cap still propagates
+    the lock to the deepest mutation (no spurious CC201)."""
+    depth = 14
+    lines = ["import threading",
+             "class C:",
+             "    def __init__(self):",
+             "        self._lock = threading.Lock()",
+             "        self.n = 0",
+             "    def entry(self):",
+             "        with self._lock:",
+             "            self._h0()"]
+    for i in range(depth):
+        lines += [f"    def _h{i}(self):",
+                  f"        self._h{i + 1}()"]
+    lines += [f"    def _h{depth}(self):",
+              "        self.n += 1",
+              "    def other(self):",
+              "        with self._lock:",
+              "            self.n += 1"]
+    assert _concur("\n".join(lines) + "\n") == []

@@ -1,27 +1,33 @@
-"""Static analysis gate: JAX hazard linter + plan-IR verifier.
+"""Static analysis gate: JAX hazard linter + concurrency verifier +
+plan-IR verifier.
 
-Runs both passes of pinot_tpu/analysis and exits non-zero on anything
-new (tier-1 runs this through tests/test_static_analysis.py, alongside
-tools/check_ledger.py):
+Runs the three passes of pinot_tpu/analysis and exits non-zero on
+anything new (tier-1 runs this through tests/test_static_analysis.py,
+alongside tools/check_ledger.py):
 
 1. **Linter** (analysis/jaxlint.py) over the whole pinot_tpu tree.
    Findings are ratcheted against tools/jaxlint_baseline.json: new
    findings above a ``file::scope::rule`` count fail; counts that DROP
    also fail until the baseline is ratcheted down (run with
    ``--update-baseline`` after fixing sites).
-2. **Verifier** (analysis/plan_verify.py) over every plan the planner
-   produces for the full SSB query set (bench.QUERIES), the NYC-taxi
-   set (bench_taxi.QUERIES), and ``--fuzz N`` seeded fuzzer-generated
-   queries (pinot_tpu/tools/fuzzer.py) — all at CI scale, plan-only
-   (no kernels execute). Any diagnostic fails.
+2. **Concurrency verifier** (analysis/concur.py, rules CC201-CC205:
+   mixed-guard, blocking-under-lock, lock-order cycles, thread-local
+   escape, check-then-act) over the whole tree, ratcheted the same way
+   against tools/concur_baseline.json.
+3. **Plan verifier** (analysis/plan_verify.py) over every plan the
+   planner produces for the full SSB query set (bench.QUERIES), the
+   NYC-taxi set (bench_taxi.QUERIES), and ``--fuzz N`` seeded
+   fuzzer-generated queries (pinot_tpu/tools/fuzzer.py) — all at CI
+   scale, plan-only (no kernels execute). Any diagnostic fails.
 
-    python tools/check_static.py [--lint-only|--verify-only]
-                                 [--update-baseline] [--fuzz N]
-
-Prints one summary JSON line last, check_ledger-style.
+Prints one summary JSON line last, check_ledger-style; ``--json``
+instead prints exactly one machine-readable JSON document (per-rule
+finding counts, file/line per finding, suppressed/baselined split per
+pass) so CI and the builder can diff findings across PRs.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -35,33 +41,76 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 BASELINE = os.path.join(REPO, "tools", "jaxlint_baseline.json")
+CONCUR_BASELINE = os.path.join(REPO, "tools", "concur_baseline.json")
 FUZZ_SEED = 20260804
+
+EXIT_CODES = """\
+exit codes:
+  0  clean: no findings beyond the committed ratchet baselines, no
+     stale baseline counts, no plan diagnostics or coverage failures
+  1  gate failure: new lint/concur findings above a baseline count, a
+     baseline count that no longer matches (ratchet it down), a plan
+     verifier diagnostic, or lost corpus coverage
+  2  usage error (bad arguments)
+
+The two ratchet baselines (tools/jaxlint_baseline.json,
+tools/concur_baseline.json) grandfather true-but-benign findings per
+file::scope::rule; regenerate with --update-baseline (combine with
+--lint-only / --concur-only to re-ratchet one of them)."""
+
+
+def _ratchet_pass(findings, suppressed, baseline_path, update, label,
+                  write_baseline):
+    """Shared jaxlint/concur ratchet flow -> summary dict (+ the
+    machine-readable details for --json)."""
+    from pinot_tpu.analysis import jaxlint
+
+    if update:
+        write_baseline(findings, baseline_path)
+    baseline = jaxlint.load_baseline(baseline_path)
+    new, stale = jaxlint.compare_baseline(findings, baseline)
+    for f in new:
+        print(f"NEW [{label}] {f}")
+    for key, allowed, actual in stale:
+        print(f"STALE [{label}] {key}: baseline {allowed}, found "
+              f"{actual} — ratchet down with --update-baseline")
+    rules: dict = {}
+    for f in findings:
+        rules[f.rule] = rules.get(f.rule, 0) + 1
+    out = {"findings": len(findings), "new": len(new),
+           "stale": len(stale), "suppressed": len(suppressed),
+           "baselined": len(findings) - len(new), "rules": rules}
+    if update:
+        out["updated"] = True
+    out["_details"] = {
+        "findings": [{"rule": f.rule, "file": f.path, "line": f.line,
+                      "scope": f.scope, "message": f.message,
+                      "baselined": f not in new}
+                     for f in findings],
+        "suppressed": [{"rule": f.rule, "file": f.path, "line": f.line,
+                        "scope": f.scope} for f in suppressed],
+        "stale": [{"key": k, "baseline": a, "found": n}
+                  for k, a, n in stale],
+    }
+    return out
 
 
 def run_lint(update_baseline: bool = False) -> dict:
     from pinot_tpu.analysis import jaxlint
 
-    findings = jaxlint.lint_tree(REPO)
-    if update_baseline:
-        jaxlint.write_baseline(findings, BASELINE)
-        # re-compare against the freshly written baseline: parse-error
-        # findings are never written into it, so an unparseable module
-        # keeps the gate red even on the re-ratchet run itself
-        baseline = jaxlint.load_baseline(BASELINE)
-        new, stale = jaxlint.compare_baseline(findings, baseline)
-        for f in new:
-            print(f"NEW {f}")
-        return {"findings": len(findings), "new": len(new),
-                "stale": len(stale), "updated": True}
-    baseline = jaxlint.load_baseline(BASELINE)
-    new, stale = jaxlint.compare_baseline(findings, baseline)
-    for f in new:
-        print(f"NEW {f}")
-    for key, allowed, actual in stale:
-        print(f"STALE {key}: baseline {allowed}, found {actual} — "
-              "ratchet down with --update-baseline")
-    return {"findings": len(findings), "new": len(new),
-            "stale": len(stale)}
+    findings, suppressed = jaxlint.lint_tree_ex(REPO)
+    return _ratchet_pass(findings, suppressed, BASELINE,
+                         update_baseline, "jaxlint",
+                         jaxlint.write_baseline)
+
+
+def run_concur(update_baseline: bool = False) -> dict:
+    from pinot_tpu.analysis import concur
+
+    findings, suppressed = concur.analyze_tree(REPO)
+    return _ratchet_pass(findings, suppressed, CONCUR_BASELINE,
+                         update_baseline, "concur",
+                         concur.write_baseline)
 
 
 def _verify_corpus(label: str, segment, sqls, counts: dict,
@@ -146,6 +195,14 @@ def _run_verify(fuzz_n: int) -> dict:
         print(f"DIAG [{label}] {d}\n  query: {sql}")
     for label, sql, d in warns:
         print(f"WARN [{label}] {d}\n  query: {sql}")
+    detail = {
+        "diagnostics": [{"corpus": lb, "rule": d.rule, "path": d.path,
+                         "message": d.message, "query": s}
+                        for lb, s, d in diags],
+        "warnings": [{"corpus": lb, "rule": d.rule, "path": d.path,
+                      "message": d.message, "query": s}
+                     for lb, s, d in warns],
+    }
 
     # anti-vacuous-pass floors: zero diagnostics only counts if the
     # verifier actually saw the plans it claims to cover. Every SSB and
@@ -169,6 +226,7 @@ def _run_verify(fuzz_n: int) -> dict:
             "plan — generator or planner drift gutted coverage")
     for msg in coverage:
         print(f"COVERAGE {msg}")
+    detail["coverage"] = coverage
 
     out = {"queries": 0, "plans": 0, "skipped": 0, "device_plans": 0}
     for c in corpora.values():
@@ -177,31 +235,79 @@ def _run_verify(fuzz_n: int) -> dict:
     out["diagnostics"] = len(diags)
     out["warnings"] = len(warns)
     out["coverage_failures"] = len(coverage)
+    out["_details"] = detail
     return out
 
 
 def main(argv=None) -> int:
-    args = list(sys.argv[1:] if argv is None else argv)
-    update = "--update-baseline" in args
-    lint_only = "--lint-only" in args
-    verify_only = "--verify-only" in args
-    fuzz_n = 150
-    if "--fuzz" in args:
-        fuzz_n = int(args[args.index("--fuzz") + 1])
+    ap = argparse.ArgumentParser(
+        prog="check_static.py",
+        description=__doc__,
+        epilog=EXIT_CODES,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    only = ap.add_mutually_exclusive_group()
+    only.add_argument("--lint-only", action="store_true",
+                      help="run only the jaxlint pass")
+    only.add_argument("--concur-only", action="store_true",
+                      help="run only the concurrency verifier pass")
+    only.add_argument("--verify-only", action="store_true",
+                      help="run only the plan-IR verifier pass")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-ratchet the baseline(s) of the passes "
+                         "being run (jaxlint and/or concur), then "
+                         "re-compare; parse errors stay red")
+    ap.add_argument("--fuzz", type=int, default=150, metavar="N",
+                    help="fuzzer queries for the plan verifier "
+                         "(default 150)")
+    ap.add_argument("--json", action="store_true",
+                    help="print exactly one machine-readable JSON "
+                         "document (per-rule counts, file/line per "
+                         "finding, suppressed/baselined split) "
+                         "instead of the line-oriented report")
+    args = ap.parse_args(argv)
+
+    # --json buffers the human chatter so stdout is ONE JSON document
+    out_buf = None
+    real_stdout = sys.stdout
+    if args.json:
+        import io
+        out_buf = io.StringIO()
+        sys.stdout = out_buf
 
     summary: dict = {}
     rc = 0
-    if not verify_only:
-        summary["lint"] = run_lint(update)
-        if summary["lint"].get("new") or summary["lint"].get("stale"):
-            rc = 1
-    if not lint_only:
-        summary["verify"] = run_verify(fuzz_n)
-        if summary["verify"]["diagnostics"] or \
-                summary["verify"]["coverage_failures"]:
-            rc = 1
+    try:
+        if not (args.verify_only or args.concur_only):
+            summary["lint"] = run_lint(args.update_baseline)
+            if summary["lint"]["new"] or summary["lint"]["stale"]:
+                rc = 1
+        if not (args.verify_only or args.lint_only):
+            summary["concur"] = run_concur(args.update_baseline)
+            if summary["concur"]["new"] or summary["concur"]["stale"]:
+                rc = 1
+        if not (args.lint_only or args.concur_only):
+            summary["verify"] = run_verify(args.fuzz)
+            if summary["verify"]["diagnostics"] or \
+                    summary["verify"]["coverage_failures"]:
+                rc = 1
+    finally:
+        if out_buf is not None:
+            sys.stdout = real_stdout
     summary["ok"] = rc == 0
-    print(json.dumps(summary))
+    if args.json:
+        # scalar counts stay as-is; the per-finding records (file/line/
+        # rule/scope, suppressed/stale splits, plan diagnostics and
+        # coverage messages) land under "detail" — a failing run must
+        # be actionable from the JSON alone, since the line report was
+        # swallowed by the buffer
+        for sec in ("lint", "concur", "verify"):
+            if sec in summary and "_details" in summary[sec]:
+                summary[sec]["detail"] = summary[sec].pop("_details")
+        print(json.dumps(summary, indent=1))
+    else:
+        for sec in ("lint", "concur", "verify"):
+            summary.get(sec, {}).pop("_details", None)
+        print(json.dumps(summary))
     return rc
 
 
