@@ -422,9 +422,78 @@ def span_regression_gate(ledger_path: str | None = None,
         return {"ok": True, "skipped": f"{type(e).__name__}: {e}"}
 
 
+def freshness_regression_gate(ledger_path: str | None = None,
+                              capture_if_empty: bool = True,
+                              baseline_path: str | None = None
+                              ) -> dict | None:
+    """tools/freshness_gate.py check vs the checked-in
+    tools/freshness_baseline.json — the ingest-freshness ratchet, run at
+    bench time beside the span gate. Checks ``ledger_path``'s
+    ingest_bench records when they overlap the baseline's scenarios
+    (bench_ingest.py runs land there); other benches' ledgers carry
+    none, so the gate then captures a fresh gate-corpus run
+    (freshness_gate capture — the same deterministic loadgen scenario
+    the baseline was built from) and checks that. Returns the check
+    summary, or None when there is no baseline (vacuous pass)."""
+    baseline = baseline_path or os.path.join(REPO, "tools",
+                                             "freshness_baseline.json")
+    ledger_path = ledger_path or LEDGER
+    if not os.path.exists(baseline):
+        return None
+    fgate = os.path.join(REPO, "tools", "freshness_gate.py")
+
+    def run_check(path: str) -> dict:
+        proc = subprocess.run(
+            [sys.executable, fgate, "check", path,
+             "--baseline", baseline],
+            capture_output=True, text=True, timeout=120)
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        if proc.returncode == 3:
+            # the shared span_diff environment pin (exit 3): baseline
+            # captured under a different backend/x64 — explicit skip,
+            # never a phantom regression
+            return {"ok": True,
+                    "skipped": "environment mismatch vs baseline — "
+                               "re-capture in this environment",
+                    "env_mismatch": summary.get("env_mismatch")}
+        summary["ok"] = proc.returncode == 0
+        return summary
+
+    try:
+        summary = None
+        if os.path.exists(ledger_path):
+            summary = run_check(ledger_path)
+            summary["source"] = "ledger"
+        if capture_if_empty and (
+                summary is None or (not summary.get("scenarios_checked")
+                                    and not summary.get("skipped"))):
+            tmp = os.path.join(
+                tempfile.mkdtemp(prefix="ptpu_fresh_gate_"),
+                "ingest_bench.jsonl")
+            try:
+                env = dict(os.environ)
+                # same engine pin as the span gate's corpus: the
+                # baseline is captured in the tier-1 configuration
+                env["PINOT_CPU_FAST_GROUPBY"] = "0"
+                proc = subprocess.run(
+                    [sys.executable, fgate, "capture",
+                     "--out", tmp, "--iters", "3"],
+                    env=env, capture_output=True, text=True, timeout=300)
+                if proc.returncode != 0:
+                    return {"ok": True, "skipped":
+                            "capture failed: " + proc.stderr[-200:]}
+                summary = run_check(tmp)
+                summary["source"] = "capture"
+            finally:
+                shutil.rmtree(os.path.dirname(tmp), ignore_errors=True)
+        return summary
+    except Exception as e:  # the gate must never lose a capture
+        return {"ok": True, "skipped": f"{type(e).__name__}: {e}"}
+
+
 def finish(out: dict, backend: str, all_ok: bool) -> None:
-    """Shared tail: ledger compare+append, span-diff regression gate,
-    print the ONE JSON line, exit."""
+    """Shared tail: ledger compare+append, span-diff + freshness
+    regression gates, print the ONE JSON line, exit."""
     disarm_capture_guard()
     gate = span_regression_gate()
     if gate is not None:
@@ -436,6 +505,15 @@ def finish(out: dict, backend: str, all_ok: bool) -> None:
             n_reg = len(gate.get("regressions") or [])
             out.setdefault(
                 "error", "span_diff phase-regression gate failed "
+                         f"({n_reg} regression(s))")
+    fgate = freshness_regression_gate()
+    if fgate is not None:
+        out["freshness_gate"] = fgate
+        if not fgate.get("ok", True):
+            all_ok = False
+            n_reg = len(fgate.get("regressions") or [])
+            out.setdefault(
+                "error", "freshness_gate regression gate failed "
                          f"({n_reg} regression(s))")
     prev = ledger_last(out["metric"], backend, out.get("n_rows"))
     d = ledger_deltas(out, prev)

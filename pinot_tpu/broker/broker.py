@@ -317,7 +317,14 @@ class Broker:
         stmt = self._resolve_subqueries(stmt)
         from ..engine.accounting import global_accountant
         from ..multistage.window import has_window
-        query_id = uuid.uuid4().hex[:12]
+        # OPTION(queryId=...) names the accountant registration too (not
+        # just the round-12 sampling decision): chaos tooling needs the
+        # per-query fault streams (utils/faults.py) keyed by a
+        # DETERMINISTIC id so same-seed runs reproduce p<1 draws.
+        # Collisions are the caller's contract — two concurrent queries
+        # sharing a name would share accounting and fault streams.
+        query_id = str(getattr(stmt, "options", {}).get("queryId")
+                       or uuid.uuid4().hex[:12])[:64]
         timeout_ms = int(stmt.options.get("timeoutMs", DEFAULT_TIMEOUT_MS))
         deadline = t0 + timeout_ms / 1e3
         if self._is_hybrid(stmt.table):

@@ -68,16 +68,9 @@ NODE_COUNTER_KEYS = (
 )
 
 
-def _pctl(sorted_vals: List[float], frac: float) -> float:
-    """The registry's percentile definition (utils/metrics.snapshot):
-    p50 = s[n//2], p99 = s[min(n-1, int(n*0.99))] — one definition
-    shared fleet-wide so trend lines are comparable."""
-    if not sorted_vals:
-        return 0.0
-    if frac == 0.5:
-        return sorted_vals[len(sorted_vals) // 2]
-    return sorted_vals[min(len(sorted_vals) - 1,
-                           int(len(sorted_vals) * frac))]
+from ..utils.stats import pctl as _pctl  # noqa: E402 — the ONE fleet
+# percentile definition (utils/metrics snapshots + engine/loadgen
+# ingest-bench percentiles share it so trend lines stay comparable)
 
 
 def _ts_epoch(ts: Any) -> Optional[float]:
@@ -130,11 +123,19 @@ def aggregate_tables(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         if ts is not None:
             e["t_min"] = ts if e["t_min"] is None else min(e["t_min"], ts)
             e["t_max"] = ts if e["t_max"] is None else max(e["t_max"], ts)
-    # latest ingest freshness per table (the freshness ledger)
+    # latest ingest freshness per table (the freshness ledger); round 16
+    # writers (engine/loadgen, bench_ingest) also carry the sustained-run
+    # percentiles — trended per table when present
     freshness: Dict[str, float] = {}
+    fresh_pctl: Dict[str, Dict[str, float]] = {}
     for rec in records:
         if rec.get("kind") == "ingest_stats" and rec.get("table"):
             freshness[rec["table"]] = float(rec.get("freshness_ms", 0.0))
+            pcts = {k: float(rec[k])
+                    for k in ("freshness_p50_ms", "freshness_p99_ms")
+                    if isinstance(rec.get(k), (int, float))}
+            if pcts:
+                fresh_pctl[rec["table"]] = pcts
     out: Dict[str, Any] = {}
     for t, e in sorted(acc.items()):
         walls = sorted(e.pop("walls"))
@@ -154,6 +155,9 @@ def aggregate_tables(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             out[t]["freshness_ms"] = round(freshness[t], 3)
     for t, f in freshness.items():
         out.setdefault(t, {"queries": 0})["freshness_ms"] = round(f, 3)
+    for t, pcts in fresh_pctl.items():
+        out.setdefault(t, {"queries": 0}).update(
+            {k: round(v, 3) for k, v in pcts.items()})
     return out
 
 

@@ -156,10 +156,12 @@ class ResourceAccountant:
         if u.killed_reason is None:
             # deterministic chaos hook: behave exactly as the HeapWatcher
             # would under heap pressure — flag the query, count the kill,
-            # raise at this (the query's own) sample point. Decides on
-            # the process-global "" stream (query ids are random, so
-            # keying by them would break same-seed determinism); the id
-            # rides along as the logged detail only
+            # raise at this (the query's own) sample point. The site key
+            # stays "": decide() partitions the stream by the OWNING
+            # query id (this thread is attached to u.query_id), so each
+            # query draws its own hit/fire windows — `times=1` kills
+            # every matching query once, and `match=<queryId>` pins the
+            # kill to one named query
             from ..utils.faults import fault_fires
             if fault_fires("accounting.oom_kill", detail=u.query_id):
                 u.killed_reason = ("injected heap pressure "

@@ -39,10 +39,14 @@ the deadline check. Every query wraps its wait + dispatch in a
 so per-query wall attribution survives the fusion, and the accountant
 carries batched/batch_size per query for the query_stats ledger.
 
-Disabled by default (PINOT_MICROBATCH=1, Broker(micro_batch=True) or
-configure() turn it on): fused compositions depend on arrival timing,
-so chaos plans that pin same-seed *fault streams* must opt in with a
-deterministic composition (tests barrier their submissions).
+ENABLED by default since round 16 (PINOT_MICROBATCH=0,
+Broker(micro_batch=False) or configure() turn it off). Batching was
+opt-in through rounds 13-15 because fused compositions depend on
+arrival timing and the fault registry's process-global per-site hit
+counters made chaos decisions composition-sensitive; utils/faults.py
+now keys decision streams by (owning query id, site key), so a query's
+same-seed fault stream is identical whether its peers fused, ran solo,
+or interleaved arbitrarily — chaos soaks run with batching armed.
 """
 from __future__ import annotations
 
@@ -452,6 +456,12 @@ def _pow2(n: int) -> int:
 # the batcher
 # ---------------------------------------------------------------------------
 
+def default_enabled() -> bool:
+    """The process-default batching switch: ON unless PINOT_MICROBATCH=0
+    (flipped from opt-in in round 16 — module docstring)."""
+    return os.environ.get("PINOT_MICROBATCH") != "0"
+
+
 class _Submission:
     __slots__ = ("plans", "resolved", "future", "query_id", "t0",
                  "n_items", "abandoned")
@@ -477,7 +487,7 @@ class RaggedBatcher:
                  enabled: Optional[bool] = None):
         self.window_ms = window_ms
         self.max_batch = max_batch
-        self.enabled = (os.environ.get("PINOT_MICROBATCH") == "1"
+        self.enabled = (default_enabled()
                         if enabled is None else bool(enabled))
         self.queue = MicroBatchQueue()
         self._lock = threading.Lock()

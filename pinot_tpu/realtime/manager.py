@@ -119,6 +119,12 @@ class RealtimeTableDataManager(TableDataManager):
             "orphans_cleaned": 0, "handoff_retries": 0}
         self._ingest_t0: Optional[float] = None
         self._freshness_ms: Optional[float] = None
+        # commit latency (seal -> durable checkpoint, split-commit RPC
+        # included on the protocol path): EWMA for the ledger +
+        # a bounded raw history for percentile-grade consumers
+        # (engine/loadgen). Both guarded by _stats_lock.
+        self._commit_ewma: Optional[float] = None
+        self._commit_ms_hist: List[float] = []
         self._clean_orphans()
 
         # upsert/dedup metadata, per partition (PKs are partition-local,
@@ -186,6 +192,22 @@ class RealtimeTableDataManager(TableDataManager):
         with self._stats_lock:
             self._stats[name] += n
         global_metrics.count("ingest_" + name, n)
+
+    def _note_commit_ms(self, ms: float) -> None:
+        """One committed segment's seal->checkpoint latency."""
+        with self._stats_lock:
+            e = self._commit_ewma
+            self._commit_ewma = ms if e is None else 0.8 * e + 0.2 * ms
+            self._commit_ms_hist.append(ms)
+            if len(self._commit_ms_hist) > 4096:
+                del self._commit_ms_hist[:2048]
+
+    def commit_latencies(self) -> List[float]:
+        """Raw per-commit latencies (ms, bounded history) — the
+        percentile inputs engine/loadgen aggregates into the
+        ``ingest_bench`` ledger record."""
+        with self._stats_lock:
+            return list(self._commit_ms_hist)
 
     def _clean_orphans(self) -> None:
         """Idempotent-restart hygiene: a crash between the segment build
@@ -511,6 +533,7 @@ class RealtimeTableDataManager(TableDataManager):
             # build-then-commit-then-adopt: local durable state advances
             # ONLY after the controller acknowledged the split commit —
             # a failed commit leaves the mutable live for retry/takeover
+            t_commit = time.monotonic()
             with self._seal_lock:
                 built = self._build_artifact(p)
             if built is None:
@@ -535,6 +558,8 @@ class RealtimeTableDataManager(TableDataManager):
             if ok:
                 with self._seal_lock:
                     self._commit_local(p, mm, seg, sealed)
+                self._note_commit_ms(
+                    (time.monotonic() - t_commit) * 1e3)
             else:
                 # the mutable stays live: the next poll re-reports,
                 # the controller re-elects/continues, and the build
@@ -686,13 +711,15 @@ class RealtimeTableDataManager(TableDataManager):
     def seal_partition(self, p: int) -> Optional[ImmutableSegment]:
         """CONSUMING -> ONLINE: build, swap, checkpoint (standalone
         mode — no controller arbitration)."""
+        t_commit = time.monotonic()
         with self._seal_lock:
             built = self._build_artifact(p)
             if built is None:
                 return None
             m, seg, sealed = built
             self._commit_local(p, m, seg, sealed)
-            return seg
+        self._note_commit_ms((time.monotonic() - t_commit) * 1e3)
+        return seg
 
     # -- background consumption (PartitionConsumer.run analog) -------------
     def start(self) -> None:
@@ -766,6 +793,7 @@ class RealtimeTableDataManager(TableDataManager):
             stats = dict(self._stats)
             t0 = self._ingest_t0
             fresh = self._freshness_ms
+            commit = self._commit_ewma
         elapsed = (time.monotonic() - t0) if t0 is not None else 0.0
         plan = faults.current_plan()
         # every counter in _stats ships under its own name; a new stat
@@ -777,6 +805,7 @@ class RealtimeTableDataManager(TableDataManager):
             "rows_per_s": round(stats["rows"] / elapsed, 3)
             if elapsed > 0 else 0.0,
             "freshness_ms": round(fresh, 3) if fresh is not None else None,
+            "commit_ms": round(commit, 3) if commit is not None else None,
             "segments": self.num_segments,
             "consuming_docs": self.consuming_docs,
             "partitions": len(self._mutables),

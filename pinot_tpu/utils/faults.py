@@ -58,23 +58,44 @@ Activation: ``PINOT_FAULTS`` env var at process start, or
              segment.slow: delay_ms=200, after=1
 
 Per-spec fields: ``p`` fire probability, ``match`` substring filter on
-the site key (server URL, instance id, segment name), ``times`` max
-fires **per site key** (-1 unlimited), ``after`` skip the first N
-matching hits (per key), ``delay_ms``, ``http_status``.
+the stream name (``qid|site-key`` under a query context, else the bare
+site key — server URL, instance id, segment name), ``times`` max fires
+**per stream** (-1 unlimited), ``after`` skip the first N matching
+hits (per stream), ``delay_ms``, ``http_status``.
 
-Determinism: a decision is a pure function of
-``hash(seed, point, key, hit_index)`` — per-(spec, key) hit AND fire
-counters mean background traffic (heartbeats, routing polls) and
-thread interleaving across servers cannot perturb another key's
-decision stream (a shared ``times`` budget would let whichever thread
-reaches the lock first consume it), so the same seed over the same
-per-key call sequence fires the same faults. ``accounting.oom_kill``
-is the one point with no natural stable key: it decides on the
-process-global ``""`` stream (``match`` does not apply; sequential
-queries are deterministic, concurrent ones interleave their sample
-counts). Every fired fault is appended to ``plan.fired`` (under the
-plan lock), annotated onto the active span, and counted in
-``global_metrics`` (``faults_fired`` + ``fault_<point>``).
+Determinism — per-query / per-partition streams (round 16): a decision
+is a pure function of ``hash(seed, point, stream, hit_index)`` where
+the **stream** is ``(owning query id, site key)`` when the calling
+thread executes on behalf of a registered query
+(``engine.accounting.global_accountant.current_query_id()``) and the
+bare site key otherwise (ingest consumer threads, broker scatter pool
+threads — ingest sites embed ``table/partition`` in the key, so those
+are naturally per-partition streams). Hit AND fire counters are kept
+per (spec, stream): background traffic, thread interleaving across
+servers, AND — the round-13 carried item — the micro-batcher's
+admission-window composition cannot perturb another stream's
+decisions, so the same seed fires the same faults for a query whether
+its peers fused, ran solo, or interleaved arbitrarily.
+
+Compat note (pre-round-16 plans): hit/fire/``after``/``times`` windows
+used to be per SITE KEY across the whole process, shared by every
+query touching the site; they are now per (query, site) wherever a
+query context exists, so e.g. ``times=1`` at a query-execution point
+bounds fires *per query*, not per process (``accounting.oom_kill``
+included — it used to decide on one process-global stream). To pin a
+fault to one specific query, name it (``OPTION(queryId=...)``, honored
+by the in-process broker) and use ``match`` — the match filter tests
+the COMPOSITE ``qid|site-key`` stream name. Note that p<1 draws hash
+the stream name, so cross-run reproducibility of probabilistic specs
+at query-context sites requires deterministically named query ids
+(chaos tooling — chaos_smoke, engine/loadgen, bench_ingest — names
+them); ``p=1``/``times``/``after`` specs are reproducible regardless,
+because the per-stream counters do not depend on the id's value.
+
+Every fired fault is appended to ``plan.fired`` (under the plan lock,
+with the owning query id when one exists), annotated onto the active
+span, and counted in ``global_metrics`` (``faults_fired`` +
+``fault_<point>``).
 """
 from __future__ import annotations
 
@@ -114,9 +135,9 @@ class IngestCrash(FaultInjected):
 class FaultSpec:
     point: str
     prob: float = 1.0
-    match: str = ""          # substring of the site key; "" matches all
-    times: int = -1          # max fires per site key; -1 = unlimited
-    after: int = 0           # skip the first N matching hits (per key)
+    match: str = ""          # substring of the stream name; "" = all
+    times: int = -1          # max fires per stream; -1 = unlimited
+    after: int = 0           # skip the first N matching hits (per stream)
     delay_ms: float = 0.0
     http_status: int = 503
 
@@ -152,9 +173,23 @@ def _unit(seed: int, point: str, key: str, hit: int) -> float:
     return int.from_bytes(h[:8], "big") / 2.0 ** 64
 
 
+def _context_query_id() -> str:
+    """The query this thread executes on behalf of, or '' — the stream
+    partitioner for decide(). Lazy import: utils must not pull the
+    engine in at import time (engine.accounting itself imports this
+    module lazily inside sample())."""
+    try:
+        from ..engine.accounting import global_accountant
+    except Exception:  # engine unavailable (stripped install)
+        return ""
+    return global_accountant.current_query_id() or ""
+
+
 class FaultPlan:
-    """One installed chaos plan: specs + seed + per-(spec, key) hit
-    counters + the fired-fault log."""
+    """One installed chaos plan: specs + seed + per-(spec, stream) hit
+    counters + the fired-fault log (stream = (owning query id, site
+    key) where a query context exists, site key alone otherwise — see
+    the module doc)."""
 
     def __init__(self, specs: List[FaultSpec], seed: int = 0):
         self.specs = list(specs)
@@ -178,36 +213,50 @@ class FaultPlan:
 
     def decide(self, point: str, key: str) -> Optional[FaultSpec]:
         """First matching spec that fires for this hit, or None. Pure in
-        (seed, point, key, per-key hit index) — see module doc."""
+        (seed, point, stream, per-stream hit index) where stream =
+        (owning query id | site key) — see module doc. The query id is
+        resolved OUTSIDE the plan lock (the accountant takes its own
+        lock; nesting it under ours would order locks against
+        engine.accounting's internals)."""
+        qid = _context_query_id()
+        stream = f"{qid}|{key}" if qid else key
         fired: Optional[FaultSpec] = None
         with self._lock:
             for i, spec in enumerate(self.specs):
                 if spec.point != point:
                     continue
-                if spec.match and spec.match not in key:
+                if spec.match and spec.match not in stream:
                     continue
-                hit = self._hits.get((i, key), 0)
-                self._hits[(i, key)] = hit + 1
+                hit = self._hits.get((i, stream), 0)
+                self._hits[(i, stream)] = hit + 1
                 if hit < spec.after:
                     continue
-                # fire budget is per (spec, key) like the hit counter: a
-                # shared budget would be consumed by whichever thread
-                # reaches the lock first, breaking same-seed determinism
+                # fire budget is per (spec, stream) like the hit
+                # counter: a shared budget would be consumed by
+                # whichever thread reached the lock first, breaking
+                # same-seed determinism
                 if spec.times >= 0 and \
-                        self._fires.get((i, key), 0) >= spec.times:
+                        self._fires.get((i, stream), 0) >= spec.times:
                     continue
                 if spec.prob < 1.0 and \
-                        _unit(self.seed, point, key, hit) >= spec.prob:
+                        _unit(self.seed, point, stream, hit) >= spec.prob:
                     continue
-                self._fires[(i, key)] = self._fires.get((i, key), 0) + 1
-                self.fired.append({"point": point, "key": key, "hit": hit})
+                self._fires[(i, stream)] = \
+                    self._fires.get((i, stream), 0) + 1
+                entry = {"point": point, "key": key, "hit": hit}
+                if qid:
+                    entry["q"] = qid
+                self.fired.append(entry)
                 fired = spec
                 break
         return fired
 
     def fired_summary(self) -> List[Tuple[str, str, int]]:
         """Order-independent view of the fired log (threads race on
-        append order; (point, key, hit) triples do not)."""
+        append order; (point, key, per-stream hit) triples do not —
+        and they stay comparable across runs even when query ids are
+        random, because the triple carries the SITE key while the hit
+        index comes from the owning stream's own counter)."""
         with self._lock:
             return sorted((f["point"], f["key"], f["hit"])
                           for f in self.fired)

@@ -29,6 +29,11 @@ Kinds:
   freshness ledger (rows/sec, end-to-end freshness ms, commit retries,
   rebalance/replay/orphan recovery counts, faults fired) — the ingest
   plane's first-class counterpart to query latency.
+- ``ingest_bench``     — bench_ingest.py / pinot_tpu/engine/loadgen.py
+  sustained ingest-while-query harness headlines (rows/s per partition,
+  freshness p50/p99, commit latency, query p50/p99 under ingest
+  pressure, chaos seed, batched flag) — tools/freshness_gate.py
+  ratchets these against tools/freshness_baseline.json.
 - ``fleet_rollup``     — cluster/rollup.py ForensicsRollupTask: the
   controller's cluster-wide aggregation over the per-node ledgers it
   pulls (per-table fleet stats, hot-segment heat ranking, per-node
@@ -113,14 +118,41 @@ KINDS: Dict[str, Dict[str, set]] = {
         # EWMA), commit retries and faults fired — chaos soaks trend
         # these the way query_stats trends the scatter plane.
         # faults_fired is the installed plan's PROCESS-WIDE total (no
-        # per-table attribution); chaos runs override it per run
+        # per-table attribution); chaos runs override it per run.
+        # commit_ms: seal->checkpoint latency EWMA (round 16);
+        # freshness_p50_ms/p99_ms: per-table percentiles over a
+        # sustained run's freshness samples (engine/loadgen writers) —
+        # the fleet rollup trends them per table when present
         "required": {"table", "rows", "rows_per_s", "freshness_ms",
                      "commits", "commit_retries", "faults_fired"},
         "optional": {"commit_failures", "rebalance_resets",
                      "stream_retries", "upsert_replays",
                      "orphans_cleaned", "handoff_retries", "segments",
                      "consuming_docs", "partitions", "restarts", "seed",
-                     "backend", "extra"},
+                     "backend", "extra", "commit_ms",
+                     "freshness_p50_ms", "freshness_p99_ms"},
+    },
+    "ingest_bench": {
+        # one sustained ingest-while-query harness run (bench_ingest.py
+        # / pinot_tpu/engine/loadgen.py): multi-partition ingest through
+        # the wire-protocol consumers concurrent with a broker query
+        # mix, chaos-armed — the freshness-vs-throughput headline the
+        # way bench_capture is the latency headline. ``scenario`` keys
+        # the freshness-gate ratchet (tools/freshness_gate.py) the way
+        # normalized SQL keys span_diff; ``duration_s`` is the run wall
+        # the gate's speed calibration divides by; ``batched`` records
+        # whether the micro-batcher was armed; ``seed`` is the chaos /
+        # row-generation seed; ``oracle_ok`` = final queryable state
+        # byte-identical to the fault-free oracle
+        "required": {"backend", "ok", "scenario", "seed", "tables",
+                     "partitions", "rows", "rows_per_s", "duration_s",
+                     "freshness_p50_ms", "freshness_p99_ms",
+                     "queries_concurrent", "batched"},
+        "optional": {"rows_per_s_per_partition", "commit_p50_ms",
+                     "commit_p99_ms", "commits", "queries",
+                     "query_p50_ms", "query_p99_ms", "query_errors",
+                     "faults_fired", "restarts", "chaos", "oracle_ok",
+                     "per_table", "freshness_gate", "error", "extra"},
     },
     "fleet_rollup": {
         # one controller rollup pass (cluster/rollup.py): pull health

@@ -13,6 +13,19 @@ import threading
 from typing import Callable, Dict
 
 
+def pctl(sorted_vals, frac: float) -> float:
+    """The ONE fleet percentile definition (p50 = s[n//2], p99 =
+    s[min(n-1, int(n*0.99))]) — utils/metrics snapshots,
+    cluster/rollup fleet aggregation and engine/loadgen ingest-bench
+    percentiles all share it so trend lines stay comparable."""
+    if not sorted_vals:
+        return 0.0
+    if frac == 0.5:
+        return sorted_vals[len(sorted_vals) // 2]
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(len(sorted_vals) * frac))]
+
+
 def make_bump(stats: Dict[str, int]) -> Callable[[str], None]:
     lock = threading.Lock()
 

@@ -112,6 +112,39 @@ def test_after_and_times_windows():
     assert hits2 == [False, False, True, True, False, False]
 
 
+def test_per_query_stream_keying():
+    """Round-16 rekeying: a thread executing on behalf of a registered
+    query draws from its own (query-id, site-key) stream — hit/fire
+    windows and ``match`` are per query, so another query's (or
+    no-context) traffic at the same site cannot perturb them."""
+    from pinot_tpu.engine.accounting import global_accountant
+    p = faults.FaultPlan.parse("seed=2; rpc.drop: times=1")
+    # no query context: one shared per-site stream (pre-round-16 shape)
+    assert p.decide("rpc.drop", "k") is not None
+    assert p.decide("rpc.drop", "k") is None        # site budget spent
+    # under a query context the same site is a FRESH stream per query
+    global_accountant.register("qa")
+    try:
+        assert p.decide("rpc.drop", "k") is not None
+        assert p.decide("rpc.drop", "k") is None    # qa's budget spent
+    finally:
+        global_accountant.unregister("qa")
+    global_accountant.register("qb")
+    try:
+        assert p.decide("rpc.drop", "k") is not None  # qb unaffected
+        # the fired log carries the owning query; the summary stays
+        # site-keyed with per-stream hit indices (cross-run comparable
+        # even when query ids are random)
+        assert [f.get("q") for f in p.fired] == [None, "qa", "qb"]
+        assert p.fired_summary() == [("rpc.drop", "k", 0)] * 3
+        # match tests the composite stream name: pin to one named query
+        p2 = faults.FaultPlan.parse("seed=2; rpc.drop: match=qb|")
+        assert p2.decide("rpc.drop", "k") is not None
+    finally:
+        global_accountant.unregister("qb")
+    assert p2.decide("rpc.drop", "k") is None       # no context: no match
+
+
 def test_inactive_is_noop():
     assert not faults.active()
     faults.fault_point("rpc.drop", "anything")      # must not raise
@@ -332,17 +365,25 @@ def test_oom_kill_recovery(cluster):
     ctrl, servers, broker, data = cluster
     _reset_broker(broker)
     k0 = _counter("queries_killed_oom")
+    # per-query fault streams (round 16): times=1 bounds the kill PER
+    # QUERY — every query the plan matches dies once at its own sample
+    # point while the plan is armed (the old process-global stream
+    # spent the budget on the first query only)
     faults.install("seed=4; accounting.oom_kill: times=1")
-    with pytest.raises(urllib.error.HTTPError) as ei:
-        _q(broker, "SELECT SUM(amount) FROM sales")
-    body = ei.value.read().decode()
-    assert "heap pressure" in body
-    assert _counter("queries_killed_oom") == k0 + 1
-    # an application-level kill is NOT a health signal: no failover,
-    # servers stay healthy, and the very next query (fault spent) works
-    assert all(broker._failures.healthy(s.instance_id) for s in servers)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _q(broker, "SELECT SUM(amount) FROM sales")
+        body = ei.value.read().decode()
+        assert "heap pressure" in body
+        assert _counter("queries_killed_oom") >= k0 + 1
+        # an application-level kill is NOT a health signal: no
+        # failover, servers stay healthy
+        assert all(broker._failures.healthy(s.instance_id)
+                   for s in servers)
+    finally:
+        faults.clear()
+    # plan cleared: nothing latched — the very next query works
     resp = _q(broker, "SELECT SUM(amount) FROM sales")
-    faults.clear()
     assert resp["resultTable"]["rows"] == [[int(data["amount"].sum())]]
 
 
@@ -525,6 +566,28 @@ def test_chaos_smoke_cli(capsys):
     assert summary["ok"] and summary["plans"] == 4
     assert summary["rollup_faults_fired"] >= 1
     assert summary["fleet_ledger_kinds"].get("fleet_rollup", 0) >= 1
+
+
+def test_chaos_smoke_rate_cli(capsys):
+    """Round-16 rate gate (ISSUE 11): sustained multi-partition ingest
+    concurrent with queries under the full armed ingest fault plan —
+    final state byte-exact vs the oracle, a validated ingest_bench
+    record + per-table ingest_stats rows, and the freshness-gate
+    ratchet green against the checked-in baseline, with micro-batching
+    at its (on) process default."""
+    import chaos_smoke
+    assert chaos_smoke.main(["--rate", "--rows", "400",
+                             "--gate-iters", "2"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = __import__("json").loads(out[-1])
+    assert summary["ok"] and summary["mode"] == "rate"
+    assert summary["oracle_ok"] is True
+    assert summary["faults_fired"] >= 1
+    assert summary["queries"] >= 1 and summary["query_errors"] == 0
+    assert summary["ledger_kinds"].get("ingest_bench", 0) >= 1
+    assert summary["ledger_kinds"].get("ingest_stats", 0) >= 2
+    assert summary["freshness_gate_exit"] == 0
+    assert summary["batched"] is True  # default-on, armed during chaos
 
 
 @pytest.mark.slow

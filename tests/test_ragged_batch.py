@@ -33,11 +33,13 @@ from pinot_tpu.utils.metrics import global_metrics
 
 
 @pytest.fixture(autouse=True)
-def _batching_off_after():
-    """Batching is opt-in per test and must never leak into other
-    suites (fused composition depends on arrival timing)."""
+def _batcher_defaults_after():
+    """Tests flip the batcher's knobs; restore the PROCESS DEFAULT
+    (enabled since round 16, PINOT_MICROBATCH=0 disables) so the rest
+    of the suite runs the configuration production ships."""
+    from pinot_tpu.engine.ragged import default_enabled
     yield
-    global_batcher.configure(enabled=False,
+    global_batcher.configure(enabled=default_enabled(),
                              window_ms=4.0, max_batch=32)
     faults.clear()
 
@@ -198,6 +200,76 @@ def test_same_seed_determinism_under_chaos(ssb, grouped):
     assert d1 == d2 == baseline + [solo_base, solo_base]
     assert f1 == f2
     assert f1, "the chaos plan never fired — the gate is vacuous"
+
+
+def test_chaos_streams_solo_vs_batched_vs_interleaved(ssb, grouped):
+    """Round-16 acceptance (ISSUE 11): with per-query fault streams
+    (utils/faults.py rekeying), a query's same-seed fired-fault stream
+    is IDENTICAL whether the concurrent wave around it dispatches solo
+    (batching disabled), fuses behind a barrier, or fuses with
+    arbitrary staggered arrival — no barrier-deterministic composition
+    required any more, which is what lets chaos soaks run with
+    micro-batching on by default."""
+    _seg, sbroker = ssb
+    _dm, broker = grouped
+    sqls = [_grp(i) + bench.OPTION for i in range(6)]
+    q21 = next(q for q in bench.QUERIES if q[0] == "q2.1")
+    solo_sql = bench.spec_to_sql(q21[1], q21[2], q21[3]) + bench.OPTION
+    global_batcher.configure(enabled=False)
+    baseline = [bench._digest(broker.query(s).rows) for s in sqls]
+    solo_base = bench._digest(sbroker.query(solo_sql).rows)
+
+    def chaos_run(batched, stagger):
+        # match pins the armed point to the probe's segment: the wave's
+        # own overflow sites are composition-DEPENDENT by construction
+        # (a fused query never reaches the solo retry ladder), so the
+        # cross-mode invariant is the probe's stream
+        plan = faults.install(
+            f"seed=16; device.overflow: match={_seg.name}, times=1",
+            seed=16)
+        global_batcher.configure(enabled=batched, window_ms=30.0)
+        try:
+            probe_digests = []
+
+            def probe():
+                probe_digests.append(
+                    bench._digest(sbroker.query(solo_sql).rows))
+            pt = threading.Thread(target=probe)
+            pt.start()
+            if stagger:
+                results = [None] * len(sqls)
+                errs = []
+
+                def run(i, s):
+                    try:
+                        results[i] = broker.query(s)
+                    except Exception as e:  # noqa: BLE001 — asserted
+                        errs.append(f"q{i}: {e}")
+                threads = []
+                for i, s in enumerate(sqls):
+                    th = threading.Thread(target=run, args=(i, s))
+                    threads.append(th)
+                    th.start()
+                    time.sleep(0.002 * (i % 3))  # ragged arrival
+                for th in threads:
+                    th.join()
+                assert not errs, errs
+            else:
+                results = _concurrent(broker, sqls)
+            pt.join()
+            return ([bench._digest(r.rows) for r in results]
+                    + probe_digests, plan.fired_summary())
+        finally:
+            faults.clear()
+
+    runs = [chaos_run(batched=False, stagger=True),
+            chaos_run(batched=True, stagger=False),
+            chaos_run(batched=True, stagger=True)]
+    for d, _f in runs:
+        assert d == baseline + [solo_base]
+    f_solo, f_barrier, f_staggered = (f for _d, f in runs)
+    assert f_solo == f_barrier == f_staggered
+    assert f_solo, "the chaos plan never fired — the gate is vacuous"
 
 
 # -- admission fairness -----------------------------------------------------

@@ -33,11 +33,23 @@ digest-exact vs the fault-free oracle (exactly-once across
 crash/restart, upsert latest-wins preserved) and (b) every run
 appended a validated ``ingest_stats`` freshness-ledger record.
 
+``--rate`` runs the round-16 sustained-rate gate
+(pinot_tpu/engine/loadgen.py, tier-1 via tests/test_faults.py): 2
+tables (append standalone + upsert protocol) x 2 partitions of
+sustained multi-partition ingest WITH a concurrent query mix and ALL
+ingest fault points armed, micro-batching at its process default (ON
+since round 16), asserting (a) byte-exact final queryable state vs the
+ingest_fuzz oracle, (b) >=1 validated ``ingest_bench`` ledger record
+plus per-table ``ingest_stats`` rows, and (c) the freshness gate green
+— a fresh tools/freshness_gate.py capture checked against the
+checked-in tools/freshness_baseline.json.
+
 Prints one summary JSON line last, check_ledger-style; exit 0 when all
 assertions hold.
 
     python tools/chaos_smoke.py [--rows N] [--seed N]
     python tools/chaos_smoke.py --ingest [--rows N] [--seeds 40,50,57]
+    python tools/chaos_smoke.py --rate [--rows N] [--seed N]
 """
 from __future__ import annotations
 
@@ -267,6 +279,92 @@ def main_ingest(args) -> int:
     return 0 if not failures else 1
 
 
+RATE_ROWS = 600
+
+
+def main_rate(args) -> int:
+    """--rate: the sustained ingest-while-query chaos gate (module
+    docstring). Chaos-armed loadgen run -> oracle exactness + validated
+    ingest_bench/ingest_stats records -> fault-free freshness-gate
+    capture+check vs the checked-in baseline."""
+    import freshness_gate as FG
+    from pinot_tpu.engine.loadgen import (LoadgenConfig, TableLoadSpec,
+                                          run_load)
+    from pinot_tpu.tools.ingest_fuzz import ingest_plan
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils import ledger as uledger
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_rate_chaos_")
+    ledger_path = os.path.join(tmp, "ingest_bench.jsonl")
+    failures = []
+    summary = {"mode": "rate", "rows": args.rows, "seed": args.seed}
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+            print(f"FAIL {name}: {detail}")
+
+    faults.clear()
+    try:
+        cfg = LoadgenConfig(
+            tables=[
+                TableLoadSpec("rate_append", partitions=2),
+                TableLoadSpec("rate_upsert", partitions=2, upsert=True,
+                              protocol=True),
+            ],
+            seed=args.seed,
+            rows_per_partition=args.rows,
+            query_concurrency=2,
+            scenario="chaos_rate",
+            fault_plan=ingest_plan(args.seed, protocol=True),
+            ledger_path=ledger_path,
+            max_wall_s=90.0)
+        res = run_load(os.path.join(tmp, "run"), cfg)
+        summary.update(
+            {k: res.get(k) for k in
+             ("rows", "rows_per_s", "duration_s", "freshness_p50_ms",
+              "freshness_p99_ms", "commit_p50_ms", "queries",
+              "query_p50_ms", "query_errors", "restarts",
+              "faults_fired", "batched", "oracle_ok")})
+        # (a) chaos actually happened AND the final state is byte-exact
+        # vs the fault-free oracle (run_load diffs per table/partition)
+        check("rate.ok", res.get("ok") is True,
+              res.get("error", "oracle mismatch"))
+        check("rate.fired", res.get("faults_fired", 0) >= 1,
+              "the armed plan never fired")
+        check("rate.queries_ran", res.get("queries", 0) >= 1,
+              "no concurrent queries completed")
+        # (b) validated ledger: one ingest_bench + per-table stats rows
+        lres = uledger.validate_file(ledger_path)
+        summary["ledger_kinds"] = lres["kinds"]
+        check("rate.ledger_valid", not lres["errors"],
+              f"invalid records: {lres['errors'][:3]}")
+        check("rate.ingest_bench_record",
+              lres["kinds"].get("ingest_bench", 0) >= 1
+              and lres["kinds"].get("ingest_stats", 0) >= 2,
+              f"kinds={lres['kinds']}")
+        # (c) the freshness ratchet: fresh fault-free gate-corpus
+        # capture checked against the checked-in baseline (the same
+        # check bench_common.finish() runs on every bench capture)
+        gate_ledger = os.path.join(tmp, "gate_corpus.jsonl")
+        try:
+            FG.capture(gate_ledger, iters=args.gate_iters)
+            rc = FG.main(["check", gate_ledger])
+            summary["freshness_gate_exit"] = rc
+            check("rate.freshness_gate", rc == 0, f"exit {rc}")
+        except Exception as e:  # noqa: BLE001 — into the summary
+            check("rate.freshness_gate", False,
+                  f"EXC {type(e).__name__}: {e}")
+    finally:
+        faults.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
 def _rollup_gate(ctrl, broker, tmp, queries, seed, check) -> dict:
     """The round-14 fleet-rollup chaos gate (satellite): fault-kill one
     broker's ledger pull mid-rollup, then assert skip-count + exact
@@ -352,13 +450,23 @@ def main(argv=None) -> int:
     ap.add_argument("--ingest", action="store_true",
                     help="run the realtime ingest chaos gate instead "
                          "of the cluster query gate")
+    ap.add_argument("--rate", action="store_true",
+                    help="run the sustained ingest-while-query rate "
+                         "gate (loadgen + ingest_bench + freshness "
+                         "ratchet)")
     ap.add_argument("--seeds", default=",".join(map(str, INGEST_SEEDS)),
                     help="--ingest mode seeds (comma-separated)")
+    ap.add_argument("--gate-iters", type=int, default=2,
+                    help="--rate mode: freshness-gate capture "
+                         "iterations (default %(default)s)")
     args = ap.parse_args(argv)
     if args.rows is None:
-        args.rows = INGEST_ROWS if args.ingest else 4096
+        args.rows = INGEST_ROWS if args.ingest \
+            else RATE_ROWS if args.rate else 4096
     if args.ingest:
         return main_ingest(args)
+    if args.rate:
+        return main_rate(args)
 
     from pinot_tpu.cluster.http_util import http_json
     from pinot_tpu.utils import faults
