@@ -491,6 +491,68 @@ def freshness_regression_gate(ledger_path: str | None = None,
         return {"ok": True, "skipped": f"{type(e).__name__}: {e}"}
 
 
+def overload_regression_gate(ledger_path: str | None = None,
+                             capture_if_empty: bool = True
+                             ) -> dict | None:
+    """tools/traffic_replay.py overload gate, run at bench time beside
+    the span and freshness gates. Checks ``ledger_path``'s
+    ``replay_bench`` records when present (a failed/regressed replay
+    run must fail THIS capture); other benches' ledgers carry none, so
+    the gate then runs a fresh local-mode replay (in-process broker,
+    self-calibrating — pre-spike baseline and recovery bar are measured
+    in-run, so no checked-in baseline file is needed). Returns the
+    check summary, or None when the harness is absent."""
+    replay = os.path.join(REPO, "tools", "traffic_replay.py")
+    if not os.path.exists(replay):
+        return None
+    ledger_path = ledger_path or LEDGER
+
+    def check_records(path: str) -> dict | None:
+        import json as _json
+        recs = []
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    try:
+                        rec = _json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and \
+                            rec.get("kind") == "replay_bench":
+                        recs.append(rec)
+        except OSError:
+            return None
+        if not recs:
+            return None
+        bad = [r for r in recs[-3:]
+               if not r.get("ok") or r.get("protected_sheds", 0)
+               or r.get("recovered") is False]
+        return {"ok": not bad, "records_checked": len(recs[-3:]),
+                "source": "ledger",
+                "failures": [r.get("error") or "not ok" for r in bad]}
+
+    try:
+        summary = check_records(ledger_path)
+        if summary is not None or not capture_if_empty:
+            return summary
+        env = dict(os.environ)
+        env["PINOT_CPU_FAST_GROUPBY"] = "0"
+        proc = subprocess.run(
+            [sys.executable, replay, "gate", "--mode", "local",
+             "--queries", "32"],
+            env=env, capture_output=True, text=True, timeout=300)
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        return {"ok": proc.returncode == 0 and res.get("ok") is True,
+                "source": "capture",
+                "shed": res.get("shed"),
+                "protected_sheds": res.get("protected_sheds"),
+                "deterministic": res.get("deterministic"),
+                "recovered": res.get("recovered"),
+                "failures": res.get("failures") or []}
+    except Exception as e:  # the gate must never lose a capture
+        return {"ok": True, "skipped": f"{type(e).__name__}: {e}"}
+
+
 def finish(out: dict, backend: str, all_ok: bool) -> None:
     """Shared tail: ledger compare+append, span-diff + freshness
     regression gates, print the ONE JSON line, exit."""
@@ -515,6 +577,15 @@ def finish(out: dict, backend: str, all_ok: bool) -> None:
             out.setdefault(
                 "error", "freshness_gate regression gate failed "
                          f"({n_reg} regression(s))")
+    ogate = overload_regression_gate()
+    if ogate is not None:
+        out["overload_gate"] = ogate
+        if not ogate.get("ok", True):
+            all_ok = False
+            out.setdefault(
+                "error", "overload replay gate failed: "
+                         + "; ".join(ogate.get("failures") or
+                                     ["not ok"])[:200])
     prev = ledger_last(out["metric"], backend, out.get("n_rows"))
     d = ledger_deltas(out, prev)
     if d is not None:

@@ -98,6 +98,13 @@ class Broker:
         # expanded into CTEs at reference time (_expand_views)
         self._views: Dict[str, Any] = {}
         self.quota = QueryQuotaManager()
+        # overload protection (ISSUE 12, broker/workload.py): per-tenant
+        # budgets + the watermark degradation ladder. Process-global
+        # like the accountant — tenant isolation is a per-process
+        # property, and in-process clusters run several broker roles in
+        # one interpreter
+        from .workload import global_workload
+        self.workload = global_workload
         # traceRatio production sampling (round 12): constructor wins,
         # then PINOT_TRACE_RATIO, then off (the shared
         # forensics.default_trace_ratio chain). OPTION(traceRatio=...)
@@ -113,6 +120,11 @@ class Broker:
         cfg = getattr(dm, "table_config", None)
         if cfg is not None and getattr(cfg, "quota_qps", None):
             self.quota.set_quota(dm.table_name, cfg.quota_qps)
+        if cfg is not None:
+            # workload tenant from the TableConfig tenant field; tables
+            # without one charge the default tenant
+            self.workload.set_table_tenant(
+                dm.table_name, getattr(cfg, "tenant", None))
 
     def table(self, name: str) -> TableDataManager:
         if name not in self._tables:
@@ -140,26 +152,48 @@ class Broker:
         if isinstance(stmt, DdlStmt):
             return self._execute_ddl(stmt, t0)
         stmt._raw_sql = sql  # for the EXPLAIN ANALYZE ledger record
-        # traceRatio production sampling: plan-only (EXPLAIN) and
-        # analyze statements never sample; the decision is
-        # deterministic in the query id (utils/spans.sample_decision)
-        # and costs nothing when it comes up unsampled.
-        if not getattr(stmt, "analyze", False) and \
-                not getattr(stmt, "explain", False):
-            from ..cluster.forensics import parse_trace_ratio
-            ratio = parse_trace_ratio(
-                getattr(stmt, "options", {}) or {}, self._trace_ratio)
-            if ratio > 0:
-                from ..utils.spans import sample_decision
-                # OPTION(queryId=...) lets replicas/retries of the same
-                # logical query agree on the decision; otherwise a
-                # fresh uuid draws independently per broker
-                opts = getattr(stmt, "options", {}) or {}
-                qid = str(opts.get("queryId")
-                          or uuid.uuid4().hex[:12])[:64]
-                if sample_decision(qid, ratio):
-                    return self._execute_sampled(stmt, sql, t0, qid)
-        return self._execute_stmt(stmt, t0)
+        opts = getattr(stmt, "options", {}) or {}
+        # OPTION(queryId=...) lets replicas/retries of the same logical
+        # query agree on the sampling AND shed decisions; otherwise a
+        # fresh uuid draws independently per broker
+        qid = str(opts.get("queryId") or uuid.uuid4().hex[:12])[:64]
+        # overload admission (broker/workload.py), once per USER query —
+        # nested CTE/subquery/set-op statements recurse through
+        # _execute_stmt under this ticket. Plan-only EXPLAIN never
+        # admits (no execution to protect); EXPLAIN ANALYZE does.
+        # A shed raises the 429-shaped OverloadShedError here, before
+        # any planning/dispatch work.
+        from .workload import (clamp_brownout, leaf_table,
+                               parse_retry_attempt)
+        ticket = None
+        if not getattr(stmt, "explain", False) or \
+                getattr(stmt, "analyze", False):
+            ticket = self.workload.admit(
+                qid, leaf_table(stmt),
+                retry_attempt=parse_retry_attempt(opts))
+            if ticket.brownout:
+                # rung-3 brownout: clamp to the floor deadline and
+                # force partial-result semantics — degraded answers
+                # beat a metastable queue
+                clamp_brownout(stmt.options, DEFAULT_TIMEOUT_MS)
+        try:
+            # traceRatio production sampling: plan-only (EXPLAIN) and
+            # analyze statements never sample; the decision is
+            # deterministic in the query id (utils/spans.
+            # sample_decision) and costs nothing when unsampled. Rung
+            # >= 1 sheds this speculative work entirely.
+            if not getattr(stmt, "analyze", False) and \
+                    not getattr(stmt, "explain", False) and \
+                    not (ticket is not None and ticket.degraded):
+                from ..cluster.forensics import parse_trace_ratio
+                ratio = parse_trace_ratio(opts, self._trace_ratio)
+                if ratio > 0:
+                    from ..utils.spans import sample_decision
+                    if sample_decision(qid, ratio):
+                        return self._execute_sampled(stmt, sql, t0, qid)
+            return self._execute_stmt(stmt, t0)
+        finally:
+            self.workload.release(ticket)
 
     def _execute_sampled(self, stmt, sql: str, t0: float,
                          qid: str) -> ResultTable:
@@ -327,12 +361,17 @@ class Broker:
                        or uuid.uuid4().hex[:12])[:64]
         timeout_ms = int(stmt.options.get("timeoutMs", DEFAULT_TIMEOUT_MS))
         deadline = t0 + timeout_ms / 1e3
+        # tenant attribution rides the accountant registration: the
+        # watcher's tier-aware kill ordering and the post-paid tenant
+        # budgets (workload.observe at unregister) both read it there
+        tenant, tier = self.workload.resolve(stmt.table)
         if self._is_hybrid(stmt.table):
             if stmt.joins or has_window(stmt):
                 raise SqlError("joins/window functions over hybrid "
                                "tables are not supported yet; query the "
                                "_OFFLINE/_REALTIME tables directly")
-            global_accountant.register(query_id, deadline=deadline)
+            global_accountant.register(query_id, deadline=deadline,
+                                       tenant=tenant, tier=tier)
             try:
                 return self._execute_hybrid(stmt, t0, query_id)
             finally:
@@ -347,7 +386,8 @@ class Broker:
             from ..multistage.executor import explain_multistage
             if stmt.explain:
                 return explain_multistage(self, stmt)
-            global_accountant.register(query_id, deadline=deadline)
+            global_accountant.register(query_id, deadline=deadline,
+                                       tenant=tenant, tier=tier)
             try:
                 return execute_multistage(self, stmt)
             finally:
@@ -355,7 +395,8 @@ class Broker:
         ctx = build_query_context(stmt)
         trace_on = _truthy(ctx.options.get("trace"))
         scope = Tracing.register(query_id, trace_on)
-        global_accountant.register(query_id, deadline=deadline)
+        global_accountant.register(query_id, deadline=deadline,
+                                   tenant=tenant, tier=tier)
         try:
             result = self._execute_ctx(ctx, stmt, t0, deadline,
                                        query_id=query_id)

@@ -13,6 +13,7 @@ in-process broker uses.
 from __future__ import annotations
 
 import itertools
+import json
 import threading
 import time
 import urllib.error
@@ -179,6 +180,11 @@ class BrokerNode:
         import os
         from ..broker.quota import QueryQuotaManager
         from ..broker.routing import make_selector
+        from ..broker.workload import global_workload
+        # overload protection (ISSUE 12): per-tenant budget admission +
+        # the watermark degradation ladder, shared process-global with
+        # the in-process broker (tenant isolation is per process)
+        self.workload = global_workload
         self.controller_url = controller_url
         self.routing_refresh = routing_refresh
         # fleet identity (round 14): brokers register with the controller
@@ -319,9 +325,38 @@ class BrokerNode:
 
     def _check_quota(self, table: str,
                      snap: Optional[Dict[str, Any]] = None) -> None:
+        snap = snap if snap is not None else self._snapshot()
         qps = self._table_config(table, snap).get("quotaQps")
+        # the reference divides the table quota by the number of LIVE
+        # brokers (external-view-change analog): the controller ships
+        # the heartbeat-fresh broker list in every routing snapshot
+        self._quota.set_num_brokers(len(snap.get("liveBrokers") or [])
+                                    or 1)
         self._quota.set_quota(table, qps)
         self._quota.check(table)
+
+    def _resolve_workload_tenant(self, table: Optional[str]) -> None:
+        """Refresh the workload manager's table->tenant mapping from
+        the routing snapshot's table config (the TableConfig ``tenant``
+        field as shipped by the controller; hybrid logical names fall
+        back to the _OFFLINE half's config)."""
+        if not table:
+            return
+        cfg = self._table_config(table)
+        if not cfg:
+            cfg = self._table_config(f"{table}_OFFLINE")
+        self.workload.set_table_tenant(table, cfg.get("tenant"))
+
+    @staticmethod
+    def _workload_fields(ticket) -> Optional[Dict[str, Any]]:
+        """query_stats ledger fields for an ADMITTED query's workload
+        attribution (the shed path builds its own)."""
+        if ticket is None:
+            return None
+        out: Dict[str, Any] = {"tenant": ticket.tenant}
+        if ticket.rung:
+            out["rung"] = ticket.rung
+        return out
 
     def query(self, sql: str) -> ResultTable:
         t0 = time.perf_counter()
@@ -337,66 +372,125 @@ class BrokerNode:
         slow_ms = parse_slow_query_ms(options,
                                       self.forensics.default_slow_ms)
         ratio = parse_trace_ratio(options, self.forensics.trace_ratio)
-        if getattr(stmt, "analyze", False):
-            return self._query_analyze(stmt, sql, t0, slow_ms)
         # a client-supplied OPTION(queryId=...) is what makes the
-        # deterministic sampling decision hold ACROSS broker replicas
-        # and client retries — without it each broker draws a fresh
-        # uuid and only same-broker machinery (failover/hedge attempts,
-        # which share this qid via traceContext) agrees
+        # deterministic sampling AND shed decisions hold ACROSS broker
+        # replicas and client retries — without it each broker draws a
+        # fresh uuid and only same-broker machinery (failover/hedge
+        # attempts, which share this qid via traceContext) agrees
         qid = str(options.get("queryId") or uuid.uuid4().hex[:12])[:64]
-        # traceRatio production sampling: deterministic in the qid so
-        # replicas/retries agree when the client names the query; a
-        # sampled query roots the SAME span tree EXPLAIN ANALYZE uses
-        # (the scatter then propagates sampled=true traceContext to
-        # every server), zero spans when unsampled. EXPLAIN (plan-only)
-        # queries never sample.
-        sampled = (not getattr(stmt, "explain", False)
-                   and sample_decision(qid, ratio))
-        scatters: List[ScatterResult] = []
         table = getattr(stmt, "table", None)
-        root: Optional[Span] = None
-        if sampled:
-            root = span_tracer.start(ph.QUERY, table=table, query_id=qid,
-                                     sampled=True)
-        try:
+        # overload admission (ISSUE 12, broker/workload.py) once per
+        # user query, before any planning/dispatch work. Plan-only
+        # EXPLAIN skips (nothing to protect); a shed is recorded as a
+        # query_stats row (tenant/rung/retryAfterMs) so the fleet
+        # rollup trends shed rates, then surfaces as the structured
+        # 429 (the /query/sql handler renders e.payload()).
+        from ..broker.workload import (OverloadShedError, clamp_brownout,
+                                       leaf_table, parse_retry_attempt)
+        retry_attempt = parse_retry_attempt(options)
+        ticket = None
+        if not getattr(stmt, "explain", False) or \
+                getattr(stmt, "analyze", False):
+            wl_table = table or leaf_table(stmt)
+            self._resolve_workload_tenant(wl_table)
             try:
-                result = self._query_stmt(stmt, sql, t0, qid, scatters)
-            finally:
-                if sampled:
-                    # stop on EVERY exit: a leaked thread-local stack
-                    # would silently trace the next query on this
-                    # HTTP worker thread
-                    root = span_tracer.stop() or root
-        except SqlError as e:
-            if sampled and root is not None:
-                # the stats record below is flagged traced=true, so the
-                # trace record must exist for the qid join to hold —
-                # a failed query's spans are exactly the wanted ones
-                root.annotate(error=str(e)[:200])
+                ticket = self.workload.admit(
+                    qid, wl_table, retry_attempt=retry_attempt)
+            except OverloadShedError as e:
+                self.forensics.record(
+                    qid, table, sql, t0, None, [], slow_ms, error=e,
+                    workload={"tenant": e.tenant, "tier": e.tier,
+                              "shed": True, "shed_rung": e.rung,
+                              "retry_after_ms": e.retry_after_ms})
+                raise
+            if ticket.brownout:
+                # rung-3 brownout: every admitted query clamps to the
+                # floor deadline and runs with partial-result
+                # semantics — a degraded answer beats a metastable
+                # retry storm (one shared helper so the two brokers'
+                # ladders can't drift)
+                from ..broker.broker import DEFAULT_TIMEOUT_MS
+                clamp_brownout(stmt.options, DEFAULT_TIMEOUT_MS)
+        result: Optional[ResultTable] = None
+        try:
+            if getattr(stmt, "analyze", False):
+                result = self._query_analyze(stmt, sql, t0, slow_ms)
+                return result
+            # traceRatio production sampling: deterministic in the qid
+            # so replicas/retries agree when the client names the
+            # query; a sampled query roots the SAME span tree EXPLAIN
+            # ANALYZE uses (the scatter then propagates sampled=true
+            # traceContext to every server), zero spans when unsampled.
+            # EXPLAIN (plan-only) never samples, and rung >= 1 sheds
+            # this speculative work entirely.
+            sampled = (not getattr(stmt, "explain", False)
+                       and not (ticket is not None and ticket.degraded)
+                       and sample_decision(qid, ratio))
+            scatters: List[ScatterResult] = []
+            root: Optional[Span] = None
+            if sampled:
+                root = span_tracer.start(ph.QUERY, table=table,
+                                         query_id=qid, sampled=True)
+            try:
+                try:
+                    result = self._query_stmt(
+                        stmt, sql, t0, qid, scatters,
+                        workload=None if ticket is None else
+                        {"tenant": ticket.tenant, "tier": ticket.tier})
+                finally:
+                    if sampled:
+                        # stop on EVERY exit: a leaked thread-local
+                        # stack would silently trace the next query on
+                        # this HTTP worker thread
+                        root = span_tracer.stop() or root
+            except SqlError as e:
+                if sampled and root is not None:
+                    # the stats record below is flagged traced=true, so
+                    # the trace record must exist for the qid join to
+                    # hold — a failed query's spans are exactly the
+                    # wanted ones
+                    root.annotate(error=str(e)[:200])
+                    self.forensics.record_trace(root, sql, qid)
+                self.forensics.record(qid, table, sql, t0, None,
+                                      scatters, slow_ms, trace=root,
+                                      error=e, traced=sampled,
+                                      workload=self._workload_fields(
+                                          ticket))
+                raise
+            if sampled:
+                root.annotate(
+                    rows=len(result.rows),
+                    servers_queried=result.num_servers_queried,
+                    servers_responded=result.num_servers_responded)
+                global_metrics.count("sampled_traces")
                 self.forensics.record_trace(root, sql, qid)
-            self.forensics.record(qid, table, sql, t0, None, scatters,
-                                  slow_ms, trace=root, error=e,
-                                  traced=sampled)
-            raise
-        if sampled:
-            root.annotate(rows=len(result.rows),
-                          servers_queried=result.num_servers_queried,
-                          servers_responded=result.num_servers_responded)
-            global_metrics.count("sampled_traces")
-            self.forensics.record_trace(root, sql, qid)
-        self.forensics.record(qid, table, sql, t0, result, scatters,
-                              slow_ms, trace=root, traced=sampled)
-        return result
+            self.forensics.record(qid, table, sql, t0, result, scatters,
+                                  slow_ms, trace=root, traced=sampled,
+                                  workload=self._workload_fields(ticket))
+            return result
+        finally:
+            # result-bytes estimate feeds the tenant's post-paid bucket
+            # (the cluster broker never runs the engine's track_result
+            # fence itself — the reduced rows are its usage signal)
+            est = 0
+            if result is not None:
+                est = len(result.rows) * max(len(result.columns), 1) * 8
+            self.workload.release(ticket, result_bytes=est or None)
 
     def _query_stmt(self, stmt, sql: str, t0: float, qid: str,
-                    scatters: List["ScatterResult"]) -> ResultTable:
+                    scatters: List["ScatterResult"],
+                    workload: Optional[Dict[str, Any]] = None
+                    ) -> ResultTable:
         """One statement through routing/scatter/reduce. ``scatters``
         collects every ScatterResult this statement dispatched so the
         caller (forensics, EXPLAIN ANALYZE) sees per-query hedge and
-        failover counts."""
+        failover counts. ``workload`` is the admitted query's
+        tenant/tier attribution, forwarded on every server dispatch so
+        the server-side accountant registers it too — the tier-aware
+        HeapWatcher kill ordering and the post-paid cpu budgets run
+        where the work actually executes, not just at the broker."""
         if isinstance(stmt, SetOpStmt):
-            return self._query_setop(stmt, t0, qid, scatters)
+            return self._query_setop(stmt, t0, qid, scatters, workload)
         from ..multistage.window import has_window
         if stmt.joins or has_window(stmt):
             raise SqlError("multi-stage joins/windows over the remote data "
@@ -417,13 +511,13 @@ class BrokerNode:
                 f"{stmt.table}_OFFLINE" in snap_tables and \
                 f"{stmt.table}_REALTIME" in snap_tables:
             return self._query_hybrid(stmt, t0, snap, deadline, qid,
-                                      scatters)
+                                      scatters, workload)
 
         self._check_quota(stmt.table, snap)
         ctx = build_query_context(stmt)
         if stmt.explain:
             return self._explain_remote(sql, ctx.table, deadline)
-        sc = self._scatter(sql, ctx, snap, deadline, qid)
+        sc = self._scatter(sql, ctx, snap, deadline, qid, workload)
         scatters.append(sc)
         with span(ph.REDUCE, partials=len(sc.partials)):
             result = reduce_partials(ctx, sc.partials)
@@ -498,7 +592,8 @@ class BrokerNode:
     def _query_hybrid(self, stmt, t0: float, snap: Dict[str, Any],
                       deadline: Optional[float] = None,
                       qid: Optional[str] = None,
-                      scatters_out: Optional[List["ScatterResult"]] = None
+                      scatters_out: Optional[List["ScatterResult"]] = None,
+                      workload: Optional[Dict[str, Any]] = None
                       ) -> ResultTable:
         from ..broker.routing import (resolve_time_column, split_hybrid,
                                       time_boundary)
@@ -526,7 +621,7 @@ class BrokerNode:
             ctx_p = build_query_context(part_stmt)
             scatters.append(
                 self._scatter(to_sql(part_stmt), ctx_p, snap, deadline,
-                              qid))
+                              qid, workload))
         if scatters_out is not None:
             scatters_out.extend(scatters)
         with span(ph.REDUCE,
@@ -606,7 +701,11 @@ class BrokerNode:
         150 ms — the EWMA mixes query shapes, so a low floor would hedge
         every legitimately-heavy query after a stream of cheap ones
         (duplicated dispatch exactly when the cluster is loaded). A
-        hedge fires at most once per group either way."""
+        hedge fires at most once per group either way. Overload rung
+        >= 1 disables hedging outright — speculative duplicate
+        dispatch is the FIRST work the degradation ladder sheds."""
+        if self.workload.governor.rung() >= 1:
+            return None
         if hedge_opt is not None:
             return hedge_opt if hedge_opt > 0 else None
         est = getattr(self._selector, "estimate_ms", None)
@@ -619,7 +718,9 @@ class BrokerNode:
     def _scatter(self, sql: str, ctx,
                  snap: Optional[Dict[str, Any]] = None,
                  deadline: Optional[float] = None,
-                 qid: Optional[str] = None) -> ScatterResult:
+                 qid: Optional[str] = None,
+                 workload: Optional[Dict[str, Any]] = None
+                 ) -> ScatterResult:
         # one snapshot for assignment + segment metadata: the refresh
         # thread swaps self._routing, and mixing two snapshots could
         # silently drop segments assigned in one but absent in the other
@@ -719,6 +820,10 @@ class BrokerNode:
                 from ..engine.datablock import decode_wire_frame
                 from ..utils.faults import corrupt_bytes
                 body = {"sql": sql, "segments": segs}
+                if workload:
+                    # tenant/tier attribution crosses the wire: the
+                    # server registers its accountant entry with it
+                    body["workload"] = workload
                 if qid is not None or sampled:
                     # cross-node trace context: query id + sampled flag
                     # + the dispatching span, so the server's remote
@@ -775,12 +880,29 @@ class BrokerNode:
                 # signal — surface it, don't poison the failure detector
                 self._failures.record_success(server)
                 try:
-                    detail = e.read().decode()[:200]
+                    raw_body = e.read().decode()
                 except Exception:
-                    detail = str(e)
+                    raw_body = str(e)
+                detail = raw_body[:200]
                 if sp is not None:
                     sp.finish()
                     sp.annotate(status="rejected", error=detail)
+                if e.code == 429:
+                    # a capacity rejection (SchedulerRejectedError via
+                    # the server's JsonHandler): keep it STRUCTURED end
+                    # to end so the broker's own /query/sql can render
+                    # the retryable 429 instead of flattening to a 400
+                    try:
+                        body = json.loads(raw_body)
+                    except ValueError:
+                        body = {}
+                    if isinstance(body, dict) and                             body.get("retryAfterMs") is not None:
+                        err = SqlError(f"server {server} out of "
+                                       f"capacity: "
+                                       f"{body.get('error', detail)}")
+                        err.error_code = int(body.get("errorCode", 429))
+                        err.retry_after_ms = int(body["retryAfterMs"])
+                        raise err from None
                 raise SqlError(f"server {server} rejected query: "
                                f"{detail}") from None
             except (ScatterTimeoutError, SqlError):
@@ -937,14 +1059,20 @@ class BrokerNode:
                     elif isinstance(e, ReplicaExhaustedError):
                         code = ERR_SERVER_NOT_RESPONDED
                     elif isinstance(e, SqlError):
-                        code = ERR_QUERY_EXECUTION
+                        # a capacity rejection keeps its own code (211/
+                        # 429) so exceptions[] and the final raise stay
+                        # structured-retryable end to end
+                        code = getattr(e, "error_code", None) \
+                            or ERR_QUERY_EXECUTION
                     else:
                         code = ERR_SERVER_NOT_RESPONDED
                     if not is_hedge:
                         g["primary_failed"] = True
-                    g["errors"].append({"errorCode": code,
-                                        "message": str(e),
-                                        "server": server})
+                    entry = {"errorCode": code, "message": str(e),
+                             "server": server}
+                    if getattr(e, "retry_after_ms", None) is not None:
+                        entry["retryAfterMs"] = e.retry_after_ms
+                    g["errors"].append(entry)
                     continue
                 if g["done"]:
                     continue  # the other attempt already resolved it
@@ -1019,11 +1147,18 @@ class BrokerNode:
                         f"{[e['message'] for e in res.exceptions][:3]}")
                 first = (failed[0]["errors"] or
                          [{"message": "server failed"}])[0]
-                raise SqlError(first["message"])
+                err = SqlError(first["message"])
+                if first.get("retryAfterMs") is not None:
+                    # re-attach the capacity-rejection shape: the
+                    # /query/sql handler renders these as HTTP 429
+                    err.error_code = first.get("errorCode", 429)
+                    err.retry_after_ms = first["retryAfterMs"]
+                raise err
 
     def _query_setop(self, stmt: SetOpStmt, t0: float,
                      qid: Optional[str] = None,
-                     scatters: Optional[List["ScatterResult"]] = None
+                     scatters: Optional[List["ScatterResult"]] = None,
+                     workload: Optional[Dict[str, Any]] = None
                      ) -> ResultTable:
         """Set operations over the remote data plane: run each branch as
         its own scatter-gather (rendered back to SQL), combine at this
@@ -1057,7 +1192,8 @@ class BrokerNode:
                 node.limit = 1 << 31
             branch_sql = to_sql(node)
             out = self._query_stmt(parse_sql(branch_sql), branch_sql,
-                                   time.perf_counter(), qid, scatters)
+                                   time.perf_counter(), qid, scatters,
+                                   workload)
             branches.append(out)
             return out
 
@@ -1085,10 +1221,14 @@ class BrokerNode:
         the round-9 scatter counters (in-process roles share
         global_metrics; a standalone broker reports zeros)."""
         from ..engine.ragged import batching_health
+        from ..utils.metrics import overload_health
         snap = global_metrics.snapshot()
         c = snap["counters"]
         fd = self._failures.snapshot()
         instances = self._snapshot().get("instances", {})
+        overload = overload_health(snap)
+        overload["tenants"] = self.workload.health()
+        overload["governor"] = self.workload.governor.snapshot()
         return {
             "servers": fd,
             "unhealthyServers": sum(
@@ -1102,6 +1242,9 @@ class BrokerNode:
             # cross-query micro-batching counters (PR 8) — rendered on
             # the /ui console next to the scatter block
             "batching": batching_health(snap),
+            # overload-protection plane (ISSUE 12): shed/degrade-rung
+            # counters + per-tenant gauges (broker/workload.py)
+            "overload": overload,
         }
 
     # -- REST --------------------------------------------------------------
@@ -1109,12 +1252,28 @@ class BrokerNode:
         node = self
 
         def q(h, b):
+            from ..broker.workload import OverloadShedError
             sql = (b or {}).get("sql")
             if not sql:
                 return 400, {"error": "missing sql"}
             try:
                 return 200, node.query(sql).to_dict()
+            except OverloadShedError as e:
+                # the structured 429: errorCode + retryAfterMs +
+                # tenant/tier/rung — NEVER a 500/stack trace (the
+                # acceptance contract chaos_smoke --overload pins)
+                return 429, e.payload()
             except SqlError as e:
+                code = getattr(e, "error_code", None)
+                if code is not None and \
+                        getattr(e, "retry_after_ms", None) is not None:
+                    # e.g. a server's SchedulerRejectedError surfacing
+                    # through the broker: keep it retryable-structured
+                    return 429, (e.payload() if hasattr(e, "payload")
+                                 else {"error": str(e),
+                                       "errorCode": code,
+                                       "retryAfterMs":
+                                           e.retry_after_ms})
                 return 400, {"error": str(e)}
 
         def debug_queries(h, b):
@@ -1229,6 +1388,7 @@ async function health(){
       .join(' | ')||'all healthy';
     const i=m.ingest||{};
     const b=m.batching||{};const sf=b.solo_fallbacks||{};
+    const o=m.overload||{};const ot=o.tenants||{};
     document.getElementById('scatter').textContent=
       'scatter health: '+m.unhealthyServers+'/'+m.knownServers+
       ' unhealthy | failovers '+(c.scatter_failovers||0)+
@@ -1256,7 +1416,17 @@ async function health(){
       ', timeout '+(sf.timeout||0)+
       ', leader-error '+(sf.leader_error||0)+
       ' | errors '+(b.fused_dispatch_errors||0)+
-      ' | sizes '+JSON.stringify(b.batch_size_histogram||{});
+      ' | sizes '+JSON.stringify(b.batch_size_histogram||{})+
+      '\\noverload: rung '+(o.rung||0)+
+      ' | shed '+(o.overload_shed||0)+
+      ' (rung2 '+((o.shed_by_rung||{})['2']||0)+
+      ', rung3 '+((o.shed_by_rung||{})['3']||0)+')'+
+      ' | brownout clamps '+(o.overload_brownout_clamped||0)+
+      ' | retries suppressed '+(o.overload_retries_suppressed||0)+
+      ' | scheduler rejected '+(o.scheduler_rejected||0)+
+      ' | tenants '+(Object.entries(ot).map(([t,s])=>
+        esc(t)+'['+s.tier+'] inflight '+s.inflight+
+        ' shed '+((o.shed_by_tenant||{})[t]||0)).join(', ')||'none');
   }catch(e){}
 }
 async function slowq(){

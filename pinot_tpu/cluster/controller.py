@@ -321,6 +321,18 @@ class Controller:
             out.append(i["id"])
         return sorted(out)
 
+    def live_brokers(self) -> List[str]:
+        """Live (heartbeat-fresh) broker instances — the reference's
+        HelixExternalViewBasedQueryQuotaManager divides each table's
+        QPS quota by this count, and round 14 made brokers
+        register+heartbeat exactly like servers, so the routing
+        snapshot can now ship it (broker/quota.py consumes it)."""
+        now = time.monotonic()
+        return sorted(
+            i["id"] for i in self._instances.values()
+            if i.get("role") == "broker"
+            and now - i["lastHeartbeat"] <= self.heartbeat_timeout)
+
     # -- tables / segments -------------------------------------------------
     def add_table(self, name: str, schema: Dict[str, Any],
                   config: Optional[Dict[str, Any]] = None,
@@ -866,6 +878,7 @@ class Controller:
                           "role": i.get("role")}
                 for i in self._instances.values()}
             snap["liveServers"] = self.live_servers()
+            snap["liveBrokers"] = self.live_brokers()
             return snap
 
     def server_assignment(self, instance_id: str) -> Dict[str, Any]:

@@ -120,6 +120,12 @@ class QueryForensics:
         self.traces_written = 0
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        # epoch for the query_stats ``arrival_ms`` offsets: the ledger's
+        # envelope ts has 1 s resolution, far too coarse for the
+        # traffic-replay harness's inter-arrival deltas
+        # (tools/traffic_replay.py) — arrival offsets are recorded in
+        # ms against this per-broker epoch instead
+        self._epoch = time.perf_counter()
 
     # -- recording ---------------------------------------------------------
     def record(self, qid: str, table: Optional[str], sql: str, t0: float,
@@ -127,7 +133,9 @@ class QueryForensics:
                slow_ms: Optional[float] = None,
                trace: Optional[Any] = None,
                error: Optional[BaseException] = None,
-               traced: bool = False) -> Dict[str, Any]:
+               traced: bool = False,
+               workload: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
         """Build + validate the query_stats record for one completed (or
         failed) cluster query; append it to the stats ledger when one is
         configured, and admit slow/errored/traced queries to the ring.
@@ -151,7 +159,16 @@ class QueryForensics:
             "hedges": sum(getattr(s, "hedges", 0) for s in scatters),
             "failovers": sum(getattr(s, "failovers", 0)
                              for s in scatters),
+            # ms since this broker's forensics epoch: the inter-arrival
+            # signal tools/traffic_replay.py replays at multiples
+            "arrival_ms": round((t0 - self._epoch) * 1e3, 3),
         }
+        if workload:
+            # overload plane attribution (broker/workload.py): tenant,
+            # degraded rung, and — on a shed — shed/shed_rung/
+            # retry_after_ms, the per-table/tenant shed-rate trend line
+            # the fleet rollup aggregates
+            fields.update(workload)
         if result is not None:
             fields["rows"] = len(result.rows)
             fields["segments_queried"] = result.num_segments

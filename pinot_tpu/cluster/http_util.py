@@ -46,8 +46,24 @@ class JsonHandler(BaseHTTPRequestHandler):
             if m == method and self.path.split("?")[0].startswith(prefix):
                 try:
                     status, payload = fn(self, body)
-                except Exception as e:  # surface handler errors as 500 JSON
-                    status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+                except Exception as e:
+                    # capacity/shed rejections (broker/workload.
+                    # OverloadShedError, engine/scheduler.
+                    # SchedulerRejectedError) must surface as
+                    # STRUCTURED retryable JSON — HTTP 429 with
+                    # errorCode + retryAfterMs — never a 500/stack
+                    # trace a client can't act on
+                    if getattr(e, "retry_after_ms", None) is not None \
+                            and hasattr(e, "error_code"):
+                        payload = (e.payload() if hasattr(e, "payload")
+                                   else {"error": str(e),
+                                         "errorCode": e.error_code,
+                                         "retryAfterMs":
+                                             e.retry_after_ms})
+                        status = 429
+                    else:  # surface handler errors as 500 JSON
+                        status, payload = 500, {
+                            "error": f"{type(e).__name__}: {e}"}
                 if isinstance(payload, (bytes, bytearray)):
                     # binary data plane (DataTable-over-Netty analog)
                     data = bytes(payload)

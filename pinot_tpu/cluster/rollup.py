@@ -101,10 +101,23 @@ def aggregate_tables(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         e = acc.setdefault(t, {
             "queries": 0, "errors": 0, "partial": 0, "slow": 0,
             "traced": 0, "failovers": 0, "hedges": 0, "batched": 0,
-            "batched_queries": 0, "rows": 0, "walls": [],
+            "batched_queries": 0, "rows": 0, "shed": 0,
+            "shed_by_tenant": {}, "walls": [],
             "t_min": None, "t_max": None})
         e["queries"] += 1
-        e["walls"].append(float(rec.get("wall_ms", 0.0)))
+        if rec.get("shed"):
+            # overload plane (ISSUE 12): fleet-wide shed-rate trend
+            # lines per table and per tenant. Shed rows are counted in
+            # ``queries`` (the chaos gate's exactness contract) but
+            # EXCLUDED from the latency walls: a shed is rejected at
+            # admission in sub-ms, and folding those into p50/p99
+            # would mask the latency regression exactly during the
+            # overload the shed counters are reporting.
+            e["shed"] += 1
+            tn = rec.get("tenant") or "default"
+            e["shed_by_tenant"][tn] = e["shed_by_tenant"].get(tn, 0) + 1
+        else:
+            e["walls"].append(float(rec.get("wall_ms", 0.0)))
         if rec.get("error"):
             e["errors"] += 1
         if rec.get("partial"):

@@ -184,7 +184,8 @@ class ServerNode:
     def execute(self, sql: str, segment_names: Optional[List[str]] = None,
                 priority: int = 0,
                 deadline_ms: Optional[float] = None,
-                trace_ctx: Optional[Dict[str, Any]] = None
+                trace_ctx: Optional[Dict[str, Any]] = None,
+                workload: Optional[Dict[str, Any]] = None
                 ) -> Dict[str, Any]:
         """Admit through the scheduler (QueryScheduler.submit analog) and
         account the query so the watcher can kill it under pressure.
@@ -227,7 +228,14 @@ class ServerNode:
             resp["trace"] = root.to_dict()
             return resp
 
-        global_accountant.register(query_id)
+        # tenant/tier attribution forwarded by the dispatching broker
+        # (broker_node._scatter): the tier-aware HeapWatcher kill
+        # ordering and post-paid tenant budgets act HERE, where the
+        # kernels actually execute
+        wl = workload or {}
+        global_accountant.register(query_id,
+                                   tenant=wl.get("tenant"),
+                                   tier=wl.get("tier"))
         try:
             resp = self.scheduler.execute(run, query_id,
                                           priority=priority)
@@ -296,11 +304,12 @@ class ServerNode:
     def execute_json(self, sql: str,
                      segment_names: Optional[List[str]] = None,
                      deadline_ms: Optional[float] = None,
-                     trace_ctx: Optional[Dict[str, Any]] = None
+                     trace_ctx: Optional[Dict[str, Any]] = None,
+                     workload: Optional[Dict[str, Any]] = None
                      ) -> Dict[str, Any]:
         """Legacy/debuggable JSON wire (also serves EXPLAIN)."""
         resp = self.execute(sql, segment_names, deadline_ms=deadline_ms,
-                            trace_ctx=trace_ctx)
+                            trace_ctx=trace_ctx, workload=workload)
         raw = resp.pop("partials_raw", None)
         if raw is not None:
             resp["partials"] = [partial_to_wire(p) for p in raw]
@@ -309,7 +318,8 @@ class ServerNode:
     def execute_bin(self, sql: str,
                     segment_names: Optional[List[str]] = None,
                     deadline_ms: Optional[float] = None,
-                    trace_ctx: Optional[Dict[str, Any]] = None) -> bytes:
+                    trace_ctx: Optional[Dict[str, Any]] = None,
+                    workload: Optional[Dict[str, Any]] = None) -> bytes:
         """Binary data plane: columnar DataBlock partials in one frame.
         The span tree (when sampled) rides the JSON frame header, along
         with ``serdeEncodeMs`` — the partial-encode time this side of
@@ -319,7 +329,7 @@ class ServerNode:
         from ..engine.datablock import (encode_partial,
                                         encode_wire_frame_blocks)
         resp = self.execute(sql, segment_names, deadline_ms=deadline_ms,
-                            trace_ctx=trace_ctx)
+                            trace_ctx=trace_ctx, workload=workload)
         raw = resp.pop("partials_raw", [])
         t_enc = time.perf_counter()
         blocks = [encode_partial(p) for p in raw]
@@ -381,11 +391,13 @@ class ServerNode:
                 ("POST", "/query/bin"): lambda h, b: (
                     200, node.execute_bin(b["sql"], b.get("segments"),
                                           b.get("deadlineMs"),
-                                          b.get("traceContext"))),
+                                          b.get("traceContext"),
+                                          b.get("workload"))),
                 ("POST", "/query"): lambda h, b: (
                     200, node.execute_json(b["sql"], b.get("segments"),
                                            b.get("deadlineMs"),
-                                           b.get("traceContext"))),
+                                           b.get("traceContext"),
+                                           b.get("workload"))),
                 # multi-stage data plane (mailbox.proto analog) + stage
                 # dispatch (worker.proto Submit analog; the trace
                 # context rides an HTTP header because the StagePlan

@@ -158,12 +158,16 @@ function vFleet() {
     ${r.fleet_records || 0} fleet records · ledger ${esc(f.ledger
     || "")}</p>`;
   const tbl = table(["table", "queries", "qps", "p50 ms", "p99 ms",
-      "partial", "failovers", "hedges", "batched", "slow",
+      "partial", "failovers", "hedges", "batched", "slow", "shed",
       "freshness ms"],
     Object.entries(r.tables || {}).map(([t, s]) =>
       [esc(t), s.queries || 0, s.qps || 0, s.p50_ms || 0,
        s.p99_ms || 0, s.partial || 0, s.failovers || 0, s.hedges || 0,
        s.batched_queries || 0, s.slow || 0,
+       (s.shed || 0) + (s.shed_by_tenant &&
+         Object.keys(s.shed_by_tenant).length
+         ? " (" + Object.entries(s.shed_by_tenant).map(([tn, n]) =>
+             esc(tn) + ":" + n).join(", ") + ")" : ""),
        s.freshness_ms != null ? s.freshness_ms : "-"]));
   const slow = table(["qid", "node", "table", "wall ms", "partial",
       "sql"],

@@ -64,6 +64,13 @@ class QueryUsage:
     cpu_s: float = 0.0
     mem_bytes: int = 0
     killed_reason: Optional[str] = None
+    # workload isolation (broker/workload.py): the owning tenant and
+    # its priority tier. The watcher's kill ordering sheds besteffort
+    # tenants before standard before protected, and unregister feeds
+    # the tenant's post-paid cpu/result-bytes budgets from the usage
+    # this fence already tracks
+    tenant: Optional[str] = None
+    tier: Optional[str] = None
     # cross-query micro-batching (engine/ragged.py): how many fused
     # dispatches this query rode and the largest batch it shared — the
     # server ships them in the wire header and the broker's forensics
@@ -92,9 +99,11 @@ class ResourceAccountant:
         self._by_thread: Dict[int, str] = {}
 
     # -- registration ------------------------------------------------------
-    def register(self, query_id: str, deadline: Optional[float] = None
-                 ) -> QueryUsage:
-        u = QueryUsage(query_id, deadline=deadline)
+    def register(self, query_id: str, deadline: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 tier: Optional[str] = None) -> QueryUsage:
+        u = QueryUsage(query_id, deadline=deadline, tenant=tenant,
+                       tier=tier)
         tid = threading.get_ident()
         with self._lock:
             self._by_query[query_id] = u
@@ -118,6 +127,16 @@ class ResourceAccountant:
             for tid in [t for t, q in self._by_thread.items()
                         if q == query_id]:
                 del self._by_thread[tid]
+        if u is not None and u.tenant:
+            # post-paid tenant budgets (broker/workload.py): the usage
+            # this accountant already tracked through the track_result
+            # fence debits the tenant's cpu-ms/result-bytes buckets —
+            # OUTSIDE our lock (the workload manager takes its own)
+            try:
+                from ..broker.workload import global_workload
+                global_workload.observe(u)
+            except Exception:
+                pass  # stripped installs without the broker package
         return u
 
     def usage(self, query_id: str) -> Optional[QueryUsage]:
@@ -237,10 +256,25 @@ class ResourceAccountant:
         return True
 
     def kill_most_expensive(self, reason: str) -> Optional[str]:
-        """PerQueryCPUMemResourceUsageAccountant.java:471-494 analog."""
+        """PerQueryCPUMemResourceUsageAccountant.java:471-494 analog,
+        tier-aware (broker/workload.py): victims come from the least-
+        protected tier that has a running query — a ``protected``
+        tenant's query is only ever killed when NOTHING less protected
+        is running, the memory-pressure half of workload isolation."""
+        try:
+            from ..broker.workload import tier_shed_rank
+        except Exception:
+            # stripped install without the broker package (same stance
+            # as unregister's observe hook): the watcher must still
+            # kill SOMETHING, untiered, or the process OOMs
+            def tier_shed_rank(_tier):
+                return 0
         candidates = [u for u in self.running() if u.killed_reason is None]
         if not candidates:
             return None
+        lowest = min(tier_shed_rank(u.tier) for u in candidates)
+        candidates = [u for u in candidates
+                      if tier_shed_rank(u.tier) == lowest]
         victim = max(candidates, key=QueryUsage.cost)
         victim.killed_reason = reason
         from ..utils.metrics import global_metrics

@@ -486,6 +486,11 @@ class RaggedBatcher:
                  max_batch: int = DEFAULT_MAX_BATCH,
                  enabled: Optional[bool] = None):
         self.window_ms = window_ms
+        # overload degradation (broker/workload.OverloadGovernor): at
+        # rung >= 1 the governor widens the admission window by this
+        # factor — fewer, fuller fused launches while the cluster sheds
+        # speculative work (reset to 1.0 when pressure clears)
+        self.window_scale = 1.0
         self.max_batch = max_batch
         self.enabled = (default_enabled()
                         if enabled is None else bool(enabled))
@@ -562,11 +567,12 @@ class RaggedBatcher:
                 return self._solo("incompatible")
         qid = global_accountant.current_query_id()
         key = (spec, bucket, group_sig)
+        window_ms = self.window_ms * self.window_scale
         usage = global_accountant.usage(qid) if qid else None
         if usage is not None and usage.deadline is not None:
             rem_ms = (usage.deadline - time.perf_counter()) * 1e3
-            est = self.estimate_ms(key) or self.window_ms
-            if rem_ms < self.window_ms + 2.0 * est:
+            est = self.estimate_ms(key) or window_ms
+            if rem_ms < window_ms + 2.0 * est:
                 return self._solo("deadline")
         sub = _Submission(plans, resolved, qid)
         # weight cap = largest pow2 <= the budgeted item count, so the
@@ -577,7 +583,7 @@ class RaggedBatcher:
                   strategy=spec.kp.strategy):
             global_metrics.gauge("batch_queue_depth", self.queue.depth())
             batch = self.queue.offer(
-                key, sub, self.window_ms / 1e3, self.max_batch,
+                key, sub, window_ms / 1e3, self.max_batch,
                 max_weight=max_weight, weight=sub.n_items)
             # re-read after the offer resolves so a drained queue
             # reports 0 instead of freezing at the last pre-offer value

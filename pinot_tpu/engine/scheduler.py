@@ -19,12 +19,29 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional
 
+from ..query.sql import SqlError
 from ..utils.metrics import global_metrics
 from .accounting import ResourceAccountant, global_accountant
 
 
-class SchedulerRejectedError(RuntimeError):
-    """Queue full — the 'server busy, scheduler rejected' analog."""
+class SchedulerRejectedError(SqlError):
+    """Queue full — the 'server busy, scheduler rejected' analog
+    (Pinot's SERVER_OUT_OF_CAPACITY, error code 211). A ``SqlError``
+    (not a bare RuntimeError) so it can never escape the HTTP plane as
+    a 500: cluster/http_util.JsonHandler renders any exception carrying
+    ``error_code``/``retry_after_ms`` as structured retryable JSON, and
+    the broker/server query handlers surface it the same way the
+    overload sheds are surfaced (broker/workload.OverloadShedError)."""
+
+    error_code = 211  # broker/workload.ERR_SERVER_OUT_OF_CAPACITY
+
+    def __init__(self, msg: str, retry_after_ms: int = 200):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+
+    def payload(self):
+        return {"error": str(self), "errorCode": self.error_code,
+                "retryAfterMs": self.retry_after_ms}
 
 
 class _Job:
@@ -79,8 +96,12 @@ class QueryScheduler:
                 raise SchedulerRejectedError("scheduler stopped")
             if len(self._heap) >= self.max_pending:
                 global_metrics.count("scheduler_rejected")
+                # retryAfterMs scales with the backlog: a full queue of
+                # short queries drains in tens of ms per entry
                 raise SchedulerRejectedError(
-                    f"{len(self._heap)} queries pending >= {self.max_pending}")
+                    f"{len(self._heap)} queries pending >= "
+                    f"{self.max_pending}",
+                    retry_after_ms=50 + 10 * len(self._heap))
             heapq.heappush(self._heap, (job.priority, job.seq, job))
             self._work.notify()
         return future

@@ -128,6 +128,12 @@ class TableConfig:
     ingestion: Optional[IngestionConfig] = None
     # max queries/sec for this table (query quota; None = unlimited)
     quota_qps: Optional[float] = None
+    # workload tenant (TableConfig tenants.broker analog): the broker's
+    # WorkloadManager (broker/workload.py) charges this table's queries
+    # to the named tenant's budgets/priority tier; None = the default
+    # tenant. Distinct from the controller's serverTenant tag (which
+    # servers HOST segments) — this is who PAYS for the queries.
+    tenant: Optional[str] = None
     # age-based storage tiers, first match wins (common/tier/ analog)
     tiers: List[TierConfig] = field(default_factory=list)
 
@@ -161,6 +167,7 @@ class TableConfig:
             "numPartitions": self.num_partitions,
             "timeColumn": self.time_column,
             "quotaQps": self.quota_qps,
+            "tenant": self.tenant,
             "ingestion": None if self.ingestion is None else {
                 "filterFunction": self.ingestion.filter_function,
                 "transforms": self.ingestion.transforms,
@@ -201,6 +208,7 @@ class TableConfig:
             num_partitions=d.get("numPartitions", 1),
             time_column=d.get("timeColumn"),
             quota_qps=d.get("quotaQps"),
+            tenant=d.get("tenant"),
             ingestion=None if not d.get("ingestion") else IngestionConfig(
                 filter_function=d["ingestion"].get("filterFunction"),
                 transforms=d["ingestion"].get("transforms", []),

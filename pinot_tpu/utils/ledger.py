@@ -34,6 +34,11 @@ Kinds:
   freshness p50/p99, commit latency, query p50/p99 under ingest
   pressure, chaos seed, batched flag) — tools/freshness_gate.py
   ratchets these against tools/freshness_baseline.json.
+- ``replay_bench``     — tools/traffic_replay.py closed-loop overload
+  replay gate headlines (goodput at N x recorded load, shed counts by
+  tenant/rung, per-tier p50/p99, shed-stream determinism, recovery
+  back to the pre-spike baseline) — chaos_smoke --overload and the
+  bench_common.finish() overload gate consume these.
 - ``fleet_rollup``     — cluster/rollup.py ForensicsRollupTask: the
   controller's cluster-wide aggregation over the per-node ledgers it
   pulls (per-table fleet stats, hot-segment heat ranking, per-node
@@ -106,11 +111,20 @@ KINDS: Dict[str, Dict[str, set]] = {
                      "exception_codes"},
         # ``batched``/``batch_size``: cross-query micro-batching (PR 8)
         # — fused ragged dispatches this query's server executions rode
-        # and the largest batch any of them shared
+        # and the largest batch any of them shared.
+        # Overload plane (ISSUE 12, broker/workload.py): ``tenant``/
+        # ``tier`` = workload attribution; ``rung`` = the degradation
+        # rung the query was ADMITTED at (absent at rung 0); ``shed``/
+        # ``shed_rung``/``retry_after_ms`` = a load-shed query's
+        # structured 429 parameters; ``arrival_ms`` = ms since the
+        # broker's forensics epoch — the inter-arrival deltas
+        # tools/traffic_replay.py replays at multiples.
         "optional": {"sql", "rows", "segments_queried",
                      "segments_pruned", "hedges", "failovers", "slow",
                      "error", "backend", "traced", "serde_ms", "net_ms",
-                     "batched", "batch_size"},
+                     "batched", "batch_size", "tenant", "tier", "rung",
+                     "shed", "shed_rung", "retry_after_ms",
+                     "arrival_ms"},
     },
     "ingest_stats": {
         # the freshness ledger (realtime/manager.write_ingest_stats):
@@ -153,6 +167,32 @@ KINDS: Dict[str, Dict[str, set]] = {
                      "query_p50_ms", "query_p99_ms", "query_errors",
                      "faults_fired", "restarts", "chaos", "oracle_ok",
                      "per_table", "freshness_gate", "error", "extra"},
+    },
+    "replay_bench": {
+        # one closed-loop traffic-replay run (tools/traffic_replay.py):
+        # query_stats records replayed at ``multiple``x their recorded
+        # inter-arrival spacing against a live cluster, chaos armable —
+        # the "what happens at 4x capacity" headline. ``offered`` =
+        # scheduled queries (retries included), ``completed`` = answers,
+        # ``shed`` = structured 429s; ``goodput_qps`` = completed/s
+        # during the spike window. ``tiers`` = per-tier p50/p99 +
+        # shed/error counts; ``protected_sheds`` MUST be 0 for a green
+        # gate. ``deterministic`` = the live shed stream matched the
+        # pure precomputed decision stream (and two same-seed plans
+        # matched each other). ``recovered``/``recovery`` = post-spike
+        # latency back inside the pre-spike noise floor (no metastable
+        # state).
+        "required": {"backend", "ok", "scenario", "seed", "multiple",
+                     "offered", "completed", "shed", "goodput_qps",
+                     "duration_s"},
+        "optional": {"mode", "queries_recorded", "shed_by_tenant",
+                     "shed_by_rung", "shed_by_reason", "tiers",
+                     "protected_sheds", "protected_p99_ms",
+                     "protected_bar_ms", "deterministic", "retries",
+                     "retries_suppressed", "recovered", "recovery",
+                     "pre_p50_ms", "post_p50_ms", "spike_errors",
+                     "chaos", "faults_fired", "query_errors",
+                     "structured_429", "error", "extra"},
     },
     "fleet_rollup": {
         # one controller rollup pass (cluster/rollup.py): pull health

@@ -97,6 +97,42 @@ def ingest_health(snapshot: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+OVERLOAD_COUNTERS = (
+    "overload_shed", "overload_brownout_clamped",
+    "overload_retries_suppressed", "scheduler_rejected",
+)
+
+
+def overload_health(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """The overload-protection block (broker/workload.py) the broker
+    /metrics endpoint and both consoles render: shed totals, the
+    current degradation rung, shed counts by rung, and per-tenant
+    shed counters / in-flight gauges. Tenant names embed in metric
+    names (``tenant_shed_<tenant>``) — the Prometheus renderer
+    sanitizes them through ``_prom_name``."""
+    c = snapshot["counters"]
+    g = snapshot["gauges"]
+    out: Dict[str, Any] = {k: c.get(k, 0) for k in OVERLOAD_COUNTERS}
+    out["rung"] = g.get("overload_rung", 0)
+    out["pressure"] = g.get("overload_pressure", 0.0)
+    # derived from whatever rung counters exist: budget sheds
+    # (inflight/cpu/bytes/retry) land on the CURRENT rung — 0/1
+    # included — and the breakdown must sum to the shed total
+    rung_prefix = "overload_shed_rung_"
+    out["shed_by_rung"] = {k[len(rung_prefix):]: v
+                           for k, v in c.items()
+                           if k.startswith(rung_prefix)}
+    shed_prefix = "tenant_shed_"
+    out["shed_by_tenant"] = {k[len(shed_prefix):]: v
+                             for k, v in c.items()
+                             if k.startswith(shed_prefix)}
+    infl_prefix = "tenant_inflight_"
+    out["inflight_by_tenant"] = {k[len(infl_prefix):]: v
+                                 for k, v in g.items()
+                                 if k.startswith(infl_prefix)}
+    return out
+
+
 def _prom_name(name: str) -> str:
     """Sanitize to the Prometheus metric-name alphabet: registry names
     may embed user-supplied strings (ingest_freshness_ms_<table>), and

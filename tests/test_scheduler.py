@@ -135,8 +135,8 @@ def test_killed_query_aborts_engine_loop(tmp_path):
     import pinot_tpu.broker.broker as broker_mod
     orig_register = global_accountant.register
 
-    def register_and_kill(query_id, deadline=None):
-        u = orig_register(query_id, deadline=deadline)
+    def register_and_kill(query_id, deadline=None, **kw):
+        u = orig_register(query_id, deadline=deadline, **kw)
         global_accountant.kill(query_id, "watcher says no")
         return u
 

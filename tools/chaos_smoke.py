@@ -280,6 +280,83 @@ def main_ingest(args) -> int:
 
 
 RATE_ROWS = 600
+OVERLOAD_ROWS = 2048
+
+
+def main_overload(args) -> int:
+    """--overload: the ISSUE-12 overload-resilience gate. One closed-
+    loop traffic replay (tools/traffic_replay.py, cluster mode): record
+    a three-tenant mix at 1x, replay it at --multiple N with chaos
+    armed, and assert the acceptance contract — protected-tenant p99
+    inside its bar with ZERO sheds/kills while besteffort sheds absorb
+    the excess, every shed a structured 429 with retryAfterMs, the
+    shed stream byte-identical to the pure same-seed plan, post-spike
+    latency back inside the pre-spike noise floor, and >=1 validated
+    ``replay_bench`` ledger record."""
+    import traffic_replay as TR
+    from pinot_tpu.utils import ledger as uledger
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_overload_")
+    ledger_path = os.path.join(tmp, "replay_bench.jsonl")
+    failures = []
+    summary = {"mode": "overload", "seed": args.seed,
+               "multiple": args.multiple, "rows": args.rows}
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+            print(f"FAIL {name}: {detail}")
+
+    try:
+        res = TR.run_gate(multiple=args.multiple, seed=args.seed,
+                          n_queries=args.replay_queries, rows=args.rows,
+                          mode="cluster", chaos=True,
+                          ledger_out=ledger_path)
+        summary.update({k: res.get(k) for k in (
+            "offered", "completed", "shed", "shed_by_tenant",
+            "shed_by_rung", "tiers", "structured_429", "retries",
+            "deterministic", "protected_sheds", "protected_p99_ms",
+            "protected_bar_ms", "goodput_qps", "faults_fired",
+            "recovered", "recovery")})
+        check("overload.ok", res.get("ok") is True,
+              res.get("error", "gate failed"))
+        check("overload.deterministic", res.get("deterministic") is True,
+              "same-seed shed streams diverged")
+        check("overload.protected_untouched",
+              res.get("protected_sheds") == 0
+              and (res.get("tiers") or {}).get(
+                  "protected", {}).get("errors", 1) == 0,
+              f"protected sheds={res.get('protected_sheds')} "
+              f"errors={(res.get('tiers') or {}).get('protected')}")
+        check("overload.besteffort_absorbs",
+              (res.get("shed_by_tenant") or {}).get(
+                  "ten_besteffort", 0) >= 1,
+              f"shed_by_tenant={res.get('shed_by_tenant')}")
+        check("overload.structured_429",
+              res.get("structured_429") == res.get("shed")
+              and res.get("shed", 0) >= 1,
+              f"{res.get('structured_429')} structured of "
+              f"{res.get('shed')} sheds")
+        check("overload.chaos_fired", res.get("faults_fired", 0) >= 1,
+              "the armed chaos plan never fired")
+        check("overload.recovered", res.get("recovered") is True,
+              f"recovery={res.get('recovery')}")
+        lres = uledger.validate_file(ledger_path)
+        summary["ledger_kinds"] = lres["kinds"]
+        check("overload.ledger_valid", not lres["errors"],
+              f"invalid records: {lres['errors'][:3]}")
+        check("overload.replay_bench_record",
+              lres["kinds"].get("replay_bench", 0) >= 1,
+              f"kinds={lres['kinds']}")
+    except Exception as e:  # noqa: BLE001 — into the summary
+        check("overload.run", False, f"EXC {type(e).__name__}: {e}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    return 0 if not failures else 1
 
 
 def main_rate(args) -> int:
@@ -454,6 +531,13 @@ def main(argv=None) -> int:
                     help="run the sustained ingest-while-query rate "
                          "gate (loadgen + ingest_bench + freshness "
                          "ratchet)")
+    ap.add_argument("--overload", action="store_true",
+                    help="run the closed-loop traffic-replay overload "
+                         "gate (tools/traffic_replay.py cluster mode)")
+    ap.add_argument("--multiple", type=float, default=4.0,
+                    help="--overload mode: replay load multiple")
+    ap.add_argument("--replay-queries", type=int, default=40,
+                    help="--overload mode: recorded-mix size")
     ap.add_argument("--seeds", default=",".join(map(str, INGEST_SEEDS)),
                     help="--ingest mode seeds (comma-separated)")
     ap.add_argument("--gate-iters", type=int, default=2,
@@ -462,11 +546,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.rows is None:
         args.rows = INGEST_ROWS if args.ingest \
-            else RATE_ROWS if args.rate else 4096
+            else RATE_ROWS if args.rate \
+            else OVERLOAD_ROWS if args.overload else 4096
     if args.ingest:
         return main_ingest(args)
     if args.rate:
         return main_rate(args)
+    if args.overload:
+        return main_overload(args)
 
     from pinot_tpu.cluster.http_util import http_json
     from pinot_tpu.utils import faults

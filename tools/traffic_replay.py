@@ -1,0 +1,793 @@
+"""Closed-loop traffic replay: the overload-resilience gate (ISSUE 12).
+
+ROADMAP direction 3 named the missing half of the millions-of-users
+story: replay real query mixes "at replayable multiples against a
+scaling cluster, with per-tenant accountant budgets enforcing QoS — the
+millions-of-users benchmark bench.py can't express". This harness is
+that loop, closed end to end:
+
+1. **Record** — a seeded three-tenant query mix (``protected`` /
+   ``standard`` / ``besteffort`` tables) runs at 1x through the real
+   broker path, landing ``query_stats`` ledger records that carry SQL,
+   per-query ``arrival_ms`` offsets, tenant and qid — the replay input
+   AND the pre-spike latency baseline.
+2. **Plan** — the recorded records compress to ``--multiple N`` x their
+   inter-arrival spacing. The offered-rate curve (a pure function of
+   ledger + multiple + capacity) maps through the SAME watermark ladder
+   live signals drive (``OverloadGovernor.rung_for_pressure``) into a
+   per-qid rung schedule, and the pure shed ladder
+   (``workload.shed_decision``) precomputes the full shed stream —
+   retries included (a shed query retries once after its deterministic
+   ``retryAfterMs``). The plan is computed TWICE and must match itself;
+   this is the round-16 stream-keying discipline applied to load
+   shedding.
+3. **Spike** — the rung schedule pins onto the broker's governor
+   (``pin_rungs`` — decisions stay in the broker: tier ladder, hash
+   draws, 429 shaping, counters, ledger rows all execute there), a
+   chaos plan arms (recoverable faults: straggler delay + one dropped
+   dispatch per server, so failover runs under load), and the replay
+   client dispatches on schedule, honoring each shed response's
+   ``retryAfterMs`` before its single retry. Every shed response must
+   be a structured 429 (errorCode + retryAfterMs) — a 500 anywhere
+   fails the gate.
+4. **Verify** — the broker's OBSERVED shed stream must equal the
+   precomputed one byte-for-byte; ``protected`` must see ZERO sheds and
+   zero errors with spike p99 inside its self-calibrated bar while
+   ``besteffort`` absorbs the excess; and after the spike the governor
+   unpins and a fresh 1x pass must land back inside the pre-spike noise
+   floor — no metastable retry-storm state.
+
+The summary lands as one validated ``replay_bench`` ledger record
+(utils/ledger.py). Consumers: ``tools/chaos_smoke.py --overload``
+(tier-1, cluster mode) and ``bench_common.finish()``'s overload gate
+(local mode).
+
+    python tools/traffic_replay.py gate [--multiple 4] [--seed N]
+        [--queries 48] [--mode cluster|local] [--no-chaos]
+        [--ledger OUT.jsonl]
+    python tools/traffic_replay.py plan STATS.jsonl --multiple 4
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# -- gate scenario ----------------------------------------------------------
+
+TENANT_TABLES = (
+    # (table, tenant, tier, mix weight)
+    ("rp_orders", "ten_protected", "protected", 3),
+    ("rp_events", "ten_standard", "standard", 3),
+    ("rp_logs", "ten_besteffort", "besteffort", 4),
+)
+
+QUERY_SHAPES = (
+    "SELECT k, SUM(v), COUNT(*) FROM {t} WHERE v < {p} GROUP BY k "
+    "ORDER BY k LIMIT 16",
+    "SELECT COUNT(*), SUM(v) FROM {t} WHERE v < {p}",
+)
+
+OPTION_TIMEOUT_MS = 120_000
+# pressure = offered qps / (recorded qps * CAPACITY_HEADROOM): at 1x the
+# steady offered rate reads ~0.4 — comfortably under every watermark —
+# while --multiple 4 plateaus at ~1.6, deep in rung 3, with the window
+# ramp passing rungs 1-2 at the spike edges
+CAPACITY_HEADROOM = 2.5
+PRESSURE_WINDOW_S = 0.25
+# recovery bar: post-spike p50 within factor x pre-spike p50 + floor
+# (floor absorbs scheduler jitter on tiny absolute latencies; the
+# metastable failure mode this guards against is 10-100x, not 2x)
+RECOVER_FACTOR = 3.0
+RECOVER_FLOOR_MS = 80.0
+# protected p99 bar during the spike, relative to its own pre-spike p99
+# (the floor absorbs the armed chaos plan's own injected straggler
+# delays + queueing on loaded CI boxes; the failure mode this guards —
+# protected queries starving behind an unshed backlog — is seconds)
+PROTECTED_BAR_FACTOR = 5.0
+PROTECTED_BAR_FLOOR_MS = 750.0
+
+
+def _pctl(sorted_vals: List[float], frac: float) -> float:
+    from pinot_tpu.utils.stats import pctl
+    return pctl(sorted_vals, frac)
+
+
+# -- clients (cluster HTTP vs in-process broker) ----------------------------
+
+class _Outcome:
+    __slots__ = ("kind", "ms", "payload")
+
+    def __init__(self, kind: str, ms: float = 0.0,
+                 payload: Optional[dict] = None):
+        self.kind = kind          # ok | shed | error
+        self.ms = ms
+        self.payload = payload or {}
+
+
+class _ClusterClient:
+    """POST /query/sql against a BrokerNode; a shed is HTTP 429 with
+    the structured payload (anything else shed-shaped fails the
+    structured-429 contract)."""
+
+    extra_opt = ""  # appended inside every OPTION(...) clause
+
+    def __init__(self, broker_url: str):
+        self.url = broker_url
+
+    def query(self, sql: str) -> _Outcome:
+        from pinot_tpu.cluster.http_util import http_json
+        t0 = time.perf_counter()
+        try:
+            http_json("POST", f"{self.url}/query/sql", {"sql": sql},
+                      timeout=120.0)
+            return _Outcome("ok", (time.perf_counter() - t0) * 1e3)
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:
+                body = {}
+            if e.code == 429:
+                return _Outcome("shed", payload=body)
+            return _Outcome("error", payload={
+                "status": e.code, **(body if isinstance(body, dict)
+                                     else {})})
+        except Exception as e:  # noqa: BLE001 — summarized, not raised
+            return _Outcome("error",
+                            payload={"error": f"{type(e).__name__}: {e}"})
+
+
+class _LocalClient:
+    """In-process Broker path: a shed raises OverloadShedError, whose
+    payload() is the same structured shape the HTTP plane ships."""
+
+    extra_opt = ""  # appended inside every OPTION(...) clause
+
+    def __init__(self, broker):
+        self.broker = broker
+
+    def query(self, sql: str) -> _Outcome:
+        from pinot_tpu.broker.workload import OverloadShedError
+        from pinot_tpu.query.sql import SqlError
+        t0 = time.perf_counter()
+        try:
+            self.broker.query(sql)
+            return _Outcome("ok", (time.perf_counter() - t0) * 1e3)
+        except OverloadShedError as e:
+            return _Outcome("shed", payload=e.payload())
+        except SqlError as e:
+            return _Outcome("error", payload={"error": str(e)})
+
+
+# -- cluster / table builders ----------------------------------------------
+
+def _gen_columns(rows: int, seed: int = 7) -> Dict[str, Any]:
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return {"k": rng.integers(0, 16, rows).astype(np.int32),
+            "v": rng.integers(0, 1000, rows).astype(np.int32)}
+
+
+def _schema(table: str):
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+    return Schema(table, [
+        FieldSpec("k", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+
+
+def configure_tenants() -> None:
+    """Register the gate's tenant tiers on the process-global workload
+    manager. Budgets stay unlimited here on purpose: the replay's shed
+    stream must be a pure function of the pinned rung schedule
+    (budget sheds are wall-clock-fed and unit-tested separately)."""
+    from pinot_tpu.broker.workload import global_workload
+    for _table, tenant, tier, _w in TENANT_TABLES:
+        global_workload.set_tenant(tenant, tier=tier)
+
+
+def build_cluster(tmp: str, rows: int = 4096, poll: float = 0.1):
+    """Controller + 2 servers + broker hosting the three tenant tables
+    (TableConfig ``tenant`` field shipped through the routing
+    snapshot)."""
+    from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.spi import TableConfig
+
+    ctrl = Controller(os.path.join(tmp, "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=0.2)
+    servers = [ServerNode(f"server_{i}", ctrl.url, poll_interval=poll)
+               for i in range(2)]
+    broker = BrokerNode(ctrl.url, routing_refresh=poll,
+                        query_stats_path=os.path.join(
+                            tmp, "query_stats.jsonl"))
+    cols = _gen_columns(rows)
+    for table, tenant, _tier, _w in TENANT_TABLES:
+        schema = _schema(table)
+        builder = SegmentBuilder(schema, TableConfig(table))
+        ctrl.add_table(table, schema.to_dict(),
+                       config={"tenant": tenant}, replication=2)
+        half = rows // 2
+        for i, (lo, hi) in enumerate(((0, half), (half, rows))):
+            d = builder.build({n: v[lo:hi] for n, v in cols.items()},
+                              os.path.join(tmp, table), f"seg_{i}")
+            ctrl.add_segment(table, f"seg_{i}", d)
+    v = ctrl.routing_snapshot()["version"]
+    for s in servers:
+        assert s.wait_for_version(v, timeout=30.0), "server never synced"
+    assert broker.wait_for_version(v, timeout=30.0), "broker never synced"
+
+    def stop():
+        broker.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        ctrl.stop()
+
+    return ctrl, servers, broker, stop
+
+
+def build_local(tmp: str, rows: int = 4096):
+    """In-process Broker hosting the same tenant tables (the
+    bench_common overload gate's fast mode)."""
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import TableConfig
+
+    broker = Broker()
+    cols = _gen_columns(rows)
+    for table, tenant, _tier, _w in TENANT_TABLES:
+        schema = _schema(table)
+        cfg = TableConfig(table, tenant=tenant)
+        dm = TableDataManager(table)
+        dm.table_config = cfg
+        dm.add_segment_dir(SegmentBuilder(schema, cfg).build(
+            cols, os.path.join(tmp, table), "seg_0"))
+        broker.register_table(dm)
+    return broker
+
+
+# -- the seeded mix ---------------------------------------------------------
+
+def build_mix(seed: int, n_queries: int) -> List[Dict[str, Any]]:
+    """The seeded (table, tenant, tier, sql) sequence — pure in
+    (seed, n)."""
+    import numpy as np
+    rng = np.random.default_rng([seed, 1209])
+    weighted = [t for t in TENANT_TABLES for _ in range(t[3])]
+    out = []
+    for i in range(n_queries):
+        table, tenant, tier, _w = \
+            weighted[int(rng.integers(len(weighted)))]
+        shape = QUERY_SHAPES[int(rng.integers(len(QUERY_SHAPES)))]
+        sql = shape.format(t=table, p=int(rng.integers(100, 1000)))
+        out.append({"qid": f"rp{seed}_{i}", "table": table,
+                    "tenant": tenant, "tier": tier, "sql": sql})
+    return out
+
+
+# -- recording --------------------------------------------------------------
+
+def record_phase(client, mix: List[Dict[str, Any]], qps: float,
+                 stats_path: Optional[str],
+                 prefix: str = "") -> Dict[str, Any]:
+    """Run the mix at 1x, paced at ``qps``; returns per-tier latency
+    baselines and (local mode) writes the query_stats records the
+    cluster broker would have written itself."""
+    from pinot_tpu.utils import ledger as uledger
+    lat: Dict[str, List[float]] = {}
+    errors = 0
+    t0 = time.perf_counter()
+    for i, q in enumerate(mix):
+        due = t0 + i / qps
+        now = time.perf_counter()
+        if due > now:
+            time.sleep(due - now)
+        sql = (f"{q['sql']} OPTION(timeoutMs={OPTION_TIMEOUT_MS},"
+               f"queryId={prefix}{q['qid']}{client.extra_opt})")
+        out = client.query(sql)
+        if out.kind == "ok":
+            lat.setdefault(q["tier"], []).append(out.ms)
+            if stats_path is not None:
+                # local mode writes the replay input itself — the SAME
+                # validated query_stats contract the cluster broker's
+                # forensics plane appends (arrival_ms per record)
+                uledger.append_record(uledger.make_record(
+                    "query_stats", qid=q["qid"], table=q["table"],
+                    wall_ms=round(out.ms, 3), partial=False,
+                    servers_queried=0, servers_responded=0,
+                    exception_codes=[], sql=q["sql"],
+                    tenant=q["tenant"],
+                    arrival_ms=round((time.perf_counter() - t0) * 1e3,
+                                     3)), stats_path)
+        else:
+            errors += 1
+    return {"latencies": {t: sorted(v) for t, v in lat.items()},
+            "errors": errors,
+            "duration_s": time.perf_counter() - t0}
+
+
+# -- the pure replay plan ---------------------------------------------------
+
+def load_records(stats_path: str) -> List[Dict[str, Any]]:
+    """query_stats records with the replay fields, arrival order."""
+    records = []
+    with open(stats_path) as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") == "query_stats" \
+                    and rec.get("sql") and not rec.get("shed") \
+                    and rec.get("arrival_ms") is not None:
+                # the cluster broker records the FULL SQL including its
+                # original OPTION clause; the replay appends its own
+                # (fresh qid/timeout/retryAttempt), so strip the old one
+                rec = dict(rec)
+                rec["sql"] = rec["sql"].split(" OPTION(")[0].rstrip()
+                records.append(rec)
+    records.sort(key=lambda r: (float(r["arrival_ms"]), r.get("qid")))
+    return records
+
+
+def plan_replay(records: List[Dict[str, Any]], multiple: float,
+                seed: int, capacity_qps: Optional[float] = None,
+                tier_of: Optional[Dict[str, str]] = None
+                ) -> Dict[str, Any]:
+    """The PURE replay plan: schedule + rung pins + predicted shed
+    stream, a function of (records, multiple, seed, capacity) only —
+    no clocks, no randomness beyond the seeded deterministic draws.
+
+    The offered-rate curve over the compressed schedule maps through
+    ``OverloadGovernor.rung_for_pressure`` (the same watermark ladder
+    live signals drive) into a rung per scheduled query; the pure shed
+    ladder then decides each (qid, tenant, tier) — and each shed
+    query's single retry is scheduled ``retryAfterMs`` later and
+    decided the same way. Computing this twice MUST yield identical
+    streams (the gate asserts it), and the live run's observed stream
+    must match it exactly."""
+    from pinot_tpu.broker.workload import (OverloadGovernor,
+                                           retry_after_ms,
+                                           shed_decision)
+    if not records:
+        return {"entries": [], "pins": {}, "shed_stream": [],
+                "capacity_qps": 0.0}
+    t_base = float(records[0]["arrival_ms"])
+    span_ms = max(float(records[-1]["arrival_ms"]) - t_base, 1.0)
+    if capacity_qps is None:
+        recorded_qps = len(records) / (span_ms / 1e3)
+        capacity_qps = recorded_qps * CAPACITY_HEADROOM
+    offsets = [(float(r["arrival_ms"]) - t_base) / 1e3 / multiple
+               for r in records]
+
+    def pressure_at(t: float, sched: List[float]) -> float:
+        lo = t - PRESSURE_WINDOW_S
+        n = sum(1 for s in sched if lo < s <= t)
+        return (n / PRESSURE_WINDOW_S) / capacity_qps
+
+    entries: List[Dict[str, Any]] = []
+    pins: Dict[str, int] = {}
+    shed_stream: List[Tuple[str, str, int, str, int]] = []
+    for r, off in zip(records, offsets):
+        qid = f"{r['qid']}_x{seed}"
+        tenant = r.get("tenant") or "default"
+        tier = (tier_of or {}).get(tenant) or r.get("tier") \
+            or "standard"
+        rung = OverloadGovernor.rung_for_pressure(
+            pressure_at(off, offsets))
+        pins[qid] = rung
+        entry = {"offset_s": off, "qid": qid, "sql": r["sql"],
+                 "tenant": tenant, "tier": tier, "rung": rung,
+                 "retry_attempt": 0}
+        entries.append(entry)
+        reason = shed_decision(qid, tenant, tier, rung)
+        if reason is None:
+            continue
+        after = retry_after_ms(qid, tenant, rung)
+        shed_stream.append((qid, tenant, rung, reason, after))
+        # the client-side retry contract: one retry, retryAfterMs
+        # later, marked retryAttempt=1 — decided by the same ladder
+        r_qid = f"{qid}_r1"
+        r_off = off + after / 1e3
+        r_rung = OverloadGovernor.rung_for_pressure(
+            pressure_at(r_off, offsets))
+        pins[r_qid] = r_rung
+        entries.append({"offset_s": r_off, "qid": r_qid, "sql": r["sql"],
+                        "tenant": tenant, "tier": tier, "rung": r_rung,
+                        "retry_attempt": 1, "retry_of": qid})
+        r_reason = shed_decision(r_qid, tenant, tier, r_rung)
+        if r_reason is not None:
+            shed_stream.append((r_qid, tenant, r_rung, r_reason,
+                                retry_after_ms(r_qid, tenant, r_rung)))
+    entries.sort(key=lambda e: (e["offset_s"], e["qid"]))
+    return {"entries": entries, "pins": pins,
+            "shed_stream": sorted(shed_stream),
+            "capacity_qps": capacity_qps}
+
+
+# -- the spike --------------------------------------------------------------
+
+def run_spike(client, plan: Dict[str, Any], workers: int = 8
+              ) -> Dict[str, Any]:
+    """Dispatch the plan on schedule (pins already installed by the
+    caller). Retries are REACTIVE: a worker that receives a shed
+    honors the RESPONSE's retryAfterMs — the plan's precomputed retry
+    entries are only the prediction it is checked against."""
+    lat: Dict[str, List[float]] = {}
+    sheds: List[Tuple[str, str, int, str, int]] = []
+    errors: Dict[str, int] = {}
+    structured = [0, 0]   # well-formed 429 payloads, malformed sheds
+    submitted = [0]
+    lock = threading.Lock()
+    sem = threading.Semaphore(workers)
+    threads: List[threading.Thread] = []
+    t0 = time.perf_counter()
+
+    def fire(entry: Dict[str, Any]) -> None:
+        sql = (f"{entry['sql']} OPTION("
+               f"timeoutMs={OPTION_TIMEOUT_MS},"
+               f"queryId={entry['qid']},"
+               f"retryAttempt={entry['retry_attempt']}"
+               f"{client.extra_opt})")
+        with lock:
+            submitted[0] += 1
+        out = client.query(sql)
+        if out.kind == "ok":
+            with lock:
+                lat.setdefault(entry["tier"], []).append(out.ms)
+            return
+        if out.kind == "error":
+            with lock:
+                errors[entry["tier"]] = \
+                    errors.get(entry["tier"], 0) + 1
+            return
+        p = out.payload
+        well_formed = (p.get("errorCode") == 429
+                       and isinstance(p.get("retryAfterMs"), int)
+                       and p.get("retryAfterMs") > 0)
+        with lock:
+            structured[0 if well_formed else 1] += 1
+            sheds.append((entry["qid"], p.get("tenant") or "?",
+                          int(p.get("rung") or 0),
+                          p.get("reason") or "?",
+                          int(p.get("retryAfterMs") or 0)))
+        if entry["retry_attempt"] == 0 and well_formed:
+            # honor the response: wait retryAfterMs, retry once
+            time.sleep(p["retryAfterMs"] / 1e3)
+            fire({**entry, "qid": f"{entry['qid']}_r1",
+                  "retry_attempt": 1})
+
+    def dispatch(entry: Dict[str, Any]) -> None:
+        try:
+            fire(entry)
+        finally:
+            sem.release()
+
+    for entry in plan["entries"]:
+        if entry["retry_attempt"]:
+            continue  # reactive retries only — predictions not replayed
+        due = t0 + entry["offset_s"]
+        now = time.perf_counter()
+        if due > now:
+            time.sleep(due - now)
+        sem.acquire()
+        th = threading.Thread(target=dispatch, args=(entry,),
+                              daemon=True)
+        threads.append(th)
+        th.start()
+    for th in threads:
+        th.join(timeout=130.0)
+    wall = time.perf_counter() - t0
+    return {"latencies": {t: sorted(v) for t, v in lat.items()},
+            "sheds": sorted(sheds), "errors": errors,
+            "submitted": submitted[0],
+            "structured_429": structured[0],
+            "malformed_sheds": structured[1],
+            "duration_s": wall}
+
+
+# -- the gate ---------------------------------------------------------------
+
+def run_gate(multiple: float = 4.0, seed: int = 20260805,
+             n_queries: int = 48, rows: int = 4096,
+             mode: str = "cluster", chaos: bool = True,
+             record_qps: float = 24.0,
+             ledger_out: Optional[str] = None,
+             keep_dir: Optional[str] = None) -> Dict[str, Any]:
+    """The full closed loop (module docstring). Returns the summary
+    dict; ``ok`` is the gate verdict. Resets the process-global
+    workload/governor state around the run."""
+    from pinot_tpu.broker.workload import (global_governor,
+                                           global_workload)
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils import ledger as uledger
+
+    tmp = keep_dir or tempfile.mkdtemp(prefix="ptpu_replay_")
+    failures: List[str] = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        if not ok:
+            failures.append(f"{name}: {detail}")
+
+    global_workload.reset()
+    faults.clear()
+    stop = None
+    summary: Dict[str, Any] = {
+        "mode": mode, "scenario": "overload_replay", "seed": seed,
+        "multiple": multiple, "queries_recorded": n_queries}
+    try:
+        configure_tenants()
+        stats_path = os.path.join(tmp, "replay_stats.jsonl")
+        if mode == "cluster":
+            _ctrl, _servers, broker, stop = build_cluster(tmp, rows)
+            stats_path = broker.forensics.ledger_path
+            client = _ClusterClient(broker.url)
+            p0 = _servers[0].port
+            chaos_plan_text = (
+                f"seed={seed}; "
+                f"segment.slow: match=server_0, delay_ms=40, times=8; "
+                f"rpc.drop: match=:{p0}/query/bin, times=1")
+        elif mode == "local":
+            broker = build_local(tmp, rows)
+            client = _LocalClient(broker)
+            # local-mode chaos is armed AFTER the plan is computed: an
+            # accountant OOM kill targeted at one ADMITTED besteffort
+            # query (the watcher-kill story under pressure; protected
+            # must still see zero kills)
+            chaos_plan_text = None
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+
+        mix = build_mix(seed, n_queries)
+        # warmup: every (table, shape) pays its XLA compile outside the
+        # measured phases
+        seen = set()
+        for q in mix:
+            key = (q["table"], q["sql"].split("FROM")[0])
+            if key in seen:
+                continue
+            seen.add(key)
+            client.query(f"{q['sql']} OPTION("
+                         f"timeoutMs={OPTION_TIMEOUT_MS},"
+                         f"queryId=warm_{len(seen)}"
+                         f"{client.extra_opt})")
+
+        # 1) record at 1x — the replay input + the pre-spike baseline
+        pre = record_phase(
+            client, mix, record_qps,
+            stats_path if mode == "local" else None)
+        check("record.errors", pre["errors"] == 0,
+              f"{pre['errors']} errors during the 1x recording")
+        records = [r for r in load_records(stats_path)
+                   if str(r.get("qid", "")).startswith(f"rp{seed}_")]
+        check("record.count", len(records) >= n_queries * 0.9,
+              f"only {len(records)} of {n_queries} recorded")
+
+        # 2) the pure plan, computed twice — must match itself
+        tier_of = {t[1]: t[2] for t in TENANT_TABLES}
+        plan = plan_replay(records, multiple, seed, tier_of=tier_of)
+        plan2 = plan_replay(records, multiple, seed, tier_of=tier_of)
+        deterministic = (plan["shed_stream"] == plan2["shed_stream"]
+                         and plan["pins"] == plan2["pins"])
+        check("plan.deterministic", deterministic,
+              "two same-seed plans diverged")
+        check("plan.sheds_besteffort",
+              any(s[1] == "ten_besteffort"
+                  for s in plan["shed_stream"]),
+              "the 4x plan shed no besteffort query — raise multiple")
+        check("plan.protected_never_shed",
+              all(s[1] != "ten_protected" for s in plan["shed_stream"]),
+              "plan shed a protected query")
+
+        if mode == "local" and chaos:
+            shed_qids = {s0[0] for s0 in plan["shed_stream"]}
+            victim = next(
+                (e["qid"] for e in plan["entries"]
+                 if e["tier"] == "besteffort"
+                 and not e["retry_attempt"]
+                 and e["qid"] not in shed_qids), None)
+            check("plan.oom_victim", victim is not None,
+                  "no admitted besteffort query to target with "
+                  "accounting.oom_kill")
+            chaos_plan_text = (
+                f"seed={seed}; accounting.oom_kill: match={victim}, "
+                f"times=1") if victim else None
+
+        # 3) the spike: pins + chaos armed, replay on schedule
+        global_workload.clear_shed_log()
+        global_governor.pin_rungs(plan["pins"])
+        fault_plan = faults.install(chaos_plan_text) \
+            if chaos and chaos_plan_text else None
+        try:
+            spike = run_spike(client, plan)
+        finally:
+            fired = len(fault_plan.fired) if fault_plan else 0
+            faults.clear()
+            global_governor.unpin()
+        observed = [s for s in global_workload.shed_stream()
+                    if s[0] in plan["pins"]]
+
+        # 4) verify
+        check("spike.stream_matches_plan",
+              observed == plan["shed_stream"],
+              f"observed {len(observed)} shed(s) != planned "
+              f"{len(plan['shed_stream'])}")
+        client_seen = sorted(s[0] for s in spike["sheds"])
+        planned_qids = sorted(s[0] for s in plan["shed_stream"])
+        check("spike.client_saw_every_shed",
+              client_seen == planned_qids,
+              f"client saw {len(client_seen)} shed responses, "
+              f"planned {len(planned_qids)}")
+        check("spike.structured_429",
+              spike["malformed_sheds"] == 0
+              and spike["structured_429"] == len(spike["sheds"]),
+              f"{spike['malformed_sheds']} shed responses were not "
+              "structured 429s")
+        check("spike.protected_zero_sheds",
+              not any(s[1] == "ten_protected" for s in observed),
+              "a protected-tenant query was shed")
+        check("spike.protected_zero_errors",
+              spike["errors"].get("protected", 0) == 0,
+              f"{spike['errors'].get('protected', 0)} protected "
+              "errors (OOM-kill/5xx) during the spike")
+        pre_prot = pre["latencies"].get("protected") or [0.0]
+        prot = spike["latencies"].get("protected") or []
+        prot_bar = (_pctl(pre_prot, 0.99) * PROTECTED_BAR_FACTOR
+                    + PROTECTED_BAR_FLOOR_MS)
+        prot_p99 = _pctl(prot, 0.99) if prot else 0.0
+        check("spike.protected_completed", len(prot) >= 1,
+              "no protected query completed during the spike")
+        check("spike.protected_p99_bar", prot_p99 <= prot_bar,
+              f"protected p99 {prot_p99:.1f}ms > bar {prot_bar:.1f}ms")
+        if chaos:
+            check("spike.chaos_fired", fired >= 1,
+                  "the armed chaos plan never fired")
+
+        # 5) recovery: fresh 1x pass must land inside the noise floor
+        post_mix = [{**q, "qid": q["qid"] + "_post"} for q in mix]
+        post = record_phase(client, post_mix, record_qps, None)
+        pre_all = sorted(x for v in pre["latencies"].values()
+                         for x in v)
+        post_all = sorted(x for v in post["latencies"].values()
+                          for x in v)
+        pre_p50 = _pctl(pre_all, 0.5)
+        post_p50 = _pctl(post_all, 0.5)
+        recover_bar = pre_p50 * RECOVER_FACTOR + RECOVER_FLOOR_MS
+        recovered = bool(post_all) and post_p50 <= recover_bar
+        check("recovery", recovered,
+              f"post-spike p50 {post_p50:.1f}ms > bar "
+              f"{recover_bar:.1f}ms (pre {pre_p50:.1f}ms) — "
+              "metastable state?")
+
+        completed = sum(len(v) for v in spike["latencies"].values())
+        shed_by_tenant: Dict[str, int] = {}
+        shed_by_rung: Dict[str, int] = {}
+        shed_by_reason: Dict[str, int] = {}
+        for _qid, tn, rung, reason, _after in observed:
+            shed_by_tenant[tn] = shed_by_tenant.get(tn, 0) + 1
+            shed_by_rung[str(rung)] = shed_by_rung.get(str(rung), 0) + 1
+            shed_by_reason[reason] = shed_by_reason.get(reason, 0) + 1
+        tiers = {}
+        for tier in ("protected", "standard", "besteffort"):
+            lat = spike["latencies"].get(tier) or []
+            tiers[tier] = {
+                "completed": len(lat),
+                "p50_ms": round(_pctl(lat, 0.5), 3),
+                "p99_ms": round(_pctl(lat, 0.99), 3),
+                "errors": spike["errors"].get(tier, 0),
+            }
+        summary.update({
+            "backend": _backend(),
+            "offered": spike["submitted"],
+            "completed": completed,
+            "shed": len(observed),
+            "shed_by_tenant": shed_by_tenant,
+            "shed_by_rung": shed_by_rung,
+            "shed_by_reason": shed_by_reason,
+            "tiers": tiers,
+            "structured_429": spike["structured_429"],
+            "retries": len([s for s in spike["sheds"]
+                            if s[0].endswith("_r1")]),
+            "deterministic": bool(deterministic
+                                  and observed == plan["shed_stream"]),
+            "protected_sheds": shed_by_tenant.get("ten_protected", 0),
+            "protected_p99_ms": round(prot_p99, 3),
+            "protected_bar_ms": round(prot_bar, 3),
+            "goodput_qps": round(
+                completed / max(spike["duration_s"], 1e-3), 3),
+            "duration_s": round(spike["duration_s"], 3),
+            "spike_errors": sum(spike["errors"].values()),
+            "chaos": chaos,
+            "faults_fired": fired,
+            "recovered": recovered,
+            "recovery": {"pre_p50_ms": round(pre_p50, 3),
+                         "post_p50_ms": round(post_p50, 3),
+                         "bar_ms": round(recover_bar, 3)},
+            "ok": not failures,
+        })
+        if failures:
+            summary["error"] = "; ".join(failures[:4])
+        if ledger_out:
+            contract = uledger.KINDS["replay_bench"]
+            allowed = contract["required"] | contract["optional"]
+            rec = uledger.make_record("replay_bench", **{
+                k: v for k, v in summary.items() if k in allowed})
+            uledger.append_record(rec, ledger_out)
+        summary["failures"] = failures
+        return summary
+    finally:
+        faults.clear()
+        global_workload.reset()
+        if stop is not None:
+            stop()
+        if keep_dir is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _backend() -> str:
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
+
+
+# -- CLI --------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd")
+    g = sub.add_parser("gate", help="full closed-loop overload gate")
+    g.add_argument("--multiple", type=float, default=4.0)
+    g.add_argument("--seed", type=int, default=20260805)
+    g.add_argument("--queries", type=int, default=48)
+    g.add_argument("--rows", type=int, default=4096)
+    g.add_argument("--mode", choices=("cluster", "local"),
+                   default="cluster")
+    g.add_argument("--no-chaos", action="store_true")
+    g.add_argument("--ledger", default=None,
+                   help="append the replay_bench record here")
+    p = sub.add_parser("plan", help="print the pure shed-decision "
+                                    "stream for a query_stats ledger")
+    p.add_argument("stats", help="query_stats JSONL path")
+    p.add_argument("--multiple", type=float, default=4.0)
+    p.add_argument("--seed", type=int, default=20260805)
+    args = ap.parse_args(argv)
+    if args.cmd == "plan":
+        records = load_records(args.stats)
+        plan = plan_replay(records, args.multiple, args.seed)
+        print(json.dumps({
+            "records": len(records),
+            "capacity_qps": round(plan["capacity_qps"], 3),
+            "entries": len(plan["entries"]),
+            "shed_stream": [list(s) for s in plan["shed_stream"]]}))
+        return 0
+    if args.cmd != "gate":
+        ap.print_help()
+        return 2
+    summary = run_gate(multiple=args.multiple, seed=args.seed,
+                       n_queries=args.queries, rows=args.rows,
+                       mode=args.mode, chaos=not args.no_chaos,
+                       ledger_out=args.ledger)
+    print(json.dumps(summary))
+    return 0 if summary.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
