@@ -151,6 +151,18 @@ def prune_segments(segment_meta: Dict[str, Dict[str, Any]], where: Any,
 # instance selection
 # ---------------------------------------------------------------------------
 
+# placement-affinity multipliers (HBM tier, engine/tier.py): a replica
+# already holding a segment's columns hot — or a warm ragged cube for
+# the plan key — answers without paying the upload, so its adaptive
+# score shrinks by the factor. Warm (padded host arrays) still skips
+# the mmap re-pad, a weaker but real preference. Unknown/cold = 1.0.
+PLACEMENT_AFFINITY = {"hot": 0.3, "cube": 0.45, "warm": 0.6}
+
+# every selector's select() accepts ``placement`` ({segment: {server:
+# tier}} from the residency heartbeats); only the adaptive selector
+# uses it — the deterministic selectors keep their reference semantics
+
+
 class BalancedInstanceSelector:
     """Round-robin across healthy replicas per segment (the default)."""
 
@@ -158,7 +170,7 @@ class BalancedInstanceSelector:
         self._rr = 0
 
     def select(self, assignment: Dict[str, List[str]],
-               healthy) -> Dict[str, Optional[str]]:
+               healthy, placement=None) -> Dict[str, Optional[str]]:
         out: Dict[str, Optional[str]] = {}
         for seg, holders in assignment.items():
             cands = [h for h in holders if healthy(h)] or list(holders)
@@ -180,7 +192,7 @@ class ReplicaGroupInstanceSelector:
         self._rr = 0
 
     def select(self, assignment: Dict[str, List[str]],
-               healthy) -> Dict[str, Optional[str]]:
+               healthy, placement=None) -> Dict[str, Optional[str]]:
         self._rr += 1
         r = self._rr
         out: Dict[str, Optional[str]] = {}
@@ -202,7 +214,7 @@ class StrictReplicaGroupInstanceSelector(ReplicaGroupInstanceSelector):
     (strict consistency for partial-upsert routing)."""
 
     def select(self, assignment: Dict[str, List[str]],
-               healthy) -> Dict[str, Optional[str]]:
+               healthy, placement=None) -> Dict[str, Optional[str]]:
         self._rr += 1
         r = self._rr
         out: Dict[str, Optional[str]] = {}
@@ -216,7 +228,12 @@ class StrictReplicaGroupInstanceSelector(ReplicaGroupInstanceSelector):
 class AdaptiveServerSelector:
     """Latency-EWMA + in-flight aware replica choice
     (adaptiveserverselector/ NumInFlightReqSelector + LatencySelector
-    hybrid): score = ewma_latency_ms * (1 + in_flight)."""
+    hybrid): score = ewma_latency_ms * (1 + in_flight), scaled by the
+    placement-affinity factor when tier residency is known — a replica
+    already holding the segment hot (or a warm cube) wins unless its
+    latency/in-flight picture is badly worse, and the server-name
+    tiebreak keeps repeated picks sticky instead of ping-ponging
+    uploads across replicas."""
 
     ALPHA = 0.3
 
@@ -238,9 +255,7 @@ class AdaptiveServerSelector:
                 (1 - self.ALPHA) * prev + self.ALPHA * latency_ms
 
     def score(self, server: str) -> float:
-        with self._lock:
-            return self._lat.get(server, 1.0) * \
-                (1 + self._inflight.get(server, 0))
+        return self._score_default(server, 1.0)
 
     def estimate_ms(self, server: str) -> Optional[float]:
         """Latency EWMA for hedging decisions (None until the first
@@ -248,12 +263,35 @@ class AdaptiveServerSelector:
         with self._lock:
             return self._lat.get(server)
 
+    def _score_default(self, server: str, default: float) -> float:
+        """score() with an explicit unknown-latency default (the
+        placement-aware path): the stock 1.0 ms optimism makes a
+        never-measured replica out-bid a measured one holding the
+        segment HOT, ping-ponging uploads across replicas — with
+        residency in hand, an unknown server scores like the average
+        known one instead."""
+        with self._lock:
+            return self._lat.get(server, default) * \
+                (1 + self._inflight.get(server, 0))
+
     def select(self, assignment: Dict[str, List[str]],
-               healthy) -> Dict[str, Optional[str]]:
+               healthy, placement=None) -> Dict[str, Optional[str]]:
+        with self._lock:
+            lats = list(self._lat.values())
+        mean_lat = (sum(lats) / len(lats)) if lats else 1.0
         out: Dict[str, Optional[str]] = {}
         for seg, holders in assignment.items():
             cands = [h for h in holders if healthy(h)] or list(holders)
-            out[seg] = min(cands, key=self.score) if cands else None
+            if not cands:
+                out[seg] = None
+                continue
+            tiers = (placement or {}).get(seg) or {}
+            default = mean_lat if tiers else 1.0
+            out[seg] = min(
+                cands,
+                key=lambda h: (self._score_default(h, default)
+                               * PLACEMENT_AFFINITY.get(tiers.get(h),
+                                                        1.0), h))
         return out
 
 

@@ -99,6 +99,11 @@ class ScatterResult:
     # executions rode, and the largest batch any of them shared
     batched_dispatches: int = 0
     batch_size_max: int = 0
+    # placement-affinity routing (HBM tier): segments this scatter sent
+    # to a replica already holding them hot (or a warm cube) — the
+    # per-query avoided-upload count (set on the scatter thread before
+    # dispatch, never from pool threads)
+    affinity_hits: int = 0
     # failovers/serde/net increment from call() on POOL threads —
     # float/int += is a non-atomic read-modify-write (the same race _rr
     # hit before its itertools.count fix), so they mutate under this lock
@@ -314,6 +319,18 @@ class BrokerNode:
                       ) -> Dict[str, Any]:
         snap = snap if snap is not None else self._snapshot()
         return (snap.get("tables", {}).get(table) or {}).get("config") or {}
+
+    def _placement(self, table: str,
+                   snap: Dict[str, Any]) -> Dict[str, Dict[str, str]]:
+        """{segment: {server: tier}} from the heartbeat-shipped
+        residency blocks (HBM tier placement signal); empty when no
+        server reports residency for this table."""
+        out: Dict[str, Dict[str, str]] = {}
+        for sid, inst in (snap.get("instances") or {}).items():
+            res = (inst.get("residency") or {}).get(table) or {}
+            for seg, tier in res.items():
+                out.setdefault(seg, {})[sid] = tier
+        return out
 
     def _segment_meta(self, table: str,
                       snap: Optional[Dict[str, Any]] = None
@@ -761,11 +778,31 @@ class BrokerNode:
                       for s, holders in assignment.items()}
 
         # instance selection (pluggable: balanced / replicaGroup /
-        # strictReplicaGroup / adaptive)
+        # strictReplicaGroup / adaptive) — placement-aware: the
+        # residency heartbeats tell the adaptive selector which
+        # replicas already hold each segment hot (HBM tier)
         def healthy(h: str) -> bool:
             return self._failures.healthy(h)
 
-        picks = self._selector.select(assignment, healthy)
+        placement = self._placement(ctx.table, snap)
+        picks = self._selector.select(assignment, healthy,
+                                      placement=placement)
+        if placement:
+            # avoided-vs-paid uploads: a pick landing on a replica
+            # that holds the segment hot (or a warm cube) skips the
+            # column upload entirely. Segments NO server reported
+            # residency for (heartbeat cap, table not yet surveyed)
+            # count neither way — they would understate the hit ratio
+            # through no fault of the routing
+            for seg, pick in picks.items():
+                tiers = placement.get(seg)
+                if pick is None or not tiers:
+                    continue
+                if tiers.get(pick) in ("hot", "cube"):
+                    res.affinity_hits += 1
+                    global_metrics.count("tier_affinity_hits")
+                else:
+                    global_metrics.count("tier_affinity_misses")
         unserved = [s for s, p in picks.items() if p is None]
         if unserved:
             msg = (f"no live replica for segments {unserved[:3]}"
@@ -1221,6 +1258,7 @@ class BrokerNode:
         the round-9 scatter counters (in-process roles share
         global_metrics; a standalone broker reports zeros)."""
         from ..engine.ragged import batching_health
+        from ..engine.tier import tier_health
         from ..utils.metrics import overload_health
         snap = global_metrics.snapshot()
         c = snap["counters"]
@@ -1245,6 +1283,9 @@ class BrokerNode:
             # overload-protection plane (ISSUE 12): shed/degrade-rung
             # counters + per-tenant gauges (broker/workload.py)
             "overload": overload,
+            # HBM tier occupancy + placement-affinity hit ratio
+            # (engine/tier.py) — the memory-hierarchy health block
+            "tier": tier_health(snap),
         }
 
     # -- REST --------------------------------------------------------------
@@ -1417,6 +1458,20 @@ async function health(){
       ', leader-error '+(sf.leader_error||0)+
       ' | errors '+(b.fused_dispatch_errors||0)+
       ' | sizes '+JSON.stringify(b.batch_size_histogram||{})+
+      '\\ntier ('+((m.tier||{}).armed?'budget '+
+        ((m.tier||{}).budget_bytes||0)+'B':'unbounded')+'): hot '+
+      (((m.tier||{}).hot||{}).segments||0)+' seg / '+
+      (((m.tier||{}).hot||{}).bytes||0)+'B | warm '+
+      (((m.tier||{}).warm||{}).segments||0)+' seg / '+
+      (((m.tier||{}).warm||{}).bytes||0)+'B | cold '+
+      (((m.tier||{}).cold||{}).segments||0)+
+      ' | promotions '+((m.tier||{}).promotions||0)+
+      ' | demotions '+((m.tier||{}).demotions||0)+
+      ' | affinity '+((m.tier||{}).affinity_hits||0)+'/'+
+      (((m.tier||{}).affinity_hits||0)+
+       ((m.tier||{}).affinity_misses||0))+
+      ((m.tier||{}).affinity_hit_ratio!=null?
+        ' ('+((m.tier||{}).affinity_hit_ratio*100).toFixed(1)+'%)':'')+
       '\\noverload: rung '+(o.rung||0)+
       ' | shed '+(o.overload_shed||0)+
       ' (rung2 '+((o.shed_by_rung||{})['2']||0)+

@@ -298,12 +298,19 @@ class Controller:
             self._instances[inst["id"]] = inst
             self._reconcile_locked()
 
-    def heartbeat(self, instance_id: str) -> bool:
+    def heartbeat(self, instance_id: str,
+                  residency: Optional[Dict[str, Any]] = None) -> bool:
+        """Liveness refresh; servers also piggyback their per-segment
+        tier residency ({table: {segment: hot|warm|cold|cube}}, the
+        HBM-tier placement signal the routing snapshot ships to
+        brokers for affinity routing)."""
         with self._lock:
             inst = self._instances.get(instance_id)
             if inst is None:
                 return False
             inst["lastHeartbeat"] = time.monotonic()
+            if residency is not None:
+                inst["residency"] = residency
             return True
 
     def live_servers(self, tenant: Optional[str] = None) -> List[str]:
@@ -872,10 +879,15 @@ class Controller:
                 }
                 self._routing_cache = snap
                 snap = dict(snap)
-            # liveness is heartbeat-driven, not version-driven: always fresh
+            # liveness is heartbeat-driven, not version-driven: always
+            # fresh — residency (the HBM-tier placement signal) rides
+            # the same path because it changes with every query, not
+            # with the assignment version
             snap["instances"] = {
                 i["id"]: {"host": i["host"], "port": i["port"],
-                          "role": i.get("role")}
+                          "role": i.get("role"),
+                          **({"residency": i["residency"]}
+                             if i.get("residency") else {})}
                 for i in self._instances.values()}
             snap["liveServers"] = self.live_servers()
             snap["liveBrokers"] = self.live_brokers()
@@ -925,7 +937,8 @@ class Controller:
                     ctrl.register_instance(b) or (200, {"status": "OK"})),
                 ("POST", "/heartbeat/"): lambda h, b: (
                     (200, {"status": "OK"})
-                    if ctrl.heartbeat(h.path.rsplit("/", 1)[1])
+                    if ctrl.heartbeat(h.path.rsplit("/", 1)[1],
+                                      (b or {}).get("residency"))
                     else (404, {"error": "unknown instance"})),
                 ("POST", "/tables"): lambda h, b: (
                     ctrl.add_table(b["name"], b["schema"],

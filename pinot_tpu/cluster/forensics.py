@@ -196,6 +196,12 @@ class QueryForensics:
             fields["batched"] = batched
             fields["batch_size"] = max(
                 getattr(s, "batch_size_max", 0) for s in scatters)
+        # placement-affinity routing (HBM tier): segments dispatched to
+        # a replica already holding them hot — the per-query
+        # avoided-upload trend line the fleet rollup aggregates
+        affinity = sum(getattr(s, "affinity_hits", 0) for s in scatters)
+        if affinity:
+            fields["tier_affinity_hits"] = affinity
         rec = uledger.make_record("query_stats", **fields)
         if self.ledger_path:
             try:
@@ -311,6 +317,7 @@ def ledger_debug_payload(node_id: str, role: str, path: Optional[str],
     device-memory pools and the segment-heat table — so one pull per
     node gathers everything the fleet view needs."""
     from ..engine.ragged import batching_health
+    from ..engine.tier import global_tier
     from ..utils.devmem import global_device_memory
     from ..utils.heat import global_segment_heat
     records, next_seq = read_ledger_since(path, since)
@@ -321,16 +328,26 @@ def ledger_debug_payload(node_id: str, role: str, path: Optional[str],
             "counters": snap["counters"], "gauges": snap["gauges"],
             "batching": batching_health(snap),
             "memory": global_device_memory.snapshot(),
+            "tier": global_tier.snapshot(),
             "heat": global_segment_heat.snapshot(top=heat_top)}
 
 
-def memory_debug_payload(node_id: str) -> Dict[str, Any]:
+def memory_debug_payload(node_id: str,
+                         residency: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
     """GET /debug/memory payload: what lives in HBM on this node right
-    now — per-pool live bytes / entries / evictions (utils/devmem) and
-    the hottest segments (utils/heat). The admission/eviction signal
-    the future HBM-tiered segment cache consumes (ROADMAP direction 3)."""
+    now — per-pool live bytes / entries / evictions (utils/devmem), the
+    tier occupancy (engine/tier.py hot/warm/cold + budget), this node's
+    per-segment tier residency (servers pass it — the same block their
+    heartbeats ship for affinity routing) and the hottest segments
+    (utils/heat)."""
+    from ..engine.tier import global_tier
     from ..utils.devmem import global_device_memory
     from ..utils.heat import global_segment_heat
-    return {"node": node_id, "proc": PROC_TOKEN,
-            "pools": global_device_memory.snapshot(),
-            "heat": global_segment_heat.snapshot(top=50)}
+    out = {"node": node_id, "proc": PROC_TOKEN,
+           "pools": global_device_memory.snapshot(),
+           "tier": global_tier.snapshot(),
+           "heat": global_segment_heat.snapshot(top=50)}
+    if residency is not None:
+        out["residency"] = residency
+    return out

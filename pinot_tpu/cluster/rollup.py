@@ -65,6 +65,9 @@ NODE_COUNTER_KEYS = (
     "scatter_server_errors", "batched_dispatches", "batched_queries",
     "fused_dispatch_errors", "cube_cache_hits", "cube_cache_misses",
     "sampled_traces", "faults_fired",
+    # HBM tier (engine/tier.py): paid uploads / budget demotions /
+    # affinity-routed avoided uploads
+    "tier_promotions", "tier_demotions", "tier_affinity_hits",
 )
 
 
@@ -355,6 +358,7 @@ class ForensicsRollupTask:
                              for k in NODE_COUNTER_KEYS},
                 "batching": resp.get("batching"),
                 "memory": resp.get("memory"),
+                "tier": resp.get("tier"),
                 "heat": resp.get("heat"),
             }
         self._save_cursors()
@@ -368,7 +372,10 @@ class ForensicsRollupTask:
                 "counters": b["counters"],
                 "memory": {p: v for p, v in
                            ((b.get("memory") or {}).items())
-                           if p == "total" or (v or {}).get("entries")}}
+                           if p == "total" or (v or {}).get("entries")},
+                # HBM tier occupancy beside the device-bytes block
+                # (webapp Fleet view renders both)
+                **({"tier": b["tier"]} if b.get("tier") else {})}
             for n, b in node_blocks.items()}
         fields: Dict[str, Any] = {
             "nodes_polled": len(targets),

@@ -114,13 +114,41 @@ class ServerNode:
                     raise
                 time.sleep(min(0.5 * (attempt + 1), 5.0))
 
+    def _residency(self, cap: int = 512) -> Dict[str, Dict[str, str]]:
+        """Per-table {segment: tier} for THIS node's hosted segments —
+        the placement signal every heartbeat carries (the broker's
+        affinity routing prefers replicas already holding a segment
+        hot). ``cube`` marks a non-hot segment whose ragged cube is
+        resident (it answers plan-key-sharing queries without any
+        column upload). Capped so a wide node can't bloat the
+        control-plane heartbeat."""
+        from ..engine.tier import TIER_HOT, segment_tier
+        from ..ops.plan_cache import global_cube_cache
+        cube_uids = global_cube_cache.resident_uids()
+        out: Dict[str, Dict[str, str]] = {}
+        n = 0
+        for table, dm in list(self._tables.items()):
+            segs: Dict[str, str] = {}
+            for s in dm.acquire_segments():
+                if n >= cap:
+                    break
+                t = segment_tier(s)
+                if t != TIER_HOT and getattr(s, "uid", None) in cube_uids:
+                    t = "cube"
+                segs[s.name] = t
+                n += 1
+            if segs:
+                out[table] = segs
+        return out
+
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
             try:
                 try:
                     http_json("POST",
                               f"{self.controller_url}/heartbeat/"
-                              f"{self.instance_id}")
+                              f"{self.instance_id}",
+                              {"residency": self._residency()})
                 except urllib.error.HTTPError as e:
                     if e.code != 404:
                         raise
@@ -387,7 +415,8 @@ class ServerNode:
                         node.instance_id, "server", node.ledger_path,
                         parse_since(h.path))),
                 ("GET", "/debug/memory"): lambda h, b: (
-                    200, memory_debug_payload(node.instance_id)),
+                    200, memory_debug_payload(node.instance_id,
+                                              node._residency())),
                 ("POST", "/query/bin"): lambda h, b: (
                     200, node.execute_bin(b["sql"], b.get("segments"),
                                           b.get("deadlineMs"),

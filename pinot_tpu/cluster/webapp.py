@@ -180,22 +180,31 @@ function vFleet() {
       h.rows_scanned,
       h.device_hit_ratio != null ? h.device_hit_ratio : "-"]));
   const nodes = table(["node", "role", "drift det/req/rec",
-      "retraces", "batched", "cube hit/miss", "device bytes"],
+      "retraces", "batched", "cube hit/miss", "device bytes",
+      "tier hot/warm/cold", "promote/demote", "affinity"],
     Object.entries(r.nodes || {}).map(([n, b]) => {
       const c = b.counters || {};
       const mem = ((b.memory || {}).total || {}).bytes || 0;
+      const t = b.tier || {};
+      const th = t.hot || {}, tw = t.warm || {}, tc = t.cold || {};
       return [esc(n), esc(b.role || ""),
         `${c.selectivity_drift_detected || 0}/` +
           `${c.selectivity_drift_requantized || 0}/` +
           `${c.selectivity_drift_recompiles || 0}`,
         c.plan_cache_retraces || 0, c.batched_dispatches || 0,
-        `${c.cube_cache_hits || 0}/${c.cube_cache_misses || 0}`, mem];
+        `${c.cube_cache_hits || 0}/${c.cube_cache_misses || 0}`, mem,
+        `${th.segments || 0} (${th.bytes || 0}B) / ` +
+          `${tw.segments || 0} (${tw.bytes || 0}B) / ` +
+          `${tc.segments || 0}` +
+          (t.armed ? ` · budget ${t.budget_bytes}B` : ""),
+        `${c.tier_promotions || 0}/${c.tier_demotions || 0}`,
+        c.tier_affinity_hits || 0];
     }));
   return `<h2>Fleet forensics</h2>${pull}
     <h3>Per-table fleet stats</h3>${tbl}
     <h3>Slowest queries</h3>${slow}
     <h3>Hot segments</h3>${heat}
-    <h3>Drift / batching / device memory per node</h3>${nodes}`;
+    <h3>Drift / batching / device memory / HBM tier per node</h3>${nodes}`;
 }
 
 function vTasks() {

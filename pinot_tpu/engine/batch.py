@@ -100,12 +100,18 @@ def _stacked_cols(plans: List[CompiledPlan], bucket: int
             return cols
         _STACK_CACHE[key] = cols
         # device-memory telemetry: the stack cache is an HBM resident
-        # the future tiered store must see (utils/devmem, /debug/memory)
+        # the tiered store manages (utils/devmem, /debug/memory)
         global_device_memory.add("stack_cache", key,
                                  sum(int(c.nbytes) for c in cols))
         while len(_STACK_CACHE) > _STACK_CACHE_MAX:
             old_key, _old = _STACK_CACHE.popitem(last=False)
             global_device_memory.remove("stack_cache", old_key)
+    # shared-budget admission (engine/tier.py), OUTSIDE _STACK_LOCK
+    # (the demotion path re-enters evict_stacks_containing): a stack
+    # insert can push HBM over budget — demote the coldest segments
+    # outside this group's working set
+    from .tier import global_tier
+    global_tier.enforce(protect={u for u, _n in key[0]})
     return cols
 
 
@@ -210,38 +216,52 @@ def execute_plans_batched(plans: List[CompiledPlan]) -> List[Any]:
                 for k, i in enumerate(idxs):
                     results[i] = partials[k]
                 continue
-        cols = _stacked_cols(group_plans, bucket)
-        n_docs = jnp.asarray([p.segment.n_docs for p in group_plans],
-                             dtype=jnp.int32)
-        params = tuple(
-            jnp.stack([resolved[i][j] for i in idxs])
-            for j in range(len(resolved[idxs[0]])))
-        if kind == "segc":
-            _run_segmented_compact(plans, idxs, plan_struct, bucket,
-                                   cols, n_docs, params, results)
-            continue
-        with span("vmap_dispatch", segments=n_seg, bucket=bucket,
-                  strategy=plan_struct.strategy):
-            _maybe_profile_phases(group_plans[0])
-            fn = _vmapped_kernel(plan_struct, bucket)
-            with span("device_execute"):
-                dev = fn(cols, n_docs, params)
-                device_fence(dev)
-            with span("device_transfer"):
-                out = jax.device_get(dev)  # jaxlint: ok host-sync
-            global_accountant.track_result(out)
-            # per-segment slicing below runs on host numpy behind the
-            # single fence above — host-sync [jaxlint baseline]
-            for k, i in enumerate(idxs):
-                per_seg = {name: v[k] for name, v in out.items()}
-                if int(per_seg.pop("group_overflow", 0)):
-                    # this segment alone exceeded the transfer-compaction
-                    # cap; rerun it solo, straight to dense outputs
-                    from .executor import run_kernel
-                    dense = run_kernel(plans[i], xfer_compact=False)
-                    results[i] = extract_partial(plans[i], dense)
-                else:
-                    results[i] = extract_partial(plans[i], per_seg)
+        # tier access hook BEFORE the stack build: a warm stack hit
+        # never reaches device_col, so this is where the tier.evict
+        # chaos point can force a mid-query demotion of a segment this
+        # group is using (the build below then re-promotes it)
+        from .tier import global_tier
+        for p in group_plans:
+            global_tier.on_access(p.segment)
+        # pin the group's working set for the WHOLE dispatch (stack
+        # build through extraction): a budget demotion triggered from
+        # THIS thread — the group's own admissions, or a nested plan-
+        # cache accumulator registration — must pick victims outside it
+        # (engine/tier.py, anti-thrash)
+        with global_tier.pinned({p.segment.uid for p in group_plans}):
+            cols = _stacked_cols(group_plans, bucket)
+            n_docs = jnp.asarray([p.segment.n_docs for p in group_plans],
+                                 dtype=jnp.int32)
+            params = tuple(
+                jnp.stack([resolved[i][j] for i in idxs])
+                for j in range(len(resolved[idxs[0]])))
+            if kind == "segc":
+                _run_segmented_compact(plans, idxs, plan_struct, bucket,
+                                       cols, n_docs, params, results)
+                continue
+            with span("vmap_dispatch", segments=n_seg, bucket=bucket,
+                      strategy=plan_struct.strategy):
+                _maybe_profile_phases(group_plans[0])
+                fn = _vmapped_kernel(plan_struct, bucket)
+                with span("device_execute"):
+                    dev = fn(cols, n_docs, params)
+                    device_fence(dev)
+                with span("device_transfer"):
+                    out = jax.device_get(dev)  # jaxlint: ok host-sync
+                global_accountant.track_result(out)
+                # per-segment slicing below runs on host numpy behind
+                # the single fence above — host-sync [jaxlint baseline]
+                for k, i in enumerate(idxs):
+                    per_seg = {name: v[k] for name, v in out.items()}
+                    if int(per_seg.pop("group_overflow", 0)):
+                        # this segment alone exceeded the transfer-
+                        # compaction cap; rerun it solo, straight to
+                        # dense outputs
+                        from .executor import run_kernel
+                        dense = run_kernel(plans[i], xfer_compact=False)
+                        results[i] = extract_partial(plans[i], dense)
+                    else:
+                        results[i] = extract_partial(plans[i], per_seg)
     return results
 
 

@@ -209,10 +209,16 @@ def run_kernel(plan: CompiledPlan,
     already knows the transfer compaction spilled (engine/batch.py's
     vmapped path)."""
     from ..ops.plan_cache import global_plan_cache
+    from .tier import global_tier
     seg = plan.segment
     with span("segment_kernel", segment=seg.name, bucket=seg.bucket,
               strategy=plan.kernel_plan.strategy,
-              est_sel=plan.est_selectivity, slots_cap=plan.slots_cap):
+              est_sel=plan.est_selectivity, slots_cap=plan.slots_cap), \
+            global_tier.pinned({seg.uid}):
+        # pinned for the WHOLE solo execution: the plan-cache entry's
+        # first-run accumulator registration enforces the tier budget,
+        # and without the pin it could demote the very segment whose
+        # columns this query just uploaded (engine/tier anti-thrash)
         cols = seg.device_cols(plan.col_names)
         params = resolve_params(plan)
         n = np.int32(seg.n_docs)

@@ -220,7 +220,12 @@ class PlanCacheEntry:
                 if self.devmem_evicted:
                     global_device_memory.remove("plan_cache_acc",
                                                 id(self), evicted=False)
-            return host
+        if first:
+            # shared-budget admission (engine/tier.py) — OUTSIDE the
+            # entry lock: the demotion path takes the stack/cube locks
+            from ..engine.tier import global_tier
+            global_tier.enforce()
+        return host
 
     def record_measured(self, matched: int, rows: int) -> None:
         with self.lock:
@@ -487,6 +492,10 @@ class CubeCache:
             ev = self._building.pop(key, None)
         if ev is not None:
             ev.set()
+        # shared-budget admission (engine/tier.py), outside self._lock:
+        # the cube is a new HBM resident charged to the one budget
+        from ..engine.tier import global_tier
+        global_tier.enforce(protect={segment.uid})
         return built
 
     def stacked(self, spec, segments, per_segment: List[Dict[str, Any]]
@@ -512,7 +521,18 @@ class CubeCache:
             while len(self._stacked) > self._maxsize:
                 old_key, _old = self._stacked.popitem(last=False)
                 global_device_memory.remove("cube_stacked", old_key)
-            return stacked
+        # shared-budget admission (engine/tier.py), outside self._lock
+        from ..engine.tier import global_tier
+        global_tier.enforce(protect={s.uid for s in segments})
+        return stacked
+
+    def resident_uids(self) -> set:
+        """Segment uids with a resident per-segment cube — the 'warm
+        ragged cube' placement signal the residency heartbeats report
+        (a replica holding the cube answers plan-key-sharing queries
+        without re-scanning the columns)."""
+        with self._lock:
+            return {k[1] for k in self._entries}
 
     def evict_containing(self, segment_name: str) -> None:
         with self._lock:

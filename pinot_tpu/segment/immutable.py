@@ -14,6 +14,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -95,6 +96,18 @@ class ImmutableSegment:
         self._nulls: Dict[str, Optional[np.ndarray]] = {}
         # key: (name, bucket, sharding) — sharding None = default backend
         self._device: Dict[Tuple[str, int, Any], jax.Array] = {}
+        # warm tier (engine/tier.py): padded host arrays kept after an
+        # HBM demotion so re-promotion is one device_put, no re-pad.
+        # Only populated while a tier budget is armed — unbounded runs
+        # stay byte-for-byte the pre-tier behavior.
+        self._warm: Dict[Tuple[str, int, Any], np.ndarray] = {}
+        # residency lock: a cache insert (+ its devmem add) and a tier
+        # demotion's drain (+ its devmem removes) must be atomic with
+        # respect to each other, or a concurrent demote could drop an
+        # array whose bytes were just registered — a permanently
+        # orphaned devmem entry. Reads (device_col's dict.get) stay
+        # lock-free: a racy miss only re-uploads.
+        self._res_lock = threading.Lock()
         # upsert validDocIds (None = all docs valid); versioned so the
         # device-resident copy invalidates on update
         self.valid_docs: Optional[np.ndarray] = None
@@ -212,12 +225,28 @@ class ImmutableSegment:
         query runs on a CPU mesh under a TPU default."""
         return jax.device_put(host, sharding)
 
-    def _cache_device(self, key, arr: jax.Array) -> jax.Array:
+    def _cache_device(self, key, arr: jax.Array,
+                      host: Optional[np.ndarray] = None) -> jax.Array:
         """Every _device insert routes through here so the device-memory
-        registry's live-byte gauges always reconcile with the cache."""
-        self._device[key] = arr
-        global_device_memory.add("segment_cols", (self.uid, key),
-                                 int(arr.nbytes))
+        registry's live-byte gauges always reconcile with the cache —
+        and so the HBM tier (engine/tier.py) sees every admission: the
+        insert promotes this segment hot and enforces the shared budget.
+        ``host`` is the uploaded host representation; while a tier
+        budget is armed it is stashed warm for cheap re-promotion."""
+        from ..engine.tier import global_tier
+        with self._res_lock:
+            self._device[key] = arr
+            global_device_memory.add("segment_cols", (self.uid, key),
+                                     int(arr.nbytes))
+            if host is not None and global_tier.armed:
+                old = self._warm.get(key)
+                self._warm[key] = host
+                global_tier.note_warm(
+                    self.uid, int(getattr(host, "nbytes", 0))
+                    - (int(old.nbytes) if old is not None else 0))
+        # tier admission OUTSIDE _res_lock: enforcement may demote
+        # OTHER segments (their _res_lock) — never nested under ours
+        global_tier.admitted(self)
         return arr
 
     def device_col(self, col: str, bucket: Optional[int] = None,
@@ -227,17 +256,28 @@ class ImmutableSegment:
         Dict ids upcast to int32 (byte-width storage is a host format detail;
         int32 is the TPU-friendly lane width). Raw columns keep their dtype.
         Pad value 0 — validity masks make padding inert.
+
+        This is also the tier's transparent re-promotion path: a
+        demoted segment's read misses the device cache, uploads from
+        the warm host array when one is stashed (no re-pad) and lands
+        byte-identical regardless of prior tier placement.
         """
+        from ..engine.tier import global_tier
         bucket = bucket or self.bucket
         key = (col, bucket, sharding)
         hit = self._device.get(key)
         # observed device-cache hit ratio feeds the segment-heat table
-        # (the admission signal for the future HBM tier)
+        # (the tier's admission signal)
         global_segment_heat.device_access(self, hit is not None)
+        # tier.evict chaos hook: may force-demote THIS segment mid-query
+        # (a ref already fetched stays alive; later columns re-promote)
+        global_tier.on_access(self)
         if hit is None:
-            hit = self._cache_device(
-                key, self._put(self.host_col_padded(col, bucket),
-                               sharding))
+            host = self._warm.get(key)
+            if host is None:
+                host = self.host_col_padded(col, bucket)
+            hit = self._cache_device(key, self._put(host, sharding),
+                                     host=host)
         return hit
 
     def host_col_padded(self, col: str, bucket: Optional[int] = None
@@ -268,33 +308,54 @@ class ImmutableSegment:
     def device_dict_values(self, col: str, sharding=None) -> jax.Array:
         """Device-resident sorted dictionary values (cached; used for
         id->value gathers inside kernels)."""
+        # return the LOCAL ref, never re-read self._device: a
+        # concurrent tier demotion may drop the key between insert and
+        # return (the device_col discipline — a racy loser only
+        # re-uploads next call, never KeyErrors)
         key = (f"__dict__{col}", 0, sharding)
-        if key not in self._device:
-            m = self.columns[col]
-            vals = np.asarray(self.dictionary(col).values,
-                              dtype=m.data_type.np_dtype)
-            self._cache_device(key, self._put(vals, sharding))
-        return self._device[key]
+        hit = self._device.get(key)
+        if hit is None:
+            vals = self._warm.get(key)
+            if vals is None:
+                m = self.columns[col]
+                vals = np.asarray(self.dictionary(col).values,
+                                  dtype=m.data_type.np_dtype)
+            hit = self._cache_device(key, self._put(vals, sharding),
+                                     host=vals)
+        return hit
 
     def device_null_mask(self, col: str, bucket: Optional[int] = None,
                          sharding=None) -> jax.Array:
         bucket = bucket or self.bucket
         key = (f"__null__{col}", bucket, sharding)
-        if key not in self._device:
-            nm = self.null_mask(col)
-            padded = np.zeros(bucket, dtype=bool)
-            if nm is not None:
-                padded[: len(nm)] = nm
-            self._cache_device(key, self._put(padded, sharding))
-        return self._device[key]
+        hit = self._device.get(key)
+        if hit is None:
+            padded = self._warm.get(key)
+            if padded is None:
+                nm = self.null_mask(col)
+                padded = np.zeros(bucket, dtype=bool)
+                if nm is not None:
+                    padded[: len(nm)] = nm
+            hit = self._cache_device(key, self._put(padded, sharding),
+                                     host=padded)
+        return hit  # local ref: a racy demotion must not KeyError
 
     def set_valid_docs(self, mask: Optional[np.ndarray]) -> None:
         self.valid_docs = mask
         self.valid_docs_version += 1
-        # drop stale device copies
-        for key in [k for k in self._device if k[0].startswith("__valid__")]:
-            del self._device[key]
-            global_device_memory.remove("segment_cols", (self.uid, key))
+        # drop stale device AND warm copies (the warm stash must never
+        # re-promote a superseded validity mask)
+        with self._res_lock:
+            for key in [k for k in self._device
+                        if k[0].startswith("__valid__")]:
+                del self._device[key]
+                global_device_memory.remove("segment_cols",
+                                            (self.uid, key))
+            for key in [k for k in self._warm
+                        if k[0].startswith("__valid__")]:
+                old = self._warm.pop(key)
+                from ..engine.tier import global_tier
+                global_tier.note_warm(self.uid, -int(old.nbytes))
 
     def persist_valid_docs(self) -> None:
         """Snapshot validDocIds next to the segment (upsert snapshot analog,
@@ -310,23 +371,63 @@ class ImmutableSegment:
                           sharding=None) -> jax.Array:
         bucket = bucket or self.bucket
         key = (f"__valid__v{self.valid_docs_version}", bucket, sharding)
-        if key not in self._device:
-            padded = np.zeros(bucket, dtype=bool)
-            if self.valid_docs is not None:
-                padded[: self.n_docs] = self.valid_docs
-            else:
-                padded[: self.n_docs] = True
-            self._cache_device(key, self._put(padded, sharding))
-        return self._device[key]
+        hit = self._device.get(key)
+        if hit is None:
+            padded = self._warm.get(key)
+            if padded is None:
+                padded = np.zeros(bucket, dtype=bool)
+                if self.valid_docs is not None:
+                    padded[: self.n_docs] = self.valid_docs
+                else:
+                    padded[: self.n_docs] = True
+            hit = self._cache_device(key, self._put(padded, sharding),
+                                     host=padded)
+        return hit  # local ref: a racy demotion must not KeyError
 
-    def evict_device(self) -> None:
-        for key in self._device:
-            global_device_memory.remove("segment_cols", (self.uid, key))
-        self._device.clear()
+    def demote_device(self, drop_warm: bool = False) -> None:
+        """Tier demotion (engine/tier.py): drop the device residents
+        and every stacked/cube copy containing this segment (the
+        round-9 eviction discipline — a demotion that left a stacked
+        copy resident would free nothing). The warm padded host arrays
+        survive for cheap re-promotion unless ``drop_warm`` (host ->
+        disk: the mmap is the only remaining copy). The drain is
+        atomic vs concurrent inserts (_res_lock), so devmem can never
+        track an array this demotion dropped."""
+        with self._res_lock:
+            for key in list(self._device):
+                global_device_memory.remove("segment_cols",
+                                            (self.uid, key))
+            self._device.clear()
+            if drop_warm:
+                self._drop_warm_locked()
         from ..engine.batch import evict_stacks_containing
         evict_stacks_containing(self.name)
         from ..ops.plan_cache import global_cube_cache
         global_cube_cache.evict_containing(self.name)
+
+    def _drop_warm_locked(self) -> bool:  # holds-lock: _res_lock
+        if not self._warm:
+            return False
+        from ..engine.tier import global_tier
+        global_tier.note_warm(
+            self.uid,
+            -sum(int(a.nbytes) for a in self._warm.values()))
+        self._warm.clear()  # jaxlint: ok unlocked-mutation
+        return True
+
+    def drop_warm(self) -> bool:
+        """Release ONLY the warm host stash (engine/tier's warm-budget
+        enforcement on segments that stay HOT — their device residents
+        are untouched; the next demotion just re-pads from mmap).
+        True when there was a stash to drop."""
+        with self._res_lock:
+            return self._drop_warm_locked()
+
+    def evict_device(self) -> None:
+        """Full unload: device + warm copies gone, tier state cold."""
+        self.demote_device(drop_warm=True)
+        from ..engine.tier import global_tier
+        global_tier.on_evicted(self)
 
     def __repr__(self) -> str:
         return (f"ImmutableSegment({self.name!r}, docs={self.n_docs}, "

@@ -48,6 +48,11 @@ target these):
 ``upsert.compact_crash`` decision hook: crash mid upsert-metadata
                      replay / TTL eviction (upsert/metadata.py) — the
                      site raises ``IngestCrash``
+``tier.evict``       decision hook: the HBM tier force-demotes the
+                     touched segment MID-QUERY (engine/tier.on_access,
+                     site key = segment name) — the query must
+                     re-promote through device_col and finish
+                     byte-exact (tools/chaos_smoke.py ``--tier``)
 ==================== ======================================================
 
 Activation: ``PINOT_FAULTS`` env var at process start, or
@@ -113,6 +118,8 @@ FAULT_POINTS = (
     # ingest fault family (realtime consume -> seal -> commit -> handoff)
     "stream.error", "stream.rebalance", "commit.crash",
     "commit.http_error", "handoff.stall", "upsert.compact_crash",
+    # HBM tier (engine/tier.py): forced mid-query demotion
+    "tier.evict",
 )
 
 

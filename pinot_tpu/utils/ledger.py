@@ -119,12 +119,15 @@ KINDS: Dict[str, Dict[str, set]] = {
         # structured 429 parameters; ``arrival_ms`` = ms since the
         # broker's forensics epoch — the inter-arrival deltas
         # tools/traffic_replay.py replays at multiples.
+        # ``tier_affinity_hits``: placement-affinity routing (HBM tier,
+        # engine/tier.py) — segments this query dispatched to a replica
+        # already holding them hot/cube-resident (avoided uploads).
         "optional": {"sql", "rows", "segments_queried",
                      "segments_pruned", "hedges", "failovers", "slow",
                      "error", "backend", "traced", "serde_ms", "net_ms",
                      "batched", "batch_size", "tenant", "tier", "rung",
                      "shed", "shed_rung", "retry_after_ms",
-                     "arrival_ms"},
+                     "arrival_ms", "tier_affinity_hits"},
     },
     "ingest_stats": {
         # the freshness ledger (realtime/manager.write_ingest_stats):

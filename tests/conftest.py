@@ -59,8 +59,13 @@ def _reset_telemetry_registries():
     entries."""
     yield
     from pinot_tpu.engine.batch import clear_stack_cache
+    from pinot_tpu.engine.tier import global_tier
     from pinot_tpu.utils.devmem import global_device_memory
     from pinot_tpu.utils.heat import global_segment_heat
     global_segment_heat.clear()
     clear_stack_cache()
     global_device_memory.clear()
+    # the HBM tier registry is process-global like heat/devmem (and its
+    # clear() also disarms any test-configured budget); segments keep
+    # their caches — they re-register on their next admission
+    global_tier.clear()

@@ -573,7 +573,7 @@ def _ssb_broker(tmp_path, led, rows=1 << 13):
     return b, by_id
 
 
-def _ssb_overhead(b, sqls, passes=5):
+def _ssb_overhead(b, sqls, passes=3):
     def one_pass(ratio):
         t = time.perf_counter()
         for s in sqls:
@@ -601,12 +601,15 @@ def test_ssb_trace_ratio_one_records_every_query(tmp_path):
     sqls = [bench.spec_to_sql(*by_id[qid][1:]) for qid in SSB_FAST_QIDS]
     for s in sqls:                           # warmup pays the compiles
         b.query(s + " OPTION(timeoutMs=300000,traceRatio=0)")
+    # 3 paired passes (trimmed from 5 in round 18 to offset the tier
+    # tests — the min-over-pairs estimator needs one clean pair, and
+    # the slow-marked full-corpus variant keeps the deeper soak)
     overhead = _ssb_overhead(b, sqls)
     res = uledger.validate_file(led)
     assert not res["errors"], res["errors"][:3]
     # one validated record per query per traced pass (= the helper's
     # pass count)
-    assert res["kinds"]["query_trace"] == 5 * len(sqls)
+    assert res["kinds"]["query_trace"] == 3 * len(sqls)
     traced_sqls = {json.loads(line)["sql"].split(" OPTION")[0]
                    for line in open(led)}
     assert traced_sqls == set(sqls)          # EVERY query emitted one

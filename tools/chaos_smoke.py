@@ -33,6 +33,20 @@ digest-exact vs the fault-free oracle (exactly-once across
 crash/restart, upsert latest-wins preserved) and (b) every run
 appended a validated ``ingest_stats`` freshness-ledger record.
 
+``--tier`` runs the HBM-tier chaos gate (ISSUE 13, tier-1 via
+tests/test_tier.py): an in-process broker over two 4-segment SSB-lite
+tables captures fault-free digests, then (a) a seeded ``tier.evict``
+plan force-demotes a segment MID-QUERY (between planning and the
+group dispatch — its device columns and stacked copies drop) — every
+query must rebuild/re-promote through the normal device_col path and
+answer byte-exact, with two same-seed runs firing identical (point,
+site, hit) streams (the round-16 per-(qid, site-key) discipline); and
+(b) the mix re-runs under a constrained HBM budget (half the live
+two-table working set), alternating tables so coldest-first demotion
+has victims outside the pinned working set: demotions must fire,
+digests stay byte-exact, and every devmem pool must reconcile
+tracked-vs-actual to the byte across the churn.
+
 ``--rate`` runs the round-16 sustained-rate gate
 (pinot_tpu/engine/loadgen.py, tier-1 via tests/test_faults.py): 2
 tables (append standalone + upsert protocol) x 2 partitions of
@@ -87,27 +101,14 @@ def build_ssb_cluster(tmp: str, rows: int = 4096, n_segments: int = 4,
     (replication 2) and a ``lineorder_r1`` twin (replication 1) built
     from the same segment directories. Returns (ctrl, servers, broker,
     stop)."""
-    import numpy as np
-
     import bench
     from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
     from pinot_tpu.segment import SegmentBuilder
     from pinot_tpu.segment.builder import Categorical
-    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
-                               TableConfig)
+    from pinot_tpu.spi import Schema, TableConfig
 
     cols = bench.gen_columns(rows)
-    fields = []
-    for name, v in cols.items():
-        if name.startswith("lo_") and name not in ("lo_quantity",
-                                                   "lo_discount"):
-            fields.append(FieldSpec(name, DataType.INT, FieldType.METRIC))
-        elif isinstance(v, np.ndarray):
-            fields.append(FieldSpec(name, DataType.INT,
-                                    FieldType.DIMENSION))
-        else:
-            fields.append(FieldSpec(name, DataType.STRING,
-                                    FieldType.DIMENSION))
+    fields = bench._ssb_fields(cols)
 
     ctrl = Controller(os.path.join(tmp, "ctrl"), heartbeat_timeout=5.0,
                       reconcile_interval=0.2)
@@ -281,6 +282,193 @@ def main_ingest(args) -> int:
 
 RATE_ROWS = 600
 OVERLOAD_ROWS = 2048
+TIER_ROWS = 2048
+
+
+def build_ssb_table(tmp: str, rows: int, n_segments: int = 4,
+                    table: str = "lineorder", seg_prefix: str = "seg_"):
+    """In-process SSB-lite table: ``n_segments`` segments split from
+    one seeded bench.gen_columns draw. Returns (TableDataManager,
+    segment dirs)."""
+    import bench
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.segment.builder import Categorical
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import Schema, TableConfig
+
+    cols = bench.gen_columns(rows)
+    schema = Schema(table, bench._ssb_fields(cols))
+    builder = SegmentBuilder(schema, TableConfig(table))
+    dm = TableDataManager(table)
+    step = rows // n_segments
+    dirs = []
+    for i in range(n_segments):
+        lo, hi = i * step, rows if i == n_segments - 1 else (i + 1) * step
+        part = {n: (Categorical(v.codes[lo:hi], v.values)
+                    if isinstance(v, Categorical) else v[lo:hi])
+                for n, v in cols.items()}
+        d = builder.build(part, os.path.join(tmp, table),
+                          f"{seg_prefix}{i}")
+        dirs.append(d)
+        dm.add_segment_dir(d)
+    return dm, dirs
+
+
+def main_tier(args) -> int:
+    """--tier: the HBM-tier chaos gate (module docstring): mid-query
+    ``tier.evict`` demotion recovers byte-exact with same-seed
+    determinism, and a constrained budget demotes coldest-first with
+    every devmem pool reconciling to the byte."""
+    import bench
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.engine.tier import global_tier, reconcile_devmem
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils.devmem import global_device_memory
+    from pinot_tpu.utils.metrics import global_metrics
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_tier_chaos_")
+    failures = []
+    summary = {"mode": "tier", "rows": args.rows, "seed": args.seed,
+               "queries": 0, "faults_fired": 0, "promotions": 0,
+               "demotions": 0}
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+            print(f"FAIL {name}: {detail}")
+
+    faults.clear()
+    global_tier.configure(budget_bytes=None)
+    # start from devmem-synced caches: when this gate runs inside a
+    # warm pytest process, earlier tests' cube/stack entries survive
+    # the per-test accounting reset and would fail the byte-exact
+    # reconcile below through no fault of the tier's
+    from pinot_tpu.engine.batch import clear_stack_cache
+    from pinot_tpu.ops.plan_cache import global_cube_cache
+    clear_stack_cache()
+    global_cube_cache.clear()
+    try:
+        # TWO tables over the same seeded data: the twin gives the
+        # budget enforcement demotion victims OUTSIDE the querying
+        # table's pinned working set (and its digests must equal the
+        # original's — same rows, different placement history)
+        dm, _dirs = build_ssb_table(tmp, args.rows)
+        dm2, _dirs2 = build_ssb_table(tmp, args.rows,
+                                      table="lineorder2",
+                                      seg_prefix="t2seg_")
+        broker = Broker()
+        broker.register_table(dm)
+        broker.register_table(dm2)
+        queries = smoke_queries(tuple(args.queries.split(",")))
+        summary["queries"] = len(queries)
+
+        def run_all(tag, twin=False):
+            # deterministic query ids: the per-(qid, site-key) fault
+            # streams must be identical across same-seed runs
+            out = {}
+            for qid, sql in queries:
+                if twin:
+                    sql = sql.replace("FROM lineorder ",
+                                      "FROM lineorder2 ")
+                res = broker.query(
+                    sql + f" OPTION(timeoutMs=300000,"
+                          f"queryId=tier.{tag}.{qid})")
+                out[qid] = bench._digest([tuple(r) for r in res.rows])
+            return out
+
+        baseline = run_all("base")
+        check("twin.digests", run_all("base2", twin=True) == baseline,
+              "twin table digests differ from the original's")
+
+        # (a) mid-query demotion: the group access hook force-demotes
+        # seg_1 (device columns AND stacked copies) after planning,
+        # before dispatch — the SAME query must rebuild/re-promote
+        # through device_col and answer byte-exact. times=1 per
+        # (query id, site) stream: once per query, every query.
+        plan_text = (f"seed={args.seed}; "
+                     "tier.evict: match=seg_1, times=1")
+
+        def run_plan(tag):
+            plan = faults.install(plan_text)
+            try:
+                got = run_all(tag)
+            finally:
+                faults.clear()
+            return plan, got
+
+        d0 = global_tier.demotions
+        plan1, got1 = run_plan("evict")
+        summary["faults_fired"] += len(plan1.fired)
+        check("tier_evict.fired", len(plan1.fired) >= 1,
+              "tier.evict never fired")
+        check("tier_evict.demoted", global_tier.demotions > d0,
+              "no demotion recorded")
+        for qid in baseline:
+            check(f"tier_evict.{qid}", got1[qid] == baseline[qid],
+                  "digest mismatch after mid-query demotion")
+        # same-seed determinism: identical (point, site, hit) streams
+        plan2, got2 = run_plan("evict")
+        summary["faults_fired"] += len(plan2.fired)
+        check("tier_evict.deterministic",
+              plan1.fired_summary() == plan2.fired_summary(),
+              f"{plan1.fired_summary()} != {plan2.fired_summary()}")
+        for qid in baseline:
+            check(f"tier_evict.rerun.{qid}", got2[qid] == baseline[qid],
+                  "digest mismatch on same-seed rerun")
+
+        # (b) constrained budget: half the live two-table working set —
+        # alternating tables forces coldest-first demotion of the idle
+        # table's segments; digests stay exact, pools reconcile
+        total = global_device_memory.snapshot()["total"]["bytes"]
+        budget = max(total // 2, 1)
+        summary["budget_bytes"] = budget
+        global_tier.configure(budget_bytes=budget)
+        d1 = global_tier.demotions
+        got3 = run_all("budget")
+        got4 = run_all("budget2", twin=True)
+        got5 = run_all("budget3")
+        for qid in baseline:
+            check(f"tier_budget.{qid}",
+                  got3[qid] == baseline[qid]
+                  and got4[qid] == baseline[qid]
+                  and got5[qid] == baseline[qid],
+                  "digest mismatch under constrained budget")
+        check("tier_budget.demoted", global_tier.demotions > d1,
+              "constrained budget never demoted")
+        # the four pools this gate resets at start; plan_cache_acc is
+        # suite-wide compile warmth (donated buffers, TPU only) whose
+        # accounting a warm pytest process has already zeroed — the
+        # fresh-process bench covers all five
+        rec = reconcile_devmem(
+            dm.acquire_segments() + dm2.acquire_segments(),
+            pools=("segment_cols", "stack_cache", "cube_cache",
+                   "cube_stacked"))
+        summary["reconcile"] = rec
+        for pool, r in rec.items():
+            check(f"reconcile.{pool}", r["tracked"] == r["actual"],
+                  f"tracked {r['tracked']} != actual {r['actual']}")
+        snap = global_tier.snapshot()
+        summary["promotions"] = snap["promotions"]
+        summary["demotions"] = snap["demotions"]
+        # churn bound: demotions are per-query work (at most the idle
+        # table's segments per alternation), not a runaway loop
+        check("tier_budget.churn_bounded",
+              global_tier.demotions - d1 <= 8 * 3 * len(queries) + 8,
+              f"{global_tier.demotions - d1} demotions for "
+              f"{3 * len(queries)} queries")
+        c = global_metrics.snapshot()["counters"]
+        check("tier.promotions_counted",
+              c.get("tier_promotions", 0) >= snap["promotions"] - 1,
+              "tier_promotions counter missing")
+    finally:
+        faults.clear()
+        global_tier.configure(budget_bytes=None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    return 0 if not failures else 1
 
 
 def main_overload(args) -> int:
@@ -534,6 +722,10 @@ def main(argv=None) -> int:
     ap.add_argument("--overload", action="store_true",
                     help="run the closed-loop traffic-replay overload "
                          "gate (tools/traffic_replay.py cluster mode)")
+    ap.add_argument("--tier", action="store_true",
+                    help="run the HBM-tier gate: mid-query tier.evict "
+                         "recovery + constrained-budget demotion with "
+                         "devmem reconciliation")
     ap.add_argument("--multiple", type=float, default=4.0,
                     help="--overload mode: replay load multiple")
     ap.add_argument("--replay-queries", type=int, default=40,
@@ -547,13 +739,16 @@ def main(argv=None) -> int:
     if args.rows is None:
         args.rows = INGEST_ROWS if args.ingest \
             else RATE_ROWS if args.rate \
-            else OVERLOAD_ROWS if args.overload else 4096
+            else OVERLOAD_ROWS if args.overload \
+            else TIER_ROWS if args.tier else 4096
     if args.ingest:
         return main_ingest(args)
     if args.rate:
         return main_rate(args)
     if args.overload:
         return main_overload(args)
+    if args.tier:
+        return main_tier(args)
 
     from pinot_tpu.cluster.http_util import http_json
     from pinot_tpu.utils import faults
