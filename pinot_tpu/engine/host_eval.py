@@ -74,6 +74,13 @@ def eval_value(e: Any, seg: ImmutableSegment,
 
 def _eval_func(e: FuncCall, seg: ImmutableSegment,
                sel: Optional[np.ndarray]) -> np.ndarray:
+    if e.name == "vector_similarity":
+        # VECTOR_SIMILARITY as a VALUE (ORDER BY score / select-list
+        # column): exact per-doc similarity over the selected rows —
+        # the candidate SELECTION already ran on device through the
+        # filter's memoized search (engine/vector_exec.py)
+        from .vector_exec import order_scores
+        return order_scores(seg, e, sel)
     fd = F.lookup(e.name)
     if fd is None:
         raise SqlError(f"unknown function {e.name!r}")

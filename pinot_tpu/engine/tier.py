@@ -477,12 +477,14 @@ def reconcile_devmem(segments, pools=None) -> Dict[str, Dict[str, int]]:
     plan_cache_acc, whose donated buffers are suite-wide compile
     warmth it must not wipe)."""
     from ..engine import batch as eb
+    from ..index import vector as vix
     from ..ops.plan_cache import global_cube_cache, global_plan_cache
     from ..utils.devmem import nbytes_of
     actual = {
         "segment_cols": sum(
             int(a.nbytes) for s in segments
             for a in list(getattr(s, "_device", {}).values())),
+        "vector": sum(r.device_bytes() for r in vix.live_readers()),
         "stack_cache": sum(nbytes_of(v)
                            for v in list(eb._STACK_CACHE.values())),
         "cube_cache": sum(

@@ -53,17 +53,11 @@ def index_filter_mask(seg, e: FuncCall) -> np.ndarray:
                            "(tableConfig indexing.jsonIndexColumns)")
         return reader.match(str(_lit(e, 1, "filter")), seg.n_docs)
     if e.name == "vector_similarity":
-        reader = seg.index_reader(col, "vector")
-        if reader is None:
-            raise SqlError(f"VECTOR_SIMILARITY requires a vector index on "
-                           f"{col!r} (tableConfig indexing."
-                           "vectorIndexColumns)")
-        qv = _lit(e, 1, "query vector (ARRAY[...])")
-        if not isinstance(qv, (tuple, list)):
-            raise SqlError("VECTOR_SIMILARITY query must be ARRAY[...]")
-        k = int(_lit(e, 2, "topK")) if len(e.args) > 2 else 10
-        return reader.top_k_mask(np.asarray(qv, dtype=np.float32), k,
-                                 seg.n_docs)
+        # the vector execution plane (engine/vector_exec.py): validated
+        # IVF/flat device search, memoized per (query, segment, call),
+        # micro-batched with concurrent same-shape queries
+        from ..engine.vector_exec import filter_mask
+        return filter_mask(seg, e)
     raise SqlError(f"not an index predicate: {e.name}")
 
 

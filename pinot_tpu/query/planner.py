@@ -1205,6 +1205,24 @@ class SegmentPlanner:
             if n not in self.seg.columns and n not in virtual:
                 raise PlanError(f"unknown column {n!r}; segment has "
                                 f"{list(self.seg.columns)}")
+        self._validate_vector_calls()
+
+    def _validate_vector_calls(self) -> None:
+        """VECTOR_SIMILARITY fail-fast validation over the filter,
+        select list AND order-by (the order-by isn't part of the column
+        walk above): malformed calls — missing index, dim mismatch,
+        k <= 0, non-numeric ARRAY — are structured user errors (plain
+        SqlError, HTTP 400), raised at plan time on every path.
+        Deliberately NOT PlanError: a bad call must never demote to a
+        host-path surprise."""
+        from ..engine.vector_exec import validate_call, vector_calls
+        ctx = self.ctx
+        calls = vector_calls(
+            ctx.filter,
+            *[i for i in ctx.select_items if not hasattr(i, "kind")],
+            *[o.expr for o in ctx.order_by])
+        for call in calls:
+            validate_call(self.seg, call)
 
     # -- top-level ---------------------------------------------------------
     def plan(self) -> CompiledPlan:

@@ -214,9 +214,7 @@ class SegmentBuilder:
                 }
                 extra = index_pkg.build_indexes_for_column(
                     f.name, ["vector"], seg_dir, values=arr, ids=None,
-                    cardinality=0)
-                extra["vector"].update({k: v for k, v in vcfg.items()
-                                        if k == "metric"})
+                    cardinality=0, configs={"vector": vcfg})
                 cmeta["indexes"] = extra
                 meta["columns"][f.name] = cmeta
                 continue

@@ -193,8 +193,14 @@ class ImmutableSegment:
         key = (col, kind)
         if key not in self._index_readers:
             from .. import index as index_pkg
-            self._index_readers[key] = index_pkg.load_index(
-                self.dir, col, kind, m.indexes[kind])
+            reader = index_pkg.load_index(self.dir, col, kind,
+                                          m.indexes[kind])
+            if kind == "vector":
+                # bind tier/devmem identity: the reader's device
+                # residents account as (uid, col) in the `vector` pool
+                # and its uploads admit THIS segment to the HBM tier
+                reader.attach_owner(self, col)
+            self._index_readers[key] = reader
         return self._index_readers[key]
 
     def raw_values(self, col: str) -> np.ndarray:
@@ -404,6 +410,12 @@ class ImmutableSegment:
         evict_stacks_containing(self.name)
         from ..ops.plan_cache import global_cube_cache
         global_cube_cache.evict_containing(self.name)
+        # vector-pool residents (index/vector.py) demote with the
+        # segment too: the readers re-upload transparently on the next
+        # search, byte-identically (their own lock discipline)
+        for (c, kind), rd in list(self._index_readers.items()):
+            if kind == "vector":
+                rd.evict_device()
 
     def _drop_warm_locked(self) -> bool:  # holds-lock: _res_lock
         if not self._warm:

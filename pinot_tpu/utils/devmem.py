@@ -36,8 +36,10 @@ from .metrics import global_metrics
 #   cube_stacked    ops/plan_cache.CubeCache warm stacked-cube tensors
 #   plan_cache_acc  ops/plan_cache.PlanCacheEntry donated accumulators
 #   segment_cols    segment/immutable.ImmutableSegment._device arrays
+#   vector          index/vector.VectorIndexReader device residents
+#                   (matrix / centroids / IVF pages — round 19)
 POOLS = ("stack_cache", "cube_cache", "cube_stacked", "plan_cache_acc",
-         "segment_cols")
+         "segment_cols", "vector")
 
 
 def nbytes_of(tree: Any) -> int:

@@ -39,6 +39,11 @@ Kinds:
   tenant/rung, per-tier p50/p99, shed-stream determinism, recovery
   back to the pre-spike baseline) — chaos_smoke --overload and the
   bench_common.finish() overload gate consume these.
+- ``vector_bench``     — bench_vector.py ``--ivf`` vector-search
+  headlines (rows/dim/k/nprobe, recall@10 vs the exact numpy oracle,
+  IVF vs exact-scan QPS, latency percentiles, batched-equality and
+  zero-retrace flags, vector-pool reconciliation) — the recall/QPS
+  curves that sit beside the SSB numbers (ROADMAP direction 5).
 - ``fleet_rollup``     — cluster/rollup.py ForensicsRollupTask: the
   controller's cluster-wide aggregation over the per-node ledgers it
   pulls (per-table fleet stats, hot-segment heat ranking, per-node
@@ -196,6 +201,24 @@ KINDS: Dict[str, Dict[str, set]] = {
                      "pre_p50_ms", "post_p50_ms", "spike_errors",
                      "chaos", "faults_fired", "query_errors",
                      "structured_429", "error", "extra"},
+    },
+    "vector_bench": {
+        # one bench_vector.py --ivf capture: ``recall_at_10`` is mean
+        # |ivf top-10 ∩ exact top-10| / 10 over the query draw at the
+        # DEFAULT nprobe; ``qps_ratio`` = qps_ivf / qps_exact (the
+        # same-data exact full-matrix device scan); ``p50_ms/p99_ms``
+        # are solo IVF search latencies; ``batched_equal`` = fused
+        # concurrent results byte-identical to solo; ``retraces`` =
+        # vector-kernel compiles observed during the MEASURED phase
+        # (must be 0 post-warmup); ``unaccounted_bytes`` = vector-pool
+        # tracked-minus-actual after the eviction churn (must be 0).
+        "required": {"backend", "ok", "rows", "dim", "metric", "k",
+                     "nprobe", "n_lists", "recall_at_10", "qps_ivf",
+                     "qps_exact", "qps_ratio", "p50_ms", "p99_ms"},
+        "optional": {"seed", "queries", "page_size", "batch",
+                     "qps_batched", "batched_equal", "retraces",
+                     "unaccounted_bytes", "nprobe_sweep", "error",
+                     "extra"},
     },
     "fleet_rollup": {
         # one controller rollup pass (cluster/rollup.py): pull health

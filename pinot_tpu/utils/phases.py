@@ -55,6 +55,10 @@ RAGGED_DISPATCH = "ragged_dispatch"
 CUBE_BUILD = "cube_build"
 FUSED_EXECUTE = "fused_execute"
 
+# vector search subsystem (engine/vector_exec.py): one span per
+# (query, segment) device search — batched or solo annotated on it
+VECTOR_SEARCH = "vector_search"
+
 # names Tracing.phase may emit into the flat trace envelope
 TRACED_PHASES = frozenset(
     {PLANNING, EXECUTION, REDUCE, DISTRIBUTED_EXECUTE})

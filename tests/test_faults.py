@@ -569,6 +569,23 @@ def test_chaos_smoke_cli(capsys):
     assert summary["fleet_ledger_kinds"].get("fleet_rollup", 0) >= 1
 
 
+def test_chaos_smoke_vector_cli(capsys):
+    """Round-19 vector gate (ISSUE 14): seeded VECTOR_SIMILARITY top-k
+    queries over a 2-server cluster fail over byte-identically under
+    rpc.drop (same-seed runs fire identical streams), recover
+    byte-identical top-k from a mid-query tier.evict demotion of the
+    vector pool, reject bad-dim calls as structured 400s, and leave
+    the vector devmem pool reconciled to the byte."""
+    import chaos_smoke
+    assert chaos_smoke.main(["--vector", "--rows", "1024"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = __import__("json").loads(out[-1])
+    assert summary["ok"] and summary["mode"] == "vector"
+    assert summary["faults_fired"] >= 2
+    assert summary["vector_pool"]["tracked"] \
+        == summary["vector_pool"]["actual"]
+
+
 def test_chaos_smoke_rate_cli(capsys):
     """Round-16 rate gate (ISSUE 11): sustained multi-partition ingest
     concurrent with queries under the full armed ingest fault plan —

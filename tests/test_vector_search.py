@@ -1,0 +1,488 @@
+"""Vector search subsystem (ISSUE 14): SQL surface, IVF index, ragged
+micro-batching, devmem/tier accounting, cluster scatter.
+
+Covers:
+- parse/plan goldens for VECTOR_SIMILARITY as filter, ORDER BY score
+  and select-list value;
+- structured-error negatives (bad dim, k <= 0, missing index,
+  non-float ARRAY, bad nprobe) — SqlError on every path, never a
+  host-path demotion;
+- IVF recall@10 vs the exact numpy oracle across an nprobe sweep, with
+  nprobe >= n_lists exactly equal to the oracle;
+- batched-vs-solo EXACT equality (the lax.map kernel contract) both at
+  the kernel level and through the real admission-window batcher under
+  concurrent broker queries;
+- the file-build round trip (SegmentBuilder nLists config -> IVF files
+  -> reader);
+- vector devmem pool accounting: build-race single upload, demotion /
+  re-promotion reconciliation, HBM-budget integration;
+- the validated ``vector_bench`` ledger contract;
+- a 2-server scatter smoke: global top-k through the broker merge
+  byte-equal to the numpy oracle.
+
+The chaos gate (tools/chaos_smoke.py --vector) runs from
+tests/test_faults.py beside the other CLI gates.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+from pinot_tpu.broker import Broker                              # noqa: E402
+from pinot_tpu.engine import vector_exec as vx                   # noqa: E402
+from pinot_tpu.index.vector import VectorIndexReader             # noqa: E402
+from pinot_tpu.query.context import build_query_context          # noqa: E402
+from pinot_tpu.query.planner import PlanError, SegmentPlanner    # noqa: E402
+from pinot_tpu.query.sql import FuncCall, SqlError, parse_sql    # noqa: E402
+from pinot_tpu.segment import SegmentBuilder                     # noqa: E402
+from pinot_tpu.segment.immutable import ImmutableSegment         # noqa: E402
+from pinot_tpu.server import TableDataManager                    # noqa: E402
+from pinot_tpu.spi import Schema, TableConfig                    # noqa: E402
+from pinot_tpu.spi.config import IndexingConfig                  # noqa: E402
+from pinot_tpu.spi.schema import (DataType, FieldSpec,           # noqa: E402
+                                  FieldType)
+from pinot_tpu.utils.devmem import global_device_memory          # noqa: E402
+from pinot_tpu.utils.metrics import global_metrics               # noqa: E402
+
+N, DIM, LISTS = 3000, 12, 16
+K = 5
+
+
+def _gen(seed=3, rows=N, dim=DIM, clusters=8):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((clusters, dim)).astype(np.float32)
+    a = rng.integers(0, clusters, rows)
+    vecs = (centers[a] + 0.1 * rng.standard_normal(
+        (rows, dim))).astype(np.float32)
+    return vecs, rng
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    """Two-segment vector table + broker (module-scoped: segment build
+    and index fit run once)."""
+    vecs, rng = _gen()
+    data = {"id": np.arange(N, dtype=np.int64), "emb": vecs,
+            "views": rng.integers(0, 100, N).astype(np.int32)}
+    schema = Schema("vt", [
+        FieldSpec("id", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("emb", DataType.FLOAT, FieldType.DIMENSION),
+        FieldSpec("views", DataType.INT, FieldType.METRIC)])
+    cfg = TableConfig("vt", indexing=IndexingConfig(
+        vector_index_columns={"emb": {"metric": "cosine",
+                                      "nLists": LISTS, "seed": 7}}))
+    out = tmp_path_factory.mktemp("vt")
+    builder = SegmentBuilder(schema, cfg)
+    dm = TableDataManager("vt")
+    segs = []
+    for i in range(2):
+        lo, hi = i * (N // 2), (i + 1) * (N // 2)
+        d = builder.build({k: v[lo:hi] for k, v in data.items()},
+                          str(out), f"seg_{i}")
+        segs.append(dm.add_segment_dir(d))
+    b = Broker()
+    b.register_table(dm)
+    return {"broker": b, "segments": segs, "vecs": vecs, "dm": dm}
+
+
+def _vs(q, k=K, nprobe=None, col="emb"):
+    arr = ", ".join(f"{float(x):.6f}" for x in q)
+    tail = f", {nprobe}" if nprobe else ""
+    return f"VECTOR_SIMILARITY({col}, ARRAY[{arr}], {k}{tail})"
+
+
+def _oracle_topk(vecs, q, k):
+    mn = vecs / np.maximum(
+        np.linalg.norm(vecs, axis=1, keepdims=True), 1e-30)
+    sims = mn @ (np.asarray(q, np.float32) / np.linalg.norm(q))
+    return np.argsort(-sims, kind="stable")[:k]
+
+
+# ---------------------------------------------------------------------------
+# parse / plan goldens
+# ---------------------------------------------------------------------------
+
+def test_parse_call_golden(table):
+    stmt = parse_sql("SELECT id FROM vt WHERE "
+                     "VECTOR_SIMILARITY(emb, ARRAY[1.0, 2.0], 7, 3) "
+                     "LIMIT 7")
+    call = stmt.where
+    assert isinstance(call, FuncCall) and call.name == "vector_similarity"
+    col, qv, k, nprobe = vx.parse_call(call)
+    assert (col, qv, k, nprobe) == ("emb", (1.0, 2.0), 7, 3)
+    # k defaults to 10, nprobe to the index default
+    col, qv, k, nprobe = vx.parse_call(
+        parse_sql("SELECT id FROM vt WHERE "
+                  "VECTOR_SIMILARITY(emb, ARRAY[1.0]) LIMIT 1").where)
+    assert k == 10 and nprobe is None
+
+
+def test_plan_kinds_golden(table):
+    seg = table["segments"][0]
+    q = table["vecs"][4]
+    # aggregation + VS filter -> device kernel plan (MaskParam path)
+    ctx = build_query_context(parse_sql(
+        f"SELECT SUM(views) FROM vt WHERE {_vs(q)}"))
+    assert SegmentPlanner(ctx, seg).plan().kind == "kernel"
+    # identifier selection + VS filter + LIMIT -> device kselect
+    ctx = build_query_context(parse_sql(
+        f"SELECT id FROM vt WHERE {_vs(q)} LIMIT {K}"))
+    assert SegmentPlanner(ctx, seg).plan().kind == "kselect"
+    # ORDER BY score -> host selection (score is a host-merged key)
+    ctx = build_query_context(parse_sql(
+        f"SELECT id FROM vt WHERE {_vs(q)} "
+        f"ORDER BY {_vs(q)} DESC LIMIT {K}"))
+    assert SegmentPlanner(ctx, seg).plan().kind == "host"
+
+
+def test_filter_order_select_end_to_end(table):
+    b, vecs = table["broker"], table["vecs"]
+    q = vecs[42]
+    res = b.query(f"SELECT id, {_vs(q)} AS score FROM vt WHERE "
+                  f"{_vs(q)} ORDER BY {_vs(q)} DESC LIMIT {K}")
+    rows = [tuple(r) for r in res.rows]
+    assert res.columns == ["id", "score"]
+    assert len(rows) == K
+    # scores are descending and the self-match leads with score ~1.0
+    scores = [r[1] for r in rows]
+    assert scores == sorted(scores, reverse=True)
+    assert rows[0][0] == 42 and scores[0] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_exact_nprobe_matches_oracle_through_broker(table):
+    """nprobe >= n_lists is the exact scan: the 2-segment broker merge
+    must equal the global numpy oracle top-k exactly."""
+    b, vecs = table["broker"], table["vecs"]
+    q = vecs[7]
+    res = b.query(f"SELECT id FROM vt WHERE {_vs(q, nprobe=LISTS)} "
+                  f"ORDER BY {_vs(q, nprobe=LISTS)} DESC LIMIT {K}")
+    got = [r[0] for r in res.rows]
+    assert got == [int(i) for i in _oracle_topk(vecs, q, K)]
+
+
+# ---------------------------------------------------------------------------
+# structured-error negatives
+# ---------------------------------------------------------------------------
+
+BAD = [
+    ("VECTOR_SIMILARITY(emb, ARRAY[1.0, 2.0], 3)", "dim mismatch"),
+    ("VECTOR_SIMILARITY(emb, ARRAY[%s], 0)", "topK must be a positive"),
+    ("VECTOR_SIMILARITY(emb, ARRAY[%s], -2)", "topK must be a positive"),
+    ("VECTOR_SIMILARITY(views, ARRAY[%s], 3)", "requires a vector index"),
+    ("VECTOR_SIMILARITY(emb, ARRAY['a', 'b'], 3)", "numeric ARRAY"),
+    ("VECTOR_SIMILARITY(emb, ARRAY[], 3)", "numeric ARRAY"),
+    ("VECTOR_SIMILARITY(emb, ARRAY[%s], 3, 0)", "nprobe must be a positive"),
+    ("VECTOR_SIMILARITY(emb, 42, 3)", "ARRAY"),
+]
+
+
+@pytest.mark.parametrize("expr,msg", BAD)
+def test_structured_errors(table, expr, msg):
+    b, vecs = table["broker"], table["vecs"]
+    arr = ", ".join(f"{float(x):.6f}" for x in vecs[0])
+    expr = expr % arr if "%s" in expr else expr
+    for sql in (f"SELECT id FROM vt WHERE {expr} LIMIT 3",
+                f"SELECT id FROM vt ORDER BY {expr} DESC LIMIT 3",
+                f"SELECT {expr} FROM vt LIMIT 3"):
+        with pytest.raises(SqlError, match=msg) as ei:
+            b.query(sql)
+        # a user error, never a host-fallback PlanError demotion
+        assert not isinstance(ei.value, PlanError)
+
+
+# ---------------------------------------------------------------------------
+# IVF recall vs the exact oracle
+# ---------------------------------------------------------------------------
+
+def test_ivf_recall_sweep_vs_numpy_oracle():
+    vecs, rng = _gen(seed=5, rows=4096, dim=16, clusters=16)
+    reader = VectorIndexReader.from_matrix(vecs).build_ivf(
+        n_lists=16, seed=7)
+    queries = vecs[rng.integers(0, 4096, 8)]
+    recalls = {}
+    for nprobe in (1, 2, 4, 8, 16):
+        tot = 0.0
+        for q in queries:
+            _s, d = reader.search_batch(q[None, :], 10, nprobe=nprobe)
+            exact = set(int(i) for i in _oracle_topk(vecs, q, 10))
+            tot += len(exact & set(d[0].tolist())) / 10
+        recalls[nprobe] = tot / len(queries)
+    # the sweep reaches high recall well before the full scan, and the
+    # full probe IS the exact scan
+    assert recalls[16] == 1.0
+    assert recalls[8] >= 0.9
+    assert recalls[1] <= recalls[16]
+    # nprobe >= n_lists routes to the flat kernel (0 == exact)
+    assert reader.effective_nprobe(16) == 0
+    assert reader.effective_nprobe(None) == reader.nprobe_default
+
+
+def test_file_built_ivf_roundtrip(table):
+    """SegmentBuilder's nLists config lands IVF files the reader loads:
+    centroids/pages/pageptr shapes agree and every doc appears exactly
+    once in the page layout."""
+    seg = table["segments"][0]
+    reader = seg.index_reader("emb", "vector")
+    assert reader.ivf is not None
+    assert reader.n_lists == LISTS
+    pages, ptr = reader.ivf["pages"], reader.ivf["pageptr"]
+    assert ptr.shape == (LISTS + 1,) and int(ptr[-1]) == pages.shape[0]
+    docs = pages[pages < seg.n_docs]
+    assert len(docs) == seg.n_docs
+    assert len(np.unique(docs)) == seg.n_docs
+    # owner attached: tier/devmem identity is (uid, col)
+    assert reader._pool_key == (seg.uid, "emb")
+    assert reader.owner() is seg
+
+
+# ---------------------------------------------------------------------------
+# batched == solo, kernel level and through the admission window
+# ---------------------------------------------------------------------------
+
+def test_batched_vs_solo_exact_equality():
+    vecs, rng = _gen(seed=9, rows=4096, dim=16, clusters=8)
+    reader = VectorIndexReader.from_matrix(vecs).build_ivf(
+        n_lists=16, seed=7)
+    queries = vecs[rng.integers(0, 4096, 6)] \
+        + 0.01 * rng.standard_normal((6, 16)).astype(np.float32)
+    for nprobe in (None, 16):  # IVF default and exact flat
+        solo = [reader.search_batch(q[None, :], 10, nprobe=nprobe)
+                for q in queries]
+        bs, bd = reader.search_batch(queries, 10, nprobe=nprobe)
+        for i in range(len(queries)):
+            np.testing.assert_array_equal(solo[i][0][0], bs[i])
+            np.testing.assert_array_equal(solo[i][1][0], bd[i])
+
+
+def test_admission_window_fuses_concurrent_broker_queries(table):
+    """Four threads issue same-shape vector queries through the real
+    broker with a widened window: at least one fused dispatch must
+    happen and every result must equal its solo run exactly."""
+    b, vecs = table["broker"], table["vecs"]
+    queries = [vecs[i] for i in (10, 20, 30, 40)]
+    sqls = [f"SELECT id, {_vs(q)} AS score FROM vt WHERE {_vs(q)} "
+            f"ORDER BY {_vs(q)} DESC LIMIT {K}" for q in queries]
+    solo = [[tuple(r) for r in b.query(s).rows] for s in sqls]
+
+    from pinot_tpu.engine.vector_exec import global_vector_batcher
+    global_vector_batcher.configure(enabled=True, window_ms=250.0)
+    c0 = global_metrics.snapshot()["counters"].get(
+        "vector_batched_dispatches", 0)
+    results = [None] * 4
+    errors = []
+    barrier = threading.Barrier(4)
+
+    def run(i):
+        try:
+            barrier.wait(timeout=10)
+            results[i] = [tuple(r) for r in b.query(sqls[i]).rows]
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    finally:
+        global_vector_batcher.configure(window_ms=None or 2.0)
+    assert not errors, errors
+    c1 = global_metrics.snapshot()["counters"].get(
+        "vector_batched_dispatches", 0)
+    assert c1 > c0, "no fused vector dispatch under concurrency"
+    for i in range(4):
+        assert results[i] == solo[i], f"batched result {i} != solo"
+
+
+def test_memo_one_search_per_query_segment(table):
+    """Filter + ORDER BY + select-list score reuse ONE device search
+    per (query, segment): the counter rises by exactly n_segments."""
+    b, vecs = table["broker"], table["vecs"]
+    q = vecs[77]
+    c0 = global_metrics.snapshot()["counters"].get("vector_searches", 0)
+    b.query(f"SELECT id, {_vs(q)} AS score FROM vt WHERE {_vs(q)} "
+            f"ORDER BY {_vs(q)} DESC LIMIT {K}")
+    c1 = global_metrics.snapshot()["counters"].get("vector_searches", 0)
+    assert c1 - c0 == len(table["segments"])
+
+
+# ---------------------------------------------------------------------------
+# devmem pool + tier integration
+# ---------------------------------------------------------------------------
+
+def _sync_readers(table):
+    """Start a devmem-sensitive test from accounting-synced residency:
+    the autouse fixture resets the registry between tests while the
+    module-scoped readers keep their device arrays (the same warm-
+    process discipline the chaos gates apply to the engine caches)."""
+    for s in table["segments"]:
+        s.index_reader("emb", "vector").evict_device()
+
+
+def test_build_race_single_upload(table):
+    """The CC205 fix: hammering ensure_device from many threads after
+    an eviction uploads ONCE — accounting equals live arrays, no
+    double-add."""
+    _sync_readers(table)
+    reader = table["segments"][0].index_reader("emb", "vector")
+    base = global_device_memory.pool_bytes("vector")
+    barrier = threading.Barrier(6)
+
+    def up():
+        barrier.wait(timeout=10)
+        reader.ensure_device()
+
+    threads = [threading.Thread(target=up) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    added = global_device_memory.pool_bytes("vector") - base
+    assert added == reader.device_bytes() > 0
+
+
+def test_gc_without_evict_drops_pool_accounting():
+    """A resident reader GC'd without evict_device must not leave
+    phantom vector-pool bytes charging the tier budget: the finalizer
+    queues its entries and the next pool touch reaps them."""
+    import gc
+    from pinot_tpu.index import vector as vix
+    vecs, _ = _gen(seed=13, rows=256, dim=8, clusters=4)
+    reader = VectorIndexReader.from_matrix(vecs)
+    reader.ensure_device()
+    nbytes = reader.device_bytes()
+    assert nbytes > 0
+    base = global_device_memory.pool_bytes("vector")
+    del reader
+    gc.collect()
+    vix.live_readers()  # drains the dead-entry queue
+    assert global_device_memory.pool_bytes("vector") == base - nbytes
+
+
+def test_demote_reconciles_and_repromotes(table):
+    """A tier demotion of the owning segment drops the vector pool's
+    residents; the next search transparently re-uploads with identical
+    results and to-the-byte accounting."""
+    _sync_readers(table)
+    b, vecs = table["broker"], table["vecs"]
+    seg = table["segments"][0]
+    reader = seg.index_reader("emb", "vector")
+    q = vecs[55]
+    sql = (f"SELECT id FROM vt WHERE {_vs(q)} "
+           f"ORDER BY {_vs(q)} DESC LIMIT {K}")
+    before = [tuple(r) for r in b.query(sql).rows]
+    assert reader.device_bytes() > 0
+    seg.demote_device()
+    assert reader.device_bytes() == 0
+    # pool tracks only the OTHER segment's reader now
+    others = sum(
+        s.index_reader("emb", "vector").device_bytes()
+        for s in table["segments"])
+    assert global_device_memory.pool_bytes("vector") == others
+    after = [tuple(r) for r in b.query(sql).rows]
+    assert after == before
+    assert reader.device_bytes() > 0
+    assert global_device_memory.pool_bytes("vector") == sum(
+        s.index_reader("emb", "vector").device_bytes()
+        for s in table["segments"])
+
+
+def test_hbm_budget_counts_vector_pool(table):
+    """The shared PINOT_HBM_BUDGET_BYTES budget sums the vector pool:
+    arming a budget below the resident set demotes segments (vector
+    residents included) and the query still answers identically."""
+    from pinot_tpu.engine.tier import global_tier
+    _sync_readers(table)
+    b, vecs = table["broker"], table["vecs"]
+    q = vecs[88]
+    sql = (f"SELECT id FROM vt WHERE {_vs(q)} "
+           f"ORDER BY {_vs(q)} DESC LIMIT {K}")
+    before = [tuple(r) for r in b.query(sql).rows]
+    total = sum(global_device_memory.pool_bytes(p)
+                for p in ("segment_cols", "vector"))
+    assert total > 0
+    d0 = global_tier.demotions
+    try:
+        global_tier.configure(budget_bytes=max(total // 4, 1))
+        after = [tuple(r) for r in b.query(sql).rows]
+    finally:
+        global_tier.configure(budget_bytes=None)
+    assert after == before
+    assert global_tier.demotions > d0
+
+
+# ---------------------------------------------------------------------------
+# ledger contract
+# ---------------------------------------------------------------------------
+
+def test_vector_bench_ledger_contract(tmp_path):
+    from pinot_tpu.utils import ledger as uledger
+    rec = uledger.make_record(
+        "vector_bench", backend="cpu", ok=True, rows=1024, dim=16,
+        metric="cosine", k=10, nprobe=4, n_lists=64, recall_at_10=0.97,
+        qps_ivf=100.0, qps_exact=30.0, qps_ratio=3.33, p50_ms=1.0,
+        p99_ms=2.0, batched_equal=True, retraces=0,
+        unaccounted_bytes=0)
+    path = str(tmp_path / "ledger.jsonl")
+    uledger.append_record(rec, path)
+    res = uledger.validate_file(path)
+    assert not res["errors"] and res["kinds"] == {"vector_bench": 1}
+    # writer-side validation: missing required field refuses to append
+    with pytest.raises(ValueError, match="recall_at_10"):
+        uledger.make_record(
+            "vector_bench", backend="cpu", ok=True, rows=1, dim=1,
+            metric="cosine", k=1, nprobe=1, n_lists=1, qps_ivf=1.0,
+            qps_exact=1.0, qps_ratio=1.0, p50_ms=1.0, p99_ms=1.0)
+    # ...and so does an unknown (typo'd) field
+    with pytest.raises(ValueError, match="unknown fields"):
+        uledger.make_record("vector_bench", recal_at_10=0.5, **{
+            k: v for k, v in rec.items()
+            if k not in ("v", "ts", "kind")})
+
+
+# ---------------------------------------------------------------------------
+# 2-server scatter smoke
+# ---------------------------------------------------------------------------
+
+def test_two_server_scatter_smoke(tmp_path):
+    """Vector top-k through the real scatter/gather plane: 2 servers,
+    replication 2, 4 segments — the broker's merged exact-probe top-k
+    equals the global numpy oracle, and per-query stats land."""
+    import chaos_smoke as cs
+    from pinot_tpu.cluster.http_util import http_json
+
+    rows = 512
+    ctrl, servers, broker, stop, qvecs = cs.build_vector_cluster(
+        str(tmp_path), rows, seed=17, n_segments=4)
+    try:
+        # rebuild the data the cluster holds (same seed/path as the
+        # builder) for the oracle
+        rng = np.random.default_rng(17)
+        centers = rng.standard_normal((8, cs.VECTOR_DIM)).astype(
+            np.float32)
+        a = rng.integers(0, 8, rows)
+        vecs = (centers[a] + 0.15 * rng.standard_normal(
+            (rows, cs.VECTOR_DIM))).astype(np.float32)
+        q = qvecs[0]
+        k = 6
+        sql = (f"SELECT id FROM vectors WHERE "
+               f"{_vs(q, k=k, nprobe=cs.VECTOR_LISTS)} ORDER BY "
+               f"{_vs(q, k=k, nprobe=cs.VECTOR_LISTS)} DESC LIMIT {k} "
+               f"OPTION(timeoutMs=300000)")
+        resp = http_json("POST", f"{broker.url}/query/sql",
+                         {"sql": sql}, timeout=120.0)
+        got = [r[0] for r in resp["resultTable"]["rows"]]
+        # exact probe per segment + broker merge == global oracle
+        assert got == [int(i) for i in _oracle_topk(vecs, q, k)]
+        assert resp.get("numServersQueried", 0) >= 1
+    finally:
+        stop()

@@ -471,6 +471,232 @@ def main_tier(args) -> int:
     return 0 if not failures else 1
 
 
+VECTOR_ROWS = 4096
+VECTOR_DIM = 16
+VECTOR_LISTS = 16
+VECTOR_K = 8
+
+
+def build_vector_cluster(tmp: str, rows: int, seed: int,
+                         n_segments: int = 4, poll: float = 0.1):
+    """Controller + 2 servers + broker over a ``vectors`` table
+    (replication 2) with an IVF vector index on ``emb``. Returns
+    (ctrl, servers, broker, stop, query_vectors)."""
+    import numpy as np
+    from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.spi import Schema, TableConfig
+    from pinot_tpu.spi.config import IndexingConfig
+    from pinot_tpu.spi.schema import DataType, FieldSpec, FieldType
+
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((8, VECTOR_DIM)).astype(np.float32)
+    a = rng.integers(0, 8, rows)
+    vecs = (centers[a] + 0.15 * rng.standard_normal(
+        (rows, VECTOR_DIM))).astype(np.float32)
+    data = {"id": np.arange(rows, dtype=np.int64), "emb": vecs,
+            "views": rng.integers(0, 1000, rows).astype(np.int32)}
+    qvecs = vecs[rng.integers(0, rows, 4)] + 0.01 * rng.standard_normal(
+        (4, VECTOR_DIM)).astype(np.float32)
+
+    schema = Schema("vectors", [
+        FieldSpec("id", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("emb", DataType.FLOAT, FieldType.DIMENSION),
+        FieldSpec("views", DataType.INT, FieldType.METRIC)])
+    cfg = TableConfig("vectors", indexing=IndexingConfig(
+        vector_index_columns={"emb": {
+            "metric": "cosine", "nLists": VECTOR_LISTS, "seed": 7}}))
+    ctrl = Controller(os.path.join(tmp, "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=0.2)
+    servers = [ServerNode(f"server_{i}", ctrl.url, poll_interval=poll)
+               for i in range(2)]
+    broker = BrokerNode(ctrl.url, routing_refresh=poll,
+                        query_stats_path=os.path.join(
+                            tmp, "query_stats.jsonl"))
+    builder = SegmentBuilder(schema, cfg)
+    ctrl.add_table("vectors", schema.to_dict(), replication=2)
+    step = rows // n_segments
+    for i in range(n_segments):
+        lo, hi = i * step, rows if i == n_segments - 1 \
+            else (i + 1) * step
+        d = builder.build({k: v[lo:hi] for k, v in data.items()},
+                          os.path.join(tmp, "vectors"), f"seg_{i}")
+        ctrl.add_segment("vectors", f"seg_{i}", d)
+    v = ctrl.routing_snapshot()["version"]
+    for s in servers:
+        assert s.wait_for_version(v, timeout=30.0), "server never synced"
+    assert broker.wait_for_version(v, timeout=30.0), \
+        "broker never synced"
+
+    def stop():
+        broker.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        ctrl.stop()
+
+    return ctrl, servers, broker, stop, qvecs
+
+
+def vector_sql(qvec, k: int = VECTOR_K) -> str:
+    arr = ", ".join(f"{float(x):.6f}" for x in qvec)
+    vs = f"VECTOR_SIMILARITY(emb, ARRAY[{arr}], {k})"
+    return (f"SELECT id, {vs} AS score FROM vectors WHERE {vs} "
+            f"ORDER BY {vs} DESC LIMIT {k}")
+
+
+def main_vector(args) -> int:
+    """--vector: the vector-search chaos gate (ISSUE 14): seeded
+    VECTOR_SIMILARITY top-k queries over a 2-server cluster must
+    (a) fail over byte-identically under ``rpc.drop`` with same-seed
+    runs firing identical decision streams, (b) recover byte-identical
+    top-k from a mid-query ``tier.evict`` demotion of the vector pool,
+    (c) reject malformed calls as structured errors even under chaos,
+    and (d) leave the ``vector`` devmem pool reconciled to the byte."""
+    from pinot_tpu.cluster.http_util import http_json
+    from pinot_tpu.index import vector as vix
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils.devmem import global_device_memory
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_vector_chaos_")
+    failures = []
+    summary = {"mode": "vector", "rows": args.rows, "seed": args.seed,
+               "queries": 0, "faults_fired": 0}
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+            print(f"FAIL {name}: {detail}")
+
+    faults.clear()
+    # start from devmem-synced vector residents: inside a warm pytest
+    # process, earlier tests' readers can still hold device arrays
+    # whose pool accounting the per-test reset already cleared (the
+    # --tier gate's cache-clear discipline, applied to this pool)
+    for r in vix.live_readers():
+        r.evict_device()
+    ctrl, servers, broker, stop, qvecs = build_vector_cluster(
+        tmp, args.rows, args.seed)
+    try:
+        sqls = [vector_sql(q) for q in qvecs]
+
+        def run_all(tag):
+            out = {}
+            for i, sql in enumerate(sqls):
+                resp = http_json(
+                    "POST", f"{broker.url}/query/sql",
+                    {"sql": sql + f" OPTION(timeoutMs=300000,"
+                                  f"queryId=vec.{tag}.{i})"},
+                    timeout=120.0)
+                out[i] = digest(resp)
+            return out
+
+        baseline = run_all("base")
+        summary["queries"] = len(sqls)
+        check("baseline.rows", all(baseline.values()),
+              "a fault-free vector query returned no rows")
+
+        # (a) rpc.drop failover: server_0's first /query/bin dispatch
+        # dies; the broker must fail over to the replica and answer
+        # byte-identically, two same-seed runs firing identical streams
+        # (port-scoped match: heartbeat traffic must not join the
+        # stream comparison — background timing isn't deterministic)
+        p0 = servers[0].port
+        plan_text = (f"seed={args.seed}; "
+                     f"rpc.drop: match=:{p0}/query/bin, times=1")
+
+        def run_plan(tag):
+            # clear the previous plan's failure backoff so the
+            # selector dials server_0 again and the fault re-fires —
+            # same-seed determinism is a property of the decision
+            # STREAMS, so both runs must present the same dial pattern
+            for s in servers:
+                broker._failures.record_success(s.instance_id)
+            plan = faults.install(plan_text)
+            try:
+                got = run_all(tag)
+            finally:
+                faults.clear()
+            return plan, got
+
+        plan1, got1 = run_plan("drop")
+        summary["faults_fired"] += len(plan1.fired)
+        check("rpc_drop.fired", len(plan1.fired) >= 1,
+              "rpc.drop never fired")
+        for i in baseline:
+            check(f"rpc_drop.q{i}", got1[i] == baseline[i],
+                  "top-k digest mismatch after failover")
+        plan2, got2 = run_plan("drop")
+        check("rpc_drop.deterministic",
+              plan1.fired_summary() == plan2.fired_summary(),
+              f"{plan1.fired_summary()} != {plan2.fired_summary()}")
+        for i in baseline:
+            check(f"rpc_drop.rerun.q{i}", got2[i] == baseline[i],
+                  "digest mismatch on same-seed rerun")
+
+        # (b) tier.evict mid-query: the vector pool's device residents
+        # drop between accesses; the search must re-upload and answer
+        # byte-identically (once per query stream, every query)
+        plan3 = faults.install(
+            f"seed={args.seed}; tier.evict: match=seg_1, times=1")
+        got3 = run_all("evict")
+        faults.clear()
+        summary["faults_fired"] += len(plan3.fired)
+        check("tier_evict.fired", len(plan3.fired) >= 1,
+              "tier.evict never fired")
+        for i in baseline:
+            check(f"tier_evict.q{i}", got3[i] == baseline[i],
+                  "top-k digest mismatch after mid-query demotion")
+
+        # (c) structured errors survive chaos: a bad-dim call is a
+        # user error (HTTP 400 / SqlError), never a partial result
+        from urllib.error import HTTPError
+        try:
+            http_json("POST", f"{broker.url}/query/sql",
+                      {"sql": "SELECT id FROM vectors WHERE "
+                              "VECTOR_SIMILARITY(emb, ARRAY[1.0], 3) "
+                              "LIMIT 3"}, timeout=60.0)
+            check("bad_dim.structured", False, "no error raised")
+        except HTTPError as e:
+            body = e.read().decode("utf-8", "replace")
+            check("bad_dim.structured",
+                  e.code == 400 and "dim mismatch" in body,
+                  f"HTTP {e.code}: {body[:200]}")
+        except Exception as e:  # noqa: BLE001 — into the summary
+            check("bad_dim.structured", False,
+                  f"unexpected error: {e}")
+
+        # (d) vector pool reconciles to the byte across the churn
+        tracked = global_device_memory.pool_bytes("vector")
+        actual = sum(r.device_bytes() for r in vix.live_readers())
+        summary["vector_pool"] = {"tracked": tracked, "actual": actual}
+        check("reconcile.vector", tracked == actual,
+              f"tracked {tracked} != actual {actual}")
+
+        # forensics ride along for free: every vector query landed a
+        # validated query_stats record
+        from pinot_tpu.utils import ledger as uledger
+        res = uledger.validate_file(
+            os.path.join(tmp, "query_stats.jsonl"))
+        check("query_stats.valid", not res["errors"],
+              f"invalid records: {res['errors'][:3]}")
+        check("query_stats.count",
+              res["kinds"].get("query_stats", 0) >= 4 * len(sqls),
+              f"{res['kinds'].get('query_stats', 0)} records for "
+              f"{4 * len(sqls)} queries")
+    finally:
+        faults.clear()
+        stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
 def main_overload(args) -> int:
     """--overload: the ISSUE-12 overload-resilience gate. One closed-
     loop traffic replay (tools/traffic_replay.py, cluster mode): record
@@ -726,6 +952,11 @@ def main(argv=None) -> int:
                     help="run the HBM-tier gate: mid-query tier.evict "
                          "recovery + constrained-budget demotion with "
                          "devmem reconciliation")
+    ap.add_argument("--vector", action="store_true",
+                    help="run the vector-search gate: seeded "
+                         "VECTOR_SIMILARITY queries under rpc.drop + "
+                         "tier.evict with identical top-k and a "
+                         "reconciled vector devmem pool")
     ap.add_argument("--multiple", type=float, default=4.0,
                     help="--overload mode: replay load multiple")
     ap.add_argument("--replay-queries", type=int, default=40,
@@ -740,7 +971,8 @@ def main(argv=None) -> int:
         args.rows = INGEST_ROWS if args.ingest \
             else RATE_ROWS if args.rate \
             else OVERLOAD_ROWS if args.overload \
-            else TIER_ROWS if args.tier else 4096
+            else TIER_ROWS if args.tier \
+            else VECTOR_ROWS if args.vector else 4096
     if args.ingest:
         return main_ingest(args)
     if args.rate:
@@ -749,6 +981,8 @@ def main(argv=None) -> int:
         return main_overload(args)
     if args.tier:
         return main_tier(args)
+    if args.vector:
+        return main_vector(args)
 
     from pinot_tpu.cluster.http_util import http_json
     from pinot_tpu.utils import faults
