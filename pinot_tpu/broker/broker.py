@@ -113,6 +113,13 @@ class Broker:
         from ..cluster.forensics import default_trace_ratio
         self._trace_ratio = default_trace_ratio(trace_ratio)
         self._trace_ledger_path = trace_ledger_path
+        # compile-plane forensics (ISSUE 15): a broker with a trace
+        # ledger and no explicit PINOT_COMPILE_LEDGER lands compile
+        # events in the same file, so span_diff captures double as
+        # warmup-debt corpora (tools/warmup_report.py --gate)
+        if trace_ledger_path:
+            from ..utils.compileplane import global_compile_log
+            global_compile_log.configure_path_if_unset(trace_ledger_path)
 
     # -- table registry (ideal-state analog) -------------------------------
     def register_table(self, dm: TableDataManager) -> None:
@@ -371,7 +378,8 @@ class Broker:
                                "tables are not supported yet; query the "
                                "_OFFLINE/_REALTIME tables directly")
             global_accountant.register(query_id, deadline=deadline,
-                                       tenant=tenant, tier=tier)
+                                       tenant=tenant, tier=tier,
+                                       sql=getattr(stmt, "_raw_sql", None))
             try:
                 return self._execute_hybrid(stmt, t0, query_id)
             finally:
@@ -387,7 +395,8 @@ class Broker:
             if stmt.explain:
                 return explain_multistage(self, stmt)
             global_accountant.register(query_id, deadline=deadline,
-                                       tenant=tenant, tier=tier)
+                                       tenant=tenant, tier=tier,
+                                       sql=getattr(stmt, "_raw_sql", None))
             try:
                 return execute_multistage(self, stmt)
             finally:
@@ -396,7 +405,8 @@ class Broker:
         trace_on = _truthy(ctx.options.get("trace"))
         scope = Tracing.register(query_id, trace_on)
         global_accountant.register(query_id, deadline=deadline,
-                                   tenant=tenant, tier=tier)
+                                   tenant=tenant, tier=tier,
+                                   sql=getattr(stmt, "_raw_sql", None))
         try:
             result = self._execute_ctx(ctx, stmt, t0, deadline,
                                        query_id=query_id)

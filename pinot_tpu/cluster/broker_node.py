@@ -205,6 +205,15 @@ class BrokerNode:
         self.forensics = QueryForensics(slow_query_ms=slow_query_ms,
                                         ledger_path=query_stats_path,
                                         trace_ratio=trace_ratio)
+        # compile-plane forensics (ISSUE 15): with a stats ledger
+        # configured and no explicit PINOT_COMPILE_LEDGER, compile
+        # events land in the SAME ledger so /debug/ledger ships them to
+        # the fleet rollup's plan_shapes ranking with zero extra config
+        # (first broker wins in in-process multi-broker tests)
+        if self.forensics.ledger_path:
+            from ..utils.compileplane import global_compile_log
+            global_compile_log.configure_path_if_unset(
+                self.forensics.ledger_path)
         self._routing: Dict[str, Any] = {"version": -1}
         # round-robin cursor for explain/failover re-picks. An itertools
         # counter, not an int += 1: _pick_replica runs on pool threads
@@ -1259,6 +1268,7 @@ class BrokerNode:
         global_metrics; a standalone broker reports zeros)."""
         from ..engine.ragged import batching_health
         from ..engine.tier import tier_health
+        from ..utils.compileplane import compile_health
         from ..utils.metrics import overload_health
         snap = global_metrics.snapshot()
         c = snap["counters"]
@@ -1280,6 +1290,10 @@ class BrokerNode:
             # cross-query micro-batching counters (PR 8) — rendered on
             # the /ui console next to the scatter block
             "batching": batching_health(snap),
+            # compile-plane warmup debt + storm alerting (ISSUE 15):
+            # per-trigger compile counters, compile_ms_total, and the
+            # storm watermark gauge beside the batching block
+            "compile": compile_health(snap),
             # overload-protection plane (ISSUE 12): shed/degrade-rung
             # counters + per-tenant gauges (broker/workload.py)
             "overload": overload,
@@ -1291,6 +1305,10 @@ class BrokerNode:
     # -- REST --------------------------------------------------------------
     def _make_handler(self):
         node = self
+
+        def _compile_log_snapshot():
+            from ..utils.compileplane import global_compile_log
+            return global_compile_log.snapshot()
 
         def q(h, b):
             from ..broker.workload import OverloadShedError
@@ -1344,6 +1362,10 @@ class BrokerNode:
                         parse_since(h.path))),
                 ("GET", "/debug/memory"): lambda h, b: (
                     200, memory_debug_payload(node.instance_id)),
+                # compile-plane forensics ring (ISSUE 15): recent
+                # compile_events + compile-storm alerts, newest first
+                ("GET", "/debug/compile"): lambda h, b: (
+                    200, _compile_log_snapshot()),
                 ("GET", "/ui"): lambda h, b: (
                     200, ("text/html", node.ui_page())),
                 ("POST", "/query/sql"): q,
@@ -1458,6 +1480,14 @@ async function health(){
       ', leader-error '+(sf.leader_error||0)+
       ' | errors '+(b.fused_dispatch_errors||0)+
       ' | sizes '+JSON.stringify(b.batch_size_histogram||{})+
+      '\\ncompile: '+(((m.compile||{}).compiles)||0)+
+      ' compiles / '+(((m.compile||{}).compile_ms_total)||0).toFixed(0)+
+      ' ms debt | triggers '+
+      JSON.stringify((m.compile||{}).by_trigger||{})+
+      ' | post-warmup '+(((m.compile||{}).post_warmup)||0)+
+      ' | storm '+(((m.compile||{}).storm_per_min)||0)+'/min (watermark '+
+      (((m.compile||{}).storm_watermark)||0)+') | alerts '+
+      (((m.compile||{}).storm_alerts)||0)+
       '\\ntier ('+((m.tier||{}).armed?'budget '+
         ((m.tier||{}).budget_bytes||0)+'B':'unbounded')+'): hot '+
       (((m.tier||{}).hot||{}).segments||0)+' seg / '+

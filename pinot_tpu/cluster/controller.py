@@ -24,6 +24,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .http_util import JsonHandler, start_http
 
+
+def _compile_health_snapshot() -> Dict[str, Any]:
+    """Compile-plane block for /ui/data (utils/compileplane)."""
+    from ..utils.compileplane import compile_health
+    from ..utils.metrics import global_metrics
+    return compile_health(global_metrics.snapshot())
+
 HEARTBEAT_TIMEOUT_S = 10.0
 RECONCILE_INTERVAL_S = 1.0
 
@@ -834,6 +841,10 @@ class Controller:
                 # realtime-plane health next to the cluster view (shared
                 # global_metrics for in-process roles)
                 "ingest": ingest_health(global_metrics.snapshot()),
+                # compile-plane warmup debt + storm alerts (ISSUE 15;
+                # in-process roles share global_metrics — a standalone
+                # controller reports zeros)
+                "compile": _compile_health_snapshot(),
                 # fleet forensics rollup (webapp Fleet view): the latest
                 # ForensicsRollup pass, None until one has run
                 "fleet": self.rollup.snapshot()}

@@ -318,6 +318,7 @@ def ledger_debug_payload(node_id: str, role: str, path: Optional[str],
     node gathers everything the fleet view needs."""
     from ..engine.ragged import batching_health
     from ..engine.tier import global_tier
+    from ..utils.compileplane import compile_health
     from ..utils.devmem import global_device_memory
     from ..utils.heat import global_segment_heat
     records, next_seq = read_ledger_since(path, since)
@@ -327,6 +328,8 @@ def ledger_debug_payload(node_id: str, role: str, path: Optional[str],
             "records": records,
             "counters": snap["counters"], "gauges": snap["gauges"],
             "batching": batching_health(snap),
+            # compile-plane warmup debt + storm state (ISSUE 15)
+            "compile": compile_health(snap),
             "memory": global_device_memory.snapshot(),
             "tier": global_tier.snapshot(),
             "heat": global_segment_heat.snapshot(top=heat_top)}

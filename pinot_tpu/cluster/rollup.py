@@ -68,7 +68,11 @@ NODE_COUNTER_KEYS = (
     # HBM tier (engine/tier.py): paid uploads / budget demotions /
     # affinity-routed avoided uploads
     "tier_promotions", "tier_demotions", "tier_affinity_hits",
+    # compile-plane warmup debt (utils/compileplane, ISSUE 15)
+    "compiles_total", "compiles_retrace", "compiles_lru_evict_rebuild",
+    "compile_ms_total", "compile_storm_alerts",
 )
+PLAN_SHAPE_TOP = 20
 
 
 from ..utils.stats import pctl as _pctl  # noqa: E402 — the ONE fleet
@@ -175,6 +179,49 @@ def aggregate_tables(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         out.setdefault(t, {"queries": 0}).update(
             {k: round(v, 3) for k, v in pcts.items()})
     return out
+
+
+def rank_plan_shapes(records: List[Dict[str, Any]],
+                     top: int = PLAN_SHAPE_TOP) -> List[Dict[str, Any]]:
+    """The fleet's hottest plan shapes ranked by warmup cost —
+    ``compiles x median compile_ms`` per normalized plan-shape hash
+    over the pulled ``compile_event`` corpus. Events dedupe by their
+    (proc, seq) identity first (the heat-table rule: two in-process
+    node roles shipping one shared compile ledger must not
+    double-count), then aggregate per shape with the trigger breakdown.
+    This ranking is verbatim the prefetch list ROADMAP direction 3's
+    AOT executable plane consumes: a fresh replica warming these
+    shapes first amortizes the most cold-start debt per compile."""
+    seen: set = set()
+    by_shape: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        if rec.get("kind") != "compile_event":
+            continue
+        uid = (rec.get("proc"), rec.get("seq"))
+        if uid in seen:
+            continue
+        seen.add(uid)
+        shape = rec.get("plan_shape") or "<none>"
+        e = by_shape.setdefault(shape, {
+            "plan_shape": shape, "sql": None, "compiles": 0,
+            "triggers": {}, "_ms": []})
+        e["compiles"] += 1
+        e["_ms"].append(float(rec.get("lower_ms", 0.0))
+                        + float(rec.get("compile_ms", 0.0)))
+        t = rec.get("trigger") or "?"
+        e["triggers"][t] = e["triggers"].get(t, 0) + 1
+        if not e["sql"] and rec.get("sql"):
+            e["sql"] = str(rec["sql"])[:120]
+    out: List[Dict[str, Any]] = []
+    for e in by_shape.values():
+        ms = sorted(e.pop("_ms"))
+        med = _pctl(ms, 0.5)
+        e["median_compile_ms"] = round(med, 3)
+        e["total_compile_ms"] = round(sum(ms), 3)
+        e["warmup_cost"] = round(e["compiles"] * med, 3)
+        out.append(e)
+    out.sort(key=lambda e: (-e["warmup_cost"], e["plan_shape"]))
+    return out[: max(top, 0)]
 
 
 def slow_queries(records: List[Dict[str, Any]],
@@ -386,6 +433,9 @@ class ForensicsRollupTask:
             "fleet_records": self._total_records,
             "tables": aggregate_tables(fleet_records),
             "slow_queries": slow_queries(fleet_records),
+            # the fleet's hottest plan shapes by warmup cost — the
+            # direction-3 executable-plane prefetch list (ISSUE 15)
+            "plan_shapes": rank_plan_shapes(fleet_records),
             "heat": merge_heat(node_blocks),
             "nodes": node_summaries,
             "fleet": fleet_totals(node_blocks),

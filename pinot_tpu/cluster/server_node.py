@@ -263,7 +263,7 @@ class ServerNode:
         wl = workload or {}
         global_accountant.register(query_id,
                                    tenant=wl.get("tenant"),
-                                   tier=wl.get("tier"))
+                                   tier=wl.get("tier"), sql=sql)
         try:
             resp = self.scheduler.execute(run, query_id,
                                           priority=priority)

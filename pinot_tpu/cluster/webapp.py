@@ -174,6 +174,14 @@ function vFleet() {
     (r.slow_queries || []).map(q => [esc(q.qid || ""),
       esc(q.node || ""), esc(q.table || ""), q.wall_ms,
       q.partial ? "YES" : "no", esc(q.sql || "")]));
+  // hottest plan shapes by warmup cost (compiles x median compile ms)
+  // — the AOT executable plane's prefetch list (ISSUE 15)
+  const shapes = table(["plan shape", "compiles", "median ms",
+      "total ms", "warmup cost", "triggers", "sql"],
+    (r.plan_shapes || []).map(p => [esc(p.plan_shape || ""),
+      p.compiles || 0, p.median_compile_ms || 0,
+      p.total_compile_ms || 0, p.warmup_cost || 0,
+      esc(JSON.stringify(p.triggers || {})), esc(p.sql || "")]));
   const heat = table(["table", "segment", "touches", "rows scanned",
       "device hit ratio"],
     (r.heat || []).map(h => [esc(h.table), esc(h.segment), h.touches,
@@ -203,6 +211,7 @@ function vFleet() {
   return `<h2>Fleet forensics</h2>${pull}
     <h3>Per-table fleet stats</h3>${tbl}
     <h3>Slowest queries</h3>${slow}
+    <h3>Hottest plan shapes (warmup debt)</h3>${shapes}
     <h3>Hot segments</h3>${heat}
     <h3>Drift / batching / device memory / HBM tier per node</h3>${nodes}`;
 }

@@ -64,6 +64,11 @@ class QueryUsage:
     cpu_s: float = 0.0
     mem_bytes: int = 0
     killed_reason: Optional[str] = None
+    # the query's SQL text (when the registration point has it): the
+    # compile-forensics plane (utils/compileplane) hashes it through
+    # utils/shapehash so every compile_event carries the plan shape of
+    # the query that paid the compile
+    sql: Optional[str] = None
     # workload isolation (broker/workload.py): the owning tenant and
     # its priority tier. The watcher's kill ordering sheds besteffort
     # tenants before standard before protected, and unregister feeds
@@ -101,9 +106,10 @@ class ResourceAccountant:
     # -- registration ------------------------------------------------------
     def register(self, query_id: str, deadline: Optional[float] = None,
                  tenant: Optional[str] = None,
-                 tier: Optional[str] = None) -> QueryUsage:
+                 tier: Optional[str] = None,
+                 sql: Optional[str] = None) -> QueryUsage:
         u = QueryUsage(query_id, deadline=deadline, tenant=tenant,
-                       tier=tier)
+                       tier=tier, sql=sql)
         tid = threading.get_ident()
         with self._lock:
             self._by_query[query_id] = u

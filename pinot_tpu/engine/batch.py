@@ -61,8 +61,10 @@ def _seg_key(seg) -> Tuple[int, str]:
 
 @functools.lru_cache(maxsize=512)
 def _vmapped_kernel_cached(plan_struct, bucket: int, scatter: bool):
-    return jax.jit(jax.vmap(build_kernel(plan_struct, bucket,
-                                         scatter=scatter)))
+    from ..utils.compileplane import staged
+    return staged(jax.jit(jax.vmap(build_kernel(plan_struct, bucket,
+                                                scatter=scatter))),
+                  "vmap_kernel", ("vmap", plan_struct, bucket, scatter))
 
 
 def _vmapped_kernel(plan_struct, bucket: int):
@@ -305,16 +307,22 @@ def _run_segmented_compact(plans, idxs, plan_struct, bucket, cols, n_docs,
         out = jax.device_get(dev)  # jaxlint: ok host-sync
         # retry-ladder checks + slicing below read host numpy behind the
         # fence above — host-sync [jaxlint baseline]
+        from ..ops.plan_cache import global_plan_cache
         if int(out.pop("overflow", 0)):
             cap = full_slots_cap(n_seg * bucket)
-            with span("overflow_retry", slots_cap=cap):
+            # expected() bracket: the full-capacity recompile is a
+            # deliberate retry, counted overflow_retry in the
+            # compile-event taxonomy — never a retrace
+            with span("overflow_retry", slots_cap=cap), \
+                    global_plan_cache.detector.expected():
                 fn = jitted_segmented_compact(plan_struct, bucket, n_seg,
                                               cap)
                 out = jax.device_get(fn(cols, n_docs, params))
             out.pop("overflow", None)
             annotate(overflow_retry=True, slots_cap=cap)
         if int(out.pop("group_overflow", 0)):
-            with span("group_overflow_retry"):
+            with span("group_overflow_retry"), \
+                    global_plan_cache.detector.expected():
                 fn = jitted_segmented_compact(plan_struct, bucket, n_seg,
                                               cap, xfer_compact=False)
                 out = jax.device_get(fn(cols, n_docs, params))

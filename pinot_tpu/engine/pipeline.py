@@ -121,8 +121,13 @@ def execute_kernel_plans_pipelined(plans: List[CompiledPlan],
             seg = plan.segment
             cols = tuple(jax.device_put(seg.host_col_padded(c, bucket))
                          for c in plan.col_names)
-            dense = jax.device_get(dense_fn(  # jaxlint: ok host-sync
-                cols, jnp.int32(seg.n_docs), resolved_params[idxs[k]]))
+            from ..ops.plan_cache import global_plan_cache
+            with global_plan_cache.detector.expected():
+                # a deliberate dense rerun (compile-event taxonomy:
+                # overflow_retry, never a retrace)
+                dense = jax.device_get(dense_fn(  # jaxlint: ok host-sync
+                    cols, jnp.int32(seg.n_docs),
+                    resolved_params[idxs[k]]))
             del cols
             dense.pop("group_overflow", None)
             global_accountant.track_result(dense)

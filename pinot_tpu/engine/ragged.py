@@ -419,30 +419,40 @@ class _KernelRegistry:
     def __init__(self, maxsize: int = 256):
         self._lock = threading.Lock()
         self._fns: "OrderedDict[Tuple, Any]" = OrderedDict()
+        # keys the LRU dropped: their rebuild classifies as
+        # lru_evict_rebuild in the compile-event taxonomy
+        self._evicted: "OrderedDict[Tuple, bool]" = OrderedDict()
         self._maxsize = maxsize
 
     def get(self, key: Tuple, make):
-        from ..ops.plan_cache import global_plan_cache
         # the whole miss path stays under the lock so concurrent
-        # leaders of one key can't double-register the compile (the
-        # second observe_compile would read the first's generation
-        # stamp as a spurious retrace). Cheap to hold: jax.jit() is
-        # lazy — tracing happens at first call, outside this lock.
+        # leaders of one key can't double-build the wrapper; the
+        # compile itself classifies + lands its compile_event at first
+        # call (utils/compileplane.StagedFn, single-flight under the
+        # wrapper's own lock). Cheap to hold: jax.jit() is lazy.
+        from ..utils.compileplane import staged
         with self._lock:
             fn = self._fns.get(key)
             if fn is not None:
                 self._fns.move_to_end(key)
                 return fn
-            global_plan_cache.detector.observe_compile(key)
-            fn = jax.jit(make())
+            hints = None
+            if key in self._evicted:
+                del self._evicted[key]
+                hints = {"evicted": True}
+            fn = staged(jax.jit(make()), "ragged", key, hints=hints)
             self._fns[key] = fn
             while len(self._fns) > self._maxsize:
-                self._fns.popitem(last=False)
+                old_key, _old = self._fns.popitem(last=False)
+                self._evicted[old_key] = True
+                while len(self._evicted) > 4 * self._maxsize:
+                    self._evicted.popitem(last=False)
             return fn
 
     def clear(self):
         with self._lock:
             self._fns.clear()
+            self._evicted.clear()
 
 
 _kernels = _KernelRegistry()

@@ -308,7 +308,9 @@ def _batched_flat_kernel(metric: str, k_pad: int, n_docs: int,
     def run(qs, m_pad, row_sq_pad):
         return jax.lax.map(lambda q: body(q, m_pad, row_sq_pad), qs)
 
-    return jax.jit(run)
+    from ..utils.compileplane import staged
+    return staged(jax.jit(run), "vector",
+                  ("vec_flat", metric, k_pad, n_docs, dim, b_pad))
 
 
 @functools.lru_cache(maxsize=256)
@@ -358,7 +360,10 @@ def _batched_ivf_kernel(metric: str, k_pad: int, nprobe: int,
             lambda q: body(q, paged, paged_sq, cents, cent_sq,
                            pages_pad, pageptr), qs)
 
-    return jax.jit(run)
+    from ..utils.compileplane import staged
+    return staged(jax.jit(run), "vector",
+                  ("vec_ivf", metric, k_pad, nprobe, max_pages, n_docs,
+                   n_pages, dim, b_pad))
 
 
 def _pow2(n: int) -> int:

@@ -145,11 +145,21 @@ def try_mesh_shuffle_join(left: Relation, right: Relation,
 
 @functools.lru_cache(maxsize=64)
 def _jitted_equi_join(max_dup: int):
+    """One staged wrapper per max_dup, exactly the pre-round-20 cache
+    granularity: the wrapper keeps one compiled executable PER concrete
+    (shape, dtype) signature internally (utils/compileplane.StagedFn),
+    and an extra signature of a warm wrapper classifies per-shape —
+    cold, never a phantom retrace — so the naturally shape-polymorphic
+    join neither loses executables to LRU churn nor mislabels
+    rebuilds."""
     import jax
 
     from ..ops.join import device_equi_join
+    from ..utils.compileplane import staged
 
-    return jax.jit(functools.partial(device_equi_join, max_dup=max_dup))
+    return staged(
+        jax.jit(functools.partial(device_equi_join, max_dup=max_dup)),
+        "multistage", ("equi_join", max_dup))
 
 
 def try_device_join(left: Relation, right: Relation,

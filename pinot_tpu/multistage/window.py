@@ -169,7 +169,11 @@ def _seg_scan_jit(op: str, n_pad: int):
             return fa | fb, v
         _f, v = jax.lax.associative_scan(combine, (flags, vals))
         return v
-    return run
+
+    from ..utils.compileplane import staged
+    # dtype re-specializations under one (op, n_pad) key stage as their
+    # own signatures (compileplane keys extra signatures per shape)
+    return staged(run, "multistage", ("seg_scan", op, n_pad))
 
 
 def _device_seg_scan(op: str, v: np.ndarray,
@@ -464,7 +468,9 @@ def _segment_agg_jit(op: str, segs: int):
         else:
             per = jax.ops.segment_max(vals, ids, num_segments=segs)
         return jnp.take(per, ids)
-    return run
+
+    from ..utils.compileplane import staged
+    return staged(run, "multistage", ("segment_agg", op, segs))
 
 
 def _arg_value(rel, wf: WindowFunc, sidx: np.ndarray, i: int = 0,

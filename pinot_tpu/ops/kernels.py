@@ -1672,7 +1672,9 @@ def build_select_kernel(plan: SelectPlan, bucket: int):
 
 @functools.lru_cache(maxsize=512)
 def jitted_select_kernel(plan: SelectPlan, bucket: int):
-    return jax.jit(build_select_kernel(plan, bucket))
+    from ..utils.compileplane import staged
+    return staged(jax.jit(build_select_kernel(plan, bucket)),
+                  "select_kernel", ("select", plan, bucket))
 
 
 def _dict_value_cols(plan: KernelPlan) -> Dict[int, int]:
@@ -1792,9 +1794,12 @@ def build_segmented_compact_kernel(plan: KernelPlan, bucket: int,
 def _jitted_segmented_cached(plan, bucket, n_segments, slots_cap, platform,
                              xfer_compact, scatter, two_pass_mode,
                              ladder_min):
-    return jax.jit(build_segmented_compact_kernel(
+    from ..utils.compileplane import staged
+    key = ("segc", plan, bucket, n_segments, slots_cap, platform,
+           xfer_compact, scatter, two_pass_mode, ladder_min)
+    return staged(jax.jit(build_segmented_compact_kernel(
         plan, bucket, n_segments, slots_cap, platform, xfer_compact,
-        scatter, two_pass_mode, ladder_min))
+        scatter, two_pass_mode, ladder_min)), "segmented_kernel", key)
 
 
 def jitted_segmented_compact(plan: KernelPlan, bucket: int,
@@ -1819,10 +1824,14 @@ jitted_segmented_compact.cache_clear = _jitted_segmented_cached.cache_clear
 @functools.lru_cache(maxsize=1024)
 def _jitted_kernel_cached(plan, bucket, slots_cap, platform, xfer_compact,
                           scatter, two_pass_mode, ladder_min):
-    return jax.jit(build_kernel(plan, bucket, slots_cap, platform,
-                                xfer_compact, scatter=scatter,
-                                two_pass_mode=two_pass_mode,
-                                ladder_min=ladder_min))
+    from ..utils.compileplane import staged
+    key = ("kern", plan, bucket, slots_cap, platform, xfer_compact,
+           scatter, two_pass_mode, ladder_min)
+    return staged(jax.jit(build_kernel(plan, bucket, slots_cap, platform,
+                                       xfer_compact, scatter=scatter,
+                                       two_pass_mode=two_pass_mode,
+                                       ladder_min=ladder_min)),
+                  "kernel", key)
 
 
 def jitted_kernel(plan: KernelPlan, bucket: int,

@@ -74,6 +74,11 @@ class RetraceDetector:
         self._expected = threading.local()
         self.retraces = 0
         self.expected_recompiles = 0
+        # compile-plane forensics (utils/compileplane): first-ever vs
+        # same-generation compiles, so the compile_event trigger
+        # taxonomy reconciles EXACTLY against this snapshot
+        self.cold_compiles = 0
+        self.warmup_compiles = 0
 
     def begin_query(self, token: Any = None) -> None:
         """Advance the generation. ``token`` (the accountant's query id)
@@ -97,31 +102,61 @@ class RetraceDetector:
         finally:
             self._expected.on = prev
 
-    def observe_compile(self, plan: Any) -> bool:
-        """Called by the cache on every miss; -> True when it fired."""
-        h = hash(plan)
+    def expected_active(self) -> bool:
+        """Whether this thread is inside an expected() bracket (the
+        plan cache pins the bracket into stage hints at miss time so
+        the classification at the ACTUAL compile — which may run after
+        the bracket closed — still counts as deliberate)."""
+        return getattr(self._expected, "on", False)
+
+    def classify_compile(self, token: Any) -> str:
+        """Classify one compile of ``token`` (the forensics primitive):
+        'cold' (first ever), 'warmup' (another compile inside the
+        structure's first query generation), 'expected' (inside an
+        expected() bracket — the overflow ladder / drift re-quantize),
+        or 'retrace'. Counts the matching counter; called by
+        utils/compileplane.StagedFn at the moment the XLA compile
+        actually stages, so the compile_event stream and this
+        detector's totals reconcile one-to-one."""
+        h = hash(token)
         expected = getattr(self._expected, "on", False)
         with self._lock:
             last = self._first_gen.get(h)
             gen = self._gen
             self._first_gen[h] = gen
-            if last is None or last >= gen:
-                return False
             # counters mutate under the lock: concurrent server threads
             # (cluster scatter pool) must not lose increments
+            if last is None:
+                self.cold_compiles += 1
+                return "cold"
+            if last >= gen:
+                self.warmup_compiles += 1
+                return "warmup"
             if expected:
                 self.expected_recompiles += 1
             else:
                 self.retraces += 1
         if expected:
             global_metrics.count("plan_cache_expected_recompiles")
-            return False
+            return "expected"
         global_metrics.count("plan_cache_retraces")
         span_tracer.annotate(retrace=True)
-        return True
+        return "retrace"
+
+    def observe_compile(self, plan: Any) -> bool:
+        """Count one compile; -> True when the retrace flag fired."""
+        return self.classify_compile(plan) == "retrace"
 
     def snapshot(self) -> Dict[str, int]:
         return {"retraces": self.retraces,
+                "expected_recompiles": self.expected_recompiles}
+
+    def trigger_snapshot(self) -> Dict[str, int]:
+        """The four raw classification counters (the compile-forensics
+        reconciliation oracle; snapshot() keeps its historical shape)."""
+        return {"cold": self.cold_compiles,
+                "warmup": self.warmup_compiles,
+                "retraces": self.retraces,
                 "expected_recompiles": self.expected_recompiles}
 
     def clear(self) -> None:
@@ -131,21 +166,44 @@ class RetraceDetector:
             self._last_token = object()
             self.retraces = 0
             self.expected_recompiles = 0
+            self.cold_compiles = 0
+            self.warmup_compiles = 0
 
 
 class PlanCacheEntry:
     """One compiled kernel + its donated accumulator + run statistics."""
 
-    def __init__(self, base_fn, donate: bool):
+    def __init__(self, base_fn, donate: bool, plan: Any = None,
+                 key: Any = None,
+                 stage_hints: Optional[Dict[str, Any]] = None):
+        from ..utils.compileplane import key_fingerprint, staged
         self._base = base_fn     # unjitted builder (eval_shape surface)
         self.donate = donate
+        # compile-plane forensics: the jit is wrapped in explicit AOT
+        # staging (utils/compileplane.StagedFn) so the first run's
+        # lower/compile split, executable memory bytes and trigger
+        # classification land a compile_event. The detector token stays
+        # the PLAN STRUCTURE (the retrace detector's historical key);
+        # stage_hints carry the miss context (drift re-quantize /
+        # LRU-eviction rebuild) the trigger taxonomy refines through.
+        if plan is None:
+            # direct constructions (tests) get a never-reused token —
+            # an id() here could alias a GC'd entry's address in the
+            # detector's generation map (the round-19 memo rule)
+            import uuid
+            plan = ("plan_cache", uuid.uuid4().hex)
         if donate:
             def _wrapped(cols, n_docs, params, acc):
                 del acc          # aliasing source only, never read
                 return base_fn(cols, n_docs, params)
-            self.fn = jax.jit(_wrapped, donate_argnums=(3,))
+            self.fn = staged(jax.jit(_wrapped, donate_argnums=(3,)),
+                             "plan_cache", plan, donated=True,
+                             hints=stage_hints)
         else:
-            self.fn = jax.jit(base_fn)
+            self.fn = staged(jax.jit(base_fn), "plan_cache", plan,
+                             hints=stage_hints)
+        if key is not None:
+            self.fn.key_fp = key_fingerprint(key)
         self._acc: Any = None
         self.lock = threading.Lock()
         self.runs = 0
@@ -259,6 +317,9 @@ class KernelPlanCache:
         # (plan, bucket, cap) combinations whose drift-requantize
         # expected-compile bracket has been consumed (_note_requantize)
         self._requantized: "OrderedDict[Tuple, bool]" = OrderedDict()
+        # keys the LRU evicted (bounded memory of them): a re-miss of
+        # one is an lru_evict_rebuild in the compile-event taxonomy
+        self._evicted_keys: "OrderedDict[Tuple, bool]" = OrderedDict()
         self._maxsize = maxsize
         self._lock = threading.Lock()
         self.hits = 0
@@ -293,21 +354,25 @@ class KernelPlanCache:
             span_tracer.annotate(cache="hit")
             return ent
         span_tracer.annotate(cache="miss")
+        # compile-plane forensics: the trigger CONTEXT is known here, at
+        # the miss, but classification + the compile_event land at the
+        # entry's first run — where the XLA compile actually stages
+        # (utils/compileplane.StagedFn), so concurrent same-key misses
+        # (only one entry survives the setdefault below) can never
+        # double-count an event. The drift re-quantize hint is consumed
+        # ONCE per (plan, bucket, cap): a LATER miss of the same
+        # combination (LRU eviction churn, a mode flip) is a genuine
+        # recompile and must stay visible to the retrace detector.
+        stage_hints: Dict[str, Any] = {}
         if expected_compile and self._note_requantize(plan, bucket,
                                                       slots_cap):
-            # a deliberate recompile (the planner's selectivity-drift
-            # re-quantize): bracketed HERE, on the actual miss, so warm
-            # re-plannings of a drifted shape (cache hits) never run
-            # under expected() and the counter counts recompile events,
-            # not planned queries. The bracket is consumed ONCE per
-            # (plan, bucket, cap): a LATER miss of the same combination
-            # (LRU eviction churn, a mode flip) is a genuine recompile
-            # and must stay visible to the retrace detector.
             global_metrics.count("selectivity_drift_recompiles")
-            with self.detector.expected():
-                self.detector.observe_compile(plan)
-        else:
-            self.detector.observe_compile(plan)
+            stage_hints["expected_kind"] = "drift_requantize"
+        elif self.detector.expected_active():
+            # inside an executor expected() bracket (the overflow retry
+            # ladder): pin the kind now — the bracket may have closed
+            # by the time the entry first runs
+            stage_hints["expected_kind"] = "overflow_retry"
         if __debug__:
             # debug assertion (analysis/plan_verify): every structure
             # entering the cache must honor the hashable-frozen key
@@ -316,20 +381,37 @@ class KernelPlanCache:
             # Stripped under python -O; PINOT_PLAN_VERIFY=0 disables.
             from ..analysis.plan_verify import debug_check_cache_plan
             debug_check_cache_plan(plan, bucket)
-        with span("build_kernel", bucket=bucket, slots_cap=slots_cap):
+        with span("trace_kernel", bucket=bucket, slots_cap=slots_cap):
             base = build_kernel(plan, bucket, slots_cap, platform,
                                 xfer_compact, scatter=scatter,
                                 two_pass_mode=key[6], ladder_min=key[7])
-            ent = PlanCacheEntry(base, _donation_supported())
+            ent = PlanCacheEntry(base, _donation_supported(), plan=plan,
+                                 key=key, stage_hints=stage_hints)
         with self._lock:
             # a concurrent miss may have built the same entry; keep the
             # first one registered so its run stats/accumulator survive
             ent = self._entries.setdefault(key, ent)
+            if key in self._evicted_keys:
+                # eviction-rebuild attribution attaches to the
+                # SURVIVING entry at publish time (consumed exactly
+                # once, by the first publisher): a loser of the
+                # setdefault race above must not walk off with the
+                # hint while the winner's compile reads as a plain
+                # retrace. set_hints is a no-op once the first compile
+                # consumed the hints — by then the marker was already
+                # attached by whoever published first.
+                del self._evicted_keys[key]
+                ent.fn.set_hints(evicted=True)
             self._entries.move_to_end(key)
             while len(self._entries) > self._maxsize:
-                _, old = self._entries.popitem(last=False)
+                old_key, old = self._entries.popitem(last=False)
                 old.devmem_evicted = True  # before remove: run() rechecks
                 global_device_memory.remove("plan_cache_acc", id(old))
+                # remember the evicted key (bounded): its next miss is
+                # an lru_evict_rebuild, not an unexplained retrace
+                self._evicted_keys[old_key] = True
+                while len(self._evicted_keys) > 4 * self._maxsize:
+                    self._evicted_keys.popitem(last=False)
             global_metrics.gauge("plan_cache_entries", len(self._entries))
         return ent
 
@@ -412,6 +494,7 @@ class KernelPlanCache:
             self._entries.clear()
             self._measured.clear()
             self._requantized.clear()
+            self._evicted_keys.clear()
             self.hits = 0
             self.misses = 0
         global_device_memory.drop_pool("plan_cache_acc")

@@ -122,6 +122,9 @@ ANALYZE_COLUMNS = ["Node", "Node_Id", "Parent_Id", "Time_Ms", "Detail"]
 # alphabetical after
 _ATTR_ORDER = ["strategy", "cache", "est_sel", "meas_sel", "slots_cap",
                "matched", "retrace", "compiled",
+               # compile lane (staged build_kernel spans, ISSUE 15):
+               # trigger taxonomy + executable memory/flops as Detail
+               "trigger", "memory_bytes", "flops", "site",
                # cluster plane (scatter_call / server_query spans)
                "server", "attempt", "status", "net_ms", "error"]
 

@@ -49,6 +49,19 @@ Kinds:
   pulls (per-table fleet stats, hot-segment heat ranking, per-node
   drift/batching/device-memory blocks), one record per rollup pass in
   the controller-side fleet ledger.
+- ``compile_event``    — utils/compileplane.py: one record per XLA
+  compile anywhere in the engine (plan cache, ragged fused kernels,
+  vector search, multistage join/window, batched dispatch) with the
+  explicit ``lower_ms``/``compile_ms`` staging split, the normalized
+  plan-shape hash (utils/shapehash — joins query_trace records), the
+  cache-key fingerprint, executable memory bytes / FLOP estimate
+  (None where the backend doesn't report them) and the trigger
+  taxonomy {cold, warmup, overflow_retry, drift_requantize,
+  lru_evict_rebuild, retrace} — the warmup-debt ledger
+  tools/warmup_report.py renders and the fleet rollup ranks.
+- ``alert``            — utils/compileplane.py compile-storm alerting
+  (rate-windowed post-warmup compiles/min crossing the watermark);
+  the kind is generic so future alerting planes reuse it.
 
 Fleet provenance: the controller's rollup puller stamps every record it
 ships into the fleet ledger with ``node`` (the source instance id) so
@@ -232,10 +245,36 @@ KINDS: Dict[str, Dict[str, set]] = {
         # nodes' process tokens)
         "required": {"nodes_polled", "nodes_skipped", "records_pulled",
                      "tables"},
+        # ``plan_shapes``: the fleet's hottest plan shapes ranked by
+        # warmup cost (freq x median compile_ms over the pulled
+        # compile_event corpus, (proc, seq)-deduped) — verbatim the
+        # prefetch list ROADMAP direction 3's executable plane consumes
         "optional": {"skipped_nodes", "invalid_records", "heat",
                      "slow_queries", "nodes", "fleet", "ingest",
                      "backend", "cursors", "fleet_records",
-                     "window_clipped"},
+                     "window_clipped", "plan_shapes"},
+    },
+    "compile_event": {
+        # one XLA compile (utils/compileplane.StagedFn): ``plan_shape``
+        # is utils/shapehash.shape_key of the owning query's SQL (None
+        # when the compile happened outside a query context);
+        # ``key_fp`` fingerprints the engine cache key; ``memory_bytes``
+        # / ``flops`` are the executable's memory_analysis() /
+        # cost_analysis() where the backend reports them — None, never
+        # fabricated; (``proc``, ``seq``) uniquely identify the event
+        # for fleet dedup.
+        "required": {"site", "trigger", "plan_shape", "key_fp",
+                     "backend", "lower_ms", "compile_ms", "donated",
+                     "proc", "seq"},
+        "optional": {"sql", "qid", "memory_bytes", "flops", "extra"},
+    },
+    "alert": {
+        # a first-class operational alert (compile storms today):
+        # deterministic, rate-windowed, mirrored into the alert ring
+        # both consoles render.
+        "required": {"alert", "severity", "rate_per_min", "watermark",
+                     "window_s", "proc"},
+        "optional": {"detail", "triggers", "backend", "seq", "extra"},
     },
 }
 

@@ -1,0 +1,25 @@
+"""Normalized-SQL shape hash: ONE key per query *shape*.
+
+Hoisted out of tools/span_diff.py (ISSUE 15) so the span-diff plane and
+the compile-forensics plane key on the SAME function: a ``query_trace``
+record's shape and a ``compile_event``'s ``plan_shape`` must join
+exactly, and two private copies of the normalization would drift one
+rename at a time. tools/span_diff.py re-exports this; a tier-1 identity
+test pins the join (tests/test_compile_forensics.py).
+
+The normalization is deliberately minimal — collapse whitespace, case-
+fold — because qids are per-instance uuids and literal values are PART
+of the shape the span baseline keys on (edit a corpus query, re-capture
+the baseline). Anything smarter (literal masking) would change every
+checked-in baseline key.
+"""
+from __future__ import annotations
+
+import hashlib
+import re
+
+
+def shape_key(sql: str) -> str:
+    """12-hex-digit sha1 of the whitespace-collapsed, lowercased SQL."""
+    norm = re.sub(r"\s+", " ", sql.strip().lower())
+    return hashlib.sha1(norm.encode()).hexdigest()[:12]

@@ -60,6 +60,8 @@ def _reset_telemetry_registries():
     yield
     from pinot_tpu.engine.batch import clear_stack_cache
     from pinot_tpu.engine.tier import global_tier
+    from pinot_tpu.utils.compileplane import (DEFAULT_STORM_PER_MIN,
+                                              global_compile_log)
     from pinot_tpu.utils.devmem import global_device_memory
     from pinot_tpu.utils.heat import global_segment_heat
     global_segment_heat.clear()
@@ -69,3 +71,11 @@ def _reset_telemetry_registries():
     # clear() also disarms any test-configured budget); segments keep
     # their caches — they re-register on their next admission
     global_tier.clear()
+    # compile-plane forensics (ISSUE 15): brokers built with a trace/
+    # stats ledger auto-point the process-global compile log at it —
+    # un-point and drop the rings so one test's (often tmp-dir) ledger
+    # can't swallow the next test's compile events. Staged-kernel
+    # caches stay warm by design (the suite's compile warmth).
+    global_compile_log.reset()
+    global_compile_log.path = None
+    global_compile_log.storm_per_min = DEFAULT_STORM_PER_MIN

@@ -563,10 +563,15 @@ def test_chaos_smoke_cli(capsys):
                              "--queries", "q1.1,q4.1"]) == 0
     out = capsys.readouterr().out.strip().splitlines()
     summary = __import__("json").loads(out[-1])
-    # 3 query-plane fault plans + the round-14 fleet-rollup pull kill
-    assert summary["ok"] and summary["plans"] == 4
+    # 3 query-plane fault plans + the round-20 compile-attribution
+    # parity plan + the round-14 fleet-rollup pull kill
+    assert summary["ok"] and summary["plans"] == 5
     assert summary["rollup_faults_fired"] >= 1
     assert summary["fleet_ledger_kinds"].get("fleet_rollup", 0) >= 1
+    # compile-plane gate (ISSUE 15): every warmed plan landed >=1
+    # validated compile_event (shape-hashed) during the baseline pass
+    assert summary["compile_events"] >= 2
+    assert summary["compile_shapes"] >= 2
 
 
 def test_chaos_smoke_vector_cli(capsys):

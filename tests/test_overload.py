@@ -499,10 +499,10 @@ def test_tenant_attribution_crosses_the_wire(mini_cluster):
     seen = []
     orig = global_accountant.register
 
-    def spy(query_id, deadline=None, tenant=None, tier=None):
+    def spy(query_id, deadline=None, tenant=None, tier=None, sql=None):
         seen.append((tenant, tier))
         return orig(query_id, deadline=deadline, tenant=tenant,
-                    tier=tier)
+                    tier=tier, sql=sql)
     global_accountant.register = spy
     try:
         import json as _json

@@ -1019,6 +1019,69 @@ def main(argv=None) -> int:
         summary["queries"] = len(baseline)
         p0 = servers[0].port
 
+        # compile-plane forensics (ISSUE 15): the baseline pass paid
+        # the XLA compiles — every warmed plan must have landed >=1
+        # validated compile_event (they ride the broker's stats
+        # ledger, schema-checked with it below), keyed by the shared
+        # normalized-SQL shape hash. Then a SAME-SEED chaos pass over
+        # cleared compile caches must produce the IDENTICAL
+        # (site, trigger, plan_shape) attribution set — faults perturb
+        # routing, never compile attribution.
+        from pinot_tpu.utils.compileplane import (clear_staged_caches,
+                                                  global_compile_log)
+
+        def _qstream(events):
+            # query-attributed events only: setup-time compiles (none
+            # today, but e.g. a future index build) carry no qid and
+            # must not poison the parity comparison. cold/warmup
+            # collapse to one first-compile class: both are warmup by
+            # the detector's own rule, and which of two CONCURRENT
+            # scatter threads classifies first is scheduler noise —
+            # the attribution the gate pins is that chaos never turns
+            # a first compile into a retrace/rebuild (or vice versa).
+            def cls(t):
+                return t if t not in ("cold", "warmup") else "first"
+            return sorted({(e["site"], cls(e["trigger"]),
+                            e.get("plan_shape"))
+                           for e in events if e.get("qid")})
+
+        stream_base = _qstream(global_compile_log.events())
+        base_shapes = {s for _site, _trig, s in stream_base if s}
+        summary["compile_events"] = len(global_compile_log.events())
+        summary["compile_shapes"] = len(base_shapes)
+        check("compile.per_warmed_plan",
+              len(base_shapes) >= len(queries),
+              f"{len(base_shapes)} compile plan shapes for "
+              f"{len(queries)} warmed plans")
+        # seq watermark, not a ring index: the event ring is bounded,
+        # and a large corpus could wrap it between the passes
+        seq0 = max((e["seq"] for e in global_compile_log.events()),
+                   default=0)
+        for s in servers:
+            broker._failures.record_success(s.instance_id)
+        clear_staged_caches()
+        plan = faults.install(
+            f"seed={args.seed}; "
+            f"rpc.drop: match=:{p0}/query/bin, times=1")
+        try:
+            got = run_all()
+        finally:
+            faults.clear()
+        summary["plans"] += 1
+        stream_chaos = _qstream(
+            [e for e in global_compile_log.events()
+             if e["seq"] > seq0])
+        check("compile.chaos_fired", len(plan.fired) >= 1,
+              "parity plan never fired")
+        check("compile.stream_nonempty", len(stream_chaos) >= 1,
+              "no compile events in the chaos parity pass")
+        check("compile.chaos_parity", stream_base == stream_chaos,
+              f"attribution diverged under chaos: "
+              f"{stream_base} != {stream_chaos}")
+        for qid in baseline:
+            check(f"compile.parity.{qid}", got[qid] == baseline[qid],
+                  "digest mismatch on the recompile-under-chaos pass")
+
         # plan 1: drop server_0's first data-plane dispatch per key
         for plan_name, plan_text in (
                 ("rpc.drop",

@@ -60,10 +60,8 @@ repo ledger so a bench capture fails loudly on a span regression.
 from __future__ import annotations
 
 import argparse
-import hashlib
 import json
 import os
-import re
 import statistics
 import sys
 from typing import Any, Dict, List, Optional, Tuple
@@ -139,11 +137,11 @@ def env_mismatch(baseline_env: Optional[Dict[str, Any]]
 # aggregation
 # ---------------------------------------------------------------------------
 
-def shape_key(sql: str) -> str:
-    """Normalized-SQL hash: one key per query *shape* across capture
-    runs (qids are per-instance uuids, so they cannot key the baseline)."""
-    norm = re.sub(r"\s+", " ", sql.strip().lower())
-    return hashlib.sha1(norm.encode()).hexdigest()[:12]
+# one key per query *shape* across capture runs (qids are per-instance
+# uuids, so they cannot key the baseline). Hoisted into the shared
+# pinot_tpu/utils/shapehash.py (ISSUE 15) so compile_event records join
+# query_trace records on the SAME hash — identity pinned by test.
+from pinot_tpu.utils.shapehash import shape_key  # noqa: E402
 
 
 def load_trace_records(paths: List[str]) -> List[Dict[str, Any]]:
