@@ -604,6 +604,239 @@ def _batching_counters() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# multistage mode (--multistage, PR 16): the join+window+set-op SSB mix
+# through whole-plan mesh compilation vs the mailbox exchange plane
+# ---------------------------------------------------------------------------
+
+MS_METRIC = "ssb_multistage_fused_qps"
+MS_ROUNDS = int(os.environ.get("PINOT_BENCH_MS_ROUNDS", 5))
+MS_FACT_ROWS = int(os.environ.get("PINOT_BENCH_MS_ROWS", 1 << 18))
+MS_CUST_ROWS = 60_000     # > BROADCAST_THRESHOLD -> hash/all_to_all stage
+MS_PART_ROWS = 2_000      # broadcast stage
+
+# literal variants vary ONLY select-expression constants: every variant
+# scans/joins identical row counts, so leaf shapes stay stable and the
+# fused program compiles once per shape (the zero-retrace gate needs it)
+MS_SHAPES = [
+    ("join_gb", lambda i:
+        f"SELECT c.c_nation, SUM(o.o_price + {i % 7}), COUNT(*) "
+        f"FROM orders o JOIN customers c ON o.o_cust = c.c_id "
+        f"GROUP BY c.c_nation ORDER BY c.c_nation LIMIT 10"),
+    ("join3_gb", lambda i:
+        f"SELECT c.c_nation, p.p_brand, SUM(o.o_price * 2 + {i % 5}) "
+        f"FROM orders o JOIN customers c ON o.o_cust = c.c_id "
+        f"JOIN parts p ON o.o_part = p.p_id "
+        f"GROUP BY c.c_nation, p.p_brand "
+        f"ORDER BY c.c_nation, p.p_brand LIMIT 40"),
+    ("join_window", lambda i:
+        f"SELECT c.c_nation, o.o_key + {i % 3}, "
+        f"ROW_NUMBER() OVER (PARTITION BY c.c_nation ORDER BY o.o_key) "
+        f"FROM orders o JOIN customers c ON o.o_cust = c.c_id "
+        f"WHERE o.o_price > 3750 "
+        f"ORDER BY c.c_nation, o.o_key LIMIT 50"),
+    ("join_union", lambda i:
+        f"SELECT c.c_nation, SUM(o.o_price + {i % 4}) FROM orders o "
+        f"JOIN customers c ON o.o_cust = c.c_id "
+        f"WHERE o.o_price > 2500 GROUP BY c.c_nation "
+        f"UNION ALL "
+        f"SELECT p.p_brand, SUM(o.o_price + {i % 4}) FROM orders o "
+        f"JOIN parts p ON o.o_part = p.p_id "
+        f"WHERE o.o_price <= 2500 GROUP BY p.p_brand"),
+]
+
+
+def _ms_broker():
+    """Star schema sized to exercise BOTH collective lowerings: the
+    customers build side exceeds BROADCAST_THRESHOLD (hash exchange ->
+    lax.all_to_all), parts stays under it (broadcast)."""
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    rng = np.random.default_rng(16)
+    out = os.path.join(CACHE, f"multistage_{MS_FACT_ROWS}")
+    cust = {"c_id": np.arange(MS_CUST_ROWS).astype(np.int32),
+            "c_nation": rng.choice(["us", "de", "jp", "br", "cn"],
+                                   MS_CUST_ROWS)}
+    part = {"p_id": np.arange(MS_PART_ROWS).astype(np.int32),
+            "p_brand": rng.choice(["acme", "blitz", "corex"],
+                                  MS_PART_ROWS)}
+    orders = {
+        "o_key": np.arange(MS_FACT_ROWS).astype(np.int64),
+        "o_cust": rng.choice(MS_CUST_ROWS, MS_FACT_ROWS).astype(np.int32),
+        "o_part": rng.choice(MS_PART_ROWS, MS_FACT_ROWS).astype(np.int32),
+        "o_price": rng.integers(10, 5000, MS_FACT_ROWS).astype(np.int64),
+    }
+
+    def build(name, cols, fields, n_segments=1):
+        b = SegmentBuilder(Schema(name, fields), TableConfig(name))
+        dm = TableDataManager(name)
+        n = len(next(iter(cols.values())))
+        bounds = np.linspace(0, n, n_segments + 1).astype(int)
+        for i in range(n_segments):
+            chunk = {k: v[bounds[i]:bounds[i + 1]]
+                     for k, v in cols.items()}
+            dm.add_segment_dir(b.build(chunk, os.path.join(out, name),
+                                       f"s{i}"))
+        return dm
+
+    broker = Broker()
+    broker.register_table(build("customers", cust, [
+        FieldSpec("c_id", DataType.INT),
+        FieldSpec("c_nation", DataType.STRING)]))
+    broker.register_table(build("parts", part, [
+        FieldSpec("p_id", DataType.INT),
+        FieldSpec("p_brand", DataType.STRING)]))
+    broker.register_table(build("orders", orders, [
+        FieldSpec("o_key", DataType.LONG),
+        FieldSpec("o_cust", DataType.INT),
+        FieldSpec("o_part", DataType.INT),
+        FieldSpec("o_price", DataType.LONG, FieldType.METRIC)],
+        n_segments=4))
+    return broker
+
+
+def _ms_drive(broker, plane_opt: str, rounds: int, n_variants: int,
+              latencies: list, errors: list):
+    """-> (wall s, digests {shape: [variant digests]}, queries run)."""
+    digests: dict = {}
+    wall = 0.0
+    n = 0
+    for shape, make in MS_SHAPES:
+        digests[shape] = [None] * n_variants
+        for _r in range(rounds):
+            for k in range(n_variants):
+                sql = make(k) + plane_opt
+                try:
+                    t0 = time.perf_counter()
+                    res = broker.query(sql)
+                    dt = time.perf_counter() - t0
+                    wall += dt
+                    latencies.append(dt * 1e3)
+                    digests[shape][k] = _digest(res.rows)
+                    n += 1
+                except Exception as e:  # noqa: BLE001 — fails the run
+                    errors.append(f"{shape}[{k}]: "
+                                  f"{type(e).__name__}: {e}")
+    return wall, digests, n
+
+
+def run_multistage() -> None:
+    """PR 16 acceptance: the multistage mix through ONE fused shard_map
+    program per plan vs the mailbox exchange plane (device joins
+    disabled so every stage boundary pays the host round-trip the
+    mailbox data plane actually costs), digests byte-identical, zero
+    post-warmup retraces, >= 1.5x QPS."""
+    from bench_common import (attach_capture_context, finish,
+                              install_capture_guard, require_backend)
+    from pinot_tpu.multistage import fused
+    from pinot_tpu.ops.plan_cache import global_plan_cache
+
+    backend = require_backend(MS_METRIC)
+    n_variants = 3
+    # NB "queries" stays out of the live capture dict: finish() treats
+    # that key as the per-query detail MAP of the SSB suite record
+    out: dict = {"metric": MS_METRIC, "value": 0, "unit": "queries/s",
+                 "rows": MS_FACT_ROWS,
+                 "query_count": len(MS_SHAPES) * n_variants}
+    install_capture_guard(lambda: attach_capture_context(dict(out),
+                                                         backend))
+    broker = _ms_broker()
+    errors: list = []
+    fused0 = dict(fused.STATS)
+
+    # warmup both planes: fused whole-plan compiles (one per shape) and
+    # the mailbox plane's window/groupby kernels happen here, outside
+    # every measured window
+    _ms_drive(broker, " OPTION(multistageFused=true)", 1, n_variants,
+              [], errors)
+    mailbox_env = {"PINOT_DEVICE_JOIN_MIN_ROWS": str(1 << 62)}
+    saved = {k: os.environ.get(k) for k in mailbox_env}
+    os.environ.update(mailbox_env)
+    _ms_drive(broker, " OPTION(multistageFused=false)", 1, n_variants,
+              [], errors)
+    for k, v in saved.items():
+        os.environ.pop(k, None) if v is None else \
+            os.environ.__setitem__(k, v)
+    if errors:
+        out["error"] = f"warmup failed: {errors[0]}"
+        print(json.dumps(attach_capture_context(out, backend)))
+        sys.exit(1)
+
+    # measured: fused first, bracketed by the zero-retrace gate
+    miss0 = global_plan_cache.snapshot_misses()
+    det0 = global_plan_cache.detector.retraces
+    lat_f: list = []
+    wall_f, dig_f, n_f = _ms_drive(
+        broker, " OPTION(multistageFused=true)", MS_ROUNDS, n_variants,
+        lat_f, errors)
+    retraces = max(global_plan_cache.snapshot_misses() - miss0,
+                   global_plan_cache.detector.retraces - det0)
+
+    os.environ.update(mailbox_env)
+    lat_m: list = []
+    wall_m, dig_m, n_m = _ms_drive(
+        broker, " OPTION(multistageFused=false)", MS_ROUNDS, n_variants,
+        lat_m, errors)
+    for k, v in saved.items():
+        os.environ.pop(k, None) if v is None else \
+            os.environ.__setitem__(k, v)
+
+    digests_ok = dig_f == dig_m and not errors
+    qps_f = n_f / wall_f if wall_f else 0.0
+    qps_m = n_m / wall_m if wall_m else 0.0
+    speedup = qps_f / qps_m if qps_m else 0.0
+    sl = sorted(lat_f) or [0.0]
+    fused_delta = {k: fused.STATS[k] - fused0[k] for k in fused.STATS}
+    out.update({
+        "value": round(qps_f, 1),
+        "qps_fused": round(qps_f, 1),
+        "qps_mailbox": round(qps_m, 1),
+        "speedup": round(speedup, 2),
+        "p50_ms": round(sl[len(sl) // 2], 2),
+        "p99_ms": round(sl[min(len(sl) - 1, int(len(sl) * 0.99))], 2),
+        "digests_ok": digests_ok,
+        "retraces": retraces,
+        "extra": {
+            "rounds": MS_ROUNDS,
+            "fused_plans": fused_delta["fused_plans"],
+            "fused_fallbacks": fused_delta["fused_fallbacks"],
+            "queries_per_plane": n_f,
+        },
+    })
+    if errors:
+        out["error"] = errors[0]
+    all_ok = (digests_ok and retraces == 0 and speedup >= 1.5
+              and fused_delta["fused_fallbacks"] == 0)
+    if not all_ok and "error" not in out:
+        out["error"] = ("multistage acceptance gate failed "
+                        f"(speedup {out['speedup']}, retraces "
+                        f"{retraces}, digests_ok {digests_ok}, "
+                        f"fallbacks {fused_delta['fused_fallbacks']})")
+
+    # the validated multistage_bench v2 ledger record (writer contract
+    # in pinot_tpu/utils/ledger.py; check_ledger reports the kind)
+    from bench_common import ledger_append_raw
+    from pinot_tpu.utils.ledger import make_record
+    try:
+        ledger_append_raw(make_record(
+            "multistage_bench", backend=backend, ok=bool(all_ok),
+            queries=out["query_count"], qps_fused=out["qps_fused"],
+            qps_mailbox=out["qps_mailbox"], speedup=out["speedup"],
+            p50_ms=out["p50_ms"], p99_ms=out["p99_ms"],
+            digests_ok=bool(digests_ok), retraces=int(retraces),
+            rows=MS_FACT_ROWS, rounds=MS_ROUNDS,
+            fused_plans=fused_delta["fused_plans"],
+            fused_fallbacks=fused_delta["fused_fallbacks"]))
+    except ValueError as e:
+        out["error"] = f"ledger contract violation: {e}"
+        all_ok = False
+    finish(out, backend, all_ok)
+
+
+# ---------------------------------------------------------------------------
 # constrained-budget HBM-tier mode (--tier, ISSUE 13): the full SSB mix
 # under PINOT_HBM_BUDGET_BYTES below the working set, vs the no-tier
 # strawman that evicts everything between queries (re-upload per query)
@@ -979,6 +1212,10 @@ def main() -> None:
     if "--concurrency" in sys.argv:
         n = int(sys.argv[sys.argv.index("--concurrency") + 1])
         run_concurrent_qps(n)
+        return
+
+    if "--multistage" in sys.argv:
+        run_multistage()
         return
 
     if "--tier" in sys.argv:

@@ -36,6 +36,13 @@ Rule catalog (stable ids — tests assert them, diagnostics print them):
     PV110  malformed group keys (cardinality, key_exprs parallelism)
     PV111  parameter kind/dtype mismatch for a predicate/value node
     PV112  malformed SelectPlan (k, order-key packing)
+    PV201  fused exchange partition-spec/key-dtype inconsistency
+    PV202  fused per-shard shape instability across a collective
+    PV203  fused-stage accumulator width overflow
+
+The PV2xx family covers the cross-stage fused IR (ops/ir.FusedPlan):
+``verify_fused_plan`` / ``check_fused_plan`` run fail-fast in
+multistage/fused.py before the whole-plan program is staged.
 """
 from __future__ import annotations
 
@@ -62,6 +69,11 @@ RULES = {
     "PV110": "malformed group keys",
     "PV111": "parameter kind/dtype mismatch",
     "PV112": "malformed SelectPlan",
+    # fused cross-stage IR (ops/ir.FusedPlan — whole-plan mesh
+    # compilation, round 16): the fail-fast contract survives fusion
+    "PV201": "fused exchange partition-spec/key-dtype inconsistency",
+    "PV202": "fused per-shard shape instability across a collective",
+    "PV203": "fused-stage accumulator width overflow",
 }
 
 
@@ -816,6 +828,129 @@ def verify_compiled_plan(cp: Any) -> List[Diagnostic]:
             bucket=cp.segment.bucket, params=cp.params,
             col_names=cp.col_names, segment=cp.segment)
     return []
+
+
+def verify_fused_plan(fp: "ir.FusedPlan") -> List[Diagnostic]:
+    """PV2xx rules over a fused whole-plan IR (ops/ir.FusedPlan).
+
+    The fused program is ONE shard_map over every stage, so one bad
+    static — an exchange partitioned differently from the mesh, a key
+    dtype the int32 collective cannot carry, a stage whose per-shard
+    shape drifts across the all_to_all, a canonical-position domain
+    past the accumulator — corrupts every query sharing the shape.
+    Rules:
+
+        PV201  exchange partition-spec/key-dtype consistency: every
+               exchange runs over the plan's one mesh (partitions
+               equal across stages and to the plan), key dtype is the
+               int32 the collectives are lowered for, hash exchanges
+               carry a pow2 bucket cap, key slots name joined tables
+        PV202  per-shard shape stability across collective boundaries:
+               base_rows divides over the mesh; a hash exchange's
+               received shape (partitions * cap) must cover the shard
+               it was fed (rows are dropped silently otherwise);
+               max_dup/build_rows are pow2 statics within the dense
+               candidate bound
+        PV203  accumulator widths: pos_bound (base_rows * prod
+               max_dup) must fit the int32 accumulator — the canonical
+               row order cannot be restored past it
+    """
+    c = _Ctx(None, None)
+    n_stages = len(fp.stages)
+    if fp.partitions < 1:
+        c.diag("PV201", "fused.partitions",
+               f"mesh partition count {fp.partitions} < 1")
+    if fp.acc_dtype != "int32":
+        c.diag("PV201", "fused.acc_dtype",
+               f"accumulator dtype {fp.acc_dtype!r} is not the int32 "
+               "the collective lowering carries")
+    if fp.base_rows < 1 or fp.base_rows % max(fp.partitions, 1):
+        c.diag("PV202", "fused.base_rows",
+               f"probe seed of {fp.base_rows} rows does not shard "
+               f"evenly over {fp.partitions} devices")
+    shard_rows = fp.base_rows // max(fp.partitions, 1)
+    pos_bound = fp.base_rows
+    for i, st in enumerate(fp.stages):
+        path = f"fused.stages[{i}]"
+        ex = st.exchange
+        if ex.kind not in ("hash", "broadcast"):
+            c.diag("PV201", path + ".exchange.kind",
+                   f"unknown exchange kind {ex.kind!r}")
+        if ex.partitions != fp.partitions:
+            c.diag("PV201", path + ".exchange.partitions",
+                   f"exchange partitioned over {ex.partitions} devices "
+                   f"but the fused mesh has {fp.partitions}",
+                   fix="every stage of one fused program shares one "
+                       "mesh; replan or route mailbox")
+        if ex.key_dtype != "int32":
+            c.diag("PV201", path + ".exchange.key_dtype",
+                   f"key dtype {ex.key_dtype!r}; the collectives are "
+                   "lowered for int32 codes")
+        if not ex.key_slots:
+            c.diag("PV201", path + ".exchange.key_slots",
+                   "exchange carries no key columns")
+        for s, owner in enumerate(ex.key_slots):
+            if not 0 <= owner <= i:
+                c.diag("PV201", path + f".exchange.key_slots[{s}]",
+                       f"key slot gathers from table ordinal {owner}, "
+                       f"not joined before stage {i}")
+        if st.how not in ("inner", "left"):
+            c.diag("PV201", path + ".how",
+                   f"fused lowering has no {st.how!r} join body")
+        if st.max_dup < 1 or st.max_dup & (st.max_dup - 1):
+            c.diag("PV202", path + ".max_dup",
+                   f"max_dup {st.max_dup} is not a pow2 static")
+        if st.build_rows < 1 or st.build_rows & (st.build_rows - 1):
+            c.diag("PV202", path + ".build_rows",
+                   f"padded build side {st.build_rows} is not pow2 "
+                   "(the padded shape is the compile signature)")
+        if ex.kind == "hash":
+            if ex.cap < 1 or ex.cap & (ex.cap - 1):
+                c.diag("PV201", path + ".exchange.cap",
+                       f"hash-exchange bucket cap {ex.cap} is not a "
+                       "pow2 static")
+            elif ex.partitions * ex.cap < shard_rows:
+                c.diag("PV202", path + ".exchange.cap",
+                       f"received shape {ex.partitions}x{ex.cap} cannot "
+                       f"cover the {shard_rows}-row shard it is fed — "
+                       "a full bucket would drop live rows silently",
+                       fix="raise the bucket cap (slack) or fall back "
+                           "to the mailbox plane")
+            # post-exchange, every device probes its received buckets
+            shard_rows = ex.partitions * ex.cap
+        elif ex.cap:
+            c.diag("PV201", path + ".exchange.cap",
+                   "broadcast exchanges have no bucket; cap must be 0")
+        shard_rows *= st.max_dup
+        pos_bound *= st.max_dup
+    if fp.pos_bound != pos_bound and not any(
+            d.rule == "PV202" for d in c.out):
+        c.diag("PV202", "fused.pos_bound",
+               f"declared pos_bound {fp.pos_bound} != base_rows * "
+               f"prod(max_dup) = {pos_bound}")
+    if pos_bound > 2**31 - 1 or fp.pos_bound > 2**31 - 1:
+        c.diag("PV203", "fused.pos_bound",
+               f"canonical-position domain {max(pos_bound, fp.pos_bound)}"
+               " overflows the int32 accumulator — order restoration "
+               "would alias rows",
+               fix="route the plan to the mailbox plane (the fused "
+                   "planner's eligibility gate should have)")
+    if fp.n_tables != n_stages + 1:
+        c.diag("PV202", "fused.n_tables",
+               f"{fp.n_tables} tables with {n_stages} join stages "
+               "(want n_stages + 1)")
+    return c.out
+
+
+def check_fused_plan(fp: Any) -> None:
+    """Fail-fast pre-compile hook (multistage/fused.py): raise on any
+    ERROR diagnostic before the whole-plan program is staged.
+    PINOT_PLAN_VERIFY=0 disables, like check_compiled_plan."""
+    if not verification_enabled():
+        return
+    errors = [d for d in verify_fused_plan(fp) if d.severity == "error"]
+    if errors:
+        raise PlanVerificationError(errors)
 
 
 def verification_enabled() -> bool:

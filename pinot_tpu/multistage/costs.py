@@ -502,3 +502,50 @@ def order_inner_joins(joins: List[Any], base_label: str,
                       "estRows": round(rows)})
         joined.add(j.table.label)
     return out, trace
+
+
+# ---------------------------------------------------------------------------
+# Whole-plan mesh compilation: fused-vs-mailbox plane choice (round 16)
+# ---------------------------------------------------------------------------
+
+FUSED_MIN_ROWS = 100_000    # est. probe rows below which the device
+                            # round-trip cannot beat host hash_join
+FUSED_MAX_WIDTH = 256       # joined-relation column budget: the fused
+                            # gather materializes every needed column
+
+
+def _fused_min_rows() -> int:
+    import os
+    return int(os.environ.get("PINOT_FUSED_MIN_ROWS", FUSED_MIN_ROWS))
+
+
+def choose_multistage_plane(n_dev: int, est_rows: float, width: int,
+                            key_card: Optional[float] = None,
+                            force: Optional[str] = None
+                            ) -> Tuple[str, Dict]:
+    """'fused' or 'mailbox' for a co-located multi-stage plan.
+
+    Estimates only ever steer the physical choice — the fused planner
+    (multistage/fused.py) re-checks every gate exactly against the
+    scanned relations and falls back to the mailbox plane, so
+    correctness never depends on the numbers here. ``force`` carries
+    the OPTION(multistageFused=...) override; it wins whenever the
+    plan is structurally fuseable at all."""
+    trace: Dict[str, Any] = {"nDev": n_dev, "estRows": round(est_rows),
+                             "width": width}
+    if key_card is not None:
+        trace["keyCard"] = round(key_card)
+    if force in ("fused", "mailbox"):
+        trace["forced"] = force
+        return force, trace
+    if est_rows < _fused_min_rows():
+        trace["reason"] = f"estRows<{_fused_min_rows()}"
+        return "mailbox", trace
+    if width > FUSED_MAX_WIDTH:
+        trace["reason"] = f"width>{FUSED_MAX_WIDTH}"
+        return "mailbox", trace
+    if key_card is not None and key_card > 2**31 - 1:
+        trace["reason"] = "keyCard>int32"
+        return "mailbox", trace
+    trace["reason"] = "fused"
+    return "fused", trace

@@ -100,6 +100,8 @@ class MultiStageExecutor:
         self.mailboxes = MailboxService()
         self.join_backends: List[str] = []  # one entry per executed join
         self.dynamic_filters: List[str] = []  # semi-join pushdowns applied
+        self.plane = "mailbox"              # 'fused' once a whole-plan
+        self.plane_trace: Dict[str, Any] = {}  # program served the joins
 
     def _table_schema(self, name: str):
         dm = self.broker.table(name)
@@ -270,6 +272,32 @@ class MultiStageExecutor:
         return order_inner_joins(self.stmt.joins, self.tables[0].label,
                                  table_rows, key_ndv, equi_ok)
 
+    # -- fused-vs-mailbox plane (whole-plan mesh compilation) --------------
+    def _choose_plane(self, needed: Dict[str, Set[str]],
+                      pushed: Dict[str, List[Any]]) -> Tuple[str, Dict]:
+        """costs.choose_multistage_plane over the scan estimate, with
+        the OPTION(multistageFused=true/false) override."""
+        from .costs import (TableStats, _fused_min_rows,
+                            choose_multistage_plane, scan_cardinality)
+        opt = self.stmt.options.get("multistageFused")
+        force = None
+        if opt is not None:
+            force = "fused" if str(opt).strip().lower() in (
+                "1", "true", "yes") else "mailbox"
+        base = self.tables[0]
+        stats = TableStats.from_segments(
+            self.broker.table(base.name).acquire_segments())
+        est = scan_cardinality(stats, _and(pushed.get(base.label, [])))
+        width = sum(len(cols) for cols in needed.values())
+        if force is None and est < _fused_min_rows():
+            # the common small query routes mailbox without paying
+            # backend initialization for a device count it won't use
+            return choose_multistage_plane(0, est, width, None, None)
+        import jax
+
+        return choose_multistage_plane(jax.device_count(), est, width,
+                                       None, force)
+
     # -- joins -------------------------------------------------------------
     def _split_on(self, on: Any, left_labels: Set[str], right_label: str
                   ) -> Tuple[List[Tuple[str, str]], List[Any]]:
@@ -438,32 +466,50 @@ class MultiStageExecutor:
         needed = self._collect_needed()
         pushed, post_where = self._split_where()
 
-        # leaf stages (span-visible: a sampled or EXPLAIN ANALYZE
-        # multistage query attributes scan/join/window/final time the
-        # way single-stage queries attribute their engine phases)
         base = self.tables[0]
-        with span(ph.LEAF_SCAN, table=base.label) as sp:
-            current = self.leaf_scan(base, needed[base.label],
-                                     _and(pushed[base.label]))
-            if sp is not None:
-                sp.annotate(rows=current.n_rows)
-        joined_labels = {base.label}
         # stats collection only pays off when an order choice exists
         ordered_joins = stmt.joins if len(stmt.joins) < 2 \
             else self.plan_join_order(pushed)[0]
-        for si, j in enumerate(ordered_joins):
-            label = j.table.label
-            with span(ph.JOIN_STAGE, table=label,
-                      how=j.join_type) as jsp:
-                current = self._join_step(
-                    j, si, needed, pushed, joined_labels, current,
-                    query_id)
-                if jsp is not None:
-                    jsp.annotate(rows=current.n_rows,
-                                 backend=(self.join_backends[-1]
-                                          if self.join_backends
-                                          else None))
-            joined_labels.add(label)
+
+        # whole-plan mesh compilation (round 16): when the cost plane
+        # picks it, the entire join pipeline runs as ONE shard_map
+        # program (multistage/fused.py) and the mailbox never opens;
+        # any ineligibility/overflow returns None and the classic
+        # per-join path below serves the query — results byte-identical
+        current: Optional[Relation] = None
+        if ordered_joins:
+            plane, self.plane_trace = self._choose_plane(needed, pushed)
+            if plane == "fused":
+                from .fused import execute_fused
+                current = execute_fused(self, ordered_joins, needed,
+                                        pushed, BROADCAST_THRESHOLD)
+                if current is not None:
+                    self.plane = "fused"
+                    self.join_backends = ["fused"] * len(ordered_joins)
+
+        if current is None:
+            # leaf stages (span-visible: a sampled or EXPLAIN ANALYZE
+            # multistage query attributes scan/join/window/final time
+            # the way single-stage queries attribute engine phases)
+            with span(ph.LEAF_SCAN, table=base.label) as sp:
+                current = self.leaf_scan(base, needed[base.label],
+                                         _and(pushed[base.label]))
+                if sp is not None:
+                    sp.annotate(rows=current.n_rows)
+            joined_labels = {base.label}
+            for si, j in enumerate(ordered_joins):
+                label = j.table.label
+                with span(ph.JOIN_STAGE, table=label,
+                          how=j.join_type) as jsp:
+                    current = self._join_step(
+                        j, si, needed, pushed, joined_labels, current,
+                        query_id)
+                    if jsp is not None:
+                        jsp.annotate(rows=current.n_rows,
+                                     backend=(self.join_backends[-1]
+                                              if self.join_backends
+                                              else None))
+                joined_labels.add(label)
 
         for conj in post_where:
             if host_eval.null_aware(stmt):
@@ -550,6 +596,20 @@ def explain_multistage(broker, stmt: SelectStmt) -> ResultTable:
     parent = final
     ordered, trace = ex.plan_join_order(pushed)
     base_est = ex._table_row_est[ex.tables[0].label]
+    if stmt.joins:
+        # plane prediction mirrors _choose_plane minus the device count
+        # (EXPLAIN never initializes a backend — predict_backend rule)
+        from .costs import choose_multistage_plane
+        opt = stmt.options.get("multistageFused")
+        force = None if opt is None else (
+            "fused" if str(opt).strip().lower() in ("1", "true", "yes")
+            else "mailbox")
+        width = sum(len(cols) for cols in needed.values())
+        plane, _ = choose_multistage_plane(0, base_est, width, None,
+                                           force)
+        if plane == "fused":
+            parent = emit(f"FUSED_MESH_PLAN(stages:{len(ordered)},"
+                          f"est_rows:{round(base_est)})", parent)
     # probe-side estimate entering join i = output estimate of join i-1
     probe_ests = [base_est] + [s["estRows"] for s in trace[:-1]]
     for j, step, probe_est in zip(reversed(ordered), reversed(trace),

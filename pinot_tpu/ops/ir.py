@@ -239,6 +239,66 @@ class SelectPlan:
     k: int
 
 
+# ---------------------------------------------------------------------------
+# Cross-stage fused IR (whole-plan mesh compilation, round 16)
+#
+# A multi-stage join pipeline compiles into ONE shard_map program when
+# every stage worker shares a mesh: each stage boundary that the mailbox
+# plane would serve with a host exchange becomes an explicit Exchange
+# node, lowered to a collective inside the fused program ('hash' ->
+# lax.all_to_all bucket exchange, 'broadcast' -> replication of the
+# build side, the all_gather degenerate). The nodes carry exactly the
+# static facts the verifier (analysis/plan_verify.py PV2xx) and the
+# compile plane (utils/compileplane.staged token) need: partition spec,
+# key slots, dtypes, and the per-shard shapes that must stay stable
+# across collective boundaries. Like KernelPlan, everything here is
+# frozen/hashable — one XLA binary per fused plan SHAPE, runtime arrays
+# re-parameterize it.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Exchange:
+    """One stage boundary inside a fused plan. ``partitions`` is the
+    mesh size the collective runs over (1 = single-device mesh, still a
+    shard_map program); ``key_slots`` are (table_ordinal, slot) pairs
+    naming which already-joined table each probe-key slot gathers from;
+    ``cap`` is the pow2 per-device bucket capacity of a hash exchange
+    (0 for broadcast — replication has no bucket)."""
+    kind: str                           # 'hash' | 'broadcast'
+    partitions: int
+    key_slots: Tuple[int, ...]          # probe-side owner table ordinals
+    key_dtype: str = "int32"
+    cap: int = 0
+
+
+@dataclass(frozen=True)
+class FusedJoin:
+    """One join stage of the fused program: the exchange that feeds it
+    plus the dense-formulation statics (ops/join.device_equi_join).
+    ``build_rows`` is the padded build-side length (static shape);
+    ``max_dup`` the pow2 build-key multiplicity bound."""
+    exchange: Exchange
+    how: str                            # 'inner' | 'left'
+    max_dup: int
+    build_rows: int
+
+
+@dataclass(frozen=True)
+class FusedPlan:
+    """The whole-plan IR: N join stages over ``n_tables`` relations,
+    probe seed of ``base_rows`` (padded) rows sharded over
+    ``partitions`` devices. ``pos_bound`` = base_rows * prod(max_dup)
+    is the canonical-position domain — it must fit the accumulator
+    dtype (``acc_dtype``) or the host cannot restore hash_join's
+    canonical row order after the program returns."""
+    stages: Tuple[FusedJoin, ...]
+    n_tables: int
+    base_rows: int
+    partitions: int
+    pos_bound: int
+    acc_dtype: str = "int32"
+
+
 @dataclass(frozen=True)
 class KernelPlan:
     """Everything the kernel builder needs, hashable. group_keys is a tuple

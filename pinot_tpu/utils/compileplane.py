@@ -601,3 +601,9 @@ def clear_staged_caches() -> None:
         window._segment_agg_jit.cache_clear()
     except Exception:
         pass
+    try:
+        from ..multistage import fused
+
+        fused._fused_program.cache_clear()
+    except Exception:
+        pass

@@ -215,6 +215,24 @@ KINDS: Dict[str, Dict[str, set]] = {
                      "chaos", "faults_fired", "query_errors",
                      "structured_429", "error", "extra"},
     },
+    "multistage_bench": {
+        # one bench.py --multistage capture: the join+window+set-op SSB
+        # mix through BOTH planes. ``qps_fused`` runs whole-plan mesh
+        # compilation (multistage/fused.py), ``qps_mailbox`` the same
+        # statements forced OPTION(multistageFused=false) with device
+        # joins disabled — the honest host-exchange plane; ``speedup``
+        # = qps_fused / qps_mailbox. ``digests_ok`` = every query's
+        # sorted-row digest byte-identical across planes (hard gate);
+        # ``retraces`` = post-warmup retraces during the MEASURED
+        # phase (max of plan-cache misses and RetraceDetector, must be
+        # 0); ``p50_ms/p99_ms`` are fused-plane latencies.
+        "required": {"backend", "ok", "queries", "qps_fused",
+                     "qps_mailbox", "speedup", "p50_ms", "p99_ms",
+                     "digests_ok", "retraces"},
+        "optional": {"rows", "devices", "rounds", "per_query",
+                     "fused_plans", "fused_fallbacks", "error",
+                     "extra"},
+    },
     "vector_bench": {
         # one bench_vector.py --ivf capture: ``recall_at_10`` is mean
         # |ivf top-10 ∩ exact top-10| / 10 over the query draw at the

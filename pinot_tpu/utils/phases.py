@@ -46,6 +46,17 @@ STAGE = "stage"                    # remote /stage worker-rooted tree
 STAGE_CALL = "stage_call"          # driver-side per-submission attempt
 STAGE_DISPATCH = "stage_dispatch"  # driver-side fan-out parent
 
+# whole-plan mesh compilation (round 16): when every stage worker
+# shares one mesh, the join pipeline compiles into ONE shard_map
+# program (multistage/fused.py) and the mailbox spans above disappear —
+# fused_plan is their replacement parent (leaf scans, the staged
+# compile/execute, and the canonical-order gather are its children) and
+# collective_exchange attributes each in-program stage boundary
+# (hash -> all_to_all, broadcast -> replication) so EXPLAIN ANALYZE and
+# the span-diff gate keep per-stage self-times when the plan fuses
+FUSED_PLAN = "fused_plan"
+COLLECTIVE_EXCHANGE = "collective_exchange"
+
 # cross-query micro-batching (PR 8): every query that passes through the
 # ragged admission queue wraps its wait + fused dispatch in ONE
 # ragged_dispatch span on its own thread (queue_wait_ms annotated), so
@@ -68,5 +79,6 @@ TRACED_PHASES = frozenset(
 SPAN_NAMES = TRACED_PHASES | frozenset(
     {QUERY, BROKER_OVERHEAD, SCATTER, SCATTER_CALL, SERVER_QUERY,
      LEAF_SCAN, JOIN_STAGE, EXCHANGE, WINDOW_STAGE, FINAL_STAGE,
+     FUSED_PLAN, COLLECTIVE_EXCHANGE,
      STAGE, STAGE_CALL, STAGE_DISPATCH,
      RAGGED_DISPATCH, CUBE_BUILD, FUSED_EXECUTE})

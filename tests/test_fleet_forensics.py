@@ -277,6 +277,7 @@ def _count_stats(path):
     return out
 
 
+@pytest.mark.slow
 def test_fleet_rollup_multi_node_smoke(fleet):
     ctrl, srv, (b1, b2) = fleet
     for b, n in ((b1, 3), (b2, 2)):

@@ -1,0 +1,434 @@
+"""Whole-plan mesh compilation tests: the fused shard_map plane must be
+byte-identical to the mailbox plane over the full multistage corpus.
+
+Covers: fused==mailbox digests (joins incl. null-aware keys, windows,
+set-ops, hybrid mixes), all three exchange lowerings (csr broadcast,
+hash all_to_all, sort broadcast), PV2xx plan verification, the cost
+model's plane choice, the device.overflow chaos fallback edge, zero
+post-warmup retraces via the RetraceDetector, and compile-event
+reconciliation (site "multistage" in the compile log).
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.broker import Broker
+from pinot_tpu.ops import ir
+from pinot_tpu.ops.plan_cache import global_plan_cache
+from pinot_tpu.segment import SegmentBuilder
+from pinot_tpu.server import TableDataManager
+from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                           TableConfig)
+from pinot_tpu.analysis.plan_verify import (PlanVerificationError,
+                                            check_fused_plan,
+                                            verify_fused_plan)
+from pinot_tpu.multistage import fused as fused_mod
+from pinot_tpu.multistage.costs import choose_multistage_plane
+from pinot_tpu.utils import faults
+from pinot_tpu.utils.compileplane import global_compile_log
+
+N_ORDERS = 3000
+
+FUSED = " OPTION(multistageFused=true)"
+MAILBOX = " OPTION(multistageFused=false)"
+
+# the multistage corpus: every shape class the fused lowering claims —
+# single/multi join, LEFT, multi-key equi, deferred non-equi conjunct,
+# pushed + post-join filters, window frame, set-op hybrid
+CORPUS = [
+    ("join_gb",
+     "SELECT c.c_nation, SUM(o.o_price), COUNT(*) FROM orders o "
+     "JOIN customers c ON o.o_cust = c.c_id "
+     "GROUP BY c.c_nation ORDER BY c.c_nation LIMIT 10"),
+    ("join3_gb",
+     "SELECT c.c_nation, p.p_brand, SUM(o.o_price) FROM orders o "
+     "JOIN customers c ON o.o_cust = c.c_id "
+     "JOIN parts p ON o.o_part = p.p_id "
+     "GROUP BY c.c_nation, p.p_brand "
+     "ORDER BY c.c_nation, p.p_brand LIMIT 40"),
+    ("join_window",
+     "SELECT c.c_nation, o.o_price, "
+     "ROW_NUMBER() OVER (PARTITION BY c.c_nation ORDER BY o.o_price) "
+     "FROM orders o JOIN customers c ON o.o_cust = c.c_id "
+     "WHERE o.o_price > 4000 ORDER BY c.c_nation, o.o_price LIMIT 50"),
+    ("join_union",
+     "SELECT c.c_nation, SUM(o.o_price) FROM orders o "
+     "JOIN customers c ON o.o_cust = c.c_id "
+     "WHERE o.o_price > 2500 GROUP BY c.c_nation "
+     "UNION ALL "
+     "SELECT p.p_brand, SUM(o.o_price) FROM orders o "
+     "JOIN parts p ON o.o_part = p.p_id "
+     "WHERE o.o_price <= 2500 GROUP BY p.p_brand"),
+    ("left_join_gb",
+     "SELECT c.c_nation, COUNT(*) FROM orders o "
+     "LEFT JOIN customers c ON o.o_cust = c.c_id "
+     "GROUP BY c.c_nation ORDER BY c.c_nation LIMIT 10"),
+    ("multi_key",
+     "SELECT COUNT(*), SUM(o.o_price) FROM orders o "
+     "JOIN customers c ON o.o_cust = c.c_id AND o.o_qty = c.c_active"),
+    ("non_equi_rest",
+     "SELECT c.c_nation, COUNT(*) FROM orders o "
+     "JOIN customers c ON o.o_cust = c.c_id AND o.o_price > 2500 "
+     "GROUP BY c.c_nation ORDER BY c.c_nation"),
+    ("post_where",
+     "SELECT SUM(o.o_qty) FROM orders o "
+     "JOIN customers c ON o.o_cust = c.c_id "
+     "WHERE c.c_active = 1 AND o.o_price > 1000 AND c.c_nation = 'us'"),
+]
+
+
+@pytest.fixture(scope="module")
+def star(tmp_path_factory):
+    rng = np.random.default_rng(5)
+    out = tmp_path_factory.mktemp("fused_star")
+
+    cust_ids = np.arange(100)
+    cust = {
+        "c_id": cust_ids.astype(np.int32),
+        "c_nation": rng.choice(["us", "de", "jp", "br"], 100),
+        "c_active": rng.integers(0, 2, 100).astype(np.int32),
+    }
+    part_ids = np.arange(40)
+    part = {
+        "p_id": part_ids.astype(np.int32),
+        "p_brand": rng.choice(["acme", "blitz", "corex"], 40),
+    }
+    orders = {
+        "o_cust": rng.choice(cust_ids, N_ORDERS).astype(np.int32),
+        "o_part": rng.choice(part_ids, N_ORDERS).astype(np.int32),
+        "o_qty": rng.integers(1, 20, N_ORDERS).astype(np.int32),
+        "o_price": rng.integers(10, 5000, N_ORDERS).astype(np.int64),
+    }
+
+    def build(name, cols, fields, n_segments=1):
+        schema = Schema(name, fields)
+        b = SegmentBuilder(schema, TableConfig(name))
+        dm = TableDataManager(name)
+        n = len(next(iter(cols.values())))
+        bounds = np.linspace(0, n, n_segments + 1).astype(int)
+        for i in range(n_segments):
+            chunk = {k: v[bounds[i]:bounds[i + 1]] for k, v in cols.items()}
+            dm.add_segment_dir(b.build(chunk, str(out / name), f"s{i}"))
+        return dm
+
+    broker = Broker()
+    broker.register_table(build("customers", cust, [
+        FieldSpec("c_id", DataType.INT),
+        FieldSpec("c_nation", DataType.STRING),
+        FieldSpec("c_active", DataType.INT),
+    ]))
+    broker.register_table(build("parts", part, [
+        FieldSpec("p_id", DataType.INT),
+        FieldSpec("p_brand", DataType.STRING),
+    ]))
+    broker.register_table(build("orders", orders, [
+        FieldSpec("o_cust", DataType.INT),
+        FieldSpec("o_part", DataType.INT),
+        FieldSpec("o_qty", DataType.INT, FieldType.METRIC),
+        FieldSpec("o_price", DataType.LONG, FieldType.METRIC),
+    ], n_segments=3))
+    return broker
+
+
+def _rows(broker, sql):
+    return [tuple(r) for r in broker.query(sql).rows]
+
+
+# ---------------------------------------------------------------------------
+# parity: fused == mailbox, byte-identical row streams
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,sql", CORPUS, ids=[n for n, _ in CORPUS])
+def test_fused_mailbox_parity(star, name, sql):
+    assert _rows(star, sql + FUSED) == _rows(star, sql + MAILBOX)
+
+
+def test_fused_plane_engages(star):
+    """OPTION(multistageFused=true) actually takes the fused plane (not
+    a silent fallback) for a plain fuseable join."""
+    before = dict(fused_mod.STATS)
+    _rows(star, CORPUS[0][1] + FUSED)
+    assert fused_mod.STATS["fused_plans"] > before["fused_plans"]
+    # and the explicit mailbox override pins the classic plane
+    before = dict(fused_mod.STATS)
+    _rows(star, CORPUS[0][1] + MAILBOX)
+    assert fused_mod.STATS["fused_plans"] == before["fused_plans"]
+
+
+def test_null_join_keys_parity(tmp_path):
+    """NULL keys never match on either plane; LEFT null-extends. The
+    fused program must agree with the mailbox plane row for row."""
+    ls = Schema("fna", [FieldSpec("k", DataType.INT),
+                        FieldSpec("v", DataType.INT, FieldType.METRIC)])
+    rs = Schema("fnb", [FieldSpec("k", DataType.INT),
+                        FieldSpec("x", DataType.INT, FieldType.METRIC)])
+    ldm = TableDataManager("fna")
+    ldm.add_segment_dir(SegmentBuilder(ls, TableConfig("fna")).build(
+        [{"k": 1, "v": 10}, {"k": None, "v": 20}, {"k": 3, "v": 30}],
+        str(tmp_path / "fna"), "s0"))
+    rdm = TableDataManager("fnb")
+    rdm.add_segment_dir(SegmentBuilder(rs, TableConfig("fnb")).build(
+        [{"k": 1, "x": 100}, {"k": None, "x": 200}],
+        str(tmp_path / "fnb"), "s0"))
+    b = Broker()
+    b.register_table(ldm)
+    b.register_table(rdm)
+    for sql, want in [
+        ("SELECT COUNT(*) FROM fna a JOIN fnb b2 ON a.k = b2.k",
+         [(1,)]),
+        ("SELECT a.v, b2.x FROM fna a LEFT JOIN fnb b2 ON a.k = b2.k "
+         "ORDER BY a.v", [(10, 100), (20, 0), (30, 0)]),
+    ]:
+        assert _rows(b, sql + FUSED) == want
+        assert _rows(b, sql + MAILBOX) == want
+
+
+def test_duplicate_keys_parity(tmp_path):
+    """max_dup > 1 row expansion is order-identical across planes."""
+    ls = Schema("fdl", [FieldSpec("k", DataType.INT)])
+    rs = Schema("fdr", [FieldSpec("k", DataType.INT),
+                        FieldSpec("x", DataType.INT, FieldType.METRIC)])
+    ldm = TableDataManager("fdl")
+    ldm.add_segment_dir(SegmentBuilder(ls, TableConfig("fdl")).build(
+        {"k": np.array([1, 1, 2], np.int32)}, str(tmp_path / "fdl"), "s0"))
+    rdm = TableDataManager("fdr")
+    rdm.add_segment_dir(SegmentBuilder(rs, TableConfig("fdr")).build(
+        {"k": np.array([1, 1, 3], np.int32),
+         "x": np.array([5, 7, 9], np.int32)}, str(tmp_path / "fdr"), "s0"))
+    b = Broker()
+    b.register_table(ldm)
+    b.register_table(rdm)
+    sql = "SELECT l.k, r.x FROM fdl l JOIN fdr r ON l.k = r.k"
+    rows = _rows(b, sql + FUSED)
+    assert rows == _rows(b, sql + MAILBOX)
+    assert sorted(rows) == [(1, 5), (1, 5), (1, 7), (1, 7)]
+
+
+# ---------------------------------------------------------------------------
+# the three exchange lowerings
+# ---------------------------------------------------------------------------
+
+def _stage_kinds(monkeypatch):
+    """Spy on plan_fused: record the stage kinds every fused plan used."""
+    seen = []
+    real = fused_mod.plan_fused
+
+    def spy(*a, **kw):
+        plan, stages, reason = real(*a, **kw)
+        if plan is not None:
+            seen.append([s.kind for s in stages])
+        return plan, stages, reason
+
+    monkeypatch.setattr(fused_mod, "plan_fused", spy)
+    return seen
+
+
+def test_hash_exchange_parity(star, monkeypatch):
+    """Drop both thresholds so the customers build side crosses into the
+    hash/all_to_all lowering; results stay byte-identical."""
+    import pinot_tpu.multistage.executor as ex_mod
+    sql = CORPUS[0][1]
+    baseline = _rows(star, sql + MAILBOX)   # before knobs move
+    monkeypatch.setenv("PINOT_FUSED_HASH_MIN", "0")
+    monkeypatch.setattr(ex_mod, "BROADCAST_THRESHOLD", 0)
+    kinds = _stage_kinds(monkeypatch)
+    assert _rows(star, sql + FUSED) == baseline
+    assert kinds and "hash" in kinds[-1]
+
+
+def test_sort_exchange_parity(star, monkeypatch):
+    """PINOT_FUSED_MAX_CSR=0 disables the CSR lowering: broadcast joins
+    take the device sort/search path and must agree byte for byte."""
+    sql = CORPUS[1][1]
+    baseline = _rows(star, sql + MAILBOX)
+    monkeypatch.setenv("PINOT_FUSED_MAX_CSR", "0")
+    kinds = _stage_kinds(monkeypatch)
+    assert _rows(star, sql + FUSED) == baseline
+    assert kinds and all(k == "sort" for k in kinds[-1])
+
+
+def test_csr_is_default_broadcast_lowering(star, monkeypatch):
+    kinds = _stage_kinds(monkeypatch)
+    _rows(star, CORPUS[1][1] + FUSED)
+    assert kinds and all(k == "csr" for k in kinds[-1])
+
+
+# ---------------------------------------------------------------------------
+# chaos: forced device.overflow takes the real fallback edge
+# ---------------------------------------------------------------------------
+
+def test_device_overflow_falls_back_to_mailbox(star):
+    sql = CORPUS[0][1]
+    want = _rows(star, sql + MAILBOX)
+    before = dict(fused_mod.STATS)
+    faults.install("seed=11; device.overflow: match=multistage.fused, "
+                   "p=1.0")
+    try:
+        assert _rows(star, sql + FUSED) == want
+    finally:
+        faults.clear()
+    assert fused_mod.STATS["fused_fallbacks"] > before["fused_fallbacks"]
+    # and with the fault cleared the fused plane serves again
+    before = dict(fused_mod.STATS)
+    assert _rows(star, sql + FUSED) == want
+    assert fused_mod.STATS["fused_plans"] > before["fused_plans"]
+
+
+# ---------------------------------------------------------------------------
+# compile plane: zero post-warmup retraces, events reconcile
+# ---------------------------------------------------------------------------
+
+def test_zero_post_warmup_retraces(star):
+    """A warm second pass over the whole corpus must not retrace: the
+    fused program is one cached XLA binary per plan shape."""
+    for _, sql in CORPUS:          # warmup (first pass may cold-compile)
+        _rows(star, sql + FUSED)
+    det = global_plan_cache.detector
+    before_retraces = det.retraces
+    before_misses = global_plan_cache.snapshot_misses()
+    for _, sql in CORPUS:
+        _rows(star, sql + FUSED)
+    assert det.retraces == before_retraces
+    assert global_plan_cache.snapshot_misses() == before_misses
+
+
+def test_compile_events_reconcile(tmp_path):
+    """Fused compiles land in the compile log at site "multistage" and
+    none of them classifies as a retrace (detector reconciliation).
+    Staged caches stay warm across tests (suite warmth) while conftest
+    resets the compile log between tests, so this builds a dedicated
+    3-table chain whose stage statics match no other test's — its
+    fused program compiles cold inside THIS test's log window."""
+    rng = np.random.default_rng(7)
+    t1 = Schema("ev1", [FieldSpec("a", DataType.INT),
+                        FieldSpec("v", DataType.INT, FieldType.METRIC)])
+    t2 = Schema("ev2", [FieldSpec("a", DataType.INT),
+                        FieldSpec("b", DataType.INT)])
+    t3 = Schema("ev3", [FieldSpec("b", DataType.INT),
+                        FieldSpec("w", DataType.INT, FieldType.METRIC)])
+    cols = {
+        "ev1": (t1, {"a": rng.integers(0, 6, 48).astype(np.int32),
+                     "v": np.arange(48, dtype=np.int32)}),
+        "ev2": (t2, {"a": np.repeat(np.arange(6), 3).astype(np.int32),
+                     "b": rng.integers(0, 5, 18).astype(np.int32)}),
+        "ev3": (t3, {"b": np.repeat(np.arange(5), 2).astype(np.int32),
+                     "w": np.arange(10, dtype=np.int32)}),
+    }
+    b = Broker()
+    for name, (schema, data) in cols.items():
+        dm = TableDataManager(name)
+        dm.add_segment_dir(SegmentBuilder(schema, TableConfig(name)).build(
+            data, str(tmp_path / name), "s0"))
+        b.register_table(dm)
+    sql = ("SELECT SUM(t.v + r.w) FROM ev1 t "
+           "JOIN ev2 m ON t.a = m.a JOIN ev3 r ON m.b = r.b")
+    misses = fused_mod._fused_program.cache_info().misses
+    assert _rows(b, sql + FUSED) == _rows(b, sql + MAILBOX)
+    assert fused_mod._fused_program.cache_info().misses > misses, \
+        "plan shape collided with a warm program; event window is void"
+    ms = [e for e in global_compile_log.events()
+          if e["site"] == "multistage"]
+    assert ms, "fused compile left no site=multistage compile event"
+    assert all(e["trigger"] in ("cold", "warmup") for e in ms), ms
+
+
+def test_explain_shows_fused_plan(star):
+    res = star.query("EXPLAIN " + CORPUS[0][1] + FUSED)
+    ops = [r[0] for r in res.rows]
+    assert any(op.startswith("FUSED_MESH_PLAN(") for op in ops), ops
+    # the mailbox override keeps the fused row out of the plan
+    res = star.query("EXPLAIN " + CORPUS[0][1] + MAILBOX)
+    assert not any(r[0].startswith("FUSED_MESH_PLAN(") for r in res.rows)
+
+
+def test_explain_analyze_fused_spans(star):
+    from pinot_tpu.utils import phases as ph
+    res = star.query("EXPLAIN ANALYZE " + CORPUS[0][1] + FUSED)
+    names = {r[0] for r in res.rows}
+    assert ph.FUSED_PLAN in names
+    assert ph.COLLECTIVE_EXCHANGE in names
+    assert ph.FUSED_EXECUTE in names
+
+
+# ---------------------------------------------------------------------------
+# cost model: the plane choice
+# ---------------------------------------------------------------------------
+
+def test_choose_plane_cost_gates():
+    plane, trace = choose_multistage_plane(8, 1e6, 10)
+    assert plane == "fused" and trace["reason"] == "fused"
+    plane, trace = choose_multistage_plane(8, 10, 10)
+    assert plane == "mailbox" and "estRows<" in trace["reason"]
+    plane, trace = choose_multistage_plane(8, 1e6, 500)
+    assert plane == "mailbox" and "width>" in trace["reason"]
+    plane, trace = choose_multistage_plane(8, 1e6, 10, key_card=2.0**32)
+    assert plane == "mailbox" and trace["reason"] == "keyCard>int32"
+
+
+def test_choose_plane_force_overrides_estimates():
+    plane, trace = choose_multistage_plane(8, 10, 10, force="fused")
+    assert plane == "fused" and trace["forced"] == "fused"
+    plane, trace = choose_multistage_plane(8, 1e6, 10, force="mailbox")
+    assert plane == "mailbox" and trace["forced"] == "mailbox"
+
+
+def test_fused_min_rows_env_knob(monkeypatch):
+    monkeypatch.setenv("PINOT_FUSED_MIN_ROWS", "5")
+    plane, _ = choose_multistage_plane(8, 10, 10)
+    assert plane == "fused"
+
+
+# ---------------------------------------------------------------------------
+# PV2xx: fused-plan verification
+# ---------------------------------------------------------------------------
+
+def _good_plan(**over):
+    ex = ir.Exchange(kind=over.pop("kind", "broadcast"),
+                     partitions=over.pop("ex_partitions", 8),
+                     key_slots=over.pop("key_slots", (0,)),
+                     key_dtype=over.pop("key_dtype", "int32"),
+                     cap=over.pop("cap", 0))
+    st = ir.FusedJoin(exchange=ex, how=over.pop("how", "inner"),
+                      max_dup=over.pop("max_dup", 2),
+                      build_rows=over.pop("build_rows", 128))
+    base = over.pop("base_rows", 1024)
+    return ir.FusedPlan(stages=(st,), n_tables=over.pop("n_tables", 2),
+                        base_rows=base, partitions=over.pop("partitions", 8),
+                        pos_bound=over.pop("pos_bound", base * st.max_dup),
+                        acc_dtype=over.pop("acc_dtype", "int32"))
+
+
+def _rules(fp):
+    return {d.rule for d in verify_fused_plan(fp)}
+
+
+def test_pv_clean_plan_verifies():
+    assert verify_fused_plan(_good_plan()) == []
+    check_fused_plan(_good_plan())   # no raise
+
+
+def test_pv201_exchange_consistency():
+    assert "PV201" in _rules(_good_plan(ex_partitions=4))   # mesh drift
+    assert "PV201" in _rules(_good_plan(key_dtype="int64"))
+    assert "PV201" in _rules(_good_plan(key_slots=()))
+    assert "PV201" in _rules(_good_plan(key_slots=(1,)))    # not joined yet
+    assert "PV201" in _rules(_good_plan(kind="shuffle"))
+    assert "PV201" in _rules(_good_plan(cap=64))            # broadcast w/ cap
+    assert "PV201" in _rules(_good_plan(kind="hash", cap=0))
+
+
+def test_pv202_shape_stability():
+    assert "PV202" in _rules(_good_plan(max_dup=3))
+    assert "PV202" in _rules(_good_plan(build_rows=100))
+    assert "PV202" in _rules(_good_plan(base_rows=100, pos_bound=200))
+    # a hash exchange whose received shape cannot cover its fed shard
+    assert "PV202" in _rules(_good_plan(kind="hash", cap=8))
+    assert "PV202" in _rules(_good_plan(n_tables=5))
+    assert "PV202" in _rules(_good_plan(pos_bound=4096))    # declared drift
+
+
+def test_pv203_accumulator_overflow():
+    fp = _good_plan(base_rows=2**20, max_dup=2**12, build_rows=2**12,
+                    pos_bound=2**32)
+    assert "PV203" in _rules(fp)
+    with pytest.raises(PlanVerificationError):
+        check_fused_plan(fp)

@@ -176,7 +176,8 @@ def test_invalid_trace_ratio_is_sql_error(skew_segment_dir):
 # ---------------------------------------------------------------------------
 
 DRIFT_SQL = ("SELECT k, SUM(v) FROM drifty WHERE f <= 50 "
-             "GROUP BY k ORDER BY k LIMIT 3000")
+             "GROUP BY k ORDER BY k LIMIT 3000 "
+             "OPTION(timeoutMs=60000)")
 
 
 def test_drift_requantizes_cap_and_recompiles_once(skew_segment_dir):

@@ -70,8 +70,11 @@ def _tree_ok(rows):
 # ---------------------------------------------------------------------------
 
 def test_explain_analyze_tree_and_timing(broker):
+    # timeoutMs: the warm run may pay the compact kernel's cold compile
+    # when no earlier test warmed this shape (the fleet-smoke idiom)
     sql = ("EXPLAIN ANALYZE SELECT k, g, SUM(v) FROM obs WHERE v > 10 "
-           "GROUP BY k, g OPTION(groupByStrategy=compact)")
+           "GROUP BY k, g OPTION(groupByStrategy=compact, "
+           "timeoutMs=60000)")
     broker.query(sql)                       # warm: compile outside timing
     res = broker.query(sql)
     assert res.columns == ANALYZE_COLUMNS

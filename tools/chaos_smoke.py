@@ -697,6 +697,281 @@ def main_vector(args) -> int:
     return 0 if not failures else 1
 
 
+FUSED_ROWS = 65536
+
+
+def build_fused_broker(tmp: str, rows: int, seed: int):
+    """In-process broker over a 3-table join star (the whole-plan mesh
+    compilation surface: fact ``orders`` in 4 segments + two dims)."""
+    import numpy as np
+
+    from pinot_tpu.broker import Broker
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.server import TableDataManager
+    from pinot_tpu.spi import (DataType, FieldSpec, FieldType, Schema,
+                               TableConfig)
+
+    rng = np.random.default_rng(seed)
+    n_cust = max(rows // 4, 64)
+    n_part = max(rows // 64, 16)
+    tables = {
+        "customers": ({
+            "c_id": np.arange(n_cust).astype(np.int32),
+            "c_nation": rng.choice(["us", "de", "jp", "br", "cn"],
+                                   n_cust),
+        }, [FieldSpec("c_id", DataType.INT),
+            FieldSpec("c_nation", DataType.STRING)], 1),
+        "parts": ({
+            "p_id": np.arange(n_part).astype(np.int32),
+            "p_brand": rng.choice(["acme", "blitz", "corex"], n_part),
+        }, [FieldSpec("p_id", DataType.INT),
+            FieldSpec("p_brand", DataType.STRING)], 1),
+        "orders": ({
+            "o_key": np.arange(rows).astype(np.int64),
+            "o_cust": rng.choice(n_cust, rows).astype(np.int32),
+            "o_part": rng.choice(n_part, rows).astype(np.int32),
+            "o_price": rng.integers(10, 5000, rows).astype(np.int64),
+        }, [FieldSpec("o_key", DataType.LONG),
+            FieldSpec("o_cust", DataType.INT),
+            FieldSpec("o_part", DataType.INT),
+            FieldSpec("o_price", DataType.LONG, FieldType.METRIC)], 4),
+    }
+    broker = Broker()
+    for name, (cols, fields, n_segments) in tables.items():
+        schema = Schema(name, fields)
+        b = SegmentBuilder(schema, TableConfig(name))
+        dm = TableDataManager(name)
+        n = len(next(iter(cols.values())))
+        step = -(-n // n_segments)
+        for i in range(n_segments):
+            chunk = {k: v[i * step:(i + 1) * step]
+                     for k, v in cols.items()}
+            dm.add_segment_dir(b.build(chunk, os.path.join(tmp, name),
+                                       f"s{i}"))
+        broker.register_table(dm)
+    return broker, tables
+
+
+FUSED_MIX = [
+    "SELECT c.c_nation, SUM(o.o_price), COUNT(*) FROM orders o "
+    "JOIN customers c ON o.o_cust = c.c_id "
+    "GROUP BY c.c_nation ORDER BY c.c_nation LIMIT 10",
+    "SELECT c.c_nation, p.p_brand, SUM(o.o_price) FROM orders o "
+    "JOIN customers c ON o.o_cust = c.c_id "
+    "JOIN parts p ON o.o_part = p.p_id "
+    "GROUP BY c.c_nation, p.p_brand "
+    "ORDER BY c.c_nation, p.p_brand LIMIT 20",
+    "SELECT c.c_nation, o.o_key, "
+    "ROW_NUMBER() OVER (PARTITION BY c.c_nation ORDER BY o.o_key) "
+    "FROM orders o JOIN customers c ON o.o_cust = c.c_id "
+    "WHERE o.o_price > 4900 ORDER BY c.c_nation, o.o_key LIMIT 50",
+    "SELECT c.c_nation, SUM(o.o_price) FROM orders o "
+    "JOIN customers c ON o.o_cust = c.c_id "
+    "WHERE o.o_price > 2500 GROUP BY c.c_nation "
+    "UNION ALL "
+    "SELECT p.p_brand, SUM(o.o_price) FROM orders o "
+    "JOIN parts p ON o.o_part = p.p_id "
+    "WHERE o.o_price <= 2500 GROUP BY p.p_brand",
+]
+
+
+def main_fused(args) -> int:
+    """--fused: the whole-plan mesh compilation chaos gate (ISSUE 16):
+    (a) fused == mailbox byte-identical digests over a join + window +
+    set-op mix, (b) a p=1.0 ``device.overflow`` plan forces the real
+    fallback edge — the mailbox plane serves every query byte-
+    identically, two same-seed runs firing identical streams — and
+    (c) a cross-host distributed_join under a seeded ``rpc.drop``
+    pins that cross-process plans ride the mailbox data plane (the
+    fused counter never moves), fail LOUDLY when a frame drops, and
+    answer byte-identical to the numpy oracle once the fault clears."""
+    import numpy as np
+
+    from pinot_tpu.multistage import fused
+    from pinot_tpu.utils import faults
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_fused_chaos_")
+    failures = []
+    summary = {"mode": "fused", "rows": args.rows, "seed": args.seed,
+               "queries": len(FUSED_MIX), "faults_fired": 0}
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+            print(f"FAIL {name}: {detail}")
+
+    def dig(res):
+        return sorted(tuple(r) for r in res.rows)
+
+    faults.clear()
+    broker, _tables = build_fused_broker(tmp, args.rows, args.seed)
+    try:
+        # (a) parity: every mix query byte-identical across planes,
+        # and the fused plane genuinely engaged
+        plans0 = fused.STATS["fused_plans"]
+        for i, q in enumerate(FUSED_MIX):
+            d_m = dig(broker.query(q + " OPTION(multistageFused=false)"))
+            d_f = dig(broker.query(q + " OPTION(multistageFused=true)"))
+            check(f"parity.q{i}", d_f == d_m,
+                  "fused and mailbox digests differ")
+        check("parity.engaged",
+              fused.STATS["fused_plans"] - plans0 >= len(FUSED_MIX),
+              "the fused plane never engaged on the mix")
+
+        # (b) device.overflow chaos: forced overflow takes the real
+        # fallback edge; the mailbox plane must serve every query
+        # byte-identically and same-seed runs fire identical streams
+        def overflow_run():
+            plan = faults.install(
+                f"seed={args.seed}; device.overflow: "
+                f"match=multistage.fused, p=1.0")
+            try:
+                out = [dig(broker.query(
+                    q + " OPTION(multistageFused=true)"))
+                    for q in FUSED_MIX]
+            finally:
+                faults.clear()
+            return plan, out
+
+        fb0 = fused.STATS["fused_fallbacks"]
+        plan1, got1 = overflow_run()
+        summary["faults_fired"] += len(plan1.fired)
+        check("overflow.fired", len(plan1.fired) >= len(FUSED_MIX),
+              f"{len(plan1.fired)} fires for {len(FUSED_MIX)} queries")
+        check("overflow.fallbacks",
+              fused.STATS["fused_fallbacks"] - fb0 >= len(FUSED_MIX),
+              "forced overflow did not route the mailbox fallback")
+        for i, q in enumerate(FUSED_MIX):
+            check(f"overflow.q{i}",
+                  got1[i] == dig(broker.query(
+                      q + " OPTION(multistageFused=false)")),
+                  "digest mismatch on the chaos fallback path")
+        plan2, got2 = overflow_run()
+        check("overflow.deterministic",
+              plan1.fired_summary() == plan2.fired_summary(),
+              f"{plan1.fired_summary()} != {plan2.fired_summary()}")
+        check("overflow.rerun", got1 == got2,
+              "same-seed rerun digests differ")
+
+        # (c) cross-host plans ride the mailbox data plane: a 2-process
+        # distributed_join never touches the fused counter; a seeded
+        # rpc.drop of one mailbox frame fails the stage loudly (no
+        # partial relation), same-seed reruns fire identical streams,
+        # and the join is byte-exact once the fault clears
+        from pinot_tpu.cluster import Controller, ServerNode
+        from pinot_tpu.multistage.dispatch import distributed_join
+        from pinot_tpu.segment import SegmentBuilder
+        from pinot_tpu.spi import (DataType, FieldSpec, FieldType,
+                                   Schema, TableConfig)
+
+        rng = np.random.default_rng(args.seed + 1)
+        n_o, n_c = 400, 50
+        xo = {"cust_id": rng.integers(0, n_c + 5, n_o)
+              .astype(np.int32),
+              "amount": rng.integers(1, 1000, n_o).astype(np.int32)}
+        xc = {"id": np.arange(n_c, dtype=np.int32),
+              "tier": rng.choice(["gold", "silver"], n_c)}
+        ctrl = Controller(os.path.join(tmp, "ctrl"),
+                          heartbeat_timeout=5.0,
+                          reconcile_interval=0.2)
+        servers = [ServerNode(f"server_{i}", ctrl.url,
+                              poll_interval=0.1) for i in range(2)]
+        try:
+            so = Schema("xorders", [
+                FieldSpec("cust_id", DataType.INT),
+                FieldSpec("amount", DataType.INT, FieldType.METRIC)])
+            sc = Schema("xcust", [
+                FieldSpec("id", DataType.INT),
+                FieldSpec("tier", DataType.STRING)])
+            ctrl.add_table("xorders", so.to_dict(), replication=1)
+            ctrl.add_table("xcust", sc.to_dict(), replication=1)
+            ctrl.add_segment("xorders", "xorders_0", SegmentBuilder(
+                so, TableConfig("xorders")).build(
+                xo, os.path.join(tmp, "xseg"), "xorders_0"))
+            ctrl.add_segment("xcust", "xcust_0", SegmentBuilder(
+                sc, TableConfig("xcust")).build(
+                xc, os.path.join(tmp, "xseg"), "xcust_0"))
+            v = ctrl.routing_snapshot()["version"]
+            for s in servers:
+                assert s.wait_for_version(v, timeout=30.0)
+
+            def owner_url(table):
+                for s in servers:
+                    dm = s._tables.get(table)
+                    if dm is not None and dm.acquire_segments():
+                        return s.url
+                raise AssertionError(table)
+
+            def run_join():
+                return distributed_join(
+                    [{"url": owner_url("xorders"),
+                      "sql": "SELECT cust_id, amount FROM xorders "
+                             "LIMIT 100000", "alias": "o"}],
+                    [{"url": owner_url("xcust"),
+                      "sql": "SELECT id, tier FROM xcust "
+                             "LIMIT 100000", "alias": "c"}],
+                    [s.url for s in servers],
+                    ["o.cust_id"], ["c.id"])
+
+            plans_x = fused.STATS["fused_plans"]
+            drop_text = (f"seed={args.seed}; rpc.drop: "
+                         f"match=/mailbox, times=1")
+
+            def drop_run():
+                plan = faults.install(drop_text)
+                loud = False
+                try:
+                    run_join()
+                except Exception:
+                    loud = True
+                finally:
+                    faults.clear()
+                return plan, loud
+
+            pland1, loud1 = drop_run()
+            summary["faults_fired"] += len(pland1.fired)
+            check("rpc_drop.fired", len(pland1.fired) >= 1,
+                  "rpc.drop never fired on the mailbox plane")
+            check("rpc_drop.loud", loud1,
+                  "a dropped mailbox frame did not fail the stage")
+            pland2, loud2 = drop_run()
+            check("rpc_drop.deterministic",
+                  pland1.fired_summary() == pland2.fired_summary(),
+                  f"{pland1.fired_summary()} != "
+                  f"{pland2.fired_summary()}")
+            check("rpc_drop.rerun_loud", loud2,
+                  "same-seed rerun did not fail the stage")
+
+            rel = run_join()
+            tier = {int(i): t for i, t in zip(xc["id"], xc["tier"])}
+            exp = sorted((int(c), int(a), tier[int(c)]) for c, a in
+                         zip(xo["cust_id"], xo["amount"])
+                         if int(c) in tier)
+            got = sorted(zip(rel.data["o.cust_id"].tolist(),
+                             rel.data["o.amount"].tolist(),
+                             rel.data["c.tier"].tolist()))
+            check("crosshost.digest", got == exp,
+                  "distributed join differs from the numpy oracle")
+            check("crosshost.mailbox_pinned",
+                  fused.STATS["fused_plans"] == plans_x,
+                  "a cross-host plan engaged the fused plane")
+        finally:
+            for s in servers:
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+            ctrl.stop()
+    finally:
+        faults.clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
 def main_overload(args) -> int:
     """--overload: the ISSUE-12 overload-resilience gate. One closed-
     loop traffic replay (tools/traffic_replay.py, cluster mode): record
@@ -957,6 +1232,11 @@ def main(argv=None) -> int:
                          "VECTOR_SIMILARITY queries under rpc.drop + "
                          "tier.evict with identical top-k and a "
                          "reconciled vector devmem pool")
+    ap.add_argument("--fused", action="store_true",
+                    help="run the whole-plan mesh compilation gate: "
+                         "fused == mailbox parity, device.overflow "
+                         "fallback and cross-host mailbox pinning "
+                         "under seeded rpc.drop")
     ap.add_argument("--multiple", type=float, default=4.0,
                     help="--overload mode: replay load multiple")
     ap.add_argument("--replay-queries", type=int, default=40,
@@ -972,7 +1252,8 @@ def main(argv=None) -> int:
             else RATE_ROWS if args.rate \
             else OVERLOAD_ROWS if args.overload \
             else TIER_ROWS if args.tier \
-            else VECTOR_ROWS if args.vector else 4096
+            else VECTOR_ROWS if args.vector \
+            else FUSED_ROWS if args.fused else 4096
     if args.ingest:
         return main_ingest(args)
     if args.rate:
@@ -983,6 +1264,8 @@ def main(argv=None) -> int:
         return main_tier(args)
     if args.vector:
         return main_vector(args)
+    if args.fused:
+        return main_fused(args)
 
     from pinot_tpu.cluster.http_util import http_json
     from pinot_tpu.utils import faults
