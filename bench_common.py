@@ -615,6 +615,52 @@ def warmup_debt_gate(ledger_path: str | None = None,
         return {"ok": True, "skipped": f"{type(e).__name__}: {e}"}
 
 
+def slo_gate(ledger_path: str | None = None) -> dict | None:
+    """tools/slo_report.py gate over the bench ledger's query_stats
+    corpus (ISSUE 17): the FIFTH gate beside span/freshness/overload/
+    warmup. The bars come from the environment —
+    ``PINOT_SLO_LATENCY_BAR_MS`` and/or ``PINOT_SLO_AVAILABILITY``
+    (good-fraction target), plus optional ``PINOT_SLO_OBJECTIVE`` and
+    ``PINOT_SLO_BURN_THRESHOLD`` — and with NEITHER bar configured the
+    gate passes vacuously *and says so*: an SLO gate with no declared
+    objective has nothing to judge, and inventing a default bar would
+    fail every bench whose hardware this repo has never seen."""
+    sreport = os.path.join(REPO, "tools", "slo_report.py")
+    if not os.path.exists(sreport):
+        return None
+    bar = os.environ.get("PINOT_SLO_LATENCY_BAR_MS")
+    avail = os.environ.get("PINOT_SLO_AVAILABILITY")
+    if not bar and not avail:
+        return {"ok": True, "skipped": "no SLO bars configured "
+                "(PINOT_SLO_LATENCY_BAR_MS / PINOT_SLO_AVAILABILITY)"}
+    ledger_path = ledger_path or LEDGER
+    if not os.path.exists(ledger_path):
+        return {"ok": True, "skipped": "no bench ledger to judge"}
+    try:
+        cmd = [sys.executable, sreport, "gate", ledger_path,
+               # an existing bench ledger legitimately carries no
+               # query_stats (bench_capture records only) — vacuity is
+               # the tool's default; min-events 0 keeps this gate
+               # judging only what the corpus actually recorded
+               "--min-events", "0"]
+        if bar:
+            cmd += ["--latency-bar-ms", bar]
+        if avail:
+            cmd += ["--availability-objective", avail]
+        if os.environ.get("PINOT_SLO_OBJECTIVE"):
+            cmd += ["--objective", os.environ["PINOT_SLO_OBJECTIVE"]]
+        if os.environ.get("PINOT_SLO_BURN_THRESHOLD"):
+            cmd += ["--burn-threshold",
+                    os.environ["PINOT_SLO_BURN_THRESHOLD"]]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        summary = json.loads(proc.stdout.strip().splitlines()[-1])
+        summary["ok"] = proc.returncode == 0
+        return summary
+    except Exception as e:  # the gate must never lose a capture
+        return {"ok": True, "skipped": f"{type(e).__name__}: {e}"}
+
+
 def finish(out: dict, backend: str, all_ok: bool) -> None:
     """Shared tail: ledger compare+append, span-diff + freshness
     regression gates, print the ONE JSON line, exit."""
@@ -656,6 +702,15 @@ def finish(out: dict, backend: str, all_ok: bool) -> None:
             out.setdefault(
                 "error", "warmup-debt gate failed: "
                          + "; ".join(wgate.get("failures")
+                                     or ["not ok"])[:200])
+    sgate = slo_gate()
+    if sgate is not None:
+        out["slo_gate"] = sgate
+        if not sgate.get("ok", True):
+            all_ok = False
+            out.setdefault(
+                "error", "SLO burn gate failed: "
+                         + "; ".join(sgate.get("failures")
                                      or ["not ok"])[:200])
     prev = ledger_last(out["metric"], backend, out.get("n_rows"))
     d = ledger_deltas(out, prev)

@@ -29,9 +29,11 @@ from ..query.sql import SetOpStmt, SqlError, parse_sql, to_sql
 from ..utils import phases as ph
 from ..utils.metrics import global_metrics, ingest_health
 from ..utils.spans import Span, sample_decision, span, span_tracer
-from .forensics import (QueryForensics, ledger_debug_payload,
-                        memory_debug_payload, parse_since,
-                        parse_slow_query_ms, parse_trace_ratio)
+from ..utils.slo import SLOWQ_TAIL, global_incidents, global_slo
+from .forensics import (QueryForensics, debug_index,
+                        ledger_debug_payload, memory_debug_payload,
+                        parse_since, parse_slow_query_ms,
+                        parse_trace_ratio)
 from .http_util import (JsonHandler, http_json, http_raw,
                         inject_trace_context, start_http)
 
@@ -214,6 +216,19 @@ class BrokerNode:
             from ..utils.compileplane import global_compile_log
             global_compile_log.configure_path_if_unset(
                 self.forensics.ledger_path)
+        # SLO plane (ISSUE 17): burn alerts / slo_status / incident
+        # bundles default into the SAME stats ledger so /debug/ledger
+        # ships them to the fleet rollup with zero extra config, and
+        # the broker donates its slow-query ring tail to the incident
+        # flight recorder's bundle (utils/ cannot import cluster state)
+        if self.forensics.ledger_path:
+            if global_slo.path is None:
+                global_slo.path = self.forensics.ledger_path
+            if global_incidents.path is None:
+                global_incidents.path = self.forensics.ledger_path
+        global_incidents.register_surface(
+            "slow_queries",
+            lambda: self.forensics.snapshot(SLOWQ_TAIL)["queries"])
         self._routing: Dict[str, Any] = {"version": -1}
         # round-robin cursor for explain/failover re-picks. An itertools
         # counter, not an int += 1: _pick_replica runs on pool threads
@@ -1270,6 +1285,11 @@ class BrokerNode:
         from ..engine.tier import tier_health
         from ..utils.compileplane import compile_health
         from ..utils.metrics import overload_health
+        # armed freshness objectives sample their ingest gauges on the
+        # health poll (dead/stale gauge = bad sample); unarmed this is
+        # one attribute read
+        if global_slo.armed:
+            global_slo.observe_freshness()
         snap = global_metrics.snapshot()
         c = snap["counters"]
         fd = self._failures.snapshot()
@@ -1300,6 +1320,9 @@ class BrokerNode:
             # HBM tier occupancy + placement-affinity hit ratio
             # (engine/tier.py) — the memory-hierarchy health block
             "tier": tier_health(snap),
+            # SLO burn table (ISSUE 17): per-objective fast/slow burn
+            # + budget remaining + latch state (utils/slo.py)
+            "slo": global_slo.status_block(),
         }
 
     # -- REST --------------------------------------------------------------
@@ -1335,14 +1358,21 @@ class BrokerNode:
                                            e.retry_after_ms})
                 return 400, {"error": str(e)}
 
-        def debug_queries(h, b):
-            # GET /debug/queries[?n=K]: the slow-query/forensics ring
+        def _limit(path):
             from urllib.parse import parse_qs, urlparse
             try:
-                limit = int(parse_qs(urlparse(h.path).query)["n"][0])
+                return int(parse_qs(urlparse(path).query)["n"][0])
             except (KeyError, ValueError, IndexError):
-                limit = None
-            return 200, node.forensics.snapshot(limit)
+                return None
+
+        def debug_queries(h, b):
+            # GET /debug/queries[?n=K]: the slow-query/forensics ring
+            return 200, node.forensics.snapshot(_limit(h.path))
+
+        def debug_incidents(h, b):
+            # GET /debug/incidents[?n=K]: flight-recorder bundles,
+            # newest first (utils/slo.py IncidentRecorder)
+            return 200, global_incidents.snapshot(_limit(h.path))
 
         class Handler(JsonHandler):
             routes = {
@@ -1366,6 +1396,15 @@ class BrokerNode:
                 # compile_events + compile-storm alerts, newest first
                 ("GET", "/debug/compile"): lambda h, b: (
                     200, _compile_log_snapshot()),
+                # debug-surface index + SLO plane (ISSUE 17)
+                ("GET", "/debug"): lambda h, b: (
+                    200, debug_index(node.instance_id, "broker",
+                                     extra=("/debug/queries",
+                                            "/debug/compile",
+                                            "/debug/slo"))),
+                ("GET", "/debug/incidents"): debug_incidents,
+                ("GET", "/debug/slo"): lambda h, b: (
+                    200, global_slo.status_block()),
                 ("GET", "/ui"): lambda h, b: (
                     200, ("text/html", node.ui_page())),
                 ("POST", "/query/sql"): q,
@@ -1395,8 +1434,17 @@ class BrokerNode:
  #slowq{color:#a96;margin-top:.5em;font-size:.85em;
    border-top:1px solid #333;padding-top:.5em}
  #slowq td{border:1px solid #333;font-size:1em}
+ #links{font-size:.85em;color:#678}
+ #links a{color:#7ac}
 </style></head><body>
 <h2>pinot-tpu query console</h2>
+<div id=links>debug: <a href=/debug>index</a> &middot;
+<a href=/debug/queries>queries</a> &middot;
+<a href=/debug/compile>compile</a> &middot;
+<a href=/debug/memory>memory</a> &middot;
+<a href=/debug/ledger>ledger</a> &middot;
+<a href=/debug/slo>slo</a> &middot;
+<a href=/debug/incidents>incidents</a></div>
 <textarea id=sql>SELECT * FROM mytable LIMIT 10</textarea><br>
 <button onclick=run()>Run (Ctrl-Enter)</button>
 <div id=stats></div><div id=warn></div><div id=err></div><div id=out></div>
@@ -1511,7 +1559,14 @@ async function health(){
       ' | scheduler rejected '+(o.scheduler_rejected||0)+
       ' | tenants '+(Object.entries(ot).map(([t,s])=>
         esc(t)+'['+s.tier+'] inflight '+s.inflight+
-        ' shed '+((o.shed_by_tenant||{})[t]||0)).join(', ')||'none');
+        ' shed '+((o.shed_by_tenant||{})[t]||0)).join(', ')||'none')+
+      '\\nslo: '+(((m.slo||{}).armed)?
+        ((m.slo||{}).objectives||[]).map(s=>
+          esc(s.scope)+'/'+s.kind+' burn '+s.burn_fast+'x/'+
+          s.burn_slow+'x budget '+
+          (s.budget_remaining*100).toFixed(0)+'%'+
+          (s.alerting?' ALERTING':'')).join(' | ')||'no objectives'
+        :'unarmed');
   }catch(e){}
 }
 async function slowq(){

@@ -938,6 +938,16 @@ class Controller:
                 return fn(h, b)
             return wrapped
 
+        def _debug_index(c):
+            from .forensics import debug_index
+            return debug_index(
+                getattr(c, "instance_id", "controller"), "controller",
+                surfaces=("/debug/fleet", "/debug/incidents"))
+
+        def _incidents():
+            from ..utils.slo import global_incidents
+            return global_incidents.snapshot()
+
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/ui"): lambda h, b: (
@@ -994,6 +1004,13 @@ class Controller:
                 # fleet forensics rollup plane (round 14)
                 ("GET", "/debug/fleet"): lambda h, b: (
                     200, ctrl.rollup.snapshot()),
+                # debug-surface index + incident ring (ISSUE 17): the
+                # controller serves the fleet view, not node ledgers —
+                # its index says so instead of advertising 404s
+                ("GET", "/debug"): lambda h, b: (
+                    200, _debug_index(ctrl)),
+                ("GET", "/debug/incidents"): lambda h, b: (
+                    200, _incidents()),
                 ("POST", "/segmentConsumed"): lambda h, b: (
                     200, ctrl.completion.segment_consumed(
                         b["table"], b["segment"], b["server"],

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..query.sql import SqlError
 from ..utils import ledger as uledger
 from ..utils.metrics import global_metrics
+from ..utils.slo import global_incidents, global_slo
 
 DEFAULT_SLOW_QUERY_MS = 500.0
 DEFAULT_TRACE_RATIO = 0.0
@@ -221,6 +222,9 @@ class QueryForensics:
                                   if hasattr(trace, "to_dict") else trace)
             with self._lock:
                 self._ring.append(entry)
+        # SLO plane feed (utils/slo.py): unarmed this is ONE attribute
+        # read — the <1% hot-path overhead contract
+        global_slo.observe_query(rec)
         return rec
 
     def record_trace(self, root: Any, sql: str, qid: str
@@ -332,7 +336,31 @@ def ledger_debug_payload(node_id: str, role: str, path: Optional[str],
             "compile": compile_health(snap),
             "memory": global_device_memory.snapshot(),
             "tier": global_tier.snapshot(),
-            "heat": global_segment_heat.snapshot(top=heat_top)}
+            "heat": global_segment_heat.snapshot(top=heat_top),
+            # SLO burn table + incident counts (ISSUE 17): the rollup
+            # aggregates these per node into fleet_rollup.slo
+            "slo": global_slo.status_block(),
+            "incidents": {"count": global_incidents.snapshot(0)["count"],
+                          "captured": global_incidents.captured}}
+
+
+# the debug surfaces every data-plane role serves at minimum; roles
+# extend with their extras (broker: queries/compile/slo; controller
+# advertises its own set — it serves /debug/fleet, not node ledgers)
+DEBUG_SURFACES = ("/debug/ledger", "/debug/memory", "/debug/incidents")
+
+
+def debug_index(node_id: str, role: str,
+                extra: Tuple[str, ...] = (),
+                surfaces: Optional[Tuple[str, ...]] = None
+                ) -> Dict[str, Any]:
+    """GET /debug payload — the index of every debug surface THIS node
+    actually serves (truthful per role), so an operator landing on any
+    role can enumerate the forensics endpoints instead of memorizing
+    them. ``surfaces`` overrides the data-plane default set."""
+    base = DEBUG_SURFACES if surfaces is None else surfaces
+    return {"node": node_id, "role": role, "proc": PROC_TOKEN,
+            "surfaces": sorted(tuple(base) + tuple(extra))}
 
 
 def memory_debug_payload(node_id: str,

@@ -270,6 +270,54 @@ def merge_heat(node_blocks: Dict[str, Dict[str, Any]],
     return out
 
 
+def aggregate_slo(node_blocks: Dict[str, Dict[str, Any]]
+                  ) -> Dict[str, Any]:
+    """Fleet SLO table from the per-node ``slo``/``incidents`` blocks
+    (ledger_debug_payload, ISSUE 17). Node blocks dedupe by ``proc``
+    first (in-process roles share ONE SloPlane — summing per node would
+    multiply-count), then merge per (scope, kind): worst (max) burn
+    rates and lowest budget remaining across processes — the fleet view
+    surfaces the most-burned replica, not an average that hides it —
+    with additive event/bad/incident counts (distinct processes observe
+    distinct queries). Pure record->dict math, exported for the oracle
+    tests."""
+    seen: Dict[str, Dict[str, Any]] = {}
+    for node_id in sorted(node_blocks):
+        blk = node_blocks[node_id]
+        seen.setdefault(blk.get("proc") or node_id, blk)
+    rows: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    incidents = 0
+    armed = False
+    for blk in seen.values():
+        inc = blk.get("incidents") or {}
+        incidents += int(inc.get("count", 0))
+        slo = blk.get("slo") or {}
+        armed = armed or bool(slo.get("armed"))
+        for r in slo.get("objectives") or []:
+            key = (str(r.get("scope") or "?"), str(r.get("kind") or "?"))
+            m = rows.setdefault(key, {
+                "scope": key[0], "kind": key[1],
+                "objective": r.get("objective"),
+                "burn_fast": 0.0, "burn_slow": 0.0,
+                "budget_remaining": 1.0, "events": 0, "bad": 0,
+                "alerting": False})
+            m["burn_fast"] = max(m["burn_fast"],
+                                 float(r.get("burn_fast", 0.0)))
+            m["burn_slow"] = max(m["burn_slow"],
+                                 float(r.get("burn_slow", 0.0)))
+            m["budget_remaining"] = min(
+                m["budget_remaining"],
+                float(r.get("budget_remaining", 1.0)))
+            m["events"] += int(r.get("events", 0))
+            m["bad"] += int(r.get("bad", 0))
+            m["alerting"] = m["alerting"] or bool(r.get("alerting"))
+            if r.get("stale"):
+                m["stale"] = True
+    return {"armed": armed,
+            "objectives": [rows[k] for k in sorted(rows)],
+            "open_incidents": incidents}
+
+
 def fleet_totals(node_blocks: Dict[str, Dict[str, Any]]
                  ) -> Dict[str, int]:
     """Unique-process sums of the carried counters + device bytes."""
@@ -407,6 +455,9 @@ class ForensicsRollupTask:
                 "memory": resp.get("memory"),
                 "tier": resp.get("tier"),
                 "heat": resp.get("heat"),
+                # SLO burn table + incident counts (ISSUE 17)
+                "slo": resp.get("slo"),
+                "incidents": resp.get("incidents"),
             }
         self._save_cursors()
 
@@ -439,6 +490,8 @@ class ForensicsRollupTask:
             "heat": merge_heat(node_blocks),
             "nodes": node_summaries,
             "fleet": fleet_totals(node_blocks),
+            # worst-replica fleet SLO view + open incident count
+            "slo": aggregate_slo(node_blocks),
         }
         if self._total_records > len(fleet_records):
             # older records aged out of the window: say so instead of

@@ -398,13 +398,20 @@ class ServerNode:
         return execute_stage(self, spec, trace_ctx=trace_ctx)
 
     def _make_handler(self):
-        from .forensics import (ledger_debug_payload, memory_debug_payload,
-                                parse_since)
+        from ..utils.slo import global_incidents
+        from .forensics import (debug_index, ledger_debug_payload,
+                                memory_debug_payload, parse_since)
         node = self
 
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
+                # debug-surface index + incident flight-recorder ring
+                # (ISSUE 17; in-process clusters share the recorder)
+                ("GET", "/debug"): lambda h, b: (
+                    200, debug_index(node.instance_id, "server")),
+                ("GET", "/debug/incidents"): lambda h, b: (
+                    200, global_incidents.snapshot()),
                 # ledger shipping + device-memory telemetry (round 14):
                 # the controller's ForensicsRollupTask pulls the ledger
                 # delta + heat/devmem/counters blocks; /debug/memory is

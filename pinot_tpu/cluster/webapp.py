@@ -118,7 +118,12 @@ function vCluster() {
     `<h2>Leadership</h2>` +
     table(["leader", "lease holder", "this instance"],
       [[esc(D.leader || "-"), esc(D.lease_holder || "-"),
-        esc(D.instance_id || "-")]]);
+        esc(D.instance_id || "-")]]) +
+    `<p class="mut">debug surfaces: <a href="/debug">/debug</a> ·
+     <a href="/debug/fleet">/debug/fleet</a> ·
+     <a href="/debug/incidents">/debug/incidents</a> — per-node
+     queries/compile/memory/ledger/slo indexes at each broker and
+     server's own <code>/debug</code></p>`;
 }
 
 function vTables() {
@@ -157,6 +162,28 @@ function vFleet() {
     (${(r.skipped_nodes || []).map(esc).join(", ") || "none skipped"}) ·
     ${r.fleet_records || 0} fleet records · ledger ${esc(f.ledger
     || "")}</p>`;
+  // fleet SLO view (ISSUE 17): worst-replica burn per objective —
+  // fleet_rollup.slo from the proc-deduped node blocks
+  const slo = r.slo || {};
+  const sloTbl = (slo.objectives || []).length ? table(
+    ["scope", "kind", "objective", "burn fast", "burn slow",
+     "budget left", "events", "bad", "state"],
+    slo.objectives.map(s => [esc(s.scope), esc(s.kind),
+      s.objective != null ? s.objective : "-",
+      (s.burn_fast != null ? s.burn_fast : 0) + "x",
+      (s.burn_slow != null ? s.burn_slow : 0) + "x",
+      ((s.budget_remaining != null ? s.budget_remaining : 1) * 100)
+        .toFixed(1) + "%",
+      s.events || 0, s.bad || 0,
+      (s.alerting ? '<span class="badge dead">ALERTING</span>'
+                  : '<span class="badge live">OK</span>') +
+      (s.stale ? ' <span class="badge dead">STALE</span>' : "")]))
+    : `<p class="mut">${slo.armed ? "no objectives reporting yet"
+        : "SLO plane unarmed — no objectives declared on the nodes"
+      }</p>`;
+  const sloHead = `<h3>SLO error budgets <span class="mut">(worst
+    replica · open incidents ${slo.open_incidents || 0} — see
+    /debug/incidents on any node)</span></h3>`;
   const tbl = table(["table", "queries", "qps", "p50 ms", "p99 ms",
       "partial", "failovers", "hedges", "batched", "slow", "shed",
       "freshness ms"],
@@ -209,6 +236,7 @@ function vFleet() {
         c.tier_affinity_hits || 0];
     }));
   return `<h2>Fleet forensics</h2>${pull}
+    ${sloHead}${sloTbl}
     <h3>Per-table fleet stats</h3>${tbl}
     <h3>Slowest queries</h3>${slow}
     <h3>Hottest plan shapes (warmup debt)</h3>${shapes}

@@ -129,11 +129,14 @@ class CompileLog:
         self._seq = 0
         self._events: deque = deque(maxlen=RING_CAPACITY)
         self._alerts: deque = deque(maxlen=ALERT_RING_CAPACITY)
-        # (monotonic ts, trigger) of post-warmup compiles inside the
-        # storm window; _storming latches so one sustained storm fires
-        # ONE alert at the crossing (re-armed when the rate drains)
-        self._storm: deque = deque()
-        self._storming = False
+        # storm detection delegates to the ONE latch/window
+        # implementation (utils/alerts.RateWindowRule, ISSUE 17):
+        # registered on the generic manager so /debug surfaces and the
+        # SLO plane share a single alerting plane — the rule owns the
+        # (ts, trigger) window deque and the fire-once latch verbatim
+        from .alerts import global_alerts
+        self._storm_rule = global_alerts.rate_rule(
+            "compile_storm", self.storm_per_min, STORM_WINDOW_S)
         self.events_written = 0
         self.alerts_fired = 0
 
@@ -168,10 +171,9 @@ class CompileLog:
         with self._lock:
             self._events.clear()
             self._alerts.clear()
-            self._storm.clear()
-            self._storming = False
             self.events_written = 0
             self.alerts_fired = 0
+        self._storm_rule.reset()
 
     # -- recording (compile-time only: never on the warm hot path) --------
     def record(self, site: str, trigger: str, lower_ms: float,
@@ -218,50 +220,35 @@ class CompileLog:
 
     def _note_storm(self, rec: Dict[str, Any]) -> None:
         """Rate-windowed compile-storm detection: deterministic in the
-        event stream (one alert per watermark crossing)."""
+        event stream (one alert per watermark crossing). The window +
+        latch live in the shared RateWindowRule (utils/alerts) — the
+        watermark is passed per call so ``configure()`` keeps working;
+        non-storm triggers still prune/evaluate (count=False) so the
+        rate decays and the latch re-arms on quiet streams."""
         now = time.monotonic()
-        fire = None
-        with self._lock:
-            if rec["trigger"] in POST_WARMUP_TRIGGERS:
-                self._storm.append((now, rec["trigger"]))
-            while self._storm and now - self._storm[0][0] \
-                    > STORM_WINDOW_S:
-                self._storm.popleft()
-            rate = len(self._storm)
-            watermark = self.storm_per_min
-            if rate >= watermark and not self._storming:
-                self._storming = True
-                counts: Dict[str, int] = {}
-                for _t, trig in self._storm:
-                    counts[trig] = counts.get(trig, 0) + 1
-                fire = (rate, watermark, counts)
-            elif rate < watermark:
-                self._storming = False
+        watermark = self.storm_per_min
+        fire, rate = self._storm_rule.note(
+            now, tag=rec["trigger"],
+            count=rec["trigger"] in POST_WARMUP_TRIGGERS,
+            watermark=watermark)
         global_metrics.gauge("compile_storm_per_min", rate)
         global_metrics.gauge("compile_storm_watermark", watermark)
         if fire is not None:
-            self._fire_alert(*fire)
+            self._fire_alert(fire["rate"], int(fire["watermark"]),
+                             fire["tags"])
 
     def _fire_alert(self, rate: int, watermark: int,
                     counts: Dict[str, int]) -> Dict[str, Any]:
-        from . import ledger as uledger
+        from .alerts import global_alerts
 
-        rec = uledger.make_record(
-            "alert", alert="compile_storm", severity="warn",
-            rate_per_min=rate, watermark=watermark,
-            window_s=STORM_WINDOW_S, proc=PROC_TOKEN,
-            triggers=counts, backend=_backend(),
+        rec = global_alerts.fire(
+            "compile_storm", "warn", rate, watermark, STORM_WINDOW_S,
+            triggers=counts, backend=_backend(), proc=PROC_TOKEN,
+            path=self.path, counter="compile_storm_alerts",
             detail=f"{rate} post-warmup compiles/min >= watermark "
                    f"{watermark} (retrace churn / eviction rebuild "
                    "thrash)")
-        global_metrics.count("compile_storm_alerts")
         span_tracer.annotate(compile_storm=True)
-        path = self.path
-        if path:
-            try:
-                uledger.append_record(rec, path)
-            except OSError:
-                global_metrics.count("compile_event_write_errors")
         with self._lock:
             self._alerts.append(rec)
             self.alerts_fired += 1

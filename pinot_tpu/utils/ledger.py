@@ -59,9 +59,24 @@ Kinds:
   taxonomy {cold, warmup, overflow_retry, drift_requantize,
   lru_evict_rebuild, retrace} — the warmup-debt ledger
   tools/warmup_report.py renders and the fleet rollup ranks.
-- ``alert``            — utils/compileplane.py compile-storm alerting
-  (rate-windowed post-warmup compiles/min crossing the watermark);
-  the kind is generic so future alerting planes reuse it.
+- ``alert``            — utils/alerts.py AlertManager firings: the
+  compile-storm detector (rate-windowed post-warmup compiles/min
+  crossing the watermark, utils/compileplane.py) and the SLO plane's
+  burn-rate alerts (utils/slo.py — ``rate_per_min`` carries the burn
+  rate, ``window_s`` the slow window, ``extra`` the objective scope/
+  kind/windows). One generic kind; one latch implementation.
+- ``slo_status``       — utils/slo.py per-objective status emissions
+  (on alert fire/clear transitions + explicit snapshots): burn rates
+  over the paired fast/slow windows, error-budget remaining over the
+  slow window, event/bad counts — the per-node stream
+  cluster/rollup.py aggregates into the ``fleet_rollup.slo`` block
+  and tools/slo_report.py gates on.
+- ``incident``         — utils/slo.py incident flight recorder: on an
+  alert fire, ONE bounded bundle of the node's debug surfaces
+  (slow-query ring tail, governor rung + shed counters, tier
+  occupancy, devmem pools, compile block, active SLO burn table)
+  keyed by the firing alert — served at GET /debug/incidents and
+  rendered in the webapp.
 
 Fleet provenance: the controller's rollup puller stamps every record it
 ships into the fleet ledger with ``node`` (the source instance id) so
@@ -267,10 +282,13 @@ KINDS: Dict[str, Dict[str, set]] = {
         # warmup cost (freq x median compile_ms over the pulled
         # compile_event corpus, (proc, seq)-deduped) — verbatim the
         # prefetch list ROADMAP direction 3's executable plane consumes
+        # ``slo``: the worst-replica fleet SLO view (ISSUE 17) —
+        # per-(scope, kind) max burn / min budget remaining across
+        # proc-deduped node blocks + the open incident count
         "optional": {"skipped_nodes", "invalid_records", "heat",
                      "slow_queries", "nodes", "fleet", "ingest",
                      "backend", "cursors", "fleet_records",
-                     "window_clipped", "plan_shapes"},
+                     "window_clipped", "plan_shapes", "slo"},
     },
     "compile_event": {
         # one XLA compile (utils/compileplane.StagedFn): ``plan_shape``
@@ -293,6 +311,37 @@ KINDS: Dict[str, Dict[str, set]] = {
         "required": {"alert", "severity", "rate_per_min", "watermark",
                      "window_s", "proc"},
         "optional": {"detail", "triggers", "backend", "seq", "extra"},
+    },
+    "slo_status": {
+        # one objective's burn status (utils/slo.py): ``scope`` is the
+        # table name or ``tenant:<name>``; ``slo_kind`` in {latency,
+        # availability, freshness} (the envelope ``kind`` is already
+        # ``slo_status``); ``objective`` the good-event fraction
+        # target; burn rates are bad_fraction/error_budget over the
+        # paired windows (``fast_window_s`` / ``window_s`` slow);
+        # ``budget_remaining`` = 1 - burn_slow clamped to [0, 1] — the
+        # slow-window budget fraction left. Emitted on alert fire/clear
+        # transitions and explicit snapshots, NEVER per query — the hot
+        # path only appends to an in-memory deque.
+        "required": {"scope", "slo_kind", "objective", "burn_fast",
+                     "burn_slow", "budget_remaining", "window_s",
+                     "proc"},
+        "optional": {"bar_ms", "fast_window_s", "threshold", "events",
+                     "bad", "alerting", "stale", "severity", "backend",
+                     "extra"},
+    },
+    "incident": {
+        # one incident flight-recorder bundle (utils/slo.py): captured
+        # on an alert fire, ``surfaces`` is the BOUNDED dict of debug
+        # snapshots (slow_queries tail, governor, tier, devmem,
+        # compile, slo burn table — each size-capped, each optional:
+        # a broken surface is recorded as its error string, never a
+        # lost bundle); (``proc``, ``seq``) is the incident identity
+        # for fleet dedup, ``alert`` the firing alert's name.
+        "required": {"incident_id", "alert", "severity", "proc",
+                     "surfaces"},
+        "optional": {"detail", "scope", "slo", "seq", "backend",
+                     "extra"},
     },
 }
 

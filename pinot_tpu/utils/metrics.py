@@ -11,7 +11,7 @@ import re
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 
 class MetricsRegistry:
@@ -19,6 +19,14 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
+        # per-gauge last-update timestamp (monotonic seconds): a gauge
+        # value alone cannot distinguish "freshness 50 ms" from
+        # "freshness gauge dead for 10 minutes" — the SLO plane
+        # (utils/slo.py) trips the freshness objective on stale gauges
+        # instead of silently passing them. ``_now`` is injectable so
+        # staleness tests don't sleep.
+        self._gauge_ts: Dict[str, float] = {}
+        self._now = time.monotonic  # guarded-by: none — test injection
         self._timers: Dict[str, List[float]] = {}
 
     def count(self, name: str, n: int = 1) -> None:
@@ -28,6 +36,7 @@ class MetricsRegistry:
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = value
+            self._gauge_ts[name] = self._now()
 
     def remove_gauge(self, name: str) -> None:
         """Drop a gauge (no-op when absent): a stopped table's last
@@ -35,6 +44,16 @@ class MetricsRegistry:
         churn must not grow the gauge set without bound."""
         with self._lock:
             self._gauges.pop(name, None)
+            self._gauge_ts.pop(name, None)
+
+    def gauge_age_s(self, name: str) -> Optional[float]:
+        """Seconds since the gauge was last written (None when the
+        gauge does not exist) — the dead-gauge signal."""
+        with self._lock:
+            ts = self._gauge_ts.get(name)
+            if ts is None:
+                return None
+            return max(self._now() - ts, 0.0)
 
     @contextmanager
     def timer(self, name: str):
@@ -61,8 +80,16 @@ class MetricsRegistry:
                     "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
                     "max": s[-1],
                 }
+            # ``gauge_age_s`` rides beside ``gauges`` (a NEW key — every
+            # existing consumer reads ``gauges`` as plain name->float
+            # and keeps working): seconds since each gauge's last write,
+            # so snapshot readers can spot a dead gauge
+            now = self._now()
             return {"counters": dict(self._counters),
                     "gauges": dict(self._gauges),
+                    "gauge_age_s": {
+                        k: round(max(now - ts, 0.0), 3)
+                        for k, ts in self._gauge_ts.items()},
                     "timers": timers}
 
     def prometheus(self) -> str:
@@ -94,6 +121,14 @@ def ingest_health(snapshot: Dict[str, Any]) -> Dict[str, Any]:
                 if k.startswith(prefix)}
     out["freshness_by_table"] = by_table
     out["freshness_ms"] = max(by_table.values()) if by_table else None
+    # gauge staleness (ISSUE 17): seconds since each freshness gauge
+    # last moved — a frozen gauge under live ingest is a dead writer,
+    # and the SLO freshness objective trips on it instead of trusting
+    # the last value forever
+    ages = snapshot.get("gauge_age_s") or {}
+    out["freshness_age_s"] = {k[len(prefix):]: v
+                              for k, v in ages.items()
+                              if k.startswith(prefix)}
     return out
 
 

@@ -79,3 +79,11 @@ def _reset_telemetry_registries():
     global_compile_log.reset()
     global_compile_log.path = None
     global_compile_log.storm_per_min = DEFAULT_STORM_PER_MIN
+    # SLO plane (ISSUE 17): same discipline — a test that arms
+    # objectives or captures incidents must not leak them (clear() also
+    # resets the shared alert manager's rules/ring)
+    from pinot_tpu.utils.slo import global_incidents, global_slo
+    global_slo.clear()
+    global_slo.path = None
+    global_incidents.reset()
+    global_incidents.path = None

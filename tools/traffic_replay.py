@@ -98,6 +98,14 @@ RECOVER_FLOOR_MS = 80.0
 # protected queries starving behind an unshed backlog — is seconds)
 PROTECTED_BAR_FACTOR = 5.0
 PROTECTED_BAR_FLOOR_MS = 750.0
+# SLO burn windows for the spike (ISSUE 17): the fast window is wider
+# than the whole compressed spike (so both paired windows see the full
+# shed fraction — the fire decision reduces to the cumulative bad
+# fraction, order-independent), and narrow enough that ~1 s into the
+# good-traffic recovery phase it drains to zero and CLEARS the latch
+SLO_FAST_S = 1.0
+SLO_SLOW_S = 6.0
+SLO_BURN_THRESHOLD = 1.0
 
 
 def _pctl(sorted_vals: List[float], frac: float) -> float:
@@ -421,6 +429,59 @@ def plan_replay(records: List[Dict[str, Any]], multiple: float,
             "capacity_qps": capacity_qps}
 
 
+# -- the pure SLO alert plan (ISSUE 17) -------------------------------------
+
+def plan_slo(records: List[Dict[str, Any]], plan: Dict[str, Any],
+             multiple: float) -> Tuple[List[Dict[str, Any]],
+                                       Dict[str, Any], float]:
+    """The precomputed SLO burn-alert stream for the spike, pure in
+    (records, plan, multiple): synthetic spike ``query_stats`` modeled
+    from the replay plan — scheduled arrivals, recorded 1x walls x
+    ``multiple``, the planned shed stream — fed through
+    ``utils/slo.plan_alert_stream``. Same inputs => byte-identical
+    output (the gate computes it twice and compares).
+
+    Objectives are derived FROM the plan so the verdict has margin:
+    availability budget = half the planned besteffort shed fraction
+    (final burn 2.0x by construction — fires decisively — while the
+    protected tenant burns 0.0x), and the latency bar sits at 1.5x the
+    recorded p50, which the ``multiple``x-modeled walls overrun.
+
+    -> (objectives, plan_alert_stream output, besteffort shed frac)."""
+    walls = {str(r["qid"]): float(r.get("wall_ms", 1.0))
+             for r in records}
+    shed_qids = {s[0] for s in plan["shed_stream"]}
+    srecs: List[Dict[str, Any]] = []
+    for e in plan["entries"]:
+        base = e["qid"].split("_x")[0]   # rpSEED_i[_xSEED[_r1]]
+        srecs.append({
+            "tenant": e["tenant"],
+            "arrival_ms": round(e["offset_s"] * 1e3, 3),
+            "wall_ms": round(walls.get(base, 1.0) * multiple, 3),
+            "shed": e["qid"] in shed_qids})
+    be = [r for r in srecs if r["tenant"] == "ten_besteffort"]
+    frac = (sum(1 for r in be if r["shed"]) / len(be)) if be else 0.0
+    objectives: List[Dict[str, Any]] = []
+    if 0.0 < frac < 1.0:
+        avail_obj = 1.0 - frac / 2.0
+        for tenant in ("ten_besteffort", "ten_protected"):
+            objectives.append({
+                "scope": f"tenant:{tenant}", "kind": "availability",
+                "objective": round(avail_obj, 6),
+                "fast_s": SLO_FAST_S, "slow_s": SLO_SLOW_S,
+                "burn_threshold": SLO_BURN_THRESHOLD})
+    sorted_walls = sorted(walls.values())
+    bar = _pctl(sorted_walls, 0.5) * 1.5 if sorted_walls else 100.0
+    for tenant in ("ten_besteffort", "ten_standard"):
+        objectives.append({
+            "scope": f"tenant:{tenant}", "kind": "latency",
+            "bar_ms": round(bar, 3),
+            "fast_s": SLO_FAST_S, "slow_s": SLO_SLOW_S,
+            "burn_threshold": SLO_BURN_THRESHOLD})
+    from pinot_tpu.utils.slo import plan_alert_stream
+    return objectives, plan_alert_stream(srecs, objectives), frac
+
+
 # -- the spike --------------------------------------------------------------
 
 def run_spike(client, plan: Dict[str, Any], workers: int = 8
@@ -517,6 +578,8 @@ def run_gate(multiple: float = 4.0, seed: int = 20260805,
                                            global_workload)
     from pinot_tpu.utils import faults
     from pinot_tpu.utils import ledger as uledger
+    from pinot_tpu.utils.slo import (global_incidents, global_slo,
+                                     normalize_alerts)
 
     tmp = keep_dir or tempfile.mkdtemp(prefix="ptpu_replay_")
     failures: List[str] = []
@@ -595,6 +658,41 @@ def run_gate(multiple: float = 4.0, seed: int = 20260805,
               all(s[1] != "ten_protected" for s in plan["shed_stream"]),
               "plan shed a protected query")
 
+        # 2b) the pure SLO alert plan, computed twice — byte-identical
+        # (utils/slo.plan_alert_stream: same corpus => same alert
+        # stream, the ISSUE 17 determinism contract)
+        slo_objs, slo_plan, be_frac = plan_slo(records, plan, multiple)
+        slo_plan2 = plan_slo(records, plan, multiple)[1]
+        slo_deterministic = (
+            json.dumps(slo_plan, sort_keys=True)
+            == json.dumps(slo_plan2, sort_keys=True))
+        check("slo.plan_deterministic", slo_deterministic,
+              "two same-input SLO alert plans diverged")
+        check("slo.plan_alerts", len(slo_plan["alerts"]) >= 1,
+              "the 4x SLO plan fired no burn alert — raise multiple")
+        planned_avail = sorted({
+            x for x in normalize_alerts(slo_plan["alerts"])
+            if x[2] == "availability"})
+        check("slo.plan_besteffort_burns",
+              any(x[1] == "tenant:ten_besteffort"
+                  for x in planned_avail),
+              "planned availability burn missed the shed tenant")
+        check("slo.plan_protected_never_burns",
+              all(x[1] != "tenant:ten_protected"
+                  for x in normalize_alerts(slo_plan["alerts"])),
+              "the plan burned the protected tenant's budget")
+        # live SLO plane: armed with the plan's availability objectives
+        # only (live wall clocks are nondeterministic — the latency
+        # objectives stay plan-side); fed by the cluster broker's
+        # forensics plane per completed/shed query
+        slo_live = mode == "cluster"
+        if slo_live:
+            global_slo.clear()
+            global_incidents.reset()
+            for spec in slo_objs:
+                if spec["kind"] == "availability":
+                    global_slo.set_objective(**spec)
+
         if mode == "local" and chaos:
             shed_qids = {s0[0] for s0 in plan["shed_stream"]}
             victim = next(
@@ -659,6 +757,47 @@ def run_gate(multiple: float = 4.0, seed: int = 20260805,
             check("spike.chaos_fired", fired >= 1,
                   "the armed chaos plan never fired")
 
+        # 4b) live SLO verdicts (cluster mode): the live availability
+        # alert set must match the precomputed plan's — compared on the
+        # normalized (alert, scope, kind, severity) projection, the
+        # shed-stream discipline (ts/proc/burn magnitudes are process
+        # identity and jitter, not decisions)
+        live_avail: List[Any] = []
+        incidents_count = 0
+        if slo_live:
+            global_incidents.drain(5.0)
+            live_avail = sorted({
+                x for x in normalize_alerts(global_slo.alerts.alerts())
+                if x[0] == "slo_burn" and x[2] == "availability"})
+            check("slo.live_matches_plan", live_avail == planned_avail,
+                  f"live availability alerts {live_avail} != "
+                  f"planned {planned_avail}")
+            blk = global_slo.status_block()
+            prot = next(
+                (r for r in blk["objectives"]
+                 if r["scope"] == "tenant:ten_protected"
+                 and r["kind"] == "availability"), None)
+            check("slo.protected_budget_intact",
+                  prot is not None and prot["burn_slow"] == 0.0
+                  and prot["budget_remaining"] == 1.0,
+                  f"protected error budget dented: {prot}")
+            inc = global_incidents.snapshot()
+            incidents_count = inc["count"]
+            check("slo.incident_captured", incidents_count >= 1,
+                  "no incident bundle captured on the burn alert")
+            if inc["incidents"]:
+                first = inc["incidents"][0]
+                verr = uledger.validate_record(first)
+                check("slo.incident_valid", not verr,
+                      f"incident bundle violates the ledger "
+                      f"contract: {verr}")
+                check("slo.incident_surfaces",
+                      {"slow_queries", "overload", "tier", "devmem",
+                       "compile", "slo"}
+                      <= set(first.get("surfaces") or {}),
+                      f"incident bundle missing surfaces: "
+                      f"{sorted(first.get('surfaces') or {})}")
+
         # 5) recovery: fresh 1x pass must land inside the noise floor
         post_mix = [{**q, "qid": q["qid"] + "_post"} for q in mix]
         post = record_phase(client, post_mix, record_qps, None)
@@ -674,6 +813,20 @@ def run_gate(multiple: float = 4.0, seed: int = 20260805,
               f"post-spike p50 {post_p50:.1f}ms > bar "
               f"{recover_bar:.1f}ms (pre {pre_p50:.1f}ms) — "
               "metastable state?")
+
+        # 5b) the post-spike good traffic drained the 1s fast window,
+        # so the paired-window level dropped below threshold and the
+        # latched burn alert CLEARED — no stale page after recovery
+        if slo_live:
+            blk = global_slo.status_block()
+            be = next(
+                (r for r in blk["objectives"]
+                 if r["scope"] == "tenant:ten_besteffort"
+                 and r["kind"] == "availability"), None)
+            check("slo.recovery_burn_cleared",
+                  be is not None and not be["alerting"]
+                  and be["burn_fast"] == 0.0,
+                  f"burn alert latched past recovery: {be}")
 
         completed = sum(len(v) for v in spike["latencies"].values())
         shed_by_tenant: Dict[str, int] = {}
@@ -719,6 +872,15 @@ def run_gate(multiple: float = 4.0, seed: int = 20260805,
             "recovery": {"pre_p50_ms": round(pre_p50, 3),
                          "post_p50_ms": round(post_p50, 3),
                          "bar_ms": round(recover_bar, 3)},
+            "extra": {"slo": {
+                "plan_deterministic": slo_deterministic,
+                "alerts_planned": len(slo_plan["alerts"]),
+                "planned_availability": [list(x) for x in planned_avail],
+                "live_availability": [list(x) for x in live_avail],
+                "live": slo_live,
+                "incidents": incidents_count,
+                "besteffort_shed_frac": round(be_frac, 4),
+            }},
             "ok": not failures,
         })
         if failures:
@@ -734,6 +896,10 @@ def run_gate(multiple: float = 4.0, seed: int = 20260805,
     finally:
         faults.clear()
         global_workload.reset()
+        global_slo.clear()
+        global_slo.path = None     # the tmp ledger dir is about to go
+        global_incidents.reset()
+        global_incidents.path = None
         if stop is not None:
             stop()
         if keep_dir is None:
