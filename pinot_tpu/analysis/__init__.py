@@ -1,10 +1,10 @@
 """Static analysis over the engine's contract surfaces.
 
-Three passes, one goal: hazards that today corrupt results, retrace or
-race silently at RUN time must fail loudly at PLAN / LINT time, before
-a TPU is ever attached ("Query Processing on Tensor Computation
-Runtimes": relational-on-tensor stacks live or die by static
-shape/dtype contracts).
+Four passes, one goal: hazards that today corrupt results, retrace,
+race or drift silently at RUN time must fail loudly at PLAN / LINT
+time, before a TPU is ever attached ("Query Processing on Tensor
+Computation Runtimes": relational-on-tensor stacks live or die by
+static shape/dtype contracts).
 
 - plan_verify: abstract shape/dtype inference over the ops/ir.py kernel
   plan tree — index bounds, plan-cache hashability, lossless carrier
@@ -21,11 +21,21 @@ shape/dtype contracts).
   held locks, lock-order cycles over the resolved call graph,
   thread-local state escaping into pool closures, check-then-act.
   Ratcheted at tools/concur_baseline.json.
+- detlint: whole-program determinism & replay-safety verifier
+  (DT301–DT305) — wall-clock reads without an injectable escape hatch,
+  ambient randomness, unordered-collection serialization, query-time
+  os.environ reads, and completion-order float accumulation, taint
+  propagated from the deterministic-plane entry registry (chaos / SLO /
+  alert / shed / replay planes) through the shared call resolver
+  (astutil.py). Ratcheted at tools/detlint_baseline.json.
 
-`tools/check_static.py` runs all three passes (the linter and the
-concurrency verifier over the tree, the plan verifier over every plan
-the planner produces for the SSB + taxi + fuzzer query corpus) and
-gates tier-1 alongside tools/check_ledger.py.
+Shared plumbing (Finding, ratchet baselines, suppression comments, the
+corpus-wide call resolver) lives in astutil.py.
+
+`tools/check_static.py` runs all four passes (the three lint passes
+over the tree, the plan verifier over every plan the planner produces
+for the SSB + taxi + fuzzer query corpus) and gates tier-1 alongside
+tools/check_ledger.py.
 """
 from .plan_verify import (Diagnostic, PlanVerificationError,  # noqa: F401
                           RULES, check_compiled_plan, format_diagnostics,
@@ -35,3 +45,4 @@ from .jaxlint import (Finding, LINT_RULES, compare_baseline,  # noqa: F401
                       write_baseline)
 from .concur import (CONCUR_RULES, Program,  # noqa: F401
                      analyze_source, analyze_tree)
+from .detlint import DETLINT_RULES, ROOTS  # noqa: F401

@@ -88,7 +88,11 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
-from .jaxlint import Finding, compare_baseline, counts_of, load_baseline
+from .astutil import (CallResolver, Finding, compare_baseline,
+                      counts_of, iter_py_files, line_comments,
+                      load_baseline, module_qual, suppress_regex)
+from .astutil import call_parts as _call_parts
+from .astutil import self_attr as _self_attr
 
 __all__ = [
     "CONCUR_RULES", "Program", "analyze_tree", "analyze_source",
@@ -107,7 +111,7 @@ CONCUR_RULES = {
     "parse-error": "module failed to parse",
 }
 
-_SUPPRESS_RE = re.compile(r"concur:\s*ok\s+([\w,\- ]+)")
+_SUPPRESS_RE = suppress_regex("concur")
 _GUARDED_RE = re.compile(r"guarded-by:\s*([\w]+)")
 _HOLDS_RE = re.compile(r"holds-lock:\s*([\w,\s]+)")
 
@@ -210,10 +214,7 @@ class _ModuleInfo:
         "native.__init__"): bare stems repeat across packages
         (batch.py, __init__.py), and two same-named locks must not
         merge into one graph node."""
-        q = self.path
-        if q.startswith("pinot_tpu/"):
-            q = q[len("pinot_tpu/"):]
-        return os.path.splitext(q)[0].replace("/", ".")
+        return module_qual(self.path)
 
     def mod_lock_id(self, name: str) -> Optional[str]:
         if name in self.mod_locks:
@@ -272,14 +273,6 @@ class _FnInfo:
     holds_union: FrozenSet[str] = frozenset()
 
 
-def _call_parts(func: ast.AST) -> Tuple[Optional[str], Optional[str]]:
-    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
-        return func.value.id, func.attr
-    if isinstance(func, ast.Name):
-        return None, func.id
-    return None, None
-
-
 def _is_lock_ctor(value: ast.AST) -> Optional[str]:
     """'Lock' | 'RLock' | 'Condition' when value constructs one."""
     if isinstance(value, ast.Call):
@@ -305,20 +298,7 @@ def _container_ctor(value: ast.AST) -> Optional[str]:
     return None
 
 
-def _self_attr(node: ast.AST) -> Optional[str]:
-    if isinstance(node, ast.Attribute) and \
-            isinstance(node.value, ast.Name) and node.value.id == "self":
-        return node.attr
-    return None
-
-
-def _line_comments(src: str, regex: re.Pattern) -> Dict[int, str]:
-    out: Dict[int, str] = {}
-    for i, line in enumerate(src.splitlines(), start=1):
-        m = regex.search(line)
-        if m:
-            out[i] = m.group(1)
-    return out
+_line_comments = line_comments
 
 
 # ---------------------------------------------------------------------------
@@ -797,16 +777,9 @@ class Program:
         return None
 
     def add_tree(self, root: str, package: str = "pinot_tpu") -> None:
-        pkg_dir = os.path.join(root, package)
-        for dirpath, dirnames, filenames in os.walk(pkg_dir):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-            for fn in sorted(filenames):
-                if not fn.endswith(".py") or fn.endswith("_pb2.py"):
-                    continue
-                full = os.path.join(dirpath, fn)
-                rel = os.path.relpath(full, root).replace(os.sep, "/")
-                with open(full, "r", encoding="utf-8") as fh:
-                    self.add_source(fh.read(), rel)
+        for full, rel in iter_py_files(root, package):
+            with open(full, "r", encoding="utf-8") as fh:
+                self.add_source(fh.read(), rel)
 
     # -- analysis ----------------------------------------------------------
     def analyze(self) -> Tuple[List[Finding], List[Finding]]:
@@ -866,68 +839,28 @@ class Program:
 
     def _build_indexes(self, fns: List[_FnInfo]) -> None:
         self._by_fid = {fi.fid: fi for fi in fns}
-        # method name -> fids across the corpus (for unique-name
-        # resolution of attr calls)
-        self._by_method: Dict[str, List[str]] = {}
-        for fi in fns:
-            if fi.cls is not None:
-                self._by_method.setdefault(
-                    fi.qualname.split(".", 1)[1], []).append(fi.fid)
-        # module-level singleton name -> class (corpus-wide, unique)
-        self._singleton_cls: Dict[str, str] = {}
-        dropped: Set[str] = set()
-        class_names = {c for m in self.modules.values()
-                       for c in m.classes}
-        for m in self.modules.values():
-            for name, ctor in m.singletons.items():
-                if ctor not in class_names:
-                    continue
-                if name in self._singleton_cls and \
-                        self._singleton_cls[name] != ctor:
-                    dropped.add(name)
-                self._singleton_cls[name] = ctor
-        for name in dropped:
-            self._singleton_cls.pop(name, None)
-        # (path, class, method) -> fid: bare class names repeat across
-        # modules (_Conn, Pred), and a self-call always resolves within
-        # its own module
-        self._class_fid: Dict[Tuple[str, str, str], str] = {}
-        self._cls_paths: Dict[str, List[str]] = {}
+        # the shared corpus-wide resolver (analysis/astutil.py): exact
+        # for self-calls and same-module bare calls, singleton- and
+        # unique-METHOD-name-based for attribute calls
+        self._resolver = CallResolver()
         for path, m in self.modules.items():
-            for cname in m.classes:
-                self._cls_paths.setdefault(cname, []).append(path)
+            self._resolver.add_module(path, m.functions.keys(),
+                                      m.classes.keys(), m.singletons)
         for fi in fns:
             if fi.cls is not None:
-                self._class_fid[(fi.path, fi.cls.name,
-                                 fi.qualname.split(".", 1)[1])] = fi.fid
+                self._resolver.add_function(
+                    fi.fid, fi.path, fi.cls.name,
+                    fi.qualname.split(".", 1)[1])
+        self._resolver.finalize()
 
     def _resolve(self, fi: _FnInfo, kind: str, base: Optional[str],
                  name: str) -> Optional[_FnInfo]:
-        """Resolve one call event to an analyzed function, or None.
-        Exact for self-calls and same-module bare calls; singleton- and
-        unique-name-based for attribute calls (approximation documented
-        in the module docstring)."""
-        if kind == "self" and fi.cls is not None:
-            fid = self._class_fid.get((fi.path, fi.cls.name, name))
-            return self._by_fid.get(fid) if fid else None
-        if kind == "bare":
-            if name in fi.module.functions:
-                return self._by_fid.get(f"{fi.path}::{name}")
-            return None
-        if kind == "attr" and base is not None:
-            cls = self._singleton_cls.get(base)
-            if cls is not None:
-                paths = self._cls_paths.get(cls, [])
-                if len(paths) != 1:
-                    return None   # ambiguous class name: refuse
-                fid = self._class_fid.get((paths[0], cls, name))
-                if fid:
-                    return self._by_fid.get(fid)
-                return None
-            fids = self._by_method.get(name, [])
-            if len(fids) == 1:
-                return self._by_fid.get(fids[0])
-        return None
+        """Resolve one call event to an analyzed function, or None
+        (approximation documented in the module docstring)."""
+        fid = self._resolver.resolve(
+            fi.path, fi.cls.name if fi.cls is not None else None,
+            kind, base, name)
+        return self._by_fid.get(fid) if fid else None
 
     def _infer_caller_holds(self, fns: List[_FnInfo]) -> None:
         """Caller-holds-lock inference: a PRIVATE method (``_name``,
@@ -1338,7 +1271,7 @@ def analyze_tree(root: str, package: str = "pinot_tpu"
 
 
 def write_baseline(findings, path: str) -> None:
-    from .jaxlint import write_baseline as _wb
+    from .astutil import write_baseline as _wb
     _wb(findings, path, comment=(
         "concur ratchet baseline — grandfathered CC findings per "
         "file::scope::rule. Regenerate with `python tools/"

@@ -33,11 +33,18 @@ the baseline is ratcheted down with ``--update-baseline``.
 from __future__ import annotations
 
 import ast
-import json
 import os
-import re
-from dataclasses import dataclass
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .astutil import (Finding, compare_baseline, counts_of,
+                      load_baseline, parse_suppressions)
+from .astutil import write_baseline as _write_baseline
+
+__all__ = [
+    "LINT_RULES", "Finding", "lint_source", "lint_source_ex",
+    "lint_tree", "lint_tree_ex", "counts_of", "load_baseline",
+    "write_baseline", "compare_baseline",
+]
 
 LINT_RULES = {
     "host-sync": "device→host sync in a device hot path",
@@ -70,43 +77,15 @@ _NONSTATIC_CALLS = {("os", "getenv"), ("time", "time"),
                     ("time", "perf_counter"), ("time", "thread_time"),
                     ("time", "monotonic")}
 
-_SUPPRESS_RE = re.compile(r"jaxlint:\s*ok\s+([\w,\- ]+)")
-
-
-@dataclass(frozen=True)
-class Finding:
-    rule: str
-    path: str       # repo-relative, posix separators
-    line: int
-    scope: str      # enclosing qualname, e.g. "KernelPlanCache.entry"
-    message: str
-
-    @property
-    def key(self) -> str:
-        """Baseline key: line numbers drift, (file, scope, rule) don't."""
-        return f"{self.path}::{self.scope}::{self.rule}"
-
-    def __str__(self) -> str:
-        return (f"{self.path}:{self.line}: [{self.rule}] {self.scope}: "
-                f"{self.message}")
-
 
 def _suppressions(src: str) -> Dict[int, set]:
-    out: Dict[int, set] = {}
-    for i, line in enumerate(src.splitlines(), start=1):
-        m = _SUPPRESS_RE.search(line)
-        if m:
-            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
-    return out
+    return parse_suppressions(src, "jaxlint")
 
 
 def _call_name(func: ast.AST) -> Tuple[Optional[str], Optional[str]]:
     """('np', 'asarray') for np.asarray(...); (None, 'int') for int(...)."""
-    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
-        return func.value.id, func.attr
-    if isinstance(func, ast.Name):
-        return None, func.id
-    return None, None
+    from .astutil import call_parts
+    return call_parts(func)
 
 
 def _is_jax_jit(func: ast.AST) -> bool:
@@ -361,20 +340,14 @@ def lint_tree_ex(root: str, package: str = "pinot_tpu"
                  ) -> Tuple[List[Finding], List[Finding]]:
     """Lint every .py file under <root>/<package> -> (findings,
     suppressed)."""
+    from .astutil import iter_py_files
     findings: List[Finding] = []
     suppressed: List[Finding] = []
-    pkg_dir = os.path.join(root, package)
-    for dirpath, dirnames, filenames in os.walk(pkg_dir):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py") or fn.endswith("_pb2.py"):
-                continue
-            full = os.path.join(dirpath, fn)
-            rel = os.path.relpath(full, root).replace(os.sep, "/")
-            with open(full, "r", encoding="utf-8") as fh:
-                fs, sup = lint_source_ex(fh.read(), rel)
-            findings.extend(fs)
-            suppressed.extend(sup)
+    for full, rel in iter_py_files(root, package):
+        with open(full, "r", encoding="utf-8") as fh:
+            fs, sup = lint_source_ex(fh.read(), rel)
+        findings.extend(fs)
+        suppressed.extend(sup)
     return findings, suppressed
 
 
@@ -384,61 +357,14 @@ def lint_tree(root: str, package: str = "pinot_tpu") -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# ratchet baseline
+# ratchet baseline (shared machinery: analysis/astutil.py)
 # ---------------------------------------------------------------------------
-
-def counts_of(findings: Sequence[Finding]) -> Dict[str, int]:
-    out: Dict[str, int] = {}
-    for f in findings:
-        out[f.key] = out.get(f.key, 0) + 1
-    return out
-
-
-def load_baseline(path: str) -> Dict[str, int]:
-    if not os.path.exists(path):
-        return {}
-    with open(path, "r", encoding="utf-8") as fh:
-        data = json.load(fh)
-    return dict(data.get("counts", {}))
-
 
 def write_baseline(findings: Sequence[Finding], path: str,
                    comment: Optional[str] = None) -> None:
-    # parse-error can never be grandfathered: a module that stops
-    # parsing must fail the gate even right after --update-baseline
-    findings = [f for f in findings if f.rule != "parse-error"]
-    data = {
-        "comment": comment or (
-            "jaxlint ratchet baseline — grandfathered findings "
-            "per file::scope::rule. Regenerate with "
-            "`python tools/check_static.py --update-baseline`; "
-            "new findings above these counts fail check_static, "
-            "and counts that drop must be ratcheted down here."),
-        "version": 1,
-        "counts": dict(sorted(counts_of(findings).items())),
-    }
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(data, fh, indent=1, sort_keys=False)
-        fh.write("\n")
-
-
-def compare_baseline(findings: Sequence[Finding], baseline: Dict[str, int]
-                     ) -> Tuple[List[Finding], List[Tuple[str, int, int]]]:
-    """-> (new_findings, stale_entries).
-
-    new_findings: findings in keys whose count exceeds the baseline
-    (the whole key's findings are reported so the offender is visible).
-    stale_entries: (key, baseline_count, actual_count) where the actual
-    count dropped below the baseline — ratchet the baseline down.
-    """
-    actual = counts_of(findings)
-    new: List[Finding] = []
-    for key, n in sorted(actual.items()):
-        allowed = baseline.get(key, 0)
-        if n > allowed:
-            new.extend(sorted((f for f in findings if f.key == key),
-                              key=lambda f: f.line))
-    stale = [(key, allowed, actual.get(key, 0))
-             for key, allowed in sorted(baseline.items())
-             if actual.get(key, 0) < allowed]
-    return new, stale
+    _write_baseline(findings, path, comment=comment or (
+        "jaxlint ratchet baseline — grandfathered findings "
+        "per file::scope::rule. Regenerate with "
+        "`python tools/check_static.py --update-baseline`; "
+        "new findings above these counts fail check_static, "
+        "and counts that drop must be ratcheted down here."))

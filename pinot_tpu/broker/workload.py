@@ -612,20 +612,25 @@ class OverloadGovernor:
                 continue  # a broken signal must never fail admission
         return p
 
-    def rung(self) -> int:
+    def rung(self, now: Optional[float] = None) -> int:
         """Current rung from live signals, with hysteresis (a rung only
         drops once pressure falls HYSTERESIS below its watermark — no
-        flapping at the boundary)."""
+        flapping at the boundary). ``now`` injects the poll clock for
+        replay/tests; pinned and inert governors return before any
+        clock read, so replay-mode admission never touches wall time.
+        """
         with self._lock:
             pinned = self._pins is not None
             inert = not self._signals
             current = self._rung
-            fresh = (time.monotonic() - self._t_sample) < self.POLL_S
+            t_sample = self._t_sample
         if pinned or inert:
             # inert (nothing armed) is the process default: zero work,
-            # zero metric churn on every admission/hedge check
+            # zero metric churn, zero clock reads on every
+            # admission/hedge check
             return current
-        if fresh:
+        t = now if now is not None else time.monotonic()
+        if (t - t_sample) < self.POLL_S:
             return current
         p = self.pressure()
         rung = self.rung_for_pressure(p)
@@ -636,19 +641,19 @@ class OverloadGovernor:
                 rung = current
         with self._lock:
             self._pressure = p
-            self._t_sample = time.monotonic()
+            self._t_sample = t if now is not None else time.monotonic()
         if rung != current:
             self._apply(rung)
         global_metrics.gauge("overload_pressure", round(p, 4))
         return rung
 
-    def rung_for(self, qid: str) -> int:
+    def rung_for(self, qid: str, now: Optional[float] = None) -> int:
         """The admission rung for one query: the pinned schedule when
         one is installed (replay), else the live rung."""
         with self._lock:
             if self._pins is not None:
                 return self._pins.get(qid, self._pin_default)
-        return self.rung()
+        return self.rung(now)
 
     def _apply(self, rung: int) -> None:
         """Rung transition side effects: the speculative-work ladder

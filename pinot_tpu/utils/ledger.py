@@ -350,13 +350,43 @@ KINDS: Dict[str, Dict[str, set]] = {
 # every kind may carry it without forking each contract
 _ENVELOPE = {"v", "ts", "kind", "node"}
 
+# The round-22 lesson, generalized: a payload field named like an
+# envelope/identity key silently overwrites the envelope on
+# ``rec.update(fields)`` (the ``kind`` collision renamed an slo_status
+# record mid-write and turned a shed into a 500 — hence ``slo_kind``).
+# make_record rejects any **fields name below unless the kind's
+# contract explicitly declares it (``proc``/``seq`` for the
+# operational kinds); ``ts`` stays injectable for deterministic
+# emitters but must already be a formatted string.
+_RESERVED = ("kind", "node", "proc", "seq", "ts")
 
-def make_record(kind: str, **fields: Any) -> Dict[str, Any]:
+
+def make_record(kind: str, /, **fields: Any) -> Dict[str, Any]:
     """Build + validate one v2 record. Raises ValueError on a schema
-    violation so a writer can never append an invalid line."""
+    violation so a writer can never append an invalid line.
+
+    ``kind`` is positional-only: a stray ``kind`` in an expanded
+    ``**fields`` dict lands in ``fields`` and gets the reserved-key
+    rejection below, not a cryptic TypeError."""
+    contract = KINDS.get(kind) or {"required": set(), "optional": set()}
+    declared = contract["required"] | contract["optional"]
+    shadows = [k for k in _RESERVED
+               if k in fields and k != "ts" and k not in declared]
+    if shadows:
+        raise ValueError(
+            f"invalid ledger record ({kind}): field(s) {shadows} would "
+            f"shadow reserved envelope keys {sorted(_RESERVED)} — "
+            f"rename the payload field (the kind/slo_kind precedent)")
+    ts = fields.pop("ts", None)
+    if ts is not None and not isinstance(ts, str):
+        raise ValueError(
+            f"invalid ledger record ({kind}): injected ts must be a "
+            f"formatted string, got {type(ts).__name__}")
     rec: Dict[str, Any] = {
         "v": SCHEMA_VERSION,
-        "ts": fields.pop("ts", None) or time.strftime(
+        # the live-mode wall-clock default; deterministic emitters
+        # inject ts= (detlint DT301 baseline documents this hatch)
+        "ts": ts or time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "kind": kind,
     }

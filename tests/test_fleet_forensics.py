@@ -221,8 +221,7 @@ def test_check_ledger_reports_fleet_rollup_kind(tmp_path, capsys):
 # multi-node smoke: the acceptance pin
 # ---------------------------------------------------------------------------
 
-@pytest.fixture()
-def fleet(tmp_path):
+def _make_fleet(tmp_path, n_brokers):
     # (the autouse conftest fixture resets the process-global heat
     # registry between tests, so earlier tests' hotter segments can't
     # crowd "ft" out of the top-N rankings this smoke asserts on)
@@ -236,7 +235,7 @@ def fleet(tmp_path):
                           query_stats_path=str(tmp_path / f"b{i}.jsonl"),
                           trace_ratio=1.0,
                           instance_id=f"broker_{i}")
-               for i in range(2)]
+               for i in range(n_brokers)]
     ctrl.add_table("ft", schema.to_dict(), replication=1)
     d = SegmentBuilder(schema, TableConfig("ft")).build(
         {"k": (np.arange(200, dtype=np.int32) % 7),
@@ -260,6 +259,18 @@ def fleet(tmp_path):
         except Exception:
             pass
         ctrl.stop()
+
+
+@pytest.fixture()
+def fleet(tmp_path):
+    yield from _make_fleet(tmp_path, n_brokers=2)
+
+
+@pytest.fixture()
+def fleet1(tmp_path):
+    # the single-broker variant for tier-1 tests that only drive one
+    # broker — the 2-broker spin-up/teardown stays on the slow smoke
+    yield from _make_fleet(tmp_path, n_brokers=1)
 
 
 SMOKE_SQL = ("SELECT k, SUM(v) FROM ft GROUP BY k ORDER BY k LIMIT 10 "
@@ -354,8 +365,8 @@ def test_rollup_never_wedges_on_unreachable_node(tmp_path):
 # device-memory telemetry: /debug/memory reconciles across an eviction
 # ---------------------------------------------------------------------------
 
-def test_debug_memory_reconciles_across_eviction(fleet):
-    ctrl, srv, (b1, _b2) = fleet
+def test_debug_memory_reconciles_across_eviction(fleet1):
+    ctrl, srv, (b1,) = fleet1
     http_json("POST", f"{b1.url}/query/sql", {"sql": SMOKE_SQL},
               timeout=60.0)
     seg = srv._tables["ft"].acquire_segments()[0]

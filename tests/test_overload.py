@@ -447,6 +447,33 @@ def test_governor_unsticks_when_signals_removed():
     assert gov.rung() == 0
 
 
+def test_governor_no_clock_read_in_pinned_or_inert_mode(monkeypatch):
+    """The detlint round-23 fix stays fixed: pinned (replay) and inert
+    governors must answer admission checks without EVER touching the
+    wall clock — wall time must not leak into replayable decisions.
+    Pre-fix, rung() read time.monotonic() before the early return."""
+    from pinot_tpu.broker import workload as wl
+
+    def _no_clock():
+        raise AssertionError(
+            "deterministic plane read time.monotonic()")
+
+    gov = OverloadGovernor()
+    monkeypatch.setattr(wl.time, "monotonic", _no_clock)
+    # inert: nothing armed — the process default on every admission
+    assert gov.rung() == 0
+    assert gov.rung_for("q1") == 0
+    # pinned: the replay schedule answers, live signals stay silent
+    gov.add_signal("x", lambda: 95.0, 100.0)
+    gov.pin_rungs({"q2": 2}, default=1)
+    assert gov.rung_for("q2") == 2
+    assert gov.rung_for("q3") == 1
+    assert gov.rung() == 2  # pinned rung() reports cached state only
+    # live mode takes the injected poll clock, not the wall clock
+    gov.unpin()
+    assert gov.rung(now=1000.0) == 3
+
+
 def test_inert_fast_path_counts_nothing():
     """The process default (no tenants, nothing armed) must not churn
     metrics or in-flight state per query."""

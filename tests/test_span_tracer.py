@@ -319,6 +319,63 @@ def test_ledger_make_and_validate():
     assert uledger.validate_record({"metric": "old", "value": 1}) == []
 
 
+def test_ledger_reserved_key_kind_rejected():
+    """The round-22 collision, generalized: a payload field named
+    ``kind`` would rename the record mid-write (hence slo_status's
+    ``slo_kind``). Expanded dicts route into **fields thanks to the
+    positional-only signature — a clear ValueError, never a
+    TypeError."""
+    fields = {"kind": "latency", "scope": "t", "objective": 0.99,
+              "burn_fast": 0.0, "burn_slow": 0.0,
+              "budget_remaining": 1.0, "window_s": 3600, "proc": "p"}
+    with pytest.raises(ValueError, match="shadow reserved"):
+        uledger.make_record("slo_status", **fields)
+
+
+def test_ledger_reserved_key_node_rejected():
+    """``node`` is fleet provenance, stamped envelope-level by the
+    rollup puller — a writer-side payload field must not forge it."""
+    with pytest.raises(ValueError, match="shadow reserved"):
+        uledger.make_record("metrics_snapshot", counters={},
+                            node="forged")
+
+
+def test_ledger_reserved_key_proc_rejected():
+    """``proc`` is admitted only where the kind's contract declares it
+    (alert/compile_event/slo_status/incident) — on any other kind it
+    shadows the fleet-dedup identity."""
+    with pytest.raises(ValueError, match="shadow reserved"):
+        uledger.make_record("metrics_snapshot", counters={},
+                            proc="1234-abc")
+    # a declaring kind still takes it (the AlertManager.fire path)
+    rec = uledger.make_record(
+        "alert", alert="a", severity="warning", rate_per_min=1.0,
+        watermark=1.0, window_s=60, proc="1234-abc")
+    assert rec["proc"] == "1234-abc"
+
+
+def test_ledger_reserved_key_seq_rejected():
+    with pytest.raises(ValueError, match="shadow reserved"):
+        uledger.make_record("metrics_snapshot", counters={}, seq=7)
+    # declared on compile_event: the per-process event counter
+    rec = uledger.make_record(
+        "compile_event", site="engine", trigger="miss", plan_shape="s",
+        key_fp="fp", backend="cpu", lower_ms=1.0, compile_ms=2.0,
+        donated=True, proc="1234-abc", seq=7)
+    assert rec["seq"] == 7
+
+
+def test_ledger_reserved_key_ts_string_enforced():
+    """``ts`` stays injectable (deterministic emitters pin it) but must
+    already be a formatted string — a float would corrupt the
+    envelope's ISO-8601 contract."""
+    rec = uledger.make_record("metrics_snapshot", counters={},
+                              ts="t+1.000s")
+    assert rec["ts"] == "t+1.000s"
+    with pytest.raises(ValueError, match="ts must be a formatted"):
+        uledger.make_record("metrics_snapshot", counters={}, ts=123.4)
+
+
 def test_ledger_file_validation(tmp_path):
     path = str(tmp_path / "ledger.jsonl")
     uledger.append_record(

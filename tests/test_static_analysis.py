@@ -1073,3 +1073,314 @@ def test_concur_inference_converges_on_deep_chains():
               "        with self._lock:",
               "            self.n += 1"]
     assert _concur("\n".join(lines) + "\n") == []
+
+
+# ---------------------------------------------------------------------------
+# detlint: the whole-program determinism & replay-safety verifier
+# ---------------------------------------------------------------------------
+
+from pinot_tpu.analysis import detlint  # noqa: E402
+
+DMOD = "pinot_tpu/cluster/detmod.py"
+
+
+def _detlint(src, path=DMOD):
+    findings, _sup = detlint.analyze_source(src, path)
+    return findings
+
+
+def test_dt301_wall_clock_in_plane():
+    """A clock read transitively reachable from a declared entry point
+    is flagged AT ITS SITE — three helpers deep, same module."""
+    src = ("import time\n"
+           "def decide(seed, qid):  # detlint: entrypoint\n"
+           "    return _stamp(qid)\n"
+           "def _stamp(qid):\n"
+           "    return _now(), qid\n"
+           "def _now():\n"
+           "    return time.monotonic()\n")
+    fs = _detlint(src)
+    assert [(f.rule, f.line, f.scope) for f in fs] == \
+        [("DT301", 7, "_now")]
+    assert "decide" in fs[0].message  # root attribution
+    # the identical helpers with no entry point are outside the plane
+    assert _detlint(src.replace("  # detlint: entrypoint", "")) == []
+
+
+def test_dt301_escape_hatch_idioms_are_clean():
+    """All three injectable-now idioms the planes actually use: IfExp,
+    `if x is None:` on a one-step-derived local, and `or` fallback."""
+    src = ("import time\n"
+           "def decide(rec, now=None):  # detlint: entrypoint\n"
+           "    a = now if now is not None else time.monotonic()\n"
+           "    t = now if now is not None else rec.get('ts')\n"
+           "    if t is None:\n"
+           "        t = time.monotonic()\n"
+           "    b = now or time.monotonic()\n"
+           "    return a + t + b\n")
+    assert _detlint(src) == []
+    # the same reads with NO None-default parameter are violations
+    bad = ("import time\n"
+           "def decide(rec):  # detlint: entrypoint\n"
+           "    return time.monotonic()\n")
+    assert [f.rule for f in _detlint(bad)] == ["DT301"]
+
+
+def test_dt301_gmtime_arg_is_pure_conversion():
+    src = ("import time\n"
+           "def decide(ts):  # detlint: entrypoint\n"
+           "    return time.strftime('%Y', time.gmtime(ts))\n")
+    assert _detlint(src) == []
+    bad = src.replace("time.gmtime(ts)", "time.gmtime()")
+    assert [f.rule for f in _detlint(bad)] == ["DT301"]
+
+
+def test_dt302_ambient_randomness():
+    src = ("import random, uuid, os\n"
+           "def decide(seed):  # detlint: entrypoint\n"
+           "    a = random.random()\n"
+           "    b = uuid.uuid4().hex\n"
+           "    c = os.urandom(4)\n"
+           "    d = hash(seed)\n"
+           "    return a, b, c, d\n")
+    fs = _detlint(src)
+    assert [(f.rule, f.line) for f in fs] == \
+        [("DT302", 3), ("DT302", 4), ("DT302", 5), ("DT302", 6)]
+    assert "PYTHONHASHSEED" in fs[3].message
+    # seeded constructors are deterministic by contract
+    clean = ("import random\n"
+             "import numpy as np\n"
+             "def decide(seed):  # detlint: entrypoint\n"
+             "    rng = np.random.default_rng(seed)\n"
+             "    r = random.Random(seed)\n"
+             "    return rng.integers(10), r.random()\n")
+    assert _detlint(clean) == []
+
+
+def test_dt303_unordered_serialization():
+    src = ("import os\n"
+           "def emit(xs):  # detlint: entrypoint\n"
+           "    out = []\n"
+           "    for x in set(xs):\n"
+           "        out.append(x)\n"
+           "    key = ','.join({str(x) for x in xs})\n"
+           "    files = os.listdir('.')\n"
+           "    return out, key, files\n")
+    fs = _detlint(src)
+    assert [(f.rule, f.line) for f in fs] == \
+        [("DT303", 4), ("DT303", 6), ("DT303", 7)]
+    # sorted() at the site makes every one of them deterministic
+    clean = ("import os\n"
+             "def emit(xs):  # detlint: entrypoint\n"
+             "    out = []\n"
+             "    for x in sorted(set(xs)):\n"
+             "        out.append(x)\n"
+             "    key = ','.join(sorted({str(x) for x in xs}))\n"
+             "    files = sorted(os.listdir('.'))\n"
+             "    return out, key, files\n")
+    assert _detlint(clean) == []
+
+
+def test_dt304_query_time_environ():
+    src = ("import os\n"
+           "def decide(qid):  # detlint: entrypoint\n"
+           "    ratio = float(os.environ.get('PINOT_DRIFT_RATIO', 1))\n"
+           "    mode = os.getenv('PINOT_MODE')\n"
+           "    return ratio, mode\n")
+    fs = _detlint(src)
+    assert [(f.rule, f.line) for f in fs] == \
+        [("DT304", 3), ("DT304", 4)]
+    assert "PINOT_DRIFT_RATIO" in fs[0].message
+    # the startup-parsed-once idiom (module level) is outside any
+    # function body and therefore clean
+    clean = ("import os\n"
+             "_RATIO = float(os.environ.get('PINOT_DRIFT_RATIO', 1))\n"
+             "def decide(qid):  # detlint: entrypoint\n"
+             "    return _RATIO\n")
+    assert _detlint(clean) == []
+
+
+def test_dt305_completion_order_float_accumulation():
+    """Corpus-wide (no entry point needed): float += over
+    as_completed() results re-associates the sum."""
+    src = ("from concurrent.futures import as_completed\n"
+           "def tally(futs):\n"
+           "    total = 0.0\n"
+           "    done = 0\n"
+           "    for f in as_completed(futs):\n"
+           "        total += f.result()\n"
+           "        done += 1\n"
+           "    return total, done\n")
+    fs = _detlint(src)
+    # the float accumulation is flagged; the integer counter is not
+    assert [(f.rule, f.line) for f in fs] == [("DT305", 6)]
+    assert "submission order" in fs[0].message
+    # sum() over an as_completed generator is the same hazard
+    gen = ("from concurrent.futures import as_completed\n"
+           "def tally(futs):\n"
+           "    return sum(f.result() for f in as_completed(futs))\n")
+    assert [f.rule for f in _detlint(gen)] == ["DT305"]
+    # submission-order accumulation is the deterministic fix
+    clean = ("def tally(futs):\n"
+             "    total = 0.0\n"
+             "    for f in futs:\n"
+             "        total += f.result()\n"
+             "    return total\n")
+    assert _detlint(clean) == []
+
+
+def test_detlint_cross_module_taint():
+    """Reachability follows imported names and module aliases: the
+    entry point lives in one module, the violation in another."""
+    prog = detlint.Program()
+    prog.add_source(
+        "from pinot_tpu.cluster.helpers import stamp\n"
+        "from pinot_tpu.cluster import helpers as h\n"
+        "def decide(qid):  # detlint: entrypoint\n"
+        "    return stamp(qid), h.tag(qid)\n",
+        "pinot_tpu/cluster/detmod.py")
+    prog.add_source(
+        "import time, random\n"
+        "def stamp(qid):\n"
+        "    return time.time(), qid\n"
+        "def tag(qid):\n"
+        "    return random.random()\n"
+        "def unreached(qid):\n"
+        "    return time.time()\n",
+        "pinot_tpu/cluster/helpers.py")
+    findings, _sup = prog.analyze()
+    got = {(f.rule, f.path, f.scope) for f in findings}
+    assert ("DT301", "pinot_tpu/cluster/helpers.py", "stamp") in got
+    assert ("DT302", "pinot_tpu/cluster/helpers.py", "tag") in got
+    # a function nothing on the plane calls stays unflagged
+    assert all(f.scope != "unreached" for f in findings)
+
+
+def test_detlint_suppression_roundtrip():
+    src = ("import time\n"
+           "def decide(qid):  # detlint: entrypoint\n"
+           "    return time.time()  # detlint: ok DT301\n")
+    findings, sup = detlint.analyze_source(src, DMOD)
+    assert findings == []
+    assert [f.rule for f in sup] == ["DT301"]
+    # "all" suppresses every rule on the line
+    src_all = src.replace("ok DT301", "ok all")
+    findings, sup = detlint.analyze_source(src_all, DMOD)
+    assert findings == [] and [f.rule for f in sup] == ["DT301"]
+    # a mismatched rule id suppresses nothing
+    src_other = src.replace("ok DT301", "ok DT302")
+    findings, _sup = detlint.analyze_source(src_other, DMOD)
+    assert [f.rule for f in findings] == ["DT301"]
+
+
+def test_detlint_parse_error_never_baselined(tmp_path):
+    findings, _sup = detlint.analyze_source("def broken(:\n", DMOD)
+    assert [f.rule for f in findings] == ["parse-error"]
+    path = str(tmp_path / "base.json")
+    detlint.write_baseline(findings, path)
+    new, _stale = detlint.compare_baseline(
+        findings, detlint.load_baseline(path))
+    assert [f.rule for f in new] == ["parse-error"]
+
+
+def test_detlint_registry_roots_all_resolve():
+    """Every ROOTS entry must still name a real function — a rename
+    silently disarming the plane is itself a gate failure."""
+    prog = detlint.Program()
+    prog.add_tree(REPO)
+    prog.analyze()
+    assert prog.roots_missing == [], prog.roots_missing
+    assert len(prog.roots_matched) == len(detlint.ROOTS)
+
+
+def test_detlint_corpus_clean_and_baseline_pinned():
+    """Repo findings must exactly match the checked-in ratchet baseline
+    (tools/detlint_baseline.json), inside the 10s tier-1 budget."""
+    import time
+    t0 = time.perf_counter()
+    findings, _sup = detlint.analyze_tree(REPO)
+    assert time.perf_counter() - t0 < 10.0, \
+        "detlint must stay under the 10s tier-1 budget"
+    assert all(f.rule != "parse-error" for f in findings)
+    baseline = detlint.load_baseline(
+        os.path.join(REPO, "tools", "detlint_baseline.json"))
+    new, stale = detlint.compare_baseline(findings, baseline)
+    assert new == [], "\n".join(str(f) for f in new)
+    assert stale == [], stale
+    # the round-23 fix stays fixed: the overload governor makes no
+    # clock read on the deterministic plane (pinned/inert replay mode)
+    assert not [f for f in findings
+                if f.path == "pinot_tpu/broker/workload.py"], \
+        [str(f) for f in findings]
+    # the one grandfathered site is make_record's documented live-mode
+    # ts fallback (ts= through **fields is its escape hatch)
+    assert {f.key for f in findings} <= \
+        {"pinot_tpu/utils/ledger.py::make_record::DT301"}
+
+
+def test_check_static_detlint_cli_clean_and_json(capsys):
+    import json as _json
+
+    import check_static
+    assert check_static.main(["--detlint-only"]) == 0
+    out = capsys.readouterr().out
+    summary = _json.loads(out.strip().splitlines()[-1])
+    assert summary["ok"] is True
+    assert summary["detlint"]["new"] == 0
+    assert summary["detlint"]["stale"] == 0
+    # --json: exactly one JSON document with the per-finding detail
+    assert check_static.main(["--detlint-only", "--json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    d = doc["detlint"]
+    assert set(d["rules"]) <= set(detlint.DETLINT_RULES)
+    assert d["baselined"] == d["findings"] - d["new"]
+    for f in d["detail"]["findings"]:
+        assert {"rule", "file", "line", "scope",
+                "message", "baselined"} <= set(f)
+    assert isinstance(d["detail"]["suppressed"], list)
+    assert isinstance(d["detail"]["stale"], list)
+
+
+def test_check_static_detlint_fails_on_drift(monkeypatch, tmp_path,
+                                             capsys):
+    import check_static
+    empty = tmp_path / "detlint_baseline.json"
+    empty.write_text('{"version": 1, "counts": {}}')
+    monkeypatch.setattr(check_static, "DETLINT_BASELINE", str(empty))
+    assert check_static.main(["--detlint-only"]) == 1
+    assert "NEW [detlint]" in capsys.readouterr().out
+
+
+def test_check_static_changed_mode(monkeypatch, capsys):
+    """--changed: findings and baselines restricted to the changed
+    files, plan verifier skipped, flag incompatibilities rejected."""
+    import json as _json
+
+    import check_static
+    monkeypatch.setattr(check_static, "_changed_files",
+                        lambda: ["pinot_tpu/utils/ledger.py"])
+    assert check_static.main(["--changed", "--json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["changed"] == ["pinot_tpu/utils/ledger.py"]
+    assert "verify" not in doc  # plan verifier skipped
+    # the one grandfathered ledger site is in scope and baselined
+    assert doc["detlint"]["findings"] == 1
+    assert doc["detlint"]["new"] == 0
+    # every reported finding is inside the changed scope
+    for sec in ("lint", "concur", "detlint"):
+        for f in doc[sec]["detail"]["findings"]:
+            assert f["file"] == "pinot_tpu/utils/ledger.py"
+    # no changed .py files: every pass skips, still exit 0
+    monkeypatch.setattr(check_static, "_changed_files", lambda: [])
+    assert check_static.main(["--changed"]) == 0
+    doc = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc == {"changed": [], "ok": True}
+    # incompatible flag combinations are usage errors (exit 2)
+    with pytest.raises(SystemExit) as e:
+        check_static.main(["--changed", "--verify-only"])
+    assert e.value.code == 2
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as e:
+        check_static.main(["--changed", "--update-baseline"])
+    assert e.value.code == 2
+    capsys.readouterr()

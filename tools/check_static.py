@@ -1,7 +1,7 @@
 """Static analysis gate: JAX hazard linter + concurrency verifier +
-plan-IR verifier.
+determinism verifier + plan-IR verifier.
 
-Runs the three passes of pinot_tpu/analysis and exits non-zero on
+Runs the four passes of pinot_tpu/analysis and exits non-zero on
 anything new (tier-1 runs this through tests/test_static_analysis.py,
 alongside tools/check_ledger.py):
 
@@ -14,11 +14,23 @@ alongside tools/check_ledger.py):
    mixed-guard, blocking-under-lock, lock-order cycles, thread-local
    escape, check-then-act) over the whole tree, ratcheted the same way
    against tools/concur_baseline.json.
-3. **Plan verifier** (analysis/plan_verify.py) over every plan the
+3. **Determinism verifier** (analysis/detlint.py, rules DT301-DT305:
+   wall-clock, ambient RNG, unordered serialization, query-time
+   environ, completion-order float accumulation) — whole-program:
+   taint propagates from the deterministic-plane entry registry
+   through the corpus call graph (the tree plus
+   tools/traffic_replay.py), ratcheted against
+   tools/detlint_baseline.json.
+4. **Plan verifier** (analysis/plan_verify.py) over every plan the
    planner produces for the full SSB query set (bench.QUERIES), the
    NYC-taxi set (bench_taxi.QUERIES), and ``--fuzz N`` seeded
    fuzzer-generated queries (pinot_tpu/tools/fuzzer.py) — all at CI
    scale, plan-only (no kernels execute). Any diagnostic fails.
+
+``--changed`` is the fast pre-commit mode: the three lint passes still
+analyze the whole program (detlint's reachability needs the full call
+graph) but findings and baselines are restricted to git-changed .py
+files, and the plan verifier is skipped.
 
 Prints one summary JSON line last, check_ledger-style; ``--json``
 instead prints exactly one machine-readable JSON document (per-rule
@@ -42,32 +54,58 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 BASELINE = os.path.join(REPO, "tools", "jaxlint_baseline.json")
 CONCUR_BASELINE = os.path.join(REPO, "tools", "concur_baseline.json")
+DETLINT_BASELINE = os.path.join(REPO, "tools", "detlint_baseline.json")
 FUZZ_SEED = 20260804
 
 EXIT_CODES = """\
 exit codes:
   0  clean: no findings beyond the committed ratchet baselines, no
      stale baseline counts, no plan diagnostics or coverage failures
-  1  gate failure: new lint/concur findings above a baseline count, a
-     baseline count that no longer matches (ratchet it down), a plan
-     verifier diagnostic, or lost corpus coverage
+  1  gate failure: new lint/concur/detlint findings above a baseline
+     count, a baseline count that no longer matches (ratchet it down),
+     a plan verifier diagnostic, or lost corpus coverage
   2  usage error (bad arguments)
 
-The two ratchet baselines (tools/jaxlint_baseline.json,
-tools/concur_baseline.json) grandfather true-but-benign findings per
-file::scope::rule; regenerate with --update-baseline (combine with
---lint-only / --concur-only to re-ratchet one of them)."""
+The three ratchet baselines (tools/jaxlint_baseline.json,
+tools/concur_baseline.json, tools/detlint_baseline.json) grandfather
+true-but-benign findings per file::scope::rule; regenerate with
+--update-baseline (combine with --lint-only / --concur-only /
+--detlint-only to re-ratchet one of them)."""
+
+
+def _changed_files() -> list:
+    """Repo-relative .py files changed vs HEAD (staged + unstaged +
+    untracked) — the --changed reporting scope."""
+    import subprocess
+    paths: list = []
+    for cmd in (["git", "-C", REPO, "diff", "--name-only", "HEAD"],
+                ["git", "-C", REPO, "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 check=True).stdout
+        except Exception:
+            continue
+        paths.extend(p.strip() for p in out.splitlines() if p.strip())
+    return sorted({p for p in paths if p.endswith(".py")})
 
 
 def _ratchet_pass(findings, suppressed, baseline_path, update, label,
-                  write_baseline):
-    """Shared jaxlint/concur ratchet flow -> summary dict (+ the
-    machine-readable details for --json)."""
+                  write_baseline, paths=None):
+    """Shared jaxlint/concur/detlint ratchet flow -> summary dict (+
+    the machine-readable details for --json). ``paths`` (the --changed
+    scope) restricts findings AND baseline keys to those files."""
     from pinot_tpu.analysis import jaxlint
 
     if update:
         write_baseline(findings, baseline_path)
     baseline = jaxlint.load_baseline(baseline_path)
+    if paths is not None:
+        scope = set(paths)
+        findings = [f for f in findings if f.path in scope]
+        suppressed = [f for f in suppressed if f.path in scope]
+        baseline = {k: v for k, v in baseline.items()
+                    if k.split("::", 1)[0] in scope}
     new, stale = jaxlint.compare_baseline(findings, baseline)
     for f in new:
         print(f"NEW [{label}] {f}")
@@ -95,22 +133,31 @@ def _ratchet_pass(findings, suppressed, baseline_path, update, label,
     return out
 
 
-def run_lint(update_baseline: bool = False) -> dict:
+def run_lint(update_baseline: bool = False, paths=None) -> dict:
     from pinot_tpu.analysis import jaxlint
 
     findings, suppressed = jaxlint.lint_tree_ex(REPO)
     return _ratchet_pass(findings, suppressed, BASELINE,
                          update_baseline, "jaxlint",
-                         jaxlint.write_baseline)
+                         jaxlint.write_baseline, paths)
 
 
-def run_concur(update_baseline: bool = False) -> dict:
+def run_concur(update_baseline: bool = False, paths=None) -> dict:
     from pinot_tpu.analysis import concur
 
     findings, suppressed = concur.analyze_tree(REPO)
     return _ratchet_pass(findings, suppressed, CONCUR_BASELINE,
                          update_baseline, "concur",
-                         concur.write_baseline)
+                         concur.write_baseline, paths)
+
+
+def run_detlint(update_baseline: bool = False, paths=None) -> dict:
+    from pinot_tpu.analysis import detlint
+
+    findings, suppressed = detlint.analyze_tree(REPO)
+    return _ratchet_pass(findings, suppressed, DETLINT_BASELINE,
+                         update_baseline, "detlint",
+                         detlint.write_baseline, paths)
 
 
 def _verify_corpus(label: str, segment, sqls, counts: dict,
@@ -250,11 +297,19 @@ def main(argv=None) -> int:
                       help="run only the jaxlint pass")
     only.add_argument("--concur-only", action="store_true",
                       help="run only the concurrency verifier pass")
+    only.add_argument("--detlint-only", action="store_true",
+                      help="run only the determinism verifier pass")
     only.add_argument("--verify-only", action="store_true",
                       help="run only the plan-IR verifier pass")
+    ap.add_argument("--changed", action="store_true",
+                    help="fast pre-commit mode: restrict lint/concur/"
+                         "detlint findings and baselines to git-"
+                         "changed .py files (analysis still covers "
+                         "the whole program) and skip the plan "
+                         "verifier")
     ap.add_argument("--update-baseline", action="store_true",
                     help="re-ratchet the baseline(s) of the passes "
-                         "being run (jaxlint and/or concur), then "
+                         "being run (jaxlint/concur/detlint), then "
                          "re-compare; parse errors stay red")
     ap.add_argument("--fuzz", type=int, default=150, metavar="N",
                     help="fuzzer queries for the plan verifier "
@@ -265,6 +320,14 @@ def main(argv=None) -> int:
                          "finding, suppressed/baselined split) "
                          "instead of the line-oriented report")
     args = ap.parse_args(argv)
+    if args.changed and args.verify_only:
+        ap.error("--changed skips the plan verifier; it cannot be "
+                 "combined with --verify-only")
+    if args.changed and args.update_baseline:
+        ap.error("--update-baseline needs the full-corpus view; it "
+                 "cannot be combined with --changed")
+
+    changed = _changed_files() if args.changed else None
 
     # --json buffers the human chatter so stdout is ONE JSON document
     out_buf = None
@@ -274,18 +337,26 @@ def main(argv=None) -> int:
         out_buf = io.StringIO()
         sys.stdout = out_buf
 
+    lint_passes = (
+        ("lint", args.lint_only, run_lint),
+        ("concur", args.concur_only, run_concur),
+        ("detlint", args.detlint_only, run_detlint),
+    )
+    any_only = any(flag for _s, flag, _r in lint_passes) or \
+        args.verify_only
     summary: dict = {}
     rc = 0
     try:
-        if not (args.verify_only or args.concur_only):
-            summary["lint"] = run_lint(args.update_baseline)
-            if summary["lint"]["new"] or summary["lint"]["stale"]:
+        if changed is not None:
+            summary["changed"] = changed
+        for sec, only_flag, runner in lint_passes:
+            if (any_only and not only_flag) or \
+                    (changed is not None and not changed):
+                continue
+            summary[sec] = runner(args.update_baseline, changed)
+            if summary[sec]["new"] or summary[sec]["stale"]:
                 rc = 1
-        if not (args.verify_only or args.lint_only):
-            summary["concur"] = run_concur(args.update_baseline)
-            if summary["concur"]["new"] or summary["concur"]["stale"]:
-                rc = 1
-        if not (args.lint_only or args.concur_only):
+        if (not any_only or args.verify_only) and changed is None:
             summary["verify"] = run_verify(args.fuzz)
             if summary["verify"]["diagnostics"] or \
                     summary["verify"]["coverage_failures"]:
@@ -300,12 +371,12 @@ def main(argv=None) -> int:
         # coverage messages) land under "detail" — a failing run must
         # be actionable from the JSON alone, since the line report was
         # swallowed by the buffer
-        for sec in ("lint", "concur", "verify"):
+        for sec in ("lint", "concur", "detlint", "verify"):
             if sec in summary and "_details" in summary[sec]:
                 summary[sec]["detail"] = summary[sec].pop("_details")
         print(json.dumps(summary, indent=1))
     else:
-        for sec in ("lint", "concur", "verify"):
+        for sec in ("lint", "concur", "detlint", "verify"):
             summary.get(sec, {}).pop("_details", None)
         print(json.dumps(summary))
     return rc
