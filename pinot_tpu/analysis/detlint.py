@@ -148,6 +148,14 @@ ROOTS: Tuple[Tuple[str, str], ...] = (
     ("tools/traffic_replay.py", "load_records"),
     ("tools/traffic_replay.py", "plan_replay"),
     ("tools/traffic_replay.py", "plan_slo"),
+    # closed-loop rebalance planning plane (round 24): the move plan
+    # plus its freeze/burn/affinity/budget predicates — execution-side
+    # impurity stays in ClosedLoopRebalanceTask, outside the registry
+    ("pinot_tpu/cluster/rebalancer.py", "plan_moves"),
+    ("pinot_tpu/cluster/rebalancer.py", "incident_frozen"),
+    ("pinot_tpu/cluster/rebalancer.py", "burning_tables"),
+    ("pinot_tpu/cluster/rebalancer.py", "receiver_affinity"),
+    ("pinot_tpu/cluster/rebalancer.py", "churn_capped"),
 )
 
 # tools/ modules named by the registry ride along with the package walk

@@ -267,10 +267,17 @@ class BrokerNode:
     # -- routing -----------------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.wait(self.routing_refresh):
+            epoch = None
             try:
                 try:
-                    http_json("POST", f"{self.controller_url}/heartbeat/"
-                                      f"{self.instance_id}")
+                    resp = http_json(
+                        "POST", f"{self.controller_url}/heartbeat/"
+                                f"{self.instance_id}")
+                    # assignment-version epoch (round 24): the
+                    # heartbeat response names the controller's current
+                    # version, so a rebalance flip that lands mid-poll
+                    # converges on THIS tick instead of the next one
+                    epoch = (resp or {}).get("version")
                 except urllib.error.HTTPError as e:
                     if e.code != 404:
                         raise
@@ -281,6 +288,11 @@ class BrokerNode:
                 pass
             try:
                 self._refresh_routing()
+                if epoch is not None and \
+                        self._routing.get("version", -1) < epoch:
+                    # the refresh raced a concurrent flip: the epoch
+                    # proves a newer assignment exists — re-fetch now
+                    self._refresh_routing()
             except Exception:
                 pass
 

@@ -101,6 +101,17 @@ class Controller:
             ForensicsRollupTask.NAME, interval_s=30.0,
             initial_delay_s=30.0,
             fn=self._leader_gated(self.rollup.run)))
+        # closed-loop rebalance (round 24): consumes the rollup's
+        # slo/heat/plan_shapes blocks, moves segments when a budget
+        # burns, freezes while an incident is open. Leader-gated +
+        # REST-triggerable like the rollup; the initial delay sits
+        # after the first rollup pass so a pass has a fleet view
+        from .rebalancer import ClosedLoopRebalanceTask
+        self.rebalancer = ClosedLoopRebalanceTask(self)
+        self.scheduler.register(BasePeriodicTask(
+            ClosedLoopRebalanceTask.NAME, interval_s=60.0,
+            initial_delay_s=45.0,
+            fn=self._leader_gated(self.rebalancer.run)))
         # realtime commit arbitration (SegmentCompletionManager FSM); the
         # registry fallback keeps restarts/purges from re-electing a
         # committer for an already-registered segment
@@ -319,6 +330,14 @@ class Controller:
             if residency is not None:
                 inst["residency"] = residency
             return True
+
+    def assignment_version(self) -> int:
+        """The current property-store version — piggybacked on
+        heartbeat responses as an assignment epoch so brokers/servers
+        converge on a flip without waiting out a poll interval (or a
+        restart)."""
+        with self._lock:
+            return self._state["version"]
 
     def live_servers(self, tenant: Optional[str] = None) -> List[str]:
         """Live server instances; with tenant, only instances carrying
@@ -847,7 +866,10 @@ class Controller:
                 "compile": _compile_health_snapshot(),
                 # fleet forensics rollup (webapp Fleet view): the latest
                 # ForensicsRollup pass, None until one has run
-                "fleet": self.rollup.snapshot()}
+                "fleet": self.rollup.snapshot(),
+                # closed-loop rebalance moves ring (Fleet view panel
+                # beside the SLO budgets table)
+                "rebalance": self.rebalancer.snapshot(limit=20)}
 
     def ui_page(self) -> str:
         """The controller web application (GET /ui): the reference's
@@ -942,7 +964,8 @@ class Controller:
             from .forensics import debug_index
             return debug_index(
                 getattr(c, "instance_id", "controller"), "controller",
-                surfaces=("/debug/fleet", "/debug/incidents"))
+                surfaces=("/debug/fleet", "/debug/incidents",
+                          "/debug/rebalance"))
 
         def _incidents():
             from ..utils.slo import global_incidents
@@ -956,8 +979,14 @@ class Controller:
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
                 ("POST", "/instances"): lambda h, b: (
                     ctrl.register_instance(b) or (200, {"status": "OK"})),
+                # heartbeat responses carry the assignment-version
+                # epoch (round 24): a broker/server whose routing is
+                # behind re-syncs immediately instead of waiting out
+                # its poll — rebalance cutovers converge in one
+                # heartbeat interval without restarts
                 ("POST", "/heartbeat/"): lambda h, b: (
-                    (200, {"status": "OK"})
+                    (200, {"status": "OK",
+                           "version": ctrl.assignment_version()})
                     if ctrl.heartbeat(h.path.rsplit("/", 1)[1],
                                       (b or {}).get("residency"))
                     else (404, {"error": "unknown instance"})),
@@ -1011,6 +1040,9 @@ class Controller:
                     200, _debug_index(ctrl)),
                 ("GET", "/debug/incidents"): lambda h, b: (
                     200, _incidents()),
+                # closed-loop rebalance audit ring (round 24)
+                ("GET", "/debug/rebalance"): lambda h, b: (
+                    200, ctrl.rebalancer.snapshot()),
                 ("POST", "/segmentConsumed"): lambda h, b: (
                     200, ctrl.completion.segment_consumed(
                         b["table"], b["segment"], b["server"],

@@ -337,6 +337,19 @@ def fleet_totals(node_blocks: Dict[str, Dict[str, Any]]
     return totals
 
 
+def _node_slo_brief(slo: Dict[str, Any]) -> Dict[str, Any]:
+    """One node's SLO block compressed to the rebalancer's donor
+    signal: worst slow-window burn across its objectives + whether any
+    alert is latched."""
+    objs = (slo or {}).get("objectives") or []
+    return {
+        "worst_burn_slow": max(
+            [float(o.get("burn_slow", 0.0) or 0.0) for o in objs]
+            or [0.0]),
+        "alerting": any(bool(o.get("alerting")) for o in objs),
+    }
+
+
 class ForensicsRollupTask:
     """The controller-side pull + aggregate pass (module docstring).
     Registered as a BasePeriodicTask; ``run()`` is also the manual
@@ -473,7 +486,15 @@ class ForensicsRollupTask:
                            if p == "total" or (v or {}).get("entries")},
                 # HBM tier occupancy beside the device-bytes block
                 # (webapp Fleet view renders both)
-                **({"tier": b["tier"]} if b.get("tier") else {})}
+                **({"tier": b["tier"]} if b.get("tier") else {}),
+                # per-node SLO brief (worst slow-window burn + alerting
+                # flag): the closed-loop rebalancer's donor-ranking
+                # signal (cluster/rebalancer.plan_moves). In-process
+                # roles share one SloPlane so these degenerate to the
+                # same value per proc — the planner's load tiebreak
+                # carries ranking then; distinct processes diverge.
+                **({"slo": _node_slo_brief(b["slo"])}
+                   if (b.get("slo") or {}).get("armed") else {})}
             for n, b in node_blocks.items()}
         fields: Dict[str, Any] = {
             "nodes_polled": len(targets),

@@ -143,12 +143,20 @@ class ServerNode:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
+            epoch = None
             try:
                 try:
-                    http_json("POST",
-                              f"{self.controller_url}/heartbeat/"
-                              f"{self.instance_id}",
-                              {"residency": self._residency()})
+                    resp = http_json("POST",
+                                     f"{self.controller_url}/heartbeat/"
+                                     f"{self.instance_id}",
+                                     {"residency": self._residency()})
+                    # assignment-version epoch (round 24): when the
+                    # heartbeat says our applied version is current,
+                    # skip the assignment fetch this tick. A stale or
+                    # absent epoch (older controller) always syncs; a
+                    # partially-failed sync keeps _assignment_version
+                    # behind the epoch, so retries still fire each poll
+                    epoch = (resp or {}).get("version")
                 except urllib.error.HTTPError as e:
                     if e.code != 404:
                         raise
@@ -157,7 +165,8 @@ class ServerNode:
                     # (the ZK ephemeral-node re-registration Helix does
                     # on session re-establishment)
                     self._register()
-                self._sync_assignment()
+                if epoch is None or epoch != self._assignment_version:
+                    self._sync_assignment()
             except Exception:
                 pass  # controller briefly unreachable; keep serving
 

@@ -235,8 +235,27 @@ function vFleet() {
         `${c.tier_promotions || 0}/${c.tier_demotions || 0}`,
         c.tier_affinity_hits || 0];
     }));
+  // closed-loop rebalance moves ring (round 24, D.rebalance —
+  // GET /debug/rebalance): the move audit stream beside the SLO
+  // budgets that trigger it
+  const rb = D.rebalance || {};
+  const moveTbl = (rb.moves || []).length ? table(
+    ["phase", "table", "segment", "donor", "receiver", "bytes",
+     "reason"],
+    rb.moves.map(m => [esc(m.phase || ""), esc(m.table || ""),
+      esc(m.segment || ""), esc(m.donor || ""), esc(m.receiver || ""),
+      m.bytes || 0, esc(m.reason || "")]))
+    : `<p class="mut">no moves yet — the ClosedLoopRebalance task
+      plans from the rollup's burn table (frozen while incidents are
+      open)</p>`;
+  const moveHead = `<h3>Rebalance moves <span class="mut">(passes
+    ${rb.passes || 0} · executed ${rb.executed || 0} · aborted
+    ${rb.aborted || 0} · resumed ${rb.resumed || 0} · frozen
+    ${rb.frozen_passes || 0}${rb.pending ? " · MOVE PENDING" : ""}
+    )</span></h3>`;
   return `<h2>Fleet forensics</h2>${pull}
     ${sloHead}${sloTbl}
+    ${moveHead}${moveTbl}
     <h3>Per-table fleet stats</h3>${tbl}
     <h3>Slowest queries</h3>${slow}
     <h3>Hottest plan shapes (warmup debt)</h3>${shapes}

@@ -264,6 +264,43 @@ class TierManager:
         self._export()
         return True
 
+    def drain(self, name: str, reason: str = "drain",
+              table: Optional[str] = None) -> int:
+        """Rebalance drain entry point (cluster/rebalancer.py): warm-
+        demote every live HOT copy of the named segment — device
+        residents drop, the padded host arrays stay warm, so there is
+        NO cold re-pad if the copy is touched again and in-flight
+        queries finish on references they already acquired. In-process
+        replicas register distinct segment objects under the same name;
+        a drain demotes them all (a receiver that just pre-warmed
+        re-promotes from its warm arrays on first touch — cheap
+        device_put, digests unaffected). Segment names recur ACROSS
+        tables too, so pass ``table`` to demote only copies whose
+        schema carries that table — an unrelated table sharing the
+        name must not pay a re-promotion. Returns demotions
+        performed."""
+        with self._lock:
+            self._reap_locked()
+            uids = sorted(uid for uid, n in self._names.items()
+                          if n == name
+                          and self._state.get(uid) == TIER_HOT)
+            segs = []
+            for uid in uids:
+                ref = self._refs.get(uid)
+                seg = ref() if ref is not None else None
+                if seg is None:
+                    continue
+                if table is not None and \
+                        getattr(getattr(seg, "schema", None),
+                                "name", None) != table:
+                    continue
+                segs.append(seg)
+        n = 0
+        for seg in segs:  # demote takes _lock itself (leaf) — call outside
+            if self.demote(seg, TIER_WARM, reason=reason):
+                n += 1
+        return n
+
     def on_evicted(self, segment) -> None:
         """ImmutableSegment.evict_device (unload/reload path): the
         segment left the hierarchy entirely — mark cold, no demotion

@@ -53,6 +53,17 @@ target these):
                      site key = segment name) — the query must
                      re-promote through device_col and finish
                      byte-exact (tools/chaos_smoke.py ``--tier``)
+``rebalance.crash``  decision hook: the controller dies inside the
+                     rebalance cutover window — after the receiver
+                     pre-warmed but BEFORE the flip journal commit
+                     (cluster/rebalancer.py raises RebalanceCrash;
+                     site key ``rebalance/<table>/<segment>``). The
+                     next pass / new leader must resume the journaled
+                     move idempotently, never double-assign
+``cutover.stall``    a rebalance receiver pre-warm hangs past its
+                     deadline: sleep ``delay_ms`` then OSError at the
+                     pre-warm wait (same site key) — the move aborts,
+                     the donor keeps serving, placement is unchanged
 ==================== ======================================================
 
 Activation: ``PINOT_FAULTS`` env var at process start, or
@@ -120,6 +131,8 @@ FAULT_POINTS = (
     "commit.http_error", "handoff.stall", "upsert.compact_crash",
     # HBM tier (engine/tier.py): forced mid-query demotion
     "tier.evict",
+    # closed-loop rebalance cutover (cluster/rebalancer.py)
+    "rebalance.crash", "cutover.stall",
 )
 
 
@@ -367,6 +380,11 @@ def fault_point(point: str, key: str = "") -> None:
         # retries from its next completion poll
         time.sleep(spec.delay_ms / 1e3)
         raise OSError(f"injected fault handoff.stall ({key})")
+    if point == "cutover.stall":
+        # receiver pre-warm hangs past its deadline: the rebalancer
+        # aborts the move and the donor keeps serving
+        time.sleep(spec.delay_ms / 1e3)
+        raise OSError(f"injected fault cutover.stall ({key})")
     raise FaultInjected(f"fault point {point} has no inline effect; "
                         "use fault_fires()/corrupt_bytes()")
 

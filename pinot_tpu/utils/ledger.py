@@ -77,6 +77,13 @@ Kinds:
   occupancy, devmem pools, compile block, active SLO burn table)
   keyed by the firing alert — served at GET /debug/incidents and
   rendered in the webapp.
+- ``rebalance_event``  — cluster/rebalancer.py closed-loop rebalance
+  audit stream: one record per move phase (plan / freeze / prewarm /
+  flip / drain / abort / resume) carrying the move's table/segment,
+  donor/receiver instance ids, byte size, the planner's reason string
+  and ``planned`` (False for freeze passes and other non-move
+  bookkeeping). Mirrored into the controller's bounded ring at
+  GET /debug/rebalance and the webapp Fleet "moves" panel.
 
 Fleet provenance: the controller's rollup puller stamps every record it
 ships into the fleet ledger with ``node`` (the source instance id) so
@@ -341,6 +348,20 @@ KINDS: Dict[str, Dict[str, set]] = {
         "required": {"incident_id", "alert", "severity", "proc",
                      "surfaces"},
         "optional": {"detail", "scope", "slo", "seq", "backend",
+                     "extra"},
+    },
+    "rebalance_event": {
+        # one closed-loop rebalance phase (cluster/rebalancer.py —
+        # the writer-side contract): ``phase`` in {plan, freeze,
+        # prewarm, flip, drain, abort, resume}; ``donor``/``receiver``
+        # are instance ids (empty for pass-level bookkeeping like
+        # freeze); ``bytes`` the segment's on-disk size charged
+        # against the churn budget; ``reason`` the planner's burn
+        # rationale (or the abort/resume cause); ``planned`` False for
+        # records that are not an executed planned move phase.
+        "required": {"table", "segment", "donor", "receiver", "phase",
+                     "reason", "bytes", "planned"},
+        "optional": {"version", "seed", "backend", "proc", "seq",
                      "extra"},
     },
 }

@@ -1,0 +1,278 @@
+"""Closed-loop rebalance (ISSUE 19): the pure move planner + the
+crash-safe three-phase cutover journal (cluster/rebalancer.py).
+
+Contract under test:
+- ``plan_moves`` is a deterministic pure function: frozen to zero moves
+  while any incident is open, threshold-gated, churn-budget capped
+  (first move always fits), worst-burn donor / best-affinity receiver
+  ranking, tenant-scoped burns nominate nothing, and the recent-move
+  cooldown (the anti-flap guard) skips just-moved segments;
+- a leader that dies between the flip-journal commit and the flip is
+  resumed idempotently by the promoted standby over the shared data
+  dir — exactly one final assignment, the donor drained once, the
+  resume pass plans no NEW moves, and a second pass is a no-op;
+- a torn journal tmp (crash mid-rename) is dropped on construction and
+  a garbage journal body is ignored, never replayed;
+- the ``chaos_smoke --rebalance`` tier-1 gate stays green end to end.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from pinot_tpu.cluster import Controller  # noqa: E402
+from pinot_tpu.cluster.rebalancer import (  # noqa: E402
+    ClosedLoopRebalanceTask, burning_tables, churn_capped,
+    incident_frozen, plan_moves, receiver_affinity)
+
+# ---------------------------------------------------------------------------
+# the pure planning plane
+# ---------------------------------------------------------------------------
+
+INSTANCES = {
+    "s0": {"role": "server", "residency": {}},
+    "s1": {"role": "server", "residency": {}},
+    "b0": {"role": "broker", "residency": {}},
+}
+ASSIGN = {"t": {"seg_0": ["s0"], "seg_1": ["s0"], "seg_2": ["s0"]}}
+BIG = {"moves": 8, "bytes": 1 << 30}
+
+
+def _rollup(burn=5.0, open_incidents=0, scope="t", nodes=None, heat=()):
+    return {"slo": {"armed": True, "open_incidents": open_incidents,
+                    "objectives": [{"scope": scope, "kind": "latency",
+                                    "burn_slow": burn,
+                                    "alerting": True}]},
+            "nodes": nodes or {}, "heat": list(heat)}
+
+
+def test_plan_frozen_under_open_incident():
+    assert incident_frozen(_rollup(open_incidents=2))
+    assert not incident_frozen(_rollup())
+    assert plan_moves(_rollup(open_incidents=1), ASSIGN, budget=BIG,
+                      instances=INSTANCES) == []
+
+
+def test_plan_requires_rollup_and_quorum():
+    assert plan_moves(None, ASSIGN, budget=BIG,
+                      instances=INSTANCES) == []
+    # one live server: nowhere to move
+    assert plan_moves(_rollup(), ASSIGN, budget=BIG,
+                      instances={"s0": INSTANCES["s0"]}) == []
+
+
+def test_plan_moves_burning_table_deterministic():
+    sizes = {"t/seg_0": 10, "t/seg_1": 20, "t/seg_2": 30}
+    moves = plan_moves(_rollup(), ASSIGN, budget=BIG,
+                       instances=INSTANCES, sizes=sizes)
+    again = plan_moves(_rollup(), ASSIGN, budget=BIG,
+                       instances=INSTANCES, sizes=sizes)
+    assert json.dumps(moves, sort_keys=True) \
+        == json.dumps(again, sort_keys=True)
+    assert [m["segment"] for m in moves] == ["seg_0", "seg_1", "seg_2"]
+    assert all(m["donor"] == "s0" and m["receiver"] == "s1"
+               for m in moves)
+    assert moves[0]["bytes"] == 10
+    assert moves[0]["reason"] == "burn_slow=5.000"
+
+
+def test_plan_threshold_and_tenant_scopes():
+    assert burning_tables(_rollup(burn=0.5)) == []
+    assert plan_moves(_rollup(burn=0.5), ASSIGN, budget=BIG,
+                      instances=INSTANCES) == []
+    # a tenant burn names no segments to move
+    assert burning_tables(_rollup(scope="tenant:acme")) == []
+    assert plan_moves(_rollup(scope="tenant:acme"), ASSIGN, budget=BIG,
+                      instances=INSTANCES) == []
+
+
+def test_churn_budget_caps_and_first_move_always_fits():
+    moves = plan_moves(_rollup(), ASSIGN, budget={"moves": 2},
+                       instances=INSTANCES)
+    assert len(moves) == 2
+    # a segment larger than the byte budget still moves, just alone
+    sizes = {k: 1000 for k in ("t/seg_0", "t/seg_1", "t/seg_2")}
+    moves = plan_moves(_rollup(), ASSIGN,
+                       budget={"moves": 8, "bytes": 100},
+                       instances=INSTANCES, sizes=sizes)
+    assert len(moves) == 1
+    assert churn_capped([], {"moves": 0}) == []
+
+
+def test_recent_cooldown_skips_just_moved_segments():
+    moves = plan_moves(_rollup(), ASSIGN, budget=BIG,
+                       instances=INSTANCES,
+                       recent=frozenset({"t/seg_0", "t/seg_2"}))
+    assert [m["segment"] for m in moves] == ["seg_1"]
+
+
+def test_receiver_prefers_residency_affinity():
+    instances = {
+        "s0": {"role": "server", "residency": {}},
+        "s1": {"role": "server",
+               "residency": {"t": {"seg_0": "warm"}}},
+        "s2": {"role": "server", "residency": {}},
+    }
+    assert receiver_affinity(instances, "t", "seg_0", "s1") == 1
+    assert receiver_affinity(instances, "t", "seg_0", "s2") == 0
+    moves = plan_moves(_rollup(), {"t": {"seg_0": ["s0"]}},
+                       budget=BIG, instances=instances)
+    assert [m["receiver"] for m in moves] == ["s1"]
+
+
+def test_donor_prefers_worst_burn_node():
+    instances = {f"s{i}": {"role": "server", "residency": {}}
+                 for i in range(3)}
+    nodes = {"s0": {"slo": {"worst_burn_slow": 0.5}},
+             "s1": {"slo": {"worst_burn_slow": 9.0}}}
+    moves = plan_moves(_rollup(nodes=nodes),
+                       {"t": {"seg_0": ["s0", "s1"]}},
+                       budget=BIG, instances=instances)
+    assert [m["donor"] for m in moves] == ["s1"]
+    assert [m["receiver"] for m in moves] == ["s2"]
+
+
+# ---------------------------------------------------------------------------
+# the crash-safe journal: leader failover mid-move, torn tmp
+# ---------------------------------------------------------------------------
+
+MOVE = {"table": "t", "segment": "seg_0", "donor": "donor",
+        "receiver": "recv", "bytes": 0, "reason": "burn_slow=5.000"}
+
+
+def test_leader_failover_mid_move_resumes_idempotently(tmp_path):
+    """The old leader pre-warmed the receiver (over-replicated holders
+    persisted), committed the FLIP journal, then crashed before the
+    flip. The promoted standby over the shared data dir must finish
+    the move exactly once: one final assignment, the donor drained
+    once, no new planning on the resume pass, and a second pass is a
+    no-op."""
+    shared = str(tmp_path / "ctrl")
+    leader = Controller(shared, heartbeat_timeout=5.0,
+                        reconcile_interval=5.0, lease_ttl=0.5,
+                        instance_id="ctrl_a")
+    standby = Controller(shared, heartbeat_timeout=5.0,
+                         reconcile_interval=5.0, lease_ttl=0.5,
+                         instance_id="ctrl_b")
+    try:
+        assert leader.is_leader and not standby.is_leader
+        with leader._lock:
+            leader._state["assignment"]["t"] = \
+                {"seg_0": ["donor", "recv"]}
+            leader._bump()
+        leader.rebalancer._journal({"move": dict(MOVE),
+                                    "phase": "flip"})
+        leader.stop(release_lease=False)  # crash: lease NOT released
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not standby.is_leader:
+            time.sleep(0.05)
+        assert standby.is_leader, "standby never acquired the lease"
+
+        rb = standby.rebalancer
+        res = rb.run()
+        assert res["resumed"] == 1
+        # recovery-only pass: the rollup predates the resumed move, so
+        # no NEW moves are planned from it
+        assert res["planned"] == 0 and res["executed"] == 0
+        assert rb._load_journal() is None, "journal left behind"
+        with standby._lock:
+            assert standby._state["assignment"]["t"]["seg_0"] \
+                == ["recv"], "flip did not land exactly once"
+        events = rb.snapshot()["moves"]
+        assert [e["phase"] for e in events] \
+            == ["resume", "flip", "drain"]
+        assert events[0]["reason"] == "journal:flip"
+
+        # idempotent: a second pass finds no journal, changes nothing
+        res = rb.run()
+        assert res["resumed"] == 0 and res["executed"] == 0
+        with standby._lock:
+            assert standby._state["assignment"]["t"]["seg_0"] \
+                == ["recv"]
+        assert [e["phase"] for e in rb.snapshot()["moves"]] \
+            == ["resume", "flip", "drain"]
+    finally:
+        try:
+            leader.stop()
+        except Exception:
+            pass
+        standby.stop()
+
+
+def test_torn_journal_tmp_and_garbage_journal(tmp_path):
+    """A crash mid-journal-write leaves ``.tmp`` behind (the rename
+    never landed): construction drops the orphan. A garbage committed
+    journal is ignored, never replayed."""
+    ctrl = Controller(str(tmp_path / "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=5.0)
+    try:
+        rb = ctrl.rebalancer
+        with open(rb.journal_path + ".tmp", "w") as fh:
+            fh.write('{"move": {"tor')
+        with open(rb.journal_path, "w") as fh:
+            fh.write("not json at all")
+        rb2 = ClosedLoopRebalanceTask(ctrl,
+                                      journal_path=rb.journal_path)
+        assert not os.path.exists(rb.journal_path + ".tmp")
+        assert rb2._load_journal() is None
+        res = rb2.run()
+        assert res["resumed"] == 0
+        # a journal whose "move" is not a dict is equally untrusted
+        rb2._journal({"move": "seg_0", "phase": "flip"})
+        assert rb2._load_journal() is None
+    finally:
+        ctrl.stop()
+
+
+def test_rebalance_surfaces_registered(tmp_path):
+    """GET /debug/rebalance serves the ring snapshot; the heartbeat
+    response carries the assignment-version epoch brokers/servers
+    converge on; the scheduler owns the leader-gated pass."""
+    from pinot_tpu.cluster.http_util import http_json
+    ctrl = Controller(str(tmp_path / "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=5.0)
+    try:
+        snap = http_json("GET", f"{ctrl.url}/debug/rebalance")
+        assert snap["passes"] == 0 and snap["moves"] == []
+        assert snap["pending"] is None
+        names = [t["name"] for t in ctrl.scheduler.status()]
+        assert ClosedLoopRebalanceTask.NAME in names
+        resp = http_json("POST", f"{ctrl.url}/instances", {
+            "id": "server_x", "host": "h", "port": 1,
+            "role": "server"})
+        assert resp["status"] == "OK"
+        hb = http_json("POST", f"{ctrl.url}/heartbeat/server_x",
+                       {"residency": {}})
+        assert hb["status"] == "OK"
+        assert hb["version"] == ctrl.assignment_version()
+    finally:
+        ctrl.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 chaos gate
+# ---------------------------------------------------------------------------
+
+def test_chaos_smoke_rebalance_cli(capsys):
+    """ISSUE 19 acceptance: a burn-triggered move under seeded
+    ``rebalance.crash`` + ``cutover.stall`` recovers byte-exact from
+    the journal, same-seed stall passes fire identical streams, an
+    incident-open pass plans ZERO moves, and the devmem pools
+    reconcile to the byte after the donor drain."""
+    import chaos_smoke
+    assert chaos_smoke.main(["--rebalance", "--rows", "512",
+                             "--queries", "q1.1"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["ok"] and summary["mode"] == "rebalance"
+    assert summary["faults_fired"] >= 3  # 1 crash + 2 stall passes
+    assert summary["rebalance"]["executed"] >= 1
+    assert summary["rebalance"]["resumed"] >= 1
+    assert summary["rebalance"]["frozen_passes"] >= 1
+    for pool in summary["reconcile"].values():
+        assert pool["tracked"] == pool["actual"]

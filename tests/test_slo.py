@@ -487,7 +487,8 @@ def test_debug_index_per_role(slo_cluster):
     assert "/debug/queries" not in s["surfaces"]   # truthful per role
     c = _get(f"{ctrl.url}/debug")
     assert c["role"] == "controller"
-    assert set(c["surfaces"]) == {"/debug/fleet", "/debug/incidents"}
+    assert set(c["surfaces"]) == {"/debug/fleet", "/debug/incidents",
+                                  "/debug/rebalance"}
 
 
 def test_live_burn_alert_incident_over_http(slo_cluster):

@@ -471,6 +471,283 @@ def main_tier(args) -> int:
     return 0 if not failures else 1
 
 
+REBALANCE_ROWS = 2048
+
+
+def build_rebalance_cluster(tmp: str, rows: int, poll: float = 0.1):
+    """A deliberately skewed cluster for the closed-loop rebalance
+    gate: ``lineorder`` (3 segments, replication 1) is added while
+    server_0 is the ONLY live server so every segment lands there;
+    then server_1 joins and the protected ``lineorder_s`` twin (2
+    segments) lands on it least-loaded. Returns (ctrl, servers,
+    broker, stop)."""
+    import bench
+    from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.segment.builder import Categorical
+    from pinot_tpu.spi import Schema, TableConfig
+
+    cols = bench.gen_columns(rows)
+    fields = bench._ssb_fields(cols)
+    ctrl = Controller(os.path.join(tmp, "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=0.2)
+    servers = [ServerNode("server_0", ctrl.url, poll_interval=poll)]
+
+    def add_table(table, n_segments):
+        schema = Schema(table, fields)
+        builder = SegmentBuilder(schema, TableConfig(table))
+        ctrl.add_table(table, schema.to_dict(), replication=1)
+        step = rows // n_segments
+        for i in range(n_segments):
+            lo, hi = i * step, rows if i == n_segments - 1 \
+                else (i + 1) * step
+            part = {n: (Categorical(v.codes[lo:hi], v.values)
+                        if isinstance(v, Categorical) else v[lo:hi])
+                    for n, v in cols.items()}
+            d = builder.build(part, os.path.join(tmp, table), f"seg_{i}")
+            ctrl.add_segment(table, f"seg_{i}", d)
+
+    add_table("lineorder", 3)       # all on server_0 (the future donor)
+    v = ctrl.routing_snapshot()["version"]
+    assert servers[0].wait_for_version(v, timeout=30.0), \
+        "server_0 never synced"
+    servers.append(ServerNode("server_1", ctrl.url, poll_interval=poll))
+    add_table("lineorder_s", 2)     # least-loaded -> server_1
+    broker = BrokerNode(ctrl.url, routing_refresh=poll)
+    v = ctrl.routing_snapshot()["version"]
+    for s in servers:
+        assert s.wait_for_version(v, timeout=30.0), "server never synced"
+    assert broker.wait_for_version(v, timeout=30.0), "broker never synced"
+
+    def stop():
+        broker.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        ctrl.stop()
+
+    return ctrl, servers, broker, stop
+
+
+def main_rebalance(args) -> int:
+    """--rebalance: the closed-loop rebalance chaos gate (ISSUE 19):
+    a burn-triggered move under seeded ``rebalance.crash`` +
+    ``cutover.stall`` recovers byte-exact from the journal, same-seed
+    stall runs fire identical (point, site, hit) streams, an
+    incident-open pass plans ZERO moves, and the devmem/tier pools
+    reconcile to the byte after the donor drain."""
+    import time as _time
+
+    from pinot_tpu.cluster.http_util import http_json
+    from pinot_tpu.engine.tier import global_tier, reconcile_devmem
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils.slo import global_incidents, global_slo
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_rebalance_chaos_")
+    failures = []
+    summary = {"mode": "rebalance", "rows": args.rows,
+               "seed": args.seed, "queries": 0, "faults_fired": 0}
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+            print(f"FAIL {name}: {detail}")
+
+    faults.clear()
+    global_slo.clear()
+    global_incidents.reset()
+    global_tier.configure(budget_bytes=None)
+    from pinot_tpu.engine.batch import clear_stack_cache
+    from pinot_tpu.ops.plan_cache import global_cube_cache
+    clear_stack_cache()
+    global_cube_cache.clear()
+    ctrl, servers, broker, stop = build_rebalance_cluster(tmp, args.rows)
+    rb = ctrl.rebalancer
+    rb.budget_moves = 1     # one move per pass: each chaos phase is
+    rb.prewarm_timeout = 10.0  # exactly one cutover
+    # park the scheduled pass: every pass in this gate is a deliberate,
+    # manually-triggered chaos phase
+    ctrl.scheduler._next_run[rb.NAME] = _time.monotonic() + 1e9
+    try:
+        queries = smoke_queries(tuple(args.queries.split(",")))
+        summary["queries"] = len(queries)
+
+        def run_all(tag):
+            out = {}
+            for qid, sql in queries:
+                for table in ("lineorder", "lineorder_s"):
+                    q = sql.replace("FROM lineorder ", f"FROM {table} ")
+                    resp = http_json(
+                        "POST", f"{broker.url}/query/sql",
+                        {"sql": q + f" OPTION(timeoutMs=300000,"
+                                    f"queryId=rb.{tag}.{table}.{qid})"},
+                        timeout=120.0)
+                    out[(table, qid)] = digest(resp)
+            return out
+
+        def holders(table="lineorder"):
+            with ctrl._lock:
+                return {s: list(h) for s, h in
+                        ctrl._state["assignment"][table].items()}
+
+        baseline = run_all("base")
+        check("skew.initial",
+              all(h == ["server_0"] for h in holders().values()),
+              f"burn table not pinned to server_0: {holders()}")
+
+        # arm a latency objective the baseline traffic cannot meet:
+        # every query is a bad event, slow-window burn saturates, the
+        # burn-rate alert fires and the flight recorder captures an
+        # incident (round-22 plane, all through the real feed path)
+        global_slo.set_objective("lineorder", "latency", bar_ms=0.01,
+                                 objective=0.9)
+        run_all("burn")
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline and \
+                global_incidents.snapshot(limit=0)["count"] < 1:
+            _time.sleep(0.05)
+        check("incident.captured",
+              global_incidents.snapshot(limit=0)["count"] >= 1,
+              "burn alert never captured an incident")
+
+        # (a) incident-open pass: plans ZERO moves, placement untouched
+        ctrl.rollup.run()
+        before = holders()
+        res = rb.run()
+        check("freeze.zero_moves",
+              res["frozen"] and res["planned"] == 0,
+              f"incident-open pass was not frozen: {res}")
+        check("freeze.placement", holders() == before,
+              "placement changed under an open incident")
+
+        # (b) burn-triggered move under rebalance.crash: the pass dies
+        # in the cutover window AFTER the receiver pre-warmed, BEFORE
+        # the flip journal commit; the journal must carry the move
+        global_incidents.reset()
+        ctrl.rollup.run()
+        plan = faults.install(f"seed={args.seed}; rebalance.crash: "
+                              f"match=rebalance/lineorder/, times=1")
+        crashed = False
+        try:
+            rb.run()
+        except faults.FaultInjected:
+            crashed = True
+        summary["faults_fired"] += len(plan.fired)
+        faults.clear()
+        check("crash.raised", crashed, "rebalance.crash never fired")
+        journal = rb._load_journal()
+        check("crash.journal",
+              journal is not None and journal.get("phase") == "prewarm",
+              f"no prewarm journal after crash: {journal}")
+        moved = (journal or {}).get("move") or {}
+        seg = moved.get("segment")
+        check("crash.overreplicated",
+              sorted(holders().get(seg) or []) ==
+              ["server_0", "server_1"],
+              f"receiver not pre-warmed: {holders()}")
+
+        # (c) recovery: the next pass (same controller, or the new
+        # leader over the shared data dir) resumes the journaled move
+        # idempotently — exactly one final assignment, donor drained
+        res = rb.run()
+        check("recover.resumed", res["resumed"] == 1,
+              f"journaled move not resumed: {res}")
+        check("recover.journal_cleared", rb._load_journal() is None,
+              "journal left behind after recovery")
+        check("recover.flip", holders().get(seg) == ["server_1"],
+              f"resumed move did not converge: {holders()}")
+        v = ctrl.routing_snapshot()["version"]
+        check("recover.converged",
+              broker.wait_for_version(v, timeout=10.0)
+              and all(s.wait_for_version(v, timeout=10.0)
+                      for s in servers),
+              "cluster never converged on the flipped assignment")
+        # no orphaned receiver load: exactly one resident copy of the
+        # moved segment on the receiver, zero on the drained donor
+        have1 = {s.name for s in
+                 servers[1]._tables["lineorder"].acquire_segments()}
+        have0 = {s.name for s in
+                 servers[0]._tables["lineorder"].acquire_segments()}
+        check("recover.receiver_loaded", seg in have1,
+              f"receiver lost the segment: {sorted(have1)}")
+        check("recover.donor_unloaded", seg not in have0,
+              f"donor still holds the segment: {sorted(have0)}")
+        got = run_all("after")
+        for k in baseline:
+            check(f"digest.{k[0]}.{k[1]}", got[k] == baseline[k],
+                  "digest drift across the crash-recovered cutover")
+
+        # (d) cutover.stall: the pre-warm hangs past its deadline; the
+        # move aborts, the donor keeps serving, placement is unchanged
+        # — and the abort path is state-neutral, so two same-seed
+        # passes must fire IDENTICAL (point, site, hit) streams
+        stall_text = (f"seed={args.seed}; cutover.stall: "
+                      f"match=rebalance/lineorder/, delay_ms=30, "
+                      f"times=-1")
+        before = holders()
+
+        def stall_pass(tag):
+            plan = faults.install(stall_text)
+            try:
+                r = rb.run()
+            finally:
+                faults.clear()
+            return plan, r
+
+        plan_a, res_a = stall_pass("a")
+        summary["faults_fired"] += len(plan_a.fired)
+        check("stall.aborted",
+              res_a["planned"] >= 1
+              and res_a["aborted"] == res_a["planned"],
+              f"stalled pass did not abort every move: {res_a}")
+        check("stall.placement", holders() == before,
+              "aborted move changed placement")
+        plan_b, res_b = stall_pass("b")
+        summary["faults_fired"] += len(plan_b.fired)
+        check("stall.deterministic",
+              plan_a.fired_summary() == plan_b.fired_summary()
+              and len(plan_a.fired) >= 1,
+              f"{plan_a.fired_summary()} != {plan_b.fired_summary()}")
+        check("stall.placement2", holders() == before,
+              "second stalled pass changed placement")
+
+        # (e) pools reconcile to the byte after the drain (the gate's
+        # devmem subset — plan_cache_acc is suite-wide compile warmth)
+        segs = []
+        for s in servers:
+            for dm in s._tables.values():
+                segs.extend(dm.acquire_segments())
+        rec = reconcile_devmem(
+            segs, pools=("segment_cols", "stack_cache", "cube_cache",
+                         "cube_stacked"))
+        summary["reconcile"] = rec
+        for pool, r in rec.items():
+            check(f"reconcile.{pool}", r["tracked"] == r["actual"],
+                  f"tracked {r['tracked']} != actual {r['actual']}")
+        got = run_all("final")
+        for k in baseline:
+            check(f"digest.final.{k[0]}.{k[1]}",
+                  got[k] == baseline[k],
+                  "digest drift after the chaos sequence")
+        snap = rb.snapshot()
+        summary["rebalance"] = {k: snap[k] for k in
+                                ("passes", "executed", "aborted",
+                                 "resumed", "frozen_passes")}
+    finally:
+        faults.clear()
+        global_slo.clear()
+        global_incidents.reset()
+        stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
 VECTOR_ROWS = 4096
 VECTOR_DIM = 16
 VECTOR_LISTS = 16
@@ -1232,6 +1509,11 @@ def main(argv=None) -> int:
                          "VECTOR_SIMILARITY queries under rpc.drop + "
                          "tier.evict with identical top-k and a "
                          "reconciled vector devmem pool")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="run the closed-loop rebalance gate: "
+                         "burn-triggered move under rebalance.crash + "
+                         "cutover.stall recovers byte-exact, incident "
+                         "freeze honored, pools reconciled")
     ap.add_argument("--fused", action="store_true",
                     help="run the whole-plan mesh compilation gate: "
                          "fused == mailbox parity, device.overflow "
@@ -1253,6 +1535,7 @@ def main(argv=None) -> int:
             else OVERLOAD_ROWS if args.overload \
             else TIER_ROWS if args.tier \
             else VECTOR_ROWS if args.vector \
+            else REBALANCE_ROWS if args.rebalance \
             else FUSED_ROWS if args.fused else 4096
     if args.ingest:
         return main_ingest(args)
@@ -1264,6 +1547,8 @@ def main(argv=None) -> int:
         return main_tier(args)
     if args.vector:
         return main_vector(args)
+    if args.rebalance:
+        return main_rebalance(args)
     if args.fused:
         return main_fused(args)
 

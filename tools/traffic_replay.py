@@ -906,6 +906,400 @@ def run_gate(multiple: float = 4.0, seed: int = 20260805,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+# -- rebalance gate (ISSUE 19) ----------------------------------------------
+# The closed-loop rebalance replay: record a skewed mix, burn the hot
+# table's latency SLO with donor-only chaos, precompute the pure move
+# plan, let the rebalancer execute it, and verify the observed move
+# stream equals the plan byte-for-byte, digests never drift across the
+# cutover, the protected table's p99 stays inside its bar, and the burn
+# is measurably lower after convergence WITHOUT shifting to the
+# receiver (the donor-matched chaos stays armed the whole time — the
+# burn drops because placement moved, not because the fault cleared).
+
+REBALANCE_TABLES = (("rb_hot", 3), ("rb_prot", 2))
+REBALANCE_DELAY_MS = 40.0
+# hot-table SLO bar, self-calibrated between the pre-chaos p99 and the
+# injected +40ms: above noise, below the slowed donor
+REBALANCE_BAR_FACTOR = 1.25
+REBALANCE_BAR_FLOOR_MS = 15.0
+# burn windows sized like the overload gate's: the slow window outlives
+# the burn phase but drains within seconds of post-cutover good traffic
+REBALANCE_FAST_S = 1.0
+REBALANCE_SLOW_S = 6.0
+REBALANCE_DRAIN_TIMEOUT_S = 20.0
+
+
+def build_rebalance_cluster(tmp: str, rows: int = 2048,
+                            poll: float = 0.1):
+    """Controller + 2 servers + broker with engineered skew: ``rb_hot``
+    lands wholly on server_0 (added while it is the only live server),
+    ``rb_prot`` lands on server_1 (least-loaded placement after it
+    joins) — the donor/receiver geometry the closed loop must fix."""
+    from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.spi import TableConfig
+
+    ctrl = Controller(os.path.join(tmp, "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=0.2)
+    servers = [ServerNode("server_0", ctrl.url, poll_interval=poll)]
+    cols = _gen_columns(rows)
+
+    def add(table: str, n_segments: int) -> None:
+        schema = _schema(table)
+        builder = SegmentBuilder(schema, TableConfig(table))
+        ctrl.add_table(table, schema.to_dict(), replication=1)
+        step = rows // n_segments
+        for i in range(n_segments):
+            lo = i * step
+            hi = rows if i == n_segments - 1 else (i + 1) * step
+            d = builder.build({n: v[lo:hi] for n, v in cols.items()},
+                              os.path.join(tmp, table), f"seg_{i}")
+            ctrl.add_segment(table, f"seg_{i}", d)
+
+    add(*REBALANCE_TABLES[0])   # all on server_0 (the future donor)
+    v = ctrl.routing_snapshot()["version"]
+    assert servers[0].wait_for_version(v, timeout=30.0), \
+        "server_0 never synced"
+    servers.append(ServerNode("server_1", ctrl.url, poll_interval=poll))
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and len(ctrl.live_servers()) < 2:
+        time.sleep(0.05)
+    assert len(ctrl.live_servers()) >= 2, "server_1 never registered"
+    add(*REBALANCE_TABLES[1])   # least-loaded -> server_1
+    broker = BrokerNode(ctrl.url, routing_refresh=poll)
+    v = ctrl.routing_snapshot()["version"]
+    for s in servers:
+        assert s.wait_for_version(v, timeout=30.0), "server never synced"
+    assert broker.wait_for_version(v, timeout=30.0), "broker never synced"
+    # park the scheduled pass: every rebalance pass in this gate is a
+    # deliberate, manually-triggered phase
+    ctrl.scheduler._next_run[ctrl.rebalancer.NAME] = \
+        time.monotonic() + 1e9
+
+    def stop():
+        broker.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        ctrl.stop()
+
+    return ctrl, servers, broker, stop
+
+
+def build_rebalance_mix(seed: int, n_queries: int
+                        ) -> List[Dict[str, Any]]:
+    """The seeded (qid, table, sql) sequence — pure in (seed, n), hot
+    table weighted 2:1 so the burn signal dominates the mix."""
+    import numpy as np
+    rng = np.random.default_rng([seed, 1906])
+    weighted = ["rb_hot", "rb_hot", "rb_prot"]
+    out = []
+    for i in range(n_queries):
+        table = weighted[int(rng.integers(len(weighted)))]
+        shape = QUERY_SHAPES[int(rng.integers(len(QUERY_SHAPES)))]
+        sql = shape.format(t=table, p=int(rng.integers(100, 1000)))
+        out.append({"qid": f"rbm{seed}_{i}", "table": table,
+                    "sql": sql})
+    return out
+
+
+def _rb_phase(broker_url: str, mix: List[Dict[str, Any]], tag: str,
+              qps: float) -> Dict[str, Any]:
+    """Run the mix once, paced at ``qps``: per-table latencies + the
+    per-qid result digest (the drift detector across cutovers)."""
+    from pinot_tpu.cluster.http_util import http_json
+    lat: Dict[str, List[float]] = {}
+    digests: Dict[str, str] = {}
+    errors = 0
+    t_start = time.perf_counter()
+    for i, q in enumerate(mix):
+        target = t_start + i / qps
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        sql = (f"{q['sql']} OPTION(timeoutMs={OPTION_TIMEOUT_MS},"
+               f"queryId={tag}_{q['qid']})")
+        t0 = time.perf_counter()
+        try:
+            resp = http_json("POST", f"{broker_url}/query/sql",
+                             {"sql": sql}, timeout=120.0)
+        except Exception:  # noqa: BLE001 — counted, not raised
+            errors += 1
+            continue
+        lat.setdefault(q["table"], []).append(
+            (time.perf_counter() - t0) * 1e3)
+        digests[q["qid"]] = json.dumps(
+            (resp or {}).get("resultTable"), sort_keys=True)
+    return {"lat": {t: sorted(v) for t, v in lat.items()},
+            "digests": digests, "errors": errors,
+            "duration_s": time.perf_counter() - t_start}
+
+
+def run_rebalance_gate(seed: int = 20260807, n_queries: int = 24,
+                       rows: int = 2048, qps: float = 12.0,
+                       ledger_out: Optional[str] = None
+                       ) -> Dict[str, Any]:
+    """The closed-loop rebalance gate (section comment above). Returns
+    the summary dict; ``ok`` is the verdict."""
+    from pinot_tpu.cluster.rebalancer import plan_moves
+    from pinot_tpu.engine.tier import global_tier
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils import ledger as uledger
+    from pinot_tpu.utils.metrics import global_metrics
+    from pinot_tpu.utils.slo import global_incidents, global_slo
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_rebalance_")
+    failures: List[str] = []
+    summary: Dict[str, Any] = {
+        "scenario": "rebalance_replay", "seed": seed, "multiple": 1.0,
+        "queries_recorded": n_queries, "mode": "cluster"}
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        if not ok:
+            failures.append(f"{name}: {detail}")
+
+    faults.clear()
+    global_slo.clear()
+    global_incidents.reset()
+    global_tier.configure(budget_bytes=None)
+    stop = None
+    try:
+        ctrl, servers, broker, stop = build_rebalance_cluster(tmp, rows)
+        rb = ctrl.rebalancer
+        rb.budget_moves = 8          # one pass moves every hot segment
+        rb.budget_bytes = 1 << 30
+        rb.prewarm_timeout = 15.0
+        mix = build_rebalance_mix(seed, n_queries)
+
+        def holders() -> Dict[str, List[str]]:
+            with ctrl._lock:
+                return {s: list(h) for s, h in
+                        ctrl._state["assignment"]["rb_hot"].items()}
+
+        check("skew.initial",
+              all(h == ["server_0"] for h in holders().values()),
+              f"hot table not pinned to the donor: {holders()}")
+
+        # warmup: every (table, shape) pays its XLA compile off-phase
+        seen = set()
+        for q in mix:
+            key = (q["table"], q["sql"].split("FROM")[0])
+            if key in seen:
+                continue
+            seen.add(key)
+            _rb_phase(broker.url, [q], f"warm{len(seen)}", qps=1e9)
+
+        # 1) record at 1x: the latency baseline + the digest corpus
+        base = _rb_phase(broker.url, mix, "base", qps)
+        check("record.errors", base["errors"] == 0,
+              f"{base['errors']} errors during the 1x recording")
+        hot_bar = (_pctl(base["lat"].get("rb_hot") or [0.0], 0.99)
+                   * REBALANCE_BAR_FACTOR + REBALANCE_BAR_FLOOR_MS)
+        prot_bar = (_pctl(base["lat"].get("rb_prot") or [0.0], 0.99)
+                    * PROTECTED_BAR_FACTOR + PROTECTED_BAR_FLOOR_MS)
+        check("record.bar_below_delay",
+              hot_bar < _pctl(base["lat"].get("rb_hot") or [0.0], 0.5)
+              + REBALANCE_DELAY_MS,
+              f"bar {hot_bar:.1f}ms cannot separate the slowed donor")
+
+        # 2) burn: donor-only chaos stays armed from here to the END —
+        # the later burn drop must come from the cutover, not disarming
+        global_slo.set_objective("rb_hot", "latency", bar_ms=hot_bar,
+                                 objective=0.9,
+                                 fast_s=REBALANCE_FAST_S,
+                                 slow_s=REBALANCE_SLOW_S)
+        global_slo.set_objective("rb_prot", "latency", bar_ms=prot_bar,
+                                 objective=0.9,
+                                 fast_s=REBALANCE_FAST_S,
+                                 slow_s=REBALANCE_SLOW_S)
+        fault_plan = faults.install(
+            f"seed={seed}; segment.slow: match=server_0, "
+            f"delay_ms={REBALANCE_DELAY_MS:.0f}, times=-1")
+        burn = _rb_phase(broker.url, mix, "burn", qps)
+        for qid, d in burn["digests"].items():
+            check(f"digest.burn.{qid}", d == base["digests"].get(qid),
+                  "digest drift under donor chaos")
+        prot_burn = burn["lat"].get("rb_prot") or []
+        check("burn.protected_p99",
+              prot_burn and _pctl(prot_burn, 0.99) <= prot_bar,
+              f"protected p99 {_pctl(prot_burn, 0.99):.1f}ms > bar "
+              f"{prot_bar:.1f}ms during the burn")
+
+        def _burn(scope: str) -> Dict[str, Any]:
+            return next(
+                (r for r in global_slo.status_block()["objectives"]
+                 if r["scope"] == scope and r["kind"] == "latency"),
+                {"burn_slow": 0.0, "burn_fast": 0.0, "alerting": False})
+
+        burn_before = _burn("rb_hot")["burn_slow"]
+        check("burn.ignited",
+              burn_before >= rb.burn_threshold,
+              f"hot-table burn {burn_before:.2f} never crossed "
+              f"{rb.burn_threshold}")
+        # the burn alert captured an incident; acknowledge it (the
+        # freeze lever belongs to chaos_smoke --rebalance) and roll up
+        global_incidents.reset()
+        ctrl.rollup.run()
+        rollup = (ctrl.rollup.snapshot() or {}).get("rollup")
+
+        # 3) the pure plan, computed twice — must match itself, and the
+        # executed move stream must match it byte-for-byte
+        inputs = rb._plan_inputs()
+        kw = dict(budget=rb._budget(), instances=inputs["instances"],
+                  sizes=inputs["sizes"], recent=frozenset(),
+                  threshold=rb.burn_threshold)
+        expected = plan_moves(rollup, inputs["assignment"], **kw)
+        expected2 = plan_moves(rollup, inputs["assignment"], **kw)
+        proj = ("table", "segment", "donor", "receiver", "bytes",
+                "reason")
+        as_bytes = lambda moves: json.dumps(  # noqa: E731
+            [{k: m[k] for k in proj} for m in moves], sort_keys=True)
+        check("plan.deterministic",
+              as_bytes(expected) == as_bytes(expected2),
+              "two same-input plans diverged")
+        check("plan.moves", len(expected) == REBALANCE_TABLES[0][1],
+              f"planned {len(expected)} of {REBALANCE_TABLES[0][1]} "
+              f"hot segments: {expected}")
+        check("plan.geometry",
+              all(m["donor"] == "server_0"
+                  and m["receiver"] == "server_1" for m in expected),
+              f"plan left the donor/receiver geometry: {expected}")
+
+        ring_before = len(rb.snapshot()["moves"])
+        res = rb.run()
+        check("cutover.executed",
+              not res["frozen"] and res["planned"] == len(expected)
+              and res["executed"] == len(expected),
+              f"pass did not execute the plan: {res}")
+        events = rb.snapshot()["moves"][ring_before:]
+        observed = [{k: e[k] for k in proj} for e in events
+                    if e["phase"] == "plan"]
+        check("cutover.stream_matches_plan",
+              json.dumps(observed, sort_keys=True) == as_bytes(expected),
+              f"observed move stream != plan "
+              f"({len(observed)} vs {len(expected)} moves)")
+        flipped = sorted(e["segment"] for e in events
+                         if e["phase"] == "flip")
+        check("cutover.flips",
+              flipped == sorted(m["segment"] for m in expected),
+              f"flips {flipped} != plan")
+        v = ctrl.routing_snapshot()["version"]
+        check("cutover.converged",
+              broker.wait_for_version(v, timeout=15.0)
+              and all(s.wait_for_version(v, timeout=15.0)
+                      for s in servers),
+              "cluster never converged on the flipped assignment")
+        check("cutover.placement",
+              all(h == ["server_1"] for h in holders().values()),
+              f"hot table not on the receiver: {holders()}")
+
+        # 4) after: chaos STILL armed on the donor; queries now route
+        # to the receiver, so latency recovers and the burn drains
+        c0 = global_metrics.snapshot()["counters"]
+        after = _rb_phase(broker.url, mix, "after", qps)
+        for qid, d in after["digests"].items():
+            check(f"digest.after.{qid}", d == base["digests"].get(qid),
+                  "digest drift across the cutover")
+        hot_after = after["lat"].get("rb_hot") or []
+        check("after.hot_inside_bar",
+              hot_after and _pctl(hot_after, 0.99) <= hot_bar,
+              f"hot p99 {_pctl(hot_after, 0.99):.1f}ms still over the "
+              f"bar {hot_bar:.1f}ms after the cutover")
+        prot_after = after["lat"].get("rb_prot") or []
+        check("after.protected_p99",
+              prot_after and _pctl(prot_after, 0.99) <= prot_bar,
+              f"protected p99 {_pctl(prot_after, 0.99):.1f}ms > bar "
+              f"{prot_bar:.1f}ms after the cutover")
+        # the receiver's first touch per drained segment re-promotes
+        # from WARM arrays (no cold re-pad): bounded, then zero
+        c1 = global_metrics.snapshot()["counters"]
+        promo_after = (c1.get("tier_promotions", 0)
+                       - c0.get("tier_promotions", 0))
+        check("after.promotions_bounded",
+              promo_after <= len(expected),
+              f"{promo_after} promotions for {len(expected)} drained "
+              "segments — cold re-pads?")
+        settle = _rb_phase(broker.url, mix, "settle", qps)
+        for qid, d in settle["digests"].items():
+            check(f"digest.settle.{qid}", d == base["digests"].get(qid),
+                  "digest drift at steady state")
+        c2 = global_metrics.snapshot()["counters"]
+        promo_settle = (c2.get("tier_promotions", 0)
+                        - c1.get("tier_promotions", 0))
+        check("settle.no_rewarm", promo_settle == 0,
+              f"{promo_settle} promotions at steady state — the "
+              "pre-warm did not pay the receiver's warmup debt")
+
+        # 5) burn convergence: measurably lower on the hot table, NOT
+        # shifted to the receiver's protected table
+        deadline = time.monotonic() + REBALANCE_DRAIN_TIMEOUT_S
+        hot = _burn("rb_hot")
+        while time.monotonic() < deadline and \
+                (hot["burn_fast"] > 0.0
+                 or hot["burn_slow"] >= burn_before * 0.5):
+            time.sleep(0.25)
+            hot = _burn("rb_hot")
+        check("converge.burn_lower",
+              hot["burn_slow"] < burn_before * 0.5
+              and hot["burn_fast"] == 0.0,
+              f"burn {hot['burn_slow']:.2f} (was {burn_before:.2f}) "
+              "never drained after the cutover")
+        prot = _burn("rb_prot")
+        check("converge.not_shifted",
+              prot["burn_slow"] < rb.burn_threshold
+              and not prot["alerting"],
+              f"burn shifted to the receiver: {prot}")
+
+        summary.update({
+            "backend": _backend(),
+            "offered": 4 * n_queries,
+            "completed": 4 * n_queries
+            - sum(p["errors"] for p in (base, burn, after, settle)),
+            "shed": 0,
+            "goodput_qps": round(
+                len(after["digests"])
+                / max(after["duration_s"], 1e-3), 3),
+            "duration_s": round(base["duration_s"] + burn["duration_s"]
+                                + after["duration_s"]
+                                + settle["duration_s"], 3),
+            "faults_fired": len(fault_plan.fired),
+            "chaos": True,
+            "deterministic": as_bytes(expected) == as_bytes(expected2),
+            "extra": {"rebalance": {
+                "moves_planned": len(expected),
+                "moves_executed": res["executed"],
+                "burn_before": round(burn_before, 3),
+                "burn_after": round(hot["burn_slow"], 3),
+                "receiver_burn": round(prot["burn_slow"], 3),
+                "hot_bar_ms": round(hot_bar, 3),
+                "promotions_after": promo_after,
+                "promotions_settle": promo_settle,
+            }},
+            "ok": not failures,
+        })
+        if failures:
+            summary["error"] = "; ".join(failures[:4])
+        if ledger_out:
+            contract = uledger.KINDS["replay_bench"]
+            allowed = contract["required"] | contract["optional"]
+            rec = uledger.make_record("replay_bench", **{
+                k: v for k, v in summary.items() if k in allowed})
+            uledger.append_record(rec, ledger_out)
+        summary["failures"] = failures
+        return summary
+    finally:
+        faults.clear()
+        global_slo.clear()
+        global_slo.path = None
+        global_incidents.reset()
+        global_incidents.path = None
+        if stop is not None:
+            stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _backend() -> str:
     try:
         import jax
@@ -934,7 +1328,25 @@ def main(argv=None) -> int:
     p.add_argument("stats", help="query_stats JSONL path")
     p.add_argument("--multiple", type=float, default=4.0)
     p.add_argument("--seed", type=int, default=20260805)
+    r = sub.add_parser("rebalance",
+                       help="closed-loop rebalance gate (ISSUE 19)")
+    r.add_argument("--seed", type=int, default=20260807)
+    r.add_argument("--queries", type=int, default=24)
+    r.add_argument("--rows", type=int, default=2048)
+    r.add_argument("--qps", type=float, default=12.0)
+    r.add_argument("--ledger", default=None,
+                   help="append the replay_bench record here")
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["--rebalance"]:  # flag spelling of the subcommand
+        argv[0] = "rebalance"
     args = ap.parse_args(argv)
+    if args.cmd == "rebalance":
+        summary = run_rebalance_gate(seed=args.seed,
+                                     n_queries=args.queries,
+                                     rows=args.rows, qps=args.qps,
+                                     ledger_out=args.ledger)
+        print(json.dumps(summary))
+        return 0 if summary.get("ok") else 1
     if args.cmd == "plan":
         records = load_records(args.stats)
         plan = plan_replay(records, args.multiple, args.seed)
