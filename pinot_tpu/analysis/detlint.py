@@ -156,6 +156,23 @@ ROOTS: Tuple[Tuple[str, str], ...] = (
     ("pinot_tpu/cluster/rebalancer.py", "burning_tables"),
     ("pinot_tpu/cluster/rebalancer.py", "receiver_affinity"),
     ("pinot_tpu/cluster/rebalancer.py", "churn_capped"),
+    # incident autopsy plane (round 25): corpus loading, the window
+    # assembler, every cause scorer and both verdict planners — the
+    # byte-replayable attribution surface (traffic_replay --autopsy
+    # computes each verdict twice and compares bytes). Ledger/ring
+    # impurity stays in AutopsyPlane, outside the registry.
+    ("pinot_tpu/cluster/autopsy.py", "load_corpus"),
+    ("pinot_tpu/cluster/autopsy.py", "assemble_window"),
+    ("pinot_tpu/cluster/autopsy.py", "score_compile_storm"),
+    ("pinot_tpu/cluster/autopsy.py", "score_tier_thrash"),
+    ("pinot_tpu/cluster/autopsy.py", "score_overload_shed"),
+    ("pinot_tpu/cluster/autopsy.py", "score_rebalance_churn"),
+    ("pinot_tpu/cluster/autopsy.py", "score_chaos_faults"),
+    ("pinot_tpu/cluster/autopsy.py", "score_straggler"),
+    ("pinot_tpu/cluster/autopsy.py", "score_drift_recompile"),
+    ("pinot_tpu/cluster/autopsy.py", "score_ingest_stall"),
+    ("pinot_tpu/cluster/autopsy.py", "plan_autopsy"),
+    ("pinot_tpu/cluster/autopsy.py", "whydown"),
 )
 
 # tools/ modules named by the registry ride along with the package walk

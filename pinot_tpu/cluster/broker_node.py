@@ -30,6 +30,7 @@ from ..utils import phases as ph
 from ..utils.metrics import global_metrics, ingest_health
 from ..utils.spans import Span, sample_decision, span, span_tracer
 from ..utils.slo import SLOWQ_TAIL, global_incidents, global_slo
+from .autopsy import global_autopsy, load_corpus, whydown
 from .forensics import (QueryForensics, debug_index,
                         ledger_debug_payload, memory_debug_payload,
                         parse_since, parse_slow_query_ms,
@@ -226,6 +227,14 @@ class BrokerNode:
                 global_slo.path = self.forensics.ledger_path
             if global_incidents.path is None:
                 global_incidents.path = self.forensics.ledger_path
+            # incident autopsy plane (round 25): verdicts land in the
+            # SAME ledger, and attribution runs automatically after
+            # each incident capture — on the recorder's background
+            # thread, fenced, never on the query path
+            if global_autopsy.path is None:
+                global_autopsy.path = self.forensics.ledger_path
+            if global_incidents.post_hook is None:
+                global_incidents.post_hook = global_autopsy.on_incident
         global_incidents.register_surface(
             "slow_queries",
             lambda: self.forensics.snapshot(SLOWQ_TAIL)["queries"])
@@ -627,6 +636,16 @@ class BrokerNode:
         result.time_ms = (time.perf_counter() - t0) * 1e3
         self.forensics.record(qid, table, sql, t0, result, scatters,
                               slow_ms, trace=root, traced=True)
+        # whydown lane (round 25): OPTION(whydown=true) annotates the
+        # analyze trace with the cross-plane events overlapping this
+        # query's wall window. AFTER forensics.record, so the query's
+        # own stats line anchors the ledger-position overlap
+        from ..query.planner import _truthy
+        options = getattr(stmt, "options", {}) or {}
+        if _truthy(options.get("whydown", False)) and \
+                self.forensics.ledger_path:
+            trace["whydown"] = whydown(
+                load_corpus(self.forensics.ledger_path), qid=qid)
         return result
 
     @staticmethod
@@ -1386,6 +1405,21 @@ class BrokerNode:
             # newest first (utils/slo.py IncidentRecorder)
             return 200, global_incidents.snapshot(_limit(h.path))
 
+        def debug_autopsy(h, b):
+            # GET /debug/autopsy[?n=K]: verdict ring, newest first;
+            # ?run=1 computes a fresh verdict synchronously over the
+            # node ledger; ?qid=<id> runs the per-query whydown lane
+            from urllib.parse import parse_qs, urlparse
+            params = parse_qs(urlparse(h.path).query)
+            qid = (params.get("qid") or [None])[0]
+            if qid:
+                return 200, whydown(
+                    load_corpus(node.forensics.ledger_path), qid=qid)
+            if (params.get("run") or [None])[0]:
+                return 200, global_autopsy.run(
+                    ledger_path=node.forensics.ledger_path)
+            return 200, global_autopsy.snapshot(_limit(h.path))
+
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/health"): lambda h, b: (200, {"status": "OK"}),
@@ -1415,6 +1449,7 @@ class BrokerNode:
                                             "/debug/compile",
                                             "/debug/slo"))),
                 ("GET", "/debug/incidents"): debug_incidents,
+                ("GET", "/debug/autopsy"): debug_autopsy,
                 ("GET", "/debug/slo"): lambda h, b: (
                     200, global_slo.status_block()),
                 ("GET", "/ui"): lambda h, b: (
@@ -1456,7 +1491,8 @@ class BrokerNode:
 <a href=/debug/memory>memory</a> &middot;
 <a href=/debug/ledger>ledger</a> &middot;
 <a href=/debug/slo>slo</a> &middot;
-<a href=/debug/incidents>incidents</a></div>
+<a href=/debug/incidents>incidents</a> &middot;
+<a href=/debug/autopsy>autopsy</a></div>
 <textarea id=sql>SELECT * FROM mytable LIMIT 10</textarea><br>
 <button onclick=run()>Run (Ctrl-Enter)</button>
 <div id=stats></div><div id=warn></div><div id=err></div><div id=out></div>

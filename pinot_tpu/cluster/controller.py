@@ -971,6 +971,21 @@ class Controller:
             from ..utils.slo import global_incidents
             return global_incidents.snapshot()
 
+        def _autopsy(h):
+            # on-demand fleet autopsy (round 25): the controller keeps
+            # no verdict ring of its own — it plans over the rollup's
+            # fleet ledger, where the brokers' rca_verdict records and
+            # all cross-plane evidence already land. ?qid= runs the
+            # per-query whydown lane instead.
+            from urllib.parse import parse_qs, urlparse
+            from .autopsy import load_corpus, plan_autopsy, whydown
+            params = parse_qs(urlparse(h.path).query)
+            corpus = load_corpus(ctrl.rollup.ledger_path)
+            qid = (params.get("qid") or [None])[0]
+            if qid:
+                return whydown(corpus, qid=qid)
+            return plan_autopsy(corpus)
+
         class Handler(JsonHandler):
             routes = {
                 ("GET", "/ui"): lambda h, b: (
@@ -1040,6 +1055,10 @@ class Controller:
                     200, _debug_index(ctrl)),
                 ("GET", "/debug/incidents"): lambda h, b: (
                     200, _incidents()),
+                # incident autopsy plane (round 25): fleet-wide
+                # root-cause verdict on demand (cluster/autopsy.py)
+                ("GET", "/debug/autopsy"): lambda h, b: (
+                    200, _autopsy(h)),
                 # closed-loop rebalance audit ring (round 24)
                 ("GET", "/debug/rebalance"): lambda h, b: (
                     200, ctrl.rebalancer.snapshot()),

@@ -349,6 +349,12 @@ def ledger_debug_payload(node_id: str, role: str, path: Optional[str],
 # advertises its own set — it serves /debug/fleet, not node ledgers)
 DEBUG_SURFACES = ("/debug/ledger", "/debug/memory", "/debug/incidents")
 
+# roles that serve the incident autopsy plane (cluster/autopsy.py):
+# the broker runs it over its node ledger, the controller over the
+# fleet ledger — servers have no attribution surface, so advertising
+# it there would be a lie the index exists to prevent
+AUTOPSY_ROLES = ("broker", "controller")
+
 
 def debug_index(node_id: str, role: str,
                 extra: Tuple[str, ...] = (),
@@ -357,10 +363,15 @@ def debug_index(node_id: str, role: str,
     """GET /debug payload — the index of every debug surface THIS node
     actually serves (truthful per role), so an operator landing on any
     role can enumerate the forensics endpoints instead of memorizing
-    them. ``surfaces`` overrides the data-plane default set."""
-    base = DEBUG_SURFACES if surfaces is None else surfaces
+    them. ``surfaces`` overrides the data-plane default set.
+    ``/debug/autopsy`` is appended here, once, per AUTOPSY_ROLES — one
+    source of truth instead of each role's extras drifting."""
+    base = tuple(DEBUG_SURFACES if surfaces is None else surfaces)
+    out = base + tuple(extra)
+    if role in AUTOPSY_ROLES and "/debug/autopsy" not in out:
+        out = out + ("/debug/autopsy",)
     return {"node": node_id, "role": role, "proc": PROC_TOKEN,
-            "surfaces": sorted(tuple(base) + tuple(extra))}
+            "surfaces": sorted(out)}
 
 
 def memory_debug_payload(node_id: str,

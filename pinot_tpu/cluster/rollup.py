@@ -337,6 +337,42 @@ def fleet_totals(node_blocks: Dict[str, Dict[str, Any]]
     return totals
 
 
+VERDICT_TOP = 5
+
+
+def latest_verdicts(records: List[Dict[str, Any]],
+                    top: int = VERDICT_TOP) -> List[Dict[str, Any]]:
+    """The newest ``rca_verdict`` briefs in the pulled corpus (round
+    25, webapp Autopsy panel): (proc, seq)-deduped like the plan-shape
+    ranking (two in-process roles shipping one shared ledger must not
+    double-count), newest last in ledger order so the panel's top row
+    is the freshest verdict. Pure record->list math, exported for the
+    oracle tests."""
+    seen: set = set()
+    rows: List[Dict[str, Any]] = []
+    for rec in records:
+        if rec.get("kind") != "rca_verdict":
+            continue
+        uid = (rec.get("proc"), rec.get("seq"))
+        if uid in seen:
+            continue
+        seen.add(uid)
+        causes = rec.get("causes") or []
+        rows.append({
+            "node": rec.get("node"), "proc": rec.get("proc"),
+            "seq": rec.get("seq"), "ts": rec.get("ts"),
+            "incident_ref": rec.get("incident_ref"),
+            "top_cause": rec.get("top_cause"),
+            "inconclusive": bool(rec.get("inconclusive")),
+            "top_score": (causes[0].get("score")
+                          if causes and isinstance(causes[0], dict)
+                          else None),
+            "detail": (causes[0].get("detail")
+                       if causes and isinstance(causes[0], dict)
+                       else None)})
+    return rows[-max(top, 0):][::-1]
+
+
 def _node_slo_brief(slo: Dict[str, Any]) -> Dict[str, Any]:
     """One node's SLO block compressed to the rebalancer's donor
     signal: worst slow-window burn across its objectives + whether any
@@ -513,6 +549,8 @@ class ForensicsRollupTask:
             "fleet": fleet_totals(node_blocks),
             # worst-replica fleet SLO view + open incident count
             "slo": aggregate_slo(node_blocks),
+            # newest root-cause verdicts (round 25, Autopsy panel)
+            "autopsy": latest_verdicts(fleet_records),
         }
         if self._total_records > len(fleet_records):
             # older records aged out of the window: say so instead of

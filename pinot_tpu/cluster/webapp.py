@@ -253,8 +253,25 @@ function vFleet() {
     ${rb.aborted || 0} · resumed ${rb.resumed || 0} · frozen
     ${rb.frozen_passes || 0}${rb.pending ? " · MOVE PENDING" : ""}
     )</span></h3>`;
+  // incident autopsy verdicts (round 25, fleet_rollup.autopsy —
+  // newest rca_verdict briefs in the pulled corpus; on-demand
+  // fleet-wide attribution at GET /debug/autopsy)
+  const rcaTbl = (r.autopsy || []).length ? table(
+    ["ts", "node", "incident", "verdict", "score", "detail"],
+    r.autopsy.map(v => [esc(v.ts || ""), esc(v.node || ""),
+      esc(v.incident_ref || "—"),
+      v.inconclusive ? '<span class="mut">inconclusive</span>'
+                     : esc(v.top_cause || ""),
+      v.top_score != null ? v.top_score : "",
+      esc(v.detail || "")]))
+    : `<p class="mut">no verdicts yet — attribution runs
+      automatically when an incident fires (cluster/autopsy.py), or
+      on demand at <a href="/debug/autopsy">/debug/autopsy</a></p>`;
+  const rcaHead = `<h3>Autopsy <span class="mut">(root-cause
+    verdicts, newest first)</span></h3>`;
   return `<h2>Fleet forensics</h2>${pull}
     ${sloHead}${sloTbl}
+    ${rcaHead}${rcaTbl}
     ${moveHead}${moveTbl}
     <h3>Per-table fleet stats</h3>${tbl}
     <h3>Slowest queries</h3>${slow}

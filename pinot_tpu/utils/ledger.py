@@ -84,6 +84,16 @@ Kinds:
   and ``planned`` (False for freeze passes and other non-move
   bookkeeping). Mirrored into the controller's bounded ring at
   GET /debug/rebalance and the webapp Fleet "moves" panel.
+- ``rca_verdict``      — cluster/autopsy.py incident autopsy plane:
+  one deterministic root-cause attribution over an incident window —
+  the FULL ranked cause taxonomy (compile storm, tier thrash,
+  overload shed, rebalance churn, chaos faults, straggler, drift
+  recompile, ingest stall), each cause carrying matched-evidence
+  ``[node, proc, seq]`` ledger pointers and an excess-attribution
+  fraction, plus an explicit ``inconclusive`` flag when no cause
+  clears the confidence floor. Attached to the firing incident's
+  ring entry, served at GET /debug/autopsy, replay-gated by
+  tools/traffic_replay.py --autopsy.
 
 Fleet provenance: the controller's rollup puller stamps every record it
 ships into the fleet ledger with ``node`` (the source instance id) so
@@ -292,10 +302,13 @@ KINDS: Dict[str, Dict[str, set]] = {
         # ``slo``: the worst-replica fleet SLO view (ISSUE 17) —
         # per-(scope, kind) max burn / min budget remaining across
         # proc-deduped node blocks + the open incident count
+        # ``autopsy``: the newest rca_verdict briefs in the pulled
+        # corpus, (proc, seq)-deduped (round 25 — webapp Autopsy panel)
         "optional": {"skipped_nodes", "invalid_records", "heat",
                      "slow_queries", "nodes", "fleet", "ingest",
                      "backend", "cursors", "fleet_records",
-                     "window_clipped", "plan_shapes", "slo"},
+                     "window_clipped", "plan_shapes", "slo",
+                     "autopsy"},
     },
     "compile_event": {
         # one XLA compile (utils/compileplane.StagedFn): ``plan_shape``
@@ -345,10 +358,15 @@ KINDS: Dict[str, Dict[str, set]] = {
         # a broken surface is recorded as its error string, never a
         # lost bundle); (``proc``, ``seq``) is the incident identity
         # for fleet dedup, ``alert`` the firing alert's name.
+        # ``rca``: the autopsy verdict ref the recorder stamps onto
+        # the ring entry post-attribution (round 25 —
+        # {proc, seq, top_cause, inconclusive} pointing at the
+        # rca_verdict record), so a re-validated ring snapshot stays
+        # contract-clean.
         "required": {"incident_id", "alert", "severity", "proc",
                      "surfaces"},
         "optional": {"detail", "scope", "slo", "seq", "backend",
-                     "extra"},
+                     "rca", "extra"},
     },
     "rebalance_event": {
         # one closed-loop rebalance phase (cluster/rebalancer.py —
@@ -363,6 +381,23 @@ KINDS: Dict[str, Dict[str, set]] = {
                      "reason", "bytes", "planned"},
         "optional": {"version", "seed", "backend", "proc", "seq",
                      "extra"},
+    },
+    "rca_verdict": {
+        # one incident autopsy (cluster/autopsy.py): ``incident_ref``
+        # the incident_id the verdict attaches to ("" for on-demand
+        # runs); ``window`` the assembled incident window (t0/t1 on
+        # the broker's event-time clock + stats/baseline counts,
+        # baseline p50 and the excess the fractions divide by);
+        # ``causes`` the FULL ranked taxonomy — every family scored,
+        # each row {cause, score, evidence: [[node, proc, seq]...],
+        # detail}; ``top_cause`` empty iff ``inconclusive`` (an
+        # explicit non-answer, never a confabulated cause);
+        # (``proc``, ``seq``) identify the verdict for fleet dedup
+        # and the incident-ring rca ref.
+        "required": {"incident_ref", "window", "causes", "top_cause",
+                     "inconclusive", "proc"},
+        "optional": {"seq", "ledger", "evidence_total", "backend",
+                     "detail", "extra"},
     },
 }
 

@@ -504,6 +504,14 @@ class IncidentRecorder:
         self._surfaces: Dict[str, Callable[[], Any]] = {}
         self.proc = proc_token or PROC_TOKEN
         self.path: Optional[str] = None  # guarded-by: none — config
+        # post-snapshot hook (config-time wiring): called with the
+        # captured incident record AFTER the bundle lands — the
+        # autopsy plane attaches here (cluster/autopsy.py, which
+        # utils/ cannot import). Runs on the capture thread, fenced:
+        # a broken hook can never lose the bundle or take the
+        # recorder down.
+        self.post_hook: Optional[
+            Callable[[Dict[str, Any]], Any]] = None  # guarded-by: none
         self._seq = 0
         self.captured = 0
 
@@ -597,7 +605,27 @@ class IncidentRecorder:
             self._ring.append(rec)
             self.captured += 1
         global_metrics.count("incidents_captured")
+        hook = self.post_hook
+        if hook is not None:
+            try:
+                hook(rec)
+            except Exception:
+                global_metrics.count("incident_post_hook_errors")
         return rec
+
+    def attach_verdict(self, incident_id: str,
+                       ref: Dict[str, Any]) -> bool:
+        """Stamp an autopsy verdict ref onto the named incident's ring
+        entry (``rca``: proc/seq/top_cause/inconclusive), so
+        GET /debug/incidents answers "what burned AND why" without a
+        second lookup. Returns False when the incident already rolled
+        off the ring."""
+        with self._lock:
+            for entry in self._ring:
+                if entry.get("incident_id") == incident_id:
+                    entry["rca"] = ref
+                    return True
+        return False
 
     def _providers(self) -> List[Tuple[str, Callable[[], Any]]]:
         """The bounded default surfaces + registered extras. Defaults

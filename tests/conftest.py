@@ -87,3 +87,11 @@ def _reset_telemetry_registries():
     global_slo.path = None
     global_incidents.reset()
     global_incidents.path = None
+    # autopsy plane (round 25): brokers wire the recorder's post hook
+    # to the process-global verdict ring and point it at their (tmp)
+    # ledger — un-wire both so a later test's incident can't run
+    # attribution against a deleted path
+    from pinot_tpu.cluster.autopsy import global_autopsy
+    global_incidents.post_hook = None
+    global_autopsy.reset()
+    global_autopsy.path = None

@@ -1,0 +1,376 @@
+"""Incident autopsy plane (round 25): deterministic cross-plane
+root-cause attribution with a replay-gated verdict
+(cluster/autopsy.py).
+
+Contract under test:
+- each cause-family scorer is a pure oracle over hand-built corpora —
+  the expected fractions are computed independently here, never read
+  back from the implementation;
+- the compile trigger taxonomy splits attribution (eviction rebuilds
+  -> tier thrash, drift retraces -> drift, the rest -> storm) and
+  straggler skew is discounted by in-window compile time;
+- ``plan_autopsy`` is byte-replayable (same corpus -> byte-identical
+  verdict), ranks by (-score, cause) with alphabetical tie-breaks, and
+  answers an EXPLICIT ``inconclusive`` below ``MIN_SCORE`` rather than
+  confabulating a top cause;
+- every evidence pointer a verdict over a real ledger carries resolves
+  back to its line through ``forensics.read_ledger_since``;
+- the ``whydown`` per-query lane windows by the query's own wall
+  interval and ships the cross-plane events between the touched
+  queries' ledger positions;
+- the live ``AutopsyPlane`` lands a contract-valid ``rca_verdict`` in
+  the ledger, keeps the /debug/autopsy ring, and stamps the ``rca``
+  ref back onto the originating incident's ring entry;
+- the whole attribution surface is pinned in the detlint ROOTS
+  registry, and both CLI gates (``traffic_replay --autopsy``,
+  ``chaos_smoke --autopsy``) stay green end to end.
+"""
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from pinot_tpu.cluster.autopsy import (  # noqa: E402
+    CAUSES, MIN_SCORE, global_autopsy, load_corpus, plan_autopsy,
+    whydown)
+from pinot_tpu.cluster.forensics import read_ledger_since  # noqa: E402
+from pinot_tpu.utils import ledger as uledger  # noqa: E402
+
+WINDOW = (10.0, None)   # event-time seconds; baselines sit below 10s
+
+
+def _stat(qid, arrival_ms, wall_ms, **kw):
+    return {"kind": "query_stats", "qid": qid, "table": "t",
+            "arrival_ms": arrival_ms, "wall_ms": wall_ms, **kw}
+
+
+def _compile(trigger, compile_ms, lower_ms=0.0):
+    return {"kind": "compile_event", "trigger": trigger,
+            "compile_ms": compile_ms, "lower_ms": lower_ms}
+
+
+def _baseline(wall_ms=10.0, n=4):
+    # completions at ~0.01..3.01s — all below the 10s window start
+    return [_stat(f"b{i}", i * 1000.0, wall_ms) for i in range(n)]
+
+
+def _trace(qid, spans):
+    return {"kind": "query_trace", "qid": qid,
+            "root": {"name": "broker_query", "ms": 0.0, "children": [
+                {"name": "scatter_call", "ms": ms,
+                 "attrs": {"server": srv}}
+                for srv, ms in sorted(spans.items())]}}
+
+
+def _score(verdict, cause):
+    return next(c for c in verdict["causes"] if c["cause"] == cause)
+
+
+# ---------------------------------------------------------------------------
+# per-cause oracles (independently computed fractions)
+# ---------------------------------------------------------------------------
+
+def test_clean_corpus_is_explicitly_inconclusive():
+    recs = _baseline() + [_stat("w0", 20000.0, 10.0),
+                          _stat("w1", 21000.0, 10.0)]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert v["inconclusive"] is True and v["top_cause"] == ""
+    assert v["window"]["excess_ms"] == 0.0
+    assert [c["cause"] for c in v["causes"]] == sorted(CAUSES)
+
+
+def test_compile_storm_oracle():
+    # excess = 510 - 10 = 500 ms; storm compile = 100 + 300 = 400 ms
+    # -> exactly 0.8, with the compile event as the evidence pointer
+    recs = _baseline() + [_compile("cold", 300.0, 100.0),
+                          _stat("w0", 20000.0, 510.0),
+                          _stat("w1", 21000.0, 10.0)]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert v["top_cause"] == "compile_storm"
+    top = v["causes"][0]
+    assert top["score"] == 0.8
+    assert top["evidence"] == [["", "", 5]]   # the compile line
+    assert v["window"]["baseline_p50_ms"] == 10.0
+    assert v["window"]["excess_ms"] == 500.0
+
+
+def test_trigger_taxonomy_splits_attribution():
+    # excess 400: evict-rebuild 200 -> tier 0.5; cold 100 -> storm
+    # 0.25; retrace 100 -> drift 0.25 — and the 0.25 tie breaks
+    # alphabetically (compile_storm before drift_recompile)
+    recs = _baseline() + [_compile("lru_evict_rebuild", 200.0),
+                          _compile("retrace", 100.0),
+                          _compile("cold", 100.0),
+                          _stat("w0", 20000.0, 410.0)]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert [c["cause"] for c in v["causes"][:3]] == \
+        ["tier_thrash", "compile_storm", "drift_recompile"]
+    assert _score(v, "tier_thrash")["score"] == 0.5
+    assert _score(v, "compile_storm")["score"] == 0.25
+    assert _score(v, "drift_recompile")["score"] == 0.25
+
+
+def test_tier_thrash_demotion_churn_oracle():
+    # demotions 5 -> 7 across the window under an ARMED budget, 4
+    # window queries -> churn score 2/4 = 0.5; zero excess, so the
+    # compile-fraction term contributes nothing
+    pre = {"kind": "incident", "incident_id": "p-1",
+           "surfaces": {"tier": {"armed": True, "demotions": 5}}}
+    post = {"kind": "incident", "incident_id": "p-2",
+            "surfaces": {"tier": {"armed": True, "demotions": 7}}}
+    recs = [_stat("b0", 0.0, 10.0), pre, _stat("b1", 1000.0, 10.0)]
+    recs += [_stat(f"w{i}", 20000.0 + i * 1000.0, 10.0)
+             for i in range(4)]
+    recs += [post]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert v["top_cause"] == "tier_thrash"
+    top = v["causes"][0]
+    assert top["score"] == 0.5
+    assert top["evidence"][0] == ["", "", len(recs)]   # the post bundle
+    # an unarmed tier surface scores nothing (no budget -> no thrash)
+    post_off = copy.deepcopy(post)
+    post_off["surfaces"]["tier"]["armed"] = False
+    v2 = plan_autopsy(recs[:-1] + [post_off], window=WINDOW)
+    assert v2["inconclusive"] is True
+
+
+def test_overload_shed_oracle():
+    recs = _baseline() + [
+        _stat("w0", 20000.0, 10.0),
+        _stat("w1", 21000.0, 0.0, shed=True),
+        _stat("w2", 22000.0, 0.0, shed=True),
+        _stat("w3", 23000.0, 0.0, shed=True)]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert v["top_cause"] == "overload_shed"
+    assert v["causes"][0]["score"] == 0.75
+    # shed queries are denied answers, never latency samples
+    assert v["window"]["excess_ms"] == 0.0
+
+
+def test_rebalance_churn_oracle():
+    moves = [{"kind": "rebalance_event", "phase": p}
+             for p in ("prewarm", "flip", "drain")]
+    plan_only = [{"kind": "rebalance_event", "phase": "plan"}]
+    recs = _baseline() + moves + plan_only + \
+        [_stat("w0", 20000.0, 10.0)]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert v["top_cause"] == "rebalance_churn"
+    assert v["causes"][0]["score"] == 0.5       # 3 / saturation 6
+    assert len(v["causes"][0]["evidence"]) == 3  # plan phase excluded
+
+
+def test_chaos_faults_delta_oracle():
+    # ingest counter 2 -> 4 (delta 2, cumulative, deltaed against the
+    # pre-window record) + a chaos replay_bench with 1 firing = 3
+    # firings over 4 window queries -> 0.75
+    pre = {"kind": "ingest_stats", "faults_fired": 2}
+    recs = [_stat("b0", 0.0, 10.0), pre, _stat("b1", 1000.0, 10.0)]
+    recs += [{"kind": "ingest_stats", "faults_fired": 4},
+             {"kind": "replay_bench", "faults_fired": 1}]
+    recs += [_stat(f"w{i}", 20000.0 + i * 1000.0, 10.0)
+             for i in range(4)]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert v["top_cause"] == "chaos_faults"
+    assert v["causes"][0]["score"] == 0.75
+
+
+def test_straggler_oracle_and_compile_discount():
+    # server_0 100 ms vs server_1 5 ms: ratio 20x, skew 95 ms over a
+    # 100 ms excess -> 0.95 with the trace as evidence
+    recs = _baseline() + [_stat("w0", 20000.0, 110.0),
+                          _trace("w0", {"server_0": 100.0,
+                                        "server_1": 5.0})]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert v["top_cause"] == "straggler"
+    assert v["causes"][0]["score"] == 0.95
+    assert "server_0" in v["causes"][0]["detail"]
+    # the same skew with 95 ms of in-window compile is a one-sided
+    # warmup, not a partitioned node: fully discounted
+    v2 = plan_autopsy(recs + [_compile("cold", 95.0)], window=WINDOW)
+    assert _score(v2, "straggler")["score"] == 0.0
+    assert v2["top_cause"] == "compile_storm"
+    # sub-floor skew (10 ms < 20 ms absolute floor) never counts
+    v3 = plan_autopsy(
+        _baseline() + [_stat("w0", 20000.0, 40.0),
+                       _trace("w0", {"server_0": 30.0,
+                                     "server_1": 15.0})],
+        window=WINDOW)
+    assert _score(v3, "straggler")["score"] == 0.0
+
+
+def test_ingest_stall_oracle():
+    stale = {"kind": "slo_status", "slo_kind": "freshness",
+             "stale": True}
+    burning = {"kind": "slo_status", "slo_kind": "freshness",
+               "burn_slow": 2.0, "threshold": 4.0}
+    recs = _baseline() + [burning, _stat("w0", 20000.0, 10.0)]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert _score(v, "ingest_stall")["score"] == 0.5
+    v2 = plan_autopsy(recs + [stale], window=WINDOW)
+    assert v2["top_cause"] == "ingest_stall"
+    assert v2["causes"][0]["score"] == 1.0
+
+
+def test_below_min_score_is_inconclusive_but_still_ranked():
+    # 1 shed of 8 window queries = 0.125 < MIN_SCORE: the verdict is
+    # an explicit non-answer, yet the ranked taxonomy still reports it
+    recs = _baseline() + [
+        _stat(f"w{i}", 20000.0 + i * 1000.0, 10.0) for i in range(7)]
+    recs += [_stat("w7", 27000.0, 0.0, shed=True)]
+    v = plan_autopsy(recs, window=WINDOW)
+    assert v["inconclusive"] is True and v["top_cause"] == ""
+    assert v["causes"][0]["cause"] == "overload_shed"
+    assert 0.0 < v["causes"][0]["score"] < MIN_SCORE
+
+
+# ---------------------------------------------------------------------------
+# determinism + pointer resolution
+# ---------------------------------------------------------------------------
+
+def test_same_corpus_twice_is_byte_identical():
+    recs = _baseline() + [_compile("cold", 300.0, 100.0),
+                          _stat("w0", 20000.0, 510.0),
+                          _trace("w0", {"server_0": 90.0,
+                                        "server_1": 4.0})]
+    v1 = plan_autopsy(copy.deepcopy(recs), window=WINDOW)
+    v2 = plan_autopsy(copy.deepcopy(recs), window=WINDOW)
+    assert json.dumps(v1, sort_keys=True) == \
+        json.dumps(v2, sort_keys=True)
+
+
+def test_evidence_pointers_resolve_through_read_ledger_since(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    for i in range(4):
+        uledger.append_record(uledger.make_record(
+            "query_stats", qid=f"b{i}", table="t", wall_ms=10.0,
+            arrival_ms=i * 1000.0, partial=False, servers_queried=1,
+            servers_responded=1, exception_codes=[]), path)
+    uledger.append_record(uledger.make_record(
+        "compile_event", site="engine.agg", trigger="cold",
+        plan_shape=None, key_fp="fp", backend="cpu", lower_ms=100.0,
+        compile_ms=300.0, donated=False, proc="p-test", seq=1), path)
+    uledger.append_record(uledger.make_record(
+        "query_stats", qid="w0", table="t", wall_ms=510.0,
+        arrival_ms=20000.0, partial=False, servers_queried=1,
+        servers_responded=1, exception_codes=[]), path)
+    v = plan_autopsy(load_corpus(path), window=WINDOW)
+    assert v["top_cause"] == "compile_storm"
+    assert v["evidence_total"] >= 1
+    for cause in v["causes"]:
+        for node, proc, seq in cause["evidence"]:
+            recs, _ = read_ledger_since(path, seq - 1)
+            assert recs, f"pointer {seq} fell off the ledger"
+            hit = recs[0]
+            assert str(hit.get("node") or "") == node
+            assert str(hit.get("proc") or "") == proc
+
+
+# ---------------------------------------------------------------------------
+# the whydown per-query lane
+# ---------------------------------------------------------------------------
+
+def test_whydown_overlap_and_event_slice():
+    recs = [_stat("q1", 1000.0, 100.0),          # 1.00 .. 1.10 s
+            _compile("cold", 50.0),
+            _stat("q2", 1050.0, 100.0),          # 1.05 .. 1.15 s
+            _stat("q3", 5000.0, 10.0)]           # disjoint
+    wd = whydown(recs, qid="q1")
+    assert wd["found"] is True and wd["queries"] == 2
+    assert [e["kind"] for e in wd["events"]] == ["compile_event"]
+    assert wd["events"][0]["ref"] == ["", "", 2]
+    assert wd["window"] == [1.0, 1.1]
+
+
+def test_whydown_unknown_qid_is_found_false():
+    wd = whydown([_stat("q1", 1000.0, 100.0)], qid="nope")
+    assert wd["found"] is False and wd["queries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the live plane (ring + ledger sink + incident attach)
+# ---------------------------------------------------------------------------
+
+def test_autopsy_plane_lands_verdict_and_attaches_ref(tmp_path):
+    from pinot_tpu.utils.slo import global_incidents
+    path = str(tmp_path / "ledger.jsonl")
+    global_autopsy.path = path
+    alert = uledger.make_record(
+        "alert", alert="unit", severity="page", rate_per_min=1.0,
+        watermark=1.0, window_s=60.0, proc=global_incidents.proc)
+    inc = global_incidents.request(alert, sync=True)
+    rec = global_autopsy.run(incident=inc)
+    assert rec["kind"] == "rca_verdict"
+    assert rec["incident_ref"] == inc["incident_id"]
+    assert rec["inconclusive"] is True   # empty corpus: non-answer
+    lres = uledger.validate_file(path)
+    assert not lres["errors"]
+    assert lres["kinds"]["rca_verdict"] == 1
+    snap = global_autopsy.snapshot()
+    assert snap["count"] == 1 and snap["computed"] == 1
+    entry = global_incidents.snapshot(limit=1)["incidents"][0]
+    assert entry["rca"]["inconclusive"] is True
+    assert entry["rca"]["seq"] == rec["seq"]
+
+
+def test_attribution_surface_pinned_in_detlint_roots():
+    from pinot_tpu.analysis.detlint import ROOTS
+    got = {name for mod, name in ROOTS
+           if mod == "pinot_tpu/cluster/autopsy.py"}
+    need = {"load_corpus", "assemble_window", "plan_autopsy",
+            "whydown"} | {f"score_{c}"
+                          for c in ("compile_storm", "tier_thrash",
+                                    "overload_shed", "rebalance_churn",
+                                    "chaos_faults", "straggler",
+                                    "drift_recompile", "ingest_stall")}
+    assert need <= got
+
+
+# ---------------------------------------------------------------------------
+# tier-1 CLI gates
+# ---------------------------------------------------------------------------
+
+def test_traffic_replay_autopsy_cli(capsys):
+    """ISSUE 20 acceptance: three injected causes each attributed
+    top-1 with every competitor strictly lower, both verdict
+    computations byte-identical, and the clean pass inconclusive."""
+    import traffic_replay as TR
+    assert TR.main(["--autopsy", "--queries", "6", "--rows", "512"]) \
+        == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["ok"] and summary["scenario"] == "autopsy_replay"
+    assert summary["deterministic"] is True
+    ap = summary["extra"]["autopsy"]
+    assert ap["clean"]["inconclusive"] and ap["clean"]["top_cause"] == ""
+    for tag in ("straggler", "compile_storm", "tier_thrash"):
+        assert ap[tag]["top_cause"] == tag, (tag, ap[tag])
+        assert not ap[tag]["inconclusive"]
+
+
+def test_chaos_smoke_autopsy_cli(capsys):
+    """ISSUE 20 acceptance: a real SLO burn lands a hook-run verdict
+    on the incident's ring entry, the fleet verdict's evidence
+    pointers all resolve, and the clean window says inconclusive."""
+    import chaos_smoke
+    assert chaos_smoke.main(["--autopsy", "--rows", "512"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["ok"] and summary["mode"] == "autopsy"
+    assert summary["autopsies"] >= 1
+    assert summary["fleet_top"] == "compile_storm"
+    assert summary["evidence_pointers"] >= 1
+    assert summary["ledger_kinds"]["rca_verdict"] >= 1
+
+
+@pytest.mark.slow
+def test_autopsy_gate_soak():
+    import traffic_replay as TR
+    summary = TR.run_autopsy_gate(seed=7, n_queries=24, rows=2048,
+                                  qps=25.0)
+    assert summary["ok"], summary["failures"]

@@ -488,7 +488,7 @@ def test_debug_index_per_role(slo_cluster):
     c = _get(f"{ctrl.url}/debug")
     assert c["role"] == "controller"
     assert set(c["surfaces"]) == {"/debug/fleet", "/debug/incidents",
-                                  "/debug/rebalance"}
+                                  "/debug/rebalance", "/debug/autopsy"}
 
 
 def test_live_burn_alert_incident_over_http(slo_cluster):

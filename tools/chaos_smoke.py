@@ -748,6 +748,180 @@ def main_rebalance(args) -> int:
     return 0 if not failures else 1
 
 
+AUTOPSY_ROWS = 1024
+
+
+def main_autopsy(args) -> int:
+    """--autopsy: the incident-autopsy chaos gate (ISSUE 20): a REAL
+    SLO burn fires an alert, the flight recorder captures the incident
+    and its post hook runs attribution on the capture thread — the
+    ring entry must carry the ``rca`` verdict ref and the ledger a
+    contract-valid ``rca_verdict``; a fleet-level verdict over the
+    rollup's pulled corpus must name an injected compile storm with
+    EVERY evidence pointer resolvable back to its ledger line by
+    (node, proc, seq); and a clean follow-up window must say
+    ``inconclusive`` explicitly rather than confabulate a cause."""
+    import time as _time
+
+    import traffic_replay as TR
+    from pinot_tpu.cluster.autopsy import (global_autopsy, load_corpus,
+                                           plan_autopsy)
+    from pinot_tpu.cluster.forensics import read_ledger_since
+    from pinot_tpu.engine.tier import global_tier
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils import ledger as uledger
+    from pinot_tpu.utils.compileplane import (clear_staged_caches,
+                                              global_compile_log)
+    from pinot_tpu.utils.slo import (event_time, global_incidents,
+                                     global_slo)
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_autopsy_chaos_")
+    failures = []
+    summary = {"mode": "autopsy", "rows": args.rows, "seed": args.seed,
+               "queries": 0, "faults_fired": 0}
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+            print(f"FAIL {name}: {detail}")
+
+    faults.clear()
+    global_slo.clear()
+    global_incidents.reset()
+    global_incidents.post_hook = None   # the broker re-wires below
+    global_autopsy.reset()
+    global_autopsy.path = None
+    global_tier.configure(budget_bytes=None)
+    had_compile_path = bool(global_compile_log.path)
+    stop = None
+    try:
+        ctrl, servers, broker, stop = TR.build_autopsy_cluster(
+            tmp, args.rows)
+        path = broker.forensics.ledger_path
+        mix = TR.build_autopsy_mix(args.seed, 8)
+        summary["queries"] = len(mix)
+        seen = set()
+        for q in mix:           # warmup: compiles land off-window
+            key = q["sql"].split("FROM")[0]
+            if key not in seen:
+                seen.add(key)
+                TR._rb_phase(broker.url, [q], f"cwarm{len(seen)}",
+                             qps=1e9)
+
+        def t_cut_after(seq0):
+            times = [t for t in (
+                event_time(r) for r in load_corpus(path)
+                if r["_seq"] > seq0 and r.get("kind") == "query_stats")
+                if t is not None]
+            return (max(times) + 1e-6) if times else 0.0
+
+        # (a) baseline window, then a real burn THROUGH a compile
+        # storm: an unmeetable latency objective makes every query a
+        # bad event, the burn-rate alert fires on the live feed path,
+        # the recorder captures the incident and the post hook lands
+        # the verdict — nothing in this gate calls the autopsy plane
+        # directly
+        TR._rb_phase(broker.url, mix, "cbase", qps=50.0)
+        t_cut = t_cut_after(0)
+        check("baseline.stats", t_cut > 0.0,
+              "no baseline query_stats landed in the ledger")
+        global_slo.set_objective(TR.AUTOPSY_TABLE, "latency",
+                                 bar_ms=0.01, objective=0.9)
+        clear_staged_caches()   # the cause the fleet verdict must name
+        TR._rb_phase(broker.url, mix, "cburn", qps=50.0)
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline and \
+                global_incidents.snapshot(limit=0)["count"] < 1:
+            _time.sleep(0.05)
+        global_slo.clear()      # disarm before the clean window
+        check("incident.captured",
+              global_incidents.snapshot(limit=0)["count"] >= 1,
+              "burn alert never captured an incident")
+        check("incident.drained", global_incidents.drain(timeout=10.0),
+              "capture queue never drained")
+
+        # (b) the ring answers "what burned AND why" in one lookup,
+        # and the landed verdict honors the ledger contract
+        entry = (global_incidents.snapshot(limit=1)["incidents"]
+                 or [{}])[0]
+        check("incident.rca_ref", bool(entry.get("rca")),
+              f"no rca ref on {entry.get('incident_id')}")
+        ap = global_autopsy.snapshot(limit=1)
+        summary["autopsies"] = ap["computed"]
+        check("autopsy.computed",
+              ap["computed"] >= 1 and ap["errors"] == 0,
+              f"computed={ap['computed']} errors={ap['errors']}")
+        lres = uledger.validate_file(path)
+        summary["ledger_kinds"] = lres["kinds"]
+        check("ledger.valid", not lres["errors"],
+              f"invalid records: {lres['errors'][:3]}")
+        check("ledger.rca_verdict",
+              lres["kinds"].get("rca_verdict", 0) >= 1,
+              f"kinds={lres['kinds']}")
+
+        # (c) fleet-level attribution: pull the node ledger into the
+        # rollup's fleet ledger, plan over THAT corpus, and walk every
+        # evidence pointer back to its ledger line
+        ctrl.rollup.run()
+        fleet_path = ctrl.rollup.ledger_path
+        fleet = plan_autopsy(load_corpus(fleet_path),
+                             window=(t_cut, None))
+        summary["fleet_top"] = fleet["top_cause"]
+        check("fleet.top_cause", fleet["top_cause"] == "compile_storm",
+              f"top {fleet['top_cause'] or '<inconclusive>'}: " +
+              ", ".join(f"{c['cause']}={c['score']}"
+                        for c in fleet["causes"][:3]))
+        ptrs = [p for c in fleet["causes"] for p in c["evidence"]]
+        summary["evidence_pointers"] = len(ptrs)
+        check("fleet.evidence", len(ptrs) >= 1, "verdict has no "
+              "evidence to resolve")
+        for node, proc, seq in ptrs:
+            recs, _ = read_ledger_since(fleet_path, seq - 1)
+            hit = recs[0] if recs else {}
+            if not (str(hit.get("node") or "") == node
+                    and str(hit.get("proc") or "") == proc):
+                check(f"fleet.pointer.{seq}", False,
+                      f"[{node},{proc},{seq}] resolved to "
+                      f"{hit.get('kind')}/{hit.get('node')}/"
+                      f"{hit.get('proc')}")
+
+        # (d) no anomaly -> an EXPLICIT inconclusive, not a
+        # confabulated cause
+        seq0 = load_corpus(path)[-1]["_seq"]
+        TR._rb_phase(broker.url, mix, "ccb", qps=50.0)
+        t_clean = t_cut_after(seq0)
+        TR._rb_phase(broker.url, mix, "ccw", qps=50.0)
+        clean = plan_autopsy(
+            [r for r in load_corpus(path) if r["_seq"] > seq0],
+            window=(t_clean, None))
+        check("clean.inconclusive",
+              clean["inconclusive"] and clean["top_cause"] == "",
+              f"clean window confabulated {clean['top_cause']}="
+              f"{clean['causes'][0]['score']}")
+    except Exception as e:  # noqa: BLE001 — into the summary
+        check("autopsy.run", False, f"EXC {type(e).__name__}: {e}")
+    finally:
+        faults.clear()
+        global_tier.configure(budget_bytes=None)
+        global_slo.clear()
+        global_slo.path = None
+        global_incidents.reset()
+        global_incidents.path = None
+        global_incidents.post_hook = None
+        global_autopsy.reset()
+        global_autopsy.path = None
+        if not had_compile_path:
+            global_compile_log.configure(path="")
+        if stop is not None:
+            stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    return 0 if not failures else 1
+
+
 VECTOR_ROWS = 4096
 VECTOR_DIM = 16
 VECTOR_LISTS = 16
@@ -1514,6 +1688,11 @@ def main(argv=None) -> int:
                          "burn-triggered move under rebalance.crash + "
                          "cutover.stall recovers byte-exact, incident "
                          "freeze honored, pools reconciled")
+    ap.add_argument("--autopsy", action="store_true",
+                    help="run the incident-autopsy gate: a real SLO "
+                         "burn -> incident -> post-hook rca_verdict "
+                         "with resolvable fleet evidence pointers, "
+                         "and a clean window says inconclusive")
     ap.add_argument("--fused", action="store_true",
                     help="run the whole-plan mesh compilation gate: "
                          "fused == mailbox parity, device.overflow "
@@ -1536,6 +1715,7 @@ def main(argv=None) -> int:
             else TIER_ROWS if args.tier \
             else VECTOR_ROWS if args.vector \
             else REBALANCE_ROWS if args.rebalance \
+            else AUTOPSY_ROWS if args.autopsy \
             else FUSED_ROWS if args.fused else 4096
     if args.ingest:
         return main_ingest(args)
@@ -1549,6 +1729,8 @@ def main(argv=None) -> int:
         return main_vector(args)
     if args.rebalance:
         return main_rebalance(args)
+    if args.autopsy:
+        return main_autopsy(args)
     if args.fused:
         return main_fused(args)
 

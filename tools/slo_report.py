@@ -25,6 +25,11 @@ have paged — fails with exit 1 and ``GATE FAIL:`` lines. ``--min-events``
 (default 1) guards the structurally vacuous green: a corpus with no
 ``query_stats`` records means the forensics plane is broken, not that
 the SLOs are healthy.
+
+``report --autopsy`` (round 25) joins each captured incident to its
+``rca_verdict`` record (cluster/autopsy.py) — one command answers
+"what burned and why": the verdict's top cause, an explicit
+``inconclusive``, or ``pending`` when attribution hasn't landed yet.
 """
 from __future__ import annotations
 
@@ -44,7 +49,8 @@ from pinot_tpu.utils.slo import (  # noqa: E402
 GATE_KINDS = ("query_stats", "slo_status", "alert", "incident")
 
 
-def load_records(paths: List[str]) -> List[Dict[str, Any]]:
+def load_records(paths: List[str],
+                 kinds: tuple = GATE_KINDS) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     for path in paths:
         if not os.path.exists(path):
@@ -58,9 +64,42 @@ def load_records(paths: List[str]) -> List[Dict[str, Any]]:
                     rec = json.loads(line)
                 except ValueError:
                     continue
-                if isinstance(rec, dict) and rec.get("kind") in GATE_KINDS:
+                if isinstance(rec, dict) and rec.get("kind") in kinds:
                     out.append(rec)
     return out
+
+
+def autopsy_join(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The --autopsy section rows (pure, ledger order): each captured
+    incident joined to its ``rca_verdict`` by ``incident_ref`` —
+    verdicts keyed last-wins, the incident discipline's (proc, seq)
+    identity making re-runs supersede. ``verdict`` is the top cause, an
+    explicit ``inconclusive``, or ``pending`` when attribution hasn't
+    landed (recorder hook unwired / still in flight)."""
+    verdicts: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        if r.get("kind") == "rca_verdict" and r.get("incident_ref"):
+            verdicts[str(r["incident_ref"])] = r
+    rows: List[Dict[str, Any]] = []
+    for r in records:
+        if r.get("kind") != "incident":
+            continue
+        iid = str(r.get("incident_id") or "")
+        v = verdicts.get(iid)
+        if v is None:
+            status = "pending"
+        elif v.get("inconclusive"):
+            status = "inconclusive"
+        else:
+            status = str(v.get("top_cause") or "")
+        top = (v.get("causes") or [{}])[0] if v else {}
+        rows.append({"incident_id": iid,
+                     "alert": str(r.get("alert") or ""),
+                     "severity": r.get("severity"),
+                     "verdict": status,
+                     "score": top.get("score"),
+                     "detail": top.get("detail")})
+    return rows
 
 
 def build_objectives(records: List[Dict[str, Any]],
@@ -138,10 +177,15 @@ def main(argv=None) -> int:
     ap.add_argument("--min-events", type=int, default=1,
                     help="gate: minimum query_stats records for a "
                          "non-vacuous pass (default %(default)s)")
+    ap.add_argument("--autopsy", action="store_true",
+                    help="report: join each captured incident to its "
+                         "rca_verdict (top cause / inconclusive / "
+                         "pending)")
     args = ap.parse_intermixed_args(argv)
 
     ledgers = args.ledgers or [os.path.join(REPO, "PERF_LEDGER.jsonl")]
-    records = load_records(ledgers)
+    kinds = GATE_KINDS + ("rca_verdict",) if args.autopsy else GATE_KINDS
+    records = load_records(ledgers, kinds=kinds)
     objectives = build_objectives(
         records, args.latency_bar_ms, args.availability_objective,
         args.objective, args.fast_s, args.slow_s, args.burn_threshold)
@@ -157,10 +201,31 @@ def main(argv=None) -> int:
                   f"burn {row['burn_fast']}x/{row['burn_slow']}x "
                   f"budget {row['budget_remaining'] * 100:.1f}% "
                   f"({row['bad']}/{row['events']} bad)")
+        extra: Dict[str, Any] = {}
+        if args.autopsy:
+            rows = autopsy_join(records)
+            print(f"autopsy: {len(rows)} incident(s)")
+            for row in rows:
+                score = "" if row["score"] is None \
+                    else f" ({row['score']})"
+                print(f"  {row['incident_id']} [{row['alert']}/"
+                      f"{row['severity']}]: {row['verdict']}{score}")
+                if row["detail"]:
+                    print(f"    {row['detail']}")
+            extra["autopsy"] = {
+                "incidents": len(rows),
+                "attributed": sum(
+                    1 for r in rows
+                    if r["verdict"] not in ("pending", "inconclusive")),
+                "inconclusive": sum(1 for r in rows
+                                    if r["verdict"] == "inconclusive"),
+                "pending": sum(1 for r in rows
+                               if r["verdict"] == "pending")}
         print(json.dumps({"mode": "report", "ok": True,
                           **{k: rep[k] for k in
                              ("queries", "objectives", "alerts_planned",
-                              "worst_burn_slow", "recorded")}}))
+                              "worst_burn_slow", "recorded")},
+                          **extra}))
         return 0
 
     failures: List[str] = []

@@ -1300,6 +1300,281 @@ def run_rebalance_gate(seed: int = 20260807, n_queries: int = 24,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# -- the incident-autopsy gate (round 25) -----------------------------------
+#
+# Four passes over ONE warmed cluster, each sliced out of the broker's
+# ledger by sequence: a clean pass must yield an EXPLICIT inconclusive
+# verdict, then three injected causes — donor-only ``segment.slow``
+# chaos, a cleared-cache compile storm, a starved HBM-budget tier
+# thrash — must each be named top-1 with every competing cause scored
+# strictly lower, and each verdict computed twice must be
+# byte-identical (cluster/autopsy.py plan_autopsy is a detlint ROOTS
+# member, so the same corpus can never rank differently).
+
+AUTOPSY_TABLE = "ap_events"
+AUTOPSY_DELAY_MS = 60.0
+# far below one segment column: every admission demotes everything else
+AUTOPSY_TIER_BUDGET_BYTES = 4096
+
+
+def build_autopsy_cluster(tmp: str, rows: int = 1024,
+                          poll: float = 0.1):
+    """Controller + 2 servers + broker WITH a stats/trace ledger and
+    full trace sampling (the straggler scorer reads per-server scatter
+    spans out of ``query_trace`` records), one table replicated on both
+    servers so every query scatters to both — the geometry a one-sided
+    ``segment.slow`` plan must show up in."""
+    from pinot_tpu.cluster import BrokerNode, Controller, ServerNode
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.spi import TableConfig
+
+    ctrl = Controller(os.path.join(tmp, "ctrl"), heartbeat_timeout=5.0,
+                      reconcile_interval=0.2)
+    servers = [ServerNode(f"server_{i}", ctrl.url, poll_interval=poll)
+               for i in range(2)]
+    broker = BrokerNode(ctrl.url, routing_refresh=poll,
+                        query_stats_path=os.path.join(
+                            tmp, "query_stats.jsonl"),
+                        trace_ratio=1.0)
+    cols = _gen_columns(rows)
+    schema = _schema(AUTOPSY_TABLE)
+    builder = SegmentBuilder(schema, TableConfig(AUTOPSY_TABLE))
+    ctrl.add_table(AUTOPSY_TABLE, schema.to_dict(), replication=2)
+    half = rows // 2
+    for i, (lo, hi) in enumerate(((0, half), (half, rows))):
+        d = builder.build({n: v[lo:hi] for n, v in cols.items()},
+                          os.path.join(tmp, AUTOPSY_TABLE), f"seg_{i}")
+        ctrl.add_segment(AUTOPSY_TABLE, f"seg_{i}", d)
+    v = ctrl.routing_snapshot()["version"]
+    for s in servers:
+        assert s.wait_for_version(v, timeout=30.0), "server never synced"
+    assert broker.wait_for_version(v, timeout=30.0), "broker never synced"
+    # park the closed loop: nothing may move segments mid-gate (the
+    # rebalance-churn scorer must see an empty move stream)
+    ctrl.scheduler._next_run[ctrl.rebalancer.NAME] = \
+        time.monotonic() + 1e9
+
+    def stop():
+        broker.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        ctrl.stop()
+
+    return ctrl, servers, broker, stop
+
+
+def build_autopsy_mix(seed: int, n_queries: int) -> List[Dict[str, Any]]:
+    """The seeded single-table (qid, sql) sequence — pure in (seed, n)."""
+    import numpy as np
+    rng = np.random.default_rng([seed, 2025])
+    out = []
+    for i in range(n_queries):
+        shape = QUERY_SHAPES[int(rng.integers(len(QUERY_SHAPES)))]
+        out.append({"qid": f"ap{seed}_{i}", "table": AUTOPSY_TABLE,
+                    "sql": shape.format(
+                        t=AUTOPSY_TABLE,
+                        p=int(rng.integers(100, 1000)))})
+    return out
+
+
+def run_autopsy_gate(seed: int = 20260807, n_queries: int = 12,
+                     rows: int = 1024, qps: float = 25.0,
+                     ledger_out: Optional[str] = None
+                     ) -> Dict[str, Any]:
+    """The incident-autopsy gate (section comment above). Returns the
+    summary dict; ``ok`` is the verdict."""
+    from pinot_tpu.cluster.autopsy import (global_autopsy, load_corpus,
+                                           plan_autopsy, whydown)
+    from pinot_tpu.engine.tier import global_tier
+    from pinot_tpu.utils import faults
+    from pinot_tpu.utils import ledger as uledger
+    from pinot_tpu.utils.compileplane import (clear_staged_caches,
+                                              global_compile_log)
+    from pinot_tpu.utils.slo import (event_time, global_incidents,
+                                     global_slo)
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_autopsy_")
+    failures: List[str] = []
+    summary: Dict[str, Any] = {
+        "scenario": "autopsy_replay", "seed": seed, "multiple": 1.0,
+        "queries_recorded": n_queries, "mode": "cluster"}
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        if not ok:
+            failures.append(f"{name}: {detail}")
+
+    faults.clear()
+    global_slo.clear()
+    global_incidents.reset()
+    global_incidents.post_hook = None   # the broker re-wires below
+    global_autopsy.reset()
+    global_autopsy.path = None
+    global_tier.configure(budget_bytes=None)
+    had_compile_path = bool(global_compile_log.path)
+    stop = None
+    t_start = time.perf_counter()
+    try:
+        ctrl, servers, broker, stop = build_autopsy_cluster(tmp, rows)
+        path = broker.forensics.ledger_path
+        mix = build_autopsy_mix(seed, n_queries)
+
+        # wiring sanity: the broker adopted its ledger for the autopsy
+        # plane and hooked attribution onto incident capture
+        check("wire.autopsy_path", global_autopsy.path == path,
+              f"autopsy ledger {global_autopsy.path} != {path}")
+        check("wire.post_hook",
+              getattr(global_incidents.post_hook, "__self__", None)
+              is global_autopsy,
+              "incident post hook not wired to the autopsy plane")
+
+        # warmup: each query shape pays its XLA compile off-corpus, so
+        # the clean pass sees zero in-window compile events
+        seen = set()
+        for q in mix:
+            key = q["sql"].split("FROM")[0]
+            if key in seen:
+                continue
+            seen.add(key)
+            _rb_phase(broker.url, [q], f"apwarm{len(seen)}", qps=1e9)
+
+        def probe(tag: str) -> None:
+            # a synthetic info-severity alert captures a REAL incident
+            # bundle (tier/devmem/overload/compile/slo surfaces) — the
+            # pre/post tier blocks the thrash scorer deltas, and each
+            # capture also exercises the post-hook auto-run
+            alert = uledger.make_record(
+                "alert", alert=f"autopsy_probe_{tag}", severity="info",
+                rate_per_min=0.0, watermark=0.0, window_s=0.0,
+                proc=global_incidents.proc)
+            global_incidents.request(alert, sync=True)
+
+        def run_pass(tag: str, expected: Optional[str],
+                     inject=None, revert=None) -> Dict[str, Any]:
+            prior = load_corpus(path)
+            seq0 = prior[-1]["_seq"] if prior else 0
+            probe(f"{tag}_pre")   # pre-window bundle (baseline tier)
+            base = _rb_phase(broker.url, mix, f"{tag}b", qps)
+            check(f"{tag}.baseline_errors", base["errors"] == 0,
+                  f"{base['errors']} errors during the baseline")
+            times = [t for t in (
+                event_time(r) for r in load_corpus(path)
+                if r["_seq"] > seq0 and r.get("kind") == "query_stats")
+                if t is not None]
+            check(f"{tag}.baseline_stats", bool(times),
+                  "no baseline query_stats landed in the ledger")
+            t_cut = max(times or [0.0]) + 1e-6
+            if inject is not None:
+                inject()
+            try:
+                win = _rb_phase(broker.url, mix, f"{tag}w", qps)
+                probe(f"{tag}_post")   # bundle while still injected
+            finally:
+                if revert is not None:
+                    revert()
+            check(f"{tag}.window_errors", win["errors"] == 0,
+                  f"{win['errors']} errors during the window")
+            corpus = [r for r in load_corpus(path) if r["_seq"] > seq0]
+            v1 = plan_autopsy(corpus, window=(t_cut, None))
+            v2 = plan_autopsy(corpus, window=(t_cut, None))
+            check(f"{tag}.byte_identical",
+                  json.dumps(v1, sort_keys=True)
+                  == json.dumps(v2, sort_keys=True),
+                  "two same-corpus verdicts diverged")
+            ranked = v1["causes"]
+            if expected is None:
+                check(f"{tag}.inconclusive",
+                      v1["inconclusive"] and v1["top_cause"] == "",
+                      "clean pass confabulated "
+                      f"{ranked[0]['cause']}={ranked[0]['score']}")
+            else:
+                check(f"{tag}.top_cause", v1["top_cause"] == expected,
+                      f"top {v1['top_cause'] or '<inconclusive>'} != "
+                      f"{expected}: " + ", ".join(
+                          f"{c['cause']}={c['score']}"
+                          for c in ranked[:3]))
+                check(f"{tag}.margin",
+                      ranked[0]["score"] > ranked[1]["score"],
+                      f"competing cause not strictly lower: "
+                      f"{ranked[0]['cause']}={ranked[0]['score']} vs "
+                      f"{ranked[1]['cause']}={ranked[1]['score']}")
+            return v1
+
+        verdicts: Dict[str, Dict[str, Any]] = {}
+        verdicts["clean"] = run_pass("apc", None)
+        verdicts["straggler"] = run_pass(
+            "aps", "straggler",
+            inject=lambda: faults.install(
+                f"seed={seed}; segment.slow: match=server_0, "
+                f"delay_ms={AUTOPSY_DELAY_MS:.0f}, times=-1"),
+            revert=faults.clear)
+        verdicts["compile_storm"] = run_pass(
+            "apk", "compile_storm", inject=clear_staged_caches)
+        verdicts["tier_thrash"] = run_pass(
+            "apt", "tier_thrash",
+            inject=lambda: global_tier.configure(
+                budget_bytes=AUTOPSY_TIER_BUDGET_BYTES),
+            revert=lambda: global_tier.configure(budget_bytes=None))
+
+        # the per-query lane: whydown over a straggler-window query
+        # must find it and surface the overlapping cross-plane events
+        wd = whydown(load_corpus(path), qid=f"apsw_{mix[0]['qid']}")
+        check("whydown.found",
+              bool(wd["found"]) and wd["queries"] >= 1, str(wd))
+
+        summary.update({
+            "backend": _backend(),
+            "offered": 8 * n_queries,
+            "completed": 8 * n_queries,
+            "shed": 0,
+            "goodput_qps": round(
+                n_queries
+                / max(time.perf_counter() - t_start, 1e-3), 3),
+            "duration_s": round(time.perf_counter() - t_start, 3),
+            "faults_fired": 0,
+            "chaos": True,
+            "deterministic": not any("byte_identical" in f
+                                     for f in failures),
+            "extra": {"autopsy": {
+                tag: {"top_cause": v["top_cause"],
+                      "inconclusive": v["inconclusive"],
+                      "top_score": v["causes"][0]["score"],
+                      "excess_ms": v["window"]["excess_ms"],
+                      "evidence_total": v["evidence_total"]}
+                for tag, v in verdicts.items()}},
+            "ok": not failures,
+        })
+        if failures:
+            summary["error"] = "; ".join(failures[:4])
+        if ledger_out:
+            contract = uledger.KINDS["replay_bench"]
+            allowed = contract["required"] | contract["optional"]
+            rec = uledger.make_record("replay_bench", **{
+                k: v for k, v in summary.items() if k in allowed})
+            uledger.append_record(rec, ledger_out)
+        summary["failures"] = failures
+        return summary
+    finally:
+        faults.clear()
+        global_tier.configure(budget_bytes=None)
+        global_slo.clear()
+        global_slo.path = None
+        global_incidents.reset()
+        global_incidents.path = None
+        global_incidents.post_hook = None
+        global_autopsy.reset()
+        global_autopsy.path = None
+        if not had_compile_path:
+            # the broker adopted the tmp ledger (first-wins); release
+            # it so a later in-process broker can adopt its own
+            global_compile_log.configure(path="")
+        if stop is not None:
+            stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _backend() -> str:
     try:
         import jax
@@ -1336,10 +1611,27 @@ def main(argv=None) -> int:
     r.add_argument("--qps", type=float, default=12.0)
     r.add_argument("--ledger", default=None,
                    help="append the replay_bench record here")
+    a = sub.add_parser("autopsy",
+                       help="incident-autopsy replay gate (ISSUE 20)")
+    a.add_argument("--seed", type=int, default=20260807)
+    a.add_argument("--queries", type=int, default=12)
+    a.add_argument("--rows", type=int, default=1024)
+    a.add_argument("--qps", type=float, default=25.0)
+    a.add_argument("--ledger", default=None,
+                   help="append the replay_bench record here")
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv[:1] == ["--rebalance"]:  # flag spelling of the subcommand
         argv[0] = "rebalance"
+    if argv[:1] == ["--autopsy"]:   # flag spelling of the subcommand
+        argv[0] = "autopsy"
     args = ap.parse_args(argv)
+    if args.cmd == "autopsy":
+        summary = run_autopsy_gate(seed=args.seed,
+                                   n_queries=args.queries,
+                                   rows=args.rows, qps=args.qps,
+                                   ledger_out=args.ledger)
+        print(json.dumps(summary))
+        return 0 if summary.get("ok") else 1
     if args.cmd == "rebalance":
         summary = run_rebalance_gate(seed=args.seed,
                                      n_queries=args.queries,
